@@ -1,0 +1,40 @@
+"""Test harness: CPU-simulated 8-device mesh.
+
+The reference has no cluster-free distributed story (SURVEY.md §4): its tests
+need mpirun + real GPUs.  Here every pattern runs in CI on 8 virtual CPU
+devices with real XLA collectives — the config is forced before first backend
+use so it also overrides the environment's TPU platform plugin.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("TPU_PATTERNS_TEST_DEVICES", "8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", int(os.environ["TPU_PATTERNS_TEST_DEVICES"]))
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, "conftest must provide >= 8 virtual devices"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh1d(devices):
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(devices[:8]).reshape(8), ("x",))
+
+
+@pytest.fixture(scope="session")
+def mesh2d(devices):
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(devices[:8]).reshape(4, 2), ("x", "y"))

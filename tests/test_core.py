@@ -1,0 +1,183 @@
+"""Tests for core config/results/timing (SURVEY.md §7 step 1)."""
+
+import dataclasses
+import enum
+import io
+
+import pytest
+
+from tpu_patterns.core import (
+    Record,
+    ResultWriter,
+    TimingResult,
+    Verdict,
+    clock_ns,
+    config_from_tiers,
+    device_barrier,
+    global_interval_ns,
+    min_over_reps,
+    parse_log,
+)
+from tpu_patterns.core.config import config_to_dict
+from tpu_patterns.core.results import tabulate_records
+
+
+class Mode(enum.Enum):
+    SERIAL = "serial"
+    ASYNC = "async"
+
+
+@dataclasses.dataclass
+class DemoConfig:
+    reps: int = 10
+    min_bandwidth: float = -1.0
+    verbose: bool = False
+    mode: Mode = Mode.SERIAL
+    commands: tuple[str, ...] = ("C",)
+
+
+class TestConfigTiers:
+    def test_defaults(self):
+        cfg = config_from_tiers(DemoConfig, argv=[], env={})
+        assert cfg == DemoConfig()
+
+    def test_env_tier(self):
+        cfg = config_from_tiers(
+            DemoConfig, argv=[], env={"TPU_PATTERNS_REPS": "3", "TPU_PATTERNS_MODE": "async"}
+        )
+        assert cfg.reps == 3
+        assert cfg.mode is Mode.ASYNC
+
+    def test_cli_overrides_env(self):
+        cfg = config_from_tiers(
+            DemoConfig,
+            argv=["--reps", "7", "--commands", "C,M2D", "--verbose", "true"],
+            env={"TPU_PATTERNS_REPS": "3"},
+        )
+        assert cfg.reps == 7
+        assert cfg.commands == ("C", "M2D")
+        assert cfg.verbose is True
+
+    def test_to_dict_json_friendly(self):
+        d = config_to_dict(DemoConfig())
+        assert d["mode"] == "serial"
+        assert d["commands"] == ["C"]
+
+    def test_pep604_optional_field(self):
+        @dataclasses.dataclass
+        class C:
+            limit: int | None = None
+
+        assert config_from_tiers(C, argv=["--limit", "5"], env={}).limit == 5
+        assert config_from_tiers(C, argv=[], env={"TPU_PATTERNS_LIMIT": "7"}).limit == 7
+        assert config_from_tiers(C, argv=["--limit", "none"], env={}).limit is None
+
+
+class TestResults:
+    def test_record_roundtrip(self):
+        rec = Record(
+            pattern="p2p",
+            mode="unidirectional",
+            commands="pairs=4",
+            metrics={"bandwidth_gbps": 123.4},
+            verdict=Verdict.SUCCESS,
+        )
+        back = Record.from_json(rec.to_json())
+        assert back.metrics == rec.metrics
+        assert back.verdict is Verdict.SUCCESS
+
+    def test_writer_markers_and_exit_code(self, tmp_path):
+        buf = io.StringIO()
+        w = ResultWriter(tmp_path / "out.jsonl", stream=buf)
+        w.progress("auto-tuning")
+        w.metric("Unidirectional Bandwidth", 99.5, "GB/s")
+        w.record(Record(pattern="p2p", mode="uni", commands="2dev"))
+        w.record(
+            Record(pattern="p2p", mode="bi", commands="2dev", verdict=Verdict.FAILURE)
+        )
+        out = buf.getvalue()
+        assert "# auto-tuning" in out
+        assert "## uni | 2dev | SUCCESS" in out
+        assert "## bi | 2dev | FAILURE" in out
+        assert w.exit_code == 1
+        lines = (tmp_path / "out.jsonl").read_text().splitlines()
+        assert len(lines) == 2
+
+    def test_parse_log_reference_format(self):
+        # The exact shape concurency/parse.py consumes: export-context lines
+        # followed by ## verdict markers.
+        log = [
+            "+ export ZE_AFFINITY_MASK=0.0",
+            "## serial | C C | SUCCESS",
+            "## out_of_order | C C | FAILURE",
+            "+ export ZE_AFFINITY_MASK=0",
+            "## out_of_order | C C | SUCCESS",
+        ]
+        recs = parse_log(log)
+        assert len(recs) == 3
+        assert recs[1].verdict is Verdict.FAILURE
+        assert recs[2].env["ZE_AFFINITY_MASK"] == "0"
+
+    def test_parse_log_jsonl_dedup(self, tmp_path):
+        buf = io.StringIO()
+        w = ResultWriter(tmp_path / "o.jsonl", stream=buf)
+        rec = w.record(Record(pattern="x", mode="m", commands="c"))
+        # A log that interleaves the JSON record with its own marker line
+        mixed = [rec.to_json()] + buf.getvalue().splitlines()
+        recs = parse_log(mixed)
+        assert len(recs) == 1
+
+    def test_parse_log_dedup_marker_first_and_empty_commands(self, tmp_path):
+        # ResultWriter emits the marker to stdout BEFORE appending the JSON;
+        # `cat run.log out.jsonl` therefore puts markers first.  Records with
+        # empty commands fall back to the pattern name in both places.
+        buf = io.StringIO()
+        w = ResultWriter(tmp_path / "o.jsonl", stream=buf)
+        w.record(Record(pattern="p2p", mode="uni", commands=""))
+        mixed = (
+            buf.getvalue().splitlines()
+            + (tmp_path / "o.jsonl").read_text().splitlines()
+        )
+        recs = parse_log(mixed)
+        assert len(recs) == 1
+        assert recs[0].commands == "p2p"
+
+    def test_tabulate(self):
+        recs = [
+            Record(pattern="c", mode="serial", commands="C C", verdict=Verdict.SUCCESS),
+            Record(pattern="c", mode="async", commands="C C", verdict=Verdict.FAILURE),
+        ]
+        table = tabulate_records(recs)
+        assert "serial" in table and "async" in table and "C C" in table
+
+
+class TestTiming:
+    def test_clock_monotonic(self):
+        a = clock_ns()
+        b = clock_ns()
+        assert b >= a
+
+    def test_min_over_reps_runs_and_fences(self):
+        import jax.numpy as jnp
+
+        calls = []
+
+        def fn():
+            calls.append(1)
+            return jnp.zeros(8) + 1.0
+
+        res = min_over_reps(fn, reps=3, warmup=1)
+        assert len(res.times_ns) == 3
+        assert len(calls) == 4  # warmup + reps
+        assert res.min_ns > 0
+        assert res.min_ns <= res.mean_ns
+
+    def test_gbps_is_bytes_per_ns(self):
+        t = TimingResult(times_ns=[2_000, 1_000])
+        assert t.gbps(5_000) == pytest.approx(5.0)  # 5000 B / 1000 ns = 5 GB/s
+
+    def test_global_interval_single_process(self):
+        assert global_interval_ns(10, 25) == 15
+
+    def test_device_barrier_noop_safe(self):
+        device_barrier()

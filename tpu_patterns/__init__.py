@@ -1,0 +1,23 @@
+"""tpu_patterns — a TPU-native parallel-programming pattern suite.
+
+A brand-new framework with the capabilities of argonne-lcf/HPC-Patterns
+(GPU pattern benchmarks for Aurora: MPI/SYCL/OpenMP-offload), re-designed
+idiomatically for TPU: JAX/XLA collectives over the ICI mesh replace
+GPU-aware MPICH, Pallas (Mosaic) kernels replace SYCL/OMP device kernels,
+XLA async dispatch replaces queue/stream concurrency, and XLA-FFI C++
+modules replace the Level-Zero/SYCL native layers.
+
+Layer map (mirrors SURVEY.md §1):
+  core/        config + results + timing            (ref: concurency/main.cpp CLI,
+                                                     parse.py, timing idioms)
+  topo/        topology & placement                 (ref: p2p/topology.cpp,
+                                                     p2p/tile_mapping.sh, devices.hpp)
+  comm/        communication patterns               (ref: p2p/peer2pear.cpp,
+                                                     mpi_datatype.hpp)
+  concurrency/ dispatch-concurrency harness         (ref: concurency/)
+  interop/     JAX <-> native C++ (XLA FFI)          (ref: sycl_omp_ze_interopt/)
+  miniapps/    self-validating distributed miniapps (ref: aurora.mpich.miniapps/)
+  cli.py       launcher / sweep / report            (ref: run*.sh, parse.py)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,16 @@
+"""Core services: configuration, result records/verdicts, timing discipline."""
+
+from tpu_patterns.core.config import config_from_tiers, add_config_args  # noqa: F401
+from tpu_patterns.core.results import (  # noqa: F401
+    Record,
+    ResultWriter,
+    Verdict,
+    parse_log,
+)
+from tpu_patterns.core.timing import (  # noqa: F401
+    TimingResult,
+    clock_ns,
+    device_barrier,
+    global_interval_ns,
+    min_over_reps,
+)

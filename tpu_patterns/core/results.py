@@ -1,0 +1,205 @@
+"""Result records, verdict markers, and log parsing.
+
+The reference's observability is a stdout protocol (SURVEY.md §5): ``# ...``
+progress lines (concurency/main.cpp:233,277), ``## mode | commands |
+SUCCESS/FAILURE`` verdict markers (main.cpp:310-318), and ``export KEY=VAL``
+lines giving each log section its environment context (run_omp.sh:2,
+parse.py:18-19); concurency/parse.py:12-31 scrapes those into tabulate
+tables.  Here every run additionally emits a machine-readable JSON-lines
+record, while keeping the exact human markers so logs stay grep/parse
+compatible with the reference's convention.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import os
+import re
+import sys
+import time
+from typing import Any, Iterable, TextIO
+
+
+class Verdict(enum.Enum):
+    SUCCESS = "SUCCESS"
+    FAILURE = "FAILURE"
+    WARNING = "WARNING"
+    SKIPPED = "SKIPPED"
+
+    def __bool__(self) -> bool:  # truthy iff the run passed
+        return self is not Verdict.FAILURE
+
+
+@dataclasses.dataclass
+class Record:
+    """One benchmark result: pattern x mode x workload -> metrics + verdict."""
+
+    pattern: str  # e.g. "p2p", "concurrency", "allreduce"
+    mode: str  # e.g. "serial", "async", "ring", "psum"
+    commands: str = ""  # command-group string, e.g. "C M2D"
+    metrics: dict[str, float] = dataclasses.field(default_factory=dict)
+    verdict: Verdict = Verdict.SUCCESS
+    config: dict[str, Any] = dataclasses.field(default_factory=dict)
+    env: dict[str, str] = dataclasses.field(default_factory=dict)
+    timestamp: float = dataclasses.field(default_factory=time.time)
+    notes: list[str] = dataclasses.field(default_factory=list)
+
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        d["verdict"] = self.verdict.value
+        return json.dumps(d, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, line: str) -> "Record":
+        d = json.loads(line)
+        d["verdict"] = Verdict(d.get("verdict", "SUCCESS"))
+        return cls(**d)
+
+
+# Environment variables that identify a sweep configuration, the analogue of
+# the ``export``-echo lines parse.py keys tables by (run_omp.sh:14-27).
+_CONTEXT_ENV_VARS = (
+    "JAX_PLATFORMS",
+    "XLA_FLAGS",
+    "LIBTPU_INIT_ARGS",
+    "TPU_PATTERNS_SWEEP_CONFIG",
+)
+
+
+def context_env() -> dict[str, str]:
+    return {k: os.environ[k] for k in _CONTEXT_ENV_VARS if k in os.environ}
+
+
+class ResultWriter:
+    """Emits human markers to ``stream`` and JSONL records to ``jsonl_path``.
+
+    Marker grammar (reference-compatible, concurency/main.cpp:310-318):
+        ``# <progress text>``
+        ``## <mode> | <commands> | <SUCCESS|FAILURE>``
+    """
+
+    def __init__(
+        self, jsonl_path: str | os.PathLike | None = None, stream: TextIO | None = None
+    ):
+        self.jsonl_path = os.fspath(jsonl_path) if jsonl_path else None
+        self.stream = stream if stream is not None else sys.stdout
+        self._failures = 0
+        if self.jsonl_path:
+            d = os.path.dirname(self.jsonl_path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+
+    def progress(self, text: str) -> None:
+        print(f"# {text}", file=self.stream, flush=True)
+
+    def metric(self, name: str, value: float, unit: str) -> None:
+        # Pretty-print in the spirit of time_info (main.cpp:21-44) /
+        # "mode Uni/Bidirectional Bandwidth: X GB/s" (peer2pear.cpp:137-139).
+        print(f"{name}: {value:.6g} {unit}", file=self.stream, flush=True)
+
+    def record(self, rec: Record) -> Record:
+        if not rec.env:
+            rec.env = context_env()
+        if rec.verdict is Verdict.FAILURE:
+            self._failures += 1
+        if not rec.commands:
+            rec.commands = rec.pattern  # marker and JSON record must agree
+        print(
+            f"## {rec.mode} | {rec.commands} | {rec.verdict.value}",
+            file=self.stream,
+            flush=True,
+        )
+        if self.jsonl_path:
+            with open(self.jsonl_path, "a") as f:
+                f.write(rec.to_json() + "\n")
+        return rec
+
+    @property
+    def exit_code(self) -> int:
+        """Aggregated process exit code (ref: main.cpp:270,321)."""
+        return 1 if self._failures else 0
+
+
+_VERDICT_RE = re.compile(
+    r"^##\s*(?P<mode>[^|]+?)\s*\|\s*(?P<commands>[^|]+?)\s*\|\s*(?P<verdict>SUCCESS|FAILURE|WARNING|SKIPPED)\s*$"
+)
+_EXPORT_RE = re.compile(r"^\+*\s*export\s+(?P<key>[A-Za-z_][A-Za-z0-9_]*)=(?P<val>.*)$")
+
+
+def parse_log(lines: Iterable[str]) -> list[Record]:
+    """Parse a mixed log: JSONL records, ``##`` markers, ``export`` context.
+
+    Reference parity with concurency/parse.py:12-31 — ``export`` lines update
+    the current env context; each ``##`` marker yields one record keyed by it.
+    JSON lines (from ResultWriter) are parsed directly and take precedence
+    over marker lines with the same (mode, commands) anywhere in the input —
+    concatenation order of stdout log and JSONL file does not matter.
+    """
+    lines = [ln.rstrip("\n") for ln in lines]
+    records: list[Record] = []
+    seen: set[tuple[str, str]] = set()
+    # Pass 1: JSON records (and their dedup keys).
+    json_records: dict[int, Record] = {}
+    for i, line in enumerate(lines):
+        if line.startswith("{"):
+            try:
+                rec = Record.from_json(line)
+            except (json.JSONDecodeError, TypeError, ValueError):
+                continue
+            json_records[i] = rec
+            seen.add((rec.mode, rec.commands))
+    # Pass 2: markers (skipping those shadowed by a JSON record) with
+    # export-line env context, preserving input order.
+    env: dict[str, str] = {}
+    for i, line in enumerate(lines):
+        if i in json_records:
+            records.append(json_records[i])
+            continue
+        m = _EXPORT_RE.match(line)
+        if m:
+            env[m.group("key")] = m.group("val").strip("\"'")
+            continue
+        m = _VERDICT_RE.match(line)
+        if m:
+            key = (m.group("mode"), m.group("commands"))
+            if key in seen:
+                continue
+            records.append(
+                Record(
+                    pattern="",
+                    mode=m.group("mode"),
+                    commands=m.group("commands"),
+                    verdict=Verdict(m.group("verdict")),
+                    env=dict(env),
+                )
+            )
+    return records
+
+
+def tabulate_records(records: list[Record]) -> str:
+    """Render records as per-env tables: rows=commands, cols=modes.
+
+    Same shape as concurency/parse.py's output (one table per env config).
+    """
+    from tabulate import tabulate  # deferred; baked into the image
+
+    by_env: dict[str, dict[str, dict[str, str]]] = {}
+    for rec in records:
+        env_key = ", ".join(f"{k}={v}" for k, v in sorted(rec.env.items())) or "(default env)"
+        cell = rec.verdict.value
+        if rec.metrics:
+            main_metric = next(iter(rec.metrics.items()))
+            cell = f"{rec.verdict.value} ({main_metric[0]}={main_metric[1]:.4g})"
+        by_env.setdefault(env_key, {}).setdefault(rec.commands, {})[rec.mode] = cell
+    chunks = []
+    for env_key, rows in by_env.items():
+        modes = sorted({m for cells in rows.values() for m in cells})
+        table = [
+            [cmds] + [cells.get(m, "") for m in modes] for cmds, cells in rows.items()
+        ]
+        chunks.append(env_key)
+        chunks.append(tabulate(table, headers=["commands"] + modes, tablefmt="github"))
+        chunks.append("")
+    return "\n".join(chunks)

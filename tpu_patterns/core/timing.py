@@ -1,0 +1,176 @@
+"""Timing discipline: barrier-synced repetitions, min-over-reps, global interval.
+
+Reproduces the reference's metrology (SURVEY.md §1 L5):
+  * barrier before each repetition            (p2p/peer2pear.cpp:26)
+  * min over repetitions                      (concurency/bench_sycl.cpp:84-121)
+  * global interval = max(end) - min(start)
+    fused across ranks                        (p2p/peer2pear.cpp:46-52)
+  * max-over-ranks wall time                  (allreduce-mpi-sycl.cpp:188-190)
+
+GB/s convention: bytes / nanosecond, exactly the reference's
+``N_byte*num_pair/min_time`` (peer2pear.cpp:137-139).
+
+The clock is a C++ FFI monotonic clock when the native module is built
+(tpu_patterns.interop.native), else ``time.perf_counter_ns``.  Device work is
+fenced with ``block_until_ready`` — the analogue of queue ``wait()``
+(bench_sycl.cpp:111-113) / ``taskwait`` (bench_omp.cpp:107-109).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+import time
+from typing import Any, Callable, Sequence
+
+
+def clock_ns() -> int:
+    """Monotonic nanoseconds; prefers the native FFI clock when built."""
+    native = _native_clock()
+    return native() if native is not None else time.perf_counter_ns()
+
+
+_NATIVE_CLOCK: Any = False  # False = unprobed, None = unavailable
+
+
+def _native_clock():
+    global _NATIVE_CLOCK
+    if _NATIVE_CLOCK is False:
+        try:
+            from tpu_patterns.interop import native
+
+            _NATIVE_CLOCK = native.clock_ns if native.available() else None
+        except Exception:
+            _NATIVE_CLOCK = None
+    return _NATIVE_CLOCK
+
+
+def device_barrier() -> None:
+    """Synchronization point before a timed region (ref: MPI_Barrier,
+    peer2pear.cpp:26).
+
+    Single process: drain all local devices.  Multi-process: global device
+    sync via multihost utils (collective over all processes).
+    """
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("tpu_patterns_barrier")
+    else:
+        for d in jax.local_devices():
+            # A trivial transfer per device, then fence: leaves every device
+            # queue empty so the next timestamp isn't charged prior work.
+            jax.device_put(0, d).block_until_ready()
+
+
+@dataclasses.dataclass
+class TimingResult:
+    """Per-repetition wall times of one measured region."""
+
+    times_ns: list[int]
+    label: str = ""
+
+    @property
+    def min_ns(self) -> int:
+        return min(self.times_ns)
+
+    @property
+    def mean_ns(self) -> float:
+        return statistics.fmean(self.times_ns)
+
+    @property
+    def min_s(self) -> float:
+        return self.min_ns * 1e-9
+
+    def gbps(self, n_bytes: int) -> float:
+        """bytes/ns == GB/s (decimal), the reference's unit
+        (peer2pear.cpp:138)."""
+        return n_bytes / self.min_ns
+
+    def us(self) -> float:
+        return self.min_ns * 1e-3
+
+
+def min_over_reps(
+    fn: Callable[[], Any],
+    reps: int = 10,
+    warmup: int = 1,
+    barrier: Callable[[], None] | None = device_barrier,
+    label: str = "",
+) -> TimingResult:
+    """Time ``fn`` ``reps`` times, barrier before each rep, keep every time.
+
+    ``fn`` must block until its device work completes (return value with
+    ``block_until_ready`` applied, or pure host work).  Warmup runs absorb
+    compilation — the XLA analogue of the reference's first-touch effects.
+    """
+    for _ in range(warmup):
+        r = fn()
+        _block(r)
+    times = []
+    for _ in range(reps):
+        if barrier is not None:
+            barrier()
+        t0 = clock_ns()
+        r = fn()
+        _block(r)
+        t1 = clock_ns()
+        times.append(t1 - t0)
+    return TimingResult(times_ns=times, label=label)
+
+
+def _block(x: Any) -> None:
+    import jax
+
+    jax.block_until_ready(x)
+
+
+def global_interval_ns(start_ns: int, end_ns: int) -> int:
+    """Global interval across processes: max(end) - min(start).
+
+    The reference fuses per-rank timestamps with MPI_Reduce(MIN) /
+    MPI_Reduce(MAX) (peer2pear.cpp:46-52).  Across JAX processes the same
+    fusion runs over allgathered host timestamps; one process returns the
+    local interval.  Host clocks across hosts are not synchronized — the
+    barrier preceding the region bounds the skew, exactly the accepted
+    error model of the reference (SURVEY.md §7 hard parts).
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return end_ns - start_ns
+    from jax.experimental import multihost_utils
+    import numpy as np
+
+    arr = multihost_utils.process_allgather(np.array([start_ns, end_ns], dtype=np.int64))
+    return int(arr[:, 1].max() - arr[:, 0].min())
+
+
+def max_over_processes_s(dt_s: float) -> float:
+    """Max-over-ranks duration (ref: MPI_Allreduce(MPI_MAX),
+    allreduce-mpi-sycl.cpp:188-190)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return dt_s
+    from jax.experimental import multihost_utils
+    import numpy as np
+
+    return float(
+        multihost_utils.process_allgather(np.array([dt_s], dtype=np.float64)).max()
+    )
+
+
+def measure_sequence(
+    fns: Sequence[Callable[[], Any]],
+    reps: int = 10,
+    warmup: int = 1,
+) -> list[TimingResult]:
+    """Serial per-command minima (ref: bench_sycl.cpp:103-109): each fn timed
+    separately, min over reps, device fenced between."""
+    return [
+        min_over_reps(fn, reps=reps, warmup=warmup, label=f"cmd{i}")
+        for i, fn in enumerate(fns)
+    ]
