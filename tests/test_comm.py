@@ -1,0 +1,177 @@
+"""Tests for comm: dtypes, verify, p2p, rings (SURVEY.md §7 step 3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_patterns.comm import (
+    DTYPES,
+    P2PConfig,
+    checksum_device,
+    expected_checksum,
+    fill_randomly,
+    get_dtype,
+    library_allreduce,
+    pair_permutation,
+    ring_allreduce_naive,
+    ring_allreduce_optimal,
+    ring_shift,
+    run_p2p,
+    wire_bytes,
+)
+from tpu_patterns.comm.verify import checksum_ok
+from tpu_patterns.core.results import Verdict
+
+
+class TestDtypes:
+    def test_reference_parity_10_types(self):
+        # mpi_datatype.hpp:27-51 specializes 10 scalar types + BYTE fallback
+        for name in ("float32", "float64", "int32", "uint32", "int64",
+                     "uint64", "int16", "int8", "uint8", "bool", "byte"):
+            assert name in DTYPES
+
+    def test_tpu_native_types(self):
+        assert get_dtype("bfloat16").exact_modulus == 2**8
+        assert get_dtype("float32").exact_modulus == 2**24
+
+    def test_wire_bytes(self):
+        assert wire_bytes("float32", 10) == 40
+        assert wire_bytes("int8", 10) == 10
+
+    def test_unknown_dtype_lists_options(self):
+        with pytest.raises(KeyError, match="float32"):
+            get_dtype("quaternion")
+
+
+class TestVerify:
+    @pytest.mark.parametrize("dtype", sorted(DTYPES))
+    def test_fill_checksum_all_dtypes(self, dtype):
+        # wide dtypes (uint32/int64/uint64/float64) must work under the
+        # default x64-disabled config: moduli are clamped/canonicalized
+        x = fill_randomly(512, dtype, seed=1)
+        assert x.shape == (512,)
+        assert checksum_ok(x, 512, dtype)
+
+    @pytest.mark.parametrize("dtype", ["float32", "int32", "bfloat16", "uint8"])
+    def test_fill_checksum_roundtrip(self, dtype):
+        n = 10_000
+        x = fill_randomly(n, dtype, seed=3)
+        assert x.shape == (n,)
+        assert checksum_ok(x, n, dtype)
+
+    def test_checksum_detects_corruption(self):
+        n = 10_000
+        x = fill_randomly(n, "float32")
+        x = x.at[17].add(1.0)
+        assert not checksum_ok(x, n, "float32")
+
+    def test_checksum_detects_dropped_element(self):
+        n = 1_000
+        x = fill_randomly(n, "int32")
+        assert not checksum_ok(x.at[5].set(0), n, "int32") or int(x[5]) == 0
+
+    def test_expected_checksum_small_exact(self):
+        # n below every modulus: plain N(N-1)/2, the reference's invariant
+        # (peer2pear.cpp:59-62)
+        assert expected_checksum(100, "float32") == 100 * 99 // 2
+
+    def test_values_exactly_representable(self):
+        x = fill_randomly(100_000, "bfloat16")
+        # cast to int and back must be lossless
+        assert (x.astype(jnp.int32).astype(jnp.bfloat16) == x).all()
+
+
+class TestPairPermutation:
+    def test_uni(self):
+        assert pair_permutation(4) == [(0, 1), (2, 3)]
+
+    def test_bi(self):
+        assert pair_permutation(4, True) == [(0, 1), (2, 3), (1, 0), (3, 2)]
+
+
+class TestP2P:
+    def test_run_p2p_8dev(self, mesh1d):
+        cfg = P2PConfig(count=4096, reps=3, warmup=1)
+        recs = run_p2p(mesh1d, cfg)
+        assert len(recs) == 2
+        uni, bi = recs
+        assert uni.mode == "unidirectional" and bi.mode == "bidirectional"
+        for r in recs:
+            assert r.verdict is Verdict.SUCCESS, r.notes
+            assert r.metrics["bandwidth_gbps"] > 0
+            assert r.metrics["checksum_ok"] == 1.0
+        assert bi.metrics["num_transfers"] == 2 * uni.metrics["num_transfers"]
+
+    def test_min_bandwidth_gate_fails(self, mesh1d):
+        cfg = P2PConfig(count=1024, reps=2, warmup=1, min_bandwidth=1e12,
+                        bidirectional=False)
+        (rec,) = run_p2p(mesh1d, cfg)
+        assert rec.verdict is Verdict.FAILURE
+
+    def test_odd_device_count_rejected(self, devices):
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(devices[:3]), ("x",))
+        with pytest.raises(ValueError, match="even"):
+            run_p2p(mesh, P2PConfig(count=16))
+
+
+def _shard_mapped(mesh, fn, *args):
+    out = jax.shard_map(fn, mesh=mesh, in_specs=P("x"), out_specs=P("x"))(*args)
+    return np.asarray(out)
+
+
+class TestRings:
+    def test_ring_shift_rotates(self, mesh1d):
+        n = 8
+        x = jax.device_put(
+            jnp.arange(n, dtype=jnp.float32), NamedSharding(mesh1d, P("x"))
+        )
+        out = _shard_mapped(mesh1d, lambda a: ring_shift(a, "x", n), x)
+        # device i's value moves to device i+1
+        np.testing.assert_array_equal(out, np.roll(np.arange(n), 1))
+
+    @pytest.mark.parametrize("variant", ["naive", "optimal"])
+    def test_ring_allreduce_matches_psum(self, mesh1d, variant):
+        n = 8
+        per_dev = 64
+        x = fill_randomly(n * per_dev, "float32", seed=7)
+        xs = jax.device_put(x, NamedSharding(mesh1d, P("x")))
+        impl = ring_allreduce_naive if variant == "naive" else ring_allreduce_optimal
+        got = _shard_mapped(mesh1d, lambda a: impl(a, "x", n), xs)
+        want = _shard_mapped(mesh1d, lambda a: library_allreduce(a, "x"), xs)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        # every shard holds the same reduced vector
+        got2 = got.reshape(n, per_dev)
+        for i in range(1, n):
+            np.testing.assert_allclose(got2[i], got2[0], rtol=1e-6)
+
+    def test_ring_allreduce_int_exact(self, mesh1d):
+        n = 8
+        per_dev = 32
+        x = jnp.arange(n * per_dev, dtype=jnp.int32)
+        xs = jax.device_put(x, NamedSharding(mesh1d, P("x")))
+        got = _shard_mapped(mesh1d, lambda a: ring_allreduce_optimal(a, "x", n), xs)
+        want = x.reshape(n, per_dev).sum(0)
+        np.testing.assert_array_equal(got.reshape(n, per_dev)[3], np.asarray(want))
+
+    def test_ring_optimal_requires_divisible(self, mesh1d):
+        with pytest.raises(ValueError, match="divisible"):
+            _shard_mapped(
+                mesh1d,
+                lambda a: ring_allreduce_optimal(a, "x", 8),
+                jax.device_put(
+                    jnp.zeros(8 * 9), NamedSharding(mesh1d, P("x"))
+                ),
+            )
+
+    def test_axis_size_one_identity(self, devices):
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(devices[:1]), ("x",))
+        x = jnp.arange(16, dtype=jnp.float32)
+        xs = jax.device_put(x, NamedSharding(mesh, P("x")))
+        got = _shard_mapped(mesh, lambda a: ring_allreduce_naive(a, "x", 1), xs)
+        np.testing.assert_array_equal(got, np.asarray(x))

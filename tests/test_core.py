@@ -181,3 +181,48 @@ class TestTiming:
 
     def test_device_barrier_noop_safe(self):
         device_barrier()
+
+
+class TestMeasureChain:
+    def _builder(self):
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.arange(128, dtype=jnp.float32)
+
+        def build(k):
+            f = jax.jit(
+                lambda a: jax.lax.fori_loop(0, k, lambda _, b: b * 1.0001, a).sum()
+            )
+            return lambda: f(x)
+
+        return build
+
+    def test_direct_mode_default_on_cpu(self):
+        from tpu_patterns.core import TimingMode, default_timing_mode, measure_chain
+
+        assert default_timing_mode() is TimingMode.DIRECT
+        m = measure_chain(self._builder(), reps=3, warmup=1)
+        assert m.mode is TimingMode.DIRECT
+        assert m.per_op_ns > 0
+        assert m.long is None
+
+    def test_amortized_mode(self):
+        from tpu_patterns.core import TimingMode, measure_chain
+
+        m = measure_chain(
+            self._builder(), reps=3, warmup=1, lengths=(1, 5),
+            mode=TimingMode.AMORTIZED,
+        )
+        assert m.mode is TimingMode.AMORTIZED
+        assert m.per_op_ns > 0
+        assert m.lengths == (1, 5)
+        assert m.long is not None
+        # per-op estimate can't exceed the long chain's total time
+        assert m.per_op_ns <= m.long.min_ns
+
+    def test_env_override(self, monkeypatch):
+        from tpu_patterns.core import TimingMode, default_timing_mode
+
+        monkeypatch.setenv("TPU_PATTERNS_TIMING", "amortized")
+        assert default_timing_mode() is TimingMode.AMORTIZED

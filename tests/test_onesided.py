@@ -1,0 +1,55 @@
+"""Tests for the one-sided (RMA) Pallas path (SURVEY.md C2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_patterns.comm import OneSidedConfig, local_put, ring_put, run_onesided
+from tpu_patterns.core.results import Verdict
+
+
+class TestLocalPut:
+    def test_roundtrip_interpret(self):
+        x = jnp.arange(4 * 128, dtype=jnp.float32).reshape(4, 128)
+        y = local_put(x, interpret=True)
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+
+class TestRingPut:
+    def test_ring_put_rotates_shards(self, mesh1d):
+        n = 8
+        rows, cols = 2, 128
+        x = jax.device_put(
+            jnp.arange(n * rows * cols, dtype=jnp.float32).reshape(n * rows, cols),
+            NamedSharding(mesh1d, P("x")),
+        )
+        fn = jax.jit(
+            jax.shard_map(
+                lambda a: ring_put(a, "x", n, interpret=True),
+                mesh=mesh1d,
+                in_specs=P("x"),
+                out_specs=P("x"),
+                check_vma=False,
+            )
+        )
+        out = np.asarray(fn(x))
+        np.testing.assert_array_equal(out, np.roll(np.asarray(x), rows, axis=0))
+
+
+class TestRunOneSided:
+    def test_multi_device(self, mesh1d):
+        recs = run_onesided(mesh1d, OneSidedConfig(count=2048, reps=2, warmup=1))
+        (rec,) = recs
+        assert rec.mode == "ring_put"
+        assert rec.verdict is Verdict.SUCCESS, rec.notes
+        assert rec.metrics["bandwidth_gbps"] > 0
+
+    def test_single_device(self, devices):
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(devices[:1]), ("x",))
+        (rec,) = run_onesided(mesh, OneSidedConfig(count=2048, reps=2, warmup=1))
+        assert rec.mode == "local_put"
+        assert rec.verdict is Verdict.SUCCESS, rec.notes

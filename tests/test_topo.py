@@ -1,0 +1,156 @@
+"""Tests for topology discovery & placement (SURVEY.md §7 step 2)."""
+
+import dataclasses
+
+import pytest
+
+from tpu_patterns.topo import (
+    Mechanism,
+    PlacementMode,
+    bootstrap,
+    discover,
+    make_mesh,
+    order_devices,
+    select_devices,
+)
+
+
+@dataclasses.dataclass
+class FakeDevice:
+    """Stands in for a PJRT TPU device: a 2x2 torus, 2 cores per chip."""
+
+    id: int
+    coords: tuple
+    core_on_chip: int
+    process_index: int = 0
+    platform: str = "faketpu"
+
+
+def fake_slice():
+    devs = []
+    i = 0
+    for x in range(2):
+        for y in range(2):
+            for core in range(2):
+                devs.append(FakeDevice(id=i, coords=(x, y), core_on_chip=core))
+                i += 1
+    return devs
+
+
+class TestTopology:
+    def test_torus_shape_and_cores(self):
+        topo = discover(fake_slice())
+        assert topo.num_devices == 8
+        assert topo.torus_shape == (2, 2)
+        assert topo.cores_per_chip == 2
+
+    def test_planes_are_ici_rings(self):
+        topo = discover(fake_slice())
+        rings = topo.planes()
+        # 2 axes x 2 cross-positions x 2 cores = 8 rings of length 2
+        assert len(rings) == 8
+        for ring in rings:
+            assert len(ring) == 2
+            a, b = (topo.devices[i] for i in ring)
+            # members of a ring differ in exactly one torus coordinate
+            assert sum(x != y for x, y in zip(a.coords, b.coords)) == 1
+            assert a.core_on_chip == b.core_on_chip
+
+    def test_neighbors_on_2x2(self):
+        topo = discover(fake_slice())
+        for d in topo.devices:
+            assert len(topo.neighbors(d.index)) == 2
+
+    def test_flat_and_entry(self):
+        topo = discover(fake_slice())
+        flat = topo.flat()
+        assert sorted(flat) == list(range(8))
+        assert topo.entry(0) == flat[0]
+        assert topo.entry(9) == flat[1]  # wraps modulo, devices.hpp:46-48 style
+
+    def test_synthetic_coords_on_cpu(self, devices):
+        topo = discover(devices)
+        assert topo.devices[0].synthetic_coords
+        assert topo.torus_shape == (len(devices),)
+        assert topo.planes()  # still yields at least one plane
+        assert "devices:" in topo.describe()
+
+
+class TestPlacement:
+    def test_compact_fills_chip_first(self):
+        topo = discover(fake_slice())
+        order = order_devices(topo, PlacementMode.COMPACT)
+        first_two = [topo.devices[i] for i in order[:2]]
+        assert first_two[0].coords == first_two[1].coords  # same chip
+        assert first_two[0].core_on_chip != first_two[1].core_on_chip
+
+    def test_spread_round_robins_chips(self):
+        topo = discover(fake_slice())
+        order = order_devices(topo, PlacementMode.SPREAD)
+        first_four = [topo.devices[i] for i in order[:4]]
+        assert len({d.coords for d in first_four}) == 4  # all different chips
+        assert all(d.core_on_chip == 0 for d in first_four)
+
+    def test_plan_walks_rings(self):
+        topo = discover(fake_slice())
+        order = order_devices(topo, PlacementMode.PLAN)
+        assert sorted(order) == list(range(8))
+        # the first pair comes off one ring: directly wired neighbors
+        a, b = (topo.devices[i] for i in order[:2])
+        assert sum(x != y for x, y in zip(a.coords, b.coords)) == 1
+
+    def test_select_devices_wraps(self):
+        topo = discover(fake_slice())
+        sel = select_devices(10, topo)
+        assert len(sel) == 10
+        assert sel[8] == sel[0]
+
+    def test_make_mesh_full(self, devices):
+        mesh = make_mesh(("x",), devices=devices)
+        assert mesh.devices.shape == (len(devices),)
+
+    def test_make_mesh_2d_and_modes(self, devices):
+        mesh = make_mesh(("x", "y"), shape=(4, 2), mode=PlacementMode.SPREAD,
+                         devices=devices)
+        assert mesh.axis_names == ("x", "y")
+        assert mesh.devices.shape == (4, 2)
+
+    def test_make_mesh_visible_subset(self, devices):
+        mesh = make_mesh(("x",), shape=(2,), mechanism=Mechanism.VISIBLE,
+                         devices=devices)
+        assert mesh.devices.shape == (2,)
+
+    def test_make_mesh_mesh_mechanism_requires_cover(self, devices):
+        with pytest.raises(ValueError, match="cover all"):
+            make_mesh(("x",), shape=(2,), mechanism=Mechanism.MESH,
+                      devices=devices)
+
+    def test_make_mesh_rejects_oversubscription(self, devices):
+        with pytest.raises(ValueError, match="oversubscribe"):
+            make_mesh(("x",), shape=(2 * len(devices),),
+                      mechanism=Mechanism.VISIBLE, devices=devices)
+
+
+class TestBootstrap:
+    def test_single_process_noop(self):
+        info = bootstrap()
+        assert info.num_processes == 1
+        assert info.process_id == 0
+        assert info.is_coordinator
+        assert info.local_device_count >= 1
+
+    def test_partial_config_rejected(self, monkeypatch):
+        # coordinator set, num_processes missing: must not silently run N
+        # independent single-process jobs
+        with pytest.raises(ValueError, match="partial"):
+            bootstrap(coordinator_address="localhost:1234")
+        with pytest.raises(ValueError, match="partial"):
+            bootstrap(num_processes=4)
+        with pytest.raises(ValueError, match="partial"):
+            bootstrap(coordinator_address="localhost:1234", num_processes=4)
+
+    def test_rank_only_env_is_single_process(self, monkeypatch):
+        # mpirun -n 1 style: a rank var alone is not a distributed config
+        monkeypatch.setenv("PMI_RANK", "0")
+        info = bootstrap()
+        assert info.num_processes == 1

@@ -1,0 +1,27 @@
+"""Communication patterns over the ICI mesh (ref: p2p/, mpi_datatype.hpp).
+
+The reference's backend is GPU-aware MPICH on device pointers (SURVEY.md
+§2.4); here it is XLA collectives compiled over the mesh: ``ppermute`` pair
+exchange ≙ MPI_Isend/Irecv pairs, ``psum`` ≙ MPI_Allreduce, Pallas remote
+DMA ≙ MPI_Put one-sided RMA.
+"""
+
+from tpu_patterns.comm.dtypes import DTYPES, get_dtype, wire_bytes  # noqa: F401
+from tpu_patterns.comm.verify import (  # noqa: F401
+    checksum_device,
+    expected_checksum,
+    fill_randomly,
+)
+from tpu_patterns.comm.p2p import P2PConfig, pair_permutation, run_p2p  # noqa: F401
+from tpu_patterns.comm.ring import (  # noqa: F401
+    library_allreduce,
+    ring_allreduce_naive,
+    ring_allreduce_optimal,
+    ring_shift,
+)
+from tpu_patterns.comm.onesided import (  # noqa: F401
+    OneSidedConfig,
+    local_put,
+    ring_put,
+    run_onesided,
+)

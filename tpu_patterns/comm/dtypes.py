@@ -1,0 +1,86 @@
+"""Framework dtype <-> wire dtype table.
+
+Parity with the reference's MPI datatype traits
+(aurora.mpich.miniapps/src/include/mpi_datatype.hpp:24-52): template
+specializations for 10 scalar C++ types with an MPI_BYTE fallback.  Here the
+wire format *is* the jnp dtype; the table adds TPU-idiomatic types the MPI
+table has no notion of (bfloat16 — the MXU's native input) and records the
+"exact integer modulus" each dtype can represent, which the checksum
+verifier (comm/verify.py) relies on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class DTypeSpec:
+    name: str
+    jnp_dtype: object  # as requested (e.g. jnp.float64)
+    canonical: object  # what actually materializes under the active config
+    itemsize: int  # of the canonical dtype — what hits the wire
+    # Largest M such that every integer in [0, M) is exactly representable.
+    exact_modulus: int
+
+
+def _spec(name: str, dt, exact_modulus: int | None = None) -> DTypeSpec:
+    # Respect the active JAX precision config: with x64 disabled (the TPU
+    # default) float64/int64 silently canonicalize to their 32-bit
+    # counterparts — itemsize and exact_modulus must describe the dtype that
+    # will actually hit the wire, or reported GB/s doubles.
+    import jax.dtypes
+
+    canon = np.dtype(jax.dtypes.canonicalize_dtype(dt))
+    if exact_modulus is None:
+        if jnp.issubdtype(canon, np.integer):
+            exact_modulus = int(jnp.iinfo(canon).max)
+        else:
+            # all integers in [0, 2^(nmant+1)] are exactly representable
+            exact_modulus = 2 ** (jnp.finfo(canon).nmant + 1)
+    # The fill/checksum pipeline indexes and sums in int32 (comm/verify.py);
+    # a modulus beyond int32 range only risks overflow without adding
+    # distinguishable values (buffers are far smaller than 2^31 elements).
+    exact_modulus = min(exact_modulus, 2**31 - 1)
+    return DTypeSpec(name=name, jnp_dtype=dt, canonical=canon,
+                     itemsize=canon.itemsize, exact_modulus=exact_modulus)
+
+
+# The reference's 10 C++ scalar types (mpi_datatype.hpp:27-51), mapped to the
+# nearest jnp type, plus TPU-native extras.  `byte` is the MPI_BYTE fallback.
+DTYPES: dict[str, DTypeSpec] = {
+    s.name: s
+    for s in [
+        _spec("float32", jnp.float32),       # float        -> MPI_FLOAT
+        _spec("float64", jnp.float64),       # double       -> MPI_DOUBLE (x64 gated)
+        _spec("int32", jnp.int32),           # int          -> MPI_INT
+        _spec("uint32", jnp.uint32),         # unsigned     -> MPI_UNSIGNED
+        _spec("int64", jnp.int64),           # long         -> MPI_LONG (x64 gated)
+        _spec("uint64", jnp.uint64),         # unsigned long-> MPI_UNSIGNED_LONG
+        _spec("int16", jnp.int16),           # short        -> MPI_SHORT
+        _spec("int8", jnp.int8),             # char         -> MPI_CHAR
+        _spec("uint8", jnp.uint8),           # unsigned char-> MPI_UNSIGNED_CHAR
+        _spec("bool", jnp.bool_, exact_modulus=2),  # bool  -> MPI_CXX_BOOL
+        # TPU-native additions (no MPI analogue):
+        _spec("bfloat16", jnp.bfloat16),     # MXU-native, 8-bit mantissa
+        _spec("float16", jnp.float16),
+        _spec("byte", jnp.uint8),            # MPI_BYTE fallback (:49-51)
+    ]
+}
+
+
+def get_dtype(name: str) -> DTypeSpec:
+    """≙ mpi::get_datatype<T>() (mpi_datatype.hpp:24); KeyError lists options."""
+    try:
+        return DTYPES[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown dtype {name!r}; known: {', '.join(sorted(DTYPES))}"
+        ) from None
+
+
+def wire_bytes(name: str, count: int) -> int:
+    return get_dtype(name).itemsize * count

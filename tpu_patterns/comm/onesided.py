@@ -1,0 +1,212 @@
+"""One-sided RMA: Pallas remote-DMA put with semaphore fences.
+
+TPU-native re-design of the reference's MPI one-sided variant
+(p2p/peer2pear.cpp:68-102): the reference creates an MPI window over device
+memory (:119-122) and bounds ``MPI_Put`` transfers with ``MPI_Win_fence``
+epochs (:76-81).  The true TPU analogue (SURVEY.md C2) is a Pallas kernel
+issuing an *async remote copy* over ICI — the sender writes directly into
+the receiver's buffer (RDMA), and the fence/epoch discipline becomes DMA
+semaphores: ``send_sem`` completes the local epoch, ``recv_sem`` the remote
+exposure epoch; ``.wait()`` on both is the fence.
+
+Two kernels:
+* ``ring_put``  — every device puts its shard into its ring neighbor's
+  output buffer (multi-device; interpret-mode on CPU meshes, Mosaic on TPU).
+* ``local_put`` — same one-sided discipline against the device's own HBM
+  (HBM->HBM async DMA + semaphore wait); the single-chip measurement the
+  1-chip bench environment can run, and a direct probe of HBM copy
+  bandwidth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_patterns.comm import verify
+from tpu_patterns.comm.dtypes import get_dtype
+from tpu_patterns.core import timing
+from tpu_patterns.core.results import Record, ResultWriter, Verdict
+
+
+def _ring_put_kernel(axis: str, axis_size: int, x_ref, out_ref, send_sem, recv_sem):
+    """Put my buffer into my +1 ring neighbor's output (≙ MPI_Put,
+    peer2pear.cpp:76-81); the two semaphore waits are the closing fence."""
+    me = lax.axis_index(axis)
+    rdma = pltpu.make_async_remote_copy(
+        src_ref=x_ref,
+        dst_ref=out_ref,
+        send_sem=send_sem,
+        recv_sem=recv_sem,
+        device_id=(me + 1) % axis_size,
+        device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+    rdma.start()
+    rdma.wait()
+
+
+def ring_put(x: jax.Array, axis: str, axis_size: int, interpret: bool = False):
+    """One ring-neighbor one-sided put; call under shard_map
+    (check_vma=False — the kernel's output varies by construction)."""
+    return pl.pallas_call(
+        functools.partial(_ring_put_kernel, axis, axis_size),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA(()), pltpu.SemaphoreType.DMA(())],
+        interpret=interpret,
+    )(x)
+
+
+def _local_put_kernel(x_ref, out_ref, sem):
+    dma = pltpu.make_async_copy(x_ref, out_ref, sem)
+    dma.start()
+    dma.wait()
+
+
+def local_put(x: jax.Array, interpret: bool = False):
+    """One-sided put into the device's own HBM: async DMA + semaphore fence.
+    Measures pure HBM copy bandwidth (read + write) on a single chip."""
+    return pl.pallas_call(
+        _local_put_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA(())],
+        interpret=interpret,
+    )(x)
+
+
+@dataclasses.dataclass
+class OneSidedConfig:
+    count: int = 1179648 * 40  # elements; reference message size (≙ C1)
+    dtype: str = "float32"
+    reps: int = 10
+    warmup: int = 2
+    min_bandwidth: float = -1.0
+    seed: int = 0
+
+
+def _use_interpret() -> bool:
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+def run_onesided(
+    mesh: Mesh | None,
+    cfg: OneSidedConfig | None = None,
+    writer: ResultWriter | None = None,
+) -> list[Record]:
+    """One-sided put bandwidth: remote ring put on a multi-device mesh,
+    local HBM put when only one device is available."""
+    cfg = cfg or OneSidedConfig()
+    writer = writer or ResultWriter()
+    interpret = _use_interpret()
+    spec = get_dtype(cfg.dtype)
+    # 2-D shape: Mosaic DMAs want a (sublane, lane)-tileable layout.
+    cols = 512
+    rows = max(1, cfg.count // cols)
+    count = rows * cols
+    shard_bytes = count * spec.itemsize
+
+    n_dev = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
+    if mesh is not None and n_dev > 1:
+        axis = mesh.axis_names[0]
+        mode = "ring_put"
+        sharding = NamedSharding(mesh, P(axis))
+        x = jax.device_put(
+            verify.fill_randomly(n_dev * count, cfg.dtype, cfg.seed).reshape(
+                n_dev * rows, cols
+            ),
+            sharding,
+        )
+        fn = jax.jit(
+            jax.shard_map(
+                lambda a: ring_put(a, axis, n_dev, interpret=interpret),
+                mesh=mesh,
+                in_specs=P(axis),
+                out_specs=P(axis),
+                check_vma=False,
+            )
+        )
+
+        def build_chain(k: int):
+            def chain(a):
+                y = lax.fori_loop(
+                    0,
+                    k,
+                    lambda _, b: ring_put(b, axis, n_dev, interpret=interpret),
+                    a,
+                )
+                return jnp.sum(y.astype(jnp.float32))[None]
+
+            chained = jax.jit(
+                jax.shard_map(
+                    chain, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+                    check_vma=False,
+                )
+            )
+            return lambda: chained(x)
+
+        num_transfers = n_dev  # every device puts to its neighbor
+    else:
+        mode = "local_put"
+        x = verify.fill_randomly(count, cfg.dtype, cfg.seed).reshape(rows, cols)
+        fn = jax.jit(lambda a: local_put(a, interpret=interpret))
+
+        def build_chain(k: int):
+            chained = jax.jit(
+                lambda a: jnp.sum(
+                    lax.fori_loop(
+                        0, k, lambda _, b: local_put(b, interpret=interpret), a
+                    ).astype(jnp.float32)
+                )
+            )
+            return lambda: chained(x)
+
+        num_transfers = 1
+
+    jax.block_until_ready(x)
+    writer.progress(
+        f"onesided {mode}: {shard_bytes / 1e6:.2f} MB/put, "
+        f"{num_transfers} transfer(s), dtype={cfg.dtype}"
+    )
+    res = timing.measure_chain(
+        build_chain, reps=cfg.reps, warmup=cfg.warmup, direct_fn=lambda: fn(x)
+    )
+    gbps = res.gbps(shard_bytes * num_transfers)
+
+    out = np.asarray(fn(x))
+    if mode == "ring_put":
+        want = np.roll(np.asarray(x), shift=rows, axis=0)  # shard i -> i+1
+        data_ok = bool((out == want).all())
+    else:
+        data_ok = bool((out == np.asarray(x)).all())
+    bw_ok = cfg.min_bandwidth < 0 or gbps >= cfg.min_bandwidth
+
+    verdict = Verdict.SUCCESS if (data_ok and bw_ok) else Verdict.FAILURE
+    writer.metric(f"{mode} Bandwidth", gbps, "GB/s")
+    rec = Record(
+        pattern="onesided",
+        mode=mode,
+        commands=f"{n_dev}dev x {shard_bytes // 1_000_000}MB",
+        metrics={
+            "bandwidth_gbps": gbps,
+            "min_time_us": res.us(),
+            "bytes_per_put": float(shard_bytes),
+            "checksum_ok": float(data_ok),
+        },
+        verdict=verdict,
+    )
+    if not data_ok:
+        rec.notes.append("one-sided put data mismatch")
+    return [writer.record(rec)]

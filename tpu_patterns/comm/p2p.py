@@ -1,0 +1,178 @@
+"""Point-to-point pair-exchange bandwidth pattern.
+
+TPU-native re-design of p2p/peer2pear.cpp: the reference pairs even/odd MPI
+ranks and times MPI_Isend/Irecv/Waitall of 188.74 MB device buffers, 10
+reps, min global time, first uni- then bidirectional (:19-66,104-156).
+
+Here a pair exchange is one ``lax.ppermute`` under ``shard_map``: every even
+mesh position sends its shard to its odd neighbor (uni), and the
+bidirectional pass is a single ppermute whose permutation contains both
+directions — XLA schedules both transfers concurrently over ICI, which is
+exactly what Waitall-over-both-requests expresses.  Timing is
+barrier-synced min-over-reps (core/timing.py ≙ peer2pear.cpp:26,46-52);
+verification is the shuffled-iota checksum, computed per shard on device
+(comm/verify.py ≙ :55-63).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_patterns.comm import verify
+from tpu_patterns.comm.dtypes import get_dtype
+from tpu_patterns.core import timing
+from tpu_patterns.core.results import Record, ResultWriter, Verdict
+
+
+@dataclasses.dataclass
+class P2PConfig:
+    # Reference workload: 1179648*40 floats ≈ 188.74 MB per pair
+    # (peer2pear.cpp:23,115-116).  Override downward for CPU-simulated runs.
+    count: int = 1179648 * 40
+    dtype: str = "float32"
+    reps: int = 10  # min-over-reps (peer2pear.cpp:23)
+    warmup: int = 2
+    min_bandwidth: float = -1.0  # GB/s floor; <0 disables (≙ --min_bandwidth)
+    bidirectional: bool = True  # run the second, bidirectional pass (:141-155)
+    seed: int = 0
+
+
+def pair_permutation(n: int, bidirectional: bool = False) -> list[tuple[int, int]]:
+    """Even->odd neighbor pairs (≙ rank pairing, peer2pear.cpp:126-134);
+    the bidirectional pass adds the reverse direction (:141-150)."""
+    pairs = [(i, i + 1) for i in range(0, n - 1, 2)]
+    if bidirectional:
+        pairs += [(d, s) for (s, d) in pairs]
+    return pairs
+
+
+def _exchange(x, *, axis: str, perm):
+    return lax.ppermute(x, axis, perm)
+
+
+def _exchange_chain(x, *, axis: str, perm, k: int):
+    """k data-dependent exchanges + a per-shard scalar whose fetch forces
+    execution (core/timing.py amortized discipline)."""
+    y = lax.fori_loop(0, k, lambda _, a: lax.ppermute(a, axis, perm), x)
+    return jnp.sum(y.astype(jnp.float32))[None]
+
+
+def _shard_checksums(x, *, axis: str):
+    return verify.checksum_device(x)[None]
+
+
+def run_p2p(
+    mesh: Mesh,
+    cfg: P2PConfig | None = None,
+    writer: ResultWriter | None = None,
+) -> list[Record]:
+    """Run the uni- (and optionally bi-) directional pair-exchange pattern.
+
+    Returns one Record per direction with bandwidth in GB/s (bytes/ns, the
+    reference's unit, peer2pear.cpp:137-139,152-155).
+    """
+    cfg = cfg or P2PConfig()
+    writer = writer or ResultWriter()
+    axis = mesh.axis_names[0]
+    n_dev = int(np.prod(mesh.devices.shape))
+    if n_dev < 2 or n_dev % 2:
+        raise ValueError(
+            f"p2p needs an even number of devices >= 2, got {n_dev} "
+            "(the reference likewise pairs even/odd ranks)"
+        )
+    if len(mesh.axis_names) != 1:
+        raise ValueError("p2p expects a 1-D mesh (one ring axis)")
+
+    spec = get_dtype(cfg.dtype)
+    shard_bytes = cfg.count * spec.itemsize
+    total = cfg.count * n_dev
+    sharding = NamedSharding(mesh, P(axis))
+
+    writer.progress(
+        f"p2p: {n_dev} devices, {shard_bytes / 1e6:.2f} MB/pair, "
+        f"dtype={cfg.dtype}, reps={cfg.reps}"
+    )
+    x = jax.device_put(verify.fill_randomly(total, cfg.dtype, cfg.seed), sharding)
+    jax.block_until_ready(x)
+
+    # Per-shard checksums of the *source* data, fetched once up front.
+    csum_fn = jax.jit(
+        jax.shard_map(
+            functools.partial(_shard_checksums, axis=axis),
+            mesh=mesh,
+            in_specs=P(axis),
+            out_specs=P(axis),
+        )
+    )
+    src_sums = np.asarray(csum_fn(x))
+
+    records = []
+    passes = [("unidirectional", False)]
+    if cfg.bidirectional:
+        passes.append(("bidirectional", True))
+    for name, bidir in passes:
+        perm = pair_permutation(n_dev, bidir)
+        fn = jax.jit(
+            jax.shard_map(
+                functools.partial(_exchange, axis=axis, perm=perm),
+                mesh=mesh,
+                in_specs=P(axis),
+                out_specs=P(axis),
+            )
+        )
+
+        def build_chain(k: int, _perm=perm):
+            chained = jax.jit(
+                jax.shard_map(
+                    functools.partial(_exchange_chain, axis=axis, perm=_perm, k=k),
+                    mesh=mesh,
+                    in_specs=P(axis),
+                    out_specs=P(axis),
+                )
+            )
+            return lambda: chained(x)
+
+        res = timing.measure_chain(
+            build_chain, reps=cfg.reps, warmup=cfg.warmup, label=name,
+            direct_fn=lambda: fn(x),
+        )
+        num_pairs = len(perm)  # transfers in flight (bi counts both directions)
+        gbps = res.gbps(shard_bytes * num_pairs)
+        # Verify: receiver shard d must hold source shard s for each (s, d);
+        # non-receivers hold zeros (ppermute semantics).
+        out_sums = np.asarray(csum_fn(fn(x)))
+        expect = np.zeros_like(src_sums)
+        for s, d in perm:
+            expect[d] += src_sums[s]
+        data_ok = bool((out_sums == expect).all())
+        bw_ok = cfg.min_bandwidth < 0 or gbps >= cfg.min_bandwidth
+        verdict = Verdict.SUCCESS if (data_ok and bw_ok) else Verdict.FAILURE
+        writer.metric(f"{name.capitalize()} Bandwidth", gbps, "GB/s")
+        rec = Record(
+            pattern="p2p",
+            mode=name,
+            commands=f"{n_dev}dev x {shard_bytes // 1_000_000}MB",
+            metrics={
+                "bandwidth_gbps": gbps,
+                "min_time_us": res.us(),
+                "bytes_per_pair": float(shard_bytes),
+                "num_transfers": float(num_pairs),
+                "checksum_ok": float(data_ok),
+            },
+            verdict=verdict,
+        )
+        if not data_ok:
+            rec.notes.append("checksum mismatch after exchange")
+        if not bw_ok:
+            rec.notes.append(
+                f"bandwidth {gbps:.2f} GB/s below floor {cfg.min_bandwidth}"
+            )
+        records.append(writer.record(rec))
+    return records

@@ -1,0 +1,72 @@
+"""Data-integrity verifier: shuffled-iota fill + exact wrapped checksum.
+
+The reference fills transfer buffers with a shuffled iota (minstd_rand
+shuffle, p2p/peer2pear.cpp:8-17) and after the transfer sorts + sums on the
+host, asserting ``sum == N(N-1)/2`` (:55-63).  That detects dropped,
+duplicated, or corrupted elements.
+
+TPU-native redesign: the fill is ``jax.random.permutation`` of an iota *on
+device*, and the checksum never leaves the device.  Two refinements make the
+invariant exact where the reference's float sum is not:
+
+* values are reduced modulo the dtype's *exact integer modulus* (2^mantissa
+  for floats, comm/dtypes.py), so every stored value is exactly
+  representable — float32 cannot hold 47e6 distinct iota values, which makes
+  the reference's equality assert on large buffers rounding-dependent;
+* the sum is taken in int32 with natural wraparound (two's-complement), and
+  compared against the theoretical sum mod 2^32 computed exactly in Python —
+  no 64-bit (x64) mode needed on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpu_patterns.comm.dtypes import get_dtype
+
+
+def fill_randomly(n: int, dtype: str = "float32", seed: int = 0) -> jax.Array:
+    """Shuffled iota (mod the dtype's exact modulus), on device.
+
+    ≙ fill_randomly (peer2pear.cpp:8-17), minus the host staging: the
+    permutation and cast happen on the accelerator.
+    """
+    spec = get_dtype(dtype)
+    key = jax.random.key(seed)
+    perm = jax.random.permutation(key, jnp.arange(n, dtype=jnp.int32))
+    return (perm % spec.exact_modulus).astype(spec.canonical)
+
+
+def expected_checksum(n: int, dtype: str = "float32") -> int:
+    """Theoretical wrapped sum of ``fill_randomly(n, dtype)`` (any seed).
+
+    The multiset of values is iota(n) mod M, i.e. each v in [0, M) appears
+    ``n // M`` times plus once more if ``v < n % M``; the permutation does
+    not change the sum.  Exact Python ints, wrapped to int32 range.
+    """
+    m = get_dtype(dtype).exact_modulus
+    full, part = divmod(n, m)
+    total = full * (m * (m - 1) // 2) + part * (part - 1) // 2
+    return _wrap32(total)
+
+
+def checksum_device(x: jax.Array) -> jax.Array:
+    """Wrapped int32 sum, computed where the data lives (no host staging —
+    the reference must stage device buffers through shared memory first,
+    peer2pear.cpp:55-58)."""
+    return jnp.sum(x.astype(jnp.int32))
+
+
+def checksum_ok(x: jax.Array, n: int | None = None, dtype: str | None = None) -> bool:
+    """Full invariant check ≙ the reference's post-transfer assert
+    (peer2pear.cpp:59-63)."""
+    n = n if n is not None else x.size
+    dtype = dtype if dtype is not None else jnp.dtype(x.dtype).name
+    got = int(checksum_device(x))
+    return _wrap32(got) == expected_checksum(n, dtype)
+
+
+def _wrap32(v: int) -> int:
+    v &= 0xFFFFFFFF
+    return v - 0x100000000 if v >= 0x80000000 else v

@@ -8,9 +8,13 @@ from tpu_patterns.core.results import (  # noqa: F401
     parse_log,
 )
 from tpu_patterns.core.timing import (  # noqa: F401
+    ChainMeasurement,
+    TimingMode,
     TimingResult,
     clock_ns,
+    default_timing_mode,
     device_barrier,
     global_interval_ns,
+    measure_chain,
     min_over_reps,
 )

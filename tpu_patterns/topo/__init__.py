@@ -1,0 +1,12 @@
+"""Topology discovery & placement (ref: p2p/topology.cpp, tile_mapping.sh,
+devices.hpp)."""
+
+from tpu_patterns.topo.topology import DeviceInfo, Topology, discover  # noqa: F401
+from tpu_patterns.topo.placement import (  # noqa: F401
+    Mechanism,
+    PlacementMode,
+    make_mesh,
+    order_devices,
+    select_devices,
+)
+from tpu_patterns.topo.bootstrap import bootstrap, process_info  # noqa: F401

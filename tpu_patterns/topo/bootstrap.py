@@ -1,0 +1,109 @@
+"""Multi-process bootstrap: the launcher↔process contract.
+
+The reference's processes learn their identity from the PALS launcher
+environment (``PALS_LOCAL_RANKID``, p2p/tile_mapping.sh:7) and join the job
+via ``MPI_Init`` (peer2pear.cpp:107-110).  The TPU-native contract is
+``jax.distributed.initialize(coordinator_address, num_processes,
+process_id)`` — device binding happens at init time instead of via
+pre-launch affinity masks (SURVEY.md §5).
+
+Environment tier (first present wins per field):
+  coordinator: TPU_PATTERNS_COORDINATOR, JAX_COORDINATOR_ADDRESS
+  num_processes: TPU_PATTERNS_NUM_PROCESSES, JAX_NUM_PROCESSES
+  process_id: TPU_PATTERNS_PROCESS_ID, JAX_PROCESS_ID, PALS_RANKID,
+              PMI_RANK, OMPI_COMM_WORLD_RANK   (launcher compatibility)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+
+_COORD_VARS = ("TPU_PATTERNS_COORDINATOR", "JAX_COORDINATOR_ADDRESS")
+_NPROC_VARS = ("TPU_PATTERNS_NUM_PROCESSES", "JAX_NUM_PROCESSES")
+_PID_VARS = (
+    "TPU_PATTERNS_PROCESS_ID",
+    "JAX_PROCESS_ID",
+    "PALS_RANKID",
+    "PMI_RANK",
+    "OMPI_COMM_WORLD_RANK",
+)
+
+
+def _first_env(names: tuple[str, ...]) -> str | None:
+    for n in names:
+        v = os.environ.get(n)
+        if v not in (None, ""):
+            return v
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessInfo:
+    process_id: int
+    num_processes: int
+    local_device_count: int
+    global_device_count: int
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+
+def bootstrap(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> ProcessInfo:
+    """Join (or skip joining) the distributed job, then report identity.
+
+    With no arguments and no environment, this is a no-op single-process
+    init — the analogue of running a miniapp without mpirun.  Explicit
+    arguments override the environment.
+    """
+    import jax
+
+    coordinator_address = coordinator_address or _first_env(_COORD_VARS)
+    if num_processes is None:
+        v = _first_env(_NPROC_VARS)
+        num_processes = int(v) if v else None
+    if process_id is None:
+        v = _first_env(_PID_VARS)
+        process_id = int(v) if v else None
+
+    multi = (num_processes or 0) > 1
+    if coordinator_address and not num_processes:
+        raise ValueError(
+            "distributed config is partial: coordinator_address is set but "
+            "num_processes is not — refusing to silently run single-process "
+            f"(set one of {_NPROC_VARS})"
+        )
+    if multi and not coordinator_address:
+        raise ValueError(
+            "distributed config is partial: num_processes > 1 but no "
+            f"coordinator address (set one of {_COORD_VARS})"
+        )
+    if multi and process_id is None:
+        raise ValueError(
+            "distributed config is partial: num_processes > 1 but no process "
+            f"id (set one of {_PID_VARS})"
+        )
+    if coordinator_address and multi:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    return process_info()
+
+
+def process_info() -> ProcessInfo:
+    import jax
+
+    return ProcessInfo(
+        process_id=jax.process_index(),
+        num_processes=jax.process_count(),
+        local_device_count=jax.local_device_count(),
+        global_device_count=jax.device_count(),
+    )

@@ -1,0 +1,135 @@
+"""Rank→device placement: compact / spread / plan, as mesh orderings.
+
+The reference binds MPI ranks to GPU tiles before process start
+(p2p/tile_mapping.sh:9-29): mode ``compact`` fills the tiles of one GPU
+first (:9-12), ``spread`` round-robins across GPUs (:13-16), and
+``compact_plan`` derives the order from the fabric topology (:17-20); the
+binding *mechanism* is either an affinity mask (ZE_AFFINITY_MASK, :23-24) or
+a device selector (ONEAPI_DEVICE_SELECTOR, :25-26).  The miniapp library does
+the same in-process: round-robin vs compact block over (possibly fissioned)
+devices (devices.hpp:46-53).
+
+Under JAX, placement is not an environment mask but the *order in which
+devices enter the Mesh*: XLA lays logical mesh axes onto the device list, so
+neighbor distance on the ICI torus is decided here.  The two reference
+mechanisms survive as:
+  * ``Mechanism.MESH``    — reorder the full device list into the Mesh
+    (≙ affinity mask: every device visible, order decides adjacency);
+  * ``Mechanism.VISIBLE`` — restrict to a subset of devices
+    (≙ device selector: only the selected devices exist for the run).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Sequence
+
+import numpy as np
+
+from tpu_patterns.topo.topology import Topology, discover
+
+
+class PlacementMode(enum.Enum):
+    COMPACT = "compact"  # fill cores of a chip first (tile_mapping.sh:9-12)
+    SPREAD = "spread"  # round-robin across chips (:13-16)
+    PLAN = "compact_plan"  # topology-derived ring walk (:17-20)
+
+
+class Mechanism(enum.Enum):
+    MESH = "mesh"  # ordering mechanism (≙ ZE_AFFINITY_MASK)
+    VISIBLE = "visible"  # subset mechanism (≙ ONEAPI_DEVICE_SELECTOR)
+
+
+def order_devices(
+    topo: Topology | None = None,
+    mode: PlacementMode = PlacementMode.COMPACT,
+) -> list[int]:
+    """Device-index ordering for a given placement mode.
+
+    compact: chips in coordinate order, all cores of a chip adjacent —
+    consecutive ranks land one ICI hop (or one chip) apart.
+    spread: core-major — consecutive ranks land on *different* chips
+    (round-robin), maximizing per-rank bandwidth at the cost of locality.
+    plan: walk the ICI rings from the topology probe (planes) so that
+    consecutive ranks are always directly-wired neighbors; falls back to
+    compact when there is no real fabric.
+    """
+    topo = topo or discover()
+    if mode is PlacementMode.COMPACT:
+        return topo.flat()  # the canonical coords-major, core-adjacent order
+    if mode is PlacementMode.SPREAD:
+        return [
+            d.index
+            for d in sorted(topo.devices, key=lambda d: (d.core_on_chip, d.coords))
+        ]
+    # PLAN: concatenate the discovered rings, skipping repeats — a ring walk
+    # keeps every consecutive pair directly connected (≙ compact_plan's
+    # topology-derived mask order).
+    seen: set[int] = set()
+    order: list[int] = []
+    for ring in topo.planes():
+        for idx in ring:
+            if idx not in seen:
+                seen.add(idx)
+                order.append(idx)
+    for d in topo.devices:  # devices on no ring (isolated)
+        if d.index not in seen:
+            order.append(d.index)
+    return order
+
+
+def select_devices(
+    num: int,
+    topo: Topology | None = None,
+    mode: PlacementMode = PlacementMode.COMPACT,
+) -> list[int]:
+    """VISIBLE mechanism: the first ``num`` devices of the mode's ordering
+    (≙ ONEAPI_DEVICE_SELECTOR exposing a subset, tile_mapping.sh:25-26).
+    Oversubscription wraps modulo, like devices.hpp:46-48."""
+    order = order_devices(topo, mode)
+    return [order[i % len(order)] for i in range(num)]
+
+
+def make_mesh(
+    axis_names: Sequence[str] = ("x",),
+    shape: Sequence[int] | None = None,
+    mode: PlacementMode = PlacementMode.COMPACT,
+    mechanism: Mechanism = Mechanism.MESH,
+    devices: Sequence[Any] | None = None,
+):
+    """Build a ``jax.sharding.Mesh`` whose device order realizes a placement
+    mode.
+
+    ``shape`` defaults to all devices on one axis.  With
+    ``Mechanism.VISIBLE`` only ``prod(shape)`` devices are used (subset
+    selection); with ``Mechanism.MESH`` the shape must cover every device,
+    as an affinity mask covers the whole node.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    topo = discover(devices)
+    if shape is None:
+        shape = (len(devices),)
+    shape = tuple(int(s) for s in shape)
+    n_needed = int(np.prod(shape))
+    if mechanism is Mechanism.VISIBLE:
+        if n_needed > len(devices):
+            raise ValueError(
+                f"shape {shape} needs {n_needed} devices but only "
+                f"{len(devices)} exist; a Mesh cannot oversubscribe "
+                "(use select_devices for rank->device modulo mapping)"
+            )
+        chosen = select_devices(n_needed, topo, mode)
+    else:
+        order = order_devices(topo, mode)
+        if n_needed != len(order):
+            raise ValueError(
+                f"Mechanism.MESH requires shape to cover all {len(order)} "
+                f"devices (got shape {shape} = {n_needed}); use "
+                f"Mechanism.VISIBLE for subsets"
+            )
+        chosen = order
+    arr = np.array([devices[i] for i in chosen]).reshape(shape)
+    return Mesh(arr, tuple(axis_names))

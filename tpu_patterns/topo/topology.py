@@ -1,0 +1,167 @@
+"""Interconnect-topology discovery: devices, coordinates, ICI planes.
+
+TPU-native re-design of the reference's fabric prober
+(p2p/topology.cpp:28-107), which enumerates Level-Zero devices (:32-45) and
+fabric ports per device (:54-69), unions port-connected tiles into disjoint
+connection sets (:71-73), merges them into fully-connected "planes" (:76-89),
+and prints either all planes or the N-th tile id for launcher placement
+(:92-106).
+
+On TPU the fabric is the ICI torus and PJRT already knows it: every device
+carries integer ``coords`` (its position on the torus) and ``core_on_chip``.
+The analogue of a Xe-Link *plane* (a set of tiles wired all-to-all) is an ICI
+*ring*: the set of chips that share all torus coordinates except one — those
+are directly wired neighbors along that axis, and collectives laid out along
+the ring ride ICI at full bandwidth.  So ``planes()`` returns the torus rings
+per axis.  On hosts without coords (CPU-simulated meshes) a synthetic 1-D
+chain topology keeps every consumer (placement, tests) working unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceInfo:
+    """One addressable device (ref analogue: one PVC *tile*,
+    topology.cpp:40-44)."""
+
+    index: int  # position in jax.devices() order
+    id: int  # PJRT global device id
+    process_index: int
+    platform: str
+    coords: tuple[int, ...]  # torus coordinates (synthetic linear on CPU)
+    core_on_chip: int  # megacore/core index (≙ tile-in-GPU)
+    synthetic_coords: bool  # True when coords were invented (no ICI)
+
+    @property
+    def chip_key(self) -> tuple[int, ...]:
+        """Identity of the physical chip (all cores of a chip share it)."""
+        return self.coords
+
+
+def _device_info(i: int, d: Any) -> DeviceInfo:
+    coords = getattr(d, "coords", None)
+    synthetic = coords is None
+    if synthetic:
+        coords = (i, )
+    core = getattr(d, "core_on_chip", 0) or 0
+    return DeviceInfo(
+        index=i,
+        id=getattr(d, "id", i),
+        process_index=getattr(d, "process_index", 0),
+        platform=getattr(d, "platform", "unknown"),
+        coords=tuple(int(c) for c in coords),
+        core_on_chip=int(core),
+        synthetic_coords=synthetic,
+    )
+
+
+@dataclasses.dataclass
+class Topology:
+    """The discovered device fabric.
+
+    ``planes()`` ≙ topology.cpp:76-89's plane merge; ``flat()``/``entry(n)``
+    ≙ the CLI's two output modes (:92-106).
+    """
+
+    devices: list[DeviceInfo]
+
+    @property
+    def num_devices(self) -> int:
+        return len(self.devices)
+
+    @property
+    def torus_shape(self) -> tuple[int, ...]:
+        """Bounding box of chip coordinates (per-axis extent)."""
+        ndim = len(self.devices[0].coords)
+        return tuple(
+            len({d.coords[ax] for d in self.devices}) for ax in range(ndim)
+        )
+
+    @property
+    def cores_per_chip(self) -> int:
+        by_chip: dict[tuple[int, ...], int] = {}
+        for d in self.devices:
+            by_chip[d.chip_key] = by_chip.get(d.chip_key, 0) + 1
+        return max(by_chip.values())
+
+    def planes(self) -> list[list[int]]:
+        """ICI rings: for each torus axis with extent > 1, group devices that
+        agree on every *other* coordinate.  Each group is a set of directly
+        connected neighbors — the TPU analogue of a fully-port-connected
+        Xe-Link plane (topology.cpp:76-89).  Returns device ``index`` lists,
+        each sorted along the ring axis.
+        """
+        ndim = len(self.devices[0].coords)
+        extents = self.torus_shape
+        rings: list[list[int]] = []
+        for ax in range(ndim):
+            if extents[ax] <= 1 and ndim > 1:
+                continue
+            groups: dict[tuple, list[DeviceInfo]] = {}
+            for d in self.devices:
+                key = d.coords[:ax] + d.coords[ax + 1 :] + (d.core_on_chip,)
+                groups.setdefault(key, []).append(d)
+            for members in groups.values():
+                if len(members) > 1 or self.num_devices == 1:
+                    members.sort(key=lambda d: d.coords[ax])
+                    rings.append([d.index for d in members])
+        if not rings:  # single device, or degenerate: one plane of everything
+            rings = [[d.index for d in self.devices]]
+        return rings
+
+    def flat(self) -> list[int]:
+        """Canonical flattened device order: coords-major, then core
+        (≙ topology.cpp:99-103's flatten of the planes)."""
+        return [
+            d.index
+            for d in sorted(self.devices, key=lambda d: (d.coords, d.core_on_chip))
+        ]
+
+    def entry(self, n: int) -> int:
+        """N-th device in canonical order — what the launcher consumes as a
+        placement mask (topology.cpp:99-106 prints flatten[N])."""
+        flat = self.flat()
+        return flat[n % len(flat)]
+
+    def neighbors(self, index: int) -> list[int]:
+        """Device indices one ICI hop away (±1 along each axis, torus wrap)."""
+        me = self.devices[index]
+        extents = self.torus_shape
+        out = []
+        for other in self.devices:
+            if other.index == index or other.core_on_chip != me.core_on_chip:
+                continue
+            diffs = [
+                min(
+                    abs(a - b),
+                    extents[ax] - abs(a - b) if extents[ax] > 1 else abs(a - b),
+                )
+                for ax, (a, b) in enumerate(zip(me.coords, other.coords))
+            ]
+            if sum(diffs) == 1:
+                out.append(other.index)
+        return sorted(out)
+
+    def describe(self) -> str:
+        lines = [
+            f"devices: {self.num_devices} ({self.devices[0].platform}), "
+            f"torus {'x'.join(map(str, self.torus_shape))}, "
+            f"{self.cores_per_chip} core(s)/chip"
+            + (" [synthetic coords]" if self.devices[0].synthetic_coords else "")
+        ]
+        for i, ring in enumerate(self.planes()):
+            lines.append(f"plane {i}: {ring}")
+        return "\n".join(lines)
+
+
+def discover(devices: Sequence[Any] | None = None) -> Topology:
+    """Probe the fabric (≙ running ``./topology``, topology.cpp:28-45)."""
+    if devices is None:
+        import jax
+
+        devices = jax.devices()
+    return Topology(devices=[_device_info(i, d) for i, d in enumerate(devices)])
