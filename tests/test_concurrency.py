@@ -1,0 +1,174 @@
+"""Tests for the concurrency suite (SURVEY.md §7 step 4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_patterns.concurrency import (
+    BACKENDS,
+    Command,
+    ConcurrencyConfig,
+    MemKind,
+    busy_wait_pallas,
+    busy_wait_xla,
+    get_backend,
+    parse_command,
+    parse_group,
+    run_concurrency,
+)
+from tpu_patterns.concurrency.commands import alloc
+from tpu_patterns.concurrency.harness import TOL_SPEEDUP, auto_tune
+from tpu_patterns.core.results import Record, ResultWriter, Verdict
+
+
+class TestCommandLanguage:
+    def test_parse_compute(self):
+        c = parse_command("C")
+        assert c.kind == "compute" and c.text == "C"
+
+    @pytest.mark.parametrize("tok,src,dst", [
+        ("M2D", MemKind.M, MemKind.D),
+        ("H2D", MemKind.H, MemKind.D),
+        ("D2H", MemKind.D, MemKind.H),
+        ("S2D", MemKind.S, MemKind.D),
+        ("D2S", MemKind.D, MemKind.S),
+        ("D2D", MemKind.D, MemKind.D),
+    ])
+    def test_parse_copies(self, tok, src, dst):
+        c = parse_command(tok)
+        assert c.kind == "copy" and c.src is src and c.dst is dst
+
+    def test_reject_garbage(self):
+        with pytest.raises(ValueError, match="expected"):
+            parse_command("Q2D")
+        with pytest.raises(ValueError, match="identical"):
+            parse_command("H2H")
+        with pytest.raises(ValueError, match="empty"):
+            parse_group("   ")
+
+    def test_group_parse(self):
+        cmds = parse_group("C M2D D2M")
+        assert [c.text for c in cmds] == ["C", "M2D", "D2M"]
+
+    def test_scaled_compute_rescales_tripcount(self):
+        c = parse_command("C")
+        assert c.scaled(2.0).tripcount == 2 * c.tripcount
+        assert c.scaled(1e-9).tripcount == 1  # floor
+
+    def test_scaled_copy_rounds_to_lanes(self):
+        c = parse_command("H2D")
+        s = c.scaled(0.5)
+        assert s.copy_elements % 128 == 0
+        assert abs(s.copy_elements - c.copy_elements // 2) <= 128
+
+    def test_alloc_kinds(self):
+        c = parse_command("H2D")
+        c.copy_elements = 256
+        buf = alloc(c)
+        assert buf.sharding.memory_kind == "pinned_host"
+        m = parse_command("M2D")
+        m.copy_elements = 256
+        assert isinstance(alloc(m), np.ndarray)
+
+
+class TestBusyWait:
+    def test_xla_pallas_agree(self):
+        x = jnp.full((8, 128), 0.5, jnp.float32)
+        a = busy_wait_xla(x, 3)
+        b = busy_wait_pallas(x, 3, interpret=True)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+    def test_values_stay_finite(self):
+        x = jnp.full((8, 128), 1.0, jnp.float32)
+        y = busy_wait_xla(x, 10_000)
+        assert bool(jnp.isfinite(y).all())
+        assert float(jnp.abs(y).max()) > 0
+
+
+class TestBackendValidation:
+    def test_backends_registered(self):
+        assert set(BACKENDS) == {"xla", "pallas"}
+        with pytest.raises(KeyError, match="xla"):
+            get_backend("cuda")
+
+    def test_xla_rejects_m_in_program(self):
+        b = get_backend("xla")
+        with pytest.raises(ValueError, match="pageable host"):
+            b.validate("concurrent", parse_group("C M2D"))
+        b.validate("dispatch_async", parse_group("C M2D"))  # ok
+
+    def test_xla_rejects_d2d(self):
+        b = get_backend("xla")
+        with pytest.raises(ValueError, match="elided"):
+            b.validate("concurrent", parse_group("D2D"))
+
+    def test_pallas_rejects_host_copies(self):
+        b = get_backend("pallas")
+        with pytest.raises(ValueError, match="D2D"):
+            b.validate("dma_overlap", parse_group("C H2D"))
+        b.validate("dma_serial", parse_group("C D2D"))  # ok
+
+    def test_unknown_mode(self):
+        with pytest.raises(ValueError, match="unknown mode"):
+            get_backend("xla").validate("warp_speed", parse_group("C"))
+
+
+def small_cfg(**kw):
+    kw.setdefault("reps", 2)
+    kw.setdefault("warmup", 1)
+    kw.setdefault("tripcount", 50)
+    kw.setdefault("elements", 1024)
+    kw.setdefault("copy_elements", 1 << 14)
+    kw.setdefault("chain_lengths", (1, 3))
+    return ConcurrencyConfig(**kw)
+
+
+class TestHarness:
+    def test_auto_tune_equalizes_knobs(self):
+        cfg = small_cfg()
+        backend = get_backend("xla")
+        writer = ResultWriter()
+        cmds = [parse_command("C"), parse_command("S2D")]
+        for c in cmds:
+            c.tripcount, c.copy_elements = cfg.tripcount, cfg.copy_elements
+        tuned = auto_tune(backend, cmds, cfg, writer, {})
+        assert len(tuned) == 2
+        assert tuned[0].tripcount >= 1
+        assert tuned[1].copy_elements % 128 == 0
+
+    @pytest.mark.parametrize("mode", ["serial", "concurrent"])
+    def test_xla_in_program_modes(self, mode):
+        cfg = small_cfg(backend="xla", mode=mode, commands=("C S2D",))
+        (rec,) = run_concurrency(cfg)
+        m = rec.metrics
+        assert m["speedup"] > 0
+        assert m["theoretical_speedup"] >= 1.0
+        assert m["serial_total_us"] > 0
+        assert rec.mode == f"xla:{mode}"
+
+    def test_dispatch_modes_with_m(self):
+        cfg = small_cfg(backend="xla", mode="dispatch_async",
+                        commands=("M2D D2M",))
+        (rec,) = run_concurrency(cfg)
+        assert rec.metrics["speedup"] > 0
+
+    @pytest.mark.parametrize("mode", ["dma_serial", "dma_overlap"])
+    def test_pallas_modes(self, mode):
+        cfg = small_cfg(backend="pallas", mode=mode, commands=("C D2D",))
+        (rec,) = run_concurrency(cfg)
+        assert rec.metrics["speedup"] > 0
+
+    def test_min_bandwidth_gate(self):
+        cfg = small_cfg(backend="xla", mode="concurrent", commands=("C S2D",),
+                        min_bandwidth=1e12)
+        (rec,) = run_concurrency(cfg)
+        assert rec.verdict is Verdict.FAILURE
+        assert any("below floor" in n for n in rec.notes)
+
+    def test_exit_code_aggregation(self, tmp_path):
+        w = ResultWriter(tmp_path / "r.jsonl")
+        cfg = small_cfg(backend="xla", mode="concurrent", commands=("C S2D",),
+                        min_bandwidth=1e12)
+        run_concurrency(cfg, w)
+        assert w.exit_code == 1

@@ -226,3 +226,32 @@ class TestMeasureChain:
 
         monkeypatch.setenv("TPU_PATTERNS_TIMING", "amortized")
         assert default_timing_mode() is TimingMode.AMORTIZED
+
+    def test_adaptive_lengths_respect_max_chain(self):
+        # lengths=None + AMORTIZED is the default TPU path: the long chain
+        # grows geometrically but must never exceed max_chain (regression:
+        # the cap was once checked before the multiply, giving 2x overshoot)
+        from tpu_patterns.core import TimingMode, measure_chain
+
+        m = measure_chain(
+            self._builder(), reps=3, warmup=1, lengths=None,
+            mode=TimingMode.AMORTIZED, max_chain=64,
+        )
+        assert m.lengths[1] <= 64
+        assert m.per_op_ns > 0
+        assert len(m.long.times_ns) == 3  # accepted k1 got the full reps
+
+    def test_adaptive_handles_negative_diff(self):
+        # a "chain" whose runtime does not grow with k (noise-only) must
+        # still terminate and fall back to the upper-bound estimate
+        from tpu_patterns.core import TimingMode, measure_chain
+
+        def build(k):
+            return lambda: 0
+
+        m = measure_chain(
+            build, reps=2, warmup=0, lengths=None,
+            mode=TimingMode.AMORTIZED, max_chain=32, barrier=None,
+        )
+        assert m.lengths[1] <= 32
+        assert m.per_op_ns >= 0

@@ -108,6 +108,9 @@ def run_onesided(
 ) -> list[Record]:
     """One-sided put bandwidth: remote ring put on a multi-device mesh,
     local HBM put when only one device is available."""
+    from tpu_patterns.runtime import setup_jax
+
+    setup_jax()
     cfg = cfg or OneSidedConfig()
     writer = writer or ResultWriter()
     interpret = _use_interpret()
@@ -139,23 +142,24 @@ def run_onesided(
             )
         )
 
-        def build_chain(k: int):
-            def chain(a):
-                y = lax.fori_loop(
-                    0,
-                    k,
-                    lambda _, b: ring_put(b, axis, n_dev, interpret=interpret),
-                    a,
-                )
-                return jnp.sum(y.astype(jnp.float32))[None]
-
-            chained = jax.jit(
-                jax.shard_map(
-                    chain, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
-                    check_vma=False,
-                )
+        def chain(a, k):
+            y = lax.fori_loop(
+                0,
+                k,
+                lambda _, b: ring_put(b, axis, n_dev, interpret=interpret),
+                a,
             )
-            return lambda: chained(x)
+            return jnp.sum(y.astype(jnp.float32))[None]
+
+        chained = jax.jit(
+            jax.shard_map(
+                chain, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(axis),
+                check_vma=False,
+            )
+        )
+
+        def build_chain(k: int):
+            return lambda: chained(x, jnp.int32(k))
 
         num_transfers = n_dev  # every device puts to its neighbor
     else:
@@ -163,15 +167,16 @@ def run_onesided(
         x = verify.fill_randomly(count, cfg.dtype, cfg.seed).reshape(rows, cols)
         fn = jax.jit(lambda a: local_put(a, interpret=interpret))
 
-        def build_chain(k: int):
-            chained = jax.jit(
-                lambda a: jnp.sum(
-                    lax.fori_loop(
-                        0, k, lambda _, b: local_put(b, interpret=interpret), a
-                    ).astype(jnp.float32)
-                )
+        chained = jax.jit(
+            lambda a, k: jnp.sum(
+                lax.fori_loop(
+                    0, k, lambda _, b: local_put(b, interpret=interpret), a
+                ).astype(jnp.float32)
             )
-            return lambda: chained(x)
+        )
+
+        def build_chain(k: int):
+            return lambda: chained(x, jnp.int32(k))
 
         num_transfers = 1
 

@@ -57,9 +57,9 @@ def _exchange(x, *, axis: str, perm):
     return lax.ppermute(x, axis, perm)
 
 
-def _exchange_chain(x, *, axis: str, perm, k: int):
-    """k data-dependent exchanges + a per-shard scalar whose fetch forces
-    execution (core/timing.py amortized discipline)."""
+def _exchange_chain(x, k, *, axis: str, perm):
+    """k (traced bound) data-dependent exchanges + a per-shard scalar whose
+    fetch forces execution (core/timing.py amortized discipline)."""
     y = lax.fori_loop(0, k, lambda _, a: lax.ppermute(a, axis, perm), x)
     return jnp.sum(y.astype(jnp.float32))[None]
 
@@ -78,6 +78,9 @@ def run_p2p(
     Returns one Record per direction with bandwidth in GB/s (bytes/ns, the
     reference's unit, peer2pear.cpp:137-139,152-155).
     """
+    from tpu_patterns.runtime import setup_jax
+
+    setup_jax()
     cfg = cfg or P2PConfig()
     writer = writer or ResultWriter()
     axis = mesh.axis_names[0]
@@ -128,16 +131,17 @@ def run_p2p(
             )
         )
 
-        def build_chain(k: int, _perm=perm):
-            chained = jax.jit(
-                jax.shard_map(
-                    functools.partial(_exchange_chain, axis=axis, perm=_perm, k=k),
-                    mesh=mesh,
-                    in_specs=P(axis),
-                    out_specs=P(axis),
-                )
+        chained = jax.jit(
+            jax.shard_map(
+                functools.partial(_exchange_chain, axis=axis, perm=perm),
+                mesh=mesh,
+                in_specs=(P(axis), P()),
+                out_specs=P(axis),
             )
-            return lambda: chained(x)
+        )
+
+        def build_chain(k: int, _chained=chained):
+            return lambda: _chained(x, jnp.int32(k))
 
         res = timing.measure_chain(
             build_chain, reps=cfg.reps, warmup=cfg.warmup, label=name,

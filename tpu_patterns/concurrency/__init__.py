@@ -1,0 +1,21 @@
+"""Dispatch-concurrency suite (ref: concurency/ — harness, backends, kernel).
+
+Answers the reference's question — "does submitting independent device
+commands concurrently beat serial?" (concurency/README.md) — in XLA terms:
+does one compiled program with *independent* ops beat the same program with
+a forced sequential chain, and does an explicit Pallas kernel overlap DMA
+with compute?
+"""
+
+from tpu_patterns.concurrency.kernels import busy_wait_pallas, busy_wait_xla  # noqa: F401
+from tpu_patterns.concurrency.commands import (  # noqa: F401
+    Command,
+    MemKind,
+    parse_command,
+    parse_group,
+)
+from tpu_patterns.concurrency.backends import BACKENDS, get_backend  # noqa: F401
+from tpu_patterns.concurrency.harness import (  # noqa: F401
+    ConcurrencyConfig,
+    run_concurrency,
+)

@@ -1,0 +1,331 @@
+"""Concurrency backends: XLA dispatch and Pallas explicit-DMA overlap.
+
+The reference measures the same question through two runtimes (SURVEY.md
+C9a/C9b): OpenMP offload (serial | host_threads | nowait modes,
+bench_omp.cpp:21-143) and SYCL (serial | in_order | out_of_order queues,
+bench_sycl.cpp:19-144), both behind one ``bench()`` extern interface
+(bench.hpp:37-40).
+
+TPU equivalents:
+* ``XLABackend`` — commands compiled into ONE program; "serial" forces a
+  sequential schedule by threading ``lax.optimization_barrier`` between
+  commands (the XLA analogue of an in-order queue), "concurrent" leaves
+  them independent so XLA's scheduler may overlap them (out-of-order
+  queue).  ``dispatch_serial``/``dispatch_async`` run each command as its
+  own dispatched program, blocking after each vs once at the end — the
+  direct analogue of per-queue wait vs nowait+taskwait; host-timed, so
+  only meaningful where host timing is (DIRECT mode platforms).
+* ``PallasBackend`` — one Mosaic kernel per group; copies become explicit
+  async DMAs, compute runs on the VPU; "dma_serial" waits each DMA before
+  compute, "dma_overlap" starts DMAs, computes while they fly, then waits
+  — the in-kernel copy-engine/compute overlap the reference probes with
+  separate queues.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpu_patterns.concurrency.commands import Command, MemKind, alloc, host_sharding
+from tpu_patterns.concurrency.kernels import busy_wait_pallas, busy_wait_xla
+
+
+def _use_pallas_kernel() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@dataclasses.dataclass
+class BuiltGroup:
+    """What a backend hands the harness for one command group x mode."""
+
+    build_chain: Callable[[int], Callable[[], object]]  # for measure_chain
+    direct_fn: Callable[[], object]  # plain run, host-fenced
+    n_bytes_per_iter: int
+    cmd_bytes: list[int] = dataclasses.field(default_factory=list)
+    # bytes each command moves per measured iteration, in input order
+    # (copies chained as round trips count both directions)
+
+
+class XLABackend:
+    name = "xla"
+    modes = ("serial", "concurrent", "dispatch_serial", "dispatch_async")
+
+    def solo_mode(self, mode: str) -> str:
+        """Mode used for single-command serial probes: must share the
+        group's execution path (in-program vs dispatched) so M commands
+        stay legal and times stay comparable."""
+        return "dispatch_serial" if mode.startswith("dispatch") else "serial"
+
+    def validate(self, mode: str, cmds: Sequence[Command]) -> None:
+        """≙ validate_mode (bench_omp.cpp:15-19 / bench_sycl.cpp:14-17)."""
+        if mode not in self.modes:
+            raise ValueError(f"backend {self.name}: unknown mode {mode!r}; "
+                             f"modes: {self.modes}")
+        if not mode.startswith("dispatch"):
+            bad = [c.text for c in cmds if MemKind.M in (c.src, c.dst)]
+            if bad:
+                raise ValueError(
+                    f"commands {bad} touch pageable host memory (M), which "
+                    "cannot live inside a compiled program; use the "
+                    "dispatch_* modes or the S (unpinned_host) kind"
+                )
+        if any(c.kind == "copy" and c.src is c.dst for c in cmds):
+            raise ValueError(
+                "D2D under the xla backend would be elided by the compiler "
+                "(same memory space); use the pallas backend, whose explicit "
+                "DMA materializes the copy"
+            )
+
+    # -- single command as a traced computation ---------------------------
+
+    def _apply(self, cmd: Command, buf):
+        """One-way application (eager/dispatch path)."""
+        if cmd.kind == "compute":
+            if _use_pallas_kernel():
+                return busy_wait_pallas(buf, cmd.tripcount)
+            return busy_wait_xla(buf, cmd.tripcount)
+        return jax.device_put(buf, host_sharding(cmd.dst))
+
+    def _step(self, cmd: Command, buf):
+        """One measured unit whose OUTPUT feeds the next iteration's input
+        — a genuine loop-carried data dependence, which is the only thing
+        that stops XLA from hoisting the work out of the chain loop
+        (scheduling-only barriers get elided; measured empirically).
+        Compute feeds through directly; copies chain as round trips
+        (X2Y then Y2X), so a copy command moves 2x its bytes per iteration
+        — the reference's sweep mixes are round-trip pairs anyway
+        ("M2D D2M", "H2D D2H", run_omp.sh:9).
+        """
+        if cmd.kind == "compute":
+            if _use_pallas_kernel():
+                return busy_wait_pallas(buf, cmd.tripcount)
+            return busy_wait_xla(buf, cmd.tripcount)
+        out = jax.device_put(buf, host_sharding(cmd.dst))
+        return jax.device_put(out, host_sharding(cmd.src))
+
+    def _force_scalar(self, outs):
+        # One small data-dependent scalar; host-kind outputs are pulled to
+        # device once at the chain tail (fixed cost, cancels in differential
+        # timing).
+        parts = []
+        for o in outs:
+            od = jax.device_put(o, jax.memory.Space.Device)
+            parts.append(jnp.sum(od[..., :1, :1]))
+        return jnp.stack(parts).sum()
+
+    @staticmethod
+    def _iter_bytes(cmd: Command) -> int:
+        return cmd.bytes * (2 if cmd.kind == "copy" else 1)
+
+    def build(self, cmds: Sequence[Command], mode: str) -> BuiltGroup:
+        if mode.startswith("dispatch"):
+            return self._build_dispatch(cmds, mode)
+        bufs = [alloc(c, seed=i) for i, c in enumerate(cmds)]
+
+        def group_once(ins):
+            # serial: optimization_barrier orders command j after j-1's
+            # output WITHIN the iteration (per-command data already chains
+            # across iterations, so ordering is the barrier's only job here
+            # and it cannot be elided away without reordering).
+            outs = []
+            prev = None
+            for cmd, b in zip(cmds, ins):
+                if serial and prev is not None:
+                    b, _ = lax.optimization_barrier((b, prev))
+                o = self._step(cmd, b)
+                prev = o
+                outs.append(o)
+            return tuple(outs)
+
+        serial = mode == "serial"
+
+        # k is a traced loop bound: one compilation serves every chain
+        # length the adaptive timer probes.  Outputs ARE the next inputs
+        # (same shape and memory kind by construction of _step).
+        @jax.jit
+        def chained(k):
+            ins = lax.fori_loop(0, k, lambda _, t: group_once(t), tuple(bufs))
+            return self._force_scalar(ins)
+
+        def make(k: int):
+            return lambda: chained(k)
+
+        one = jax.jit(lambda: group_once(tuple(bufs)))
+        direct = lambda: jax.block_until_ready(one())  # noqa: E731
+        return BuiltGroup(
+            build_chain=make,
+            direct_fn=direct,
+            n_bytes_per_iter=sum(self._iter_bytes(c) for c in cmds),
+            cmd_bytes=[self._iter_bytes(c) for c in cmds],
+        )
+
+    # -- eagerly dispatched programs --------------------------------------
+
+    def _build_dispatch(self, cmds: Sequence[Command], mode: str) -> BuiltGroup:
+        block_each = mode == "dispatch_serial"
+        bufs = [alloc(c, seed=i) for i, c in enumerate(cmds)]
+        fns = []
+        for cmd, buf in zip(cmds, bufs):
+            if cmd.kind == "copy" and cmd.src is MemKind.M:
+                # pageable host -> device: a runtime transfer, like the
+                # reference's H2D `target update to` from malloc'd memory
+                fns.append(functools.partial(
+                    jax.device_put, buf, host_sharding(cmd.dst)))
+            elif cmd.kind == "copy" and cmd.dst is MemKind.M:
+                dev_buf = jax.device_put(buf, host_sharding(cmd.src))
+                fns.append(functools.partial(np.asarray, dev_buf))
+            else:
+                jitted = jax.jit(functools.partial(self._apply, cmd))
+                fns.append(functools.partial(jitted, buf))
+
+        def run_once():
+            outs = []
+            for f in fns:
+                o = f()
+                if block_each:
+                    o = jax.block_until_ready(o)
+                outs.append(o)
+            return jax.block_until_ready(outs)
+
+        def make(k: int):
+            def run_k():
+                out = None
+                for _ in range(k):
+                    out = run_once()
+                return out
+
+            return run_k
+
+        return BuiltGroup(
+            build_chain=make,
+            direct_fn=run_once,
+            n_bytes_per_iter=sum(c.bytes for c in cmds),
+            cmd_bytes=[c.bytes for c in cmds],
+        )
+
+
+class PallasBackend:
+    name = "pallas"
+    modes = ("dma_serial", "dma_overlap")
+
+    def solo_mode(self, mode: str) -> str:
+        return "dma_serial"
+
+    def validate(self, mode: str, cmds: Sequence[Command]) -> None:
+        if mode not in self.modes:
+            raise ValueError(f"backend {self.name}: unknown mode {mode!r}; "
+                             f"modes: {self.modes}")
+        for c in cmds:
+            if c.kind == "copy" and not (c.src is MemKind.D and c.dst is MemKind.D):
+                raise ValueError(
+                    f"pallas backend overlaps on-chip DMA with compute; "
+                    f"command {c.text!r} is not a D2D copy (Mosaic kernels "
+                    "cannot address host memory kinds)"
+                )
+
+    def build(self, cmds: Sequence[Command], mode: str) -> BuiltGroup:
+        overlap = mode == "dma_overlap"
+        copies = [c for c in cmds if c.kind == "copy"]
+        computes = [c for c in cmds if c.kind == "compute"]
+        copy_bufs = [alloc(c, seed=10 + i) for i, c in enumerate(copies)]
+        comp_bufs = [alloc(c, seed=20 + i) for i, c in enumerate(computes)]
+        interpret = _interpret()
+
+        n_copy = len(copies)
+
+        n_comp = len(computes)
+
+        def kernel(*refs):
+            # ref order: in_refs (copy_srcs, comp_ins), out_refs (copy_dsts,
+            # comp_outs), scratch (sems)
+            copy_srcs = refs[0:n_copy]
+            comp_ins = refs[n_copy : n_copy + n_comp]
+            copy_dsts = refs[n_copy + n_comp : 2 * n_copy + n_comp]
+            comp_outs = refs[2 * n_copy + n_comp : 2 * n_copy + 2 * n_comp]
+            sems = refs[-1]
+            dmas = [
+                pltpu.make_async_copy(src, dst, sems.at[i])
+                for i, (src, dst) in enumerate(zip(copy_srcs, copy_dsts))
+            ]
+            if overlap:
+                for d in dmas:
+                    d.start()
+                for cmd, i_ref, o_ref in zip(computes, comp_ins, comp_outs):
+                    o_ref[...] = busy_wait_xla(i_ref[...], cmd.tripcount)
+                for d in dmas:
+                    d.wait()
+            else:
+                for d in dmas:
+                    d.start()
+                    d.wait()
+                for cmd, i_ref, o_ref in zip(computes, comp_ins, comp_outs):
+                    o_ref[...] = busy_wait_xla(i_ref[...], cmd.tripcount)
+
+        in_specs = [pl.BlockSpec(memory_space=pl.ANY)] * n_copy + [
+            pl.BlockSpec(memory_space=pltpu.VMEM)
+        ] * len(computes)
+        out_specs = [pl.BlockSpec(memory_space=pl.ANY)] * n_copy + [
+            pl.BlockSpec(memory_space=pltpu.VMEM)
+        ] * len(computes)
+        out_shape = [jax.ShapeDtypeStruct(b.shape, b.dtype) for b in copy_bufs] + [
+            jax.ShapeDtypeStruct(b.shape, b.dtype) for b in comp_bufs
+        ]
+
+        call = pl.pallas_call(
+            kernel,
+            out_shape=tuple(out_shape),
+            in_specs=in_specs,
+            out_specs=tuple(out_specs),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((max(n_copy, 1),))],
+            interpret=interpret,
+        )
+
+        args = tuple(copy_bufs) + tuple(comp_bufs)
+
+        @jax.jit
+        def chained(k):
+            def body(_, ins):
+                # outputs mirror inputs (copy dsts + compute outs, same
+                # shapes), so they feed the next iteration directly: true
+                # data chaining
+                return call(*ins)
+
+            ins = lax.fori_loop(0, k, body, args)
+            outs = call(*ins)
+            return jnp.stack([jnp.sum(o[..., :1, :1]) for o in outs]).sum()
+
+        def make(k: int):
+            return lambda: chained(k)
+
+        one = jax.jit(lambda: call(*args))
+        return BuiltGroup(
+            build_chain=make,
+            direct_fn=lambda: jax.block_until_ready(one()),
+            n_bytes_per_iter=sum(c.bytes for c in cmds),
+            cmd_bytes=[c.bytes for c in cmds],
+        )
+
+
+BACKENDS = {b.name: b for b in (XLABackend(), PallasBackend())}
+
+
+def get_backend(name: str):
+    try:
+        return BACKENDS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown backend {name!r}; available: {', '.join(BACKENDS)}"
+        ) from None

@@ -1,0 +1,127 @@
+"""The command language: ``C`` (compute) and ``X2Y`` copies over M/D/H/S.
+
+Reference: concurency/main.cpp:84-89 defines the one-letter memory taxonomy
+— M(host malloc), D(device), H(pinned host), S(shared/USM) — and commands
+are either ``C`` (busy-wait kernel) or ``X2Y`` (copy from kind X to kind Y),
+given as repeated groups ``--commands "C M2D" ...`` (:143-196).
+
+TPU mapping of the taxonomy (probed from PJRT memory kinds):
+  M -> host numpy, outside the runtime     (pageable host, eager path only)
+  D -> ``device`` memory kind              (HBM)
+  H -> ``pinned_host`` memory kind         (DMA-able host, jit-addressable)
+  S -> ``unpinned_host`` memory kind       (host memory the device can reach
+                                            lazily — the USM-shared analogue)
+
+D/H/S copies compile into the program (device_put with a memory-kind
+sharding); M copies are host-runtime calls, so backends that time inside one
+compiled program reject them (validate_mode analogue, bench_omp.cpp:15-19).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import re
+
+import jax
+import numpy as np
+
+
+class MemKind(enum.Enum):
+    M = "host_malloc"  # pageable host numpy
+    D = "device"  # HBM
+    H = "pinned_host"
+    S = "unpinned_host"  # shared/USM analogue
+
+
+@dataclasses.dataclass
+class Command:
+    """One parsed command with its workload knobs (auto-tunable)."""
+
+    text: str  # canonical text, e.g. "C" or "H2D"
+    kind: str  # "compute" | "copy"
+    src: MemKind | None = None
+    dst: MemKind | None = None
+    tripcount: int = 40_000  # compute knob (ref default, main.cpp:99)
+    elements: int = 1024  # compute buffer elements (rows*128)
+    copy_elements: int = 1 << 22  # copy buffer elements
+
+    @property
+    def bytes(self) -> int:
+        n = self.elements if self.kind == "compute" else self.copy_elements
+        return 4 * n  # float32 buffers throughout, as the reference
+
+    # Tuning caps: the linear rescale must not explode a fast command into
+    # an absurd workload (a VMEM-resident copy is ~1000x faster than HBM, so
+    # matching a long compute would otherwise demand GB-scale buffers).
+    MAX_TRIPCOUNT = 10_000_000
+    MAX_COPY_ELEMENTS = 1 << 25  # 128 MiB float32
+
+    def scaled(self, factor: float) -> "Command":
+        """Linear workload rescale (≙ commands_to_parameters_tunned,
+        main.cpp:248-257): compute scales tripcount, copies scale size."""
+        c = dataclasses.replace(self)
+        if self.kind == "compute":
+            c.tripcount = min(
+                self.MAX_TRIPCOUNT, max(1, int(round(self.tripcount * factor)))
+            )
+        else:
+            # keep the (rows, 128) layout: round to 128-element multiples
+            c.copy_elements = min(
+                self.MAX_COPY_ELEMENTS,
+                max(128, 128 * int(round(self.copy_elements * factor / 128))),
+            )
+        return c
+
+
+_COPY_RE = re.compile(r"^([MDHS])2([MDHS])$")
+
+
+def parse_command(tok: str) -> Command:
+    """≙ sanitize_command (main.cpp:14-19): 'C' or 'X2Y' over {M,D,H,S}."""
+    tok = tok.strip().upper()
+    if tok == "C":
+        return Command(text="C", kind="compute")
+    m = _COPY_RE.match(tok)
+    if not m:
+        raise ValueError(
+            f"bad command {tok!r}: expected 'C' or 'X2Y' with X,Y in M/D/H/S "
+            "(e.g. 'M2D', 'H2D', 'D2S')"
+        )
+    src, dst = MemKind[m.group(1)], MemKind[m.group(2)]
+    if src is dst and src is not MemKind.D:
+        # D2D (HBM->HBM DMA) is a real on-chip transfer; same-kind host
+        # copies are not a device pattern
+        raise ValueError(f"copy {tok!r} has identical source and destination")
+    return Command(text=tok, kind="copy", src=src, dst=dst)
+
+
+def parse_group(group: str) -> list[Command]:
+    """One ``--commands`` group: whitespace-separated command list."""
+    cmds = [parse_command(t) for t in group.split()]
+    if not cmds:
+        raise ValueError("empty command group")
+    return cmds
+
+
+def host_sharding(kind: MemKind, device=None):
+    """Sharding that pins a buffer to the given memory kind on one device."""
+    from jax.sharding import SingleDeviceSharding
+
+    device = device or jax.devices()[0]
+    return SingleDeviceSharding(device, memory_kind=kind.value)
+
+
+def alloc(cmd: Command, device=None, seed: int = 0):
+    """Source buffer for a command, resident in its source memory kind
+    (≙ per-command USM allocation, bench_sycl.cpp:54-72)."""
+    rng = np.random.default_rng(seed)
+    if cmd.kind == "compute":
+        rows = max(1, cmd.elements // 128)
+        arr = rng.random((rows, 128), dtype=np.float32)
+        return jax.device_put(arr, host_sharding(MemKind.D, device))
+    rows = max(1, cmd.copy_elements // 128)
+    arr = rng.random((rows, 128), dtype=np.float32)
+    if cmd.src is MemKind.M:
+        return arr  # plain numpy: pageable host memory
+    return jax.device_put(arr, host_sharding(cmd.src, device))

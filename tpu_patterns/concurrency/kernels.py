@@ -1,0 +1,72 @@
+"""The FMA busy-wait kernel: a pure-compute knob with linear runtime.
+
+Reference: concurency/bench.hpp:7-31 — a MAD_4/MAD_16/MAD_64 macro ladder;
+each work-item performs ``64 * tripcount`` fused multiply-adds, giving a
+device busy-loop whose duration scales linearly with ``tripcount``.
+
+Two implementations with identical FLOP counts:
+* ``busy_wait_pallas`` — Mosaic kernel, the native-device-code parity path
+  (the FMAs run on the VPU out of VMEM, blocked (8, 128) to match the
+  native tile);
+* ``busy_wait_xla``    — plain ``lax.fori_loop`` version, the calibration
+  reference (SURVEY.md C10) and the portable fallback.
+
+The iteration ``x = x*a + b`` with a<1 contracts toward b/(1-a), so values
+stay finite and nonzero for any tripcount — the result must stay
+data-dependent or XLA would fold the loop away.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+_A = 0.999999
+_B = 1e-6
+FMAS_PER_TRIP = 64  # ≙ MAD_64 (bench.hpp:24-26)
+
+
+def _mad64(x):
+    # 64 unrolled FMAs per trip, the MAD_64 ladder flattened at trace time.
+    for _ in range(FMAS_PER_TRIP):
+        x = x * _A + _B
+    return x
+
+
+def _busy_wait_body(tripcount: int, x):
+    return lax.fori_loop(0, tripcount, lambda _, v: _mad64(v), x)
+
+
+def busy_wait_xla(x: jax.Array, tripcount: int) -> jax.Array:
+    """Pure-XLA busy wait: 64*tripcount FMAs per element."""
+    return _busy_wait_body(tripcount, x)
+
+
+def _busy_wait_kernel(tripcount: int, x_ref, o_ref):
+    o_ref[...] = _busy_wait_body(tripcount, x_ref[...])
+
+
+def busy_wait_pallas(
+    x: jax.Array, tripcount: int, interpret: bool = False
+) -> jax.Array:
+    """Pallas busy wait; input must be 2-D with a 128-multiple minor dim."""
+    rows, cols = x.shape
+    block_rows = 8 if rows % 8 == 0 else rows
+    grid = (rows // block_rows,)
+    return pl.pallas_call(
+        functools.partial(_busy_wait_kernel, tripcount),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        grid=grid,
+        in_specs=[pl.BlockSpec((block_rows, cols), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((block_rows, cols), lambda i: (i, 0)),
+        interpret=interpret,
+    )(x)
+
+
+def flops(n_elements: int, tripcount: int) -> int:
+    """2 FLOPs per FMA."""
+    return 2 * FMAS_PER_TRIP * tripcount * n_elements

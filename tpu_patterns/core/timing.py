@@ -82,6 +82,11 @@ class TimingResult:
         return statistics.fmean(self.times_ns)
 
     @property
+    def spread_ns(self) -> int:
+        """max - min over reps: the jitter floor of this measurement."""
+        return max(self.times_ns) - min(self.times_ns)
+
+    @property
     def min_s(self) -> float:
         return self.min_ns * 1e-9
 
@@ -231,25 +236,31 @@ def measure_chain(
     build_chain: Callable[[int], Callable[[], Any]],
     reps: int = 5,
     warmup: int = 1,
-    lengths: tuple[int, int] = (1, 9),
+    lengths: tuple[int, int] | None = None,
     mode: TimingMode | None = None,
     barrier: Callable[[], None] | None = device_barrier,
     label: str = "",
     direct_fn: Callable[[], Any] | None = None,
+    max_chain: int = 4096,
 ) -> ChainMeasurement:
     """Measure one op via ``build_chain(k)`` = callable running k dependent
     iterations and returning a SMALL data-dependent array (fetched here to
-    force execution).
+    force execution).  Backends implement k as a traced ``fori_loop`` bound,
+    so probing many chain lengths costs one compilation.
 
     DIRECT: min-over-reps of ``direct_fn`` (the *plain* op, fenced with
     block_until_ready) — the reference's discipline, which times only the
     transfer/kernel, not the verification reduction the chain carries.
     Falls back to chain(1) when no direct_fn is given.
-    AMORTIZED: min-over-reps of chain(k0) and chain(k1);
-    per_op = (min(t1) - min(t0)) / (k1 - k0), clamped to min(t1)/k1 when
-    noise makes the difference non-positive.  The chain's trailing scalar
-    reduction is shared by both chain lengths, so it cancels in the
-    difference.
+
+    AMORTIZED: per_op = (min t[k1] - min t[k0]) / (k1 - k0).  With
+    ``lengths=None`` the long length adapts: k grows geometrically until the
+    differential clears the measured jitter floor (spread of the k0 reps) by
+    4x — on remote-tunneled runtimes the fixed fetch round trip is tens of
+    ms with several ms of jitter, so fast ops need long chains before the
+    signal emerges.  The chain's trailing scalar reduction is shared by all
+    chain lengths and cancels.  Clamped to min(t1)/k1 (an upper bound) when
+    noise leaves a non-positive difference.
     """
     import numpy as np
 
@@ -265,17 +276,35 @@ def measure_chain(
         return ChainMeasurement(
             per_op_ns=float(res.min_ns), mode=mode, short=res, lengths=(1, 1)
         )
-    k0, k1 = lengths
-    assert k1 > k0 >= 1
-    f0, f1 = build_chain(k0), build_chain(k1)
-    r0 = min_over_reps(
-        lambda: np.asarray(f0()), reps=reps, warmup=warmup, barrier=barrier,
-        label=f"{label}[k={k0}]",
-    )
-    r1 = min_over_reps(
-        lambda: np.asarray(f1()), reps=reps, warmup=warmup, barrier=barrier,
-        label=f"{label}[k={k1}]",
-    )
+
+    def timed(k: int, w: int, n_reps: int | None = None) -> TimingResult:
+        f = build_chain(k)
+        return min_over_reps(
+            lambda: np.asarray(f()), reps=n_reps or reps, warmup=w,
+            barrier=barrier, label=f"{label}[k={k}]",
+        )
+
+    if lengths is not None:
+        k0, k1 = lengths
+        assert k1 > k0 >= 1
+        r0 = timed(k0, warmup)
+        r1 = timed(k1, warmup)
+    else:
+        k0 = 1
+        r0 = timed(k0, warmup)
+        threshold = max(4 * r0.spread_ns, 10_000_000)  # >= 10 ms of signal
+        # Intermediate probes only decide whether the differential clears
+        # the jitter threshold — 2 reps suffice; the accepted k1 gets the
+        # full rep count below.
+        probe_reps = min(2, reps)
+        k1 = 8
+        while True:
+            r1 = timed(k1, 1, probe_reps)
+            if r1.min_ns - r0.min_ns >= threshold or k1 >= max_chain:
+                break
+            k1 = min(k1 * 4, max_chain)
+        if reps > probe_reps:
+            r1 = timed(k1, 0)
     diff = r1.min_ns - r0.min_ns
     per_op = diff / (k1 - k0) if diff > 0 else r1.min_ns / k1
     return ChainMeasurement(
