@@ -1,0 +1,129 @@
+// tpu_patterns native module: XLA-FFI handlers + direct host entry points.
+//
+// Native (C++) parity with the reference's native layers (SURVEY.md §2.2):
+//   * monotonic clock            — the distributed timing core's clock
+//                                  (≙ the std::chrono timing in
+//                                  p2p/peer2pear.cpp:26-28 and
+//                                  concurency/bench_sycl.cpp:84-121)
+//   * wrapped-int32 checksum     — the data-integrity verifier's reduction
+//                                  (≙ sort+sum validation, peer2pear.cpp:55-63)
+//   * saxpy (high-level interop) — typed zero-copy buffer sharing between
+//                                  the framework and custom C++
+//                                  (≙ OMP<->SYCL pointer sharing proof,
+//                                  interop_omp_sycl.cpp:51-72)
+//   * raw_info (low-level interop)— hand-parsed XLA_FFI_CallFrame: raw API
+//                                  version, stage, buffer handles
+//                                  (≙ native Level-Zero handle extraction,
+//                                  interop_omp_ze_sycl.cpp:25-46)
+//
+// Built as one shared library; loaded with ctypes; handlers registered via
+// jax.ffi.register_ffi_target (tpu_patterns/interop/native.py).
+
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+
+#include "xla/ffi/api/c_api.h"
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+static uint64_t MonotonicNs() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000000000ull +
+         static_cast<uint64_t>(ts.tv_nsec);
+}
+
+// Direct host entry point (no XLA involved): the framework's clock_ns()
+// calls this through ctypes when the library is built.
+extern "C" uint64_t tp_clock_ns() { return MonotonicNs(); }
+
+// --------------------------------------------------------------------------
+// FFI: clock -> u64[] (1 element).  R1 rather than R0 keeps jax.ffi output
+// shapes trivial.
+static ffi::Error ClockNsImpl(ffi::Result<ffi::Buffer<ffi::U64>> out) {
+  out->typed_data()[0] = MonotonicNs();
+  return ffi::Error::Success();
+}
+XLA_FFI_DEFINE_HANDLER_SYMBOL(TpClockNs, ClockNsImpl,
+                              ffi::Ffi::Bind().Ret<ffi::Buffer<ffi::U64>>());
+
+// --------------------------------------------------------------------------
+// FFI: checksum(f32[n]) -> s32[] — wrapped int32 sum, the exact invariant
+// comm/verify.py computes on device (unsigned arithmetic = defined wraparound).
+static ffi::Error ChecksumF32Impl(ffi::Buffer<ffi::F32> x,
+                                  ffi::Result<ffi::Buffer<ffi::S32>> out) {
+  const float* d = x.typed_data();
+  uint32_t acc = 0;
+  const size_t n = x.element_count();
+  for (size_t i = 0; i < n; ++i) {
+    acc += static_cast<uint32_t>(static_cast<int32_t>(d[i]));
+  }
+  out->typed_data()[0] = static_cast<int32_t>(acc);
+  return ffi::Error::Success();
+}
+XLA_FFI_DEFINE_HANDLER_SYMBOL(TpChecksumF32, ChecksumF32Impl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::S32>>());
+
+// --------------------------------------------------------------------------
+// FFI high-level interop: out = alpha*x + y, computed by C++ directly on the
+// XLA-owned buffers (zero copy both directions).
+static ffi::Error SaxpyImpl(float alpha, ffi::Buffer<ffi::F32> x,
+                            ffi::Buffer<ffi::F32> y,
+                            ffi::Result<ffi::Buffer<ffi::F32>> out) {
+  const size_t n = x.element_count();
+  if (y.element_count() != n || out->element_count() != n) {
+    return ffi::Error::InvalidArgument("saxpy: shape mismatch");
+  }
+  const float* xd = x.typed_data();
+  const float* yd = y.typed_data();
+  float* od = out->typed_data();
+  for (size_t i = 0; i < n; ++i) od[i] = alpha * xd[i] + yd[i];
+  return ffi::Error::Success();
+}
+XLA_FFI_DEFINE_HANDLER_SYMBOL(TpSaxpy, SaxpyImpl,
+                              ffi::Ffi::Bind()
+                                  .Attr<float>("alpha")
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>());
+
+// --------------------------------------------------------------------------
+// FFI low-level interop: a raw XLA_FFI_Handler working straight on the C
+// call frame — no C++ binding layer.  Reports what it can see of the
+// runtime: API version, execution stage, argument metadata, and echoes the
+// device pointer of its input (proving the handle is shared, not copied).
+// Output: s32[8] = {api_major, api_minor, stage, nargs, arg0_dtype,
+//                   arg0_rank, data_ptr_lo16, copied_flag}.
+extern "C" XLA_FFI_Error* TpRawInfo(XLA_FFI_CallFrame* frame) {
+  // Metadata-query stage: XLA probes the handler's API version before use.
+  for (XLA_FFI_Extension_Base* ext = frame->extension_start; ext;
+       ext = ext->next) {
+    if (ext->type == XLA_FFI_Extension_Metadata) {
+      auto* m = reinterpret_cast<XLA_FFI_Metadata_Extension*>(ext);
+      m->metadata->api_version.major_version = XLA_FFI_API_MAJOR;
+      m->metadata->api_version.minor_version = XLA_FFI_API_MINOR;
+      return nullptr;
+    }
+  }
+  if (frame->rets.size < 1 || frame->args.size < 1) return nullptr;
+  auto* in = reinterpret_cast<XLA_FFI_Buffer*>(frame->args.args[0]);
+  auto* out = reinterpret_cast<XLA_FFI_Buffer*>(frame->rets.rets[0]);
+  int32_t* o = reinterpret_cast<int32_t*>(out->data);
+  o[0] = frame->api ? frame->api->api_version.major_version : -1;
+  o[1] = frame->api ? frame->api->api_version.minor_version : -1;
+  o[2] = static_cast<int32_t>(frame->stage);
+  o[3] = static_cast<int32_t>(frame->args.size);
+  o[4] = static_cast<int32_t>(in->dtype);
+  o[5] = static_cast<int32_t>(in->rank);
+  o[6] = static_cast<int32_t>(reinterpret_cast<uintptr_t>(in->data) & 0xFFFF);
+  // Write through the raw input pointer's data to prove shared (not copied)
+  // access: checksum of first element must match what the caller sees.
+  o[7] = in->rank > 0 && in->data
+             ? static_cast<int32_t>(reinterpret_cast<float*>(in->data)[0])
+             : -1;
+  return nullptr;
+}

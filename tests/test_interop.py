@@ -1,0 +1,96 @@
+"""Tests for the native C++ FFI interop layer (SURVEY.md C13/C14, §7 step 5)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_patterns.interop import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(),
+    reason=f"native toolchain unavailable: {native.build_error()}",
+)
+
+
+class TestNativeModule:
+    def test_direct_clock_monotonic(self):
+        a = native.clock_ns()
+        b = native.clock_ns()
+        assert b >= a > 0
+
+    def test_registration_idempotent(self):
+        assert native.register()
+        assert native.register()
+
+    def test_timing_layer_uses_native_clock(self):
+        from tpu_patterns.core import timing
+
+        timing._NATIVE_CLOCK = False  # reset probe
+        assert timing.clock_ns() > 0
+        assert timing._native_clock() is native.clock_ns
+
+
+class TestHighLevelInterop:
+    """≙ the typed interop proof (interop_omp_sycl.cpp:51-72)."""
+
+    def test_ffi_clock_inside_program(self):
+        from tpu_patterns.interop import ffi_clock_ns
+
+        t = np.asarray(ffi_clock_ns())
+        assert t.dtype == np.uint64 and t[0] > 0
+
+    def test_saxpy_eager_and_jit(self):
+        from tpu_patterns.interop import ffi_saxpy
+
+        x = jnp.arange(8.0)
+        y = jnp.ones(8)
+        np.testing.assert_allclose(np.asarray(ffi_saxpy(2.0, x, y)),
+                                   2.0 * np.arange(8.0) + 1.0)
+        jitted = jax.jit(lambda a, b: ffi_saxpy(3.0, a, b) * 2.0)
+        np.testing.assert_allclose(np.asarray(jitted(x, y)),
+                                   2.0 * (3.0 * np.arange(8.0) + 1.0))
+
+    def test_checksum_matches_device_invariant(self):
+        from tpu_patterns.comm import expected_checksum, fill_randomly
+        from tpu_patterns.interop import ffi_checksum
+
+        x = fill_randomly(5_000, "float32", seed=2)
+        assert int(ffi_checksum(x)[0]) == expected_checksum(5_000, "float32")
+
+    def test_pallas_output_flows_into_cpp(self):
+        # both-runtime pointer proof: a Pallas(interpret) kernel's output is
+        # consumed zero-copy by the C++ handler inside one jit program
+        from jax.experimental import pallas as pl
+        from tpu_patterns.interop import ffi_checksum
+
+        def kern(x_ref, o_ref):
+            o_ref[...] = x_ref[...] + 1.0
+
+        @jax.jit
+        def program(x):
+            y = pl.pallas_call(
+                kern,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                interpret=True,
+            )(x)
+            return ffi_checksum(y)
+
+        x = jnp.zeros((4, 128), jnp.float32)
+        assert int(program(x)[0]) == 4 * 128
+
+
+class TestLowLevelInterop:
+    """≙ the raw-handle interop proof (interop_omp_ze_sycl.cpp:25-46,92-113)."""
+
+    def test_raw_call_frame_fields(self):
+        from tpu_patterns.interop import raw_info
+
+        info = np.asarray(raw_info(jnp.full((16,), 9.0)))
+        api_major, api_minor, stage, nargs, dtype, rank, _ptr, first = info
+        assert (api_major, api_minor) >= (0, 1)
+        assert stage == 3  # XLA_FFI_ExecutionStage_EXECUTE
+        assert nargs == 1
+        assert dtype == 11  # XLA_FFI_DataType_F32
+        assert rank == 1
+        assert first == 9  # read through the shared raw pointer

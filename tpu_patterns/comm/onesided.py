@@ -95,10 +95,6 @@ class OneSidedConfig:
     seed: int = 0
 
 
-def _use_interpret() -> bool:
-    import jax
-
-    return jax.default_backend() != "tpu"
 
 
 def run_onesided(
@@ -108,12 +104,12 @@ def run_onesided(
 ) -> list[Record]:
     """One-sided put bandwidth: remote ring put on a multi-device mesh,
     local HBM put when only one device is available."""
-    from tpu_patterns.runtime import setup_jax
+    from tpu_patterns.runtime import setup_jax, use_interpret
 
     setup_jax()
     cfg = cfg or OneSidedConfig()
     writer = writer or ResultWriter()
-    interpret = _use_interpret()
+    interpret = use_interpret()
     spec = get_dtype(cfg.dtype)
     # 2-D shape: Mosaic DMAs want a (sublane, lane)-tileable layout.
     cols = 512

@@ -37,14 +37,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 from tpu_patterns.concurrency.commands import Command, MemKind, alloc, host_sharding
 from tpu_patterns.concurrency.kernels import busy_wait_pallas, busy_wait_xla
+from tpu_patterns.runtime import use_interpret
 
 
 def _use_pallas_kernel() -> bool:
-    return jax.default_backend() == "tpu"
-
-
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    return not use_interpret()
 
 
 @dataclasses.dataclass
@@ -242,7 +239,7 @@ class PallasBackend:
         computes = [c for c in cmds if c.kind == "compute"]
         copy_bufs = [alloc(c, seed=10 + i) for i, c in enumerate(copies)]
         comp_bufs = [alloc(c, seed=20 + i) for i, c in enumerate(computes)]
-        interpret = _interpret()
+        interpret = use_interpret()
 
         n_copy = len(copies)
 
@@ -304,8 +301,7 @@ class PallasBackend:
                 return call(*ins)
 
             ins = lax.fori_loop(0, k, body, args)
-            outs = call(*ins)
-            return jnp.stack([jnp.sum(o[..., :1, :1]) for o in outs]).sum()
+            return jnp.stack([jnp.sum(o[..., :1, :1]) for o in ins]).sum()
 
         def make(k: int):
             return lambda: chained(k)

@@ -297,7 +297,7 @@ def measure_chain(
         # the jitter threshold — 2 reps suffice; the accepted k1 gets the
         # full rep count below.
         probe_reps = min(2, reps)
-        k1 = 8
+        k1 = min(8, max_chain)
         while True:
             r1 = timed(k1, 1, probe_reps)
             if r1.min_ns - r0.min_ns >= threshold or k1 >= max_chain:

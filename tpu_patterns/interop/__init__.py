@@ -1,0 +1,22 @@
+"""Framework <-> native C++ interop via XLA FFI (ref: sycl_omp_ze_interopt/).
+
+The reference demonstrates two interop depths between runtimes sharing one
+device context (SURVEY.md C13/C14): a high-level typed path (OpenMP 5.1
+``interop`` pragma yielding SYCL objects, interop_omp_sycl.cpp:13-75) and a
+low-level native-handle path (raw ze_driver/context/device extraction,
+interop_omp_ze_sycl.cpp:14-117), each proving bidirectional pointer sharing.
+
+Here the two depths are: typed C++ FFI handlers bound through
+``xla::ffi::Ffi::Bind`` (high-level), and a hand-parsed raw
+``XLA_FFI_CallFrame`` handler (low-level) — both registered into the same
+XLA runtime the framework's jitted programs execute in, operating zero-copy
+on XLA-owned buffers.
+"""
+
+from tpu_patterns.interop import native  # noqa: F401
+from tpu_patterns.interop.calls import (  # noqa: F401
+    ffi_checksum,
+    ffi_clock_ns,
+    ffi_saxpy,
+    raw_info,
+)

@@ -1,0 +1,59 @@
+"""jit-callable wrappers over the native FFI handlers.
+
+Each wrapper is the "pointer sharing proof" of the reference's interop
+suite (interop_omp_sycl.cpp:51-72 / interop_omp_ze_sycl.cpp:92-113): data
+produced inside the XLA runtime (possibly by a Pallas kernel) flows into
+C++ without a copy, and C++ results flow back into the compiled program.
+CPU-platform handlers; call under ``jax.jit`` on the CPU backend or eagerly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpu_patterns.interop import native
+
+
+def _ensure_registered():
+    if not native.register():
+        raise RuntimeError(
+            f"native FFI module unavailable: {native.build_error()}"
+        )
+
+
+def ffi_clock_ns():
+    """Monotonic timestamp taken inside the XLA program (C4 native clock)."""
+    _ensure_registered()
+    call = jax.ffi.ffi_call(
+        "tp_clock_ns", jax.ShapeDtypeStruct((1,), jnp.uint64)
+    )
+    return call()
+
+
+def ffi_checksum(x: jax.Array) -> jax.Array:
+    """Wrapped-int32 checksum computed by C++ on the XLA buffer (C5)."""
+    _ensure_registered()
+    call = jax.ffi.ffi_call(
+        "tp_checksum_f32", jax.ShapeDtypeStruct((1,), jnp.int32)
+    )
+    return call(x.astype(jnp.float32).reshape(-1))
+
+
+def ffi_saxpy(alpha: float, x: jax.Array, y: jax.Array) -> jax.Array:
+    """alpha*x + y computed by C++ zero-copy on XLA buffers (C13)."""
+    _ensure_registered()
+    import numpy as np
+
+    call = jax.ffi.ffi_call("tp_saxpy", jax.ShapeDtypeStruct(x.shape, jnp.float32))
+    return call(x.astype(jnp.float32), y.astype(jnp.float32),
+                alpha=np.float32(alpha))
+
+
+def raw_info(x: jax.Array) -> jax.Array:
+    """Low-level raw-call-frame probe (C14): returns s32[8] =
+    {api_major, api_minor, stage, nargs, arg0_dtype, arg0_rank,
+    data_ptr_lo16, first_element_as_int}."""
+    _ensure_registered()
+    call = jax.ffi.ffi_call("tp_raw_info", jax.ShapeDtypeStruct((8,), jnp.int32))
+    return call(x.astype(jnp.float32))

@@ -1,0 +1,106 @@
+"""Build/load/register the native module (csrc/tpu_patterns_ffi.cc).
+
+Build is lazy (make on first use, cached by mtime) so the repo carries no
+binaries; registration targets the CPU platform — the C++ handlers are
+host-side modules (timing core, verification, interop demos), while device
+kernels are Pallas (SURVEY.md §2.2 decision).  TPU programs can still call
+them through host offloading where supported.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
+_BUILD = os.path.abspath(os.path.join(_CSRC, "..", "build"))
+_SO = os.path.join(_BUILD, "libtpu_patterns_ffi.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_registered = False
+_build_error: str | None = None
+
+HANDLERS = ("TpClockNs", "TpChecksumF32", "TpSaxpy", "TpRawInfo")
+TARGETS = {
+    "tp_clock_ns": "TpClockNs",
+    "tp_checksum_f32": "TpChecksumF32",
+    "tp_saxpy": "TpSaxpy",
+    "tp_raw_info": "TpRawInfo",
+}
+
+
+def _build() -> bool:
+    global _build_error
+    src = os.path.join(_CSRC, "tpu_patterns_ffi.cc")
+    if not os.path.exists(src):
+        _build_error = f"source missing: {src}"
+        return False
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(src):
+        return True
+    try:
+        proc = subprocess.run(
+            ["make", "-C", _CSRC, "BUILD=" + _BUILD],
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:  # no toolchain
+        _build_error = str(e)
+        return False
+    if proc.returncode != 0:
+        _build_error = proc.stderr[-2000:]
+        return False
+    return True
+
+
+def load() -> ctypes.CDLL | None:
+    """Build if needed and dlopen; None when the toolchain is unavailable."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not _build():
+            return None
+        _lib = ctypes.CDLL(_SO)
+        _lib.tp_clock_ns.restype = ctypes.c_uint64
+        _lib.tp_clock_ns.argtypes = []
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def build_error() -> str | None:
+    return _build_error
+
+
+def clock_ns() -> int:
+    """Direct (non-XLA) native monotonic clock."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError(f"native module unavailable: {_build_error}")
+    return int(lib.tp_clock_ns())
+
+
+def register(platform: str = "cpu") -> bool:
+    """Register every FFI handler with JAX (idempotent)."""
+    global _registered
+    lib = load()
+    if lib is None:
+        return False
+    with _lock:
+        if _registered:
+            return True
+        import jax.ffi
+
+        for target, symbol in TARGETS.items():
+            fn = getattr(lib, symbol)
+            jax.ffi.register_ffi_target(
+                target, jax.ffi.pycapsule(fn), platform=platform
+            )
+        _registered = True
+        return True

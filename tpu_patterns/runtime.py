@@ -5,6 +5,14 @@ from __future__ import annotations
 import os
 
 
+def use_interpret() -> bool:
+    """Whether Pallas kernels must run in interpret mode (no Mosaic
+    lowering): any non-TPU backend, e.g. the CPU-simulated test mesh."""
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
 def setup_jax(cache_dir: str | None = None) -> None:
     """Enable the persistent XLA compilation cache.
 
