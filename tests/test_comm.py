@@ -100,7 +100,7 @@ class TestP2P:
         assert uni.mode == "unidirectional" and bi.mode == "bidirectional"
         for r in recs:
             assert r.verdict is Verdict.SUCCESS, r.notes
-            assert r.metrics["bandwidth_gbps"] > 0
+            assert r.metrics["bandwidth_GBps"] > 0
             assert r.metrics["checksum_ok"] == 1.0
         assert bi.metrics["num_transfers"] == 2 * uni.metrics["num_transfers"]
 

@@ -79,7 +79,7 @@ class TestResults:
             pattern="p2p",
             mode="unidirectional",
             commands="pairs=4",
-            metrics={"bandwidth_gbps": 123.4},
+            metrics={"bandwidth_GBps": 123.4},
             verdict=Verdict.SUCCESS,
         )
         back = Record.from_json(rec.to_json())

@@ -1,0 +1,122 @@
+"""Miniapp framework + ring-allreduce app (SURVEY.md C15-C17).
+
+The parametrized matrix below IS the CTest registration: every discovered
+<app>/<variant> x dtype x algorithm runs as its own self-validating test,
+exactly how add_typed_mpi_app turns builds into `mpirun -np 4` CTest runs
+(src/CMakeLists.txt:39-50)."""
+
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from tpu_patterns.core.results import ResultWriter, Verdict
+from tpu_patterns.miniapps import framework
+from tpu_patterns.miniapps.apps import allreduce as core
+
+N = 512  # small per-rank buffer for CPU-simulated runs
+FAST = dict(elements=N, reps=2, warmup=1)
+
+
+def _mesh(devices, n):
+    return Mesh(np.array(devices[:n]), ("ranks",))
+
+
+def test_discovery_finds_allreduce_variants():
+    specs = framework.discover()
+    names = {s.name for s in specs}
+    assert {"allreduce/xla", "allreduce/pallas"} <= names
+    x = framework.get_variant("allreduce", "xla")
+    assert "float32" in x.dtypes and "int32" in x.dtypes  # typed matrix
+    with pytest.raises(KeyError):
+        framework.get_variant("allreduce", "cuda")
+
+
+def test_typed_runs_expand_dtypes():
+    pairs = list(framework.typed_runs())
+    assert ("allreduce/xla", "int32") in {(s.name, d) for s, d in pairs}
+    assert len(pairs) >= 5
+
+
+# The full matrix: variant x dtype x algorithm (≙ CTest's app list).
+MATRIX = [
+    (spec, dt, alg)
+    for spec, dt in framework.typed_runs()
+    for alg in spec.axes.get("algorithm", ("ring",))
+]
+
+
+@pytest.mark.parametrize(
+    "spec,dtype,alg", MATRIX, ids=[f"{s.name}.{d}.{a}" for s, d, a in MATRIX]
+)
+def test_allreduce_matrix(devices, spec, dtype, alg):
+    mesh = _mesh(devices, 4)
+    rec = spec.run(mesh=mesh, dtype=dtype, algorithm=alg, **FAST)
+    assert rec.verdict is Verdict.SUCCESS
+    assert rec.metrics["validated"] == 1.0
+    assert rec.metrics["wall_s"] > 0
+    assert rec.config["world"] == 4
+
+
+def test_allreduce_eight_ranks(devices):
+    rec = framework.get_variant("allreduce", "xla").run(
+        mesh=_mesh(devices, 8), dtype="float32", algorithm="ring_opt", **FAST
+    )
+    assert rec.verdict is Verdict.SUCCESS
+
+
+def test_world_size_requirement(devices):
+    # ≙ allreduce-mpi-sycl.cpp:95-97: even size >= 4 or error out.
+    spec = framework.get_variant("allreduce", "xla")
+    with pytest.raises(ValueError, match="even world size"):
+        spec.run(mesh=_mesh(devices, 2), dtype="float32", **FAST)
+    rec = spec.run(
+        mesh=_mesh(devices, 2), dtype="float32", require_even_ge4=False, **FAST
+    )
+    assert rec.verdict is Verdict.SUCCESS  # override for reduced CI meshes
+
+
+def test_pallas_rejects_library_path(devices):
+    with pytest.raises(ValueError, match="manual ring"):
+        framework.get_variant("allreduce", "pallas").run(
+            mesh=_mesh(devices, 4), dtype="float32", algorithm="psum", **FAST
+        )
+
+
+def test_ring_opt_divisibility(devices):
+    with pytest.raises(ValueError, match="elements % world"):
+        framework.get_variant("allreduce", "xla").run(
+            mesh=_mesh(devices, 4),
+            dtype="float32",
+            algorithm="ring_opt",
+            elements=130,
+            reps=1,
+        )
+
+
+@pytest.mark.parametrize("kind", sorted(core.MEM_KINDS))
+def test_allocator_matrix(devices, kind):
+    # ≙ the -H/-D/-S allocator choices (allreduce-mpi-sycl.cpp:104-131).
+    # Host kinds may be unsupported on a backend -> clean SKIPPED, never an
+    # exception (the reference instead #ifdef-gates its USM allocators).
+    rec = framework.get_variant("allreduce", "xla").run(
+        mesh=_mesh(devices, 4), dtype="float32", mem_kind=kind, **FAST
+    )
+    assert rec.verdict in (Verdict.SUCCESS, Verdict.SKIPPED)
+    if kind == "D":
+        assert rec.verdict is Verdict.SUCCESS
+
+
+def test_run_all_aggregates(devices, tmp_path):
+    writer = ResultWriter(jsonl_path=tmp_path / "miniapps.jsonl")
+    records = framework.run_all(writer=writer, mesh=_mesh(devices, 4), **FAST)
+    assert len(records) == len(list(framework.typed_runs()))
+    assert writer.exit_code == 0  # ≙ ctest all green
+    lines = (tmp_path / "miniapps.jsonl").read_text().splitlines()
+    assert len(lines) == len(records)
+
+
+def test_wire_bytes_model():
+    nb = 1000
+    assert core.wire_bytes_per_rank("ring", nb, 4) == 3000
+    assert core.wire_bytes_per_rank("ring_opt", nb, 4) == 1500
+    assert core.wire_bytes_per_rank("psum", nb, 4) == 1500

@@ -44,7 +44,7 @@ class TestRunOneSided:
         (rec,) = recs
         assert rec.mode == "ring_put"
         assert rec.verdict is Verdict.SUCCESS, rec.notes
-        assert rec.metrics["bandwidth_gbps"] > 0
+        assert rec.metrics["bandwidth_GBps"] > 0
 
     def test_single_device(self, devices):
         from jax.sharding import Mesh

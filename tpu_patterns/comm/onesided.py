@@ -201,7 +201,7 @@ def run_onesided(
         mode=mode,
         commands=f"{n_dev}dev x {shard_bytes // 1_000_000}MB",
         metrics={
-            "bandwidth_gbps": gbps,
+            "bandwidth_GBps": gbps,
             "min_time_us": res.us(),
             "bytes_per_put": float(shard_bytes),
             "checksum_ok": float(data_ok),

@@ -164,7 +164,7 @@ def run_p2p(
             mode=name,
             commands=f"{n_dev}dev x {shard_bytes // 1_000_000}MB",
             metrics={
-                "bandwidth_gbps": gbps,
+                "bandwidth_GBps": gbps,
                 "min_time_us": res.us(),
                 "bytes_per_pair": float(shard_bytes),
                 "num_transfers": float(num_pairs),

@@ -45,27 +45,34 @@ def library_allreduce(x: jax.Array, axis_name: str) -> jax.Array:
     return lax.psum(x, axis_name)
 
 
-def ring_allreduce_naive(x: jax.Array, axis_name: str, axis_size: int):
+def ring_allreduce_naive(x: jax.Array, axis_name: str, axis_size: int, op=None):
     """Reference-parity ring: accumulate, then (p-1) x {shift, accumulate}
     (allreduce-mpi-sycl.cpp:173-182).  Buffer "swap" (:179) becomes carry
-    rotation in the fori_loop — zero-copy either way."""
+    rotation in the fori_loop — zero-copy either way.
+
+    ``op(acc, buf)`` is the per-step accumulate (≙ the Accumulate device
+    kernel, :26-31); default elementwise add.  The miniapp's Pallas variant
+    passes its Mosaic kernel here.
+    """
+    add = op if op is not None else (lambda a, b: a + b)
     if axis_size == 1:
         return x
 
     def body(_, carry):
         acc, buf = carry
         buf = ring_shift(buf, axis_name, axis_size)
-        return acc + buf, buf
+        return add(acc, buf), buf
 
     acc, _ = lax.fori_loop(0, axis_size - 1, body, (x, x))
     return acc
 
 
-def ring_allreduce_optimal(x: jax.Array, axis_name: str, axis_size: int):
+def ring_allreduce_optimal(x: jax.Array, axis_name: str, axis_size: int, op=None):
     """Bandwidth-optimal ring: reduce-scatter then all-gather, each a
     (p-1)-step chunk ring.  Requires the per-device length to be divisible
     by ``axis_size`` (pad upstream if needed).
     """
+    add = op if op is not None else (lambda a, b: a + b)
     p = axis_size
     if p == 1:
         return x
@@ -88,7 +95,7 @@ def ring_allreduce_optimal(x: jax.Array, axis_name: str, axis_size: int):
         buf, send = carry
         recv = ring_shift(send, axis_name, p)
         recv_idx = (r - t - 1) % p
-        new_val = get(buf, recv_idx) + recv
+        new_val = add(get(buf, recv_idx), recv)
         buf = put(buf, recv_idx, new_val)
         return buf, new_val
 
@@ -108,12 +115,14 @@ def ring_allreduce_optimal(x: jax.Array, axis_name: str, axis_size: int):
     return flat.reshape(x.shape)
 
 
-def allreduce(x: jax.Array, axis_name: str, axis_size: int, variant: str):
-    """Dispatch table for the miniapp's algorithm matrix."""
+def allreduce(x: jax.Array, axis_name: str, axis_size: int, variant: str, op=None):
+    """Dispatch table for the miniapp's algorithm matrix.  ``op`` customizes
+    the per-step accumulate of the manual rings; the library path ignores it
+    (XLA owns the schedule, ≙ MPI_Allreduce owning the reduction op)."""
     if variant == "psum":
         return library_allreduce(x, axis_name)
     if variant == "ring":
-        return ring_allreduce_naive(x, axis_name, axis_size)
+        return ring_allreduce_naive(x, axis_name, axis_size, op=op)
     if variant == "ring_opt":
-        return ring_allreduce_optimal(x, axis_name, axis_size)
+        return ring_allreduce_optimal(x, axis_name, axis_size, op=op)
     raise ValueError(f"unknown allreduce variant {variant!r}")

@@ -1,0 +1,17 @@
+"""Miniapps: self-validating distributed apps with a variant matrix.
+
+TPU-native re-design of the reference's `aurora.mpich.miniapps` tree
+(SURVEY.md C15-C17): a discovery framework (framework.py ≙ the CMake
+variant glob + CTest registration, src/CMakeLists.txt:12-19,39-50) over
+apps laid out as ``apps/<app>/<variant>.py`` — the same ``<app>/<variant>``
+convention the reference globs from disk.
+"""
+
+from tpu_patterns.miniapps.framework import (  # noqa: F401
+    VariantSpec,
+    default_mesh,
+    discover,
+    get_variant,
+    run_all,
+    typed_runs,
+)

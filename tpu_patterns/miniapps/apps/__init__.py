@@ -1,0 +1,2 @@
+"""Miniapp tree: ``apps/<app>/<variant>.py`` ≙ the reference's
+``src/<app>/<paradigm-variant>/`` layout (README.rst:15-37)."""

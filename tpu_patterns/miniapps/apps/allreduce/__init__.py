@@ -1,0 +1,208 @@
+"""Ring-allreduce miniapp core (shared by the xla and pallas variants).
+
+TPU-native re-design of the reference's allreduce miniapp
+(aurora.mpich.miniapps/src/allreduce/mpi-sycl/allreduce-mpi-sycl.cpp and
+the two mpi-omp-offload twins, SURVEY.md C16/C17):
+
+* each rank owns a full N-element buffer initialized to its rank id
+  (Initialize kernel, allreduce-mpi-sycl.cpp:33-41) — here one shard of a
+  (p*N,) array per mesh position;
+* the timed region (:170-183) runs either the manual ring — accumulate,
+  then (size-1) x {ring shift, swap, accumulate} (:173-182) — or the
+  library collective (``-a`` → MPI_Allreduce, :62-67 ≙ ``lax.psum``), as
+  ONE compiled shard_map program per device;
+* allocator matrix ``-H/-D/-S`` (:104-131,154-159; allreduce/README.md's
+  allocator table) maps to PJRT memory kinds pinned_host / device (HBM) /
+  unpinned_host on the buffer shardings;
+* requires an even world size >= 4 (:95-97);
+* validation: every element equals ``size*(size-1)/2`` within 1e-6
+  (:192-204), each rank reporting ``Passed <rank>`` (:206);
+* timing: max-over-ranks wall time of the region (:185-190) via
+  core.timing's chained discipline (min-over-reps; amortized on
+  async-dispatch runtimes).
+
+Beyond parity, the ``ring_opt`` algorithm (reduce-scatter + all-gather,
+comm/ring.py) moves 2(p-1)/p x N bytes instead of the naive ring's
+(p-1) x N — the bandwidth-optimal schedule the reference leaves on the
+table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_patterns.comm import ring
+from tpu_patterns.core import timing
+from tpu_patterns.core.results import Record, ResultWriter, Verdict
+
+ALGORITHMS = ("ring", "ring_opt", "psum")
+
+# Allocator letter -> PJRT memory kind (≙ the -H/-D/-S getopt choices,
+# allreduce-mpi-sycl.cpp:104-131; same taxonomy as concurrency/commands.py).
+MEM_KINDS = {"H": "pinned_host", "D": "device", "S": "unpinned_host"}
+
+
+@dataclasses.dataclass
+class AllreduceConfig:
+    elements: int = 1 << 25  # per-rank N (≙ -p default 2^25, :99,125-128)
+    dtype: str = "float32"
+    algorithm: str = "ring"  # manual ring is the no-flag default (:173-182)
+    mem_kind: str = "D"
+    reps: int = 5
+    warmup: int = 1
+    tol: float = 1e-6  # elementwise tolerance (:203)
+    require_even_ge4: bool = True  # ≙ :95-97
+
+
+def _check_world(p: int, cfg: AllreduceConfig) -> None:
+    if cfg.require_even_ge4 and (p < 4 or p % 2):
+        raise ValueError(
+            f"allreduce miniapp needs an even world size >= 4, got {p} "
+            "(≙ allreduce-mpi-sycl.cpp:95-97)"
+        )
+
+
+def _rescale(y: jax.Array, p: int) -> jax.Array:
+    """Bounded loop-carried feed for the timing chain: after one allreduce
+    all shards are equal, so dividing by p makes further iterations a fixed
+    point — values stay finite for any chain length, and the elementwise op
+    is negligible next to the ring traffic."""
+    if jnp.issubdtype(y.dtype, jnp.integer):
+        return y // p
+    return (y * (1.0 / p)).astype(y.dtype)
+
+
+def wire_bytes_per_rank(algorithm: str, n_bytes: int, p: int) -> float:
+    """Bytes each rank puts on the wire for one allreduce."""
+    if algorithm == "ring":
+        return float((p - 1) * n_bytes)  # full buffer each step (:177-181)
+    # reduce-scatter + all-gather (also the busbw convention for psum,
+    # whose schedule XLA owns)
+    return 2.0 * (p - 1) / p * n_bytes
+
+
+def run_allreduce(
+    mesh,
+    cfg: AllreduceConfig,
+    writer: ResultWriter | None = None,
+    op=None,
+    variant: str = "xla",
+) -> Record:
+    """One app invocation: init, timed allreduce, validate, verdict."""
+    from tpu_patterns.runtime import setup_jax
+
+    setup_jax()
+    writer = writer or ResultWriter()
+    axis = mesh.axis_names[0]
+    p = int(np.prod(mesh.devices.shape))
+    _check_world(p, cfg)
+    if cfg.algorithm not in ALGORITHMS:
+        raise ValueError(f"unknown algorithm {cfg.algorithm!r}; one of {ALGORITHMS}")
+    if cfg.algorithm == "ring_opt" and cfg.elements % p:
+        raise ValueError(
+            f"ring_opt needs elements % world == 0, got {cfg.elements} % {p}"
+        )
+    kind = MEM_KINDS[cfg.mem_kind]
+    dtype = jnp.dtype(cfg.dtype)
+    n_bytes = cfg.elements * dtype.itemsize
+    label = f"{p}dev {cfg.dtype} {cfg.mem_kind} N={cfg.elements}"
+    writer.progress(
+        f"allreduce[{variant}:{cfg.algorithm}]: {label} "
+        f"({n_bytes / 1e6:.1f} MB/rank)"
+    )
+
+    # Initialize: shard d holds the constant d (≙ Initialize kernel :33-41).
+    # Host staging in the narrowest integer type, widened on device_put.
+    host = np.repeat(np.arange(p, dtype=np.min_scalar_type(p)), cfg.elements)
+    try:
+        sharding = NamedSharding(mesh, P(axis), memory_kind=kind)
+        x = jax.device_put(host.astype(cfg.dtype), sharding)
+        jax.block_until_ready(x)
+    except Exception as e:
+        if cfg.mem_kind == "D":
+            raise  # HBM placement must work; only host kinds may be absent
+        rec = Record(
+            pattern="allreduce",
+            mode=f"{variant}:{cfg.algorithm}",
+            commands=label,
+            verdict=Verdict.SKIPPED,
+            notes=[f"memory kind {kind!r} unavailable: {e}"],
+        )
+        return writer.record(rec)
+
+    reduce_fn = functools.partial(
+        ring.allreduce, axis_name=axis, axis_size=p, variant=cfg.algorithm, op=op
+    )
+
+    def _one(v):
+        return reduce_fn(v)
+
+    def _chain(v, k):
+        def body(_, t):
+            return _rescale(reduce_fn(t), p)
+
+        y = lax.fori_loop(0, k, body, v)
+        return jnp.sum(y[:1].astype(jnp.float32))[None]
+
+    # Pallas outputs carry no varying-manual-axes metadata (same stance as
+    # comm/onesided.py): disable the vma check when a kernel op is plugged in.
+    shmap = functools.partial(jax.shard_map, mesh=mesh, check_vma=op is None)
+    one = jax.jit(shmap(_one, in_specs=P(axis), out_specs=P(axis)))
+    chained = jax.jit(shmap(_chain, in_specs=(P(axis), P()), out_specs=P(axis)))
+
+    # Timed region ≙ t1..t2 (:170-183); max-over-ranks of the wall time
+    # (:185-190) is max_over_processes_s in multi-process launches.
+    res = timing.measure_chain(
+        lambda k: (lambda: chained(x, jnp.int32(k))),
+        reps=cfg.reps,
+        warmup=cfg.warmup,
+        label=f"allreduce:{cfg.algorithm}",
+        direct_fn=lambda: one(x),
+    )
+    wall_s = timing.max_over_processes_s(res.per_op_ns * 1e-9)
+
+    # Validation (≙ :192-204): elementwise size*(size-1)/2 within tol,
+    # checked per shard so each "rank" reports its own Passed line (:206).
+    out = np.asarray(one(x)).reshape(p, cfg.elements)
+    expect = p * (p - 1) // 2
+    ok_all = True
+    for r in range(p):
+        shard_ok = bool(
+            np.all(np.abs(out[r].astype(np.float64) - expect) <= cfg.tol)
+        )
+        ok_all &= shard_ok
+        writer.progress(f"Passed {r}" if shard_ok else f"FAILED {r}")
+
+    wire = wire_bytes_per_rank(cfg.algorithm, n_bytes, p)
+    busbw = 2.0 * (p - 1) / p * n_bytes / (wall_s * 1e9)  # GB/s (bytes/ns)
+    writer.metric(f"allreduce[{variant}:{cfg.algorithm}] time", wall_s, "s")
+    rec = Record(
+        pattern="allreduce",
+        mode=f"{variant}:{cfg.algorithm}",
+        commands=label,
+        metrics={
+            "wall_s": wall_s,
+            "busbw_GBps": busbw,
+            "wire_GBps": wire / (wall_s * 1e9),
+            "bytes_per_rank": float(n_bytes),
+            "validated": float(ok_all),
+        },
+        verdict=Verdict.SUCCESS if ok_all else Verdict.FAILURE,
+        config={
+            "elements": cfg.elements,
+            "dtype": cfg.dtype,
+            "algorithm": cfg.algorithm,
+            "mem_kind": cfg.mem_kind,
+            "world": p,
+        },
+    )
+    if not ok_all:
+        rec.notes.append(f"elementwise check != {expect} (tol {cfg.tol})")
+    return writer.record(rec)

@@ -1,0 +1,89 @@
+"""allreduce/pallas — Mosaic-kernel variant (≙ the mpi-omp-offload builds, C17).
+
+The reference proves the same ring through a second device runtime
+(OpenMP offload instead of SYCL, SURVEY.md C17); here the second runtime
+is Pallas: the per-step Accumulate (allreduce-mpi-sycl.cpp:26-31) runs as
+an explicit Mosaic VMEM kernel instead of XLA-fused add, plugged into the
+same ring schedule via comm.ring's ``op`` hook.  The library path (psum)
+is excluded — it has no per-step kernel to substitute, exactly as the
+OpenMP twins only build the manual ring paths.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.experimental import pallas as pl
+
+from tpu_patterns.core.results import Record, ResultWriter
+from tpu_patterns.miniapps.apps import allreduce as core
+from tpu_patterns.miniapps.framework import VariantSpec
+from tpu_patterns.runtime import use_interpret
+
+MAX_BLOCK_ROWS = 2048  # 3 x 1 MiB float32 blocks resident in VMEM
+
+
+def _acc_kernel(a_ref, b_ref, o_ref):
+    o_ref[...] = a_ref[...] + b_ref[...]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def accumulate(a: jax.Array, b: jax.Array, interpret: bool = False) -> jax.Array:
+    """Elementwise a+b as a blocked Pallas kernel over the flat shard.
+
+    Any length is handled by zero-padding up to a whole number of
+    (MAX_BLOCK_ROWS, 128) VMEM blocks — blocks stay bounded regardless of
+    divisibility, and the aligned common case pads nothing.
+    """
+    import jax.numpy as jnp
+
+    (n,) = a.shape
+    cols = 128
+    rows = -(-n // cols)  # ceil
+    br = min(rows, MAX_BLOCK_ROWS)
+    padded_rows = -(-rows // br) * br
+    pad = padded_rows * cols - n
+    if pad:
+        a = jnp.pad(a, (0, pad))
+        b = jnp.pad(b, (0, pad))
+    shape = (padded_rows, cols)
+    out = pl.pallas_call(
+        _acc_kernel,
+        out_shape=jax.ShapeDtypeStruct(shape, a.dtype),
+        grid=(padded_rows // br,),
+        in_specs=[
+            pl.BlockSpec((br, cols), lambda i: (i, 0)),
+            pl.BlockSpec((br, cols), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((br, cols), lambda i: (i, 0)),
+        interpret=interpret,
+    )(a.reshape(shape), b.reshape(shape))
+    return out.reshape(padded_rows * cols)[:n]
+
+
+def run(
+    mesh=None, dtype: str = "float32", writer: ResultWriter | None = None, **overrides
+) -> Record:
+    if mesh is None:
+        from tpu_patterns.miniapps.framework import default_mesh
+
+        mesh = default_mesh()
+    overrides.setdefault("algorithm", "ring")
+    cfg = core.AllreduceConfig(dtype=dtype, **overrides)
+    if cfg.algorithm == "psum":
+        raise ValueError(
+            "allreduce/pallas builds only the manual ring algorithms "
+            "(the library path has no per-step kernel to substitute)"
+        )
+    op = functools.partial(accumulate, interpret=use_interpret())
+    return core.run_allreduce(mesh, cfg, writer, op=op, variant="pallas")
+
+
+VARIANT = VariantSpec(
+    app="allreduce",
+    variant="pallas",
+    dtypes=("float32", "int32"),
+    run=run,
+    axes={"algorithm": ("ring", "ring_opt"), "mem_kind": tuple(core.MEM_KINDS)},
+)

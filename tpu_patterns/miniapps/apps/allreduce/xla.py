@@ -1,0 +1,35 @@
+"""allreduce/xla — pure-XLA variant (≙ the mpi-sycl build, C16).
+
+The accumulate step is plain elementwise add: XLA fuses it into the ring
+schedule (where the reference launches a separate Accumulate kernel per
+step, allreduce-mpi-sycl.cpp:26-31,176-180).  Supports all three
+algorithms including the library path (psum ≙ MPI_Allreduce, :62-67).
+bfloat16 joins the reference's float/int dtype matrix
+(allreduce/mpi-sycl/CMakeLists.txt:4-5) — the TPU-native wire format.
+"""
+
+from __future__ import annotations
+
+from tpu_patterns.core.results import Record, ResultWriter
+from tpu_patterns.miniapps.apps import allreduce as core
+from tpu_patterns.miniapps.framework import VariantSpec
+
+
+def run(
+    mesh=None, dtype: str = "float32", writer: ResultWriter | None = None, **overrides
+) -> Record:
+    if mesh is None:
+        from tpu_patterns.miniapps.framework import default_mesh
+
+        mesh = default_mesh()
+    cfg = core.AllreduceConfig(dtype=dtype, **overrides)
+    return core.run_allreduce(mesh, cfg, writer, op=None, variant="xla")
+
+
+VARIANT = VariantSpec(
+    app="allreduce",
+    variant="xla",
+    dtypes=("float32", "int32", "bfloat16"),
+    run=run,
+    axes={"algorithm": core.ALGORITHMS, "mem_kind": tuple(core.MEM_KINDS)},
+)

@@ -1,0 +1,113 @@
+"""Miniapp discovery + typed test registration (≙ CMake/CTest framework).
+
+Reference: aurora.mpich.miniapps/src/CMakeLists.txt —
+``enable_testing()`` (:4); variants discovered by globbing
+``src/<app>/<variant>/`` (:12-19); ``add_mpi_app``/``add_typed_mpi_app``
+register each build as a CTest run of ``mpirun -np 4 ./app`` (:39-50),
+with dtype instantiations via the ``APP_DATA_TYPE`` define (:45-50;
+float+int picked in allreduce/mpi-sycl/CMakeLists.txt:4-5).
+
+TPU mapping:
+* apps live as modules ``tpu_patterns/miniapps/apps/<app>/<variant>.py``,
+  each exporting a ``VARIANT: VariantSpec`` — discovery walks the package,
+  the filesystem convention *is* the registry, exactly like the glob;
+* ``add_typed_mpi_app``'s dtype matrix becomes ``VariantSpec.dtypes``,
+  expanded by :func:`typed_runs`;
+* ``mpirun -np 4`` becomes a 4-device submesh (:func:`default_mesh`) —
+  single-process, real XLA collectives; multi-process scale-out reuses the
+  same code via topo.bootstrap;
+* CTest's exit-code aggregation is :func:`run_all` + ``ResultWriter.exit_code``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import pkgutil
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+from tpu_patterns.core.results import Record, ResultWriter
+
+DEFAULT_NP = 4  # ≙ mpirun -np 4 (src/CMakeLists.txt:41)
+
+
+@dataclasses.dataclass(frozen=True)
+class VariantSpec:
+    """One ``<app>/<variant>`` build (≙ one CMake target)."""
+
+    app: str
+    variant: str
+    dtypes: tuple[str, ...]  # ≙ add_typed_mpi_app instantiations
+    run: Callable[..., Record]  # run(mesh, dtype=..., writer=..., **cfg)
+    # Config axes this variant supports beyond dtype (e.g. algorithms); used
+    # by sweeps and tests to enumerate the full matrix.
+    axes: dict[str, tuple[Any, ...]] = dataclasses.field(default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        return f"{self.app}/{self.variant}"
+
+
+def discover() -> list[VariantSpec]:
+    """Walk ``miniapps/apps`` for modules exporting ``VARIANT``
+    (≙ the ``file(GLOB ...) src/<app>/<variant>`` discovery, :12-19)."""
+    from tpu_patterns.miniapps import apps as apps_pkg
+
+    specs: list[VariantSpec] = []
+    for info in pkgutil.walk_packages(apps_pkg.__path__, apps_pkg.__name__ + "."):
+        mod = importlib.import_module(info.name)
+        spec = getattr(mod, "VARIANT", None)
+        if isinstance(spec, VariantSpec):
+            specs.append(spec)
+    return sorted(specs, key=lambda s: (s.app, s.variant))
+
+
+def get_variant(app: str, variant: str) -> VariantSpec:
+    for spec in discover():
+        if spec.app == app and spec.variant == variant:
+            return spec
+    known = ", ".join(s.name for s in discover())
+    raise KeyError(f"no miniapp variant {app}/{variant}; available: {known}")
+
+
+def typed_runs() -> Iterator[tuple[VariantSpec, str]]:
+    """(variant, dtype) pairs — the ``add_typed_mpi_app float/int`` matrix."""
+    for spec in discover():
+        for dt in spec.dtypes:
+            yield spec, dt
+
+
+def default_mesh(n_devices: int = DEFAULT_NP, axis: str = "ranks"):
+    """First ``n_devices`` devices as a 1-D mesh (≙ the 4 mpirun ranks,
+    rank→device assignment handled by topo.placement in real launches)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < n_devices:
+        raise ValueError(
+            f"need {n_devices} devices for the default miniapp mesh, have "
+            f"{len(devs)} (the reference likewise hard-requires its rank count)"
+        )
+    return Mesh(np.array(devs[:n_devices]), (axis,))
+
+
+def run_all(
+    writer: ResultWriter | None = None,
+    n_devices: int = DEFAULT_NP,
+    mesh=None,
+    **overrides,
+) -> list[Record]:
+    """Run every typed variant once with defaults — the ``ctest`` sweep.
+
+    The aggregated pass/fail is ``writer.exit_code`` (≙ CTest's summary).
+    """
+    writer = writer or ResultWriter()
+    mesh = mesh if mesh is not None else default_mesh(n_devices)
+    records = []
+    for spec, dtype in typed_runs():
+        writer.progress(f"miniapp {spec.name}.{dtype}")
+        records.append(spec.run(mesh=mesh, dtype=dtype, writer=writer, **overrides))
+    return records
