@@ -1,0 +1,172 @@
+"""CLI + sweep drivers (SURVEY.md C7, C11, C12)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+from tpu_patterns import sweep
+from tpu_patterns.cli import build_parser, main
+
+FAST_P2P = ["--count", "8192", "--reps", "2", "--warmup", "1"]
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(ln) for ln in f]
+
+
+class TestParser:
+    def test_subcommands_parse(self):
+        p = build_parser()
+        for argv in (
+            ["p2p", "--transport", "one_sided", "--devices", "2"],
+            ["concurrency", "--backend", "pallas", "--mode", "dma_overlap"],
+            ["allreduce", "--variant", "pallas", "--algorithm", "ring_opt"],
+            ["miniapps", "--devices", "4"],
+            ["topo"],
+            ["topo", "3"],
+            ["interop"],
+            ["sweep", "p2p", "--quick"],
+            ["report", "x.log"],
+        ):
+            args = p.parse_args(argv)
+            assert args.cmd == argv[0]
+
+    def test_config_fields_become_flags(self):
+        args = build_parser().parse_args(["p2p", "--count", "123", "--dtype", "bfloat16"])
+        assert args.count == 123 and args.dtype == "bfloat16"
+
+    def test_concurrency_env_tier(self, monkeypatch):
+        # add_config_args gives concurrency the same env tier as the rest.
+        monkeypatch.setenv("TPU_PATTERNS_TRIPCOUNT", "777")
+        args = build_parser().parse_args(["concurrency"])
+        assert args.tripcount == 777
+
+    def test_allreduce_typo_exits_loudly(self):
+        # user-input errors must not become SKIPPED (exit 0)
+        with pytest.raises(SystemExit):
+            main(["allreduce", "--algorithm", "ringg", "--devices", "4"])
+        with pytest.raises(SystemExit):
+            main(["allreduce", "--mem_kind", "X", "--devices", "4"])
+
+
+class TestCommands:
+    def test_p2p_two_sided(self, tmp_path):
+        jl = tmp_path / "p2p.jsonl"
+        rc = main(["--jsonl", str(jl), "p2p", *FAST_P2P, "--devices", "8"])
+        assert rc == 0
+        recs = _read_jsonl(jl)
+        assert {r["mode"] for r in recs} == {"unidirectional", "bidirectional"}
+        assert all(r["verdict"] == "SUCCESS" for r in recs)
+
+    def test_p2p_skips_on_odd_world(self, tmp_path):
+        jl = tmp_path / "p2p.jsonl"
+        rc = main(["--jsonl", str(jl), "p2p", *FAST_P2P, "--devices", "3"])
+        assert rc == 0
+        (rec,) = _read_jsonl(jl)
+        assert rec["verdict"] == "SKIPPED"
+
+    def test_allreduce(self, tmp_path):
+        jl = tmp_path / "ar.jsonl"
+        rc = main(
+            ["--jsonl", str(jl), "allreduce", "--devices", "4", "--elements",
+             "1024", "--reps", "2", "--algorithm", "ring_opt"]
+        )
+        assert rc == 0
+        (rec,) = _read_jsonl(jl)
+        assert rec["verdict"] == "SUCCESS"
+        assert rec["mode"] == "xla:ring_opt"
+
+    def test_concurrency(self, tmp_path):
+        jl = tmp_path / "con.jsonl"
+        rc = main(
+            ["--jsonl", str(jl), "concurrency", "--mode", "concurrent",
+             "--commands", "C C", "--tripcount", "200", "--elements", "256",
+             "--reps", "2"]
+        )
+        (rec,) = _read_jsonl(jl)
+        assert rec["mode"] == "xla:concurrent"
+        assert rc == (0 if rec["verdict"] == "SUCCESS" else 1)
+
+    def test_miniapps(self, tmp_path):
+        jl = tmp_path / "mini.jsonl"
+        rc = main(
+            ["--jsonl", str(jl), "miniapps", "--devices", "4", "--elements",
+             "512", "--reps", "2"]
+        )
+        assert rc == 0
+        recs = _read_jsonl(jl)
+        assert len(recs) >= 5  # the full typed-variant matrix
+
+    def test_topo(self, capsys):
+        assert main(["topo"]) == 0
+        out = capsys.readouterr().out
+        assert "devices: 8" in out and "placement compact:" in out
+        assert main(["topo", "2"]) == 0
+        n = int(capsys.readouterr().out.strip())
+        assert 0 <= n < 8
+
+    def test_interop(self, tmp_path):
+        jl = tmp_path / "interop.jsonl"
+        rc = main(["--jsonl", str(jl), "interop"])
+        recs = _read_jsonl(jl)
+        assert recs, "interop must emit records"
+        if recs[0]["verdict"] == "SKIPPED":
+            pytest.skip(f"native module unavailable: {recs[0]['notes']}")
+        assert rc == 0
+        assert {r["commands"] for r in recs} == {
+            "clock", "checksum", "saxpy", "raw_info"
+        }
+
+    def test_report(self, tmp_path, capsys):
+        log = tmp_path / "x.log"
+        log.write_text(
+            "export TPU_PATTERNS_SWEEP_CONFIG=cfg1\n"
+            "## serial | C C | SUCCESS\n"
+            "## concurrent | C C | FAILURE\n"
+        )
+        assert main(["report", str(log)]) == 0
+        out = capsys.readouterr().out
+        assert "SUCCESS" in out and "FAILURE" in out and "cfg1" in out
+
+
+class TestSweep:
+    def test_spec_matrices(self):
+        p2p = sweep.specs_for("p2p", quick=True)
+        assert len(p2p) == 12  # 3 modes x 2 mech x 2 transports x 1 size
+        con = sweep.specs_for("concurrency", quick=True)
+        assert {s.name.split(".")[1] for s in con} == {"default"}
+        ar = sweep.specs_for("allreduce")
+        assert any("pallas" in s.name for s in ar)
+        assert len(sweep.specs_for("all", quick=True)) == len(p2p) + len(con) + len(
+            sweep.specs_for("allreduce", quick=True)
+        )
+
+    def test_unknown_name_filter(self, tmp_path):
+        with pytest.raises(ValueError, match="no specs"):
+            sweep.run_sweep("p2p", out_dir=str(tmp_path), names=["nope"])
+
+    def test_run_sweep_subprocess(self, tmp_path, capsys):
+        # Two real subprocess cells on the CPU-simulated mesh (≙ two
+        # launcher lines of run.sh); env scrubbed of the platform plugin.
+        env = {
+            k: v for k, v in os.environ.items() if k != "PYTHONPATH"
+        }
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        names = [
+            "p2p.compact.mesh.two_sided.n2",
+            "allreduce.xla.float32.ring.D",
+        ]
+        rc = sweep.run_sweep(
+            "all", out_dir=str(tmp_path), quick=True, names=names, base_env=env
+        )
+        assert rc == 0
+        for name in names:
+            assert (tmp_path / f"{name}.log").exists()
+            recs = _read_jsonl(tmp_path / f"{name}.jsonl")
+            assert all(r["verdict"] in ("SUCCESS", "SKIPPED") for r in recs)
+        out = capsys.readouterr().out
+        assert "sweep cell" in out

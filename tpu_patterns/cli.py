@@ -1,0 +1,364 @@
+"""The ``tpu-patterns`` command line: one launcher for every pattern.
+
+TPU-native replacement for the reference's shell launchers (SURVEY.md C7,
+C12): where the reference builds binaries and runs ``mpirun … ./peer2pear``
+(p2p/run.sh), ``./omp_con <mode> --commands …`` (concurency/run_*.sh), and
+``ctest`` (aurora.mpich.miniapps/README.rst:18-24), here each suite is a
+subcommand over the same process:
+
+    python -m tpu_patterns p2p --transport one_sided --devices 2
+    python -m tpu_patterns concurrency --backend xla --mode concurrent \
+        --commands "C C" --commands "C H2D"
+    python -m tpu_patterns allreduce --variant pallas --algorithm ring_opt
+    python -m tpu_patterns miniapps              # ≙ ctest
+    python -m tpu_patterns topo [N]              # ≙ ./topology [N]
+    python -m tpu_patterns interop
+    python -m tpu_patterns sweep p2p --out results/
+    python -m tpu_patterns report results/*.log results/*.jsonl
+
+Every run prints the reference-compatible ``## mode | commands | VERDICT``
+markers, optionally appends JSON-lines records (``--jsonl``), and exits
+nonzero iff any verdict is FAILURE (≙ exit-code aggregation,
+concurency/main.cpp:270,321).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tpu_patterns.core.config import add_config_args
+from tpu_patterns.core.results import Record, ResultWriter, Verdict
+
+
+def _build_mesh(n_devices: int, placement: str, mechanism: str):
+    """Mesh over the first n devices (0 = all) in placement-mode order.
+
+    Both mechanisms are honored as asked: MESH orders the full node then
+    takes the first n ranks (≙ an affinity mask over everything), VISIBLE
+    selects an n-device subset (≙ a device selector).  At n <= total they
+    place identically — exactly as ZAM vs ODS place identically and are
+    swept for their mechanism overhead, tile_mapping.sh:23-29.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tpu_patterns.topo.placement import (
+        Mechanism,
+        PlacementMode,
+        order_devices,
+        select_devices,
+    )
+    from tpu_patterns.topo.topology import discover
+
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    if n > len(devices):
+        raise ValueError(f"--devices {n} exceeds the {len(devices)} available")
+    mode = PlacementMode(placement)
+    topo = discover(devices)
+    if Mechanism(mechanism) is Mechanism.MESH:
+        chosen = order_devices(topo, mode)[:n]
+    else:
+        chosen = select_devices(n, topo, mode)
+    return Mesh(np.array([devices[i] for i in chosen]), ("x",))
+
+
+def _add_mesh_args(p: argparse.ArgumentParser) -> None:
+    from tpu_patterns.topo.placement import Mechanism, PlacementMode
+
+    p.add_argument(
+        "--devices",
+        type=int,
+        default=0,
+        help="number of devices (0 = all) — ≙ mpirun -n N",
+    )
+    p.add_argument(
+        "--placement",
+        choices=[m.value for m in PlacementMode],
+        default="compact",
+        help="rank->device order (≙ tile_mapping.sh modes)",
+    )
+    p.add_argument(
+        "--mechanism",
+        choices=[m.value for m in Mechanism],
+        default="mesh",
+        help="ordering (mesh ≙ affinity mask) vs subset (visible ≙ selector)",
+    )
+
+
+def _cmd_p2p(args, writer: ResultWriter) -> None:
+    from tpu_patterns.comm.onesided import OneSidedConfig, run_onesided
+    from tpu_patterns.comm.p2p import P2PConfig, run_p2p
+
+    try:
+        mesh = _build_mesh(args.devices, args.placement, args.mechanism)
+        if args.transport == "one_sided":  # ≙ the -DUSE_WIN build (run.sh:5)
+            cfg = OneSidedConfig(
+                count=args.count,
+                dtype=args.dtype,
+                reps=args.reps,
+                warmup=args.warmup,
+                min_bandwidth=args.min_bandwidth,
+                seed=args.seed,
+            )
+            run_onesided(mesh, cfg, writer)
+        else:
+            cfg = P2PConfig(
+                count=args.count,
+                dtype=args.dtype,
+                reps=args.reps,
+                warmup=args.warmup,
+                min_bandwidth=args.min_bandwidth,
+                bidirectional=args.bidirectional,
+                seed=args.seed,
+            )
+            run_p2p(mesh, cfg, writer)
+    except ValueError as e:
+        # Not enough / odd devices for pairing: a skip, not a crash — the
+        # single-chip bench environment must survive the full sweep.
+        writer.record(
+            Record(
+                pattern="p2p",
+                mode=args.transport,
+                commands=f"devices={args.devices or 'all'}",
+                verdict=Verdict.SKIPPED,
+                notes=[str(e)],
+            )
+        )
+
+
+def _cmd_concurrency(args, writer: ResultWriter) -> None:
+    from tpu_patterns.concurrency.harness import ConcurrencyConfig, run_concurrency
+
+    cfg = ConcurrencyConfig(
+        backend=args.backend,
+        mode=args.mode,
+        commands=tuple(args.commands or ["C C"]),
+        reps=args.reps,
+        warmup=args.warmup,
+        auto_tune=args.auto_tune and not args.no_tuning,
+        min_bandwidth=args.min_bandwidth,
+        tripcount=args.tripcount,
+        elements=args.elements,
+        copy_elements=args.copy_elements,
+    )
+    run_concurrency(cfg, writer)
+
+
+def _cmd_allreduce(args, writer: ResultWriter) -> None:
+    from tpu_patterns.miniapps.apps.allreduce import ALGORITHMS, MEM_KINDS
+    from tpu_patterns.miniapps.framework import get_variant
+
+    # User-input typos must exit loudly (code 2), not become SKIPPED below.
+    if args.algorithm not in ALGORITHMS:
+        raise SystemExit(
+            f"error: --algorithm {args.algorithm!r} not one of {ALGORITHMS}"
+        )
+    if args.mem_kind not in MEM_KINDS:
+        raise SystemExit(
+            f"error: --mem_kind {args.mem_kind!r} not one of {tuple(MEM_KINDS)}"
+        )
+    spec = get_variant("allreduce", args.variant)
+    try:
+        mesh = _build_mesh(args.devices, args.placement, args.mechanism)
+        spec.run(
+            mesh=mesh,
+            dtype=args.dtype,
+            writer=writer,
+            elements=args.elements,
+            algorithm=args.algorithm,
+            mem_kind=args.mem_kind,
+            reps=args.reps,
+            warmup=args.warmup,
+            tol=args.tol,
+            require_even_ge4=args.require_even_ge4,
+        )
+    except ValueError as e:
+        # World-size / divisibility constraints unmet (e.g. single-chip
+        # bench env): a skip, not a crash — same stance as p2p above.
+        writer.record(
+            Record(
+                pattern="allreduce",
+                mode=f"{args.variant}:{args.algorithm}",
+                commands=f"devices={args.devices or 'all'}",
+                verdict=Verdict.SKIPPED,
+                notes=[str(e)],
+            )
+        )
+
+
+def _cmd_miniapps(args, writer: ResultWriter) -> None:
+    from tpu_patterns.miniapps.framework import DEFAULT_NP, default_mesh, run_all
+
+    import jax
+
+    n = args.devices or min(DEFAULT_NP, len(jax.devices()))
+    overrides = {}
+    if args.elements:
+        overrides["elements"] = args.elements
+    if n < 4 or n % 2:
+        overrides["require_even_ge4"] = False  # reduced mesh: keep apps runnable
+    run_all(writer=writer, mesh=default_mesh(n), reps=args.reps, **overrides)
+
+
+def _cmd_topo(args, writer: ResultWriter) -> None:
+    from tpu_patterns.topo.placement import PlacementMode, order_devices
+    from tpu_patterns.topo.topology import discover
+
+    topo = discover()
+    if args.n is not None:
+        # ≙ ./topology N printing the N-th placement entry (topology.cpp:99-106)
+        print(topo.entry(args.n))
+        return
+    print(topo.describe())  # ≙ plane dump (:92-97)
+    for mode in PlacementMode:
+        print(f"placement {mode.value}: {order_devices(topo, mode)}")
+
+
+def _cmd_interop(args, writer: ResultWriter) -> None:
+    """Native-interop round trips (≙ running the two interop binaries)."""
+    import numpy as np
+
+    from tpu_patterns.interop import calls, native
+
+    if not native.register():
+        writer.record(
+            Record(
+                pattern="interop",
+                mode="native",
+                verdict=Verdict.SKIPPED,
+                notes=[f"native module unavailable: {native.build_error()}"],
+            )
+        )
+        return
+    import jax
+
+    cpu = jax.local_devices(backend="cpu")[0]
+    with jax.default_device(cpu):
+        x = np.arange(256, dtype=np.float32)
+        y = np.ones(256, dtype=np.float32)
+        checks = {
+            "clock": int(calls.ffi_clock_ns()[0]) > 0,
+            "checksum": int(calls.ffi_checksum(x)[0])
+            == int(np.sum(np.arange(256, dtype=np.int64)) & 0xFFFFFFFF),
+            "saxpy": bool(
+                np.allclose(np.asarray(calls.ffi_saxpy(2.0, x, y)), 2.0 * x + y)
+            ),
+            "raw_info": int(calls.raw_info(x)[3]) == 1,  # one arg in the frame
+        }
+    for name, ok in checks.items():
+        writer.record(
+            Record(
+                pattern="interop",
+                mode="native",
+                commands=name,
+                verdict=Verdict.SUCCESS if ok else Verdict.FAILURE,
+            )
+        )
+
+
+def _cmd_sweep(args, writer: ResultWriter) -> int:
+    from tpu_patterns import sweep
+
+    return sweep.run_sweep(args.suite, out_dir=args.out, quick=args.quick)
+
+
+def _cmd_report(args, writer: ResultWriter) -> None:
+    from tpu_patterns.core.results import parse_log, tabulate_records
+
+    lines: list[str] = []
+    for path in args.paths:
+        with open(path) as f:
+            lines.extend(f.readlines())
+    print(tabulate_records(parse_log(lines)))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tpu-patterns", description=__doc__.splitlines()[0]
+    )
+    parser.add_argument("--jsonl", default=None, help="append JSONL records here")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("p2p", help="pair-exchange bandwidth (≙ peer2pear)")
+    from tpu_patterns.comm.p2p import P2PConfig
+
+    add_config_args(p, P2PConfig)
+    p.add_argument(
+        "--transport",
+        choices=("two_sided", "one_sided"),
+        default="two_sided",
+        help="ppermute exchange vs Pallas remote-DMA put (≙ -DUSE_WIN)",
+    )
+    _add_mesh_args(p)
+
+    c = sub.add_parser("concurrency", help="serial-vs-concurrent harness")
+    from tpu_patterns.concurrency.harness import ConcurrencyConfig
+
+    c.add_argument("--backend", choices=("xla", "pallas"), default="xla")
+    c.add_argument("--mode", default="concurrent")
+    c.add_argument(
+        "--commands",
+        action="append",
+        metavar='"C H2D"',
+        help="command group; repeatable (≙ --commands of concurency/main.cpp)",
+    )
+    # Scalar knobs come from the config dataclass so the env tier
+    # (TPU_PATTERNS_REPS etc.) applies here like everywhere else.
+    add_config_args(
+        c, ConcurrencyConfig, skip=("backend", "mode", "commands", "chain_lengths")
+    )
+    c.add_argument(
+        "--no_tuning", action="store_true", help="skip auto-tune (ref flag)"
+    )
+
+    a = sub.add_parser("allreduce", help="ring-allreduce miniapp")
+    from tpu_patterns.miniapps.apps.allreduce import AllreduceConfig
+
+    add_config_args(a, AllreduceConfig)
+    a.add_argument("--variant", choices=("xla", "pallas"), default="xla")
+    _add_mesh_args(a)
+
+    m = sub.add_parser("miniapps", help="run every typed variant (≙ ctest)")
+    m.add_argument("--devices", type=int, default=0)
+    m.add_argument("--elements", type=int, default=0, help="0 = app default")
+    m.add_argument("--reps", type=int, default=3)
+
+    t = sub.add_parser("topo", help="fabric probe (≙ ./topology [N])")
+    t.add_argument("n", nargs="?", type=int, default=None)
+
+    sub.add_parser("interop", help="native FFI round-trip proofs")
+
+    s = sub.add_parser("sweep", help="config-matrix sweeps (≙ run*.sh)")
+    s.add_argument("suite", choices=("p2p", "concurrency", "allreduce", "all"))
+    s.add_argument("--out", default="results", help="log/JSONL directory")
+    s.add_argument("--quick", action="store_true", help="tiny workloads")
+
+    r = sub.add_parser("report", help="tabulate logs (≙ parse.py)")
+    r.add_argument("paths", nargs="+")
+
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    writer = ResultWriter(jsonl_path=args.jsonl)
+    handlers = {
+        "p2p": _cmd_p2p,
+        "concurrency": _cmd_concurrency,
+        "allreduce": _cmd_allreduce,
+        "miniapps": _cmd_miniapps,
+        "topo": _cmd_topo,
+        "interop": _cmd_interop,
+        "report": _cmd_report,
+    }
+    if args.cmd == "sweep":
+        return _cmd_sweep(args, writer)
+    handlers[args.cmd](args, writer)
+    return writer.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

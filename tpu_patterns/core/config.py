@@ -52,17 +52,22 @@ def _env_value(name: str, env: Mapping[str, str]) -> str | None:
 
 
 def add_config_args(
-    parser: argparse.ArgumentParser, cls: type, env: Mapping[str, str] | None = None
+    parser: argparse.ArgumentParser,
+    cls: type,
+    env: Mapping[str, str] | None = None,
+    skip: Sequence[str] = (),
 ) -> None:
     """Add one ``--<field>`` flag per dataclass field.
 
     The flag default is the env-tier value when set, else the field default,
     so precedence after ``parser.parse_args`` is CLI > env > default.
+    ``skip`` names fields the caller wires up manually (e.g. repeatable
+    flags that don't fit the one-token-per-field scheme).
     """
     env = os.environ if env is None else env
     hints = typing.get_type_hints(cls)
     for f in dataclasses.fields(cls):
-        if not f.init:
+        if not f.init or f.name in skip:
             continue
         ftype = hints[f.name]
         default = (

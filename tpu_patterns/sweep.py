@@ -1,0 +1,243 @@
+"""Config-matrix sweep drivers (≙ p2p/run.sh, concurency/run_{omp,sycl}.sh).
+
+The reference sweeps shell matrices — placement modes x affinity mechanisms
+x transports x rank counts (p2p/run.sh:9-21) and env configs x modes x five
+command mixes (run_omp.sh:9,14-27, run_sycl.sh:11-26) — capturing logs with
+``tee`` and tabulating them afterwards (parse.py).  Here each cell is one
+subprocess invocation of the CLI (fresh process = fresh runtime, exactly
+like a fresh ``mpirun``), env-var context is written into the log as
+``export K=V`` lines (the ``set -o xtrace`` convention parse_log keys
+tables by), and every cell appends JSONL records for the report.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import subprocess
+import sys
+from typing import Mapping, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class SweepSpec:
+    """One cell of a sweep matrix: a CLI invocation + env context."""
+
+    name: str
+    argv: tuple[str, ...]  # CLI args after the program name
+    env: tuple[tuple[str, str], ...] = ()  # extra env (the swept knobs)
+
+    def with_env(self, **kv: str) -> "SweepSpec":
+        return dataclasses.replace(self, env=self.env + tuple(kv.items()))
+
+
+# Runtime-knob configs (≙ the env sweeps of run_omp.sh:14-18 — immediate
+# command lists, copy-engine selection etc. — mapped to this framework's
+# runtime knobs).  Each is tagged via TPU_PATTERNS_SWEEP_CONFIG so
+# results.context_env() keys report tables by it.
+CONCURRENCY_ENV_CONFIGS: dict[str, dict[str, str]] = {
+    "default": {},
+    "direct_timing": {"TPU_PATTERNS_TIMING": "direct"},
+    "amortized_timing": {"TPU_PATTERNS_TIMING": "amortized"},
+}
+
+# The five command mixes of run_omp.sh:9 — with the M (pageable host) mixes
+# routed through dispatch modes, since pageable memory cannot live inside a
+# compiled program (commands.py), and Pallas restricted to on-chip work.
+XLA_INPROGRAM_MIXES = ("C C", "C H2D", "C D2H", "H2D D2H")
+XLA_DISPATCH_MIXES = ("C M2D", "C D2M", "M2D D2M")
+PALLAS_MIXES = ("C C", "C D2D", "C C D2D")
+
+
+def p2p_specs(quick: bool = False) -> list[SweepSpec]:
+    """≙ run.sh:9-21: modes x mechanisms x transports x rank counts."""
+    from tpu_patterns.topo.placement import Mechanism, PlacementMode
+
+    sizes = [2] if quick else [2, 0]  # 0 = all devices (≙ the 12-rank run)
+    count = ["--count", "65536", "--reps", "2"] if quick else []
+    specs = []
+    for mode in PlacementMode:
+        for mech in Mechanism:
+            for transport in ("two_sided", "one_sided"):
+                for n in sizes:
+                    specs.append(
+                        SweepSpec(
+                            name=f"p2p.{mode.value}.{mech.value}.{transport}.n{n or 'all'}",
+                            argv=(
+                                "p2p",
+                                "--transport", transport,
+                                "--placement", mode.value,
+                                "--mechanism", mech.value,
+                                "--devices", str(n),
+                                *count,
+                            ),
+                            # Table key: cells differing only in placement x
+                            # mechanism would otherwise collide in the report
+                            # (transport and size already show up in the
+                            # records' mode/commands columns).
+                            env=(
+                                (
+                                    "TPU_PATTERNS_SWEEP_CONFIG",
+                                    f"p2p.{mode.value}.{mech.value}",
+                                ),
+                            ),
+                        )
+                    )
+    return specs
+
+
+def concurrency_specs(quick: bool = False) -> list[SweepSpec]:
+    """≙ run_omp.sh / run_sycl.sh: env configs x backend modes x mixes."""
+    small = (
+        ("--tripcount", "200", "--elements", "256",
+         "--copy_elements", "16384", "--reps", "2")
+        if quick
+        else ()
+    )
+    matrix: list[tuple[str, str, tuple[str, ...]]] = []
+    for mode in ("serial", "concurrent"):
+        matrix.append(("xla", mode, XLA_INPROGRAM_MIXES))
+    for mode in ("dispatch_serial", "dispatch_async"):
+        matrix.append(("xla", mode, XLA_DISPATCH_MIXES))
+    for mode in ("dma_serial", "dma_overlap"):
+        matrix.append(("pallas", mode, PALLAS_MIXES))
+    configs = (
+        {"default": {}} if quick else CONCURRENCY_ENV_CONFIGS
+    )
+    specs = []
+    for cfg_name, env in configs.items():
+        for backend, mode, mixes in matrix:
+            argv: list[str] = ["concurrency", "--backend", backend, "--mode", mode]
+            for mix in mixes:
+                argv += ["--commands", mix]
+            argv += list(small)
+            specs.append(
+                SweepSpec(
+                    name=f"concurrency.{cfg_name}.{backend}.{mode}",
+                    argv=tuple(argv),
+                    env=tuple(
+                        {**env, "TPU_PATTERNS_SWEEP_CONFIG": cfg_name}.items()
+                    ),
+                )
+            )
+    return specs
+
+
+def allreduce_specs(quick: bool = False) -> list[SweepSpec]:
+    """Variant x algorithm x allocator matrix (≙ the miniapp build matrix +
+    the -a/-H/-D/-S runtime flags)."""
+    from tpu_patterns.miniapps.framework import discover
+
+    elements = ["--elements", "4096", "--reps", "2"] if quick else []
+    kinds = ("D",) if quick else ("D", "H", "S")
+    specs = []
+    for spec in discover():
+        if spec.app != "allreduce":
+            continue
+        dtypes = spec.dtypes[:1] if quick else spec.dtypes
+        for dtype in dtypes:
+            for alg in spec.axes.get("algorithm", ("ring",)):
+                for kind in kinds:
+                    specs.append(
+                        SweepSpec(
+                            name=f"allreduce.{spec.variant}.{dtype}.{alg}.{kind}",
+                            argv=(
+                                "allreduce",
+                                "--variant", spec.variant,
+                                "--dtype", dtype,
+                                "--algorithm", alg,
+                                "--mem_kind", kind,
+                                *elements,
+                            ),
+                            # One table for the whole matrix: the records'
+                            # mode (variant:alg) and commands (dtype/kind/N)
+                            # columns already distinguish every cell.
+                            env=(("TPU_PATTERNS_SWEEP_CONFIG", "allreduce"),),
+                        )
+                    )
+    return specs
+
+
+SUITES = {
+    "p2p": p2p_specs,
+    "concurrency": concurrency_specs,
+    "allreduce": allreduce_specs,
+}
+
+
+def specs_for(suite: str, quick: bool = False) -> list[SweepSpec]:
+    if suite == "all":
+        return [s for name in SUITES for s in SUITES[name](quick)]
+    return SUITES[suite](quick)
+
+
+def run_spec(
+    spec: SweepSpec,
+    out_dir: str,
+    base_env: Mapping[str, str] | None = None,
+    timeout: float = 1800.0,
+) -> int:
+    """Run one cell: subprocess CLI, log tee'd to ``<name>.log``, JSONL to
+    ``<name>.jsonl`` (≙ ``|& tee -a $log``, run_omp.sh:26)."""
+    os.makedirs(out_dir, exist_ok=True)
+    log_path = os.path.join(out_dir, f"{spec.name}.log")
+    jsonl_path = os.path.join(out_dir, f"{spec.name}.jsonl")
+    if os.path.exists(jsonl_path):
+        os.unlink(jsonl_path)  # ResultWriter appends; stale cells must not leak
+    env = dict(base_env if base_env is not None else os.environ)
+    env.update(dict(spec.env))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_patterns", "--jsonl", jsonl_path, *spec.argv],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            timeout=timeout,
+        )
+        stdout, rc = proc.stdout, proc.returncode
+    except subprocess.TimeoutExpired as e:
+        stdout = (e.stdout or "") if isinstance(e.stdout, str) else ""
+        stdout += f"\n## {spec.name} | timeout | FAILURE\n"
+        rc = 1
+    with open(log_path, "w") as f:
+        # export-context lines first: parse_log keys the table rows by them
+        for k, v in spec.env:
+            f.write(f"export {k}={v}\n")
+        f.write(stdout)
+    return rc
+
+
+def run_sweep(
+    suite: str,
+    out_dir: str = "results",
+    quick: bool = False,
+    names: Sequence[str] | None = None,
+    base_env: Mapping[str, str] | None = None,
+) -> int:
+    """Run a suite's matrix; print the tabulated report; return the
+    aggregated exit code (any FAILURE -> 1)."""
+    from tpu_patterns.core.results import parse_log, tabulate_records
+
+    specs = specs_for(suite, quick)
+    if names is not None:
+        wanted = set(names)
+        specs = [s for s in specs if s.name in wanted]
+    if not specs:
+        raise ValueError(f"sweep {suite!r} matched no specs")
+    rc = 0
+    for spec in specs:
+        print(f"# sweep cell: {spec.name}", flush=True)
+        cell_rc = run_spec(spec, out_dir, base_env=base_env)
+        print(f"# -> exit {cell_rc}", flush=True)
+        if cell_rc != 0:  # incl. negative (signal-killed) returncodes
+            rc = 1
+    lines: list[str] = []
+    for spec in specs:
+        for ext in (".log", ".jsonl"):
+            path = os.path.join(out_dir, spec.name + ext)
+            if os.path.exists(path):
+                with open(path) as f:
+                    lines.extend(f.readlines())
+    print(tabulate_records(parse_log(lines)))
+    return rc
