@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Driver benchmark: prints exactly ONE JSON line on stdout.
+
+Headline metric, by what the hardware offers (BASELINE.md north star —
+"measured ICI bandwidth >= 90% of spec"):
+  * >= 2 devices: uni-directional p2p ICI bandwidth (GB/s) via the
+    pair-exchange pattern (comm/p2p.py ≙ peer2pear.cpp's headline number);
+    vs_baseline = measured / (0.9 * per-link ICI spec).
+  * 1 device: on-chip HBM copy bandwidth (GB/s) via the Pallas one-sided
+    local put (comm/onesided.py); a DMA copy reads + writes HBM, so
+    vs_baseline = 2 * measured / (0.9 * HBM spec) — the fraction of the
+    90%-of-spec target actually achieved.
+
+All pattern chatter goes to stderr; stdout carries only the JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+# Public chip specs, decimal GB/s.  HBM bandwidth per chip; ICI is
+# per-link, one direction.
+HBM_SPEC = {
+    "v4": 1228.0,
+    "v5p": 2765.0,
+    "v5 lite": 819.0,
+    "v5e": 819.0,
+    "v6 lite": 1640.0,
+    "v6e": 1640.0,
+}
+ICI_SPEC_PER_LINK = {
+    "v4": 50.0,
+    "v5p": 100.0,
+    "v5 lite": 50.0,
+    "v5e": 50.0,
+    "v6 lite": 100.0,
+    "v6e": 100.0,
+}
+
+
+def _spec(table: dict[str, float], device_kind: str) -> float | None:
+    kind = device_kind.lower()
+    best = None
+    for key, val in table.items():
+        if key in kind and (best is None or len(key) > best[0]):
+            best = (len(key), val)
+    return best[1] if best else None
+
+
+def run() -> dict:
+    import numpy as np
+
+    import jax
+
+    from tpu_patterns.core.config import config_from_tiers
+    from tpu_patterns.core.results import ResultWriter
+    from tpu_patterns.runtime import setup_jax
+
+    setup_jax()
+    devs = jax.devices()
+    kind = getattr(devs[0], "device_kind", devs[0].platform)
+    writer = ResultWriter(stream=sys.stderr)
+
+    if len(devs) >= 2 and len(devs) % 2 == 0:
+        from jax.sharding import Mesh
+
+        from tpu_patterns.comm.p2p import P2PConfig, run_p2p
+
+        mesh = Mesh(np.array(devs), ("x",))
+        # env tier applies (e.g. TPU_PATTERNS_COUNT shrinks CI workloads)
+        cfg = config_from_tiers(P2PConfig, argv=[], reps=5, warmup=2)
+        recs = run_p2p(mesh, cfg, writer=writer)
+        uni = next(r for r in recs if r.mode == "unidirectional")
+        value = uni.metrics["bandwidth_GBps"]
+        spec = _spec(ICI_SPEC_PER_LINK, kind)
+        vs = value / (0.9 * spec) if spec else 0.0
+        return {
+            "metric": f"p2p_ici_bandwidth_{len(devs)}x_{kind.replace(' ', '_')}",
+            "value": round(value, 3),
+            "unit": "GB/s",
+            "vs_baseline": round(vs, 4),
+        }
+
+    from tpu_patterns.comm.onesided import OneSidedConfig, run_onesided
+
+    cfg = config_from_tiers(OneSidedConfig, argv=[], reps=5, warmup=2)
+    (rec,) = run_onesided(None, cfg, writer=writer)
+    value = rec.metrics["bandwidth_GBps"]  # bytes copied / time
+    spec = _spec(HBM_SPEC, kind)
+    vs = (2.0 * value) / (0.9 * spec) if spec else 0.0  # DMA = read + write
+    return {
+        "metric": f"hbm_copy_bandwidth_{kind.replace(' ', '_')}",
+        "value": round(value, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(vs, 4),
+    }
+
+
+def main() -> int:
+    try:
+        out = run()
+    except Exception as e:  # never die silently: the driver needs its line
+        out = {
+            "metric": "bench_error",
+            "value": 0.0,
+            "unit": "",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}",
+        }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

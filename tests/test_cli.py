@@ -145,8 +145,18 @@ class TestSweep:
         )
 
     def test_unknown_name_filter(self, tmp_path):
-        with pytest.raises(ValueError, match="no specs"):
+        with pytest.raises(ValueError, match="unknown cell name"):
             sweep.run_sweep("p2p", out_dir=str(tmp_path), names=["nope"])
+        # one good + one bad name must also fail, not silently drop coverage
+        good = sweep.specs_for("p2p", quick=True)[0].name
+        with pytest.raises(ValueError, match="unknown cell name"):
+            sweep.run_sweep(
+                "p2p", out_dir=str(tmp_path), names=[good, "nope"]
+            )
+
+    def test_sweep_rejects_global_jsonl(self, tmp_path):
+        with pytest.raises(SystemExit):
+            main(["--jsonl", "x.jsonl", "sweep", "p2p", "--quick"])
 
     def test_run_sweep_subprocess(self, tmp_path, capsys):
         # Two real subprocess cells on the CPU-simulated mesh (≙ two

@@ -1,0 +1,70 @@
+"""Driver contracts: __graft_entry__.entry / dryrun_multichip + bench.py."""
+
+import importlib.util
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(name, ROOT / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestGraftEntry:
+    def test_entry_jits(self):
+        graft = _load("__graft_entry__")
+        fn, args = graft.entry()
+        out = jax.jit(fn)(*args)
+        assert float(out) > 0
+
+    @pytest.mark.parametrize("n", [2, 4, 8])
+    def test_dryrun_multichip(self, devices, n):
+        graft = _load("__graft_entry__")
+        graft.dryrun_multichip(n)  # raises on compile or numeric failure
+
+    def test_dryrun_too_many_devices(self, devices):
+        graft = _load("__graft_entry__")
+        with pytest.raises(RuntimeError, match="only"):
+            graft.dryrun_multichip(1024)
+
+
+class TestBench:
+    def test_spec_lookup(self):
+        bench = _load("bench")
+        assert bench._spec(bench.HBM_SPEC, "TPU v5 lite") == 819.0
+        assert bench._spec(bench.HBM_SPEC, "TPU v5p chip") == 2765.0
+        assert bench._spec(bench.HBM_SPEC, "unknown") is None
+
+    def test_bench_emits_one_json_line(self):
+        # Subprocess on the CPU-simulated mesh: stdout must be exactly one
+        # parsable JSON line with the driver's schema.
+        import os
+
+        env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        env["TPU_PATTERNS_COUNT"] = "65536"  # small workload for CI
+        proc = subprocess.run(
+            [sys.executable, str(ROOT / "bench.py")],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=300,
+            cwd=ROOT,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+        assert len(lines) == 1, proc.stdout
+        rec = json.loads(lines[0])
+        assert set(rec) >= {"metric", "value", "unit", "vs_baseline"}
+        assert rec["metric"] != "bench_error", rec
+        assert rec["value"] > 0

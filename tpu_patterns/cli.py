@@ -88,45 +88,57 @@ def _add_mesh_args(p: argparse.ArgumentParser) -> None:
     )
 
 
+def _world_skip(
+    writer: ResultWriter, pattern: str, mode: str, n: int, reason: str
+) -> None:
+    """World-size constraints unmet (e.g. single-chip bench env): a skip,
+    not a crash — the sweep must survive; genuine errors still raise."""
+    writer.record(
+        Record(
+            pattern=pattern,
+            mode=mode,
+            commands=f"devices={n}",
+            verdict=Verdict.SKIPPED,
+            notes=[reason],
+        )
+    )
+
+
 def _cmd_p2p(args, writer: ResultWriter) -> None:
+    import jax
+
     from tpu_patterns.comm.onesided import OneSidedConfig, run_onesided
     from tpu_patterns.comm.p2p import P2PConfig, run_p2p
 
-    try:
-        mesh = _build_mesh(args.devices, args.placement, args.mechanism)
-        if args.transport == "one_sided":  # ≙ the -DUSE_WIN build (run.sh:5)
-            cfg = OneSidedConfig(
-                count=args.count,
-                dtype=args.dtype,
-                reps=args.reps,
-                warmup=args.warmup,
-                min_bandwidth=args.min_bandwidth,
-                seed=args.seed,
-            )
-            run_onesided(mesh, cfg, writer)
-        else:
-            cfg = P2PConfig(
-                count=args.count,
-                dtype=args.dtype,
-                reps=args.reps,
-                warmup=args.warmup,
-                min_bandwidth=args.min_bandwidth,
-                bidirectional=args.bidirectional,
-                seed=args.seed,
-            )
-            run_p2p(mesh, cfg, writer)
-    except ValueError as e:
-        # Not enough / odd devices for pairing: a skip, not a crash — the
-        # single-chip bench environment must survive the full sweep.
-        writer.record(
-            Record(
-                pattern="p2p",
-                mode=args.transport,
-                commands=f"devices={args.devices or 'all'}",
-                verdict=Verdict.SKIPPED,
-                notes=[str(e)],
-            )
+    n = args.devices or len(jax.devices())
+    if n < 2 or n % 2:
+        _world_skip(
+            writer, "p2p", args.transport, n,
+            f"p2p needs an even device count >= 2, have {n}",
         )
+        return
+    mesh = _build_mesh(args.devices, args.placement, args.mechanism)
+    if args.transport == "one_sided":  # ≙ the -DUSE_WIN build (run.sh:5)
+        cfg = OneSidedConfig(
+            count=args.count,
+            dtype=args.dtype,
+            reps=args.reps,
+            warmup=args.warmup,
+            min_bandwidth=args.min_bandwidth,
+            seed=args.seed,
+        )
+        run_onesided(mesh, cfg, writer)
+    else:
+        cfg = P2PConfig(
+            count=args.count,
+            dtype=args.dtype,
+            reps=args.reps,
+            warmup=args.warmup,
+            min_bandwidth=args.min_bandwidth,
+            bidirectional=args.bidirectional,
+            seed=args.seed,
+        )
+        run_p2p(mesh, cfg, writer)
 
 
 def _cmd_concurrency(args, writer: ResultWriter) -> None:
@@ -148,45 +160,40 @@ def _cmd_concurrency(args, writer: ResultWriter) -> None:
 
 
 def _cmd_allreduce(args, writer: ResultWriter) -> None:
-    from tpu_patterns.miniapps.apps.allreduce import ALGORITHMS, MEM_KINDS
+    import jax
+
     from tpu_patterns.miniapps.framework import get_variant
 
-    # User-input typos must exit loudly (code 2), not become SKIPPED below.
-    if args.algorithm not in ALGORITHMS:
-        raise SystemExit(
-            f"error: --algorithm {args.algorithm!r} not one of {ALGORITHMS}"
-        )
-    if args.mem_kind not in MEM_KINDS:
-        raise SystemExit(
-            f"error: --mem_kind {args.mem_kind!r} not one of {tuple(MEM_KINDS)}"
-        )
+    # (flag typos are rejected by argparse choices= from the config metadata)
     spec = get_variant("allreduce", args.variant)
-    try:
-        mesh = _build_mesh(args.devices, args.placement, args.mechanism)
-        spec.run(
-            mesh=mesh,
-            dtype=args.dtype,
-            writer=writer,
-            elements=args.elements,
-            algorithm=args.algorithm,
-            mem_kind=args.mem_kind,
-            reps=args.reps,
-            warmup=args.warmup,
-            tol=args.tol,
-            require_even_ge4=args.require_even_ge4,
+    mode = f"{args.variant}:{args.algorithm}"
+    n = args.devices or len(jax.devices())
+    if args.require_even_ge4 and (n < 4 or n % 2):
+        _world_skip(
+            writer, "allreduce", mode, n,
+            f"allreduce needs an even world >= 4, have {n} "
+            "(--require_even_ge4 false to override)",
         )
-    except ValueError as e:
-        # World-size / divisibility constraints unmet (e.g. single-chip
-        # bench env): a skip, not a crash — same stance as p2p above.
-        writer.record(
-            Record(
-                pattern="allreduce",
-                mode=f"{args.variant}:{args.algorithm}",
-                commands=f"devices={args.devices or 'all'}",
-                verdict=Verdict.SKIPPED,
-                notes=[str(e)],
-            )
+        return
+    if args.algorithm == "ring_opt" and args.elements % n:
+        _world_skip(
+            writer, "allreduce", mode, n,
+            f"ring_opt needs elements % world == 0 ({args.elements} % {n})",
         )
+        return
+    mesh = _build_mesh(args.devices, args.placement, args.mechanism)
+    spec.run(
+        mesh=mesh,
+        dtype=args.dtype,
+        writer=writer,
+        elements=args.elements,
+        algorithm=args.algorithm,
+        mem_kind=args.mem_kind,
+        reps=args.reps,
+        warmup=args.warmup,
+        tol=args.tol,
+        require_even_ge4=args.require_even_ge4,
+    )
 
 
 def _cmd_miniapps(args, writer: ResultWriter) -> None:
@@ -355,6 +362,11 @@ def main(argv: list[str] | None = None) -> int:
         "report": _cmd_report,
     }
     if args.cmd == "sweep":
+        if args.jsonl:
+            raise SystemExit(
+                "error: --jsonl does not apply to sweep (each cell writes "
+                "<name>.jsonl under --out)"
+            )
         return _cmd_sweep(args, writer)
     handlers[args.cmd](args, writer)
     return writer.exit_code

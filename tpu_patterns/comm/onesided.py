@@ -55,7 +55,12 @@ def _ring_put_kernel(axis: str, axis_size: int, x_ref, out_ref, send_sem, recv_s
 
 def ring_put(x: jax.Array, axis: str, axis_size: int, interpret: bool = False):
     """One ring-neighbor one-sided put; call under shard_map
-    (check_vma=False — the kernel's output varies by construction)."""
+    (check_vma=False — the kernel's output varies by construction).
+
+    Must run under a shard_map with exactly ONE named mesh axis: LOGICAL
+    remote-DMA addressing (and the interpret-mode discharge entirely) does
+    not support multi-axis manual regions — callers on N-D meshes reshape
+    to a 1-D ring view first (see __graft_entry__.dryrun_multichip)."""
     return pl.pallas_call(
         functools.partial(_ring_put_kernel, axis, axis_size),
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),

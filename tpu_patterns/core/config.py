@@ -103,6 +103,7 @@ def add_config_args(
                 flag,
                 type=coerce,
                 default=default,
+                choices=f.metadata.get("choices"),
                 help=f"{help_text} (default: {default})",
             )
 

@@ -53,8 +53,13 @@ MEM_KINDS = {"H": "pinned_host", "D": "device", "S": "unpinned_host"}
 class AllreduceConfig:
     elements: int = 1 << 25  # per-rank N (≙ -p default 2^25, :99,125-128)
     dtype: str = "float32"
-    algorithm: str = "ring"  # manual ring is the no-flag default (:173-182)
-    mem_kind: str = "D"
+    # manual ring is the no-flag default (:173-182); choices feed argparse
+    algorithm: str = dataclasses.field(
+        default="ring", metadata={"choices": ALGORITHMS}
+    )
+    mem_kind: str = dataclasses.field(
+        default="D", metadata={"choices": tuple(MEM_KINDS)}
+    )
     reps: int = 5
     warmup: int = 1
     tol: float = 1e-6  # elementwise tolerance (:203)

@@ -223,6 +223,11 @@ def run_sweep(
     if names is not None:
         wanted = set(names)
         specs = [s for s in specs if s.name in wanted]
+        missing = wanted - {s.name for s in specs}
+        if missing:
+            raise ValueError(
+                f"sweep {suite!r}: unknown cell name(s) {sorted(missing)}"
+            )
     if not specs:
         raise ValueError(f"sweep {suite!r} matched no specs")
     rc = 0
@@ -232,12 +237,16 @@ def run_sweep(
         print(f"# -> exit {cell_rc}", flush=True)
         if cell_rc != 0:  # incl. negative (signal-killed) returncodes
             rc = 1
-    lines: list[str] = []
+    # Parse per cell: a cell's export-context lines must not leak into the
+    # next cell's marker-only records.
+    records = []
     for spec in specs:
+        lines: list[str] = []
         for ext in (".log", ".jsonl"):
             path = os.path.join(out_dir, spec.name + ext)
             if os.path.exists(path):
                 with open(path) as f:
                     lines.extend(f.readlines())
-    print(tabulate_records(parse_log(lines)))
+        records.extend(parse_log(lines))
+    print(tabulate_records(records))
     return rc
