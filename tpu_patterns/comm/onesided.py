@@ -126,24 +126,6 @@ def local_put_streamed(
     )(x)
 
 
-# A pallas_call output cannot alias the fori_loop's carried buffer, so XLA
-# materialises one whole-array copy per loop iteration; unrolling U dependent
-# puts per iteration amortises that fixed cost to 1/U (measured: 2x apparent
-# bandwidth at U=8 on v5e).
-_CHAIN_UNROLL = 8
-
-
-def _unrolled_chain(put, a, k):
-    """k fori_loop iterations of _CHAIN_UNROLL dependent ``put`` applications."""
-
-    def step(_, b):
-        for _ in range(_CHAIN_UNROLL):
-            b = put(b)
-        return b
-
-    return lax.fori_loop(0, k, step, a)
-
-
 @dataclasses.dataclass
 class OneSidedConfig:
     count: int = 1179648 * 40  # elements; reference message size (≙ C1)
@@ -198,7 +180,7 @@ def run_onesided(
         )
 
         def chain(a, k):
-            y = _unrolled_chain(
+            y = timing.unrolled_chain(
                 lambda b: ring_put(b, axis, n_dev, interpret=interpret), a, k
             )
             return jnp.sum(y.astype(jnp.float32))[None]
@@ -221,7 +203,7 @@ def run_onesided(
 
         chained = jax.jit(
             lambda a, k: jnp.sum(
-                _unrolled_chain(
+                timing.unrolled_chain(
                     lambda b: local_put_streamed(b, interpret=interpret), a, k
                 ).astype(jnp.float32)
             )
@@ -238,14 +220,10 @@ def run_onesided(
         f"{num_transfers} transfer(s), dtype={cfg.dtype}"
     )
     res = timing.measure_chain(
-        build_chain, reps=cfg.reps, warmup=cfg.warmup, direct_fn=lambda: fn(x)
+        build_chain, reps=cfg.reps, warmup=cfg.warmup,
+        direct_fn=lambda: fn(x), ops_per_iter=timing.CHAIN_UNROLL,
     )
-    # AMORTIZED chains carry _CHAIN_UNROLL puts per measured iteration;
-    # DIRECT mode times the plain single put.  All reported quantities are
-    # per single put.
-    unroll = _CHAIN_UNROLL if res.mode is timing.TimingMode.AMORTIZED else 1
-    per_put_ns = res.per_op_ns / unroll
-    gbps = shard_bytes * num_transfers / per_put_ns
+    gbps = res.gbps(shard_bytes * num_transfers)
 
     out = np.asarray(fn(x))
     if mode == "ring_put":
@@ -263,7 +241,7 @@ def run_onesided(
         commands=f"{n_dev}dev x {shard_bytes // 1_000_000}MB",
         metrics={
             "bandwidth_GBps": gbps,
-            "min_time_us": per_put_ns * 1e-3,
+            "min_time_us": res.us(),
             "bytes_per_put": float(shard_bytes),
             "checksum_ok": float(data_ok),
         },

@@ -58,9 +58,10 @@ def _exchange(x, *, axis: str, perm):
 
 
 def _exchange_chain(x, k, *, axis: str, perm):
-    """k (traced bound) data-dependent exchanges + a per-shard scalar whose
-    fetch forces execution (core/timing.py amortized discipline)."""
-    y = lax.fori_loop(0, k, lambda _, a: lax.ppermute(a, axis, perm), x)
+    """k (traced bound) iterations of CHAIN_UNROLL data-dependent exchanges
+    + a per-shard scalar whose fetch forces execution (core/timing.py
+    amortized discipline; the unroll amortises per-iteration fixed costs)."""
+    y = timing.unrolled_chain(lambda a: lax.ppermute(a, axis, perm), x, k)
     return jnp.sum(y.astype(jnp.float32))[None]
 
 
@@ -145,7 +146,7 @@ def run_p2p(
 
         res = timing.measure_chain(
             build_chain, reps=cfg.reps, warmup=cfg.warmup, label=name,
-            direct_fn=lambda: fn(x),
+            direct_fn=lambda: fn(x), ops_per_iter=timing.CHAIN_UNROLL,
         )
         num_pairs = len(perm)  # transfers in flight (bi counts both directions)
         gbps = res.gbps(shard_bytes * num_pairs)

@@ -215,6 +215,29 @@ def default_timing_mode() -> TimingMode:
     return TimingMode.DIRECT if jax.default_backend() == "cpu" else TimingMode.AMORTIZED
 
 
+# Default ops-per-iteration for chained measurements.  A pallas_call output
+# cannot alias a fori_loop's carried buffer, so XLA materialises one
+# whole-array copy per loop iteration; unrolling U dependent ops inside each
+# iteration amortises that (and any other per-iteration fixed cost) to 1/U.
+# Measured on v5e: 2x apparent bandwidth for whole-buffer Pallas copies at U=8.
+CHAIN_UNROLL = 8
+
+
+def unrolled_chain(op: Callable[[Any], Any], a: Any, k: Any):
+    """``k`` (traced bound) fori_loop iterations of exactly ``CHAIN_UNROLL``
+    dependent ``op`` applications — the standard chain body for measure_chain
+    callers passing ``ops_per_iter=CHAIN_UNROLL``.  The unroll count is not
+    overridable precisely so it cannot drift from the accounting."""
+    from jax import lax
+
+    def step(_, b):
+        for _ in range(CHAIN_UNROLL):
+            b = op(b)
+        return b
+
+    return lax.fori_loop(0, k, step, a)
+
+
 @dataclasses.dataclass
 class ChainMeasurement:
     """Per-op time from chained differential measurement."""
@@ -242,6 +265,7 @@ def measure_chain(
     label: str = "",
     direct_fn: Callable[[], Any] | None = None,
     max_chain: int = 4096,
+    ops_per_iter: int = 1,
 ) -> ChainMeasurement:
     """Measure one op via ``build_chain(k)`` = callable running k dependent
     iterations and returning a SMALL data-dependent array (fetched here to
@@ -261,20 +285,27 @@ def measure_chain(
     signal emerges.  The chain's trailing scalar reduction is shared by all
     chain lengths and cancels.  Clamped to min(t1)/k1 (an upper bound) when
     noise leaves a non-positive difference.
+
+    ``ops_per_iter``: how many dependent ops each chain iteration carries
+    (see :func:`unrolled_chain`); the returned per-op time is per single op.
+    ``direct_fn``, when given, must be the PLAIN single op regardless.
     """
     import numpy as np
 
     mode = mode or default_timing_mode()
     if mode is TimingMode.DIRECT:
         fn = direct_fn
+        per_iter_ops = 1
         if fn is None:
             chain1 = build_chain(1)
             fn = lambda: np.asarray(chain1())  # noqa: E731
+            per_iter_ops = ops_per_iter
         res = min_over_reps(
             fn, reps=reps, warmup=warmup, barrier=barrier, label=label
         )
         return ChainMeasurement(
-            per_op_ns=float(res.min_ns), mode=mode, short=res, lengths=(1, 1)
+            per_op_ns=res.min_ns / per_iter_ops, mode=mode, short=res,
+            lengths=(1, 1),
         )
 
     def timed(k: int, w: int, n_reps: int | None = None) -> TimingResult:
@@ -306,7 +337,8 @@ def measure_chain(
         if reps > probe_reps:
             r1 = timed(k1, 0)
     diff = r1.min_ns - r0.min_ns
-    per_op = diff / (k1 - k0) if diff > 0 else r1.min_ns / k1
+    per_iter = diff / (k1 - k0) if diff > 0 else r1.min_ns / k1
     return ChainMeasurement(
-        per_op_ns=float(per_op), mode=mode, short=r0, long=r1, lengths=(k0, k1)
+        per_op_ns=float(per_iter) / ops_per_iter, mode=mode, short=r0, long=r1,
+        lengths=(k0, k1),
     )
