@@ -1,0 +1,136 @@
+"""Long-context layer: ring attention and Ulysses vs ground truth.
+
+Validation philosophy per SURVEY.md §4: every distributed variant must
+reproduce the library/single-device result exactly (the allreduce miniapp's
+ring-vs-MPI_Allreduce check, applied to attention).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_patterns.longctx import attention as att
+from tpu_patterns.longctx.ring_attention import (
+    ring_attention as ring_attention_fn,
+    run_sharded as ring_run_sharded,
+)
+from tpu_patterns.longctx.ulysses import run_sharded as ulysses_run_sharded
+
+SP = 8
+L, H, D = 64, 8, 16  # global seq, heads, head_dim
+
+
+def _qkv(seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    shape = (L, H, D)
+    return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    return _qkv()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_reference(mesh1d, qkv, causal):
+    q, k, v = qkv
+    want = att.attention_reference(q, k, v, causal=causal)
+    got = ring_run_sharded(q, k, v, mesh1d, "x", causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_reference(mesh1d, qkv, causal):
+    q, k, v = qkv
+    want = att.attention_reference(q, k, v, causal=causal)
+    got = ulysses_run_sharded(q, k, v, mesh1d, "x", causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_strategies_agree(mesh1d, qkv):
+    q, k, v = qkv
+    a = ring_run_sharded(q, k, v, mesh1d, "x", causal=True)
+    b = ulysses_run_sharded(q, k, v, mesh1d, "x", causal=True)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_block_monoid_associative():
+    """combine_blocks must be order-insensitive up to float error — the
+    property that lets the ring accumulate blocks in rank order."""
+    q, k, v = _qkv(1)
+    blocks = [
+        att.block_attention(q[:16], k[i * 16 : (i + 1) * 16], v[i * 16 : (i + 1) * 16])
+        for i in range(4)
+    ]
+    left = att.empty_state(q[:16])
+    for b in blocks:
+        left = att.combine_blocks(left, b)
+    right = att.combine_blocks(
+        att.combine_blocks(blocks[0], blocks[1]),
+        att.combine_blocks(blocks[2], blocks[3]),
+    )
+    np.testing.assert_allclose(
+        np.asarray(att.finalize(left)), np.asarray(att.finalize(right)), atol=2e-5
+    )
+
+
+def test_fully_masked_rows_are_zero():
+    """A block whose mask kills every key must contribute nothing (the
+    NEG_INF guard in block_attention)."""
+    q, k, v = _qkv(2)
+    mask = jnp.zeros((16, 16), bool)
+    o, m, l = att.block_attention(q[:16], k[:16], v[:16], mask=mask)
+    assert float(jnp.max(jnp.abs(o))) == 0.0
+    assert float(jnp.max(l)) == 0.0
+    out = att.finalize(att.combine_blocks(att.empty_state(q[:16]), (o, m, l)))
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_fp16_fully_masked_stays_finite():
+    """neg_inf() must clamp per-dtype: -1e30 overflows fp16 to -inf and
+    would NaN the fully-masked guard."""
+    q, k, v = (a.astype(jnp.float16) for a in _qkv(4))
+    mask = jnp.zeros((16, 16), bool)
+    o, m, l = att.block_attention(q[:16], k[:16], v[:16], mask=mask)
+    out = att.finalize(att.combine_blocks(att.empty_state(q[:16]), (o, m, l)))
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_scale_plumbs_through_launcher(mesh1d, qkv):
+    q, k, v = qkv
+    want = att.attention_reference(q, k, v, scale=0.01)
+    got = ring_run_sharded(q, k, v, mesh1d, "x", scale=0.01)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_ring_attention_grad_finite(mesh1d):
+    """The ring is differentiable end-to-end (what a training step needs);
+    use mean-square loss over the sharded output."""
+    import functools
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    q, k, v = _qkv(3)
+    spec = P("x", None, None)
+    sharding = NamedSharding(mesh1d, spec)
+    args = tuple(jax.device_put(np.asarray(a), sharding) for a in (q, k, v))
+
+    def loss(q, k, v):
+        f = jax.shard_map(
+            functools.partial(
+                ring_attention_fn,
+                axis_name="x",
+                axis_size=SP,
+                causal=True,
+            ),
+            mesh=mesh1d,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+        )
+        return jnp.mean(f(q, k, v) ** 2)
+
+    grads = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(*args)
+    for g in grads:
+        assert np.isfinite(np.asarray(g)).all()
+        assert float(jnp.max(jnp.abs(g))) > 0.0

@@ -17,6 +17,9 @@ Layer map (mirrors SURVEY.md §1):
   concurrency/ dispatch-concurrency harness         (ref: concurency/)
   interop/     JAX <-> native C++ (XLA FFI)          (ref: sycl_omp_ze_interopt/)
   miniapps/    self-validating distributed miniapps (ref: aurora.mpich.miniapps/)
+  longctx/     sequence/context parallelism         (ring attention + Ulysses on
+                                                     the ring/all-to-all substrate,
+                                                     SURVEY.md §2.3, §5)
   cli.py       launcher / sweep / report            (ref: run*.sh, parse.py)
 """
 

@@ -1,0 +1,30 @@
+"""Long-context layer: sequence/context parallelism patterns.
+
+Two exact-attention strategies over a sequence-parallel mesh axis, both
+built from the suite's own communication substrate (SURVEY.md §2.3):
+
+* ``ring_attention`` — K/V rotation on the ring primitive (the manual-ring
+  lineage, allreduce-mpi-sycl.cpp:173-182);
+* ``ulysses``        — head/sequence all-to-all re-sharding (the
+  library-collective lineage, allreduce-mpi-sycl.cpp:62-67).
+"""
+
+from tpu_patterns.longctx.attention import (
+    attention_reference,
+    block_attention,
+    combine_blocks,
+    empty_state,
+    finalize,
+)
+from tpu_patterns.longctx.ring_attention import ring_attention
+from tpu_patterns.longctx.ulysses import ulysses_attention
+
+__all__ = [
+    "attention_reference",
+    "block_attention",
+    "combine_blocks",
+    "empty_state",
+    "finalize",
+    "ring_attention",
+    "ulysses_attention",
+]

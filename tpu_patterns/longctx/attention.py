@@ -1,0 +1,173 @@
+"""Attention building blocks for the long-context layer.
+
+The reference repo has no ML workloads, but its ring pattern
+(allreduce-mpi-sycl.cpp:173-182 — shift a buffer around the ring, combine,
+repeat) is exactly the communication substrate of ring attention / context
+parallelism (SURVEY.md §2.3, §5-long-context).  This module supplies the
+*compute* half of that substrate:
+
+* ``attention_reference`` — plain softmax attention, the single-device
+  ground truth every distributed variant is validated against (the same
+  role the library ``MPI_Allreduce`` path plays for the manual ring,
+  allreduce-mpi-sycl.cpp:62-67).
+* ``block_attention`` — one K/V-block partial attention step returning the
+  online-softmax statistics (running max, normalizer, unnormalized
+  accumulator), the combinable unit that ring/blockwise variants
+  accumulate — structurally the ring miniapp's ``Accumulate`` kernel
+  (allreduce-mpi-sycl.cpp:26-31) generalized from ``+`` to the
+  online-softmax monoid.
+
+Shapes follow the TPU-friendly layout [seq, heads, head_dim]; the softmax
+statistics are [heads, seq] so the minor dimension stays the long one.
+All matmuls are einsums that XLA tiles onto the MXU; masking is arithmetic
+(no dynamic shapes).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# Finite stand-in for -inf: keeps exp() exactly 0 without NaNs from
+# (-inf) - (-inf) when a whole block is masked out.  -1e30 is exact in
+# f32/bf16; narrower dtypes (fp16 would overflow it to -inf) get a
+# per-dtype clamp from ``neg_inf``.
+NEG_INF = -1e30
+
+
+def neg_inf(dtype) -> float:
+    """The finite -inf stand-in representable in ``dtype``."""
+    return max(NEG_INF, float(jnp.finfo(dtype).min) / 2)
+
+
+def _scale(q, scale):
+    return float(scale) if scale is not None else q.shape[-1] ** -0.5
+
+
+def causal_mask(q_pos: jax.Array, k_pos: jax.Array) -> jax.Array:
+    """[Lq, Lk] boolean mask: query may attend to keys at <= its position."""
+    return q_pos[:, None] >= k_pos[None, :]
+
+
+def attention_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: float | None = None,
+) -> jax.Array:
+    """Ground-truth softmax attention.  q: [Lq, H, D]; k, v: [Lk, H, D]."""
+    s = jnp.einsum("qhd,khd->hqk", q, k) * _scale(q, scale)
+    if causal:
+        lq, lk = q.shape[0], k.shape[0]
+        mask = causal_mask(jnp.arange(lq), jnp.arange(lk))
+        s = jnp.where(mask[None], s, neg_inf(s.dtype))
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("hqk,khd->qhd", p, v)
+
+
+def block_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    scale: float | None = None,
+    mask: jax.Array | None = None,
+):
+    """Partial attention of q against one K/V block.
+
+    Returns ``(o, m, l)``: unnormalized output [Lq, H, D], running max
+    [H, Lq], normalizer [H, Lq] — the online-softmax statistics combined
+    across blocks by ``combine_blocks`` and finalized by ``finalize``.
+    """
+    s = jnp.einsum("qhd,khd->hqk", q, k) * _scale(q, scale)
+    ninf = neg_inf(s.dtype)
+    if mask is not None:
+        s = jnp.where(mask[None], s, ninf)
+    m = jnp.max(s, axis=-1)  # [H, Lq]
+    # Guard fully-masked rows: exp(ninf - ninf) would be exp(0)=1.
+    p = jnp.exp(s - m[..., None]) * (m[..., None] > ninf / 2)
+    l = jnp.sum(p, axis=-1)  # [H, Lq]
+    o = jnp.einsum("hqk,khd->qhd", p, v)
+    return o, m, l
+
+
+def combine_blocks(state, block):
+    """Associative combine of two online-softmax partials (the monoid the
+    ring accumulates; each operand is an (o, m, l) triple)."""
+    o1, m1, l1 = state
+    o2, m2, l2 = block
+    m = jnp.maximum(m1, m2)  # [H, Lq]
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    l = a1 * l1 + a2 * l2
+    # [H, Lq] -> [Lq, H, 1] to rescale the accumulators.
+    w1 = jnp.swapaxes(a1, 0, 1)[..., None]
+    w2 = jnp.swapaxes(a2, 0, 1)[..., None]
+    return o1 * w1 + o2 * w2, m, l
+
+
+def empty_state(q: jax.Array):
+    """Identity element of the combine monoid for queries shaped like q."""
+    lq, h, _ = q.shape
+    return (
+        jnp.zeros_like(q),
+        jnp.full((h, lq), neg_inf(q.dtype), q.dtype),
+        jnp.zeros((h, lq), q.dtype),
+    )
+
+
+def finalize(state) -> jax.Array:
+    """Normalize the accumulated state into the attention output."""
+    o, _, l = state
+    denom = jnp.swapaxes(l, 0, 1)[..., None]
+    return o / jnp.where(denom == 0.0, 1.0, denom)
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_launcher(attn_fn, mesh, axis_name: str, causal: bool, scale):
+    """One jitted shard_map program per (strategy, mesh, axis, flags) — the
+    cache makes repeated run_sharded calls hit XLA's compiled program
+    instead of retracing a fresh closure each time."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(axis_name, None, None)
+    return jax.jit(
+        jax.shard_map(
+            functools.partial(
+                attn_fn,
+                axis_name=axis_name,
+                axis_size=mesh.shape[axis_name],
+                causal=causal,
+                scale=scale,
+            ),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+        )
+    )
+
+
+def run_sharded(
+    attn_fn,
+    q,
+    k,
+    v,
+    mesh,
+    axis_name: str = "sp",
+    causal: bool = False,
+    scale: float | None = None,
+) -> jax.Array:
+    """Shared launcher for the distributed attention strategies: shard
+    global [L, H, D] arrays over ``axis_name`` and run ``attn_fn`` (a
+    shard-level function taking (q, k, v, axis_name=, axis_size=, causal=,
+    scale=)) as one jitted ``shard_map`` program."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    fn = _sharded_launcher(attn_fn, mesh, axis_name, causal, scale)
+    sharding = NamedSharding(mesh, P(axis_name, None, None))
+    # device_put reshards device arrays device-to-device and uploads host
+    # arrays directly — no host roundtrip either way.
+    args = (jax.device_put(a, sharding) for a in (q, k, v))
+    return fn(*args)

@@ -1,0 +1,77 @@
+"""Ulysses sequence parallelism: all-to-all head/sequence re-sharding.
+
+The second canonical long-context strategy (SURVEY.md §5-long-context):
+instead of rotating K/V around a ring, re-shard with one collective —
+an all-to-all flips the sharded dimension from *sequence* to *heads*, each
+device computes exact full-sequence attention for its H/sp heads, and a
+second all-to-all flips back.  Two collectives total (vs sp-1 ring steps),
+at the cost of requiring heads % sp == 0.
+
+Where ring attention is the reference's manual-ring path re-applied, this
+is its library-collective path (``MPI_Allreduce`` ≙ ``lax.psum``,
+allreduce-mpi-sycl.cpp:62-67): one call, XLA owns the schedule — here
+``lax.all_to_all``, the collective MPI spells ``MPI_Alltoall``.  Both
+strategies answer the same question the allreduce miniapp asks of its two
+paths: manual ring vs library collective, same invariant, measured.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+from jax.sharding import Mesh
+
+from tpu_patterns.longctx import attention as att
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    axis_size: int,
+    causal: bool = False,
+    scale: float | None = None,
+) -> jax.Array:
+    """Exact attention via head re-sharding; call inside ``shard_map``.
+
+    q, k, v: [L_local, H, D] sequence shards with H % axis_size == 0.
+    Returns the [L_local, H, D] output shard.
+    """
+    if axis_size == 1:
+        return att.attention_reference(q, k, v, causal=causal, scale=scale)
+    h = q.shape[1]
+    if h % axis_size != 0:
+        raise ValueError(f"heads {h} not divisible by sp axis {axis_size}")
+
+    def seq_to_heads(x):  # [L/sp, H, D] -> [L, H/sp, D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=0, tiled=True)
+
+    def heads_to_seq(x):  # [L, H/sp, D] -> [L/sp, H, D]
+        return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=1, tiled=True)
+
+    o = att.attention_reference(
+        seq_to_heads(q),
+        seq_to_heads(k),
+        seq_to_heads(v),
+        causal=causal,
+        scale=scale,
+    )
+    return heads_to_seq(o)
+
+
+def run_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    causal: bool = False,
+    scale: float | None = None,
+) -> jax.Array:
+    """Shard global [L, H, D] arrays over ``axis_name`` and run Ulysses
+    attention as one jitted program."""
+    return att.run_sharded(
+        ulysses_attention, q, k, v, mesh, axis_name=axis_name, causal=causal, scale=scale
+    )
