@@ -104,6 +104,42 @@ def test_scale_plumbs_through_launcher(mesh1d, qkv):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
 
+def test_pattern_runner_verdicts(mesh1d):
+    """The measured pattern: both strategies SUCCESS with positive
+    throughput and the reference-match gate enforced."""
+    from tpu_patterns.core.results import Verdict
+    from tpu_patterns.longctx.pattern import LongCtxConfig, run_longctx
+
+    cfg = LongCtxConfig(seq=64, heads=8, head_dim=16, reps=2, warmup=1)
+    recs = run_longctx(mesh1d, cfg)
+    assert [r.mode for r in recs] == ["ring", "ulysses", "agreement"]
+    for r in recs:
+        assert r.verdict is Verdict.SUCCESS
+    assert all(r.metrics["tflops"] > 0 for r in recs[:2])
+    assert all(r.metrics["max_abs_err"] < 1e-4 for r in recs[:2])
+    assert recs[2].metrics["cross_max_err"] < 1e-4
+
+
+def test_cli_longctx(tmp_path):
+    import json
+
+    from tpu_patterns.cli import main
+
+    jl = tmp_path / "lc.jsonl"
+    rc = main(
+        [
+            "--jsonl", str(jl), "longctx", "--devices", "8",
+            "--seq", "64", "--heads", "8", "--head_dim", "16",
+            "--reps", "2", "--warmup", "1",
+        ]
+    )
+    assert rc == 0
+    with open(jl) as f:
+        recs = [json.loads(ln) for ln in f]
+    assert {r["mode"] for r in recs} == {"ring", "ulysses", "agreement"}
+    assert all(r["verdict"] == "SUCCESS" for r in recs)
+
+
 def test_ring_attention_grad_finite(mesh1d):
     """The ring is differentiable end-to-end (what a training step needs);
     use mean-square loss over the sharded output."""

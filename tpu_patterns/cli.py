@@ -196,6 +196,42 @@ def _cmd_allreduce(args, writer: ResultWriter) -> None:
     )
 
 
+def _cmd_longctx(args, writer: ResultWriter) -> None:
+    import jax
+
+    from tpu_patterns.longctx.pattern import LongCtxConfig, run_longctx
+
+    n = args.devices or len(jax.devices())
+    strategies = ("ring", "ulysses") if args.strategy == "both" else (args.strategy,)
+    if args.seq % n:
+        _world_skip(
+            writer, "longctx", args.strategy, n,
+            f"seq {args.seq} not divisible by sp={n}",
+        )
+        return
+    if "ulysses" in strategies and args.heads % n:
+        _world_skip(
+            writer, "longctx", args.strategy, n,
+            f"heads {args.heads} not divisible by sp={n} (ulysses)",
+        )
+        return
+    mesh = _build_mesh(args.devices, args.placement, args.mechanism)
+    cfg = LongCtxConfig(
+        seq=args.seq,
+        heads=args.heads,
+        head_dim=args.head_dim,
+        dtype=args.dtype,
+        causal=args.causal,
+        reps=args.reps,
+        warmup=args.warmup,
+        min_tflops=args.min_tflops,
+        tol=args.tol,
+        strategies=strategies,
+        seed=args.seed,
+    )
+    run_longctx(mesh, cfg, writer)
+
+
 def _cmd_miniapps(args, writer: ResultWriter) -> None:
     from tpu_patterns.miniapps.framework import DEFAULT_NP, default_mesh, run_all
 
@@ -328,6 +364,20 @@ def build_parser() -> argparse.ArgumentParser:
     a.add_argument("--variant", choices=("xla", "pallas"), default="xla")
     _add_mesh_args(a)
 
+    lc = sub.add_parser(
+        "longctx", help="sequence-parallel attention (ring vs Ulysses)"
+    )
+    from tpu_patterns.longctx.pattern import LongCtxConfig
+
+    add_config_args(lc, LongCtxConfig, skip=("strategies",))
+    lc.add_argument(
+        "--strategy",
+        choices=("ring", "ulysses", "both"),
+        default="both",
+        help="manual-ring vs library-collective lineage (≙ ring vs -a)",
+    )
+    _add_mesh_args(lc)
+
     m = sub.add_parser("miniapps", help="run every typed variant (≙ ctest)")
     m.add_argument("--devices", type=int, default=0)
     m.add_argument("--elements", type=int, default=0, help="0 = app default")
@@ -356,6 +406,7 @@ def main(argv: list[str] | None = None) -> int:
         "p2p": _cmd_p2p,
         "concurrency": _cmd_concurrency,
         "allreduce": _cmd_allreduce,
+        "longctx": _cmd_longctx,
         "miniapps": _cmd_miniapps,
         "topo": _cmd_topo,
         "interop": _cmd_interop,

@@ -109,13 +109,14 @@ def combine_blocks(state, block):
 
 
 def empty_state(q: jax.Array):
-    """Identity element of the combine monoid for queries shaped like q."""
-    lq, h, _ = q.shape
-    return (
-        jnp.zeros_like(q),
-        jnp.full((h, lq), neg_inf(q.dtype), q.dtype),
-        jnp.zeros((h, lq), q.dtype),
-    )
+    """Identity element of the combine monoid for queries shaped like q.
+
+    The stats are built *from* q (zeroed) rather than as fresh constants so
+    they inherit q's varying-manual-axes under shard_map — a constant init
+    would give a loop carry whose type differs from the loop output on any
+    mesh axis q varies over."""
+    base = jnp.swapaxes(q[:, :, 0], 0, 1) * 0  # [H, Lq]
+    return (jnp.zeros_like(q), base + jnp.asarray(neg_inf(q.dtype), q.dtype), base)
 
 
 def finalize(state) -> jax.Array:

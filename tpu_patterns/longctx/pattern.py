@@ -1,0 +1,196 @@
+"""Measured long-context pattern: ring vs Ulysses attention, with verdicts.
+
+Runs both sequence-parallel strategies over an "sp" mesh axis with the
+suite's metrology (core/timing.py: barrier-synced min-over-reps, amortized
+chains) and self-validation discipline (SURVEY.md §4): each strategy must
+match the single-device reference attention elementwise (one Record per
+strategy), and when both run, a final "agreement" Record gates their
+pairwise elementwise match; an optional throughput floor completes the
+verdict — the SUCCESS/FAILURE contract of the concurrency harness
+(concurency/main.cpp:303-319) applied to attention.
+
+Headline metric: attention TFLOP/s, counting the two block matmuls
+(QK^T and PV: 4*L^2*H*D FLOPs for full attention, halved for causal) —
+the standard flash-attention accounting, so numbers compare directly to
+published TPU attention kernels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_patterns.core import timing
+from tpu_patterns.core.results import Record, ResultWriter, Verdict
+from tpu_patterns.longctx import attention as att
+from tpu_patterns.longctx.ring_attention import ring_attention
+from tpu_patterns.longctx.ulysses import ulysses_attention
+
+STRATEGIES = {"ring": ring_attention, "ulysses": ulysses_attention}
+
+
+@dataclasses.dataclass
+class LongCtxConfig:
+    seq: int = 4096  # global sequence length
+    heads: int = 8
+    head_dim: int = 128
+    dtype: str = "float32"
+    causal: bool = True
+    reps: int = 10
+    warmup: int = 2
+    min_tflops: float = -1.0  # verdict floor; <0 disables (≙ --min_bandwidth)
+    tol: float = 1e-4  # elementwise |err| gate vs f32 reference (dtype-scaled)
+    strategies: tuple = ("ring", "ulysses")
+    seed: int = 0
+
+
+def attention_flops(seq: int, heads: int, head_dim: int, causal: bool) -> float:
+    """QK^T + PV matmul FLOPs for one full-sequence attention."""
+    full = 4.0 * seq * seq * heads * head_dim
+    return full / 2 if causal else full
+
+
+def _tolerance(cfg: LongCtxConfig) -> float:
+    """Elementwise gate vs the f32 reference.  Outputs are O(1) softmax
+    averages of unit-normal v, so the gate is a generous multiple of the
+    dtype's eps, capped well below the O(1) signal — a broken strategy
+    (e.g. all-zeros output) still fails at every precision."""
+    eps = float(jnp.finfo(jnp.dtype(cfg.dtype)).eps)
+    return min(0.25, max(cfg.tol, 32 * eps))
+
+
+def run_longctx(
+    mesh: Mesh,
+    cfg: LongCtxConfig | None = None,
+    writer: ResultWriter | None = None,
+) -> list[Record]:
+    """Run each strategy; one Record per strategy, TFLOP/s metric."""
+    from tpu_patterns.runtime import setup_jax
+
+    setup_jax()
+    cfg = cfg or LongCtxConfig()
+    writer = writer or ResultWriter()
+    axis = mesh.axis_names[0]
+    sp = int(np.prod(mesh.devices.shape))
+    if len(mesh.axis_names) != 1:
+        raise ValueError("longctx expects a 1-D mesh (one sp axis)")
+    if cfg.seq % sp != 0:
+        raise ValueError(f"seq {cfg.seq} not divisible by sp={sp}")
+    if cfg.heads % sp != 0 and "ulysses" in cfg.strategies:
+        raise ValueError(f"heads {cfg.heads} not divisible by sp={sp} (ulysses)")
+
+    dtype = jnp.dtype(cfg.dtype)
+    shape = (cfg.seq, cfg.heads, cfg.head_dim)
+    keys = jax.random.split(jax.random.key(cfg.seed), 3)
+    sharding = NamedSharding(mesh, P(axis, None, None))
+    q, k, v = (
+        jax.device_put(jax.random.normal(kk, shape, dtype), sharding) for kk in keys
+    )
+    jax.block_until_ready((q, k, v))
+
+    flops = attention_flops(cfg.seq, cfg.heads, cfg.head_dim, cfg.causal)
+    writer.progress(
+        f"longctx: sp={sp}, seq={cfg.seq}, heads={cfg.heads}, "
+        f"head_dim={cfg.head_dim}, causal={cfg.causal}, dtype={cfg.dtype}"
+    )
+
+    # Ground truth on one device (cast up to f32 for a stable yardstick).
+    ref = att.attention_reference(
+        jnp.asarray(np.asarray(q), jnp.float32),
+        jnp.asarray(np.asarray(k), jnp.float32),
+        jnp.asarray(np.asarray(v), jnp.float32),
+        causal=cfg.causal,
+    )
+    ref_np = np.asarray(ref)
+    tol = _tolerance(cfg)
+
+    records = []
+    outputs: dict[str, np.ndarray] = {}
+    spec = P(axis, None, None)
+    for name in cfg.strategies:
+        strat = STRATEGIES[name]
+        body = functools.partial(
+            strat, axis_name=axis, axis_size=sp, causal=cfg.causal
+        )
+        # the shared (lru-cached) launcher: identical program across calls
+        fn = att._sharded_launcher(strat, mesh, axis, cfg.causal, None)
+        # Amortized chain: feed the output back as q (shapes match), a
+        # data dependence XLA cannot elide (core/timing.py discipline).
+        chained = jax.jit(
+            jax.shard_map(
+                lambda q, k, v, n: jnp.sum(
+                    timing.unrolled_chain(lambda a: body(a, k, v), q, n).astype(
+                        jnp.float32
+                    )
+                )[None],
+                mesh=mesh,
+                in_specs=(spec, spec, spec, P()),
+                out_specs=P(axis),
+            )
+        )
+
+        def build_chain(ki: int, _c=chained):
+            return lambda: _c(q, k, v, jnp.int32(ki))
+
+        res = timing.measure_chain(
+            build_chain,
+            reps=cfg.reps,
+            warmup=cfg.warmup,
+            label=name,
+            direct_fn=lambda _f=fn: _f(q, k, v),
+            ops_per_iter=timing.CHAIN_UNROLL,
+        )
+        tflops = flops / res.per_op_ns / 1e3  # FLOP/ns == GFLOP/s; /1e3 -> TFLOP/s
+        out = np.asarray(fn(q, k, v), np.float32)
+        outputs[name] = out
+        err = float(np.max(np.abs(out - ref_np)))
+        data_ok = err <= tol
+        perf_ok = cfg.min_tflops < 0 or tflops >= cfg.min_tflops
+        verdict = Verdict.SUCCESS if (data_ok and perf_ok) else Verdict.FAILURE
+        writer.metric(f"{name} attention", tflops, "TFLOP/s")
+        rec = Record(
+            pattern="longctx",
+            mode=name,
+            commands=f"sp{sp} L{cfg.seq} H{cfg.heads} D{cfg.head_dim}"
+            + (" causal" if cfg.causal else ""),
+            metrics={
+                "tflops": tflops,
+                "min_time_us": res.us(),
+                "flops": flops,
+                "max_abs_err": err,
+                "checksum_ok": float(data_ok),
+            },
+            verdict=verdict,
+        )
+        if not data_ok:
+            rec.notes.append(f"max|err| {err:.2e} above tolerance {tol:.2e}")
+        if not perf_ok:
+            rec.notes.append(f"{tflops:.3f} TFLOP/s below floor {cfg.min_tflops}")
+        records.append(writer.record(rec))
+
+    if len(outputs) >= 2:
+        # Pairwise agreement gate (manual-ring vs library-collective, the
+        # allreduce miniapp's two-paths check applied to attention).
+        names = sorted(outputs)
+        cross = max(
+            float(np.max(np.abs(outputs[a] - outputs[b])))
+            for i, a in enumerate(names)
+            for b in names[i + 1 :]
+        )
+        agree = cross <= tol
+        rec = Record(
+            pattern="longctx",
+            mode="agreement",
+            commands=" vs ".join(names),
+            metrics={"cross_max_err": cross},
+            verdict=Verdict.SUCCESS if agree else Verdict.FAILURE,
+        )
+        if not agree:
+            rec.notes.append(f"strategies diverge: {cross:.2e} > {tol:.2e}")
+        records.append(writer.record(rec))
+    return records

@@ -85,18 +85,11 @@ def ring_attention(
         )
         return state, kv
 
-    # empty_state's constant stats are unvarying along the manual axis;
-    # cast them varying so the fori_loop carry type is stable under
-    # shard_map's VMA tracking.
-    o0, m0, l0 = att.empty_state(q)  # o0 inherits q's varying axes already
-    init = (
-        o0,
-        lax.pcast(m0, (axis_name,), to="varying"),
-        lax.pcast(l0, (axis_name,), to="varying"),
-    )
     # sp-1 {absorb, shift} steps, then absorb the final resident block
     # without the trailing shift (it would only be discarded, and XLA can't
-    # DCE a collective inside a fori_loop).
+    # DCE a collective inside a fori_loop).  empty_state derives its stats
+    # from q so the carry inherits q's varying manual axes (see attention.py).
+    init = att.empty_state(q)
     state, (kb, vb) = lax.fori_loop(0, axis_size - 1, body, (init, (k, v)))
     state = absorb(state, axis_size - 1, kb, vb)
     return att.finalize(state)

@@ -1,0 +1,24 @@
+"""Flagship model workloads composed from the pattern suite.
+
+``transformer`` — PatternFormer: a transformer block whose sharded
+training step is the composition of the suite's patterns (ring attention
+over sp, psum tensor parallelism over tp, dp gradient sync).
+"""
+
+from tpu_patterns.models.transformer import (
+    ModelConfig,
+    forward_shard,
+    init_params,
+    make_train_step,
+    param_specs,
+    shard_params,
+)
+
+__all__ = [
+    "ModelConfig",
+    "forward_shard",
+    "init_params",
+    "make_train_step",
+    "param_specs",
+    "shard_params",
+]

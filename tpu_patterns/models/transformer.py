@@ -1,0 +1,186 @@
+"""PatternFormer: the flagship workload composing the suite's patterns.
+
+The reference is a patterns suite, not an ML stack (SURVEY.md §2.3) — but
+its patterns are exactly the communication substrate of a sharded
+transformer: the ring (allreduce-mpi-sycl.cpp:173-182) becomes ring
+attention over a sequence-parallel axis, the library collective
+(MPI_Allreduce ≙ psum, :62-67) becomes tensor-parallel reduction, and the
+pair/one-sided patterns remain the transport layer under XLA.  This module
+is that composition made runnable: a transformer block whose training step
+exercises real dp x sp x tp shardings in one compiled program.
+
+Parallelism layout (shard_map over a ("dp", "sp", "tp") mesh):
+  * dp — batch data parallelism; gradients sync via the psum the allreduce
+    miniapp measures.
+  * sp — sequence/context parallelism; attention runs as the longctx ring
+    (K/V rotation, sp-1 ppermute steps inside the program).
+  * tp — tensor parallelism; attention heads and MLP hidden dim are
+    Megatron-style column/row sharded with one psum per residual branch.
+
+Everything is jit-once, static-shape, bf16-friendly einsums the MXU tiles
+directly; no data-dependent control flow anywhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_patterns.longctx.ring_attention import ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    embed: int = 128
+    heads: int = 8
+    head_dim: int = 16
+    mlp_mult: int = 4
+    causal: bool = True
+    dtype: str = "float32"
+
+    @property
+    def mlp_hidden(self) -> int:
+        return self.embed * self.mlp_mult
+
+
+# Per-parameter global shapes + shardings (tp shards heads / mlp hidden).
+def param_specs(cfg: ModelConfig) -> dict[str, tuple[tuple[int, ...], P]]:
+    e, h, d, f = cfg.embed, cfg.heads, cfg.head_dim, cfg.mlp_hidden
+    return {
+        "wqkv": ((3, e, h, d), P(None, None, "tp", None)),
+        "wo": ((h, d, e), P("tp", None, None)),
+        "w1": ((e, f), P(None, "tp")),
+        "w2": ((f, e), P("tp", None)),
+    }
+
+
+def init_params(key, cfg: ModelConfig) -> dict[str, jax.Array]:
+    dtype = jnp.dtype(cfg.dtype)
+    params = {}
+    for name, (shape, _) in param_specs(cfg).items():
+        key, sub = jax.random.split(key)
+        fan_in = float(np.prod(shape[:-1])) or 1.0
+        params[name] = jax.random.normal(sub, shape, dtype) * (fan_in**-0.5)
+    return params
+
+
+def forward_shard(
+    params: dict,
+    x: jax.Array,
+    cfg: ModelConfig,
+    sp_axis: str | None = None,
+    sp_size: int = 1,
+    tp_axis: str | None = None,
+) -> jax.Array:
+    """One transformer block on a local shard.
+
+    x: [B_local, L_local, E].  params hold the *local* tp shard (full
+    arrays when tp_axis is None).  Works identically inside ``shard_map``
+    (axes named) and on a single device (axes None) — the same
+    single-source-two-worlds discipline as the miniapps.
+    """
+    # Attention branch: heads are tp-local, sequence is sp-local.
+    qkv = jnp.einsum("ble,cehd->cblhd", x, params["wqkv"])
+    q, k, v = qkv[0], qkv[1], qkv[2]
+
+    if sp_axis is not None and sp_size > 1:
+        attn = jax.vmap(
+            functools.partial(
+                ring_attention,
+                axis_name=sp_axis,
+                axis_size=sp_size,
+                causal=cfg.causal,
+            )
+        )(q, k, v)
+    else:
+        from tpu_patterns.longctx.attention import attention_reference
+
+        attn = jax.vmap(
+            functools.partial(attention_reference, causal=cfg.causal)
+        )(q, k, v)
+
+    o = jnp.einsum("blhd,hde->ble", attn, params["wo"])
+    if tp_axis is not None:
+        o = lax.psum(o, tp_axis)  # row-parallel reduction (≙ MPI_Allreduce)
+    y = x + o
+
+    # MLP branch: column-parallel w1, row-parallel w2.
+    hidden = jax.nn.relu(jnp.einsum("ble,ef->blf", y, params["w1"]))
+    m = jnp.einsum("blf,fe->ble", hidden, params["w2"])
+    if tp_axis is not None:
+        m = lax.psum(m, tp_axis)
+    return y + m
+
+
+def loss_shard(
+    params: dict,
+    x: jax.Array,
+    cfg: ModelConfig,
+    n_global: float,
+    axes: tuple[str, ...] = (),
+    **fwd_kw,
+) -> jax.Array:
+    """Mean-square objective, globally reduced.  Summing over every mesh
+    axis (incl. tp, where the addends are replicas) and normalizing keeps
+    the result axis-invariant, so grads of replicated params come out
+    replicated — dp gradient sync falls out of the psum transpose."""
+    z = forward_shard(params, x, cfg, **fwd_kw)
+    local = jnp.sum(z.astype(jnp.float32) ** 2)
+    if axes:
+        # z is already tp-invariant (the forward's psums reduced tp), so the
+        # objective reduces over the batch/sequence axes only.
+        local = lax.psum(local, axes)
+    return local / n_global
+
+
+def make_train_step(
+    mesh: Mesh, cfg: ModelConfig, lr: float = 1e-3, x_spec: P | None = None
+):
+    """jit-compiled full training step (fwd + bwd + SGD) over the mesh.
+
+    Returns ``step(params, x) -> (params, loss)`` with params sharded per
+    ``param_specs`` and x sharded [dp, sp, -] — ONE compiled program
+    containing the ring attention ppermutes, tp psums, and dp/sp gradient
+    reductions.
+    """
+    x_spec = x_spec or P("dp", "sp", None)
+    axes = ("dp", "sp")  # tp is already reduced inside the forward
+    sp = int(mesh.shape["sp"])
+    specs = param_specs(cfg)
+    pspecs = {k: s for k, (_, s) in specs.items()}
+
+    def step(params, x):
+        n_global = 1.0  # normalizer folded into grads uniformly
+        loss, grads = jax.value_and_grad(loss_shard)(
+            params,
+            x,
+            cfg,
+            n_global,
+            axes=axes,
+            sp_axis="sp",
+            sp_size=sp,
+            tp_axis="tp",
+        )
+        new = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype), params, grads)
+        return new, loss
+
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(pspecs, x_spec),
+        out_specs=(pspecs, P()),
+    )
+    return jax.jit(sharded), pspecs
+
+
+def shard_params(params: dict, mesh: Mesh, cfg: ModelConfig) -> dict:
+    return {
+        k: jax.device_put(v, NamedSharding(mesh, param_specs(cfg)[k][1]))
+        for k, v in params.items()
+    }
