@@ -20,6 +20,10 @@ Layer map (mirrors SURVEY.md §1):
   longctx/     sequence/context parallelism         (ring attention + Ulysses on
                                                      the ring/all-to-all substrate,
                                                      SURVEY.md §2.3, §5)
+  parallel/    pipeline (pp) + expert (ep)          (GPipe ring schedule, MoE
+                                                     all-to-all dispatch)
+  models/      flagship workloads                    (PatternFormer: the
+                                                     dp x sp x tp train step)
   cli.py       launcher / sweep / report            (ref: run*.sh, parse.py)
 """
 

@@ -1,0 +1,12 @@
+"""Parallelism-strategy layer: pipeline (pp) and expert (ep) patterns.
+
+Completes the suite's distribution vocabulary alongside dp (allreduce
+miniapp), tp (psum in models/), and sp (longctx/): both built from the
+same two communication lineages every other pattern uses — the neighbor
+ring (``pipeline``) and the library all-to-all (``moe``).
+"""
+
+from tpu_patterns.parallel.moe import moe_apply, top1_route
+from tpu_patterns.parallel.pipeline import pipeline_apply
+
+__all__ = ["moe_apply", "pipeline_apply", "top1_route"]

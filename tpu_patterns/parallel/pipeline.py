@@ -1,0 +1,84 @@
+"""Pipeline parallelism: microbatch streaming over a "pp" mesh axis.
+
+The third classic distribution axis, built from the same primitive as
+everything else in the suite: a neighbor ``ppermute`` ring
+(comm/ring.ring_shift ≙ SendRecvRing, allreduce-mpi-sycl.cpp:44-59).
+GPipe-style schedule: stage s (mesh position s on "pp") owns one layer's
+parameters; microbatches enter at stage 0, activations hop one stage per
+tick, outputs drain from the last stage.  n_micro + pp - 1 ticks total,
+all inside ONE compiled program — the per-tick hop is the same
+device-kernel-alternating-with-transfer structure as the reference's ring
+loop (SURVEY.md §3.3), with the bubble (pp-1 idle ticks) as the measured
+cost of the pattern.
+
+SPMD realization (every rank runs the same program):
+  * microbatches live replicated on every rank; stage 0 feeds tick t with
+    microbatch t (`lax.dynamic_index_in_dim`), other ranks feed the
+    activation just received from their left neighbor;
+  * each rank applies ITS stage parameters (sharded over "pp") every tick
+    — ticks where a rank holds no live microbatch compute on garbage and
+    discard, the uniform-SPMD trade the suite makes everywhere;
+  * the last stage writes its result into the output buffer at ticks
+    t >= pp-1 (`dynamic_update_index_in_dim` with a clamped index and a
+    where-mask — static shapes, no data-dependent control flow).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_patterns.comm.ring import ring_perm
+
+
+def pipeline_apply(
+    stage_fn,
+    stage_params,
+    micro: jax.Array,
+    axis_name: str,
+    axis_size: int,
+):
+    """Run ``n_micro`` microbatches through ``axis_size`` pipeline stages.
+
+    stage_fn(params, x) -> y applies one stage (same shape in/out).
+    stage_params: this rank's stage parameters (sharded over ``axis_name``).
+    micro: [n_micro, B, ...] microbatches, replicated on every rank.
+    Returns [n_micro, B, ...] outputs (replicated), in microbatch order.
+    """
+    pp = axis_size
+    n_micro = micro.shape[0]
+    r = lax.axis_index(axis_name)
+    is_first = r == 0
+    is_last = r == pp - 1
+    fwd = ring_perm(pp, 1)  # stage s -> s+1 (last wraps to 0, value unused)
+
+    def tick(t, carry):
+        recv, out = carry
+        # Stage 0 ingests microbatch t while it exists; later stages use
+        # the activation received from the left neighbor.
+        feed_idx = jnp.clip(t, 0, n_micro - 1)
+        fresh = lax.dynamic_index_in_dim(micro, feed_idx, keepdims=False)
+        x = jnp.where(is_first, fresh, recv)
+        y = stage_fn(stage_params, x)
+        # Drain: the last stage finished microbatch t-(pp-1) this tick.
+        out_idx = jnp.clip(t - (pp - 1), 0, n_micro - 1)
+        take = jnp.logical_and(is_last, t >= pp - 1)
+        cur = lax.dynamic_index_in_dim(out, out_idx, keepdims=False)
+        out = lax.dynamic_update_index_in_dim(
+            out, jnp.where(take, y, cur), out_idx, 0
+        )
+        # Hop activations one stage rightward (≙ SendRecvRing).
+        recv = lax.ppermute(y, axis_name, fwd)
+        return recv, out
+
+    # Init carries varying over the pipeline axis (the loop writes
+    # rank-dependent values into them; a constant init would change the
+    # carry's varying-manual-axes type).
+    out0 = lax.pcast(jnp.zeros_like(micro), (axis_name,), to="varying")
+    recv0 = lax.pcast(jnp.zeros_like(micro[0]), (axis_name,), to="varying")
+    _, out = lax.fori_loop(0, n_micro + pp - 1, tick, (recv0, out0))
+    # Outputs accumulated on the last stage only; broadcast to every rank
+    # so the result is replicated (psum over the one-hot owner).
+    owner = (r == pp - 1).astype(out.dtype)
+    return lax.psum(out * owner, axis_name)
