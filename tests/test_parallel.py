@@ -57,7 +57,7 @@ def test_pipeline_matches_sequential(mesh1d, stage_weights, micro):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
-def test_pipeline_single_stage(mesh1d, stage_weights, micro):
+def test_pipeline_single_stage(stage_weights, micro):
     """pp=1 degenerates to a plain per-microbatch map."""
     from jax.sharding import Mesh
 

@@ -202,7 +202,12 @@ def _cmd_longctx(args, writer: ResultWriter) -> None:
     from tpu_patterns.longctx.pattern import LongCtxConfig, run_longctx
 
     n = args.devices or len(jax.devices())
-    strategies = ("ring", "ulysses") if args.strategy == "both" else (args.strategy,)
+    if args.strategy == "both":
+        # On one device, fold the fused Mosaic kernel in so the pairwise
+        # agreement Record cross-checks it against the XLA lineages.
+        strategies = ("ring", "ulysses") + (("flash",) if n == 1 else ())
+    else:
+        strategies = (args.strategy,)
     if args.seq % n:
         _world_skip(
             writer, "longctx", args.strategy, n,
@@ -213,6 +218,12 @@ def _cmd_longctx(args, writer: ResultWriter) -> None:
         _world_skip(
             writer, "longctx", args.strategy, n,
             f"heads {args.heads} not divisible by sp={n} (ulysses)",
+        )
+        return
+    if "flash" in strategies and n != 1:
+        _world_skip(
+            writer, "longctx", args.strategy, n,
+            f"flash strategy is single-device, have {n} (use --devices 1)",
         )
         return
     mesh = _build_mesh(args.devices, args.placement, args.mechanism)
@@ -372,9 +383,10 @@ def build_parser() -> argparse.ArgumentParser:
     add_config_args(lc, LongCtxConfig, skip=("strategies",))
     lc.add_argument(
         "--strategy",
-        choices=("ring", "ulysses", "both"),
+        choices=("ring", "ulysses", "flash", "both"),
         default="both",
-        help="manual-ring vs library-collective lineage (≙ ring vs -a)",
+        help="manual-ring vs library-collective lineage (≙ ring vs -a); "
+        "flash = fused Mosaic kernel, single-device",
     )
     _add_mesh_args(lc)
 

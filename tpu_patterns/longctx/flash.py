@@ -1,0 +1,152 @@
+"""Fused flash attention: the longctx hot op as a Pallas (Mosaic) kernel.
+
+The XLA path (attention.attention_reference) materializes the [H, Lq, Lk]
+score tensor in HBM; this kernel never does — each grid step streams one
+(q-block, k-block) tile through VMEM, carries the online-softmax
+statistics (running max, normalizer, unnormalized accumulator) in VMEM
+scratch across the innermost k loop, and writes each output block once.
+Same math as attention.block_attention/combine_blocks, fused (SURVEY.md
+§2.2 rule: device hot ops are native Mosaic kernels, the XLA twin is the
+calibration reference — exactly the busy-wait pairing of C10).
+
+Layout: [H, L, D] blocks of (1, block, head_dim); the stats scratch is
+[block_q, 128] lane-replicated (the TPU-native shape for per-row
+scalars).  Causal runs skip fully-masked k-blocks with ``pl.when`` —
+compute for those tiles is predicated off, the grid itself stays static.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+NEG_INF = -1e30
+
+
+def _kernel(
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    m_scr,
+    l_scr,
+    acc_scr,
+):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _body():
+        # Native-dtype operands (bf16 runs the MXU at full rate; an f32
+        # upcast here would cost 8x), f32 accumulation.
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [Bq, Bk]
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+        m_prev = m_scr[:, 0:1]  # [Bq, 1]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)  # [Bq, 1]
+        m_cur = jnp.maximum(m_prev, m_blk)
+        # Rows with nothing unmasked yet keep exp() exactly 0.
+        p = jnp.exp(s - m_cur) * (m_cur > NEG_INF / 2)  # [Bq, Bk]
+        alpha = jnp.exp(m_prev - m_cur)  # [Bq, 1]
+        l_cur = alpha * l_scr[:, 0:1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc = alpha * acc_scr[:] + jax.lax.dot(
+            p.astype(v_ref.dtype), v_ref[0], preferred_element_type=jnp.float32
+        )
+        m_scr[:] = jnp.broadcast_to(m_cur, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_cur, l_scr.shape)
+        acc_scr[:] = acc
+
+    if causal:
+        # Skip k-blocks entirely above the diagonal: their largest q
+        # position is smaller than their smallest k position.
+        pl.when((iq + 1) * block_q - 1 >= ik * block_k)(_body)
+    else:
+        _body()
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_scr[:, 0:1]
+        o_ref[0] = (acc_scr[:] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: float | None = None,
+    block_q: int = 1024,
+    block_k: int = 1024,
+    interpret: bool = False,
+) -> jax.Array:
+    """Drop-in fused replacement for ``attention.attention_reference``.
+
+    q: [Lq, H, D]; k, v: [Lk, H, D].  Block sizes clamp to the sequence
+    lengths; L must divide by the (clamped) blocks.  Defaults are the
+    measured v5e sweet spot (1024x1024: 135 TFLOP/s non-causal vs XLA's
+    125, 81 vs 30 effective TFLOP/s causal — the diagonal skip is real);
+    2048x2048 blows the 16 MB VMEM budget on the f32 score tile.
+    """
+    lq, h, d = q.shape
+    lk = k.shape[0]
+    scale = float(scale) if scale is not None else d**-0.5
+    bq, bk = min(block_q, lq), min(block_k, lk)
+    if lq % bq or lk % bk:
+        raise ValueError(
+            f"block sizes ({bq}, {bk}) must divide the sequence lengths "
+            f"({lq}, {lk})"
+        )
+
+    # [L, H, D] -> [H, L, D]: per-head tiles with (L, D) as the MXU plane.
+    qt, kt, vt = (a.swapaxes(0, 1) for a in (q, k, v))
+    grid = (h, lq // bq, lk // bk)
+    # Inside shard_map the output must declare its varying-manual-axes;
+    # it inherits q's (elementwise in the manual view).
+    vma = getattr(jax.typeof(q), "vma", None)
+    out_sds = (
+        jax.ShapeDtypeStruct((h, lq, d), q.dtype, vma=vma)
+        if vma
+        else jax.ShapeDtypeStruct((h, lq, d), q.dtype)
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, causal, scale, bq, bk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda h, iq, ik: (h, iq, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, iq, ik: (h, ik, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, iq, ik: (h, ik, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda h, iq, ik: (h, iq, 0)),
+        out_shape=out_sds,
+        scratch_shapes=[
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.swapaxes(0, 1)

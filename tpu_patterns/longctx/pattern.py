@@ -31,7 +31,25 @@ from tpu_patterns.longctx import attention as att
 from tpu_patterns.longctx.ring_attention import ring_attention
 from tpu_patterns.longctx.ulysses import ulysses_attention
 
-STRATEGIES = {"ring": ring_attention, "ulysses": ulysses_attention}
+
+def flash_local(q, k, v, axis_name=None, axis_size=1, causal=False, scale=None):
+    """The fused Mosaic kernel as a single-device "strategy": the hot-op
+    contrast to the XLA lineages (sp must be 1 — it has no comm)."""
+    from tpu_patterns.longctx.flash import flash_attention
+    from tpu_patterns.runtime import use_interpret
+
+    if axis_size != 1:
+        raise ValueError("flash strategy is single-device (sp must be 1)")
+    return flash_attention(
+        q, k, v, causal=causal, scale=scale, interpret=use_interpret()
+    )
+
+
+STRATEGIES = {
+    "ring": ring_attention,
+    "ulysses": ulysses_attention,
+    "flash": flash_local,
+}
 
 
 @dataclasses.dataclass
@@ -83,6 +101,8 @@ def run_longctx(
         raise ValueError(f"seq {cfg.seq} not divisible by sp={sp}")
     if cfg.heads % sp != 0 and "ulysses" in cfg.strategies:
         raise ValueError(f"heads {cfg.heads} not divisible by sp={sp} (ulysses)")
+    if "flash" in cfg.strategies and sp != 1:
+        raise ValueError("flash strategy is single-device (needs sp=1)")
 
     dtype = jnp.dtype(cfg.dtype)
     shape = (cfg.seq, cfg.heads, cfg.head_dim)
