@@ -140,9 +140,11 @@ class TestSweep:
         assert {s.name.split(".")[1] for s in con} == {"default"}
         ar = sweep.specs_for("allreduce")
         assert any("pallas" in s.name for s in ar)
+        lc = sweep.specs_for("longctx", quick=True)
+        assert any("agreement" in s.name for s in lc)
         assert len(sweep.specs_for("all", quick=True)) == len(p2p) + len(con) + len(
             sweep.specs_for("allreduce", quick=True)
-        )
+        ) + len(lc)
 
     def test_unknown_name_filter(self, tmp_path):
         with pytest.raises(ValueError, match="unknown cell name"):
@@ -169,6 +171,7 @@ class TestSweep:
         names = [
             "p2p.compact.mesh.two_sided.n2",
             "allreduce.xla.float32.ring.D",
+            "longctx.agreement.1dev",
         ]
         rc = sweep.run_sweep(
             "all", out_dir=str(tmp_path), quick=True, names=names, base_env=env

@@ -215,11 +215,19 @@ def _cmd_longctx(args, writer: ResultWriter) -> None:
         )
         return
     if "ulysses" in strategies and args.heads % n:
-        _world_skip(
-            writer, "longctx", args.strategy, n,
-            f"heads {args.heads} not divisible by sp={n} (ulysses)",
-        )
-        return
+        if args.strategy == "both":
+            # Only ulysses carries the heads % sp constraint; the other
+            # strategies still run and get measured.
+            strategies = tuple(s for s in strategies if s != "ulysses")
+            writer.progress(
+                f"dropping ulysses: heads {args.heads} not divisible by sp={n}"
+            )
+        else:
+            _world_skip(
+                writer, "longctx", args.strategy, n,
+                f"heads {args.heads} not divisible by sp={n} (ulysses)",
+            )
+            return
     if "flash" in strategies and n != 1:
         _world_skip(
             writer, "longctx", args.strategy, n,
@@ -401,7 +409,7 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("interop", help="native FFI round-trip proofs")
 
     s = sub.add_parser("sweep", help="config-matrix sweeps (≙ run*.sh)")
-    s.add_argument("suite", choices=("p2p", "concurrency", "allreduce", "all"))
+    s.add_argument("suite", choices=("p2p", "concurrency", "allreduce", "longctx", "all"))
     s.add_argument("--out", default="results", help="log/JSONL directory")
     s.add_argument("--quick", action="store_true", help="tiny workloads")
 

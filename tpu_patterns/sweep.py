@@ -158,10 +158,41 @@ def allreduce_specs(quick: bool = False) -> list[SweepSpec]:
     return specs
 
 
+def longctx_specs(quick: bool = False) -> list[SweepSpec]:
+    """Strategy x causal x dtype matrix over the full device world, plus
+    the single-device kernel-vs-XLA agreement cell."""
+    small = ("--seq", "256", "--head_dim", "32", "--reps", "2") if quick else (
+        "--seq", "4096", "--head_dim", "128", "--dtype", "bfloat16",
+    )
+    specs = []
+    for strategy in ("ring", "ulysses"):
+        for causal in ("true", "false") if not quick else ("true",):
+            specs.append(
+                SweepSpec(
+                    name=f"longctx.{strategy}.causal_{causal}",
+                    argv=(
+                        "longctx", "--strategy", strategy,
+                        "--causal", causal, *small,
+                    ),
+                    env=(("TPU_PATTERNS_SWEEP_CONFIG", "longctx"),),
+                )
+            )
+    # the Mosaic-vs-XLA agreement cell (flash folds in at --devices 1)
+    specs.append(
+        SweepSpec(
+            name="longctx.agreement.1dev",
+            argv=("longctx", "--devices", "1", *small),
+            env=(("TPU_PATTERNS_SWEEP_CONFIG", "longctx"),),
+        )
+    )
+    return specs
+
+
 SUITES = {
     "p2p": p2p_specs,
     "concurrency": concurrency_specs,
     "allreduce": allreduce_specs,
+    "longctx": longctx_specs,
 }
 
 
