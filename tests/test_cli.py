@@ -132,6 +132,22 @@ class TestCommands:
         assert "SUCCESS" in out and "FAILURE" in out and "cfg1" in out
 
 
+class TestProfiling:
+    def test_enable_profiling_writes_trace(self, tmp_path):
+        """--enable_profiling (≙ the reference's flag of the same name)
+        must leave a trace artifact behind."""
+        prof = tmp_path / "prof"
+        rc = main(
+            [
+                "--enable_profiling", "--profile_dir", str(prof),
+                "p2p", *FAST_P2P, "--devices", "2",
+            ]
+        )
+        assert rc == 0
+        traces = list(prof.rglob("*"))
+        assert any(p.is_file() for p in traces), "no trace files written"
+
+
 class TestSweep:
     def test_spec_matrices(self):
         p2p = sweep.specs_for("p2p", quick=True)

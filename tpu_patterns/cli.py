@@ -342,6 +342,17 @@ def build_parser() -> argparse.ArgumentParser:
         prog="tpu-patterns", description=__doc__.splitlines()[0]
     )
     parser.add_argument("--jsonl", default=None, help="append JSONL records here")
+    parser.add_argument(
+        "--enable_profiling",
+        action="store_true",
+        help="capture a jax.profiler trace of the run (≙ the reference's "
+        "--enable_profiling queue property, concurency/main.cpp:123)",
+    )
+    parser.add_argument(
+        "--profile_dir",
+        default="results/profile",
+        help="trace output directory for --enable_profiling",
+    )
     sub = parser.add_subparsers(dest="cmd", required=True)
 
     p = sub.add_parser("p2p", help="pair-exchange bandwidth (≙ peer2pear)")
@@ -438,8 +449,25 @@ def main(argv: list[str] | None = None) -> int:
                 "error: --jsonl does not apply to sweep (each cell writes "
                 "<name>.jsonl under --out)"
             )
+        if args.enable_profiling:
+            raise SystemExit(
+                "error: --enable_profiling does not apply to sweep (cells are "
+                "subprocesses; profile an individual pattern run instead)"
+            )
         return _cmd_sweep(args, writer)
-    handlers[args.cmd](args, writer)
+    if args.enable_profiling:
+        # ≙ plumbing enable_profiling into queue construction
+        # (bench_sycl.cpp:39-45) — here the whole pattern run is traced.
+        import os
+
+        import jax
+
+        os.makedirs(args.profile_dir, exist_ok=True)
+        with jax.profiler.trace(args.profile_dir):
+            handlers[args.cmd](args, writer)
+        writer.progress(f"profile trace written under {args.profile_dir}")
+    else:
+        handlers[args.cmd](args, writer)
     return writer.exit_code
 
 

@@ -28,6 +28,56 @@ LANES = 128
 NEG_INF = -1e30
 
 
+def _sds(shape, dtype, vma):
+    """ShapeDtypeStruct carrying the caller's varying-manual-axes when set
+    (required for pallas_call outputs inside shard_map)."""
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _online_step(
+    causal, scale, block_q, block_k, q_off, k_off,
+    iq, ik, q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
+):
+    """One (q-block, k-block) online-softmax update against the VMEM
+    scratch — the single body both kernels share.  ``q_off``/``k_off`` are
+    the global positions of the shards (python 0 for the single-shard
+    kernel, traced SMEM scalars inside the ring)."""
+    # Native-dtype operands (bf16 runs the MXU at full rate; an f32
+    # upcast here would cost 8x), f32 accumulation.
+    s = jax.lax.dot_general(
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [Bq, Bk]
+    if causal:
+        q_pos = q_off + iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = k_off + ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    m_prev = m_scr[:, 0:1]  # [Bq, 1]
+    m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    # Rows with nothing unmasked yet keep exp() exactly 0.
+    p = jnp.exp(s - m_cur) * (m_cur > NEG_INF / 2)  # [Bq, Bk]
+    alpha = jnp.exp(m_prev - m_cur)  # [Bq, 1]
+    l_cur = alpha * l_scr[:, 0:1] + jnp.sum(p, axis=-1, keepdims=True)
+    acc = alpha * acc_scr[:] + jax.lax.dot(
+        p.astype(v_ref.dtype), v_ref[0], preferred_element_type=jnp.float32
+    )
+    m_scr[:] = jnp.broadcast_to(m_cur, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_cur, l_scr.shape)
+    acc_scr[:] = acc
+
+
+def _init_scratch(m_scr, l_scr, acc_scr):
+    m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+    l_scr[:] = jnp.zeros_like(l_scr)
+    acc_scr[:] = jnp.zeros_like(acc_scr)
+
+
 def _kernel(
     causal: bool,
     scale: float,
@@ -43,46 +93,18 @@ def _kernel(
 ):
     iq, ik = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
-
-    @pl.when(ik == 0)
-    def _init():
-        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
+    pl.when(ik == 0)(lambda: _init_scratch(m_scr, l_scr, acc_scr))
 
     def _body():
-        # Native-dtype operands (bf16 runs the MXU at full rate; an f32
-        # upcast here would cost 8x), f32 accumulation.
-        s = jax.lax.dot_general(
-            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [Bq, Bk]
-        if causal:
-            q_pos = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-
-        m_prev = m_scr[:, 0:1]  # [Bq, 1]
-        m_blk = jnp.max(s, axis=-1, keepdims=True)  # [Bq, 1]
-        m_cur = jnp.maximum(m_prev, m_blk)
-        # Rows with nothing unmasked yet keep exp() exactly 0.
-        p = jnp.exp(s - m_cur) * (m_cur > NEG_INF / 2)  # [Bq, Bk]
-        alpha = jnp.exp(m_prev - m_cur)  # [Bq, 1]
-        l_cur = alpha * l_scr[:, 0:1] + jnp.sum(p, axis=-1, keepdims=True)
-        acc = alpha * acc_scr[:] + jax.lax.dot(
-            p.astype(v_ref.dtype), v_ref[0], preferred_element_type=jnp.float32
+        _online_step(
+            causal, scale, block_q, block_k, 0, 0,
+            iq, ik, q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
         )
-        m_scr[:] = jnp.broadcast_to(m_cur, m_scr.shape)
-        l_scr[:] = jnp.broadcast_to(l_cur, l_scr.shape)
-        acc_scr[:] = acc
 
     if causal:
         # Skip k-blocks entirely above the diagonal: their largest q
-        # position is smaller than their smallest k position.
+        # position is smaller than their smallest k position (offsets are
+        # 0 here, so the predicate is static per grid point).
         pl.when((iq + 1) * block_q - 1 >= ik * block_k)(_body)
     else:
         _body()
@@ -91,6 +113,112 @@ def _kernel(
     def _finalize():
         l = l_scr[:, 0:1]
         o_ref[0] = (acc_scr[:] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def _block_kernel(
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    off_ref,  # SMEM [2]: global (q, k) position offsets of these shards
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    m_ref,
+    l_ref,
+    m_scr,
+    l_scr,
+    acc_scr,
+):
+    """flash body that EMITS the online-softmax stats instead of
+    finalizing: the fused form of attention.block_attention, for callers
+    (the ring) that combine partials across devices."""
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+    pl.when(ik == 0)(lambda: _init_scratch(m_scr, l_scr, acc_scr))
+
+    def _body():
+        _online_step(
+            causal, scale, block_q, block_k, off_ref[0], off_ref[1],
+            iq, ik, q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
+        )
+
+    if causal:
+        # Shard offsets are traced, so the diagonal skip is a dynamic
+        # predicate (pl.when on a traced bool) rather than a static branch.
+        pl.when(
+            off_ref[0] + (iq + 1) * block_q - 1 >= off_ref[1] + ik * block_k
+        )(_body)
+    else:
+        _body()
+
+    @pl.when(ik == nk - 1)
+    def _emit():
+        o_ref[0] = acc_scr[:]
+        m_ref[0] = m_scr[:, 0:1]
+        l_ref[0] = l_scr[:, 0:1]
+
+
+def flash_block(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_off: jax.Array,
+    k_off: jax.Array,
+    causal: bool = False,
+    scale: float | None = None,
+    block_q: int = 1024,
+    block_k: int = 1024,
+    interpret: bool = False,
+):
+    """Fused ``attention.block_attention``: returns the (o, m, l) partial
+    triple (o unnormalized f32 [Lq, H, D]; m, l f32 [H, Lq]) for
+    ``attention.combine_blocks``.  ``q_off``/``k_off`` are the global
+    sequence positions of these shards (traced values inside the ring).
+    """
+    lq, h, d = q.shape
+    lk = k.shape[0]
+    scale = float(scale) if scale is not None else d**-0.5
+    bq, bk = min(block_q, lq), min(block_k, lk)
+    if lq % bq or lk % bk:
+        raise ValueError(
+            f"block sizes ({bq}, {bk}) must divide the shard lengths ({lq}, {lk})"
+        )
+    qt, kt, vt = (a.swapaxes(0, 1) for a in (q, k, v))
+    offs = jnp.stack([q_off, k_off]).astype(jnp.int32)
+    vma = getattr(jax.typeof(q), "vma", None)
+
+    o, m, l = pl.pallas_call(
+        functools.partial(_block_kernel, causal, scale, bq, bk),
+        grid=(h, lq // bq, lk // bk),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, bq, d), lambda h, iq, ik: (h, iq, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, iq, ik: (h, ik, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, iq, ik: (h, ik, 0)),
+        ],
+        # Stats carry a trailing singleton: Mosaic constrains the last two
+        # block dims, and (bq, 1) with a size-1 array minor dim satisfies it
+        # where a 2-D (1, bq) block would not.
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda h, iq, ik: (h, iq, 0)),
+            pl.BlockSpec((1, bq, 1), lambda h, iq, ik: (h, iq, 0)),
+            pl.BlockSpec((1, bq, 1), lambda h, iq, ik: (h, iq, 0)),
+        ],
+        out_shape=[
+            _sds((h, lq, d), jnp.float32, vma),
+            _sds((h, lq, 1), jnp.float32, vma),
+            _sds((h, lq, 1), jnp.float32, vma),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(offs, qt, kt, vt)
+    return o.swapaxes(0, 1), m[..., 0], l[..., 0]
 
 
 def flash_attention(
@@ -126,12 +254,7 @@ def flash_attention(
     grid = (h, lq // bq, lk // bk)
     # Inside shard_map the output must declare its varying-manual-axes;
     # it inherits q's (elementwise in the manual view).
-    vma = getattr(jax.typeof(q), "vma", None)
-    out_sds = (
-        jax.ShapeDtypeStruct((h, lq, d), q.dtype, vma=vma)
-        if vma
-        else jax.ShapeDtypeStruct((h, lq, d), q.dtype)
-    )
+    out_sds = _sds((h, lq, d), q.dtype, getattr(jax.typeof(q), "vma", None))
     out = pl.pallas_call(
         functools.partial(_kernel, causal, scale, bq, bk),
         grid=grid,

@@ -50,12 +50,24 @@ def ring_attention(
     axis_size: int,
     causal: bool = False,
     scale: float | None = None,
+    block_impl: str = "xla",
+    interpret: bool = False,
 ) -> jax.Array:
     """Full attention over the global sequence; call inside ``shard_map``.
 
     q, k, v: [L_local, H, D] shards of a [L_local*axis_size, H, D] global
     sequence, sharded contiguously over ``axis_name``.
+
+    ``block_impl`` selects the per-step compute: "xla"
+    (attention.block_attention, the calibration twin) or "pallas" (the
+    fused flash_block Mosaic kernel — the native hot op, SURVEY.md §2.2).
+    In interpret mode (CPU meshes) the pallas path needs
+    ``check_vma=False`` on the enclosing shard_map — the HLO-interpreter
+    discharge cannot track varying manual axes (same limitation as
+    comm.onesided.ring_put).
     """
+    if block_impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown block_impl {block_impl!r}")
     if axis_size == 1:
         return att.attention_reference(q, k, v, causal=causal, scale=scale)
 
@@ -72,7 +84,21 @@ def ring_attention(
         # After t forward ring shifts, this device holds the K/V shard that
         # started on rank (r - t) % sp.
         kv_rank = (r - t) % axis_size
-        block = att.block_attention(q, kb, vb, scale=scale, mask=mask_for(kv_rank))
+        if block_impl == "pallas":
+            from tpu_patterns.longctx.flash import flash_block
+
+            block = flash_block(
+                q, kb, vb,
+                q_off=r * lq,
+                k_off=kv_rank * lk,
+                causal=causal,
+                scale=scale,
+                interpret=interpret,
+            )
+        else:
+            block = att.block_attention(
+                q, kb, vb, scale=scale, mask=mask_for(kv_rank)
+            )
         return att.combine_blocks(state, block)
 
     def body(t, carry):
@@ -89,10 +115,14 @@ def ring_attention(
     # without the trailing shift (it would only be discarded, and XLA can't
     # DCE a collective inside a fori_loop).  empty_state derives its stats
     # from q so the carry inherits q's varying manual axes (see attention.py).
-    init = att.empty_state(q)
+    # The pallas block emits f32 partials, so its carry must start f32.
+    init = att.empty_state(
+        q if block_impl == "xla" else q.astype(jnp.float32)
+    )
     state, (kb, vb) = lax.fori_loop(0, axis_size - 1, body, (init, (k, v)))
     state = absorb(state, axis_size - 1, kb, vb)
-    return att.finalize(state)
+    # Both impls return q's dtype (the pallas carry runs f32 internally).
+    return att.finalize(state).astype(q.dtype)
 
 
 def run_sharded(
