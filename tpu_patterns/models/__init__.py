@@ -8,17 +8,25 @@ over sp, psum tensor parallelism over tp, dp gradient sync).
 from tpu_patterns.models.transformer import (
     ModelConfig,
     forward_shard,
+    forward_stack,
     init_params,
+    init_stack_params,
+    make_pipeline_train_step,
     make_train_step,
     param_specs,
     shard_params,
+    stack_specs,
 )
 
 __all__ = [
     "ModelConfig",
     "forward_shard",
+    "forward_stack",
     "init_params",
+    "init_stack_params",
+    "make_pipeline_train_step",
     "make_train_step",
     "param_specs",
     "shard_params",
+    "stack_specs",
 ]

@@ -43,27 +43,53 @@ class ModelConfig:
     mlp_mult: int = 4
     causal: bool = True
     dtype: str = "float32"
+    # moe=True replaces the dense MLP with a top-1 mixture whose experts
+    # are sharded one-per-rank over the SAME mesh axis as tensor
+    # parallelism (ep ≙ tp, the replicated-activation EP layout): tokens
+    # are tp-replicated, each rank computes its own expert's slots, and
+    # the combine is the branch psum the dense path already does.
+    moe: bool = False
 
     @property
     def mlp_hidden(self) -> int:
         return self.embed * self.mlp_mult
 
 
-# Per-parameter global shapes + shardings (tp shards heads / mlp hidden).
-def param_specs(cfg: ModelConfig) -> dict[str, tuple[tuple[int, ...], P]]:
+# Per-parameter global shapes + shardings (tp shards heads / mlp hidden;
+# with moe=True the experts are sharded one-per-rank over the tp axis and
+# n_experts must equal the tp axis size).
+def param_specs(
+    cfg: ModelConfig, n_experts: int = 0
+) -> dict[str, tuple[tuple[int, ...], P]]:
     e, h, d, f = cfg.embed, cfg.heads, cfg.head_dim, cfg.mlp_hidden
-    return {
+    specs = {
         "wqkv": ((3, e, h, d), P(None, None, "tp", None)),
         "wo": ((h, d, e), P("tp", None, None)),
-        "w1": ((e, f), P(None, "tp")),
-        "w2": ((f, e), P("tp", None)),
     }
+    if cfg.moe:
+        if n_experts < 1:
+            raise ValueError("moe=True needs n_experts (= tp axis size)")
+        specs.update(
+            {
+                "wg": ((e, n_experts), P(None, None)),
+                "we1": ((n_experts, e, f), P("tp", None, None)),
+                "we2": ((n_experts, f, e), P("tp", None, None)),
+            }
+        )
+    else:
+        specs.update(
+            {
+                "w1": ((e, f), P(None, "tp")),
+                "w2": ((f, e), P("tp", None)),
+            }
+        )
+    return specs
 
 
-def init_params(key, cfg: ModelConfig) -> dict[str, jax.Array]:
+def init_params(key, cfg: ModelConfig, n_experts: int = 0) -> dict[str, jax.Array]:
     dtype = jnp.dtype(cfg.dtype)
     params = {}
-    for name, (shape, _) in param_specs(cfg).items():
+    for name, (shape, _) in param_specs(cfg, n_experts).items():
         key, sub = jax.random.split(key)
         fan_in = float(np.prod(shape[:-1])) or 1.0
         params[name] = jax.random.normal(sub, shape, dtype) * (fan_in**-0.5)
@@ -110,12 +136,61 @@ def forward_shard(
         o = lax.psum(o, tp_axis)  # row-parallel reduction (≙ MPI_Allreduce)
     y = x + o
 
-    # MLP branch: column-parallel w1, row-parallel w2.
+    if cfg.moe:
+        return y + _moe_ffn(params, y, tp_axis)
+    # Dense MLP branch: column-parallel w1, row-parallel w2.
     hidden = jax.nn.relu(jnp.einsum("ble,ef->blf", y, params["w1"]))
     m = jnp.einsum("blf,fe->ble", hidden, params["w2"])
     if tp_axis is not None:
         m = lax.psum(m, tp_axis)
     return y + m
+
+
+def _moe_ffn(params: dict, y: jax.Array, tp_axis: str | None) -> jax.Array:
+    """Top-1 MoE FFN with replicated activations, experts over the tp axis
+    (ep ≙ tp).  Tokens are tp-replicated after the attention psum, so
+    dispatch needs no all-to-all: each rank selects its OWN expert's slots
+    from the shared dispatch tensor, runs its expert, and the combine is a
+    psum — gradient flows through the gate weights (routing argmax is a
+    constant, the standard top-1 straight-through treatment).  Capacity =
+    T (exact, nothing dropped; the O(T^2) dispatch tensor is the pattern
+    trade — production kernels cap C).
+    """
+    from tpu_patterns.parallel.moe import (
+        build_dispatch,
+        build_dispatch_column,
+        top1_route,
+    )
+
+    b, l, e = y.shape
+    x2 = y.reshape(-1, e)  # [T, E]
+    cap = x2.shape[0]
+    onehot, weight = top1_route(x2, params["wg"])
+
+    def expert(w1, w2, xin):
+        return jax.nn.relu(xin @ w1) @ w2
+
+    if tp_axis is None:
+        # Single device holds every expert: run them all.
+        dispatch = build_dispatch(onehot, cap, x2.dtype)  # [T, n_exp, C]
+        expert_in = jnp.einsum("tec,td->ecd", dispatch, x2)
+        out_e = jax.vmap(expert)(params["we1"], params["we2"], expert_in)
+        out = jnp.einsum("tec,ecd->td", dispatch, out_e)
+    else:
+        if params["we1"].shape[0] != 1:
+            raise ValueError(
+                f"moe over {tp_axis!r} needs one expert per rank, got a "
+                f"local shard of {params['we1'].shape[0]} (n_experts must "
+                "equal the axis size)"
+            )
+        my = lax.axis_index(tp_axis)
+        # Build only MY expert's [T, C] dispatch column — the full
+        # [T, n_exp, C] tensor is n_exp-fold wasted memory per rank.
+        my_dispatch = build_dispatch_column(onehot, my, cap, x2.dtype)
+        mine = jnp.einsum("tc,td->cd", my_dispatch, x2)  # [C, E]
+        ye = expert(params["we1"][0], params["we2"][0], mine)
+        out = lax.psum(jnp.einsum("tc,cd->td", my_dispatch, ye), tp_axis)
+    return (out * weight[:, None]).reshape(b, l, e)
 
 
 def loss_shard(
@@ -139,6 +214,10 @@ def loss_shard(
     return local / n_global
 
 
+def _n_experts(mesh: Mesh, cfg: ModelConfig) -> int:
+    return int(mesh.shape["tp"]) if cfg.moe else 0
+
+
 def make_train_step(
     mesh: Mesh, cfg: ModelConfig, lr: float = 1e-3, x_spec: P | None = None
 ):
@@ -152,7 +231,7 @@ def make_train_step(
     x_spec = x_spec or P("dp", "sp", None)
     axes = ("dp", "sp")  # tp is already reduced inside the forward
     sp = int(mesh.shape["sp"])
-    specs = param_specs(cfg)
+    specs = param_specs(cfg, _n_experts(mesh, cfg))
     pspecs = {k: s for k, (_, s) in specs.items()}
 
     def step(params, x):
@@ -180,7 +259,90 @@ def make_train_step(
 
 
 def shard_params(params: dict, mesh: Mesh, cfg: ModelConfig) -> dict:
+    specs = param_specs(cfg, _n_experts(mesh, cfg))
     return {
-        k: jax.device_put(v, NamedSharding(mesh, param_specs(cfg)[k][1]))
+        k: jax.device_put(v, NamedSharding(mesh, specs[k][1]))
         for k, v in params.items()
     }
+
+
+# ---------------------------------------------------------------------------
+# Flagship v2: the pipelined stack — dp x sp x tp x pp (x ep ≙ tp) in ONE
+# differentiable program.  Stages are PatternFormer blocks sharded over
+# "pp"; microbatches stream through parallel.pipeline_apply, whose ppermute
+# hops sit in the same compiled program as the ring-attention ppermutes
+# (sp), the tensor/expert psums (tp/ep), and the dp/sp gradient sync that
+# falls out of the loss-psum transpose.
+# ---------------------------------------------------------------------------
+
+
+def init_stack_params(
+    key, cfg: ModelConfig, n_stages: int, n_experts: int = 0
+) -> dict[str, jax.Array]:
+    """Per-stage parameters stacked on a leading [n_stages] axis."""
+    keys = jax.random.split(key, n_stages)
+    per = [init_params(k, cfg, n_experts) for k in keys]
+    return {name: jnp.stack([p[name] for p in per]) for name in per[0]}
+
+
+def stack_specs(cfg: ModelConfig, n_experts: int = 0) -> dict[str, P]:
+    return {
+        k: P("pp", *tuple(s)) for k, (_, s) in param_specs(cfg, n_experts).items()
+    }
+
+
+def forward_stack(params: dict, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Single-device reference: apply every stage sequentially."""
+    n_stages = next(iter(params.values())).shape[0]
+    for s in range(n_stages):
+        x = forward_shard({k: v[s] for k, v in params.items()}, x, cfg)
+    return x
+
+
+def make_pipeline_train_step(
+    mesh: Mesh, cfg: ModelConfig, n_micro: int, lr: float = 1e-3
+):
+    """Training step of the pipelined stack over a ("dp","sp","tp","pp")
+    mesh: GPipe microbatching in the forward, full backward through the
+    pipeline's collectives (ppermute transpose), SGD update.
+
+    Returns ``(step, pspecs)``; x is sharded [dp, sp, -] and n_micro must
+    divide its dp-local batch.
+    """
+    from tpu_patterns.parallel.pipeline import pipeline_apply
+
+    pp = int(mesh.shape["pp"])
+    sp = int(mesh.shape["sp"])
+    pspecs = stack_specs(cfg, _n_experts(mesh, cfg))
+
+    def stage_fn(local_stack, xm):
+        lead = next(iter(local_stack.values())).shape[0]
+        if lead != 1:
+            raise ValueError(
+                f"stack has {lead * pp} stages for a pp={pp} mesh; "
+                "n_stages must equal the pp axis size"
+            )
+        local = {k: v[0] for k, v in local_stack.items()}  # shard is [1, ...]
+        return forward_shard(
+            local, xm, cfg, sp_axis="sp", sp_size=sp, tp_axis="tp"
+        )
+
+    def step(stack, x):
+        b = x.shape[0]
+        micro = x.reshape(n_micro, b // n_micro, *x.shape[1:])
+
+        def loss_fn(stack):
+            out = pipeline_apply(stage_fn, stack, micro, "pp", pp)
+            return lax.psum(jnp.sum(out.astype(jnp.float32) ** 2), ("dp", "sp"))
+
+        loss, grads = jax.value_and_grad(loss_fn)(stack)
+        new = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype), stack, grads)
+        return new, loss
+
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(pspecs, P("dp", "sp", None)),
+        out_specs=(pspecs, P()),
+    )
+    return jax.jit(sharded), pspecs

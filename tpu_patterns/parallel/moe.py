@@ -41,6 +41,27 @@ def top1_route(x: jax.Array, wg: jax.Array):
     return onehot, weight
 
 
+def build_dispatch(onehot: jax.Array, cap: int, dtype) -> jax.Array:
+    """[T, n_exp] int32 routing one-hot -> [T, n_exp, C] dispatch tensor:
+    dispatch[t, e, c] = 1 iff token t is slot c of expert e (int32 slot
+    counting, then cast for the MXU einsums)."""
+    pos = jnp.cumsum(onehot, axis=0) - onehot  # [T, n_exp], rank of token
+    slot_idx = jnp.sum(pos * onehot, axis=-1)
+    slot = jax.nn.one_hot(slot_idx, cap, dtype=dtype)
+    return onehot.astype(dtype)[:, :, None] * slot[:, None, :]
+
+
+def build_dispatch_column(onehot: jax.Array, expert, cap: int, dtype) -> jax.Array:
+    """[T, C] dispatch column for ONE expert (possibly a traced index) —
+    what a rank that owns a single expert needs, without materializing the
+    full [T, n_exp, C] tensor build_dispatch produces."""
+    pos = jnp.cumsum(onehot, axis=0) - onehot
+    slot_idx = jnp.sum(pos * onehot, axis=-1)
+    slot = jax.nn.one_hot(slot_idx, cap, dtype=dtype)
+    sel = lax.dynamic_index_in_dim(onehot, expert, axis=1, keepdims=False)
+    return sel.astype(dtype)[:, None] * slot
+
+
 def moe_apply(
     expert_fn,
     expert_params,
@@ -65,13 +86,7 @@ def moe_apply(
         )
 
     onehot, weight = top1_route(x, wg)  # [T, ep] int32, [T]
-    # Slot assignment (int32 counting): position of each token within its
-    # expert's queue.
-    pos = jnp.cumsum(onehot, axis=0) - onehot  # [T, ep], rank of token
-    slot_idx = jnp.sum(pos * onehot, axis=-1)
-    slot = jax.nn.one_hot(slot_idx, cap, dtype=x.dtype)
-    # dispatch[t, exp, c] = 1 iff token t is slot c of expert exp
-    dispatch = onehot.astype(x.dtype)[:, :, None] * slot[:, None, :]
+    dispatch = build_dispatch(onehot, cap, x.dtype)
     expert_in = jnp.einsum("tec,td->ecd", dispatch, x)  # [ep, C, E]
 
     # Each rank collects its expert's slots from every ep rank:
