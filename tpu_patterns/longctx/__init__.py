@@ -16,6 +16,11 @@ from tpu_patterns.longctx.attention import (
     empty_state,
     finalize,
 )
+from tpu_patterns.longctx.flash import (
+    flash_attention,
+    flash_attention_diff,
+    flash_block,
+)
 from tpu_patterns.longctx.ring_attention import ring_attention
 from tpu_patterns.longctx.ulysses import ulysses_attention
 
@@ -25,6 +30,9 @@ __all__ = [
     "combine_blocks",
     "empty_state",
     "finalize",
+    "flash_attention",
+    "flash_attention_diff",
+    "flash_block",
     "ring_attention",
     "ulysses_attention",
 ]

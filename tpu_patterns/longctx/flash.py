@@ -115,6 +115,51 @@ def _kernel(
         o_ref[0] = (acc_scr[:] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention_diff(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: float | None = None,
+    block_q: int = 1024,
+    block_k: int = 1024,
+    interpret: bool = False,
+) -> jax.Array:
+    """Differentiable flash attention: the fused Mosaic kernel on the
+    forward pass, an XLA rematerialized backward (the two paths compute
+    identical math, so the XLA vjp is the exact gradient of the kernel up
+    to float error).  The backward materializes the O(L^2) score tensor —
+    use for training-step composition, not long-context backward scaling.
+    """
+    return flash_attention(
+        q, k, v, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+
+
+def _flash_diff_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = flash_attention(
+        q, k, v, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return out, (q, k, v)
+
+
+def _flash_diff_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    from tpu_patterns.longctx.attention import attention_reference
+
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: attention_reference(q, k, v, causal=causal, scale=scale),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+flash_attention_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
+
+
 def _block_kernel(
     causal: bool,
     scale: float,
