@@ -17,6 +17,7 @@ All pattern chatter goes to stderr; stdout carries only the JSON line.
 from __future__ import annotations
 
 import json
+import os
 import sys
 
 # Public chip specs, decimal GB/s.  HBM bandwidth per chip; ICI is
@@ -97,7 +98,7 @@ def run() -> dict:
     }
 
 
-def main() -> int:
+def _child_main() -> int:
     try:
         out = run()
     except Exception as e:  # never die silently: the driver needs its line
@@ -109,6 +110,66 @@ def main() -> int:
             "error": f"{type(e).__name__}: {e}",
         }
     print(json.dumps(out), flush=True)
+    return 0
+
+
+def main() -> int:
+    """Watchdog wrapper: the measurement runs in a child process.
+
+    A dead device tunnel hangs inside native PJRT code with the GIL held —
+    no Python exception, and SIGALRM handlers never run — so the only
+    reliable timeout is a parent that can SIGKILL.  Without it the driver
+    would wait on this process forever instead of reading its line.
+    """
+    import subprocess
+
+    if os.environ.get("_TPU_PATTERNS_BENCH_CHILD"):
+        return _child_main()
+    try:
+        timeout_s = int(os.environ.get("TPU_PATTERNS_BENCH_TIMEOUT", "900"))
+    except ValueError:
+        timeout_s = 900
+    if timeout_s <= 0:
+        return _child_main()
+
+    def error_line(msg: str) -> str:
+        return json.dumps(
+            {
+                "metric": "bench_error",
+                "value": 0.0,
+                "unit": "",
+                "vs_baseline": 0.0,
+                "error": msg,
+            }
+        )
+
+    env = dict(os.environ, _TPU_PATTERNS_BENCH_CHILD="1")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            stdout=subprocess.PIPE,
+            text=True,
+            timeout=timeout_s,
+        )
+        lines = (proc.stdout or "").strip().splitlines()
+        out = None
+        if proc.returncode == 0 and lines:
+            try:
+                json.loads(lines[-1])
+                out = lines[-1]
+            except ValueError:
+                out = None
+        if out is None:
+            # Native crash (signal) or garbage on stdout: report it rather
+            # than forwarding a non-JSON line as the headline metric.
+            out = error_line(
+                f"child exited {proc.returncode}; last output "
+                f"{lines[-1][:120] if lines else '<none>'!r}"
+            )
+    except subprocess.TimeoutExpired:
+        out = error_line(f"bench exceeded {timeout_s}s (device hang?)")
+    print(out, flush=True)
     return 0
 
 

@@ -16,11 +16,6 @@ from tpu_patterns.longctx.attention import (
     empty_state,
     finalize,
 )
-from tpu_patterns.longctx.flash import (
-    flash_attention,
-    flash_attention_diff,
-    flash_block,
-)
 from tpu_patterns.longctx.ring_attention import ring_attention
 from tpu_patterns.longctx.ulysses import ulysses_attention
 
@@ -36,3 +31,15 @@ __all__ = [
     "ring_attention",
     "ulysses_attention",
 ]
+
+_FLASH = {"flash_attention", "flash_attention_diff", "flash_block"}
+
+
+def __getattr__(name):
+    # Lazy: the flash module pulls in the Pallas/Mosaic stack, which the
+    # XLA-only strategies should not pay for (or be broken by) at import.
+    if name in _FLASH:
+        from tpu_patterns.longctx import flash
+
+        return getattr(flash, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
