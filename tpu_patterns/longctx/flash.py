@@ -39,11 +39,15 @@ def _sds(shape, dtype, vma):
 def _online_step(
     causal, scale, block_q, block_k, q_off, k_off,
     iq, ik, q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
+    q_stride=1,
+    k_stride=1,
 ):
     """One (q-block, k-block) online-softmax update against the VMEM
     scratch — the single body both kernels share.  ``q_off``/``k_off`` are
     the global positions of the shards (python 0 for the single-shard
-    kernel, traced SMEM scalars inside the ring)."""
+    kernel, traced SMEM scalars inside the ring); the strides are the
+    global-position step between consecutive shard tokens (sp for the
+    striped layout, 1 otherwise)."""
     # Native-dtype operands (bf16 runs the MXU at full rate; an f32
     # upcast here would cost 8x), f32 accumulation.
     s = jax.lax.dot_general(
@@ -51,12 +55,14 @@ def _online_step(
         preferred_element_type=jnp.float32,
     ) * scale  # [Bq, Bk]
     if causal:
-        q_pos = q_off + iq * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
-        )
-        k_pos = k_off + ik * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
+        q_pos = q_off + (
+            iq * block_q
+            + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        ) * q_stride
+        k_pos = k_off + (
+            ik * block_k
+            + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        ) * k_stride
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
     m_prev = m_scr[:, 0:1]  # [Bq, 1]
     m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -165,7 +171,7 @@ def _block_kernel(
     scale: float,
     block_q: int,
     block_k: int,
-    off_ref,  # SMEM [2]: global (q, k) position offsets of these shards
+    off_ref,  # SMEM [4]: (q_off, k_off, q_stride, k_stride) of the shards
     q_ref,
     k_ref,
     v_ref,
@@ -187,13 +193,16 @@ def _block_kernel(
         _online_step(
             causal, scale, block_q, block_k, off_ref[0], off_ref[1],
             iq, ik, q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
+            q_stride=off_ref[2],
+            k_stride=off_ref[3],
         )
 
     if causal:
         # Shard offsets are traced, so the diagonal skip is a dynamic
         # predicate (pl.when on a traced bool) rather than a static branch.
         pl.when(
-            off_ref[0] + (iq + 1) * block_q - 1 >= off_ref[1] + ik * block_k
+            off_ref[0] + ((iq + 1) * block_q - 1) * off_ref[2]
+            >= off_ref[1] + ik * block_k * off_ref[3]
         )(_body)
     else:
         _body()
@@ -216,11 +225,14 @@ def flash_block(
     block_q: int = 1024,
     block_k: int = 1024,
     interpret: bool = False,
+    pos_stride: jax.Array | int = 1,
 ):
     """Fused ``attention.block_attention``: returns the (o, m, l) partial
     triple (o unnormalized f32 [Lq, H, D]; m, l f32 [H, Lq]) for
     ``attention.combine_blocks``.  ``q_off``/``k_off`` are the global
-    sequence positions of these shards (traced values inside the ring).
+    sequence positions of these shards (traced values inside the ring);
+    ``pos_stride`` is the position step between consecutive shard tokens
+    (sp for the striped layout).
     """
     lq, h, d = q.shape
     lk = k.shape[0]
@@ -231,7 +243,14 @@ def flash_block(
             f"block sizes ({bq}, {bk}) must divide the shard lengths ({lq}, {lk})"
         )
     qt, kt, vt = (a.swapaxes(0, 1) for a in (q, k, v))
-    offs = jnp.stack([q_off, k_off]).astype(jnp.int32)
+    offs = jnp.stack(
+        [
+            jnp.asarray(q_off),
+            jnp.asarray(k_off),
+            jnp.asarray(pos_stride),
+            jnp.asarray(pos_stride),
+        ]
+    ).astype(jnp.int32)
     vma = getattr(jax.typeof(q), "vma", None)
 
     o, m, l = pl.pallas_call(

@@ -52,11 +52,13 @@ def ring_attention(
     scale: float | None = None,
     block_impl: str = "xla",
     interpret: bool = False,
+    layout: str = "contiguous",
 ) -> jax.Array:
     """Full attention over the global sequence; call inside ``shard_map``.
 
     q, k, v: [L_local, H, D] shards of a [L_local*axis_size, H, D] global
-    sequence, sharded contiguously over ``axis_name``.
+    sequence, sharded over ``axis_name`` per ``layout`` (contiguous
+    blocks by default, round-robin stripes with layout="striped").
 
     ``block_impl`` selects the per-step compute: "xla"
     (attention.block_attention, the calibration twin) or "pallas" (the
@@ -65,20 +67,39 @@ def ring_attention(
     ``check_vma=False`` on the enclosing shard_map — the HLO-interpreter
     discharge cannot track varying manual axes (same limitation as
     comm.onesided.ring_put).
+
+    ``layout`` is how global sequence positions map to shards:
+    * "contiguous" — shard r holds tokens [r*L_local, (r+1)*L_local);
+    * "striped"    — shard r holds tokens r, r+sp, r+2sp, ... (token i of
+      the shard has global position r + i*sp).  For causal runs this
+      balances the mask across ring steps — with contiguous shards, step t
+      gives ~half the ranks a fully-masked (wasted) block, while striped
+      blocks are all ~half-unmasked.  The caller stripes/unstripes the
+      data (x_global[r::sp] per shard).
     """
     if block_impl not in ("xla", "pallas"):
         raise ValueError(f"unknown block_impl {block_impl!r}")
+    if layout not in ("contiguous", "striped"):
+        raise ValueError(f"unknown layout {layout!r}")
     if axis_size == 1:
         return att.attention_reference(q, k, v, causal=causal, scale=scale)
 
     r = lax.axis_index(axis_name)
     lq, lk = q.shape[0], k.shape[0]
-    q_pos = r * lq + jnp.arange(lq)
+    striped = layout == "striped"
+    if striped:
+        q_off, stride = r, axis_size
+    else:
+        q_off, stride = r * lq, 1
+    q_pos = q_off + jnp.arange(lq) * stride
+
+    def kv_off(kv_rank):
+        return kv_rank if striped else kv_rank * lk
 
     def mask_for(kv_rank):
         if not causal:
             return None
-        return att.causal_mask(q_pos, kv_rank * lk + jnp.arange(lk))
+        return att.causal_mask(q_pos, kv_off(kv_rank) + jnp.arange(lk) * stride)
 
     def absorb(state, t, kb, vb):
         # After t forward ring shifts, this device holds the K/V shard that
@@ -89,11 +110,12 @@ def ring_attention(
 
             block = flash_block(
                 q, kb, vb,
-                q_off=r * lq,
-                k_off=kv_rank * lk,
+                q_off=q_off,
+                k_off=kv_off(kv_rank),
                 causal=causal,
                 scale=scale,
                 interpret=interpret,
+                pos_stride=stride,
             )
         else:
             block = att.block_attention(
