@@ -318,3 +318,20 @@ def test_ring_attention_grad_finite(mesh1d):
     for g in grads:
         assert np.isfinite(np.asarray(g)).all()
         assert float(jnp.max(jnp.abs(g))) > 0.0
+
+
+@pytest.mark.parametrize("name", ["ring_pallas", "ring_striped"])
+def test_pattern_runner_ring_variants(mesh1d, name):
+    """The fused-kernel and striped-layout ring variants run through the
+    measured pattern with the same reference-match gate."""
+    from tpu_patterns.core.results import Verdict
+    from tpu_patterns.longctx.pattern import LongCtxConfig, run_longctx
+
+    cfg = LongCtxConfig(
+        seq=64, heads=8, head_dim=16, reps=2, warmup=1,
+        strategies=("ring", name),
+    )
+    recs = run_longctx(mesh1d, cfg)
+    assert [r.mode for r in recs] == ["ring", name, "agreement"]
+    for r in recs:
+        assert r.verdict is Verdict.SUCCESS, (r.mode, r.notes)

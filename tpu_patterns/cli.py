@@ -402,10 +402,13 @@ def build_parser() -> argparse.ArgumentParser:
     add_config_args(lc, LongCtxConfig, skip=("strategies",))
     lc.add_argument(
         "--strategy",
-        choices=("ring", "ulysses", "flash", "both"),
+        choices=(
+            "ring", "ring_pallas", "ring_striped", "ulysses", "flash", "both"
+        ),
         default="both",
         help="manual-ring vs library-collective lineage (≙ ring vs -a); "
-        "flash = fused Mosaic kernel, single-device",
+        "ring_pallas = fused per-step kernel, ring_striped = load-balanced "
+        "causal layout, flash = fused single-device kernel",
     )
     _add_mesh_args(lc)
 

@@ -127,10 +127,14 @@ def finalize(state) -> jax.Array:
 
 
 @functools.lru_cache(maxsize=64)
-def _sharded_launcher(attn_fn, mesh, axis_name: str, causal: bool, scale):
+def _sharded_launcher(
+    attn_fn, mesh, axis_name: str, causal: bool, scale, check_vma: bool = True
+):
     """One jitted shard_map program per (strategy, mesh, axis, flags) — the
     cache makes repeated run_sharded calls hit XLA's compiled program
-    instead of retracing a fresh closure each time."""
+    instead of retracing a fresh closure each time.  ``check_vma=False``
+    is for strategies whose interpret-mode pallas discharge cannot track
+    varying manual axes (see ring_attention)."""
     from jax.sharding import PartitionSpec as P
 
     spec = P(axis_name, None, None)
@@ -146,6 +150,7 @@ def _sharded_launcher(attn_fn, mesh, axis_name: str, causal: bool, scale):
             mesh=mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,
+            check_vma=check_vma,
         )
     )
 

@@ -45,11 +45,37 @@ def flash_local(q, k, v, axis_name=None, axis_size=1, causal=False, scale=None):
     )
 
 
+def ring_pallas(q, k, v, axis_name=None, axis_size=1, causal=False, scale=None):
+    """Ring attention with the fused flash_block per-step kernel."""
+    from tpu_patterns.runtime import use_interpret
+
+    return ring_attention(
+        q, k, v, axis_name, axis_size, causal=causal, scale=scale,
+        block_impl="pallas", interpret=use_interpret(),
+    )
+
+
+def ring_striped(q, k, v, axis_name=None, axis_size=1, causal=False, scale=None):
+    """Ring attention over the striped (load-balanced causal) layout;
+    shards must hold tokens r::sp (run_longctx stripes/unstripes)."""
+    return ring_attention(
+        q, k, v, axis_name, axis_size, causal=causal, scale=scale,
+        layout="striped",
+    )
+
+
 STRATEGIES = {
     "ring": ring_attention,
+    "ring_pallas": ring_pallas,
+    "ring_striped": ring_striped,
     "ulysses": ulysses_attention,
     "flash": flash_local,
 }
+# interpret-mode pallas discharge cannot track varying manual axes
+# (ring_attention docstring); these need check_vma=False on the shard_map
+VMA_OFF = {"ring_pallas"}
+# these expect shards in the striped token layout (r::sp)
+STRIPED = {"ring_striped"}
 
 
 @dataclasses.dataclass
@@ -105,6 +131,19 @@ def reference_blockwise(q, k, v, causal: bool) -> np.ndarray:
             state = att.combine_blocks(state, chunk(qc, kc, vc, q0, k0))
         outs.append(np.asarray(att.finalize(state)))
     return np.concatenate(outs, axis=0)
+
+
+def _stripe(a: np.ndarray, sp: int) -> np.ndarray:
+    """Global token order -> striped shard order (shard r = tokens r::sp)."""
+    return np.concatenate([a[r::sp] for r in range(sp)])
+
+
+def _unstripe(a: np.ndarray, sp: int) -> np.ndarray:
+    out = np.empty_like(a)
+    lq = a.shape[0] // sp
+    for r in range(sp):
+        out[r::sp] = a[r * lq : (r + 1) * lq]
+    return out
 
 
 def _tolerance(cfg: LongCtxConfig) -> float:
@@ -167,8 +206,17 @@ def run_longctx(
         body = functools.partial(
             strat, axis_name=axis, axis_size=sp, causal=cfg.causal
         )
+        vma = name not in VMA_OFF
+        striped = name in STRIPED and sp > 1
+        if striped:
+            qs, ks, vs = (
+                jax.device_put(_stripe(np.asarray(a), sp), sharding)
+                for a in (q, k, v)
+            )
+        else:
+            qs, ks, vs = q, k, v
         # the shared (lru-cached) launcher: identical program across calls
-        fn = att._sharded_launcher(strat, mesh, axis, cfg.causal, None)
+        fn = att._sharded_launcher(strat, mesh, axis, cfg.causal, None, vma)
         # Amortized chain: feed the output back as q (shapes match), a
         # data dependence XLA cannot elide (core/timing.py discipline).
         chained = jax.jit(
@@ -181,22 +229,25 @@ def run_longctx(
                 mesh=mesh,
                 in_specs=(spec, spec, spec, P()),
                 out_specs=P(axis),
+                check_vma=vma,
             )
         )
 
-        def build_chain(ki: int, _c=chained):
-            return lambda: _c(q, k, v, jnp.int32(ki))
+        def build_chain(ki: int, _c=chained, _q=qs, _k=ks, _v=vs):
+            return lambda: _c(_q, _k, _v, jnp.int32(ki))
 
         res = timing.measure_chain(
             build_chain,
             reps=cfg.reps,
             warmup=cfg.warmup,
             label=name,
-            direct_fn=lambda _f=fn: _f(q, k, v),
+            direct_fn=lambda _f=fn, _q=qs, _k=ks, _v=vs: _f(_q, _k, _v),
             ops_per_iter=timing.CHAIN_UNROLL,
         )
         tflops = flops / res.per_op_ns / 1e3  # FLOP/ns == GFLOP/s; /1e3 -> TFLOP/s
-        out = np.asarray(fn(q, k, v), np.float32)
+        out = np.asarray(fn(qs, ks, vs), np.float32)
+        if striped:
+            out = _unstripe(out, sp)  # back to global token order
         outputs[name] = out
         err = float(np.max(np.abs(out - ref_np)))
         data_ok = err <= tol
