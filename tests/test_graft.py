@@ -37,8 +37,36 @@ class TestGraftEntry:
 
     def test_dryrun_too_many_devices(self, devices):
         graft = _load("__graft_entry__")
+        # Backend is live at 8 CPU devices under pytest: provisioning is
+        # impossible, so both requests get the honest shortfall error.
         with pytest.raises(RuntimeError, match="only"):
             graft.dryrun_multichip(1024)
+        with pytest.raises(RuntimeError, match="only"):
+            graft.dryrun_multichip(16)
+
+    def test_dryrun_provisioning_cap_fresh_process(self):
+        # In a fresh process the dryrun provisions virtual CPU devices on
+        # demand; absurd requests must fail fast BEFORE any compile and
+        # before mutating global config.
+        import os
+
+        env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+        env.pop("JAX_PLATFORMS", None)
+        env.pop("XLA_FLAGS", None)
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import __graft_entry__ as g; g.dryrun_multichip(1024)",
+            ],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=120,
+            cwd=ROOT,
+        )
+        assert proc.returncode != 0
+        assert "refusing to provision" in proc.stderr
 
 
 class TestBench:
