@@ -292,13 +292,22 @@ def measure_chain(
     """
     import numpy as np
 
+    import jax
+
+    def fetch(x):
+        # Force a host fetch of every leaf (remote runtimes complete the
+        # fetch round trip here, not at block_until_ready); leaf-wise so
+        # chains returning mixed-shape tuples (e.g. a dispatch group of C
+        # and H2D commands) materialize without a ragged-array error.
+        return jax.tree_util.tree_map(np.asarray, x)
+
     mode = mode or default_timing_mode()
     if mode is TimingMode.DIRECT:
         fn = direct_fn
         per_iter_ops = 1
         if fn is None:
             chain1 = build_chain(1)
-            fn = lambda: np.asarray(chain1())  # noqa: E731
+            fn = lambda: fetch(chain1())  # noqa: E731
             per_iter_ops = ops_per_iter
         res = min_over_reps(
             fn, reps=reps, warmup=warmup, barrier=barrier, label=label
@@ -311,7 +320,7 @@ def measure_chain(
     def timed(k: int, w: int, n_reps: int | None = None) -> TimingResult:
         f = build_chain(k)
         return min_over_reps(
-            lambda: np.asarray(f()), reps=n_reps or reps, warmup=w,
+            lambda: fetch(f()), reps=n_reps or reps, warmup=w,
             barrier=barrier, label=f"{label}[k={k}]",
         )
 
