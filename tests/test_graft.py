@@ -100,3 +100,29 @@ class TestBench:
         assert set(rec) >= {"metric", "value", "unit", "vs_baseline"}
         assert rec["metric"] != "bench_error", rec
         assert rec["value"] > 0
+
+    def test_bench_preflight_failure_is_fast_and_distinguishable(self):
+        # A broken device backend must cost ~2 preflight deadlines, not the
+        # whole measurement budget, and the error must say "preflight".
+        import os
+        import time
+
+        env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+        env["JAX_PLATFORMS"] = "no_such_platform"  # preflight child dies
+        env["TPU_PATTERNS_BENCH_PREFLIGHT"] = "20"
+        env["TPU_PATTERNS_BENCH_TIMEOUT"] = "900"
+        t0 = time.monotonic()
+        proc = subprocess.run(
+            [sys.executable, str(ROOT / "bench.py")],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=120,
+            cwd=ROOT,
+        )
+        elapsed = time.monotonic() - t0
+        assert proc.returncode == 0
+        rec = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert rec["metric"] == "bench_error"
+        assert "preflight" in rec["error"]
+        assert elapsed < 60, f"preflight failure took {elapsed:.0f}s"
