@@ -122,6 +122,10 @@ def _preflight_main() -> int:
     round-1 failure mode where one dead tunnel zeroed the round's perf
     evidence.
     """
+    from tpu_patterns.runtime import setup_jax
+
+    setup_jax()  # persistent compile cache — warm preflights cost seconds
+
     import jax
     import jax.numpy as jnp
 

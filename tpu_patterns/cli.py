@@ -247,8 +247,35 @@ def _cmd_longctx(args, writer: ResultWriter) -> None:
         tol=args.tol,
         strategies=strategies,
         seed=args.seed,
+        grad=args.grad,
     )
     run_longctx(mesh, cfg, writer)
+
+
+def _cmd_flagship(args, writer: ResultWriter) -> None:
+    import dataclasses
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tpu_patterns.models.transformer import FlagshipConfig, run_flagship
+
+    n = args.devices or len(jax.devices())
+    dp, tp = args.dp, args.tp
+    if n % (dp * tp):
+        raise SystemExit(f"devices {n} not divisible by dp*tp = {dp * tp}")
+    sp = n // (dp * tp)
+    mesh = Mesh(
+        np.array(jax.devices()[:n]).reshape(dp, sp, tp), ("dp", "sp", "tp")
+    )
+    cfg = FlagshipConfig(
+        **{
+            f.name: getattr(args, f.name)
+            for f in dataclasses.fields(FlagshipConfig)
+        }
+    )
+    run_flagship(mesh, cfg, writer)
 
 
 def _cmd_miniapps(args, writer: ResultWriter) -> None:
@@ -412,6 +439,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     _add_mesh_args(lc)
 
+    fl = sub.add_parser(
+        "flagship", help="PatternFormer train-step benchmark (fwd+bwd+SGD)"
+    )
+    from tpu_patterns.models.transformer import FlagshipConfig
+
+    add_config_args(fl, FlagshipConfig)
+    fl.add_argument("--devices", type=int, default=0, help="0 = all")
+    fl.add_argument("--dp", type=int, default=1)
+    fl.add_argument("--tp", type=int, default=1, help="remaining devices go to sp")
+
     m = sub.add_parser("miniapps", help="run every typed variant (≙ ctest)")
     m.add_argument("--devices", type=int, default=0)
     m.add_argument("--elements", type=int, default=0, help="0 = app default")
@@ -441,6 +478,7 @@ def main(argv: list[str] | None = None) -> int:
         "concurrency": _cmd_concurrency,
         "allreduce": _cmd_allreduce,
         "longctx": _cmd_longctx,
+        "flagship": _cmd_flagship,
         "miniapps": _cmd_miniapps,
         "topo": _cmd_topo,
         "interop": _cmd_interop,

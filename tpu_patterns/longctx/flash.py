@@ -27,6 +27,37 @@ from jax.experimental.pallas import tpu as pltpu
 LANES = 128
 NEG_INF = -1e30
 
+# VMEM working-set budget per kernel instance.  v5e/v5p cores have 16 MB;
+# leaving headroom for double-buffered pipeline copies, spills, and the
+# compiler's own temporaries.  Block sizes auto-shrink to fit (a fixed
+# 1024/2048 default would simply fail to compile on smaller-VMEM parts or
+# larger head dims).
+VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def _auto_block(lq: int, lk: int, d: int, in_bytes: int, score_tiles: int,
+                block_q: int, block_k: int) -> tuple[int, int]:
+    """Largest (block_q, block_k) pair <= the requested sizes whose VMEM
+    working set fits the budget.  ``score_tiles`` counts the live f32
+    [bq, bk] temporaries of the kernel body (2 for the forward's s/p, 4
+    for the backward's s/p/dp/ds)."""
+
+    def est(bq: int, bk: int) -> int:
+        score = score_tiles * bq * bk * 4
+        # in/out blocks (q-sized + 2 k-sized inputs, q-sized out) double-
+        # buffered by the pipeline, + f32 accumulator scratch + stats.
+        io = 2 * ((bq + 2 * bk) * d * in_bytes + bq * d * 4)
+        scratch = (bq + bk) * d * 4 + 2 * bq * LANES * 4
+        return score + io + scratch
+
+    bq, bk = min(block_q, lq), min(block_k, lk)
+    while est(bq, bk) > VMEM_BUDGET and max(bq, bk) > 128:
+        if bq >= bk:
+            bq //= 2
+        else:
+            bk //= 2
+    return max(bq, 128) if lq >= 128 else bq, max(bk, 128) if lk >= 128 else bk
+
 
 def _sds(shape, dtype, vma):
     """ShapeDtypeStruct carrying the caller's varying-manual-axes when set
@@ -121,6 +152,228 @@ def _kernel(
         o_ref[0] = (acc_scr[:] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Fused backward: dq / dk / dv as Pallas kernels.
+#
+# Standard flash-attention backward with the softmax row statistics saved
+# from the forward as the logsumexp (lse = m + log l):
+#     P_ij  = exp(s_ij - lse_i)            (recomputed per tile, never stored)
+#     dV_j  = sum_i P_ij^T dO_i
+#     dP_ij = dO_i V_j^T
+#     dS_ij = P_ij * (dP_ij - delta_i),    delta_i = rowsum(dO_i * O_i)
+#     dQ_i  = scale * sum_j dS_ij K_j
+#     dK_j  = scale * sum_i dS_ij^T Q_i
+# Two kernels with opposite loop nests — dq accumulates over k-blocks per
+# q-block, dk/dv accumulate over q-blocks per k-block — each recomputing
+# the score tile (the recompute-over-materialize trade that makes the
+# backward O(L) memory like the forward).  Both take the same SMEM shard
+# offsets/strides as the forward block kernel, so the ring backward reuses
+# them per visiting K/V shard.
+# ---------------------------------------------------------------------------
+
+
+def _score_tile(causal, scale, block_q, block_k, iq, ik, offs_ref,
+                q_ref, k_ref, lse_ref):
+    """Recompute the P tile [Bq, Bk] from saved row statistics."""
+    s = jax.lax.dot_general(
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if causal:
+        q_pos = offs_ref[0] + (
+            iq * block_q
+            + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        ) * offs_ref[2]
+        k_pos = offs_ref[1] + (
+            ik * block_k
+            + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        ) * offs_ref[3]
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    # lse is the GLOBAL logsumexp of the row (finite: every causal row has
+    # at least its own position unmasked), so exp is <= 1 and masked
+    # entries collapse to exactly 0.
+    return jnp.exp(s - lse_ref[0])
+
+
+def _bwd_dq_kernel(causal, scale, block_q, block_k, offs_ref,
+                   q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+    pl.when(ik == 0)(lambda: dq_scr.__setitem__(slice(None), jnp.zeros_like(dq_scr)))
+
+    def _body():
+        p = _score_tile(causal, scale, block_q, block_k, iq, ik, offs_ref,
+                        q_ref, k_ref, lse_ref)
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0])  # [Bq, Bk] f32
+        dq_scr[:] = dq_scr[:] + scale * jax.lax.dot(
+            ds.astype(k_ref.dtype), k_ref[0], preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        pl.when(
+            offs_ref[0] + ((iq + 1) * block_q - 1) * offs_ref[2]
+            >= offs_ref[1] + ik * block_k * offs_ref[3]
+        )(_body)
+    else:
+        _body()
+
+    @pl.when(ik == nk - 1)
+    def _emit():
+        dq_ref[0] = dq_scr[:]
+
+
+def _bwd_dkv_kernel(causal, scale, block_q, block_k, offs_ref,
+                    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr):
+    jk, iq = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    def _zero():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    pl.when(iq == 0)(_zero)
+
+    def _body():
+        p = _score_tile(causal, scale, block_q, block_k, iq, jk, offs_ref,
+                        q_ref, k_ref, lse_ref)
+        pt = p.astype(do_ref.dtype).T  # [Bk, Bq]
+        dv_scr[:] = dv_scr[:] + jax.lax.dot(
+            pt, do_ref[0], preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0])
+        dk_scr[:] = dk_scr[:] + scale * jax.lax.dot(
+            ds.astype(q_ref.dtype).T, q_ref[0], preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        pl.when(
+            offs_ref[0] + ((iq + 1) * block_q - 1) * offs_ref[2]
+            >= offs_ref[1] + jk * block_k * offs_ref[3]
+        )(_body)
+    else:
+        _body()
+
+    @pl.when(iq == nq - 1)
+    def _emit():
+        dk_ref[0] = dk_scr[:]
+        dv_ref[0] = dv_scr[:]
+
+
+def flash_block_bwd(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    do: jax.Array,
+    lse: jax.Array,
+    delta: jax.Array,
+    q_off: jax.Array | int = 0,
+    k_off: jax.Array | int = 0,
+    causal: bool = False,
+    scale: float | None = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+    pos_stride: jax.Array | int = 1,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Gradient contributions of one (q-shard, kv-shard) pair.
+
+    q, do: [Lq, H, D]; k, v: [Lk, H, D]; lse, delta: [H, Lq] f32 (global
+    row statistics: logsumexp of the full row and rowsum(dO*O)).  Returns
+    f32 (dq, dk, dv) — the caller sums contributions across kv shards (dq)
+    / q shards (dk, dv) and casts.  Offsets/strides address global
+    positions exactly as :func:`flash_block`.
+    """
+    lq, h, d = q.shape
+    lk = k.shape[0]
+    scale = float(scale) if scale is not None else d**-0.5
+    bq, bk = _auto_block(lq, lk, d, q.dtype.itemsize, 4, block_q, block_k)
+    if lq % bq or lk % bk:
+        raise ValueError(
+            f"block sizes ({bq}, {bk}) must divide the shard lengths ({lq}, {lk})"
+        )
+    qt, kt, vt, dot = (a.swapaxes(0, 1) for a in (q, k, v, do))
+    lse3 = lse[..., None].astype(jnp.float32)  # [H, Lq, 1]
+    delta3 = delta[..., None].astype(jnp.float32)
+    offs = jnp.stack(
+        [
+            jnp.asarray(q_off),
+            jnp.asarray(k_off),
+            jnp.asarray(pos_stride),
+            jnp.asarray(pos_stride),
+        ]
+    ).astype(jnp.int32)
+    vma = getattr(jax.typeof(q), "vma", None)
+
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    qspec = pl.BlockSpec((1, bq, d), lambda h, iq, ik: (h, iq, 0))
+    kspec = pl.BlockSpec((1, bk, d), lambda h, iq, ik: (h, ik, 0))
+    row_q = pl.BlockSpec((1, bq, 1), lambda h, iq, ik: (h, iq, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal, scale, bq, bk),
+        grid=(h, lq // bq, lk // bk),
+        in_specs=[smem, qspec, kspec, kspec, qspec, row_q, row_q],
+        out_specs=pl.BlockSpec((1, bq, d), lambda h, iq, ik: (h, iq, 0)),
+        out_shape=_sds((h, lq, d), jnp.float32, vma),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(offs, qt, kt, vt, dot, lse3, delta3)
+
+    # dk/dv: transposed nest — grid walks q-blocks innermost per k-block.
+    qspec_t = pl.BlockSpec((1, bq, d), lambda h, jk, iq: (h, iq, 0))
+    kspec_t = pl.BlockSpec((1, bk, d), lambda h, jk, iq: (h, jk, 0))
+    row_q_t = pl.BlockSpec((1, bq, 1), lambda h, jk, iq: (h, iq, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal, scale, bq, bk),
+        grid=(h, lk // bk, lq // bq),
+        in_specs=[smem, qspec_t, kspec_t, kspec_t, qspec_t, row_q_t, row_q_t],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda h, jk, iq: (h, jk, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, jk, iq: (h, jk, 0)),
+        ],
+        out_shape=[
+            _sds((h, lk, d), jnp.float32, vma),
+            _sds((h, lk, d), jnp.float32, vma),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(offs, qt, kt, vt, dot, lse3, delta3)
+    return dq.swapaxes(0, 1), dk.swapaxes(0, 1), dv.swapaxes(0, 1)
+
+
+def _row_stats(o_unnorm, m, l):
+    """(out, lse) from the block kernel's partial triple: normalize the
+    accumulator; lse = m + log l with fully-masked rows pinned to 0 (their
+    exp(s - 0) = exp(NEG_INF) underflows to exactly 0 in the backward)."""
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    out = o_unnorm / jnp.swapaxes(safe_l, 0, 1)[..., None]
+    lse = jnp.where(l == 0.0, 0.0, m + jnp.log(safe_l))
+    return out, lse
+
+
+def _delta(do, out):
+    """delta_i = rowsum(dO_i * O_i): [H, Lq] f32 (XLA; one fused pass)."""
+    return jnp.einsum(
+        "qhd,qhd->hq",
+        do.astype(jnp.float32),
+        out.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention_diff(
     q: jax.Array,
@@ -132,11 +385,11 @@ def flash_attention_diff(
     block_k: int = 1024,
     interpret: bool = False,
 ) -> jax.Array:
-    """Differentiable flash attention: the fused Mosaic kernel on the
-    forward pass, an XLA rematerialized backward (the two paths compute
-    identical math, so the XLA vjp is the exact gradient of the kernel up
-    to float error).  The backward materializes the O(L^2) score tensor —
-    use for training-step composition, not long-context backward scaling.
+    """Differentiable flash attention, fused both directions: the Mosaic
+    forward kernel plus the Pallas dq/dk/dv backward (flash_block_bwd) —
+    O(L) memory end to end, never materializing the [H, L, L] score
+    tensor.  The forward saves (q, k, v, out, lse); the backward
+    recomputes score tiles from lse per block.
     """
     return flash_attention(
         q, k, v, causal=causal, scale=scale,
@@ -145,22 +398,23 @@ def flash_attention_diff(
 
 
 def _flash_diff_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = flash_attention(
-        q, k, v, causal=causal, scale=scale,
+    o_un, m, l = flash_block(
+        q, k, v, 0, 0, causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
-    return out, (q, k, v)
+    out, lse = _row_stats(o_un, m, l)
+    out = out.astype(q.dtype)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_diff_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    from tpu_patterns.longctx.attention import attention_reference
-
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: attention_reference(q, k, v, causal=causal, scale=scale),
-        q, k, v,
+    q, k, v, out, lse = res
+    dq, dk, dv = flash_block_bwd(
+        q, k, v, g, lse, _delta(g, out),
+        causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
     )
-    return vjp(g)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 flash_attention_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
@@ -237,7 +491,7 @@ def flash_block(
     lq, h, d = q.shape
     lk = k.shape[0]
     scale = float(scale) if scale is not None else d**-0.5
-    bq, bk = min(block_q, lq), min(block_k, lk)
+    bq, bk = _auto_block(lq, lk, d, q.dtype.itemsize, 2, block_q, block_k)
     if lq % bq or lk % bk:
         raise ValueError(
             f"block sizes ({bq}, {bk}) must divide the shard lengths ({lq}, {lk})"
@@ -306,7 +560,7 @@ def flash_attention(
     lq, h, d = q.shape
     lk = k.shape[0]
     scale = float(scale) if scale is not None else d**-0.5
-    bq, bk = min(block_q, lq), min(block_k, lk)
+    bq, bk = _auto_block(lq, lk, d, q.dtype.itemsize, 2, block_q, block_k)
     if lq % bq or lk % bk:
         raise ValueError(
             f"block sizes ({bq}, {bk}) must divide the sequence lengths "

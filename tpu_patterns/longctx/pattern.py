@@ -34,14 +34,17 @@ from tpu_patterns.longctx.ulysses import ulysses_attention
 
 def flash_local(q, k, v, axis_name=None, axis_size=1, causal=False, scale=None):
     """The fused Mosaic kernel as a single-device "strategy": the hot-op
-    contrast to the XLA lineages (sp must be 1 — it has no comm)."""
-    from tpu_patterns.longctx.flash import flash_attention
+    contrast to the XLA lineages (sp must be 1 — it has no comm).  The
+    differentiable wrapper costs nothing forward and gives the grad runner
+    the fused Pallas backward."""
+    from tpu_patterns.longctx.flash import flash_attention_diff
     from tpu_patterns.runtime import use_interpret
 
     if axis_size != 1:
         raise ValueError("flash strategy is single-device (sp must be 1)")
-    return flash_attention(
-        q, k, v, causal=causal, scale=scale, interpret=use_interpret()
+    scale = float(scale) if scale is not None else None
+    return flash_attention_diff(
+        q, k, v, causal, scale, 1024, 1024, use_interpret()
     )
 
 
@@ -91,6 +94,10 @@ class LongCtxConfig:
     tol: float = 1e-4  # elementwise |err| gate vs f32 reference (dtype-scaled)
     strategies: tuple = ("ring", "ulysses")
     seed: int = 0
+    # measure the BACKWARD too: each rep runs fwd+bwd (value_and_grad of a
+    # fixed-cotangent objective), validated against the XLA reference
+    # gradients; TFLOP/s counts the standard fwd 2 + bwd 5 matmul model
+    grad: bool = False
 
 
 def attention_flops(seq: int, heads: int, head_dim: int, causal: bool) -> float:
@@ -195,14 +202,185 @@ class _Gates:
         )
 
 
-def _gates(cfg: LongCtxConfig, ref: np.ndarray) -> _Gates:
-    eps = _eps_effective(cfg)
+def _gates(cfg: LongCtxConfig, ref: np.ndarray, depth: int = 1) -> _Gates:
+    """``depth`` scales the allowances for deeper compute chains: the
+    backward chains two more matmul stages (dS from P and dP, then dQ/dK
+    from dS) than the forward, so its rounding error compounds — measured
+    ~2x the forward's worst ratio on TPU bf16; depth=4 gives the same 2-4x
+    headroom the forward gates carry."""
+    eps = _eps_effective(cfg) * depth
     ref_rms = _rms(ref)
     return _Gates(
         rtol=min(8 * eps, 0.25),
         atol=max(cfg.tol, min(4 * eps, 0.125) * ref_rms),
         rms=max(cfg.tol, min(4 * eps, 0.125) * ref_rms),
     )
+
+
+# fwd = 2 matmuls (QK^T, PV); bwd = 5 (dV, dP, dS->dQ, dS->dK, one score
+# recompute) — the standard flash accounting.  The fused backward's second
+# score recompute (one per kernel) is NOT counted: reported TFLOP/s is
+# useful work, hardware does slightly more.
+GRAD_FLOP_MULT = 3.5
+
+
+def _grad_gates(cfg: LongCtxConfig, ref: np.ndarray) -> _Gates:
+    """Gates for gradient validation.  Two differences from the forward:
+    the backward chains two more matmul stages, so eps gets 4x headroom
+    (depth); and the atol term scales with max|ref| rather than rms(ref) —
+    gradient rows that are exactly zero in the reference (e.g. causal
+    dq[0]: token 0 attends only to itself, so its dS cancels analytically)
+    come out of the kernel as dS = P*(dP - delta) where dP (in-kernel MXU)
+    and delta (XLA einsum) round independently: the absolute residue is
+    eps * the row's operand scale, which tracks the tensor's extremes,
+    not its bulk.  Measured on TPU f32 L=4096: err 0.019 at a ref-zero
+    element vs rms_ref 0.06 — an rms-scaled atol flags exactly the rows
+    the kernel cancels correctly-to-rounding."""
+    eps = _eps_effective(cfg) * 4
+    ref_scale = float(np.max(np.abs(ref)))
+    return _Gates(
+        rtol=min(8 * eps, 0.25),
+        atol=max(cfg.tol, min(2 * eps, 0.125) * ref_scale),
+        rms=max(cfg.tol, min(4 * eps, 0.125) * _rms(ref)),
+    )
+
+
+def run_longctx_grad(
+    mesh: Mesh,
+    cfg: LongCtxConfig,
+    writer: ResultWriter,
+) -> list[Record]:
+    """Measured fwd+bwd: per strategy, time value_and_grad of a fixed-
+    cotangent objective and gate (dq, dk, dv) against the XLA reference
+    gradients — the backward twin of :func:`run_longctx`."""
+    from tpu_patterns.runtime import use_interpret
+
+    axis = mesh.axis_names[0]
+    sp = int(np.prod(mesh.devices.shape))
+    dtype = jnp.dtype(cfg.dtype)
+    shape = (cfg.seq, cfg.heads, cfg.head_dim)
+    keys = jax.random.split(jax.random.key(cfg.seed), 4)
+    sharding = NamedSharding(mesh, P(axis, None, None))
+    q, k, v = (
+        jax.device_put(jax.random.normal(kk, shape, dtype), sharding)
+        for kk in keys[:3]
+    )
+    ct = jax.random.normal(keys[3], shape, jnp.float32)
+    jax.block_until_ready((q, k, v))
+
+    flops = attention_flops(
+        cfg.seq, cfg.heads, cfg.head_dim, cfg.causal
+    ) * GRAD_FLOP_MULT
+    writer.progress(
+        f"longctx grad: sp={sp}, seq={cfg.seq}, heads={cfg.heads}, "
+        f"head_dim={cfg.head_dim}, causal={cfg.causal}, dtype={cfg.dtype}"
+    )
+
+    # Reference gradients: XLA vjp of the materializing reference in f32
+    # (O(L^2) scores on device — validation only, not the measured path).
+    ref_grads = jax.jit(
+        jax.grad(
+            lambda a, b, c: jnp.sum(
+                att.attention_reference(
+                    a.astype(jnp.float32),
+                    b.astype(jnp.float32),
+                    c.astype(jnp.float32),
+                    causal=cfg.causal,
+                )
+                * ct
+            ),
+            argnums=(0, 1, 2),
+        )
+    )(q, k, v)
+    ref_np = tuple(np.asarray(g, np.float32) for g in ref_grads)
+    gates = tuple(_grad_gates(cfg, g) for g in ref_np)
+
+    interp = use_interpret()
+    records = []
+    for name in cfg.strategies:
+        strat = STRATEGIES[name]
+        vma = name not in VMA_OFF or not interp
+        striped = name in STRIPED and sp > 1
+        if striped:
+            qs, ks, vs, cts = (
+                jax.device_put(_stripe(np.asarray(a), sp), sharding)
+                for a in (q, k, v, ct)
+            )
+        else:
+            qs, ks, vs, cts = q, k, v, jax.device_put(ct, sharding)
+        fwd = att._sharded_launcher(strat, mesh, axis, cfg.causal, None, vma)
+        gfn = jax.jit(
+            jax.grad(
+                lambda a, b, c, _f=fwd, _ct=cts: jnp.sum(
+                    _f(a, b, c).astype(jnp.float32) * _ct
+                ),
+                argnums=(0, 1, 2),
+            )
+        )
+        # Chain on dq (same shape/dtype as q): each iteration is one full
+        # fwd+bwd with a data dependence XLA cannot elide.
+        chained = jax.jit(
+            lambda a, b, c, n, _g=gfn: jnp.sum(
+                timing.unrolled_chain(
+                    lambda x: _g(x, b, c)[0], a, n
+                ).astype(jnp.float32)
+            )[None]
+        )
+
+        def build_chain(ki: int, _c=chained, _q=qs, _k=ks, _v=vs):
+            return lambda: _c(_q, _k, _v, jnp.int32(ki))
+
+        res = timing.measure_chain(
+            build_chain,
+            reps=cfg.reps,
+            warmup=cfg.warmup,
+            label=f"{name}_grad",
+            direct_fn=lambda _g=gfn, _q=qs, _k=ks, _v=vs: _g(_q, _k, _v),
+            ops_per_iter=timing.CHAIN_UNROLL,
+        )
+        tflops = flops / res.per_op_ns / 1e3
+        got = gfn(qs, ks, vs)
+        got_np = []
+        for g in got:
+            g = np.asarray(g, np.float32)
+            got_np.append(_unstripe(g, sp) if striped else g)
+        violation = max(
+            gt.check_elem(g - r, r)
+            for gt, g, r in zip(gates, got_np, ref_np)
+        )
+        # per-gradient rms check: each of dq/dk/dv against ITS OWN gate
+        # (their reference magnitudes differ; the largest gate must not
+        # absolve the smallest gradient)
+        rms_ratio = max(
+            _rms(g - r) / gt.rms for gt, g, r in zip(gates, got_np, ref_np)
+        )
+        err_rms = max(_rms(g - r) for g, r in zip(got_np, ref_np))
+        data_ok = violation <= 1.0 and rms_ratio <= 1.0
+        perf_ok = cfg.min_tflops < 0 or tflops >= cfg.min_tflops
+        writer.metric(f"{name} attention grad", tflops, "TFLOP/s")
+        rec = Record(
+            pattern="longctx",
+            mode=f"{name}_grad",
+            commands=f"sp{sp} L{cfg.seq} H{cfg.heads} D{cfg.head_dim} grad"
+            + (" causal" if cfg.causal else ""),
+            metrics={
+                "tflops": tflops,
+                "min_time_us": res.us(),
+                "flops": flops,
+                "gate_violation": violation,
+                "rms_err": err_rms,
+                "checksum_ok": float(data_ok),
+            },
+            verdict=Verdict.SUCCESS if (data_ok and perf_ok) else Verdict.FAILURE,
+        )
+        if not data_ok:
+            rec.notes.append(
+                f"grad elem violation {violation:.2f}x / rms {err_rms:.2e}"
+            )
+        if not perf_ok:
+            rec.notes.append(f"{tflops:.3f} TFLOP/s below floor {cfg.min_tflops}")
+        records.append(writer.record(rec))
+    return records
 
 
 def run_longctx(
@@ -226,6 +404,8 @@ def run_longctx(
         raise ValueError(f"heads {cfg.heads} not divisible by sp={sp} (ulysses)")
     if "flash" in cfg.strategies and sp != 1:
         raise ValueError("flash strategy is single-device (needs sp=1)")
+    if cfg.grad:
+        return run_longctx_grad(mesh, cfg, writer)
 
     dtype = jnp.dtype(cfg.dtype)
     shape = (cfg.seq, cfg.heads, cfg.head_dim)

@@ -32,6 +32,8 @@ ranks); the zero-ed statistics contribute nothing.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -40,6 +42,142 @@ from jax.sharding import Mesh
 
 from tpu_patterns.comm.ring import ring_shift
 from tpu_patterns.longctx import attention as att
+
+
+# ---------------------------------------------------------------------------
+# Fused ring attention (block_impl="pallas"): custom VJP whose backward is a
+# SECOND ring pass — K/V shards rotate again, each carrying their dK/dV
+# accumulators with them, while dQ accumulates at home.  Memory stays
+# O(L_local) per device both directions (the generic fori_loop->scan
+# differentiation would instead checkpoint every visiting K/V shard, i.e.
+# the full global K/V per device, defeating long-context scaling).
+# ---------------------------------------------------------------------------
+
+
+def _shard_geometry(axis_name, axis_size, lq, lk, striped):
+    """(q_off, kv_off(t), pos_stride) global-position addressing for this
+    shard under either layout (striped: token i of shard r sits at global
+    position r + i*sp)."""
+    r = lax.axis_index(axis_name)
+    if striped:
+        q_off, stride = r, axis_size
+    else:
+        q_off, stride = r * lq, 1
+
+    def kv_off(t):
+        kv_rank = (r - t) % axis_size
+        return kv_rank if striped else kv_rank * lk
+
+    return q_off, kv_off, stride
+
+
+def _ring_flash_forward(q, k, v, axis_name, axis_size, causal, scale,
+                        interpret, striped):
+    """Forward ring with the fused flash_block per step; returns
+    (out [Lq,H,D] in q.dtype, lse [H,Lq] f32) — lse is the residual the
+    fused backward recomputes score tiles from."""
+    from tpu_patterns.longctx.flash import _row_stats, flash_block
+
+    lq, lk = q.shape[0], k.shape[0]
+    q_off, kv_off, stride = _shard_geometry(
+        axis_name, axis_size, lq, lk, striped
+    )
+
+    def absorb(state, t, kb, vb):
+        block = flash_block(
+            q, kb, vb, q_off=q_off, k_off=kv_off(t), causal=causal,
+            scale=scale, interpret=interpret, pos_stride=stride,
+        )
+        return att.combine_blocks(state, block)
+
+    def body(t, carry):
+        state, (kb, vb) = carry
+        state = absorb(state, t, kb, vb)
+        return state, (
+            ring_shift(kb, axis_name, axis_size),
+            ring_shift(vb, axis_name, axis_size),
+        )
+
+    init = att.empty_state(q.astype(jnp.float32))
+    state, (kb, vb) = lax.fori_loop(0, axis_size - 1, body, (init, (k, v)))
+    o_un, m, l = absorb(state, axis_size - 1, kb, vb)
+    out, lse = _row_stats(o_un, m, l)
+    return out.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def ring_flash_attention(q, k, v, axis_name, axis_size, causal=False,
+                         scale=None, interpret=False, striped=False):
+    """Differentiable fused ring attention; call inside ``shard_map``.
+    Same contract as :func:`ring_attention` with ``block_impl="pallas"``."""
+    out, _ = _ring_flash_forward(
+        q, k, v, axis_name, axis_size, causal, scale, interpret, striped
+    )
+    return out
+
+
+def _ring_flash_fwd_rule(q, k, v, axis_name, axis_size, causal, scale,
+                         interpret, striped):
+    out, lse = _ring_flash_forward(
+        q, k, v, axis_name, axis_size, causal, scale, interpret, striped
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd_rule(axis_name, axis_size, causal, scale, interpret,
+                         striped, res, g):
+    from tpu_patterns.longctx.flash import _delta, flash_block_bwd
+
+    q, k, v, out, lse = res
+    delta = _delta(g, out)
+    lq, lk = q.shape[0], k.shape[0]
+    q_off, kv_off, stride = _shard_geometry(
+        axis_name, axis_size, lq, lk, striped
+    )
+
+    def contrib(t, dq, kb, vb):
+        dq_c, dk_c, dv_c = flash_block_bwd(
+            q, kb, vb, g, lse, delta, q_off=q_off, k_off=kv_off(t),
+            causal=causal, scale=scale, interpret=interpret,
+            pos_stride=stride,
+        )
+        return dq + dq_c, dk_c, dv_c
+
+    def body(t, carry):
+        dq, kb, vb, dkb, dvb = carry
+        dq, dk_c, dv_c = contrib(t, dq, kb, vb)
+        # dK/dV accumulators TRAVEL with their K/V shard: after the full
+        # rotation (axis_size shifts) each shard arrives home carrying the
+        # contributions of every rank it visited.
+        return (
+            dq,
+            ring_shift(kb, axis_name, axis_size),
+            ring_shift(vb, axis_name, axis_size),
+            ring_shift(dkb + dk_c, axis_name, axis_size),
+            ring_shift(dvb + dv_c, axis_name, axis_size),
+        )
+
+    # Derive zero inits from the residents so they inherit the shards'
+    # varying-manual-axes under shard_map (see attention.empty_state).
+    init = (
+        q.astype(jnp.float32) * 0,
+        k,
+        v,
+        k.astype(jnp.float32) * 0,
+        v.astype(jnp.float32) * 0,
+    )
+    dq, kb, vb, dkb, dvb = lax.fori_loop(0, axis_size - 1, body, init)
+    # Peel the final step: only dK/dV still need their homebound shift —
+    # shifting kb/vb too would be two discarded full-shard permutes XLA
+    # cannot DCE inside the loop (same reason the forward peels its last
+    # absorb).
+    dq, dk_c, dv_c = contrib(axis_size - 1, dq, kb, vb)
+    dkb = ring_shift(dkb + dk_c, axis_name, axis_size)
+    dvb = ring_shift(dvb + dv_c, axis_name, axis_size)
+    return dq.astype(q.dtype), dkb.astype(k.dtype), dvb.astype(v.dtype)
+
+
+ring_flash_attention.defvjp(_ring_flash_fwd_rule, _ring_flash_bwd_rule)
 
 
 def ring_attention(
@@ -81,46 +219,41 @@ def ring_attention(
         raise ValueError(f"unknown block_impl {block_impl!r}")
     if layout not in ("contiguous", "striped"):
         raise ValueError(f"unknown layout {layout!r}")
+    scale = float(scale) if scale is not None else None
     if axis_size == 1:
+        if block_impl == "pallas":
+            from tpu_patterns.longctx.flash import flash_attention_diff
+
+            return flash_attention_diff(
+                q, k, v, causal, scale, 1024, 1024, interpret
+            )
         return att.attention_reference(q, k, v, causal=causal, scale=scale)
 
-    r = lax.axis_index(axis_name)
+    if block_impl == "pallas":
+        # Fused path: custom VJP whose backward is a second ring (O(L_local)
+        # memory; the generic loop differentiation below would checkpoint
+        # every visiting K/V shard instead).
+        return ring_flash_attention(
+            q, k, v, axis_name, axis_size, causal, scale, interpret,
+            layout == "striped",
+        )
+
     lq, lk = q.shape[0], k.shape[0]
     striped = layout == "striped"
-    if striped:
-        q_off, stride = r, axis_size
-    else:
-        q_off, stride = r * lq, 1
+    q_off, kv_off, stride = _shard_geometry(
+        axis_name, axis_size, lq, lk, striped
+    )
     q_pos = q_off + jnp.arange(lq) * stride
 
-    def kv_off(kv_rank):
-        return kv_rank if striped else kv_rank * lk
-
-    def mask_for(kv_rank):
+    def mask_for(t):
         if not causal:
             return None
-        return att.causal_mask(q_pos, kv_off(kv_rank) + jnp.arange(lk) * stride)
+        return att.causal_mask(q_pos, kv_off(t) + jnp.arange(lk) * stride)
 
     def absorb(state, t, kb, vb):
         # After t forward ring shifts, this device holds the K/V shard that
-        # started on rank (r - t) % sp.
-        kv_rank = (r - t) % axis_size
-        if block_impl == "pallas":
-            from tpu_patterns.longctx.flash import flash_block
-
-            block = flash_block(
-                q, kb, vb,
-                q_off=q_off,
-                k_off=kv_off(kv_rank),
-                causal=causal,
-                scale=scale,
-                interpret=interpret,
-                pos_stride=stride,
-            )
-        else:
-            block = att.block_attention(
-                q, kb, vb, scale=scale, mask=mask_for(kv_rank)
-            )
+        # started on rank (r - t) % sp — kv_off(t) is its global offset.
+        block = att.block_attention(q, kb, vb, scale=scale, mask=mask_for(t))
         return att.combine_blocks(state, block)
 
     def body(t, carry):
@@ -137,13 +270,9 @@ def ring_attention(
     # without the trailing shift (it would only be discarded, and XLA can't
     # DCE a collective inside a fori_loop).  empty_state derives its stats
     # from q so the carry inherits q's varying manual axes (see attention.py).
-    # The pallas block emits f32 partials, so its carry must start f32.
-    init = att.empty_state(
-        q if block_impl == "xla" else q.astype(jnp.float32)
-    )
+    init = att.empty_state(q)
     state, (kb, vb) = lax.fori_loop(0, axis_size - 1, body, (init, (k, v)))
     state = absorb(state, axis_size - 1, kb, vb)
-    # Both impls return q's dtype (the pallas carry runs f32 internally).
     return att.finalize(state).astype(q.dtype)
 
 

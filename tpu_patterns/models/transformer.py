@@ -49,6 +49,16 @@ class ModelConfig:
     # are tp-replicated, each rank computes its own expert's slots, and
     # the combine is the branch psum the dense path already does.
     moe: bool = False
+    # Attention compute path: "xla" (block_attention twin) or "pallas"
+    # (fused flash kernels both directions — forward flash_block inside
+    # the ring, backward via the second-ring dq/dk/dv kernels).
+    attn: str = "xla"
+    # Sequence layout over the sp axis: "contiguous" shards hold token
+    # blocks; "striped" shards hold tokens r::sp (load-balanced causal
+    # ring).  With "striped" the CALLER feeds x already striped along L
+    # (x_global[r::sp] per shard) — positions are handled inside the ring,
+    # and any token-permutation-invariant loss is unchanged.
+    attn_layout: str = "contiguous"
 
     @property
     def mlp_hidden(self) -> int:
@@ -115,15 +125,41 @@ def forward_shard(
     qkv = jnp.einsum("ble,cehd->cblhd", x, params["wqkv"])
     q, k, v = qkv[0], qkv[1], qkv[2]
 
+    # Fold batch into the head axis ([B, L, H, D] -> [L, B*H, D]):
+    # attention is independent per (batch, head), and one folded call gives
+    # the kernels a larger grid than a vmap over batch would.
+    b, l, h, d = q.shape
+
+    def fold(a):
+        return a.transpose(1, 0, 2, 3).reshape(l, b * h, d)
+
+    def unfold(a):
+        return a.reshape(l, b, h, d).transpose(1, 0, 2, 3)
+
     if sp_axis is not None and sp_size > 1:
-        attn = jax.vmap(
-            functools.partial(
-                ring_attention,
+        from tpu_patterns.runtime import use_interpret
+
+        attn = unfold(
+            ring_attention(
+                fold(q), fold(k), fold(v),
                 axis_name=sp_axis,
                 axis_size=sp_size,
                 causal=cfg.causal,
+                block_impl=cfg.attn,
+                interpret=use_interpret(),
+                layout=cfg.attn_layout,
             )
-        )(q, k, v)
+        )
+    elif cfg.attn == "pallas":
+        from tpu_patterns.longctx.flash import flash_attention_diff
+        from tpu_patterns.runtime import use_interpret
+
+        attn = unfold(
+            flash_attention_diff(
+                fold(q), fold(k), fold(v), cfg.causal, None, 1024, 1024,
+                use_interpret(),
+            )
+        )
     else:
         from tpu_patterns.longctx.attention import attention_reference
 
@@ -218,6 +254,16 @@ def _n_experts(mesh: Mesh, cfg: ModelConfig) -> int:
     return int(mesh.shape["tp"]) if cfg.moe else 0
 
 
+def _check_vma(cfg: ModelConfig) -> bool:
+    """shard_map varying-axes checking: ON everywhere except the fused
+    attention path in interpret mode, whose pallas discharge cannot track
+    varying manual axes (hardware runs keep the check — same gating as
+    longctx.pattern.VMA_OFF)."""
+    from tpu_patterns.runtime import use_interpret
+
+    return not (cfg.attn == "pallas" and use_interpret())
+
+
 def make_train_step(
     mesh: Mesh, cfg: ModelConfig, lr: float = 1e-3, x_spec: P | None = None
 ):
@@ -254,6 +300,7 @@ def make_train_step(
         mesh=mesh,
         in_specs=(pspecs, x_spec),
         out_specs=(pspecs, P()),
+        check_vma=_check_vma(cfg),
     )
     return jax.jit(sharded), pspecs
 
@@ -297,6 +344,131 @@ def forward_stack(params: dict, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     for s in range(n_stages):
         x = forward_shard({k: v[s] for k, v in params.items()}, x, cfg)
     return x
+
+
+@dataclasses.dataclass
+class FlagshipConfig:
+    """The measured flagship workload (CLI ``flagship`` subcommand)."""
+
+    embed: int = 1024
+    heads: int = 8
+    head_dim: int = 128
+    mlp_mult: int = 4
+    seq: int = 4096  # GLOBAL sequence length (split over sp)
+    batch: int = 4  # global batch (split over dp)
+    dtype: str = "bfloat16"
+    causal: bool = True
+    attn: str = "pallas"  # "xla" | "pallas"
+    attn_layout: str = "contiguous"
+    moe: bool = False
+    reps: int = 10
+    warmup: int = 2
+    min_tflops: float = -1.0
+    seed: int = 0
+
+
+def flagship_flops(cfg: FlagshipConfig) -> float:
+    """Model FLOPs of ONE training step (fwd + bwd = 3x fwd, the standard
+    accounting): qkv/out projections, attention matmuls, MLP."""
+    b, l, e = cfg.batch, cfg.seq, cfg.embed
+    hd = cfg.heads * cfg.head_dim
+    proj = 2 * b * l * e * (3 * hd) + 2 * b * l * hd * e
+    attn = 4.0 * l * l * cfg.heads * cfg.head_dim * b / (2 if cfg.causal else 1)
+    mlp = 4 * b * l * e * (e * cfg.mlp_mult)
+    return 3.0 * (proj + attn + mlp)
+
+
+def run_flagship(mesh: Mesh, cfg: FlagshipConfig, writer) -> list:
+    """Measure the full training step (fwd+bwd+SGD, one compiled program)
+    of the PatternFormer block over the given ("dp","sp","tp") mesh.
+    Returns one Record: min-over-reps step time and model TFLOP/s, with a
+    finite-loss + step-consistency gate."""
+    from tpu_patterns.core import timing
+    from tpu_patterns.core.results import Record, Verdict
+
+    mcfg = ModelConfig(
+        embed=cfg.embed,
+        heads=cfg.heads,
+        head_dim=cfg.head_dim,
+        mlp_mult=cfg.mlp_mult,
+        causal=cfg.causal,
+        dtype=cfg.dtype,
+        moe=cfg.moe,
+        attn=cfg.attn,
+        attn_layout=cfg.attn_layout,
+    )
+    dp, sp = int(mesh.shape["dp"]), int(mesh.shape["sp"])
+    if cfg.batch % dp or cfg.seq % sp:
+        raise ValueError(
+            f"batch {cfg.batch} / seq {cfg.seq} must divide dp={dp} / sp={sp}"
+        )
+    params = init_params(jax.random.key(cfg.seed), mcfg, _n_experts(mesh, mcfg))
+    dtype = jnp.dtype(cfg.dtype)
+    x = jax.random.normal(
+        jax.random.key(cfg.seed + 1), (cfg.batch, cfg.seq, cfg.embed), dtype
+    )
+    if cfg.attn_layout == "striped":
+        x = jnp.concatenate([x[:, r::sp] for r in range(sp)], axis=1)
+    # Timing lr: small enough that p - lr*g underflows to p (reps cannot
+    # diverge the unnormalized objective) but non-zero so XLA cannot fold
+    # the update away and DCE the entire backward.
+    step, _ = make_train_step(mesh, mcfg, lr=1e-30)
+    p = shard_params(params, mesh, mcfg)
+    sx = jax.device_put(x, NamedSharding(mesh, P("dp", "sp", None)))
+
+    def build_chain(k: int):
+        # k train steps chained through the updated params (data-dependent:
+        # XLA cannot elide any step), one scalar fetch at the end — the
+        # suite's amortized-chain discipline, which is what cancels the
+        # remote tunnel's per-fetch round trip (tens of ms, ~20x a step).
+        def run():
+            pp, loss = p, None
+            for _ in range(k):
+                pp, loss = step(pp, sx)
+            probe = jax.tree_util.tree_leaves(pp)[0]
+            return (
+                np.asarray(probe[(0,) * probe.ndim]),
+                np.asarray(loss),
+            )
+
+        return run
+
+    res = timing.measure_chain(
+        build_chain,
+        reps=cfg.reps,
+        warmup=cfg.warmup,
+        label=f"flagship:{cfg.attn}",
+    )
+    _, loss = step(p, sx)
+    loss = float(loss)
+    flops = flagship_flops(cfg)
+    tflops = flops / res.per_op_ns / 1e3
+    # consistency: the same step twice must reproduce the loss exactly
+    _, loss2 = step(p, sx)
+    data_ok = np.isfinite(loss) and float(loss2) == loss
+    perf_ok = cfg.min_tflops < 0 or tflops >= cfg.min_tflops
+    writer.metric(f"flagship {cfg.attn} train step", tflops, "TFLOP/s")
+    rec = Record(
+        pattern="flagship",
+        mode=cfg.attn + ("_moe" if cfg.moe else ""),
+        commands=f"dp{dp} sp{sp} tp{int(mesh.shape['tp'])} B{cfg.batch} "
+        f"L{cfg.seq} E{cfg.embed} {cfg.dtype}"
+        + (" causal" if cfg.causal else "")
+        + (f" {cfg.attn_layout}" if cfg.attn_layout != "contiguous" else ""),
+        metrics={
+            "tflops": tflops,
+            "step_ms": res.per_op_ns / 1e6,
+            "flops": flops,
+            "loss": loss,
+            "checksum_ok": float(data_ok),
+        },
+        verdict=Verdict.SUCCESS if (data_ok and perf_ok) else Verdict.FAILURE,
+    )
+    if not data_ok:
+        rec.notes.append(f"loss not finite/reproducible: {loss} vs {loss2}")
+    if not perf_ok:
+        rec.notes.append(f"{tflops:.3f} TFLOP/s below floor {cfg.min_tflops}")
+    return [writer.record(rec)]
 
 
 def make_pipeline_train_step(
@@ -344,5 +516,6 @@ def make_pipeline_train_step(
         mesh=mesh,
         in_specs=(pspecs, P("dp", "sp", None)),
         out_specs=(pspecs, P()),
+        check_vma=_check_vma(cfg),
     )
     return jax.jit(sharded), pspecs
