@@ -40,6 +40,30 @@ static uint64_t MonotonicNs() {
 extern "C" uint64_t tp_clock_ns() { return MonotonicNs(); }
 
 // --------------------------------------------------------------------------
+// Direct (ctypes) entry points for the host-offload interop path.  On TPU
+// platforms where custom-call handlers cannot live inside the compiled
+// program (the compile happens in a separate runtime process — e.g. a
+// remote-tunneled libtpu — so client-registered handler pointers do not
+// exist there), the framework reaches this C++ through jax.pure_callback:
+// XLA stages the device buffer to a host array, C++ borrows that buffer
+// zero-copy for the call duration, and the result is staged back.
+// Ownership: the caller (NumPy) owns every buffer; C++ must not retain
+// pointers past the call (≙ sycl ownership::keep semantics — borrow the
+// native handle, never adopt it; interop_omp_ze_sycl.cpp:56-73).
+extern "C" int32_t tp_checksum_f32_direct(const float* x, uint64_t n) {
+  uint32_t acc = 0;
+  for (uint64_t i = 0; i < n; ++i) {
+    acc += static_cast<uint32_t>(static_cast<int32_t>(x[i]));
+  }
+  return static_cast<int32_t>(acc);
+}
+
+extern "C" void tp_saxpy_direct(float alpha, const float* x, const float* y,
+                                float* out, uint64_t n) {
+  for (uint64_t i = 0; i < n; ++i) out[i] = alpha * x[i] + y[i];
+}
+
+// --------------------------------------------------------------------------
 // FFI: clock -> u64[] (1 element).  R1 rather than R0 keeps jax.ffi output
 // shapes trivial.
 static ffi::Error ClockNsImpl(ffi::Result<ffi::Buffer<ffi::U64>> out) {

@@ -19,6 +19,13 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", int(os.environ["TPU_PATTERNS_TEST_DEVICES"]))
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "tpu: needs a real TPU backend (skipped under the CPU conftest)",
+    )
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

@@ -213,17 +213,12 @@ def test_ring_attention_striped_layout(mesh1d, qkv, block_impl):
 
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from tpu_patterns.longctx.pattern import _stripe, _unstripe
+
     q, k, v = qkv
     # stripe: concatenate [x[r::sp] for r] so contiguous shard r == stripe r
-    def stripe(x):
-        return np.concatenate([np.asarray(x)[r::SP] for r in range(SP)])
-
-    def unstripe(x):
-        out = np.empty_like(x)
-        lq = x.shape[0] // SP
-        for r in range(SP):
-            out[r::SP] = x[r * lq : (r + 1) * lq]
-        return out
+    stripe = lambda x: _stripe(np.asarray(x), SP)  # noqa: E731
+    unstripe = lambda x: _unstripe(np.asarray(x), SP)  # noqa: E731
 
     spec = P("x", None, None)
     fn = jax.jit(
@@ -262,17 +257,11 @@ def test_ring_flash_gradients_match_reference(mesh1d, qkv, causal, layout):
 
     from jax.sharding import PartitionSpec as P
 
+    from tpu_patterns.longctx.pattern import _stripe, _unstripe
+
     q, k, v = qkv
-
-    def stripe(x):
-        return jnp.concatenate([x[r::SP] for r in range(SP)])
-
-    def unstripe(x):
-        out = np.empty_like(x)
-        lq = x.shape[0] // SP
-        for r in range(SP):
-            out[r::SP] = x[r * lq : (r + 1) * lq]
-        return out
+    stripe = lambda x: jnp.asarray(_stripe(np.asarray(x), SP))  # noqa: E731
+    unstripe = lambda x: _unstripe(np.asarray(x), SP)  # noqa: E731
 
     def loss(q, k, v):
         fn = jax.shard_map(

@@ -347,6 +347,39 @@ def _cmd_interop(args, writer: ResultWriter) -> None:
             )
         )
 
+    # Host-offload depth on the DEFAULT backend (TPU when present): eager
+    # PJRT staging always; in-program callbacks where the runtime supports
+    # host send/recv (probed, not assumed).
+    import jax.numpy as jnp
+
+    dx = jnp.arange(256, dtype=jnp.float32)
+    dy = jnp.ones(256, jnp.float32)
+    offload_checks = {
+        "offload_checksum": int(calls.offload_checksum(dx)[0])
+        == int(np.arange(256).sum()),
+        "offload_saxpy": bool(
+            np.allclose(
+                np.asarray(calls.offload_saxpy(2.0, dx, dy)),
+                2.0 * np.arange(256) + 1.0,
+            )
+        ),
+    }
+    if calls.supports_host_callbacks():
+        got = np.asarray(jax.jit(lambda a, b: calls.host_saxpy(2.0, a, b))(dx, dy))
+        offload_checks["host_callback_saxpy"] = bool(
+            np.allclose(got, 2.0 * np.arange(256) + 1.0)
+        )
+    backend = jax.default_backend()
+    for name, ok in offload_checks.items():
+        writer.record(
+            Record(
+                pattern="interop",
+                mode=f"offload:{backend}",
+                commands=name,
+                verdict=Verdict.SUCCESS if ok else Verdict.FAILURE,
+            )
+        )
+
 
 def _cmd_sweep(args, writer: ResultWriter) -> int:
     from tpu_patterns import sweep

@@ -57,3 +57,146 @@ def raw_info(x: jax.Array) -> jax.Array:
     _ensure_registered()
     call = jax.ffi.ffi_call("tp_raw_info", jax.ShapeDtypeStruct((8,), jnp.int32))
     return call(x.astype(jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# Host-offload interop: the TPU-platform depth of C14.
+#
+# On TPU the compiled program runs in a runtime the client process does not
+# share an address space with (libtpu, possibly behind a remote tunnel), so
+# a client-registered custom-call handler POINTER cannot exist inside the
+# program — the registration probe confirms it: ffi_call on the tpu
+# platform fails at compile with an unresolved custom-call target.  The
+# supported native boundary is the host-offload round trip, at two depths:
+#
+#   * host_checksum / host_saxpy — jax.pure_callback INSIDE the compiled
+#     program: XLA inserts device->host staging for the operands, C++
+#     borrows the staged buffer zero-copy, output staged back.  Works on
+#     CPU and standard libtpu; remote-tunneled runtimes without host
+#     send/recv support raise UNIMPLEMENTED at execute
+#     (supports_host_callbacks() probes this).
+#   * offload_checksum / offload_saxpy — EAGER staging through PJRT
+#     transfers: explicit device->host fetch of the real device buffer,
+#     zero-copy C++ call on the staged host array, device_put back.  Works
+#     on every runtime (the tunnel ships buffers either way).
+#
+# Ownership rules (also in csrc/tpu_patterns_ffi.cc): the runtime/NumPy
+# owns every buffer; C++ borrows for the call duration only — the
+# ownership::keep discipline of interop_omp_ze_sycl.cpp:56-73.
+# ---------------------------------------------------------------------------
+
+
+def _ensure_loaded():
+    if native.load() is None:
+        raise RuntimeError(
+            f"native module unavailable: {native.build_error()}"
+        )
+
+
+_callback_support: bool | None = None
+
+
+def supports_host_callbacks() -> bool:
+    """Whether the default backend can run host callbacks inside a compiled
+    program (standard CPU/TPU runtimes: yes; some remote-tunneled PJRT
+    plugins: no — they raise UNIMPLEMENTED at execute time, so probe with a
+    throwaway program rather than trusting the platform name."""
+    global _callback_support
+    if _callback_support is None:
+        import numpy as np
+
+        try:
+            out = jax.jit(
+                lambda x: jax.pure_callback(
+                    lambda a: np.asarray(a) + 1,
+                    jax.ShapeDtypeStruct((), jnp.float32),
+                    x,
+                )
+            )(jnp.float32(1.0))
+            _callback_support = float(out) == 2.0
+        except Exception:
+            _callback_support = False
+    return _callback_support
+
+
+def _stage_to_host(x: jax.Array):
+    """Explicit PJRT device->host transfer of a REAL device buffer."""
+    import numpy as np
+
+    return np.ascontiguousarray(jax.device_get(x), np.float32)
+
+
+def offload_checksum(x: jax.Array) -> jax.Array:
+    """Eager host-offload checksum: PJRT-stage the device buffer, C++
+    reduces the staged host array zero-copy, result returns to device."""
+    import numpy as np
+
+    _ensure_loaded()
+    arr = _stage_to_host(x.astype(jnp.float32).reshape(-1))
+    cs = native.load().tp_checksum_f32_direct(arr.ctypes.data, arr.size)
+    return jax.device_put(np.array([cs], np.int32))
+
+
+def offload_saxpy(alpha: float, x: jax.Array, y: jax.Array) -> jax.Array:
+    """Eager host-offload saxpy; C++ writes into the staging buffer that
+    device_put then uploads — one copy each direction, none on the host."""
+    import numpy as np
+
+    _ensure_loaded()
+    xa = _stage_to_host(x.astype(jnp.float32))
+    ya = _stage_to_host(y.astype(jnp.float32))
+    out = np.empty_like(xa)
+    native.load().tp_saxpy_direct(
+        float(alpha), xa.ctypes.data, ya.ctypes.data, out.ctypes.data, out.size
+    )
+    return jax.device_put(out)
+
+
+def host_checksum(x: jax.Array) -> jax.Array:
+    """Wrapped-int32 checksum via host offload — works under jit on ANY
+    platform (TPU included): pure_callback stages the operand to host,
+    C++ reduces it in place."""
+    import numpy as np
+
+    _ensure_loaded()
+
+    def _cb(arr):
+        arr = np.ascontiguousarray(arr, np.float32)
+        lib = native.load()
+        return np.array(
+            [lib.tp_checksum_f32_direct(arr.ctypes.data, arr.size)], np.int32
+        )
+
+    return jax.pure_callback(
+        _cb,
+        jax.ShapeDtypeStruct((1,), jnp.int32),
+        x.astype(jnp.float32).reshape(-1),
+        vmap_method="sequential",
+    )
+
+
+def host_saxpy(alpha: float, x: jax.Array, y: jax.Array) -> jax.Array:
+    """alpha*x + y computed by C++ on host-staged buffers (TPU-compatible
+    twin of ffi_saxpy); C++ writes straight into the result array the
+    runtime hands back to the device."""
+    import numpy as np
+
+    _ensure_loaded()
+    alpha = float(alpha)
+
+    def _cb(xa, ya):
+        xa = np.ascontiguousarray(xa, np.float32)
+        ya = np.ascontiguousarray(ya, np.float32)
+        out = np.empty_like(xa)
+        native.load().tp_saxpy_direct(
+            alpha, xa.ctypes.data, ya.ctypes.data, out.ctypes.data, out.size
+        )
+        return out
+
+    return jax.pure_callback(
+        _cb,
+        jax.ShapeDtypeStruct(x.shape, jnp.float32),
+        x.astype(jnp.float32),
+        y.astype(jnp.float32),
+        vmap_method="sequential",
+    )

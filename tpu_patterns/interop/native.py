@@ -67,6 +67,15 @@ def load() -> ctypes.CDLL | None:
         _lib = ctypes.CDLL(_SO)
         _lib.tp_clock_ns.restype = ctypes.c_uint64
         _lib.tp_clock_ns.argtypes = []
+        _lib.tp_checksum_f32_direct.restype = ctypes.c_int32
+        _lib.tp_checksum_f32_direct.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64
+        ]
+        _lib.tp_saxpy_direct.restype = None
+        _lib.tp_saxpy_direct.argtypes = [
+            ctypes.c_float, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_uint64,
+        ]
         return _lib
 
 

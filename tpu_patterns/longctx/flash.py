@@ -28,11 +28,12 @@ LANES = 128
 NEG_INF = -1e30
 
 # VMEM working-set budget per kernel instance.  v5e/v5p cores have 16 MB;
-# leaving headroom for double-buffered pipeline copies, spills, and the
-# compiler's own temporaries.  Block sizes auto-shrink to fit (a fixed
-# 1024/2048 default would simply fail to compile on smaller-VMEM parts or
-# larger head dims).
-VMEM_BUDGET = 10 * 1024 * 1024
+# block sizes auto-shrink to fit (a fixed 1024/2048 default would simply
+# fail to compile on smaller-VMEM parts or larger head dims).  14 MB is
+# calibrated against hardware: the forward's 1024x1024 d=128 config
+# (estimate 13.1 MB) measurably fits and is the documented v5e sweet spot,
+# while 2048x2048 (estimate ~40 MB) measurably OOMs scoped VMEM.
+VMEM_BUDGET = 14 * 1024 * 1024
 
 
 def _auto_block(lq: int, lk: int, d: int, in_bytes: int, score_tiles: int,

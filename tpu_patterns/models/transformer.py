@@ -400,7 +400,8 @@ def run_flagship(mesh: Mesh, cfg: FlagshipConfig, writer) -> list:
     dp, sp = int(mesh.shape["dp"]), int(mesh.shape["sp"])
     if cfg.batch % dp or cfg.seq % sp:
         raise ValueError(
-            f"batch {cfg.batch} / seq {cfg.seq} must divide dp={dp} / sp={sp}"
+            f"batch {cfg.batch} must be divisible by dp={dp} and "
+            f"seq {cfg.seq} by sp={sp}"
         )
     params = init_params(jax.random.key(cfg.seed), mcfg, _n_experts(mesh, mcfg))
     dtype = jnp.dtype(cfg.dtype)
