@@ -248,6 +248,49 @@ def test_ring_attention_striped_layout(mesh1d, qkv, block_impl):
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("q_off,k_off,stride", [(0, 0, 1), (16, 32, 1), (2, 5, 8)])
+def test_flash_block_kernels_match_xla_twins(causal, q_off, k_off, stride):
+    """The Mosaic block kernels (fwd partial triple + dq/dk/dv backward)
+    against their XLA twins at shard offsets/strides — the unit the ring
+    composes on hardware (interpret-mode rings swap in the twins, so this
+    is where the kernels' offset arithmetic is pinned down)."""
+    from tpu_patterns.longctx.flash import (
+        _delta,
+        _row_stats,
+        flash_block,
+        flash_block_bwd,
+    )
+    from tpu_patterns.longctx.ring_attention import (
+        _block_bwd_xla,
+        _block_fwd_xla,
+    )
+
+    q, k, v = _qkv(11)
+    o_p, m_p, l_p = flash_block(
+        q, k, v, q_off, k_off, causal=causal, block_q=16, block_k=16,
+        interpret=True, pos_stride=stride,
+    )
+    o_x, m_x, l_x = _block_fwd_xla(q, k, v, q_off, k_off, causal, None, stride)
+    np.testing.assert_allclose(np.asarray(o_p), np.asarray(o_x), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(l_p), np.asarray(l_x), atol=2e-5)
+
+    out, lse = _row_stats(o_x, m_x, l_x)
+    g = jax.random.normal(jax.random.key(3), q.shape, jnp.float32)
+    delta = _delta(g, out)
+    grads_p = flash_block_bwd(
+        q, k, v, g, lse, delta, q_off, k_off, causal=causal,
+        block_q=16, block_k=16, interpret=True, pos_stride=stride,
+    )
+    grads_x = _block_bwd_xla(
+        q, k, v, g, lse, delta, q_off, k_off, causal, None, stride
+    )
+    for name, a, b in zip("dq dk dv".split(), grads_p, grads_x):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, err_msg=name
+        )
+
+
+@pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("layout", ["contiguous", "striped"])
 def test_ring_flash_gradients_match_reference(mesh1d, qkv, causal, layout):
     """The fused ring backward (second ring pass carrying dK/dV with their

@@ -95,6 +95,42 @@ def test_fused_attention_flagship(mesh3d, batch, layout):
     assert float(loss2) < float(loss)
 
 
+@pytest.mark.parametrize("attn", ["xla", "pallas"])
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pipeline_schedule_gradients_agree(devices, batch, attn, schedule):
+    """EVERY (attention impl x pipeline schedule) combination must produce
+    the same updated parameters as the xla+gpipe baseline — this is the
+    gate that catches silent gradient-reduction bugs (a wrong-scaled
+    gradient still decreases the loss, so learn-tests cannot)."""
+    from tpu_patterns.models import init_stack_params, make_pipeline_train_step
+
+    mesh = Mesh(
+        np.array(devices[:8]).reshape(1, 2, 2, 2), ("dp", "sp", "tp", "pp")
+    )
+    base_cfg = ModelConfig(embed=64, heads=8, head_dim=8)
+    stack = init_stack_params(jax.random.key(0), base_cfg, 2)
+    x = batch
+
+    def run(attn_i, sched_i):
+        cfg = ModelConfig(embed=64, heads=8, head_dim=8, attn=attn_i)
+        step, pspecs = make_pipeline_train_step(
+            mesh, cfg, n_micro=2, lr=1.0, schedule=sched_i
+        )
+        p = {
+            k: jax.device_put(v, NamedSharding(mesh, pspecs[k]))
+            for k, v in stack.items()
+        }
+        sx = jax.device_put(x, NamedSharding(mesh, P("dp", "sp", None)))
+        new, loss = step(p, sx)
+        return {k: np.asarray(v) for k, v in new.items()}, float(loss)
+
+    got, loss = run(attn, schedule)
+    want, loss0 = run("xla", "gpipe")
+    assert np.isclose(loss, loss0, rtol=1e-5)
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], atol=1e-3, err_msg=k)
+
+
 def test_params_updated_consistently(mesh3d, params, batch):
     """After a step, tp-replicated params must remain identical across
     replicas (dp/sp grad sync correct) — fetching to host would mask a

@@ -278,6 +278,35 @@ def _cmd_flagship(args, writer: ResultWriter) -> None:
     run_flagship(mesh, cfg, writer)
 
 
+def _cmd_pipeline(args, writer: ResultWriter) -> None:
+    import dataclasses
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tpu_patterns.parallel.pipeline import PipelineConfig, run_pipeline
+
+    n = min(args.devices or len(jax.devices()), len(jax.devices()))
+    mesh = Mesh(np.array(jax.devices()[:n]), ("pp",))
+    schedules = (
+        ("gpipe", "1f1b") if args.schedule == "both" else (args.schedule,)
+    )
+    kw = {
+        f.name: getattr(args, f.name)
+        for f in dataclasses.fields(PipelineConfig)
+        if f.name != "schedules"
+    }
+    cfg = PipelineConfig(schedules=schedules, **kw)
+    if cfg.n_micro % n:
+        _world_skip(
+            writer, "pipeline", args.schedule, n,
+            f"n_micro {cfg.n_micro} not divisible by pp={n}",
+        )
+        return
+    run_pipeline(mesh, cfg, writer)
+
+
 def _cmd_miniapps(args, writer: ResultWriter) -> None:
     from tpu_patterns.miniapps.framework import DEFAULT_NP, default_mesh, run_all
 
@@ -482,6 +511,19 @@ def build_parser() -> argparse.ArgumentParser:
     fl.add_argument("--dp", type=int, default=1)
     fl.add_argument("--tp", type=int, default=1, help="remaining devices go to sp")
 
+    pl = sub.add_parser(
+        "pipeline", help="GPipe vs 1F1B schedule benchmark (bubble + memory)"
+    )
+    from tpu_patterns.parallel.pipeline import PipelineConfig
+
+    add_config_args(pl, PipelineConfig, skip=("schedules",))
+    pl.add_argument(
+        "--schedule",
+        choices=("gpipe", "1f1b", "both"),
+        default="both",
+    )
+    pl.add_argument("--devices", type=int, default=0, help="0 = all")
+
     m = sub.add_parser("miniapps", help="run every typed variant (≙ ctest)")
     m.add_argument("--devices", type=int, default=0)
     m.add_argument("--elements", type=int, default=0, help="0 = app default")
@@ -512,6 +554,7 @@ def main(argv: list[str] | None = None) -> int:
         "allreduce": _cmd_allreduce,
         "longctx": _cmd_longctx,
         "flagship": _cmd_flagship,
+        "pipeline": _cmd_pipeline,
         "miniapps": _cmd_miniapps,
         "topo": _cmd_topo,
         "interop": _cmd_interop,

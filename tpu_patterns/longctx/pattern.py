@@ -74,9 +74,11 @@ STRATEGIES = {
     "ulysses": ulysses_attention,
     "flash": flash_local,
 }
-# interpret-mode pallas discharge cannot track varying manual axes
-# (ring_attention docstring); these need check_vma=False on the shard_map
-VMA_OFF = {"ring_pallas"}
+# Strategies needing check_vma=False on the shard_map.  Empty since the
+# ring's interpret mode swapped to XLA twin blocks (ring_attention):
+# varying-axes tracking — which gradient reductions depend on — now stays
+# ON for every strategy on every platform.
+VMA_OFF: set[str] = set()
 # these expect shards in the striped token layout (r::sp)
 STRIPED = {"ring_striped"}
 

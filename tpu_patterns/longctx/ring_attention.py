@@ -71,6 +71,53 @@ def _shard_geometry(axis_name, axis_size, lq, lk, striped):
     return q_off, kv_off, stride
 
 
+def _block_fwd_xla(q, k, v, q_off, k_off, causal, scale, pos_stride):
+    """XLA twin of flash_block: same (o, m, l) partial triple, f32, with
+    the same global-position mask semantics.  Used in interpret mode,
+    where the pallas discharge cannot track varying manual axes — the
+    ring schedule and VJP structure stay identical, only the per-block
+    kernel differs, so CPU meshes validate the distributed logic with
+    full varying-axes checking while hardware runs the Mosaic kernels."""
+    lq, lk = q.shape[0], k.shape[0]
+    mask = None
+    if causal:
+        mask = att.causal_mask(
+            q_off + jnp.arange(lq) * pos_stride,
+            k_off + jnp.arange(lk) * pos_stride,
+        )
+    return att.block_attention(
+        q.astype(jnp.float32),
+        k.astype(jnp.float32),
+        v.astype(jnp.float32),
+        scale=scale,
+        mask=mask,
+    )
+
+
+def _block_bwd_xla(q, k, v, do, lse, delta, q_off, k_off, causal, scale,
+                   pos_stride):
+    """XLA twin of flash_block_bwd: identical math from the saved row
+    statistics (P = exp(s - lse); dV = P^T dO; dS = P*(dP - delta);
+    dQ = scale dS K; dK = scale dS^T Q), materialized scores."""
+    lq, lk, d = q.shape[0], k.shape[0], q.shape[-1]
+    scale = float(scale) if scale is not None else d**-0.5
+    qf, kf, vf, dof = (a.astype(jnp.float32) for a in (q, k, v, do))
+    s = jnp.einsum("qhd,khd->hqk", qf, kf) * scale
+    if causal:
+        mask = att.causal_mask(
+            q_off + jnp.arange(lq) * pos_stride,
+            k_off + jnp.arange(lk) * pos_stride,
+        )
+        s = jnp.where(mask[None], s, att.NEG_INF)
+    p = jnp.exp(s - lse[..., None])
+    dv = jnp.einsum("hqk,qhd->khd", p, dof)
+    dp = jnp.einsum("qhd,khd->hqk", dof, vf)
+    ds = p * (dp - delta[..., None])
+    dq = scale * jnp.einsum("hqk,khd->qhd", ds, kf)
+    dk = scale * jnp.einsum("hqk,qhd->khd", ds, qf)
+    return dq, dk, dv
+
+
 def _ring_flash_forward(q, k, v, axis_name, axis_size, causal, scale,
                         interpret, striped):
     """Forward ring with the fused flash_block per step; returns
@@ -84,10 +131,15 @@ def _ring_flash_forward(q, k, v, axis_name, axis_size, causal, scale,
     )
 
     def absorb(state, t, kb, vb):
-        block = flash_block(
-            q, kb, vb, q_off=q_off, k_off=kv_off(t), causal=causal,
-            scale=scale, interpret=interpret, pos_stride=stride,
-        )
+        if interpret:
+            block = _block_fwd_xla(
+                q, kb, vb, q_off, kv_off(t), causal, scale, stride
+            )
+        else:
+            block = flash_block(
+                q, kb, vb, q_off=q_off, k_off=kv_off(t), causal=causal,
+                scale=scale, interpret=interpret, pos_stride=stride,
+            )
         return att.combine_blocks(state, block)
 
     def body(t, carry):
@@ -136,11 +188,17 @@ def _ring_flash_bwd_rule(axis_name, axis_size, causal, scale, interpret,
     )
 
     def contrib(t, dq, kb, vb):
-        dq_c, dk_c, dv_c = flash_block_bwd(
-            q, kb, vb, g, lse, delta, q_off=q_off, k_off=kv_off(t),
-            causal=causal, scale=scale, interpret=interpret,
-            pos_stride=stride,
-        )
+        if interpret:
+            dq_c, dk_c, dv_c = _block_bwd_xla(
+                q, kb, vb, g, lse, delta, q_off, kv_off(t), causal, scale,
+                stride,
+            )
+        else:
+            dq_c, dk_c, dv_c = flash_block_bwd(
+                q, kb, vb, g, lse, delta, q_off=q_off, k_off=kv_off(t),
+                causal=causal, scale=scale, interpret=interpret,
+                pos_stride=stride,
+            )
         return dq + dq_c, dk_c, dv_c
 
     def body(t, carry):
@@ -221,7 +279,11 @@ def ring_attention(
         raise ValueError(f"unknown layout {layout!r}")
     scale = float(scale) if scale is not None else None
     if axis_size == 1:
-        if block_impl == "pallas":
+        # Fused kernels on hardware; in interpret mode the XLA reference
+        # (the pallas discharge cannot track varying manual axes, and
+        # inside shard_map that silently breaks gradient reductions — the
+        # kernels themselves are validated by the sp-free flash tests).
+        if block_impl == "pallas" and not interpret:
             from tpu_patterns.longctx.flash import flash_attention_diff
 
             return flash_attention_diff(

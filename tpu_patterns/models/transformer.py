@@ -137,8 +137,6 @@ def forward_shard(
         return a.reshape(l, b, h, d).transpose(1, 0, 2, 3)
 
     if sp_axis is not None and sp_size > 1:
-        from tpu_patterns.runtime import use_interpret
-
         attn = unfold(
             ring_attention(
                 fold(q), fold(k), fold(v),
@@ -146,18 +144,17 @@ def forward_shard(
                 axis_size=sp_size,
                 causal=cfg.causal,
                 block_impl=cfg.attn,
-                interpret=use_interpret(),
+                interpret=_interpret(),
                 layout=cfg.attn_layout,
             )
         )
-    elif cfg.attn == "pallas":
+    elif cfg.attn == "pallas" and not _interpret():
         from tpu_patterns.longctx.flash import flash_attention_diff
-        from tpu_patterns.runtime import use_interpret
 
         attn = unfold(
             flash_attention_diff(
                 fold(q), fold(k), fold(v), cfg.causal, None, 1024, 1024,
-                use_interpret(),
+                False,
             )
         )
     else:
@@ -254,14 +251,10 @@ def _n_experts(mesh: Mesh, cfg: ModelConfig) -> int:
     return int(mesh.shape["tp"]) if cfg.moe else 0
 
 
-def _check_vma(cfg: ModelConfig) -> bool:
-    """shard_map varying-axes checking: ON everywhere except the fused
-    attention path in interpret mode, whose pallas discharge cannot track
-    varying manual axes (hardware runs keep the check — same gating as
-    longctx.pattern.VMA_OFF)."""
+def _interpret() -> bool:
     from tpu_patterns.runtime import use_interpret
 
-    return not (cfg.attn == "pallas" and use_interpret())
+    return use_interpret()
 
 
 def make_train_step(
@@ -300,7 +293,6 @@ def make_train_step(
         mesh=mesh,
         in_specs=(pspecs, x_spec),
         out_specs=(pspecs, P()),
-        check_vma=_check_vma(cfg),
     )
     return jax.jit(sharded), pspecs
 
@@ -473,17 +465,34 @@ def run_flagship(mesh: Mesh, cfg: FlagshipConfig, writer) -> list:
 
 
 def make_pipeline_train_step(
-    mesh: Mesh, cfg: ModelConfig, n_micro: int, lr: float = 1e-3
+    mesh: Mesh,
+    cfg: ModelConfig,
+    n_micro: int,
+    lr: float = 1e-3,
+    schedule: str = "gpipe",
 ):
     """Training step of the pipelined stack over a ("dp","sp","tp","pp")
-    mesh: GPipe microbatching in the forward, full backward through the
-    pipeline's collectives (ppermute transpose), SGD update.
+    mesh; SGD update.  Two schedules:
+
+    * "gpipe" — forward microbatch streaming (pipeline_apply), backward by
+      autodiff (the ppermute transpose); residual memory grows with
+      n_micro.
+    * "1f1b"  — explicit one-forward-one-backward interleave
+      (pipeline_train_1f1b): activation stash bounded by 2*pp-1
+      microbatches regardless of n_micro, backward slots rematerialize
+      their stage forward.  Gradients get the dp/sp psum the loss-psum
+      transpose would otherwise supply.
 
     Returns ``(step, pspecs)``; x is sharded [dp, sp, -] and n_micro must
     divide its dp-local batch.
     """
-    from tpu_patterns.parallel.pipeline import pipeline_apply
+    from tpu_patterns.parallel.pipeline import (
+        pipeline_apply,
+        pipeline_train_1f1b,
+    )
 
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown schedule {schedule!r}")
     pp = int(mesh.shape["pp"])
     sp = int(mesh.shape["sp"])
     pspecs = stack_specs(cfg, _n_experts(mesh, cfg))
@@ -504,11 +513,29 @@ def make_pipeline_train_step(
         b = x.shape[0]
         micro = x.reshape(n_micro, b // n_micro, *x.shape[1:])
 
-        def loss_fn(stack):
-            out = pipeline_apply(stage_fn, stack, micro, "pp", pp)
-            return lax.psum(jnp.sum(out.astype(jnp.float32) ** 2), ("dp", "sp"))
+        if schedule == "1f1b":
 
-        loss, grads = jax.value_and_grad(loss_fn)(stack)
+            def out_grad(y):
+                yf = y.astype(jnp.float32)
+                return jnp.sum(yf**2), (2.0 * yf).astype(y.dtype)
+
+            loss, grads = pipeline_train_1f1b(
+                stage_fn, stack, micro, "pp", pp, out_grad
+            )
+            loss = lax.psum(loss, ("dp", "sp"))
+            # NO manual dp/sp grad psum here: varying-axes tracking is
+            # always on, so the vjp inside the 1f1b loop already inserted
+            # the psum when it transposed the invariant-params broadcast
+            # (psuming again would multiply grads by the axis sizes).
+        else:
+
+            def loss_fn(stack):
+                out = pipeline_apply(stage_fn, stack, micro, "pp", pp)
+                return lax.psum(
+                    jnp.sum(out.astype(jnp.float32) ** 2), ("dp", "sp")
+                )
+
+            loss, grads = jax.value_and_grad(loss_fn)(stack)
         new = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype), stack, grads)
         return new, loss
 
@@ -517,6 +544,5 @@ def make_pipeline_train_step(
         mesh=mesh,
         in_specs=(pspecs, P("dp", "sp", None)),
         out_specs=(pspecs, P()),
-        check_vma=_check_vma(cfg),
     )
     return jax.jit(sharded), pspecs

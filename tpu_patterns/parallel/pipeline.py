@@ -32,33 +32,109 @@ from jax import lax
 from tpu_patterns.comm.ring import ring_perm
 
 
+def bubble_fraction(schedule: str, pp: int, n_micro: int) -> float:
+    """Analytic idle fraction of the schedule's makespan.
+
+    gpipe: pp-1 idle ticks in each direction over n_micro+pp-1 ticks each —
+    the classic (pp-1)/(n_micro+pp-1).  1f1b (phase-aligned variant, see
+    pipeline_train_1f1b): 2(pp-1) idle cycles over n_micro+2(pp-1).
+    """
+    if schedule == "gpipe":
+        return (pp - 1) / (n_micro + pp - 1)
+    if schedule == "1f1b":
+        return 2 * (pp - 1) / (n_micro + 2 * (pp - 1))
+    raise ValueError(f"unknown schedule {schedule!r}")
+
+
+def peak_stash_microbatches(schedule: str, pp: int, n_micro: int) -> int:
+    """Peak live forward activations held per rank (in microbatch units).
+
+    gpipe differentiated by autodiff checkpoints one residual per forward
+    tick: n_micro + pp - 1.  1f1b stashes into a ring buffer whose size is
+    bounded by the pipeline depth, NOT the microbatch count: 2*pp - 1.
+    This is the property that lets 1f1b scale n_micro at fixed memory.
+    """
+    if schedule == "gpipe":
+        return n_micro + pp - 1
+    if schedule == "1f1b":
+        return min(2 * pp - 1, n_micro + 2 * (pp - 1))
+    raise ValueError(f"unknown schedule {schedule!r}")
+
+
+def _vary(a, axis_name):
+    """pcast to varying over ``axis_name`` unless the value already is
+    (pcast rejects varying->varying; zeros derived from sharded inputs
+    arrive varying, zeros derived from replicated ones do not)."""
+    if axis_name in getattr(jax.typeof(a), "vma", ()):
+        return a
+    return lax.pcast(a, (axis_name,), to="varying")
+
+
+def _loader_step(c, r, loader, store, axis_name, axis_size):
+    """Microbatch conveyor: microbatches live SHARDED over the pipeline
+    axis (rank r stores micro[r*K:(r+1)*K], K = n_micro/pp) instead of
+    replicated on every rank; one slab per rank rides a leftward ring
+    toward stage 0, timed so rank 0 holds micro[c] exactly at cycle c.
+
+    Rank r injects its j-th stored slab at cycle r*(K-1) + j; the slab
+    then travels r hops (one per cycle) and reaches rank 0 at cycle
+    r*K + j = its global microbatch index.  Injection cycles are disjoint
+    per slot by construction (inject + r is unique), so the conveyor
+    carries at most one live slab per rank — memory n_micro/pp + 1 slabs
+    per rank vs n_micro for replication, traffic one slab per tick (the
+    same order as the activation hops themselves).
+    """
+    k = store.shape[0]
+    j = c - r * (k - 1)
+    inject = jnp.logical_and(j >= 0, j < k)
+    slab = lax.dynamic_index_in_dim(
+        store, jnp.clip(j, 0, k - 1), keepdims=False
+    )
+    loader = jnp.where(inject, slab, loader)
+    consumed = loader  # rank 0 reads this cycle's microbatch here
+    loader = lax.ppermute(loader, axis_name, ring_perm(axis_size, -1))
+    return consumed, loader
+
+
 def pipeline_apply(
     stage_fn,
     stage_params,
     micro: jax.Array,
     axis_name: str,
     axis_size: int,
+    micro_sharded: bool = False,
 ):
-    """Run ``n_micro`` microbatches through ``axis_size`` pipeline stages.
+    """Run ``n_micro`` microbatches through ``axis_size`` pipeline stages
+    (GPipe schedule: all forwards; differentiate for the backward).
 
     stage_fn(params, x) -> y applies one stage (same shape in/out).
     stage_params: this rank's stage parameters (sharded over ``axis_name``).
-    micro: [n_micro, B, ...] microbatches, replicated on every rank.
+    micro: with ``micro_sharded=False``, [n_micro, B, ...] microbatches
+    replicated on every rank; with ``micro_sharded=True``, THIS RANK's
+    [n_micro/pp, B, ...] contiguous block of the microbatch axis (shard the
+    leading axis over ``axis_name``) — the conveyor (``_loader_step``)
+    streams them to stage 0, so no rank ever materializes all microbatches.
     Returns [n_micro, B, ...] outputs (replicated), in microbatch order.
     """
     pp = axis_size
-    n_micro = micro.shape[0]
+    k_local = micro.shape[0]
+    n_micro = k_local * pp if micro_sharded else k_local
     r = lax.axis_index(axis_name)
     is_first = r == 0
     is_last = r == pp - 1
     fwd = ring_perm(pp, 1)  # stage s -> s+1 (last wraps to 0, value unused)
 
     def tick(t, carry):
-        recv, out = carry
+        recv, out, loader = carry
         # Stage 0 ingests microbatch t while it exists; later stages use
         # the activation received from the left neighbor.
-        feed_idx = jnp.clip(t, 0, n_micro - 1)
-        fresh = lax.dynamic_index_in_dim(micro, feed_idx, keepdims=False)
+        if micro_sharded:
+            fresh, loader = _loader_step(
+                t, r, loader, micro, axis_name, axis_size
+            )
+        else:
+            feed_idx = jnp.clip(t, 0, n_micro - 1)
+            fresh = lax.dynamic_index_in_dim(micro, feed_idx, keepdims=False)
         x = jnp.where(is_first, fresh, recv)
         y = stage_fn(stage_params, x)
         # Drain: the last stage finished microbatch t-(pp-1) this tick.
@@ -70,15 +146,306 @@ def pipeline_apply(
         )
         # Hop activations one stage rightward (≙ SendRecvRing).
         recv = lax.ppermute(y, axis_name, fwd)
-        return recv, out
+        return recv, out, loader
 
     # Init carries varying over the pipeline axis (the loop writes
     # rank-dependent values into them; a constant init would change the
     # carry's varying-manual-axes type).
-    out0 = lax.pcast(jnp.zeros_like(micro), (axis_name,), to="varying")
-    recv0 = lax.pcast(jnp.zeros_like(micro[0]), (axis_name,), to="varying")
-    _, out = lax.fori_loop(0, n_micro + pp - 1, tick, (recv0, out0))
+    # Derive zero inits FROM the data (zeros_like / broadcast-add) so they
+    # inherit every varying manual axis the activations already carry
+    # (dp/sp under the flagship's 4-axis mesh), then add the pipeline axis.
+    base = jnp.zeros_like(micro[0])
+    out0 = _vary(
+        jnp.zeros((n_micro,) + base.shape, micro.dtype) + base, axis_name
+    )
+    recv0 = _vary(base, axis_name)
+    loader0 = _vary(base, axis_name)
+    _, out, _ = lax.fori_loop(
+        0, n_micro + pp - 1, tick, (recv0, out0, loader0)
+    )
     # Outputs accumulated on the last stage only; broadcast to every rank
     # so the result is replicated (psum over the one-hot owner).
     owner = (r == pp - 1).astype(out.dtype)
     return lax.psum(out * owner, axis_name)
+
+
+def pipeline_train_1f1b(
+    stage_fn,
+    stage_params,
+    micro: jax.Array,
+    axis_name: str,
+    axis_size: int,
+    out_grad_fn,
+    micro_sharded: bool = False,
+):
+    """One-forward-one-backward pipeline training pass: returns
+    ``(loss_sum, grads)`` with grads shaped like ``stage_params``.
+
+    Phase-aligned 1F1B: every cycle each rank runs ONE forward slot and
+    ONE backward slot (of different microbatches).  Forward of microbatch
+    m runs at stage s on cycle m+s; its backward runs on cycle
+    m + 2(pp-1) - s — cotangents enter at the last stage the same cycle
+    its forward completes and ripple back one stage per cycle.  Makespan
+    is n_micro + 2(pp-1) cycles (bubble 2(pp-1), see bubble_fraction); in
+    steady state both slots do useful work.
+
+    The memory property this schedule exists for: forward inputs live in a
+    ring stash of 2*pp - 1 slots — bounded by pipeline DEPTH, not by
+    n_micro (autodiff GPipe checkpoints every forward tick's residuals,
+    n_micro + pp - 1 of them).  The backward slot recomputes its stage
+    forward from the stashed input (full rematerialization, jax.vjp) —
+    the FLOPs-for-memory trade jax.checkpoint makes, applied per stage.
+
+    ``out_grad_fn(y) -> (loss, dy)`` evaluates the training objective and
+    its cotangent for one microbatch's final-stage output.
+    ``micro``/``micro_sharded`` as in :func:`pipeline_apply`.
+    Gradients are summed over microbatches; each rank returns grads for
+    ITS stage only (same sharding as stage_params).  Callers running under
+    dp/sp axes still psum the result (the loss-psum transpose autodiff
+    would otherwise supply).
+    """
+    pp = axis_size
+    k_local = micro.shape[0]
+    n_micro = k_local * pp if micro_sharded else k_local
+    r = lax.axis_index(axis_name)
+    is_first = r == 0
+    is_last = r == pp - 1
+    right = ring_perm(pp, 1)
+    left = ring_perm(pp, -1)
+    stash_slots = min(2 * pp - 1, n_micro + 2 * (pp - 1))
+    cycles = n_micro + 2 * (pp - 1)
+
+    def tick(c, carry):
+        recv_f, recv_b, stash, grads, loss_acc, loader = carry
+        # ---- forward slot: microbatch m_f = c - r -----------------------
+        if micro_sharded:
+            fresh, loader = _loader_step(
+                c, r, loader, micro, axis_name, axis_size
+            )
+        else:
+            feed_idx = jnp.clip(c, 0, n_micro - 1)
+            fresh = lax.dynamic_index_in_dim(micro, feed_idx, keepdims=False)
+        x = jnp.where(is_first, fresh, recv_f)
+        y = stage_fn(stage_params, x)
+        # Stash this cycle's forward input (ring buffer keyed by cycle;
+        # slot lifetime 2(pp-1-s) < stash_slots, so no live slot is
+        # clobbered before its backward reads it).
+        stash = lax.dynamic_update_index_in_dim(
+            stash, x, jnp.mod(c, stash_slots), 0
+        )
+        # ---- backward slot: microbatch m_b = c - 2(pp-1) + r ------------
+        m_b = c - 2 * (pp - 1) + r
+        b_valid = jnp.logical_and(m_b >= 0, m_b < n_micro)
+        # Last stage: its backward microbatch IS this cycle's forward
+        # output (m_b == m_f there), so the objective's cotangent enters
+        # here; other stages use the cotangent from their right neighbor.
+        loss_val, dy_here = out_grad_fn(y)
+        dy = jnp.where(is_last, dy_here, recv_b)
+        x_b = lax.dynamic_index_in_dim(
+            stash,
+            jnp.mod(c - 2 * (pp - 1) + 2 * r, stash_slots),
+            keepdims=False,
+        )
+        # Rematerialize the stage forward and transpose it (jax.vjp).
+        _, vjp_fn = jax.vjp(stage_fn, stage_params, x_b)
+        dparams, dx = vjp_fn(dy)
+        gate = b_valid.astype(jnp.float32)
+        grads = jax.tree.map(
+            lambda g, d: g + (gate * d.astype(jnp.float32)).astype(g.dtype),
+            grads,
+            dparams,
+        )
+        m_f = c - r
+        f_valid = jnp.logical_and(m_f >= 0, m_f < n_micro)
+        loss_acc = loss_acc + jnp.where(
+            jnp.logical_and(is_last, f_valid),
+            loss_val.astype(jnp.float32),
+            0.0,
+        )
+        # ---- hops: activations right, cotangents left -------------------
+        recv_f = lax.ppermute(y, axis_name, right)
+        recv_b = lax.ppermute(dx, axis_name, left)
+        return recv_f, recv_b, stash, grads, loss_acc, loader
+
+    # Zero inits derived from the data so they carry the activations'
+    # existing varying axes (see pipeline_apply).
+    base = jnp.zeros_like(micro[0])
+    recv_f0 = _vary(base, axis_name)
+    recv_b0 = _vary(base, axis_name)
+    stash0 = _vary(
+        jnp.zeros((stash_slots,) + base.shape, micro.dtype) + base, axis_name
+    )
+    grads0 = jax.tree.map(jnp.zeros_like, stage_params)
+    loss0 = _vary(jnp.sum(base).astype(jnp.float32), axis_name)
+    loader0 = _vary(base, axis_name)
+    _, _, _, grads, loss_acc, _ = lax.fori_loop(
+        0,
+        cycles,
+        tick,
+        (recv_f0, recv_b0, stash0, grads0, loss0, loader0),
+    )
+    # Loss lives on the last stage; replicate it (one-hot psum).
+    loss = lax.psum(loss_acc * (r == pp - 1).astype(jnp.float32), axis_name)
+    return loss, grads
+
+
+# ---------------------------------------------------------------------------
+# Measured pattern: the two schedules side by side, with the costs the
+# schedule trade is ABOUT — bubble fraction and activation memory — in the
+# Record, and a cross-schedule gradient agreement gate (the two-paths
+# discipline of the allreduce miniapp applied to pipeline training).
+# ---------------------------------------------------------------------------
+
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class PipelineConfig:
+    n_micro: int = 8
+    batch: int = 4  # per-microbatch rows
+    dim: int = 256
+    dtype: str = "float32"
+    reps: int = 5
+    warmup: int = 2
+    schedules: tuple = ("gpipe", "1f1b")
+    micro_sharded: bool = True  # conveyor feed (no microbatch replication)
+    seed: int = 0
+
+
+def run_pipeline(mesh, cfg: PipelineConfig | None = None, writer=None):
+    """Measure GPipe (autodiff backward) vs 1F1B (explicit interleaved
+    backward) training passes of a matmul-stage pipeline over a 1-D "pp"
+    mesh.  One Record per schedule: min-over-reps step time, analytic
+    bubble fraction, peak stashed activation bytes per rank; verdict gates
+    gradient agreement with the autodiff baseline."""
+    import functools
+
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_patterns.core import timing
+    from tpu_patterns.core.results import Record, ResultWriter, Verdict
+    from tpu_patterns.runtime import setup_jax
+
+    setup_jax()
+    cfg = cfg or PipelineConfig()
+    writer = writer or ResultWriter()
+    axis = mesh.axis_names[0]
+    pp = int(np.prod(mesh.devices.shape))
+    if cfg.n_micro % pp:
+        raise ValueError(f"n_micro {cfg.n_micro} not divisible by pp={pp}")
+    dtype = jnp.dtype(cfg.dtype)
+    keys = jax.random.split(jax.random.key(cfg.seed), 2)
+    w = jax.random.normal(keys[0], (pp, cfg.dim, cfg.dim), dtype) * 0.5
+    micro = jax.random.normal(
+        keys[1], (cfg.n_micro, cfg.batch, cfg.dim), dtype
+    )
+    micro_bytes = micro[0].size * micro[0].dtype.itemsize
+    stage_fn = lambda wl, a: jnp.tanh(a @ wl[0])  # noqa: E731
+
+    wspec = P(axis, None, None)
+    mspec = P(axis, None, None) if cfg.micro_sharded else P()
+    sw = jax.device_put(w, NamedSharding(mesh, wspec))
+    sm = jax.device_put(micro, NamedSharding(mesh, mspec))
+
+    def out_grad(y):
+        yf = y.astype(jnp.float32)
+        return jnp.sum(yf**2), (2.0 * yf).astype(y.dtype)
+
+    def make_step(schedule):
+        if schedule == "1f1b":
+            body = functools.partial(
+                pipeline_train_1f1b,
+                stage_fn,
+                axis_name=axis,
+                axis_size=pp,
+                out_grad_fn=out_grad,
+                micro_sharded=cfg.micro_sharded,
+            )
+            fn = jax.shard_map(
+                body, mesh=mesh, in_specs=(wspec, mspec),
+                out_specs=(P(), wspec),
+            )
+            return jax.jit(lambda wv: fn(wv, sm))
+
+        def loss_fn(wv, mv):
+            out = pipeline_apply(
+                stage_fn, wv, mv, axis, pp, micro_sharded=cfg.micro_sharded
+            )
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+
+        fn = jax.shard_map(
+            jax.value_and_grad(loss_fn),
+            mesh=mesh,
+            in_specs=(wspec, mspec),
+            out_specs=(P(), wspec),
+        )
+        return jax.jit(lambda wv: fn(wv, sm))
+
+    writer.progress(
+        f"pipeline: pp={pp}, n_micro={cfg.n_micro}, dim={cfg.dim}, "
+        f"micro_sharded={cfg.micro_sharded}, dtype={cfg.dtype}"
+    )
+
+    # Ground truth: sequential single-device autodiff (the library-path
+    # reference every schedule must reproduce — meaningful even when only
+    # one schedule runs).
+    def seq_loss(wv):
+        def run_micro(m):
+            x = m
+            for s in range(pp):
+                x = stage_fn(wv[s : s + 1], x)
+            return jnp.sum(x.astype(jnp.float32) ** 2)
+
+        return jnp.sum(jax.vmap(run_micro)(micro))
+
+    baseline = np.asarray(jax.jit(jax.grad(seq_loss))(w), np.float32)
+
+    records = []
+    for schedule in cfg.schedules:
+        step = make_step(schedule)
+
+        def build_chain(k: int, _step=step):
+            def run():
+                wv, out = sw, None
+                for _ in range(k):
+                    loss, grads = _step(wv)
+                    # data dependence so XLA cannot elide any iteration
+                    wv = jax.tree.map(lambda p, g: p - 1e-30 * g, wv, grads)
+                    out = loss
+                return np.asarray(out)
+
+            return run
+
+        res = timing.measure_chain(
+            build_chain, reps=cfg.reps, warmup=cfg.warmup,
+            label=f"pipeline:{schedule}",
+        )
+        loss, grads = step(sw)
+        grads_np = np.asarray(grads, np.float32)
+        err = float(np.max(np.abs(grads_np - baseline)))
+        agree = err <= 1e-3 * max(1.0, float(np.max(np.abs(baseline))))
+        stash = peak_stash_microbatches(schedule, pp, cfg.n_micro)
+        rec = Record(
+            pattern="pipeline",
+            mode=schedule,
+            commands=f"pp{pp} M{cfg.n_micro} B{cfg.batch} D{cfg.dim}"
+            + (" sharded" if cfg.micro_sharded else " replicated"),
+            metrics={
+                "step_us": res.us(),
+                "loss": float(loss),
+                "bubble_fraction": bubble_fraction(schedule, pp, cfg.n_micro),
+                "peak_stash_microbatches": float(stash),
+                "peak_stash_bytes": float(stash * micro_bytes),
+                "grad_max_err": err,
+                "checksum_ok": float(agree),
+            },
+            verdict=Verdict.SUCCESS if agree else Verdict.FAILURE,
+        )
+        if not agree:
+            rec.notes.append(
+                f"gradients diverge from sequential reference: {err:.2e}"
+            )
+        records.append(writer.record(rec))
+    return records
