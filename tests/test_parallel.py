@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_patterns.parallel import moe_apply, pipeline_apply
 
@@ -170,3 +170,119 @@ class TestMoE:
         weight = np.asarray(jnp.max(gates, axis=-1))
         want = np.asarray(self._expert(we[0], x)) * weight[:, None]
         np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+class TestMoECapacity:
+    """Capacity-factor regimes (C = ceil(cf*T/E)): exact when cf is
+    generous, deterministic overflow drops when it binds."""
+
+    def _setup(self, tokens=32, dim=16, ep=4, seed=2):
+        keys = jax.random.split(jax.random.key(seed), 3)
+        we = jax.random.normal(keys[0], (ep, dim, dim), jnp.float32) * 0.3
+        wg = jax.random.normal(keys[1], (dim, ep), jnp.float32)
+        xs = jax.random.normal(keys[2], (tokens, dim), jnp.float32)
+        return we, wg, xs
+
+    def _run(self, cf, we, wg, xs):
+        import functools
+
+        from tpu_patterns.parallel.moe import moe_apply
+
+        ep = we.shape[0]
+        mesh = Mesh(np.array(jax.devices()[:ep]), ("x",))
+        fn = jax.jit(
+            jax.shard_map(
+                functools.partial(
+                    moe_apply,
+                    lambda w, a: jnp.tanh(a @ w[0]),
+                    axis_name="x",
+                    axis_size=ep,
+                    capacity_factor=cf,
+                ),
+                mesh=mesh,
+                in_specs=(P("x", None, None), P(), P("x", None)),
+                out_specs=P("x", None),
+            )
+        )
+        return np.asarray(
+            fn(
+                jax.device_put(we, NamedSharding(mesh, P("x", None, None))),
+                wg,
+                jax.device_put(xs, NamedSharding(mesh, P("x", None))),
+            )
+        )
+
+    def _dense_want(self, we, wg, xs, cap):
+        """Shared host replay (moe.host_reference): routing at device
+        precision, slot counting + tanh expert in f32."""
+        from tpu_patterns.parallel.moe import host_reference
+
+        return host_reference(we, wg, xs, we.shape[0], cap)
+
+    def test_generous_capacity_is_exact(self, mesh1d):
+        from tpu_patterns.parallel.moe import capacity
+
+        ep, tokens = 4, 32
+        we, wg, xs = self._setup(tokens * ep)
+        cf = float(ep)  # C = T: nothing can drop
+        assert capacity(tokens, ep, cf) == tokens
+        got = self._run(cf, we, wg, xs)
+        want, dropped = self._dense_want(we, wg, xs, tokens)
+        assert dropped == 0
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_binding_capacity_drops_deterministically(self, mesh1d):
+        from tpu_patterns.parallel.moe import capacity
+
+        ep, tokens = 4, 32
+        we, wg, xs = self._setup(tokens * ep)
+        cap = capacity(tokens, ep, 0.5)  # C = ceil(0.5*32/4) = 4
+        assert cap == 4
+        got = self._run(0.5, we, wg, xs)
+        want, dropped = self._dense_want(we, wg, xs, cap)
+        assert dropped > 0, "test must exercise the dropping regime"
+        np.testing.assert_allclose(got, want, atol=1e-5)
+        # dropped tokens are exactly zero rows
+        zero_rows = np.where(np.all(want == 0, axis=1))[0]
+        assert np.all(got[zero_rows] == 0)
+
+    def test_dispatch_stats_match_host_replay(self):
+        from tpu_patterns.parallel.moe import dispatch_stats, top1_route
+
+        we, wg, xs = self._setup(64)
+        onehot, _ = top1_route(xs, wg)
+        n_dropped, per_expert = dispatch_stats(onehot, 8)
+        idx = np.asarray(jnp.argmax(xs @ wg, axis=-1))
+        counts = {}
+        kept = np.zeros(wg.shape[-1], np.int32)
+        drops = 0
+        for e in idx:
+            c = counts.get(int(e), 0)
+            counts[int(e)] = c + 1
+            if c < 8:
+                kept[int(e)] += 1
+            else:
+                drops += 1
+        assert int(n_dropped) == drops
+        np.testing.assert_array_equal(np.asarray(per_expert), kept)
+
+    def test_flagship_moe_capacity_factor(self, mesh1d):
+        """ModelConfig.capacity_factor threads through the flagship MoE
+        FFN: a binding factor changes the output (drops) while a generous
+        one reproduces the exact path."""
+        from tpu_patterns.models import ModelConfig, forward_shard, init_params
+
+        cfg_exact = ModelConfig(embed=32, heads=4, head_dim=8, moe=True)
+        cfg_loose = ModelConfig(
+            embed=32, heads=4, head_dim=8, moe=True, capacity_factor=8.0
+        )
+        cfg_tight = ModelConfig(
+            embed=32, heads=4, head_dim=8, moe=True, capacity_factor=0.25
+        )
+        params = init_params(jax.random.key(0), cfg_exact, n_experts=4)
+        x = jax.random.normal(jax.random.key(1), (2, 16, 32), jnp.float32)
+        out_exact = np.asarray(forward_shard(params, x, cfg_exact))
+        out_loose = np.asarray(forward_shard(params, x, cfg_loose))
+        out_tight = np.asarray(forward_shard(params, x, cfg_tight))
+        np.testing.assert_allclose(out_exact, out_loose, atol=1e-6)
+        assert not np.allclose(out_exact, out_tight, atol=1e-6)

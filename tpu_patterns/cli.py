@@ -307,6 +307,28 @@ def _cmd_pipeline(args, writer: ResultWriter) -> None:
     run_pipeline(mesh, cfg, writer)
 
 
+def _cmd_moe(args, writer: ResultWriter) -> None:
+    import dataclasses
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tpu_patterns.parallel.moe import MoEConfig, run_moe
+
+    n = min(args.devices or len(jax.devices()), len(jax.devices()))
+    mesh = Mesh(np.array(jax.devices()[:n]), ("ep",))
+    kw = {
+        f.name: getattr(args, f.name)
+        for f in dataclasses.fields(MoEConfig)
+        if f.name != "capacity_factors"
+    }
+    if args.capacity_factor:
+        kw["capacity_factors"] = tuple(args.capacity_factor)
+    cfg = MoEConfig(**kw)
+    run_moe(mesh, cfg, writer)
+
+
 def _cmd_miniapps(args, writer: ResultWriter) -> None:
     from tpu_patterns.miniapps.framework import DEFAULT_NP, default_mesh, run_all
 
@@ -524,6 +546,20 @@ def build_parser() -> argparse.ArgumentParser:
     )
     pl.add_argument("--devices", type=int, default=0, help="0 = all")
 
+    mo = sub.add_parser(
+        "moe", help="expert-parallel dispatch benchmark (capacity regimes)"
+    )
+    from tpu_patterns.parallel.moe import MoEConfig
+
+    add_config_args(mo, MoEConfig, skip=("capacity_factors",))
+    mo.add_argument(
+        "--capacity_factor",
+        type=float,
+        action="append",
+        help="repeatable; 0 = exact (C = T); default 0, 2.0, 1.0",
+    )
+    mo.add_argument("--devices", type=int, default=0, help="0 = all")
+
     m = sub.add_parser("miniapps", help="run every typed variant (≙ ctest)")
     m.add_argument("--devices", type=int, default=0)
     m.add_argument("--elements", type=int, default=0, help="0 = app default")
@@ -555,6 +591,7 @@ def main(argv: list[str] | None = None) -> int:
         "longctx": _cmd_longctx,
         "flagship": _cmd_flagship,
         "pipeline": _cmd_pipeline,
+        "moe": _cmd_moe,
         "miniapps": _cmd_miniapps,
         "topo": _cmd_topo,
         "interop": _cmd_interop,

@@ -49,6 +49,10 @@ class ModelConfig:
     # are tp-replicated, each rank computes its own expert's slots, and
     # the combine is the branch psum the dense path already does.
     moe: bool = False
+    # Per-expert capacity factor for the MoE FFN: C = ceil(cf * T / E);
+    # <= 0 keeps the exact C = T routing (nothing dropped).  Overflow
+    # tokens pass through on the residual only (their FFN term is zero).
+    capacity_factor: float = 0.0
     # Attention compute path: "xla" (block_attention twin) or "pallas"
     # (fused flash kernels both directions — forward flash_block inside
     # the ring, backward via the second-ring dq/dk/dv kernels).
@@ -170,7 +174,7 @@ def forward_shard(
     y = x + o
 
     if cfg.moe:
-        return y + _moe_ffn(params, y, tp_axis)
+        return y + _moe_ffn(params, y, tp_axis, cfg.capacity_factor)
     # Dense MLP branch: column-parallel w1, row-parallel w2.
     hidden = jax.nn.relu(jnp.einsum("ble,ef->blf", y, params["w1"]))
     m = jnp.einsum("blf,fe->ble", hidden, params["w2"])
@@ -179,25 +183,32 @@ def forward_shard(
     return y + m
 
 
-def _moe_ffn(params: dict, y: jax.Array, tp_axis: str | None) -> jax.Array:
+def _moe_ffn(
+    params: dict,
+    y: jax.Array,
+    tp_axis: str | None,
+    capacity_factor: float = 0.0,
+) -> jax.Array:
     """Top-1 MoE FFN with replicated activations, experts over the tp axis
     (ep ≙ tp).  Tokens are tp-replicated after the attention psum, so
     dispatch needs no all-to-all: each rank selects its OWN expert's slots
     from the shared dispatch tensor, runs its expert, and the combine is a
     psum — gradient flows through the gate weights (routing argmax is a
-    constant, the standard top-1 straight-through treatment).  Capacity =
-    T (exact, nothing dropped; the O(T^2) dispatch tensor is the pattern
-    trade — production kernels cap C).
+    constant, the standard top-1 straight-through treatment).  Capacity:
+    C = ceil(capacity_factor * T / E), or the exact C = T when the factor
+    is <= 0; overflow tokens are dropped (zero FFN term, residual
+    passthrough).
     """
     from tpu_patterns.parallel.moe import (
         build_dispatch,
         build_dispatch_column,
+        capacity,
         top1_route,
     )
 
     b, l, e = y.shape
     x2 = y.reshape(-1, e)  # [T, E]
-    cap = x2.shape[0]
+    cap = capacity(x2.shape[0], params["wg"].shape[-1], capacity_factor)
     onehot, weight = top1_route(x2, params["wg"])
 
     def expert(w1, w2, xin):
