@@ -625,5 +625,12 @@ def main(argv: list[str] | None = None) -> int:
     return writer.exit_code
 
 
-if __name__ == "__main__":
+def script_main() -> None:
+    """Console-script entry point (``tpu-patterns`` after pip install):
+    the process exit code IS the aggregated verdict, the reference's
+    exit-code discipline (concurency/main.cpp:270,321)."""
     sys.exit(main())
+
+
+if __name__ == "__main__":
+    script_main()

@@ -181,6 +181,7 @@ def host_reference(we, wg, xs, ep: int, cap: int):
     want = np.zeros((t_total, dim), np.float32)
     dropped = 0
     route = jax.jit(top1_route)
+    we32 = np.asarray(we, np.float32)  # one transfer, not one per token
     for rank in range(ep):
         xb = xs[rank * tokens : (rank + 1) * tokens]
         onehot, weight = route(jnp.asarray(xb), jnp.asarray(wg))
@@ -194,9 +195,7 @@ def host_reference(we, wg, xs, ep: int, cap: int):
             if slot >= cap:
                 dropped += 1
                 continue
-            want[rank * tokens + i] = gw[i] * np.tanh(
-                xb32[i] @ np.asarray(we[e], np.float32)
-            )
+            want[rank * tokens + i] = gw[i] * np.tanh(xb32[i] @ we32[e])
     return want, dropped
 
 
