@@ -172,3 +172,44 @@ class TestHarness:
                         min_bandwidth=1e12)
         run_concurrency(cfg, w)
         assert w.exit_code == 1
+
+
+def test_serial_mode_time_is_sum_of_solos():
+    """Guard on the serial-vs-concurrent CONTRAST itself: serial mode's
+    group time must be >= ~the sum of each command's solo time.  The
+    serial mode orders commands with lax.optimization_barrier; if a
+    future XLA elided the barrier AND merged/overlapped the commands, the
+    group time would collapse toward one solo time and every speedup
+    verdict would become vacuous SUCCESS — this asserts the contrast's
+    denominator stays real (≙ the serial reference, concurency
+    main.cpp:281-293)."""
+    from tpu_patterns.concurrency import harness
+    from tpu_patterns.concurrency.backends import get_backend
+    from tpu_patterns.core import timing
+
+    cfg = harness.ConcurrencyConfig(
+        backend="xla",
+        mode="serial",
+        reps=3,
+        warmup=1,
+        auto_tune=False,
+        tripcount=3000,
+        elements=16384,
+    )
+    cmds = harness._apply_defaults(harness.parse_group("C C"), cfg)
+    backend = get_backend("xla")
+    solo_ns = [harness._measure_solo(backend, c, cfg)[0] for c in cmds]
+
+    built = backend.build(cmds, "serial")
+    m = timing.measure_chain(
+        built.build_chain,
+        reps=cfg.reps,
+        warmup=cfg.warmup,
+        direct_fn=built.direct_fn,
+        label="serial-guard",
+    )
+    total = sum(solo_ns)
+    assert m.per_op_ns >= 0.6 * total, (
+        f"serial group ran in {m.per_op_ns:.0f} ns but solos sum to "
+        f"{total:.0f} ns — the serial ordering has been elided"
+    )

@@ -53,3 +53,54 @@ class TestRunOneSided:
         (rec,) = run_onesided(mesh, OneSidedConfig(count=2048, reps=2, warmup=1))
         assert rec.mode == "local_put"
         assert rec.verdict is Verdict.SUCCESS, rec.notes
+
+
+class TestLocalPutStreamedEdges:
+    """The block-cap/divisor logic of local_put_streamed (VERDICT round-1
+    gap): shrink-to-divisor, degenerate shapes, VMEM byte cap."""
+
+    def _roundtrip(self, shape, dtype=jnp.float32, block_rows=1024):
+        from tpu_patterns.comm.onesided import local_put_streamed
+
+        n = int(np.prod(shape))
+        x = jnp.arange(n, dtype=dtype).reshape(shape)
+        out = local_put_streamed(x, block_rows=block_rows, interpret=True)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+        return out
+
+    def test_rows_zero_early_out(self):
+        from tpu_patterns.comm.onesided import local_put_streamed
+
+        x = jnp.zeros((0, 128), jnp.float32)
+        out = local_put_streamed(x, interpret=True)
+        assert out.shape == (0, 128)
+
+    def test_empty_trailing_dim_early_out(self):
+        from tpu_patterns.comm.onesided import local_put_streamed
+
+        x = jnp.zeros((8, 0), jnp.float32)
+        out = local_put_streamed(x, interpret=True)
+        assert out.shape == (8, 0)
+
+    def test_block_shrinks_to_divisor(self):
+        # rows=6 with block_rows=4: 6 % 4 != 0 -> the divisor loop must
+        # walk down to 3 (not crash, not drop rows)
+        self._roundtrip((6, 256), block_rows=4)
+
+    def test_prime_rows(self):
+        # prime row count: only divisors are 1 and itself
+        self._roundtrip((7, 256), block_rows=4)
+
+    def test_non_multiple_of_128_trailing_dim(self):
+        # trailing dims that are not lane-aligned still round-trip (Mosaic
+        # handles the padding; interpret mode checks the indexing math)
+        self._roundtrip((16, 100))
+        self._roundtrip((16, 3, 37))
+
+    def test_vmem_byte_cap_bounds_block(self):
+        # a single row of 2M f32 = 8 MB > the 4 MB cap: block_rows must
+        # clamp to 1 (the max(1, ...) floor) and the copy still be exact
+        self._roundtrip((4, 2 * 1024 * 1024), block_rows=1024)
+
+    def test_1d_input(self):
+        self._roundtrip((4096,))
