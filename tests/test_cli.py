@@ -161,9 +161,14 @@ class TestSweep:
         assert any("pallas" in s.name for s in ar)
         lc = sweep.specs_for("longctx", quick=True)
         assert any("agreement" in s.name for s in lc)
+        assert any("grad" in s.name for s in lc)
+        par = sweep.specs_for("parallel", quick=True)
+        assert {s.name.split(".")[0] for s in par} == {
+            "pipeline", "moe", "flagship"
+        }
         assert len(sweep.specs_for("all", quick=True)) == len(p2p) + len(con) + len(
             sweep.specs_for("allreduce", quick=True)
-        ) + len(lc)
+        ) + len(lc) + len(par)
 
     def test_unknown_name_filter(self, tmp_path):
         with pytest.raises(ValueError, match="unknown cell name"):

@@ -571,7 +571,12 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("interop", help="native FFI round-trip proofs")
 
     s = sub.add_parser("sweep", help="config-matrix sweeps (≙ run*.sh)")
-    s.add_argument("suite", choices=("p2p", "concurrency", "allreduce", "longctx", "all"))
+    s.add_argument(
+        "suite",
+        choices=(
+            "p2p", "concurrency", "allreduce", "longctx", "parallel", "all"
+        ),
+    )
     s.add_argument("--out", default="results", help="log/JSONL directory")
     s.add_argument("--quick", action="store_true", help="tiny workloads")
 

@@ -185,6 +185,79 @@ def longctx_specs(quick: bool = False) -> list[SweepSpec]:
             env=(("TPU_PATTERNS_SWEEP_CONFIG", "longctx"),),
         )
     )
+    # backward cells: fwd+bwd measured with gradient gates
+    for strategy in ("ring", "ring_pallas"):
+        specs.append(
+            SweepSpec(
+                name=f"longctx.grad.{strategy}",
+                argv=(
+                    "longctx", "--strategy", strategy, "--grad", "true",
+                    *small,
+                ),
+                env=(("TPU_PATTERNS_SWEEP_CONFIG", "longctx.grad"),),
+            )
+        )
+    specs.append(
+        SweepSpec(
+            name="longctx.grad.flash.1dev",
+            argv=(
+                "longctx", "--devices", "1", "--strategy", "flash",
+                "--grad", "true", *small,
+            ),
+            env=(("TPU_PATTERNS_SWEEP_CONFIG", "longctx.grad"),),
+        )
+    )
+    return specs
+
+
+def parallel_specs(quick: bool = False) -> list[SweepSpec]:
+    """Schedules x feeds (pipeline) and capacity regimes (moe) + the
+    flagship train-step contrast — the round-2 pattern matrices."""
+    specs = []
+    pipe_small = (
+        ("--n_micro", "8", "--dim", "64", "--batch", "2", "--reps", "2")
+        if quick
+        else ("--n_micro", "8",)
+    )
+    for sched in ("gpipe", "1f1b"):
+        for sharded in ("true", "false"):
+            specs.append(
+                SweepSpec(
+                    name=f"pipeline.{sched}.sharded_{sharded}",
+                    argv=(
+                        "pipeline", "--schedule", sched,
+                        "--micro_sharded", sharded, *pipe_small,
+                    ),
+                    env=(("TPU_PATTERNS_SWEEP_CONFIG", "pipeline"),),
+                )
+            )
+    moe_small = (
+        ("--tokens", "64", "--reps", "2") if quick else ("--tokens", "512")
+    )
+    specs.append(
+        SweepSpec(
+            name="moe.capacity",
+            argv=(
+                "moe", "--capacity_factor", "0", "--capacity_factor", "2.0",
+                "--capacity_factor", "1.0", *moe_small,
+            ),
+            env=(("TPU_PATTERNS_SWEEP_CONFIG", "moe"),),
+        )
+    )
+    flag_small = (
+        ("--embed", "64", "--head_dim", "8", "--seq", "128", "--batch", "2",
+         "--dtype", "float32", "--reps", "2")
+        if quick
+        else ("--seq", "4096", "--batch", "2")
+    )
+    for attn in ("xla", "pallas"):
+        specs.append(
+            SweepSpec(
+                name=f"flagship.{attn}",
+                argv=("flagship", "--attn", attn, *flag_small),
+                env=(("TPU_PATTERNS_SWEEP_CONFIG", "flagship"),),
+            )
+        )
     return specs
 
 
@@ -193,6 +266,7 @@ SUITES = {
     "concurrency": concurrency_specs,
     "allreduce": allreduce_specs,
     "longctx": longctx_specs,
+    "parallel": parallel_specs,
 }
 
 
