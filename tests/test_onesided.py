@@ -6,7 +6,13 @@ import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from tpu_patterns.comm import OneSidedConfig, local_put, ring_put, run_onesided
+from tpu_patterns.comm import (
+    OneSidedConfig,
+    local_put,
+    local_put_multi,
+    ring_put,
+    run_onesided,
+)
 from tpu_patterns.core.results import Verdict
 
 
@@ -38,6 +44,34 @@ class TestRingPut:
         np.testing.assert_array_equal(out, np.roll(np.asarray(x), rows, axis=0))
 
 
+class TestLocalPutMulti:
+    def _roundtrip(self, shape, chunks):
+        n = int(np.prod(shape))
+        x = jnp.arange(n, dtype=jnp.float32).reshape(shape)
+        out = local_put_multi(x, chunks=chunks, interpret=True)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+    def test_exact_tiling(self):
+        self._roundtrip((16, 256), chunks=8)
+
+    def test_chunks_shrink_to_divisor(self):
+        # rows=6, chunks=4: must walk down to 3 concurrent DMAs
+        self._roundtrip((6, 256), chunks=4)
+
+    def test_prime_rows(self):
+        self._roundtrip((7, 256), chunks=4)
+
+    def test_more_chunks_than_rows(self):
+        self._roundtrip((2, 128), chunks=8)
+
+    def test_single_chunk_is_monolithic(self):
+        self._roundtrip((4, 128), chunks=1)
+
+    def test_rows_zero_early_out(self):
+        x = jnp.zeros((0, 128), jnp.float32)
+        assert local_put_multi(x, interpret=True).shape == (0, 128)
+
+
 class TestRunOneSided:
     def test_multi_device(self, mesh1d):
         recs = run_onesided(mesh1d, OneSidedConfig(count=2048, reps=2, warmup=1))
@@ -53,6 +87,28 @@ class TestRunOneSided:
         (rec,) = run_onesided(mesh, OneSidedConfig(count=2048, reps=2, warmup=1))
         assert rec.mode == "local_put"
         assert rec.verdict is Verdict.SUCCESS, rec.notes
+        # auto mode measured both schedules and recorded the winner
+        assert "bandwidth_GBps_streamed" in rec.metrics
+        assert "bandwidth_GBps_multi" in rec.metrics
+        assert any(n.startswith("auto-selected kernel:") for n in rec.notes)
+
+    @pytest.mark.parametrize("kernel", ["streamed", "multi", "mono"])
+    def test_single_device_explicit_kernel(self, devices, kernel):
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(devices[:1]), ("x",))
+        (rec,) = run_onesided(
+            mesh, OneSidedConfig(count=2048, reps=2, warmup=1, kernel=kernel)
+        )
+        assert rec.verdict is Verdict.SUCCESS, rec.notes
+        assert rec.metrics[f"bandwidth_GBps_{kernel}"] > 0
+
+    def test_unknown_kernel_raises(self, devices):
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(devices[:1]), ("x",))
+        with pytest.raises(ValueError, match="unknown onesided kernel"):
+            run_onesided(mesh, OneSidedConfig(count=2048, kernel="bogus"))
 
 
 class TestLocalPutStreamedEdges:

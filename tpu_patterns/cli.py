@@ -111,7 +111,9 @@ def _cmd_p2p(args, writer: ResultWriter) -> None:
     from tpu_patterns.comm.p2p import P2PConfig, run_p2p
 
     n = args.devices or len(jax.devices())
-    if n < 2 or n % 2:
+    # one_sided degrades to the single-chip local HBM put; the two-sided
+    # pair exchange genuinely needs pairs (≙ peer2pear.cpp:107-110)
+    if args.transport != "one_sided" and (n < 2 or n % 2):
         _world_skip(
             writer, "p2p", args.transport, n,
             f"p2p needs an even device count >= 2, have {n}",
@@ -126,6 +128,8 @@ def _cmd_p2p(args, writer: ResultWriter) -> None:
             warmup=args.warmup,
             min_bandwidth=args.min_bandwidth,
             seed=args.seed,
+            kernel=args.put_kernel,
+            chunks=args.chunks,
         )
         run_onesided(mesh, cfg, writer)
     else:
@@ -476,6 +480,18 @@ def build_parser() -> argparse.ArgumentParser:
         default="two_sided",
         help="ppermute exchange vs Pallas remote-DMA put (≙ -DUSE_WIN)",
     )
+    p.add_argument(
+        "--put-kernel",
+        choices=("auto", "streamed", "multi", "mono"),
+        default="auto",
+        help="one_sided single-chip DMA schedule (auto = measure and pick)",
+    )
+    p.add_argument(
+        "--chunks",
+        type=int,
+        default=8,
+        help="one_sided multi: concurrent outstanding DMAs",
+    )
     _add_mesh_args(p)
 
     c = sub.add_parser("concurrency", help="serial-vs-concurrent harness")
@@ -587,7 +603,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: list[str] | None = None) -> int:
+    from tpu_patterns.runtime import setup_jax
+
     args = build_parser().parse_args(argv)
+    setup_jax()  # platform override + compile cache BEFORE any backend touch
     writer = ResultWriter(jsonl_path=args.jsonl)
     handlers = {
         "p2p": _cmd_p2p,

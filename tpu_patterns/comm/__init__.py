@@ -22,6 +22,8 @@ from tpu_patterns.comm.ring import (  # noqa: F401
 from tpu_patterns.comm.onesided import (  # noqa: F401
     OneSidedConfig,
     local_put,
+    local_put_multi,
+    local_put_streamed,
     ring_put,
     run_onesided,
 )

@@ -9,7 +9,7 @@ the receiver's buffer (RDMA), and the fence/epoch discipline becomes DMA
 semaphores: ``send_sem`` completes the local epoch, ``recv_sem`` the remote
 exposure epoch; ``.wait()`` on both is the fence.
 
-Three kernels:
+Four kernels:
 * ``ring_put``  — every device puts its shard into its ring neighbor's
   output buffer (multi-device; interpret-mode on CPU meshes, Mosaic on TPU).
 * ``local_put`` — same one-sided discipline against the device's own HBM as
@@ -17,8 +17,14 @@ Three kernels:
   put-semantics demo.
 * ``local_put_streamed`` — the put re-scheduled for bandwidth: a Pallas
   grid pipeline streams blocks through VMEM on double-buffered async DMAs.
-  This is what the single-chip benchmark (``run_onesided`` on one device,
-  hence ``bench.py`` on a 1-chip host) measures as HBM copy bandwidth.
+* ``local_put_multi`` — the put split into N disjoint direct HBM->HBM
+  DMAs, all outstanding at once on their own semaphores (≙ N posted
+  ``MPI_Put`` in one epoch, fenced together): deeper engine occupancy than
+  the single monolithic DMA without the VMEM bounce.
+
+On one device ``run_onesided`` auto-selects the faster of the streamed and
+multi schedules (``OneSidedConfig.kernel="auto"``) — the measured winner is
+the chip's HBM copy headline (hence ``bench.py`` on a 1-chip host).
 """
 
 from __future__ import annotations
@@ -97,6 +103,15 @@ def _copy_block_kernel(x_ref, out_ref):
     out_ref[...] = x_ref[...]
 
 
+def _largest_divisor_at_most(rows: int, k: int) -> int:
+    """Largest divisor of ``rows`` that is <= ``k`` (>= 1): both DMA
+    schedules need their row-slices to tile the buffer exactly."""
+    k = max(1, min(k, rows))
+    while rows % k:
+        k -= 1
+    return k
+
+
 def local_put_streamed(
     x: jax.Array, block_rows: int = 1024, interpret: bool = False
 ):
@@ -113,15 +128,56 @@ def local_put_streamed(
     # default): tile only axis 0, so bound block_rows by the trailing-dims
     # byte size too.
     row_bytes = max(1, (x.size // rows) * x.dtype.itemsize)
-    block_rows = min(block_rows, rows, max(1, 4 * 1024 * 1024 // row_bytes))
-    while rows % block_rows:  # grid must tile exactly
-        block_rows -= 1
+    block_rows = _largest_divisor_at_most(
+        rows, min(block_rows, max(1, 4 * 1024 * 1024 // row_bytes))
+    )
     return pl.pallas_call(
         _copy_block_kernel,
         grid=(rows // block_rows,),
         in_specs=[pl.BlockSpec((block_rows,) + x.shape[1:], lambda i: (i,) + (0,) * (x.ndim - 1))],
         out_specs=pl.BlockSpec((block_rows,) + x.shape[1:], lambda i: (i,) + (0,) * (x.ndim - 1)),
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=interpret,
+    )(x)
+
+
+def _multi_put_kernel(n_chunks, chunk_rows, x_ref, out_ref, sems):
+    """Split the buffer into ``n_chunks`` row-slices and post every
+    HBM->HBM DMA before waiting on any: one exposure epoch, N puts in
+    flight (≙ the reference's posted puts inside one fence pair,
+    peer2pear.cpp:76-81)."""
+    copies = [
+        pltpu.make_async_copy(
+            x_ref.at[pl.ds(i * chunk_rows, chunk_rows)],
+            out_ref.at[pl.ds(i * chunk_rows, chunk_rows)],
+            sems.at[i],
+        )
+        for i in range(n_chunks)
+    ]
+    for c in copies:
+        c.start()
+    for c in copies:  # the closing fence: wait on every chunk's semaphore
+        c.wait()
+
+
+def local_put_multi(x: jax.Array, chunks: int = 8, interpret: bool = False):
+    """One-sided put as ``chunks`` concurrent direct HBM->HBM DMAs.
+
+    Unlike :func:`local_put_streamed` the data never bounces through VMEM,
+    so there is no block-size/VMEM budget to tune — the knob is engine
+    occupancy (how many DMAs are outstanding).  ``chunks`` shrinks to the
+    nearest divisor of the row count so the slices tile exactly.
+    """
+    rows = x.shape[0] if x.ndim else 0
+    if rows == 0 or x.size == 0:
+        return x
+    chunks = _largest_divisor_at_most(rows, chunks)
+    return pl.pallas_call(
+        functools.partial(_multi_put_kernel, chunks, rows // chunks),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((chunks,))],
         interpret=interpret,
     )(x)
 
@@ -134,6 +190,11 @@ class OneSidedConfig:
     warmup: int = 2
     min_bandwidth: float = -1.0
     seed: int = 0
+    # single-device kernel schedule: auto | streamed | multi | mono
+    # (auto measures streamed + multi and reports the winner)
+    kernel: str = "auto"
+    block_rows: int = 1024  # streamed: rows per VMEM block
+    chunks: int = 8  # multi: concurrent outstanding DMAs
 
 
 
@@ -149,6 +210,13 @@ def run_onesided(
 
     setup_jax()
     cfg = cfg or OneSidedConfig()
+    if cfg.kernel not in ("auto", "streamed", "multi", "mono"):
+        # validated regardless of mesh size: a typo must not be silently
+        # dropped just because the multi-device ring path ignores it
+        raise ValueError(
+            f"unknown onesided kernel {cfg.kernel!r}; "
+            "want auto|streamed|multi|mono"
+        )
     writer = writer or ResultWriter()
     interpret = use_interpret()
     spec = get_dtype(cfg.dtype)
@@ -199,18 +267,30 @@ def run_onesided(
     else:
         mode = "local_put"
         x = verify.fill_randomly(count, cfg.dtype, cfg.seed).reshape(rows, cols)
-        fn = jax.jit(lambda a: local_put_streamed(a, interpret=interpret))
 
-        chained = jax.jit(
-            lambda a, k: jnp.sum(
-                timing.unrolled_chain(
-                    lambda b: local_put_streamed(b, interpret=interpret), a, k
-                ).astype(jnp.float32)
+        puts = {
+            "streamed": lambda b: local_put_streamed(
+                b, block_rows=cfg.block_rows, interpret=interpret
+            ),
+            "multi": lambda b: local_put_multi(
+                b, chunks=cfg.chunks, interpret=interpret
+            ),
+            "mono": lambda b: local_put(b, interpret=interpret),
+        }
+        if cfg.kernel == "auto":
+            candidates = {k: puts[k] for k in ("streamed", "multi")}
+        else:
+            candidates = {cfg.kernel: puts[cfg.kernel]}
+
+        def one_kernel(put):
+            fn = jax.jit(put)
+            chained = jax.jit(
+                lambda a, k: jnp.sum(
+                    timing.unrolled_chain(put, a, k).astype(jnp.float32)
+                )
             )
-        )
-
-        def build_chain(k: int):
-            return lambda: chained(x, jnp.int32(k))
+            build = lambda k: (lambda: chained(x, jnp.int32(k)))  # noqa: E731
+            return fn, build
 
         num_transfers = 1
 
@@ -219,11 +299,34 @@ def run_onesided(
         f"onesided {mode}: {shard_bytes / 1e6:.2f} MB/put, "
         f"{num_transfers} transfer(s), dtype={cfg.dtype}"
     )
-    res = timing.measure_chain(
-        build_chain, reps=cfg.reps, warmup=cfg.warmup,
-        direct_fn=lambda: fn(x), ops_per_iter=timing.CHAIN_UNROLL,
-    )
-    gbps = res.gbps(shard_bytes * num_transfers)
+    extra_metrics: dict[str, float] = {}
+    notes: list[str] = []
+    if mode == "ring_put":
+        res = timing.measure_chain(
+            build_chain, reps=cfg.reps, warmup=cfg.warmup,
+            direct_fn=lambda: fn(x), ops_per_iter=timing.CHAIN_UNROLL,
+        )
+        gbps = res.gbps(shard_bytes * num_transfers)
+    else:
+        # Auto-select: measure every candidate schedule with the full
+        # discipline and keep the winner — the same "measure, then pick"
+        # move as the concurrency auto-tuner (≙ main.cpp:226-258), applied
+        # to DMA scheduling instead of command balancing.
+        best = None
+        for name, put in candidates.items():
+            kfn, kbuild = one_kernel(put)
+            kres = timing.measure_chain(
+                kbuild, reps=cfg.reps, warmup=cfg.warmup,
+                direct_fn=lambda: kfn(x), ops_per_iter=timing.CHAIN_UNROLL,
+            )
+            kgbps = kres.gbps(shard_bytes)
+            extra_metrics[f"bandwidth_GBps_{name}"] = kgbps
+            writer.progress(f"onesided local_put[{name}]: {kgbps:.1f} GB/s")
+            if best is None or kgbps > best[2]:
+                best = (name, kfn, kgbps, kres)
+        name, fn, gbps, res = best
+        if len(candidates) > 1:
+            notes.append(f"auto-selected kernel: {name}")
 
     out = np.asarray(fn(x))
     if mode == "ring_put":
@@ -244,9 +347,11 @@ def run_onesided(
             "min_time_us": res.us(),
             "bytes_per_put": float(shard_bytes),
             "checksum_ok": float(data_ok),
+            **extra_metrics,
         },
         verdict=verdict,
     )
+    rec.notes.extend(notes)
     if not data_ok:
         rec.notes.append("one-sided put data mismatch")
     return [writer.record(rec)]

@@ -13,6 +13,16 @@ def use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _backends_initialized() -> bool:
+    """Whether any JAX backend client already exists in this process."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge.backends_are_initialized())
+    except Exception:  # private API moved: assume the risky state
+        return True
+
+
 def setup_jax(cache_dir: str | None = None) -> None:
     """Enable the persistent XLA compilation cache.
 
@@ -20,8 +30,24 @@ def setup_jax(cache_dir: str | None = None) -> None:
     to build; sweeps re-run the same programs across many processes, so the
     on-disk cache pays each compile once (measured ~8x faster warm start).
     Safe to call multiple times; no-op if the user already configured one.
+
+    Also honors ``TPU_PATTERNS_PLATFORM`` (e.g. ``cpu``) via an *in-process*
+    ``jax_platforms`` update: environment-level ``JAX_PLATFORMS`` can be
+    intercepted by site plugins whose backend init hangs when the device
+    tunnel is dead (the round-1 failure mode), while the in-process config
+    never touches the plugin.  ``TPU_PATTERNS_CPU_DEVICES`` sets the virtual
+    device count for a CPU-simulated mesh.
     """
     import jax
+
+    plat = os.environ.get("TPU_PATTERNS_PLATFORM")
+    if plat and not _backends_initialized():
+        # Once backends exist, jax_platforms updates are silently inert and
+        # jax_num_cpu_devices updates raise — apply only while they can work.
+        jax.config.update("jax_platforms", plat)
+        n = os.environ.get("TPU_PATTERNS_CPU_DEVICES")
+        if plat == "cpu" and n:
+            jax.config.update("jax_num_cpu_devices", int(n))
 
     if jax.config.jax_compilation_cache_dir:
         return
