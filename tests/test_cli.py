@@ -166,9 +166,11 @@ class TestSweep:
         assert {s.name.split(".")[0] for s in par} == {
             "pipeline", "moe", "flagship"
         }
+        hier = sweep.specs_for("hier", quick=True)
+        assert len(hier) == 2  # 2 dcn splits x 1 dtype
         assert len(sweep.specs_for("all", quick=True)) == len(p2p) + len(con) + len(
             sweep.specs_for("allreduce", quick=True)
-        ) + len(lc) + len(par)
+        ) + len(lc) + len(par) + len(hier)
 
     def test_unknown_name_filter(self, tmp_path):
         with pytest.raises(ValueError, match="unknown cell name"):

@@ -130,6 +130,7 @@ def _cmd_p2p(args, writer: ResultWriter) -> None:
             seed=args.seed,
             kernel=args.put_kernel,
             chunks=args.chunks,
+            block_rows=args.block_rows,
         )
         run_onesided(mesh, cfg, writer)
     else:
@@ -143,6 +144,39 @@ def _cmd_p2p(args, writer: ResultWriter) -> None:
             seed=args.seed,
         )
         run_p2p(mesh, cfg, writer)
+
+
+def _cmd_hier(args, writer: ResultWriter) -> None:
+    import jax
+
+    from tpu_patterns.comm.hierarchical import HierConfig, run_hierarchical
+
+    avail = len(jax.devices())
+    n = args.devices or avail
+    if n > avail:  # same contract as _build_mesh's explicit error
+        raise SystemExit(f"error: --devices {n} exceeds the {avail} available")
+    if args.dcn < 1 or n % args.dcn or n // args.dcn < 2:
+        _world_skip(
+            writer, "hierarchical", "hier", n,
+            f"need dcn|{n} and ici >= 2, have dcn={args.dcn}",
+        )
+        return
+    # Deliberately NOT placement-reordered: the (dcn, ici) hierarchy IS the
+    # placement, and jax.devices() default order (by process/slice) is the
+    # only order whose row-major reshape keeps 'ici' rows within a slice.
+    from jax.sharding import Mesh
+    import numpy as np
+
+    mesh = Mesh(np.array(jax.devices()[:n]), ("x",))
+    cfg = HierConfig(
+        count=args.count,
+        dtype=args.dtype,
+        dcn=args.dcn,
+        reps=args.reps,
+        warmup=args.warmup,
+        seed=args.seed,
+    )
+    run_hierarchical(mesh, cfg, writer)
 
 
 def _cmd_concurrency(args, writer: ResultWriter) -> None:
@@ -492,7 +526,25 @@ def build_parser() -> argparse.ArgumentParser:
         default=8,
         help="one_sided multi: concurrent outstanding DMAs",
     )
+    p.add_argument(
+        "--block-rows",
+        type=int,
+        default=1024,
+        help="one_sided streamed: rows per VMEM block",
+    )
     _add_mesh_args(p)
+
+    h = sub.add_parser(
+        "hier", help="multi-slice hierarchical allreduce (ICI-inner, DCN-outer)"
+    )
+    from tpu_patterns.comm.hierarchical import HierConfig
+
+    add_config_args(h, HierConfig)
+    # no placement/mechanism args: the (dcn, ici) split is the placement,
+    # and it must follow the default (slice-ordered) device order
+    h.add_argument(
+        "--devices", type=int, default=0, help="number of devices (0 = all)"
+    )
 
     c = sub.add_parser("concurrency", help="serial-vs-concurrent harness")
     from tpu_patterns.concurrency.harness import ConcurrencyConfig
@@ -587,12 +639,9 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("interop", help="native FFI round-trip proofs")
 
     s = sub.add_parser("sweep", help="config-matrix sweeps (≙ run*.sh)")
-    s.add_argument(
-        "suite",
-        choices=(
-            "p2p", "concurrency", "allreduce", "longctx", "parallel", "all"
-        ),
-    )
+    from tpu_patterns.sweep import SUITES
+
+    s.add_argument("suite", choices=(*SUITES, "all"))
     s.add_argument("--out", default="results", help="log/JSONL directory")
     s.add_argument("--quick", action="store_true", help="tiny workloads")
 
@@ -610,6 +659,7 @@ def main(argv: list[str] | None = None) -> int:
     writer = ResultWriter(jsonl_path=args.jsonl)
     handlers = {
         "p2p": _cmd_p2p,
+        "hier": _cmd_hier,
         "concurrency": _cmd_concurrency,
         "allreduce": _cmd_allreduce,
         "longctx": _cmd_longctx,

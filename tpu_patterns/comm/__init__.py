@@ -27,3 +27,10 @@ from tpu_patterns.comm.onesided import (  # noqa: F401
     ring_put,
     run_onesided,
 )
+from tpu_patterns.comm.hierarchical import (  # noqa: F401
+    HierConfig,
+    flat_allreduce,
+    hierarchical_allreduce,
+    run_hierarchical,
+    traffic_model,
+)

@@ -1,0 +1,263 @@
+"""Hierarchical (multi-slice) collectives: ICI-inner, DCN-outer.
+
+The reference's communication backend is single-tier: GPU-aware MPICH over
+Xe-Link inside a node, with MPI hiding any node boundary (SURVEY.md §2.4).
+TPU pods make the tier boundary explicit — ICI within a slice (fast, torus),
+DCN between slices (slow, ethernet) — and the idiomatic design expresses it
+in the mesh itself: an outer ``dcn`` axis over slices and an inner ``ici``
+axis within each slice, exactly how multi-slice JAX jobs lay out their
+device mesh.
+
+The pattern here is the standard hierarchical decomposition of a cross-slice
+allreduce (the gradient-sync kernel of multi-slice data parallelism):
+
+    reduce_scatter(ici)  ->  allreduce(dcn)  ->  all_gather(ici)
+
+Each device ships only ``1/ici`` of the buffer across the slow DCN tier —
+the inner reduce-scatter pre-combines within the slice — versus a flat
+allreduce whose ring crosses the DCN boundary with full-size chunks.  The
+two variants are measured side by side and verified against the same
+elementwise invariant as the allreduce miniapp (≙ the reference's
+``size(size-1)/2`` gate, allreduce-mpi-sycl.cpp:192-204).
+
+Traffic accounting per device (N payload bytes, p = ici x dcn devices):
+
+    flat ring:   2 (p-1)/p N     on whichever links the flat ring crosses —
+                 including (dcn-1) full-chunk DCN crossings per round
+    hierarchical:
+        ici tier: 2 (ici-1)/ici N          (reduce-scatter + all-gather)
+        dcn tier: 2 (dcn-1)/dcn N / ici    (allreduce of the scattered shard)
+
+i.e. the DCN tier carries ``ici``-times fewer bytes — the whole point, and
+the number the Record carries (``dcn_bytes_per_device``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_patterns.comm import verify
+from tpu_patterns.comm.dtypes import get_dtype
+from tpu_patterns.core import timing
+from tpu_patterns.core.results import Record, ResultWriter, Verdict
+
+
+def flat_allreduce(x: jax.Array, ici_axis: str, dcn_axis: str) -> jax.Array:
+    """One-shot allreduce over both tiers: XLA owns the schedule (≙ the
+    library path, MPI_Allreduce over all ranks regardless of fabric)."""
+    return lax.psum(x, (dcn_axis, ici_axis))
+
+
+def hierarchical_allreduce(
+    x: jax.Array, ici_axis: str, ici_size: int, dcn_axis: str
+) -> jax.Array:
+    """reduce_scatter over ICI, allreduce the shard over DCN, all_gather
+    over ICI — the scaling-book multi-slice gradient-sync decomposition.
+
+    Requires the leading dim divisible by ``ici_size`` (the scatter tiling);
+    pad upstream if needed, as with :func:`ring_allreduce_optimal`.
+    """
+    n = x.shape[0]
+    if n % ici_size != 0:
+        raise ValueError(
+            f"leading dim {n} not divisible by ici axis size {ici_size}"
+        )
+    shard = lax.psum_scatter(x, ici_axis, scatter_dimension=0, tiled=True)
+    shard = lax.psum(shard, dcn_axis)  # only N/ici bytes cross the slow tier
+    return lax.all_gather(shard, ici_axis, axis=0, tiled=True)
+
+
+VARIANTS = ("flat", "hier")
+
+
+def traffic_model(
+    n_bytes: int, ici: int, dcn: int
+) -> dict[str, float]:
+    """Analytic per-device wire bytes of each variant (module docstring)."""
+    p = ici * dcn
+    return {
+        "flat_bytes_per_device": 2 * (p - 1) / p * n_bytes,
+        "ici_bytes_per_device": 2 * (ici - 1) / ici * n_bytes,
+        "dcn_bytes_per_device": 2 * (dcn - 1) / dcn * n_bytes / ici,
+    }
+
+
+@dataclasses.dataclass
+class HierConfig:
+    count: int = 2**22  # per-device elements (gradient-shard scale)
+    dtype: str = "float32"
+    dcn: int = 2  # outer (slice) axis size; inner = devices // dcn
+    reps: int = 5
+    warmup: int = 2
+    seed: int = 0
+
+
+def _mesh2d(mesh: Mesh | None, dcn: int) -> Mesh:
+    """Reshape a mesh (or all devices) into the (dcn, ici) hierarchy view.
+
+    CONTRACT: the incoming device order must follow slice boundaries —
+    ``jax.devices()`` default order (by process/slice) does, so a row-major
+    reshape keeps each ``ici`` row inside one slice.  Do NOT pass a
+    placement-reordered mesh (topo.placement modes): the per-tier traffic
+    attribution would silently lie.  On the CPU-simulated mesh any split
+    exercises the same program.
+    """
+    devs = (
+        list(mesh.devices.flat) if mesh is not None else jax.devices()
+    )
+    if dcn < 1 or len(devs) % dcn:
+        raise ValueError(
+            f"dcn axis size {dcn} must divide device count {len(devs)}"
+        )
+    arr = np.array(devs).reshape(dcn, len(devs) // dcn)
+    return Mesh(arr, ("dcn", "ici"))
+
+
+def run_hierarchical(
+    mesh: Mesh | None,
+    cfg: HierConfig | None = None,
+    writer: ResultWriter | None = None,
+) -> list[Record]:
+    """Measure flat vs hierarchical cross-tier allreduce on a (dcn, ici)
+    mesh; verify both against the host-computed elementwise sum."""
+    from tpu_patterns.runtime import setup_jax
+
+    setup_jax()
+    cfg = cfg or HierConfig()
+    writer = writer or ResultWriter()
+    spec = get_dtype(cfg.dtype)
+
+    m = _mesh2d(mesh, cfg.dcn)
+    dcn, ici = (int(s) for s in m.devices.shape)
+    p = dcn * ici
+    if ici < 2:
+        rec = Record(
+            pattern="hierarchical", mode="hier", commands=f"{dcn}x{ici}",
+            verdict=Verdict.SKIPPED,
+            notes=[f"hierarchy needs ici >= 2, have {dcn}x{ici}"],
+        )
+        return [writer.record(rec)]
+
+    # per-device length must tile the ICI scatter
+    n = max(ici, cfg.count - cfg.count % ici)
+    n_bytes = n * spec.itemsize
+    x_global = verify.fill_randomly(p * n, cfg.dtype, cfg.seed).reshape(
+        dcn, ici, n
+    )
+    if np.issubdtype(spec.canonical, np.integer):
+        # sum in the wire dtype so host wraparound matches the device's
+        want = (
+            np.asarray(x_global)
+            .sum(axis=(0, 1), dtype=spec.canonical)
+            .astype(np.float64)
+        )
+    else:
+        want = np.asarray(x_global, dtype=np.float64).sum(axis=(0, 1))
+    sharding = NamedSharding(m, P("dcn", "ici", None))
+    x = jax.device_put(jnp.asarray(x_global), sharding)
+    jax.block_until_ready(x)
+
+    fns = {
+        "flat": lambda b: flat_allreduce(b, "ici", "dcn"),
+        "hier": lambda b: hierarchical_allreduce(b, "ici", ici, "dcn"),
+    }
+    model = traffic_model(n_bytes, ici, dcn)
+    records = []
+    for name in VARIANTS:
+        body = fns[name]
+
+        def block(a, body=body):
+            return body(a[0, 0])[None, None]
+
+        fn = jax.jit(
+            jax.shard_map(
+                block, mesh=m,
+                in_specs=P("dcn", "ici", None), out_specs=P("dcn", "ici", None),
+            )
+        )
+
+        # Chain for amortized timing, in the WIRE dtype (a float32 chain
+        # would misreport wire bytes for 2- and 1-byte dtypes).  Floats
+        # renormalize by 1/p each hop so the value stays fixed (allreduce
+        # of a replicated buffer = p * buffer); integers just wrap — the
+        # chain measures the collective either way.
+        # The fori_loop carry must stay varying over both mesh axes to match
+        # its input type, but each variant leaves a different residue — psum
+        # drops every summed axis, all_gather keeps its axis varying — so
+        # re-mark exactly the missing axes (a type-level cast, no data).
+        def revary(y):
+            have = getattr(jax.typeof(y), "vma", frozenset())
+            missing = tuple(ax for ax in ("dcn", "ici") if ax not in have)
+            return lax.pcast(y, missing, to="varying") if missing else y
+
+        if np.issubdtype(spec.canonical, np.integer):
+
+            def op(b, body=body):
+                return revary(body(b[0, 0]))[None, None]
+
+        else:
+            inv_p = jnp.asarray(1.0 / p).astype(x.dtype)
+
+            def op(b, body=body):
+                return revary(body(b[0, 0]) * inv_p)[None, None]
+
+        def chain(a, k):
+            y = timing.unrolled_chain(op, a, k)
+            return jnp.sum(y.astype(jnp.float32))[None, None, None]
+
+        chained = jax.jit(
+            jax.shard_map(
+                chain, mesh=m,
+                in_specs=(P("dcn", "ici", None), P()),
+                out_specs=P("dcn", "ici", None),
+            )
+        )
+
+        res = timing.measure_chain(
+            lambda k: (lambda: chained(x, jnp.int32(k))),
+            reps=cfg.reps, warmup=cfg.warmup,
+            direct_fn=lambda: fn(x), ops_per_iter=timing.CHAIN_UNROLL,
+            label=name,
+        )
+
+        out = np.asarray(fn(x), dtype=np.float64)[0, 0]
+        # magnitude-scaled gate (≙ the miniapp's elementwise check with the
+        # ADVICE round-1 fix: tolerance relative to the reference magnitude)
+        tol = (
+            0.0
+            if np.issubdtype(spec.canonical, np.integer)
+            # jnp.finfo, not np.finfo: the latter rejects ml_dtypes (bfloat16)
+            else 64
+            * float(jnp.finfo(spec.canonical).eps)
+            * max(1.0, np.abs(want).max())
+        )
+        data_ok = bool((np.abs(out - want) <= tol).all())
+
+        wire = model["flat_bytes_per_device"] if name == "flat" else (
+            model["ici_bytes_per_device"] + model["dcn_bytes_per_device"]
+        )
+        gbps = wire / res.per_op_ns
+        writer.metric(f"{name} allreduce", res.us() / 1e3, "ms")
+        rec = Record(
+            pattern="hierarchical",
+            mode=name,
+            commands=f"{dcn}x{ici}dev x {n_bytes // 1_000_000}MB",
+            metrics={
+                "time_us": res.us(),
+                "wire_GBps_per_device": gbps,
+                "checksum_ok": float(data_ok),
+                **{k: float(v) for k, v in model.items()},
+            },
+            verdict=Verdict.SUCCESS if data_ok else Verdict.FAILURE,
+        )
+        if not data_ok:
+            rec.notes.append("hierarchical allreduce result mismatch")
+        records.append(writer.record(rec))
+    return records

@@ -261,8 +261,25 @@ def parallel_specs(quick: bool = False) -> list[SweepSpec]:
     return specs
 
 
+def hier_specs(quick: bool = False) -> list[SweepSpec]:
+    """Multi-slice hierarchy matrix: outer (DCN) axis size x dtype — the
+    flat-vs-hierarchical contrast at each hierarchy split."""
+    count = ("--count", "4096", "--reps", "2") if quick else ()
+    specs = []
+    for dcn in (2, 4):
+        for dtype in ("float32",) if quick else ("float32", "int32"):
+            specs.append(
+                SweepSpec(
+                    name=f"hier.dcn{dcn}.{dtype}",
+                    argv=("hier", "--dcn", str(dcn), "--dtype", dtype, *count),
+                )
+            )
+    return specs
+
+
 SUITES = {
     "p2p": p2p_specs,
+    "hier": hier_specs,
     "concurrency": concurrency_specs,
     "allreduce": allreduce_specs,
     "longctx": longctx_specs,
