@@ -209,3 +209,114 @@ class TestSweep:
             assert all(r["verdict"] in ("SUCCESS", "SKIPPED") for r in recs)
         out = capsys.readouterr().out
         assert "sweep cell" in out
+
+    def test_sweep_resume_skips_passed_cells(self, tmp_path, capsys):
+        env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        name = "p2p.compact.mesh.two_sided.n2"
+        rc = sweep.run_sweep(
+            "p2p", out_dir=str(tmp_path), quick=True, names=[name],
+            base_env=env,
+        )
+        assert rc == 0
+        st = sweep.load_sweep_state(str(tmp_path), "p2p")
+        assert st[name]["rc"] == 0 and st[name]["sig"]
+        capsys.readouterr()
+        # resume: the passed cell must be skipped (no subprocess), yet the
+        # report still covers it from the on-disk log/jsonl
+        rc = sweep.run_sweep(
+            "p2p", out_dir=str(tmp_path), quick=True, names=[name],
+            base_env=env, resume=True,
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "resume: already passed" in out
+        assert "-> exit" not in out  # nothing re-ran
+        assert "SUCCESS" in out  # report still tabulates the resumed cell
+
+    def test_sweep_resume_reruns_failed_cells(self, tmp_path, monkeypatch):
+        # a cell recorded rc!=0 must re-run under --resume; a fresh (non-
+        # resume) run must forget only the SELECTED cells' history
+        import json
+
+        name = "p2p.compact.mesh.two_sided.n2"
+        os.makedirs(tmp_path, exist_ok=True)
+        with open(tmp_path / "p2p.sweep-state.jsonl", "w") as f:
+            f.write(json.dumps({"cell": name, "rc": 1, "sig": "x"}) + "\n")
+            f.write(json.dumps(
+                {"cell": "p2p.other.cell", "rc": 0, "sig": "y"}
+            ) + "\n")
+            f.write("torn-write{{{\n")  # must be tolerated
+        st = sweep.load_sweep_state(str(tmp_path), "p2p")
+        assert st[name] == {"rc": 1, "sig": "x"}
+        calls = []
+        monkeypatch.setattr(
+            sweep, "run_spec", lambda spec, out, base_env=None: calls.append(
+                spec.name
+            ) or 0,
+        )
+        sweep.run_sweep(
+            "p2p", out_dir=str(tmp_path), quick=True, names=[name],
+            resume=True,
+        )
+        assert calls == [name]
+        # non-resume names-filtered run wipes the selected cell's history
+        # but PRESERVES the unselected cell's checkpoint
+        sweep.run_sweep(
+            "p2p", out_dir=str(tmp_path), quick=True, names=[name],
+        )
+        st = sweep.load_sweep_state(str(tmp_path), "p2p")
+        assert st[name]["rc"] == 0
+        assert st["p2p.other.cell"] == {"rc": 0, "sig": "y"}
+
+    def test_sweep_resume_workload_mismatch_reruns(self, tmp_path, monkeypatch):
+        # a --quick success must NOT satisfy a later full-size resume: the
+        # state entry's workload fingerprint (argv+env) must match too
+        name = "p2p.compact.mesh.two_sided.n2"
+        calls = []
+        monkeypatch.setattr(
+            sweep, "run_spec", lambda spec, out, base_env=None: calls.append(
+                spec.name
+            ) or 0,
+        )
+        sweep.run_sweep("p2p", out_dir=str(tmp_path), quick=True, names=[name])
+        assert calls == [name]
+        # resume with quick=False: same cell name, different workload
+        sweep.run_sweep(
+            "p2p", out_dir=str(tmp_path), quick=False, names=[name],
+            resume=True,
+        )
+        assert calls == [name, name]  # re-ran, not skipped
+        # resume with the SAME workload is skipped
+        sweep.run_sweep(
+            "p2p", out_dir=str(tmp_path), quick=False, names=[name],
+            resume=True,
+        )
+        assert calls == [name, name]
+
+    def test_sweep_resume_env_mismatch_reruns(self, tmp_path, monkeypatch):
+        # a pass under JAX_PLATFORMS=cpu must not satisfy a resume under a
+        # different platform env (CPU-sim numbers posing as hardware)
+        name = "p2p.compact.mesh.two_sided.n2"
+        calls = []
+        monkeypatch.setattr(
+            sweep, "run_spec", lambda spec, out, base_env=None: calls.append(
+                spec.name
+            ) or 0,
+        )
+        cpu_env = {"JAX_PLATFORMS": "cpu"}
+        sweep.run_sweep(
+            "p2p", out_dir=str(tmp_path), quick=True, names=[name],
+            base_env=cpu_env,
+        )
+        sweep.run_sweep(
+            "p2p", out_dir=str(tmp_path), quick=True, names=[name],
+            base_env={}, resume=True,
+        )
+        assert calls == [name, name]  # env changed -> re-ran
+        sweep.run_sweep(
+            "p2p", out_dir=str(tmp_path), quick=True, names=[name],
+            base_env={}, resume=True,
+        )
+        assert calls == [name, name]  # same env -> skipped

@@ -473,7 +473,9 @@ def _cmd_interop(args, writer: ResultWriter) -> None:
 def _cmd_sweep(args, writer: ResultWriter) -> int:
     from tpu_patterns import sweep
 
-    return sweep.run_sweep(args.suite, out_dir=args.out, quick=args.quick)
+    return sweep.run_sweep(
+        args.suite, out_dir=args.out, quick=args.quick, resume=args.resume
+    )
 
 
 def _cmd_report(args, writer: ResultWriter) -> None:
@@ -644,6 +646,11 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("suite", choices=(*SUITES, "all"))
     s.add_argument("--out", default="results", help="log/JSONL directory")
     s.add_argument("--quick", action="store_true", help="tiny workloads")
+    s.add_argument(
+        "--resume",
+        action="store_true",
+        help="skip cells already passed in a previous (interrupted) run",
+    )
 
     r = sub.add_parser("report", help="tabulate logs (≙ parse.py)")
     r.add_argument("paths", nargs="+")

@@ -330,15 +330,111 @@ def run_spec(
     return rc
 
 
+def _state_path(out_dir: str, suite: str) -> str:
+    return os.path.join(out_dir, f"{suite}.sweep-state.jsonl")
+
+
+def _spec_sig(spec: SweepSpec, base_env: Mapping[str, str] | None = None) -> str:
+    """Workload fingerprint: a state entry only satisfies a cell whose argv,
+    spec env AND runtime-relevant ambient env match — a completed --quick
+    run must not satisfy a later full-size run of the same cell name, and a
+    pass on the CPU simulator (JAX_PLATFORMS=cpu) must not satisfy a resume
+    that would run on real hardware.  Only platform/workload-shaping keys
+    are fingerprinted; PATH-class noise would invalidate checkpoints for
+    irrelevant reasons."""
+    import json
+
+    env = os.environ if base_env is None else base_env
+    ambient = sorted(
+        (k, v) for k, v in env.items()
+        if k.startswith(("TPU_PATTERNS_", "JAX_", "XLA_"))
+    )
+    return json.dumps([list(spec.argv), list(spec.env), ambient])
+
+
+def load_sweep_state(out_dir: str, suite: str) -> dict[str, dict]:
+    """Per-cell {rc, sig} from a previous (possibly interrupted) run."""
+    import json
+
+    state: dict[str, dict] = {}
+    try:
+        with open(_state_path(out_dir, suite)) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # a torn write from a killed run
+                if isinstance(rec, dict) and "cell" in rec:
+                    state[str(rec["cell"])] = {
+                        "rc": int(rec.get("rc", 1)),
+                        "sig": rec.get("sig", ""),
+                    }
+    except OSError:
+        pass
+    return state
+
+
+def _record_cell(
+    out_dir: str, suite: str, cell: str, rc: int, sig: str
+) -> None:
+    import json
+    import time
+
+    rec = {"cell": cell, "rc": rc, "sig": sig, "ts": time.time()}
+    with open(_state_path(out_dir, suite), "a") as f:
+        f.write(json.dumps(rec) + "\n")
+        f.flush()
+        os.fsync(f.fileno())  # survive the very crash resume exists for
+
+
+def _forget_cells(out_dir: str, suite: str, cells: set[str]) -> None:
+    """Drop state entries for ``cells`` only: a fresh (non-resume) run of a
+    names-filtered subset must not destroy checkpoint history for the
+    unselected rest of the suite."""
+    import json
+
+    path = _state_path(out_dir, suite)
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError:
+        return
+    kept = []
+    for line in lines:
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # torn writes are dropped on rewrite
+        if isinstance(rec, dict) and str(rec.get("cell")) not in cells:
+            kept.append(line)
+    # atomic rewrite: a crash mid-rewrite must not truncate the history of
+    # the unselected cells this function exists to preserve
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.writelines(kept)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 def run_sweep(
     suite: str,
     out_dir: str = "results",
     quick: bool = False,
     names: Sequence[str] | None = None,
     base_env: Mapping[str, str] | None = None,
+    resume: bool = False,
 ) -> int:
     """Run a suite's matrix; print the tabulated report; return the
-    aggregated exit code (any FAILURE -> 1)."""
+    aggregated exit code (any FAILURE -> 1).
+
+    ``resume=True`` skips cells the state file records as already-succeeded
+    — the checkpoint/resume story the reference lacks entirely (SURVEY.md
+    §5: "all runs are stateless single-shot").  A sweep on flaky hardware
+    (e.g. a device tunnel that hangs mid-suite) re-runs only the failed and
+    not-yet-run cells; their logs/JSONL from the completed cells are still
+    on disk, so the final report covers the whole matrix either way.
+    """
     from tpu_patterns.core.results import parse_log, tabulate_records
 
     specs = specs_for(suite, quick)
@@ -352,10 +448,21 @@ def run_sweep(
             )
     if not specs:
         raise ValueError(f"sweep {suite!r} matched no specs")
+    os.makedirs(out_dir, exist_ok=True)
+    done = load_sweep_state(out_dir, suite) if resume else {}
+    if not resume:  # fresh run: forget history for the selected cells only
+        _forget_cells(out_dir, suite, {s.name for s in specs})
     rc = 0
     for spec in specs:
+        prev = done.get(spec.name)
+        sig = _spec_sig(spec, base_env)
+        if prev and prev["rc"] == 0 and prev["sig"] == sig:
+            print(f"# sweep cell: {spec.name} (resume: already passed)",
+                  flush=True)
+            continue
         print(f"# sweep cell: {spec.name}", flush=True)
         cell_rc = run_spec(spec, out_dir, base_env=base_env)
+        _record_cell(out_dir, suite, spec.name, cell_rc, sig)
         print(f"# -> exit {cell_rc}", flush=True)
         if cell_rc != 0:  # incl. negative (signal-killed) returncodes
             rc = 1
