@@ -242,7 +242,7 @@ class TestSweep:
 
         name = "p2p.compact.mesh.two_sided.n2"
         os.makedirs(tmp_path, exist_ok=True)
-        with open(tmp_path / "p2p.sweep-state.jsonl", "w") as f:
+        with open(tmp_path / "sweep-state.jsonl", "w") as f:
             f.write(json.dumps({"cell": name, "rc": 1, "sig": "x"}) + "\n")
             f.write(json.dumps(
                 {"cell": "p2p.other.cell", "rc": 0, "sig": "y"}
@@ -294,6 +294,34 @@ class TestSweep:
             resume=True,
         )
         assert calls == [name, name]
+
+    def test_sweep_state_shared_across_suite_args(self, tmp_path, monkeypatch):
+        # 'sweep all' and 'sweep p2p' must share one checkpoint history:
+        # a failure recorded by the per-suite run must not be shadowed by a
+        # stale success from the 'all' run
+        name = "p2p.compact.mesh.two_sided.n2"
+        rcs = iter([0, 1])
+        calls = []
+        monkeypatch.setattr(
+            sweep, "run_spec", lambda spec, out, base_env=None: calls.append(
+                spec.name
+            ) or next(rcs),
+        )
+        sweep.run_sweep("all", out_dir=str(tmp_path), quick=True, names=[name])
+        sweep.run_sweep(  # regression recorded under the per-suite arg
+            "p2p", out_dir=str(tmp_path), quick=True, names=[name],
+        )
+        assert sweep.load_sweep_state(str(tmp_path))[name]["rc"] == 1
+        # 'all --resume' sees the latest (failed) state and re-runs
+        monkeypatch.setattr(
+            sweep, "run_spec", lambda spec, out, base_env=None: calls.append(
+                spec.name
+            ) or 0,
+        )
+        sweep.run_sweep(
+            "all", out_dir=str(tmp_path), quick=True, names=[name], resume=True
+        )
+        assert calls == [name, name, name]
 
     def test_sweep_resume_env_mismatch_reruns(self, tmp_path, monkeypatch):
         # a pass under JAX_PLATFORMS=cpu must not satisfy a resume under a

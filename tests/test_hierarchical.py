@@ -61,6 +61,38 @@ class TestHierarchicalAllreduce:
             fn(xs)
 
 
+class TestDetectHierarchy:
+    class FakeDev:
+        def __init__(self, process_index, slice_index=None):
+            self.process_index = process_index
+            if slice_index is not None:
+                self.slice_index = slice_index
+
+    def test_groups_by_slice_index_first(self):
+        from tpu_patterns.comm.hierarchical import detect_hierarchy
+
+        # slice_index present: it wins over process_index
+        devs = [self.FakeDev(0, s) for s in (1, 0, 1, 0)]
+        n, ordered = detect_hierarchy(devs)
+        assert n == 2
+        assert [d.slice_index for d in ordered] == [0, 0, 1, 1]
+
+    def test_falls_back_to_process_index(self):
+        from tpu_patterns.comm.hierarchical import detect_hierarchy
+
+        devs = [self.FakeDev(p) for p in (0, 0, 1, 1, 2, 2)]
+        n, ordered = detect_hierarchy(devs)
+        assert n == 3
+        assert [d.process_index for d in ordered] == [0, 0, 1, 1, 2, 2]
+
+    def test_unequal_groups_raise(self):
+        from tpu_patterns.comm.hierarchical import detect_hierarchy
+
+        devs = [self.FakeDev(p) for p in (0, 0, 1)]
+        with pytest.raises(ValueError, match="unequal slice sizes"):
+            detect_hierarchy(devs)
+
+
 class TestTrafficModel:
     def test_dcn_reduction_factor(self):
         # the decomposition's point: DCN bytes shrink by the ici factor
@@ -108,6 +140,18 @@ class TestRunHierarchical:
     def test_dcn_must_divide_devices(self, mesh1d):
         with pytest.raises(ValueError, match="must divide"):
             run_hierarchical(mesh1d, HierConfig(count=512, dcn=3))
+
+    def test_auto_dcn_single_process_runs_flat_hierarchy(self, mesh1d):
+        # dcn=0 auto-detect: one CPU process -> one group -> dcn=1, ici=8;
+        # the pattern still runs (DCN tier carries zero bytes)
+        recs = run_hierarchical(
+            mesh1d, HierConfig(count=512, dcn=0, reps=1, warmup=0)
+        )
+        assert [r.mode for r in recs] == ["flat", "hier"]
+        for r in recs:
+            assert r.verdict is Verdict.SUCCESS
+            assert r.commands.startswith("1x8dev")
+            assert r.metrics["dcn_bytes_per_device"] == 0.0
 
     def test_degenerate_ici_skips(self, devices):
         # dcn = all devices -> ici=1: nothing to scatter over, SKIPPED

@@ -155,7 +155,17 @@ def _cmd_hier(args, writer: ResultWriter) -> None:
     n = args.devices or avail
     if n > avail:  # same contract as _build_mesh's explicit error
         raise SystemExit(f"error: --devices {n} exceeds the {avail} available")
-    if args.dcn < 1 or n % args.dcn or n // args.dcn < 2:
+    if args.dcn == 0:
+        # auto-detect from slice/process grouping; an unequal grouping is a
+        # world-shape constraint -> a skip, not a crash (the sweep survives)
+        from tpu_patterns.comm.hierarchical import detect_hierarchy
+
+        try:
+            detect_hierarchy(jax.devices()[:n])
+        except ValueError as e:
+            _world_skip(writer, "hierarchical", "hier", n, str(e))
+            return
+    elif args.dcn < 1 or n % args.dcn or n // args.dcn < 2:
         _world_skip(
             writer, "hierarchical", "hier", n,
             f"need dcn|{n} and ici >= 2, have dcn={args.dcn}",

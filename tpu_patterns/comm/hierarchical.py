@@ -93,10 +93,38 @@ def traffic_model(
 class HierConfig:
     count: int = 2**22  # per-device elements (gradient-shard scale)
     dtype: str = "float32"
-    dcn: int = 2  # outer (slice) axis size; inner = devices // dcn
+    dcn: int = 2  # outer (slice) axis size; 0 = auto-detect (slice/process)
     reps: int = 5
     warmup: int = 2
     seed: int = 0
+
+
+def detect_hierarchy(devices) -> tuple[int, list]:
+    """Derive the slice grouping from the devices themselves.
+
+    Groups by ``slice_index`` (reported by multi-slice TPU platforms) with
+    ``process_index`` as the fallback tier boundary (multi-host single-slice
+    jobs: DCN sits between hosts).  Returns ``(n_groups, devices)`` with the
+    devices reordered group-contiguously so a row-major (dcn, ici) reshape
+    honors the real fabric — the topology-derived placement move (≙ the
+    reference's compact_plan mode, tile_mapping.sh:17-20, lifted to the
+    slice tier)."""
+    import collections
+
+    groups: dict[int, list] = collections.defaultdict(list)
+    for d in devices:
+        key = getattr(d, "slice_index", None)
+        if key is None:
+            key = getattr(d, "process_index", 0)
+        groups[int(key)].append(d)
+    sizes = {len(v) for v in groups.values()}
+    if len(sizes) != 1:
+        raise ValueError(
+            f"unequal slice sizes {sorted(len(v) for v in groups.values())}: "
+            "cannot form a rectangular (dcn, ici) mesh"
+        )
+    ordered = [d for k in sorted(groups) for d in groups[k]]
+    return len(groups), ordered
 
 
 def _mesh2d(mesh: Mesh | None, dcn: int) -> Mesh:
@@ -112,6 +140,8 @@ def _mesh2d(mesh: Mesh | None, dcn: int) -> Mesh:
     devs = (
         list(mesh.devices.flat) if mesh is not None else jax.devices()
     )
+    if dcn == 0:  # auto: derive the tier boundary from the devices
+        dcn, devs = detect_hierarchy(devs)
     if dcn < 1 or len(devs) % dcn:
         raise ValueError(
             f"dcn axis size {dcn} must divide device count {len(devs)}"

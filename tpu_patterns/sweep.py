@@ -331,7 +331,12 @@ def run_spec(
 
 
 def _state_path(out_dir: str, suite: str) -> str:
-    return os.path.join(out_dir, f"{suite}.sweep-state.jsonl")
+    # ONE state file per out_dir, not per suite argument: cell names are
+    # already suite-prefixed and unique, and 'sweep all' / 'sweep p2p' must
+    # share history — per-suite files would let a stale 'all' entry skip a
+    # cell whose latest per-suite run failed.
+    del suite
+    return os.path.join(out_dir, "sweep-state.jsonl")
 
 
 def _spec_sig(spec: SweepSpec, base_env: Mapping[str, str] | None = None) -> str:
@@ -340,19 +345,23 @@ def _spec_sig(spec: SweepSpec, base_env: Mapping[str, str] | None = None) -> str
     run must not satisfy a later full-size run of the same cell name, and a
     pass on the CPU simulator (JAX_PLATFORMS=cpu) must not satisfy a resume
     that would run on real hardware.  Only platform/workload-shaping keys
-    are fingerprinted; PATH-class noise would invalidate checkpoints for
-    irrelevant reasons."""
+    are fingerprinted (the prefixes below + the report's context vars,
+    results._CONTEXT_ENV_VARS, e.g. LIBTPU_INIT_ARGS); PATH-class noise
+    would invalidate checkpoints for irrelevant reasons."""
     import json
+
+    from tpu_patterns.core import results
 
     env = os.environ if base_env is None else base_env
     ambient = sorted(
         (k, v) for k, v in env.items()
         if k.startswith(("TPU_PATTERNS_", "JAX_", "XLA_"))
+        or k in results._CONTEXT_ENV_VARS
     )
     return json.dumps([list(spec.argv), list(spec.env), ambient])
 
 
-def load_sweep_state(out_dir: str, suite: str) -> dict[str, dict]:
+def load_sweep_state(out_dir: str, suite: str = "") -> dict[str, dict]:
     """Per-cell {rc, sig} from a previous (possibly interrupted) run."""
     import json
 
