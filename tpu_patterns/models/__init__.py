@@ -13,6 +13,7 @@ from tpu_patterns.models.transformer import (
     init_stack_params,
     make_pipeline_train_step,
     make_train_step,
+    make_zero_train_step,
     param_specs,
     shard_params,
     stack_specs,
@@ -26,6 +27,7 @@ __all__ = [
     "init_stack_params",
     "make_pipeline_train_step",
     "make_train_step",
+    "make_zero_train_step",
     "param_specs",
     "shard_params",
     "stack_specs",

@@ -308,6 +308,200 @@ def make_train_step(
     return jax.jit(sharded), pspecs
 
 
+def _local_shape(shape: tuple, spec: P, mesh: Mesh) -> tuple:
+    """Per-device block shape of a global ``shape`` under ``spec``."""
+    dims = list(shape)
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    for i, ax in enumerate(entries):
+        if ax is None:
+            continue
+        for a in (ax,) if isinstance(ax, str) else tuple(ax):
+            dims[i] //= int(mesh.shape[a])
+    return tuple(dims)
+
+
+def make_zero_train_step(
+    mesh: Mesh,
+    cfg: ModelConfig,
+    lr: float = 1e-3,
+    x_spec: P | None = None,
+    optimizer: str = "adam",
+):
+    """ZeRO-1 twin of :func:`make_train_step` (parallel/zero.py).
+
+    Params persist SHARDED over dp (each device owns a flat 1/dp slice of
+    its tp-local block) and are all_gathered at the top of the step — so
+    they are honestly dp-varying in the type system, which means the
+    backward leaves grads dp-UNREDUCED (no implicit pvary-transpose psum
+    over dp), and ``grad_shard``'s reduce-scatter completes the sum.  The
+    step is the bandwidth-optimal ring allreduce (comm/ring.py) with the
+    optax update between its two halves, and optimizer state only ever
+    exists on the shard: the 1/dp memory claim.  check_vma stays ON.
+
+    Returns ``(step, init_fn, shard_specs)`` with
+    ``init_fn(params) -> (param_shards, opt_state)`` and
+    ``step(param_shards, opt_state, x) -> (param_shards, opt_state, loss)``;
+    shard/state leaves are stacked ``[n_devices, ...]`` in mesh-axis order.
+    ``gather_fn(param_shards) -> params`` rebuilds full (replicated) params
+    for evaluation; it is returned as ``step.gather``.
+    """
+    import optax
+
+    from tpu_patterns.parallel import zero
+
+    x_spec = x_spec or P("dp", "sp", None)
+    dp, sp = int(mesh.shape["dp"]), int(mesh.shape["sp"])
+    specs = param_specs(cfg, _n_experts(mesh, cfg))
+    pspecs = {k: s for k, (_, s) in specs.items()}
+    if optimizer == "adam":
+        tx = optax.adam(lr)
+    elif optimizer == "sgd":
+        tx = optax.sgd(lr)
+    else:
+        raise ValueError(f"unknown optimizer {optimizer!r}; want adam|sgd")
+    mesh_axes = tuple(mesh.axis_names)
+    local_shapes = {
+        k: _local_shape(shape, s, mesh) for k, (shape, s) in specs.items()
+    }
+
+    # Varying axes per param leaf: dp (the shard slice) + whatever the
+    # parameter sharding already varies over (tp).  CRITICALLY sp is never
+    # claimed: the gathered params must stay sp-invariant so the backward's
+    # implicit pvary-transpose still performs the sp gradient sync — only
+    # the dp sync is deferred to grad_shard's reduce-scatter.
+    def _spec_axes(s: P) -> set:
+        out = set()
+        for e in s:
+            if e is None:
+                continue
+            out.update((e,) if isinstance(e, str) else e)
+        return out
+
+    vaxes = {
+        k: tuple(
+            ax
+            for ax in mesh_axes
+            if ax == "dp" or ax in _spec_axes(s)
+        )
+        for k, (_, s) in specs.items()
+    }
+    shard_specs = {k: P(vaxes[k], None) for k in specs}
+
+    # Optimizer-state tree structure from shard-shaped dummies (the real
+    # init runs under shard_map; eval_shape cannot trace axis_index).
+    dtype = jnp.dtype(cfg.dtype)
+    shard_dummy = {
+        k: jax.ShapeDtypeStruct(
+            (zero.shard_size(int(np.prod(ls)), dp),), dtype
+        )
+        for k, ls in local_shapes.items()
+    }
+    state_shapes = jax.eval_shape(tx.init, shard_dummy)
+
+    def _leaf_axes(path) -> tuple:
+        # optax state leaves that mirror a param (mu/nu/momentum dict
+        # entries) inherit that param's varying axes; bookkeeping scalars
+        # (count) get the dp stack only
+        for p in path:
+            if isinstance(p, jax.tree_util.DictKey) and p.key in vaxes:
+                return vaxes[p.key]
+        return ("dp",)
+
+    state_specs = jax.tree_util.tree_map_with_path(
+        lambda path, s: P(_leaf_axes(path), *([None] * len(s.shape))),
+        state_shapes,
+    )
+
+    def _stack(tree_, spec_tree):
+        # leaves -> [1, ...] (one row per device along the claimed axes);
+        # pvary first: a slice/update may be invariant over an axis its
+        # stacked out_spec claims (e.g. count over dp)
+        def one(a, spec):
+            a = jnp.asarray(a)
+            entry = spec[0]  # P normalizes a 1-tuple entry to the bare str
+            claimed = (entry,) if isinstance(entry, str) else tuple(entry or ())
+            have = getattr(jax.typeof(a), "vma", frozenset())
+            missing = tuple(ax for ax in claimed if ax not in have)
+            return (
+                lax.pcast(a, missing, to="varying") if missing else a
+            )[None]
+
+        return jax.tree.map(one, tree_, spec_tree)
+
+    def _unstack(tree_):
+        return jax.tree.map(lambda a: a[0], tree_)
+
+    def init_fn(params):
+        shards = {
+            k: zero.param_shard(params[k], "dp", dp) for k in params
+        }
+        return (
+            _stack(shards, shard_specs),
+            _stack(tx.init(shards), state_specs),
+        )
+
+    init = jax.jit(
+        jax.shard_map(
+            init_fn,
+            mesh=mesh,
+            in_specs=(pspecs,),
+            out_specs=(shard_specs, state_specs),
+        )
+    )
+
+    def _gather(k, shard):
+        return zero.unshard(
+            jax.ShapeDtypeStruct(local_shapes[k], dtype), shard, "dp"
+        )
+
+    def step(pshards, opt_state, x):
+        params = {k: _gather(k, v[0]) for k, v in pshards.items()}
+        loss, grads = jax.value_and_grad(loss_shard)(
+            params,
+            x,
+            cfg,
+            1.0,
+            axes=("dp", "sp"),  # same global objective as make_train_step
+            sp_axis="sp",
+            sp_size=sp,
+            tp_axis="tp",
+        )
+        # params are dp-varying, so grads arrive dp-unreduced: the scatter
+        # performs the dp sum (first half of the optimal ring allreduce)
+        gs = {k: zero.grad_shard(grads[k], "dp", dp) for k in grads}
+        ps = _unstack(pshards)
+        updates, new_state = tx.update(gs, _unstack(opt_state), ps)
+        new_ps = optax.apply_updates(ps, updates)
+        return (
+            _stack(new_ps, shard_specs),
+            _stack(new_state, state_specs),
+            loss,
+        )
+
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(shard_specs, state_specs, x_spec),
+        out_specs=(shard_specs, state_specs, P()),
+    )
+    step_fn = jax.jit(sharded)
+
+    # jitted ONCE here; a per-call jit(shard_map(...)) would retrace and
+    # recompile on every gather
+    gather_fn = jax.jit(
+        jax.shard_map(
+            lambda pshards: {k: _gather(k, v[0]) for k, v in pshards.items()},
+            mesh=mesh,
+            in_specs=(shard_specs,),
+            out_specs=pspecs,
+            check_vma=False,  # gathered params are replicated in value
+        )
+    )
+
+    step_fn.gather = gather_fn
+    return step_fn, init, shard_specs
+
+
 def shard_params(params: dict, mesh: Mesh, cfg: ModelConfig) -> dict:
     specs = param_specs(cfg, _n_experts(mesh, cfg))
     return {
@@ -364,6 +558,7 @@ class FlagshipConfig:
     attn: str = "pallas"  # "xla" | "pallas"
     attn_layout: str = "contiguous"
     moe: bool = False
+    optimizer: str = "sgd"  # sgd | zero-sgd | zero-adam (sharded optimizer)
     reps: int = 10
     warmup: int = 2
     min_tflops: float = -1.0
@@ -416,9 +611,26 @@ def run_flagship(mesh: Mesh, cfg: FlagshipConfig, writer) -> list:
     # Timing lr: small enough that p - lr*g underflows to p (reps cannot
     # diverge the unnormalized objective) but non-zero so XLA cannot fold
     # the update away and DCE the entire backward.
-    step, _ = make_train_step(mesh, mcfg, lr=1e-30)
-    p = shard_params(params, mesh, mcfg)
     sx = jax.device_put(x, NamedSharding(mesh, P("dp", "sp", None)))
+    if cfg.optimizer.startswith("zero"):
+        zstep, zinit, _ = make_zero_train_step(
+            mesh, mcfg, lr=1e-30, optimizer=cfg.optimizer.split("-", 1)[1]
+        )
+        shards0, state0 = zinit(shard_params(params, mesh, mcfg))
+
+        def step(carry, xb):
+            sh, st = carry
+            sh, st, loss = zstep(sh, st, xb)
+            return (sh, st), loss
+
+        p = (shards0, state0)
+    elif cfg.optimizer == "sgd":
+        step, _ = make_train_step(mesh, mcfg, lr=1e-30)
+        p = shard_params(params, mesh, mcfg)
+    else:
+        raise ValueError(
+            f"unknown optimizer {cfg.optimizer!r}; want sgd|zero-sgd|zero-adam"
+        )
 
     def build_chain(k: int):
         # k train steps chained through the updated params (data-dependent:
@@ -454,7 +666,9 @@ def run_flagship(mesh: Mesh, cfg: FlagshipConfig, writer) -> list:
     writer.metric(f"flagship {cfg.attn} train step", tflops, "TFLOP/s")
     rec = Record(
         pattern="flagship",
-        mode=cfg.attn + ("_moe" if cfg.moe else ""),
+        mode=cfg.attn
+        + ("_moe" if cfg.moe else "")
+        + (f"_{cfg.optimizer}" if cfg.optimizer != "sgd" else ""),
         commands=f"dp{dp} sp{sp} tp{int(mesh.shape['tp'])} B{cfg.batch} "
         f"L{cfg.seq} E{cfg.embed} {cfg.dtype}"
         + (" causal" if cfg.causal else "")

@@ -1,12 +1,17 @@
-"""Parallelism-strategy layer: pipeline (pp) and expert (ep) patterns.
+"""Parallelism-strategy layer: pipeline (pp), expert (ep), and sharded-
+optimizer (ZeRO) patterns.
 
 Completes the suite's distribution vocabulary alongside dp (allreduce
-miniapp), tp (psum in models/), and sp (longctx/): both built from the
-same two communication lineages every other pattern uses — the neighbor
-ring (``pipeline``) and the library all-to-all (``moe``).
+miniapp), tp (psum in models/), and sp (longctx/): all built from the
+same communication lineages every other pattern uses — the neighbor
+ring (``pipeline``), the library all-to-all (``moe``), and the
+reduce-scatter/all-gather decomposition (``zero``).
 """
 
 from tpu_patterns.parallel.moe import moe_apply, top1_route
 from tpu_patterns.parallel.pipeline import pipeline_apply
+from tpu_patterns.parallel.zero import zero_apply, zero_init
 
-__all__ = ["moe_apply", "pipeline_apply", "top1_route"]
+__all__ = [
+    "moe_apply", "pipeline_apply", "top1_route", "zero_apply", "zero_init",
+]

@@ -1,0 +1,139 @@
+"""ZeRO-style sharded optimizer over the data-parallel axis.
+
+The reference's ring allreduce (allreduce-mpi-sycl.cpp:173-182) *is* the
+communication kernel of data-parallel training (SURVEY.md §2.3: "the
+allreduce miniapp is DP's comm kernel").  The bandwidth-optimal schedule we
+already ship (`comm/ring.py::ring_allreduce_optimal`) decomposes it into
+reduce-scatter + all-gather; ZeRO (Rajbhandari et al., stage 1) is the
+observation that the optimizer can live *between* those halves:
+
+    reduce_scatter(dp) grads  ->  update MY 1/dp shard  ->  all_gather(dp)
+
+Same wire bytes as the allreduce (2·(dp-1)/dp·N per device), but optimizer
+state (e.g. Adam's two moments) and the update math shrink by the dp
+factor.  This module is optimizer-agnostic: any optax GradientTransformation
+runs on the flat shard, because elementwise transforms are oblivious to
+which slice of the parameter they see.
+
+Everything here executes inside ``shard_map`` (one compiled program; the
+scatter/gather are XLA collectives riding ICI), over ONE named axis.
+Two storage conventions build on these primitives:
+
+* ``zero_init``/``zero_apply`` — params stay replicated between steps (the
+  drop-in swap for an existing replicated train step); grads may arrive
+  unreduced (``grads_reduced=False``: the scatter performs the sum) or
+  pre-reduced (slice–update–gather, still saving the state memory).
+* sharded storage (``models/transformer.py::make_zero_train_step``) —
+  params persist as shards and are gathered at the top of each step.  This
+  is the variant that stays honest under shard_map's varying-axes type
+  checking: sharded params are dp-varying, so the backward really does
+  leave grads dp-unreduced and the scatter really is the dp gradient sync.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def shard_size(n: int, axis_size: int) -> int:
+    """Per-device flat shard length (ceil so every element is owned)."""
+    return -(-n // axis_size)
+
+
+def _padded_flat(a: jax.Array, axis_size: int) -> jax.Array:
+    """Flatten and zero-pad to a multiple of ``axis_size`` (zeros are inert
+    for gradient sums and sliced off on rebuild)."""
+    flat = a.reshape(-1)
+    pad = shard_size(flat.size, axis_size) * axis_size - flat.size
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat
+
+
+def param_shard(p: jax.Array, axis: str, axis_size: int) -> jax.Array:
+    """MY flat 1/axis_size slice of a replicated parameter."""
+    flat = _padded_flat(p, axis_size)
+    k = flat.size // axis_size
+    idx = lax.axis_index(axis)
+    return lax.dynamic_slice_in_dim(flat, idx * k, k)
+
+
+def grad_shard(
+    g: jax.Array, axis: str, axis_size: int, grads_reduced: bool = False
+) -> jax.Array:
+    """MY flat slice of the dp-SUMMED gradient.
+
+    Unreduced grads take the reduce-scatter (the first half of the optimal
+    ring allreduce); pre-reduced grads just slice.
+    """
+    if grads_reduced:
+        return param_shard(g, axis, axis_size)
+    flat = _padded_flat(g, axis_size)
+    return lax.psum_scatter(flat, axis, scatter_dimension=0, tiled=True)
+
+
+def unshard(p: jax.Array, shard: jax.Array, axis: str) -> jax.Array:
+    """all_gather the updated shards and restore the leaf's shape/dtype —
+    the second half of the optimal ring allreduce."""
+    flat = lax.all_gather(shard, axis, axis=0, tiled=True)
+    return flat[: p.size].reshape(p.shape).astype(p.dtype)
+
+
+def zero_init(tx, params, axis: str, axis_size: int):
+    """Optimizer state over MY shard of every leaf: 1/axis_size of the
+    replicated-state footprint.  Call inside shard_map."""
+    shards = jax.tree.map(
+        lambda p: param_shard(p, axis, axis_size), params
+    )
+    return tx.init(shards)
+
+def zero_apply(
+    tx,
+    grads,
+    opt_state,
+    params,
+    axis: str,
+    axis_size: int,
+    grads_reduced: bool = False,
+):
+    """One sharded optimizer step; returns (new_params, new_opt_state).
+
+    Call inside shard_map.  ``tx`` is any optax GradientTransformation
+    whose update is elementwise over leaves (true of sgd/momentum/adam/
+    adamw/rmsprop — anything built from per-element moments).
+    """
+    import optax
+
+    gs = jax.tree.map(
+        lambda g: grad_shard(g, axis, axis_size, grads_reduced), grads
+    )
+    ps = jax.tree.map(lambda p: param_shard(p, axis, axis_size), params)
+    updates, new_state = tx.update(gs, opt_state, ps)
+    new_ps = optax.apply_updates(ps, updates)
+    new_params = jax.tree.map(
+        lambda p, sh: unshard(p, sh, axis), params, new_ps
+    )
+    return new_params, new_state
+
+
+def memory_model(params, axis_size: int, state_arrays: int = 2) -> dict:
+    """Analytic bytes-per-device of optimizer state: replicated vs ZeRO.
+
+    ``state_arrays``: per-param state tensors (2 for Adam's moments, 1 for
+    momentum).  The dp-factor saving is the pattern's headline.
+    """
+    n_bytes = sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(params)
+    )
+    return {
+        "opt_state_bytes_replicated": float(state_arrays * n_bytes),
+        "opt_state_bytes_zero": float(
+            state_arrays * -(-n_bytes // axis_size)
+        ),
+        "wire_bytes_per_device": float(
+            2 * (axis_size - 1) / axis_size * n_bytes
+        ),
+    }

@@ -258,6 +258,18 @@ def parallel_specs(quick: bool = False) -> list[SweepSpec]:
                 env=(("TPU_PATTERNS_SWEEP_CONFIG", "flagship"),),
             )
         )
+    # sharded-optimizer contrast: same step, ZeRO-1 adam in the middle of
+    # the grad allreduce (reduce_scatter -> update shard -> all_gather)
+    specs.append(
+        SweepSpec(
+            name="flagship.zero_adam",
+            argv=(
+                "flagship", "--attn", "xla", "--optimizer", "zero-adam",
+                *flag_small,
+            ),
+            env=(("TPU_PATTERNS_SWEEP_CONFIG", "flagship"),),
+        )
+    )
     return specs
 
 
@@ -362,24 +374,37 @@ def _spec_sig(spec: SweepSpec, base_env: Mapping[str, str] | None = None) -> str
 
 
 def load_sweep_state(out_dir: str, suite: str = "") -> dict[str, dict]:
-    """Per-cell {rc, sig} from a previous (possibly interrupted) run."""
+    """Per-cell {rc, sig} from a previous (possibly interrupted) run.
+
+    Also reads any legacy per-suite ``<suite>.sweep-state.jsonl`` files
+    (the pre-unification layout) so checkpoints recorded before the rename
+    still count; the unified file's entries win on collision.
+    """
+    import glob
     import json
 
     state: dict[str, dict] = {}
-    try:
-        with open(_state_path(out_dir, suite)) as f:
-            for line in f:
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue  # a torn write from a killed run
-                if isinstance(rec, dict) and "cell" in rec:
-                    state[str(rec["cell"])] = {
-                        "rc": int(rec.get("rc", 1)),
-                        "sig": rec.get("sig", ""),
-                    }
-    except OSError:
-        pass
+    unified = _state_path(out_dir, suite)
+    legacy = sorted(
+        p
+        for p in glob.glob(os.path.join(out_dir, "*.sweep-state.jsonl"))
+        if p != unified
+    )
+    for path in legacy + [unified]:
+        try:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # a torn write from a killed run
+                    if isinstance(rec, dict) and "cell" in rec:
+                        state[str(rec["cell"])] = {
+                            "rc": int(rec.get("rc", 1)),
+                            "sig": rec.get("sig", ""),
+                        }
+        except OSError:
+            pass
     return state
 
 
