@@ -323,6 +323,44 @@ class TestSweep:
         )
         assert calls == [name, name, name]
 
+    def test_sweep_state_legacy_migration(self, tmp_path, monkeypatch):
+        # pre-unification per-suite files fold into the unified file keeping
+        # the NEWEST record per cell (ts), then disappear — a stale legacy
+        # pass must not shadow a newer failure
+        import json
+
+        name = "p2p.compact.mesh.two_sided.n2"
+        os.makedirs(tmp_path, exist_ok=True)
+        with open(tmp_path / "all.sweep-state.jsonl", "w") as f:
+            f.write(json.dumps(
+                {"cell": name, "rc": 0, "sig": "s", "ts": 100.0}
+            ) + "\n")
+        with open(tmp_path / "p2p.sweep-state.jsonl", "w") as f:
+            f.write(json.dumps(
+                {"cell": name, "rc": 1, "sig": "s", "ts": 200.0}
+            ) + "\n")
+            f.write(json.dumps(
+                {"cell": "p2p.other", "rc": 0, "sig": "y", "ts": 50.0}
+            ) + "\n")
+        calls = []
+        monkeypatch.setattr(
+            sweep, "run_spec", lambda spec, out, base_env=None: calls.append(
+                spec.name
+            ) or 0,
+        )
+        sweep.run_sweep(
+            "p2p", out_dir=str(tmp_path), quick=True, names=[name],
+            resume=True,
+        )
+        # the newest record for the cell was the FAILURE -> it re-ran
+        assert calls == [name]
+        # legacy files are gone; unified holds the survivors
+        assert not (tmp_path / "all.sweep-state.jsonl").exists()
+        assert not (tmp_path / "p2p.sweep-state.jsonl").exists()
+        st = sweep.load_sweep_state(str(tmp_path))
+        assert st[name]["rc"] == 0  # the re-run just recorded success
+        assert st["p2p.other"] == {"rc": 0, "sig": "y"}
+
     def test_sweep_resume_env_mismatch_reruns(self, tmp_path, monkeypatch):
         # a pass under JAX_PLATFORMS=cpu must not satisfy a resume under a
         # different platform env (CPU-sim numbers posing as hardware)

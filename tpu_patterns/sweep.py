@@ -373,38 +373,80 @@ def _spec_sig(spec: SweepSpec, base_env: Mapping[str, str] | None = None) -> str
     return json.dumps([list(spec.argv), list(spec.env), ambient])
 
 
-def load_sweep_state(out_dir: str, suite: str = "") -> dict[str, dict]:
-    """Per-cell {rc, sig} from a previous (possibly interrupted) run.
-
-    Also reads any legacy per-suite ``<suite>.sweep-state.jsonl`` files
-    (the pre-unification layout) so checkpoints recorded before the rename
-    still count; the unified file's entries win on collision.
-    """
+def _migrate_legacy_state(out_dir: str) -> None:
+    """One-time fold of legacy per-suite ``<suite>.sweep-state.jsonl``
+    files (the pre-unification layout) into the unified state file, keeping
+    the NEWEST record per cell by its ``ts`` field — a stale legacy pass
+    must not shadow a newer failure, whichever file it lives in.  Legacy
+    files are deleted afterwards so every later read/rewrite (resume,
+    _forget_cells) sees exactly one source of truth."""
     import glob
     import json
 
-    state: dict[str, dict] = {}
-    unified = _state_path(out_dir, suite)
+    unified = _state_path(out_dir, "")
     legacy = sorted(
         p
         for p in glob.glob(os.path.join(out_dir, "*.sweep-state.jsonl"))
-        if p != unified
+        if os.path.basename(p) != os.path.basename(unified)
     )
-    for path in legacy + [unified]:
+    if not legacy:
+        return
+    best: dict[str, dict] = {}
+
+    def absorb(path: str) -> None:
         try:
             with open(path) as f:
-                for line in f:
-                    try:
-                        rec = json.loads(line)
-                    except ValueError:
-                        continue  # a torn write from a killed run
-                    if isinstance(rec, dict) and "cell" in rec:
-                        state[str(rec["cell"])] = {
-                            "rc": int(rec.get("rc", 1)),
-                            "sig": rec.get("sig", ""),
-                        }
+                lines = f.readlines()
+        except OSError:
+            return
+        for line in lines:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "cell" in rec:
+                c = str(rec["cell"])
+                if c not in best or float(rec.get("ts", 0)) >= float(
+                    best[c].get("ts", 0)
+                ):
+                    best[c] = rec
+
+    for p in legacy:
+        absorb(p)
+    absorb(unified)  # >= keeps unified entries on equal-ts ties
+    tmp = unified + ".tmp"
+    with open(tmp, "w") as f:
+        for rec in best.values():
+            f.write(json.dumps(rec) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, unified)
+    for p in legacy:
+        try:
+            os.unlink(p)
         except OSError:
             pass
+
+
+def load_sweep_state(out_dir: str, suite: str = "") -> dict[str, dict]:
+    """Per-cell {rc, sig} from a previous (possibly interrupted) run."""
+    import json
+
+    state: dict[str, dict] = {}
+    try:
+        with open(_state_path(out_dir, suite)) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # a torn write from a killed run
+                if isinstance(rec, dict) and "cell" in rec:
+                    state[str(rec["cell"])] = {
+                        "rc": int(rec.get("rc", 1)),
+                        "sig": rec.get("sig", ""),
+                    }
+    except OSError:
+        pass
     return state
 
 
@@ -483,6 +525,7 @@ def run_sweep(
     if not specs:
         raise ValueError(f"sweep {suite!r} matched no specs")
     os.makedirs(out_dir, exist_ok=True)
+    _migrate_legacy_state(out_dir)
     done = load_sweep_state(out_dir, suite) if resume else {}
     if not resume:  # fresh run: forget history for the selected cells only
         _forget_cells(out_dir, suite, {s.name for s in specs})
