@@ -61,6 +61,35 @@ WORKER = textwrap.dedent(
     out = np.asarray(fn().addressable_shards[0].data)
     expect = sum(((i - 1) % n + 1) * 2.0**i for i in range(n))
     assert np.allclose(out, expect), (out, expect)
+
+    # Hierarchical tier decomposition where the PROCESS boundary is the
+    # real dcn axis: detect_hierarchy groups the 4 global devices by
+    # process (2 x 2), and the cross-tier allreduce must equal the global
+    # sum — reduce_scatter/all_gather riding intra-process links, the psum
+    # crossing gloo between processes (comm/hierarchical.py).
+    from tpu_patterns.comm.hierarchical import (
+        detect_hierarchy,
+        hierarchical_allreduce,
+    )
+
+    n_groups, ordered = detect_hierarchy(jax.devices())
+    assert n_groups == 2, n_groups  # one group per process
+    hmesh = Mesh(np.array(ordered).reshape(2, 2), ("dcn", "ici"))
+    hn = 8
+
+    def hbody():
+        r = lax.axis_index("dcn") * 2 + lax.axis_index("ici")
+        shard = r.astype(jnp.float32) + jnp.arange(hn, dtype=jnp.float32)
+        return hierarchical_allreduce(shard, "ici", 2, "dcn")[None, None]
+
+    hfn = jax.jit(
+        jax.shard_map(
+            hbody, mesh=hmesh, in_specs=(), out_specs=P("dcn", "ici", None)
+        )
+    )
+    local = np.asarray(hfn().addressable_shards[0].data)[0, 0]
+    # sum over ranks r=0..3 of (r + j) = 6 + 4j
+    assert np.allclose(local, 6.0 + 4.0 * np.arange(hn)), local
     print(f"rank {info.process_id} OK", flush=True)
     """
 )

@@ -63,19 +63,41 @@ class TestHierarchicalAllreduce:
 
 class TestDetectHierarchy:
     class FakeDev:
-        def __init__(self, process_index, slice_index=None):
+        def __init__(self, process_index, slice_index=None, platform="cpu"):
             self.process_index = process_index
+            self.platform = platform
             if slice_index is not None:
                 self.slice_index = slice_index
 
-    def test_groups_by_slice_index_first(self):
+    def test_tpu_groups_by_slice_index(self):
         from tpu_patterns.comm.hierarchical import detect_hierarchy
 
-        # slice_index present: it wins over process_index
-        devs = [self.FakeDev(0, s) for s in (1, 0, 1, 0)]
+        devs = [self.FakeDev(0, s, platform="tpu") for s in (1, 0, 1, 0)]
         n, ordered = detect_hierarchy(devs)
         assert n == 2
         assert [d.slice_index for d in ordered] == [0, 0, 1, 1]
+
+    def test_tpu_single_slice_multihost_is_one_tier(self):
+        from tpu_patterns.comm.hierarchical import detect_hierarchy
+
+        # a single-slice multi-host pod has ICI between its hosts: the
+        # constant slice_index means ONE tier, never a process split
+        devs = [
+            self.FakeDev(p, slice_index=0, platform="tpu")
+            for p in (0, 0, 1, 1)
+        ]
+        n, _ = detect_hierarchy(devs)
+        assert n == 1
+
+    def test_non_tpu_constant_slice_uses_process(self):
+        from tpu_patterns.comm.hierarchical import detect_hierarchy
+
+        # CPU/GPU platforms report a stub slice_index=0 everywhere: the
+        # process boundary is the real slow tier there
+        devs = [self.FakeDev(p, slice_index=0) for p in (0, 0, 1, 1)]
+        n, ordered = detect_hierarchy(devs)
+        assert n == 2
+        assert [d.process_index for d in ordered] == [0, 0, 1, 1]
 
     def test_falls_back_to_process_index(self):
         from tpu_patterns.comm.hierarchical import detect_hierarchy

@@ -102,21 +102,29 @@ class HierConfig:
 def detect_hierarchy(devices) -> tuple[int, list]:
     """Derive the slice grouping from the devices themselves.
 
-    Groups by ``slice_index`` (reported by multi-slice TPU platforms) with
-    ``process_index`` as the fallback tier boundary (multi-host single-slice
-    jobs: DCN sits between hosts).  Returns ``(n_groups, devices)`` with the
-    devices reordered group-contiguously so a row-major (dcn, ici) reshape
-    honors the real fabric — the topology-derived placement move (≙ the
-    reference's compact_plan mode, tile_mapping.sh:17-20, lifted to the
-    slice tier)."""
+    On the TPU platform the tier boundary is ``slice_index`` — and ONLY
+    it: a single-slice multi-host pod (constant slice_index, several
+    process_index values) has ICI between its hosts, so grouping by
+    process there would fabricate a DCN tier on ICI links.  On every
+    other platform (CPU sims, GPU) slice_index is a meaningless constant
+    stub and the process boundary is the real slow tier.  Returns
+    ``(n_groups, devices)`` with the devices reordered group-contiguously
+    so a row-major (dcn, ici) reshape honors the real fabric — the
+    topology-derived placement move (≙ the reference's compact_plan mode,
+    tile_mapping.sh:17-20, lifted to the slice tier)."""
     import collections
 
+    def keys_by(attr: str, default=None) -> list | None:
+        vals = [getattr(d, attr, default) for d in devices]
+        return None if any(v is None for v in vals) else [int(v) for v in vals]
+
+    is_tpu = bool(devices) and getattr(devices[0], "platform", "") == "tpu"
+    keys = keys_by("slice_index") if is_tpu else None
+    if keys is None:  # non-TPU, or a TPU runtime not reporting slices
+        keys = keys_by("process_index", 0)
     groups: dict[int, list] = collections.defaultdict(list)
-    for d in devices:
-        key = getattr(d, "slice_index", None)
-        if key is None:
-            key = getattr(d, "process_index", 0)
-        groups[int(key)].append(d)
+    for key, d in zip(keys, devices):
+        groups[key].append(d)
     sizes = {len(v) for v in groups.values()}
     if len(sizes) != 1:
         raise ValueError(

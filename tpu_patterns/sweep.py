@@ -393,12 +393,18 @@ def _migrate_legacy_state(out_dir: str) -> None:
         return
     best: dict[str, dict] = {}
 
-    def absorb(path: str) -> None:
+    def ts_of(rec: dict) -> float:
+        try:
+            return float(rec.get("ts", 0) or 0)
+        except (TypeError, ValueError):  # hand-edited/null ts: treat as old
+            return 0.0
+
+    def absorb(path: str) -> bool:
         try:
             with open(path) as f:
                 lines = f.readlines()
         except OSError:
-            return
+            return False  # unreadable: its records are NOT folded in
         for line in lines:
             try:
                 rec = json.loads(line)
@@ -406,13 +412,11 @@ def _migrate_legacy_state(out_dir: str) -> None:
                 continue
             if isinstance(rec, dict) and "cell" in rec:
                 c = str(rec["cell"])
-                if c not in best or float(rec.get("ts", 0)) >= float(
-                    best[c].get("ts", 0)
-                ):
+                if c not in best or ts_of(rec) >= ts_of(best[c]):
                     best[c] = rec
+        return True
 
-    for p in legacy:
-        absorb(p)
+    absorbed = [p for p in legacy if absorb(p)]
     absorb(unified)  # >= keeps unified entries on equal-ts ties
     tmp = unified + ".tmp"
     with open(tmp, "w") as f:
@@ -421,7 +425,9 @@ def _migrate_legacy_state(out_dir: str) -> None:
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, unified)
-    for p in legacy:
+    # delete ONLY what was successfully folded in: an unreadable legacy
+    # file keeps its records until a later migration can read them
+    for p in absorbed:
         try:
             os.unlink(p)
         except OSError:
