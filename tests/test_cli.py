@@ -168,9 +168,25 @@ class TestSweep:
         }
         hier = sweep.specs_for("hier", quick=True)
         assert len(hier) == 2  # 2 dcn splits x 1 dtype
-        assert len(sweep.specs_for("all", quick=True)) == len(p2p) + len(con) + len(
-            sweep.specs_for("allreduce", quick=True)
-        ) + len(lc) + len(par) + len(hier)
+        meas = sweep.specs_for("measured", quick=True)
+        assert {s.name.split(".")[0] for s in meas} == {"measured"}
+        # onesided + interop + 6 concurrency + 4 flash + 5 flagship
+        assert len(meas) == 17
+        # every flash cell pins --devices 1 (a multi-device world would
+        # silently SKIP the cell and checkpoint it as passed)
+        for s in meas:
+            if "flash" in s.name:
+                assert "--devices" in s.argv, s.name
+        # 'all' must be exactly these suites, independently summed
+        assert set(sweep.SUITES) == {
+            "p2p", "hier", "measured", "concurrency", "allreduce",
+            "longctx", "parallel",
+        }
+        assert len(sweep.specs_for("all", quick=True)) == len(p2p) + len(
+            con
+        ) + len(sweep.specs_for("allreduce", quick=True)) + len(lc) + len(
+            par
+        ) + len(hier) + len(meas)
 
     def test_unknown_name_filter(self, tmp_path):
         with pytest.raises(ValueError, match="unknown cell name"):

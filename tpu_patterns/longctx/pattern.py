@@ -74,11 +74,13 @@ STRATEGIES = {
     "ulysses": ulysses_attention,
     "flash": flash_local,
 }
-# Strategies needing check_vma=False on the shard_map.  Empty since the
-# ring's interpret mode swapped to XLA twin blocks (ring_attention):
-# varying-axes tracking — which gradient reductions depend on — now stays
-# ON for every strategy on every platform.
-VMA_OFF: set[str] = set()
+# Strategies needing check_vma=False on the shard_map — applied ONLY in
+# interpret mode (the `vma = name not in VMA_OFF or not interp` gate), so
+# hardware runs always keep the varying-axes check.  flash: the Pallas HLO
+# interpreter's grid loop cannot track varying manual axes through its
+# dynamic_slice at multi-block shapes (>=2 grid steps, e.g. seq 512
+# non-causal on CPU); Mosaic on TPU has no such limitation.
+VMA_OFF: set[str] = {"flash"}
 # these expect shards in the striped token layout (r::sp)
 STRIPED = {"ring_striped"}
 

@@ -158,10 +158,19 @@ def allreduce_specs(quick: bool = False) -> list[SweepSpec]:
     return specs
 
 
+# CI-shaped quick workloads, shared by the per-suite matrices and their
+# `measured` twins so the shapes cannot silently drift apart.
+QUICK_LONGCTX = ("--seq", "256", "--head_dim", "32", "--reps", "2")
+QUICK_FLAGSHIP = (
+    "--embed", "64", "--head_dim", "8", "--seq", "128", "--batch", "2",
+    "--dtype", "float32", "--reps", "2",
+)
+
+
 def longctx_specs(quick: bool = False) -> list[SweepSpec]:
     """Strategy x causal x dtype matrix over the full device world, plus
     the single-device kernel-vs-XLA agreement cell."""
-    small = ("--seq", "256", "--head_dim", "32", "--reps", "2") if quick else (
+    small = QUICK_LONGCTX if quick else (
         "--seq", "4096", "--head_dim", "128", "--dtype", "bfloat16",
     )
     specs = []
@@ -244,12 +253,7 @@ def parallel_specs(quick: bool = False) -> list[SweepSpec]:
             env=(("TPU_PATTERNS_SWEEP_CONFIG", "moe"),),
         )
     )
-    flag_small = (
-        ("--embed", "64", "--head_dim", "8", "--seq", "128", "--batch", "2",
-         "--dtype", "float32", "--reps", "2")
-        if quick
-        else ("--seq", "4096", "--batch", "2")
-    )
+    flag_small = QUICK_FLAGSHIP if quick else ("--seq", "4096", "--batch", "2")
     for attn in ("xla", "pallas"):
         specs.append(
             SweepSpec(
@@ -289,9 +293,115 @@ def hier_specs(quick: bool = False) -> list[SweepSpec]:
     return specs
 
 
+def measured_specs(quick: bool = False) -> list[SweepSpec]:
+    """The headline-record matrix: one resumable command reproducing the
+    records committed under docs/measured/ (run on a live chip with
+    ``tpu-patterns sweep measured --out docs/measured/r2``; a tunnel hang
+    mid-suite costs only the unfinished cells thanks to --resume)."""
+    env = (("TPU_PATTERNS_SWEEP_CONFIG", "measured"),)
+    if quick:  # CI-shaped twins: same argv surface, tiny workloads
+        onesided = ("--count", "65536", "--reps", "2")
+        flash = QUICK_LONGCTX
+        # the "long" twin doubles seq so cell names stay distinct
+        flash_long = ("--seq", "512") + QUICK_LONGCTX[2:]
+        flagship = QUICK_FLAGSHIP
+        flagship_long = QUICK_FLAGSHIP[:6] + (
+            "--batch", "1", "--dtype", "float32", "--reps", "2",
+        )
+        conc = ("--elements", "4096", "--tripcount", "64", "--reps", "2")
+    else:
+        onesided = ("--reps", "10")
+        flash = ("--seq", "4096", "--reps", "5")
+        flash_long = ("--seq", "8192", "--reps", "5")
+        flagship = ("--seq", "4096", "--batch", "2", "--reps", "5")
+        flagship_long = ("--seq", "8192", "--batch", "1", "--reps", "5")
+        conc = ("--reps", "10",)
+    specs = [
+        SweepSpec(
+            name="measured.onesided_hbm",
+            argv=(
+                "p2p", "--transport", "one_sided", "--devices", "1",
+                *onesided,
+            ),
+            env=env,
+        ),
+        SweepSpec(name="measured.interop", argv=("interop",), env=env),
+    ]
+    # the committed concurrency matrix (concurrency_tpu_v5e.jsonl): the
+    # honest platform-semantics verdicts — overlap wins only vs transfers
+    # and dispatch on one chip, so some cells FAIL by design off-TPU
+    for backend, mode, mix in (
+        ("xla", "concurrent", "C C"),
+        ("xla", "concurrent", "C H2D"),
+        ("xla", "concurrent", "H2D D2H"),
+        ("xla", "dispatch_async", "C C"),
+        ("xla", "dispatch_async", "C H2D"),
+        ("pallas", "dma_overlap", "C C"),
+    ):
+        specs.append(
+            SweepSpec(
+                name=(
+                    f"measured.concurrency.{backend}.{mode}."
+                    f"{mix.replace(' ', '_')}"
+                ),
+                argv=(
+                    "concurrency", "--backend", backend, "--mode", mode,
+                    "--commands", mix, *conc,
+                ),
+                env=env,
+            )
+        )
+    # flash is the single-device fused kernel: --devices 1, or a
+    # multi-device world silently SKIPs the cell
+    for causal, args in (
+        ("true", flash),
+        ("true", flash_long),
+        ("false", flash_long),
+    ):
+        seq = args[args.index("--seq") + 1]
+        specs.append(
+            SweepSpec(
+                name=f"measured.flash_bf16_L{seq}_causal_{causal}",
+                argv=(
+                    "longctx", "--devices", "1", "--strategy", "flash",
+                    "--dtype", "bfloat16", "--causal", causal, *args,
+                ),
+                env=env,
+            )
+        )
+    specs.append(
+        SweepSpec(
+            name="measured.flash_bf16_grad",
+            argv=(
+                "longctx", "--devices", "1", "--strategy", "flash",
+                "--dtype", "bfloat16", "--causal", "true", "--grad", "true",
+                *flash,
+            ),
+            env=env,
+        )
+    )
+    for variant, extra, sizes in (
+        ("xla", (), flagship),
+        ("pallas", (), flagship),
+        ("xla_L8192", (), flagship_long),
+        ("pallas_L8192", (), flagship_long),
+        ("zero_adam", ("--optimizer", "zero-adam"), flagship),
+    ):
+        attn = "pallas" if variant.startswith("pallas") else "xla"
+        specs.append(
+            SweepSpec(
+                name=f"measured.flagship_{variant}",
+                argv=("flagship", "--attn", attn, *extra, *sizes),
+                env=env,
+            )
+        )
+    return specs
+
+
 SUITES = {
     "p2p": p2p_specs,
     "hier": hier_specs,
+    "measured": measured_specs,
     "concurrency": concurrency_specs,
     "allreduce": allreduce_specs,
     "longctx": longctx_specs,
