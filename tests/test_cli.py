@@ -172,11 +172,12 @@ class TestSweep:
         assert {s.name.split(".")[0] for s in meas} == {"measured"}
         # onesided + interop + 6 concurrency + 4 flash + 5 flagship
         assert len(meas) == 17
-        # every flash cell pins --devices 1 (a multi-device world would
-        # silently SKIP the cell and checkpoint it as passed)
+        # every flash cell pins --devices to exactly 1 (any other world
+        # would silently SKIP the cell and checkpoint it as passed)
         for s in meas:
             if "flash" in s.name:
-                assert "--devices" in s.argv, s.name
+                i = s.argv.index("--devices")
+                assert s.argv[i + 1] == "1", s.name
         # 'all' must be exactly these suites, independently summed
         assert set(sweep.SUITES) == {
             "p2p", "hier", "measured", "concurrency", "allreduce",
@@ -226,6 +227,47 @@ class TestSweep:
         out = capsys.readouterr().out
         assert "sweep cell" in out
 
+    def test_sweep_resume_skips_completed_failure(self, tmp_path, monkeypatch):
+        # an honest FAILURE verdict is a RESULT: resume must not re-measure
+        # it, but the aggregate exit code must still reflect it
+        name = "p2p.compact.mesh.two_sided.n2"
+        calls = []
+        monkeypatch.setattr(
+            sweep,
+            "run_spec",
+            lambda spec, out, base_env=None: calls.append(spec.name)
+            or (1, True),  # completed, verdict FAILURE
+        )
+        rc = sweep.run_sweep(
+            "p2p", out_dir=str(tmp_path), quick=True, names=[name]
+        )
+        assert rc == 1 and calls == [name]
+        rc = sweep.run_sweep(
+            "p2p", out_dir=str(tmp_path), quick=True, names=[name],
+            resume=True,
+        )
+        assert calls == [name]  # NOT re-run
+        assert rc == 1  # but still reported as a failing suite
+
+    def test_sweep_resume_reruns_timeout(self, tmp_path, monkeypatch):
+        # a timeout/crash (completed=False) must re-run even with rc!=0
+        name = "p2p.compact.mesh.two_sided.n2"
+        results = iter([(1, False), (0, True)])
+        calls = []
+        monkeypatch.setattr(
+            sweep,
+            "run_spec",
+            lambda spec, out, base_env=None: calls.append(spec.name)
+            or next(results),
+        )
+        sweep.run_sweep("p2p", out_dir=str(tmp_path), quick=True, names=[name])
+        rc = sweep.run_sweep(
+            "p2p", out_dir=str(tmp_path), quick=True, names=[name],
+            resume=True,
+        )
+        assert calls == [name, name]  # re-ran after the timeout
+        assert rc == 0
+
     def test_sweep_resume_skips_passed_cells(self, tmp_path, capsys):
         env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
         env["JAX_PLATFORMS"] = "cpu"
@@ -265,12 +307,12 @@ class TestSweep:
             ) + "\n")
             f.write("torn-write{{{\n")  # must be tolerated
         st = sweep.load_sweep_state(str(tmp_path), "p2p")
-        assert st[name] == {"rc": 1, "sig": "x"}
+        assert st[name] == {"rc": 1, "sig": "x", "completed": False}
         calls = []
         monkeypatch.setattr(
             sweep, "run_spec", lambda spec, out, base_env=None: calls.append(
                 spec.name
-            ) or 0,
+            ) or (0, True),
         )
         sweep.run_sweep(
             "p2p", out_dir=str(tmp_path), quick=True, names=[name],
@@ -284,7 +326,7 @@ class TestSweep:
         )
         st = sweep.load_sweep_state(str(tmp_path), "p2p")
         assert st[name]["rc"] == 0
-        assert st["p2p.other.cell"] == {"rc": 0, "sig": "y"}
+        assert st["p2p.other.cell"] == {"rc": 0, "sig": "y", "completed": True}
 
     def test_sweep_resume_workload_mismatch_reruns(self, tmp_path, monkeypatch):
         # a --quick success must NOT satisfy a later full-size resume: the
@@ -294,7 +336,7 @@ class TestSweep:
         monkeypatch.setattr(
             sweep, "run_spec", lambda spec, out, base_env=None: calls.append(
                 spec.name
-            ) or 0,
+            ) or (0, True),
         )
         sweep.run_sweep("p2p", out_dir=str(tmp_path), quick=True, names=[name])
         assert calls == [name]
@@ -321,7 +363,7 @@ class TestSweep:
         monkeypatch.setattr(
             sweep, "run_spec", lambda spec, out, base_env=None: calls.append(
                 spec.name
-            ) or next(rcs),
+            ) or (next(rcs), False),
         )
         sweep.run_sweep("all", out_dir=str(tmp_path), quick=True, names=[name])
         sweep.run_sweep(  # regression recorded under the per-suite arg
@@ -332,7 +374,7 @@ class TestSweep:
         monkeypatch.setattr(
             sweep, "run_spec", lambda spec, out, base_env=None: calls.append(
                 spec.name
-            ) or 0,
+            ) or (0, True),
         )
         sweep.run_sweep(
             "all", out_dir=str(tmp_path), quick=True, names=[name], resume=True
@@ -362,7 +404,7 @@ class TestSweep:
         monkeypatch.setattr(
             sweep, "run_spec", lambda spec, out, base_env=None: calls.append(
                 spec.name
-            ) or 0,
+            ) or (0, True),
         )
         sweep.run_sweep(
             "p2p", out_dir=str(tmp_path), quick=True, names=[name],
@@ -375,7 +417,7 @@ class TestSweep:
         assert not (tmp_path / "p2p.sweep-state.jsonl").exists()
         st = sweep.load_sweep_state(str(tmp_path))
         assert st[name]["rc"] == 0  # the re-run just recorded success
-        assert st["p2p.other"] == {"rc": 0, "sig": "y"}
+        assert st["p2p.other"] == {"rc": 0, "sig": "y", "completed": True}
 
     def test_sweep_resume_env_mismatch_reruns(self, tmp_path, monkeypatch):
         # a pass under JAX_PLATFORMS=cpu must not satisfy a resume under a
@@ -385,7 +427,7 @@ class TestSweep:
         monkeypatch.setattr(
             sweep, "run_spec", lambda spec, out, base_env=None: calls.append(
                 spec.name
-            ) or 0,
+            ) or (0, True),
         )
         cpu_env = {"JAX_PLATFORMS": "cpu"}
         sweep.run_sweep(

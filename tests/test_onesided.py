@@ -103,6 +103,39 @@ class TestRunOneSided:
         assert rec.verdict is Verdict.SUCCESS, rec.notes
         assert rec.metrics[f"bandwidth_GBps_{kernel}"] > 0
 
+    def test_auto_survives_one_broken_kernel(self, devices, monkeypatch):
+        # a candidate the platform rejects must be skipped, not zero the
+        # headline (the bench artifact depends on this)
+        from jax.sharding import Mesh
+
+        from tpu_patterns.comm import onesided as mod
+
+        def boom(x, chunks=8, interpret=False):
+            raise RuntimeError("lowering rejected")
+
+        monkeypatch.setattr(mod, "local_put_multi", boom)
+        mesh = Mesh(np.array(devices[:1]), ("x",))
+        (rec,) = run_onesided(mesh, OneSidedConfig(count=2048, reps=2, warmup=1))
+        assert rec.verdict is Verdict.SUCCESS, rec.notes
+        assert any("multi failed: RuntimeError" in n for n in rec.notes)
+        assert any(n == "auto-selected kernel: streamed" for n in rec.notes)
+        assert "bandwidth_GBps_multi" not in rec.metrics
+
+    def test_explicit_broken_kernel_raises(self, devices, monkeypatch):
+        from jax.sharding import Mesh
+
+        from tpu_patterns.comm import onesided as mod
+
+        def boom(x, chunks=8, interpret=False):
+            raise RuntimeError("lowering rejected")
+
+        monkeypatch.setattr(mod, "local_put_multi", boom)
+        mesh = Mesh(np.array(devices[:1]), ("x",))
+        with pytest.raises(RuntimeError, match="lowering rejected"):
+            run_onesided(
+                mesh, OneSidedConfig(count=2048, reps=1, kernel="multi")
+            )
+
     def test_unknown_kernel_raises(self, devices):
         from jax.sharding import Mesh
 

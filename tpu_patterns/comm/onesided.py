@@ -311,19 +311,36 @@ def run_onesided(
         # Auto-select: measure every candidate schedule with the full
         # discipline and keep the winner — the same "measure, then pick"
         # move as the concurrency auto-tuner (≙ main.cpp:226-258), applied
-        # to DMA scheduling instead of command balancing.
+        # to DMA scheduling instead of command balancing.  In auto mode a
+        # candidate that fails (e.g. a kernel the platform's lowering
+        # rejects) is recorded and skipped — one bad schedule must not
+        # zero the headline; an explicitly requested kernel still raises.
         best = None
+        errors: list[BaseException] = []
         for name, put in candidates.items():
-            kfn, kbuild = one_kernel(put)
-            kres = timing.measure_chain(
-                kbuild, reps=cfg.reps, warmup=cfg.warmup,
-                direct_fn=lambda: kfn(x), ops_per_iter=timing.CHAIN_UNROLL,
-            )
+            try:
+                kfn, kbuild = one_kernel(put)
+                kres = timing.measure_chain(
+                    kbuild, reps=cfg.reps, warmup=cfg.warmup,
+                    direct_fn=lambda: kfn(x), ops_per_iter=timing.CHAIN_UNROLL,
+                )
+            except Exception as e:
+                if len(candidates) == 1:
+                    raise
+                errors.append(e)
+                writer.progress(
+                    f"onesided local_put[{name}] failed: "
+                    f"{type(e).__name__}: {e}"
+                )
+                notes.append(f"kernel {name} failed: {type(e).__name__}")
+                continue
             kgbps = kres.gbps(shard_bytes)
             extra_metrics[f"bandwidth_GBps_{name}"] = kgbps
             writer.progress(f"onesided local_put[{name}]: {kgbps:.1f} GB/s")
             if best is None or kgbps > best[2]:
                 best = (name, kfn, kgbps, kres)
+        if best is None:
+            raise errors[0]
         name, fn, gbps, res = best
         if len(candidates) > 1:
             notes.append(f"auto-selected kernel: {name}")

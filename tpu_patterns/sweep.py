@@ -308,7 +308,10 @@ def measured_specs(quick: bool = False) -> list[SweepSpec]:
         flagship_long = QUICK_FLAGSHIP[:6] + (
             "--batch", "1", "--dtype", "float32", "--reps", "2",
         )
-        conc = ("--elements", "4096", "--tripcount", "64", "--reps", "2")
+        conc = (
+            "--elements", "4096", "--copy_elements", "16384",
+            "--tripcount", "64", "--reps", "2",
+        )
     else:
         onesided = ("--reps", "10")
         flash = ("--seq", "4096", "--reps", "5")
@@ -329,7 +332,8 @@ def measured_specs(quick: bool = False) -> list[SweepSpec]:
     ]
     # the committed concurrency matrix (concurrency_tpu_v5e.jsonl): the
     # honest platform-semantics verdicts — overlap wins only vs transfers
-    # and dispatch on one chip, so some cells FAIL by design off-TPU
+    # and dispatch, so compute+compute cells FAIL by design even on the
+    # chip (resume treats a completed FAILURE as a result, not a retry)
     for backend, mode, mix in (
         ("xla", "concurrent", "C C"),
         ("xla", "concurrent", "C H2D"),
@@ -420,9 +424,10 @@ def run_spec(
     out_dir: str,
     base_env: Mapping[str, str] | None = None,
     timeout: float = 1800.0,
-) -> int:
+) -> tuple[int, bool]:
     """Run one cell: subprocess CLI, log tee'd to ``<name>.log``, JSONL to
-    ``<name>.jsonl`` (≙ ``|& tee -a $log``, run_omp.sh:26)."""
+    ``<name>.jsonl`` (≙ ``|& tee -a $log``, run_omp.sh:26).  Returns
+    ``(rc, completed)`` — see the completion comment below."""
     os.makedirs(out_dir, exist_ok=True)
     log_path = os.path.join(out_dir, f"{spec.name}.log")
     jsonl_path = os.path.join(out_dir, f"{spec.name}.jsonl")
@@ -440,16 +445,31 @@ def run_spec(
             timeout=timeout,
         )
         stdout, rc = proc.stdout, proc.returncode
+        timed_out = False
     except subprocess.TimeoutExpired as e:
         stdout = (e.stdout or "") if isinstance(e.stdout, str) else ""
         stdout += f"\n## {spec.name} | timeout | FAILURE\n"
-        rc = 1
+        rc, timed_out = 1, True
     with open(log_path, "w") as f:
         # export-context lines first: parse_log keys the table rows by them
         for k, v in spec.env:
             f.write(f"export {k}={v}\n")
         f.write(stdout)
-    return rc
+    # "completed" = the measurement ran to its verdict, even a FAILURE one
+    # (an honest perf verdict is a RESULT, ≙ the reference's FAILURE table
+    # rows) — as opposed to a timeout/crash, which left no verdict and must
+    # be re-run on --resume.
+    has_records = False
+    try:
+        with open(jsonl_path) as f:
+            has_records = any(line.strip() for line in f)
+    except OSError:
+        pass
+    completed = not timed_out and (
+        rc == 0
+        or (has_records and "Traceback (most recent call last)" not in stdout)
+    )
+    return rc, completed
 
 
 def _state_path(out_dir: str, suite: str) -> str:
@@ -545,7 +565,9 @@ def _migrate_legacy_state(out_dir: str) -> None:
 
 
 def load_sweep_state(out_dir: str, suite: str = "") -> dict[str, dict]:
-    """Per-cell {rc, sig} from a previous (possibly interrupted) run."""
+    """Per-cell {rc, sig, completed} from a previous (possibly
+    interrupted) run.  Records predating the ``completed`` field are
+    treated as completed iff they passed."""
     import json
 
     state: dict[str, dict] = {}
@@ -557,9 +579,11 @@ def load_sweep_state(out_dir: str, suite: str = "") -> dict[str, dict]:
                 except ValueError:
                     continue  # a torn write from a killed run
                 if isinstance(rec, dict) and "cell" in rec:
+                    rc = int(rec.get("rc", 1))
                     state[str(rec["cell"])] = {
-                        "rc": int(rec.get("rc", 1)),
+                        "rc": rc,
                         "sig": rec.get("sig", ""),
+                        "completed": bool(rec.get("completed", rc == 0)),
                     }
     except OSError:
         pass
@@ -567,12 +591,15 @@ def load_sweep_state(out_dir: str, suite: str = "") -> dict[str, dict]:
 
 
 def _record_cell(
-    out_dir: str, suite: str, cell: str, rc: int, sig: str
+    out_dir: str, suite: str, cell: str, rc: int, sig: str, completed: bool
 ) -> None:
     import json
     import time
 
-    rec = {"cell": cell, "rc": rc, "sig": sig, "ts": time.time()}
+    rec = {
+        "cell": cell, "rc": rc, "sig": sig, "completed": completed,
+        "ts": time.time(),
+    }
     with open(_state_path(out_dir, suite), "a") as f:
         f.write(json.dumps(rec) + "\n")
         f.flush()
@@ -620,12 +647,13 @@ def run_sweep(
     """Run a suite's matrix; print the tabulated report; return the
     aggregated exit code (any FAILURE -> 1).
 
-    ``resume=True`` skips cells the state file records as already-succeeded
-    — the checkpoint/resume story the reference lacks entirely (SURVEY.md
-    §5: "all runs are stateless single-shot").  A sweep on flaky hardware
-    (e.g. a device tunnel that hangs mid-suite) re-runs only the failed and
-    not-yet-run cells; their logs/JSONL from the completed cells are still
-    on disk, so the final report covers the whole matrix either way.
+    ``resume=True`` skips cells the state file records as COMPLETED — they
+    reached a verdict, SUCCESS or honest FAILURE — and re-runs only cells
+    that timed out, crashed, or never ran: the checkpoint/resume story the
+    reference lacks entirely (SURVEY.md §5: "all runs are stateless
+    single-shot").  Skipped cells keep contributing their recorded rc to
+    the aggregate exit code, and their logs/JSONL are still on disk, so
+    the final report covers the whole matrix either way.
     """
     from tpu_patterns.core.results import parse_log, tabulate_records
 
@@ -649,13 +677,21 @@ def run_sweep(
     for spec in specs:
         prev = done.get(spec.name)
         sig = _spec_sig(spec, base_env)
-        if prev and prev["rc"] == 0 and prev["sig"] == sig:
-            print(f"# sweep cell: {spec.name} (resume: already passed)",
+        # Skip cells that COMPLETED — reached a verdict, even FAILURE (an
+        # honest perf verdict is a result; re-measuring it on every resume
+        # would defeat the checkpoint) — but carry their recorded rc into
+        # the aggregate so a resumed suite still exits nonzero on FAILURE
+        # rows.  Timeouts/crashes are not completed and re-run.
+        if prev and prev["completed"] and prev["sig"] == sig:
+            word = "passed" if prev["rc"] == 0 else "completed (FAILURE)"
+            print(f"# sweep cell: {spec.name} (resume: already {word})",
                   flush=True)
+            if prev["rc"] != 0:
+                rc = 1
             continue
         print(f"# sweep cell: {spec.name}", flush=True)
-        cell_rc = run_spec(spec, out_dir, base_env=base_env)
-        _record_cell(out_dir, suite, spec.name, cell_rc, sig)
+        cell_rc, completed = run_spec(spec, out_dir, base_env=base_env)
+        _record_cell(out_dir, suite, spec.name, cell_rc, sig, completed)
         print(f"# -> exit {cell_rc}", flush=True)
         if cell_rc != 0:  # incl. negative (signal-killed) returncodes
             rc = 1
