@@ -63,6 +63,15 @@ class ModelConfig:
     # (x_global[r::sp] per shard) — positions are handled inside the ring,
     # and any token-permutation-invariant loss is unchanged.
     attn_layout: str = "contiguous"
+    # Rematerialize each block under jax.checkpoint: trade ~1 extra
+    # forward of FLOPs for dropping the blocks' activation stash from HBM
+    # — the standard long-context memory lever (HBM is the bottleneck).
+    # The win scales with depth: the backward holds ONE live block's
+    # activations instead of all ``depth`` of them.
+    remat: bool = False
+    # Number of stacked transformer blocks applied by lax.scan (params get
+    # a leading [depth] axis).  depth=1 keeps the single-block layout.
+    depth: int = 1
 
     @property
     def mlp_hidden(self) -> int:
@@ -97,10 +106,21 @@ def param_specs(
                 "w2": ((f, e), P("tp", None)),
             }
         )
+    if cfg.depth > 1:  # stacked layers: leading [depth] axis, replicated
+        specs = {
+            k: ((cfg.depth,) + shape, P(None, *tuple(s)))
+            for k, (shape, s) in specs.items()
+        }
     return specs
 
 
 def init_params(key, cfg: ModelConfig, n_experts: int = 0) -> dict[str, jax.Array]:
+    if cfg.depth > 1:
+        # per-layer init then stack, so fan-in scaling ignores the depth axis
+        layer_cfg = dataclasses.replace(cfg, depth=1)
+        keys = jax.random.split(key, cfg.depth)
+        per = [init_params(k, layer_cfg, n_experts) for k in keys]
+        return {name: jnp.stack([p[name] for p in per]) for name in per[0]}
     dtype = jnp.dtype(cfg.dtype)
     params = {}
     for name, (shape, _) in param_specs(cfg, n_experts).items():
@@ -249,7 +269,27 @@ def loss_shard(
     axis (incl. tp, where the addends are replicas) and normalizing keeps
     the result axis-invariant, so grads of replicated params come out
     replicated — dp gradient sync falls out of the psum transpose."""
-    z = forward_shard(params, x, cfg, **fwd_kw)
+    def fwd(p, xb):
+        return forward_shard(p, xb, cfg, **fwd_kw)
+
+    if cfg.depth > 1:
+        # Stacked blocks via scan over the leading [depth] param axis.
+        # With remat, each scan step is checkpointed: the backward keeps
+        # ONE live block's activations and re-runs the forward per layer —
+        # the classic O(depth) -> O(1) activation-memory trade.
+        def block(carry, layer):
+            return fwd(layer, carry), None
+
+        body = jax.checkpoint(block) if cfg.remat else block
+
+        def fwd_full(p, xb):
+            y, _ = lax.scan(body, xb, p)
+            return y
+
+    else:
+        # single block: checkpoint drops its attn/hidden stash
+        fwd_full = jax.checkpoint(fwd) if cfg.remat else fwd
+    z = fwd_full(params, x)
     local = jnp.sum(z.astype(jnp.float32) ** 2)
     if axes:
         # z is already tp-invariant (the forward's psums reduced tp), so the
@@ -559,6 +599,8 @@ class FlagshipConfig:
     attn_layout: str = "contiguous"
     moe: bool = False
     optimizer: str = "sgd"  # sgd | zero-sgd | zero-adam (sharded optimizer)
+    remat: bool = False  # jax.checkpoint each block (FLOPs for HBM)
+    depth: int = 1  # stacked blocks applied by lax.scan
     reps: int = 10
     warmup: int = 2
     min_tflops: float = -1.0
@@ -573,7 +615,25 @@ def flagship_flops(cfg: FlagshipConfig) -> float:
     proj = 2 * b * l * e * (3 * hd) + 2 * b * l * hd * e
     attn = 4.0 * l * l * cfg.heads * cfg.head_dim * b / (2 if cfg.causal else 1)
     mlp = 4 * b * l * e * (e * cfg.mlp_mult)
-    return 3.0 * (proj + attn + mlp)
+    per_block = proj + attn + mlp
+    # fwd + bwd = 3x fwd; remat re-runs the forward once more per block
+    factor = 4.0 if cfg.remat else 3.0
+    return factor * per_block * cfg.depth
+
+
+def _memory_metrics(jitted, *args) -> dict[str, float]:
+    """Compiled-program memory analysis (bytes -> MB): peak temp (the
+    activation stash the remat lever targets), argument and output sizes.
+    Best-effort — absent on backends without the analysis API."""
+    try:
+        ma = jitted.lower(*args).compile().memory_analysis()
+        return {
+            "peak_temp_MB": float(ma.temp_size_in_bytes) / 1e6,
+            "argument_MB": float(ma.argument_size_in_bytes) / 1e6,
+            "output_MB": float(ma.output_size_in_bytes) / 1e6,
+        }
+    except Exception:
+        return {}
 
 
 def run_flagship(mesh: Mesh, cfg: FlagshipConfig, writer) -> list:
@@ -594,6 +654,8 @@ def run_flagship(mesh: Mesh, cfg: FlagshipConfig, writer) -> list:
         moe=cfg.moe,
         attn=cfg.attn,
         attn_layout=cfg.attn_layout,
+        remat=cfg.remat,
+        depth=cfg.depth,
     )
     dp, sp = int(mesh.shape["dp"]), int(mesh.shape["sp"])
     if cfg.batch % dp or cfg.seq % sp:
@@ -624,9 +686,11 @@ def run_flagship(mesh: Mesh, cfg: FlagshipConfig, writer) -> list:
             return (sh, st), loss
 
         p = (shards0, state0)
+        mem = _memory_metrics(zstep, shards0, state0, sx)
     elif cfg.optimizer == "sgd":
         step, _ = make_train_step(mesh, mcfg, lr=1e-30)
         p = shard_params(params, mesh, mcfg)
+        mem = _memory_metrics(step, p, sx)
     else:
         raise ValueError(
             f"unknown optimizer {cfg.optimizer!r}; want sgd|zero-sgd|zero-adam"
@@ -668,7 +732,9 @@ def run_flagship(mesh: Mesh, cfg: FlagshipConfig, writer) -> list:
         pattern="flagship",
         mode=cfg.attn
         + ("_moe" if cfg.moe else "")
-        + (f"_{cfg.optimizer}" if cfg.optimizer != "sgd" else ""),
+        + (f"_{cfg.optimizer}" if cfg.optimizer != "sgd" else "")
+        + ("_remat" if cfg.remat else "")
+        + (f"_d{cfg.depth}" if cfg.depth > 1 else ""),
         commands=f"dp{dp} sp{sp} tp{int(mesh.shape['tp'])} B{cfg.batch} "
         f"L{cfg.seq} E{cfg.embed} {cfg.dtype}"
         + (" causal" if cfg.causal else "")
@@ -679,6 +745,7 @@ def run_flagship(mesh: Mesh, cfg: FlagshipConfig, writer) -> list:
             "flops": flops,
             "loss": loss,
             "checksum_ok": float(data_ok),
+            **mem,
         },
         verdict=Verdict.SUCCESS if (data_ok and perf_ok) else Verdict.FAILURE,
     )
@@ -711,6 +778,11 @@ def make_pipeline_train_step(
     Returns ``(step, pspecs)``; x is sharded [dp, sp, -] and n_micro must
     divide its dp-local batch.
     """
+    if cfg.depth > 1:
+        raise ValueError(
+            "pipeline stages are single blocks; express depth as pp stages "
+            "(init_stack_params), not ModelConfig.depth"
+        )
     from tpu_patterns.parallel.pipeline import (
         pipeline_apply,
         pipeline_train_1f1b,

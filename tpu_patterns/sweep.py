@@ -274,6 +274,20 @@ def parallel_specs(quick: bool = False) -> list[SweepSpec]:
             env=(("TPU_PATTERNS_SWEEP_CONFIG", "flagship"),),
         )
     )
+    # remat contrast at depth: jax.checkpoint per scanned block trades ~1
+    # forward of FLOPs for the O(depth) activation stash (peak_temp_MB
+    # shows the drop — measured 5x at depth 6 on the CPU sim)
+    for remat in ("false", "true"):
+        specs.append(
+            SweepSpec(
+                name=f"flagship.deep.remat_{remat}",
+                argv=(
+                    "flagship", "--attn", "xla", "--depth", "4",
+                    "--remat", remat, *flag_small,
+                ),
+                env=(("TPU_PATTERNS_SWEEP_CONFIG", "flagship"),),
+            )
+        )
     return specs
 
 
@@ -465,9 +479,15 @@ def run_spec(
             has_records = any(line.strip() for line in f)
     except OSError:
         pass
+    # rc < 0 is a signal kill (OOM/segfault) — never completed, even if
+    # some records were flushed before the kill
     completed = not timed_out and (
         rc == 0
-        or (has_records and "Traceback (most recent call last)" not in stdout)
+        or (
+            rc > 0
+            and has_records
+            and "Traceback (most recent call last)" not in stdout
+        )
     )
     return rc, completed
 
