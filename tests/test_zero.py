@@ -162,6 +162,50 @@ class TestZeroTrainStep:
                 np.asarray(p_z[k]), np.asarray(p_ref[k]), rtol=1e-5, atol=1e-7
             )
 
+    def test_offloaded_state_lives_in_pinned_host(self, devices):
+        # ZeRO + host offload: the moments' shardings carry the
+        # pinned_host memory kind, params stay in device memory, and the
+        # step's math is unchanged vs the on-device state variant
+        from tpu_patterns.models import (
+            ModelConfig,
+            init_params,
+            make_zero_train_step,
+            shard_params,
+        )
+
+        mesh = Mesh(np.array(devices[:8]).reshape(2, 2, 2), ("dp", "sp", "tp"))
+        cfg = ModelConfig(embed=64, heads=8, head_dim=8, dtype="float32")
+        params = init_params(jax.random.key(0), cfg)
+        x = jax.random.normal(jax.random.key(1), (4, 32, 64), jnp.float32)
+        sx = jax.device_put(x, NamedSharding(mesh, P("dp", "sp", None)))
+
+        outs = {}
+        for offload in (False, True):
+            step, init_fn, _ = make_zero_train_step(
+                mesh, cfg, lr=1e-3, optimizer="adam", offload_state=offload
+            )
+            shards, state = init_fn(shard_params(params, mesh, cfg))
+            kinds = {
+                leaf.sharding.memory_kind
+                for leaf in jax.tree_util.tree_leaves(state)
+            }
+            if offload:
+                assert kinds == {"pinned_host"}, kinds
+                pkinds = {
+                    leaf.sharding.memory_kind
+                    for leaf in jax.tree_util.tree_leaves(shards)
+                }
+                assert "pinned_host" not in pkinds  # params stay in HBM
+            shards, state, loss = step(shards, state, sx)
+            outs[offload] = (float(loss), shards)
+        np.testing.assert_allclose(outs[False][0], outs[True][0], rtol=1e-6)
+        for k in outs[False][1]:
+            np.testing.assert_allclose(
+                np.asarray(outs[False][1][k]),
+                np.asarray(outs[True][1][k]),
+                rtol=1e-6,
+            )
+
     def test_adam_learns(self, devices):
         from tpu_patterns.models import (
             ModelConfig,

@@ -116,11 +116,11 @@ def param_specs(
 
 def init_params(key, cfg: ModelConfig, n_experts: int = 0) -> dict[str, jax.Array]:
     if cfg.depth > 1:
-        # per-layer init then stack, so fan-in scaling ignores the depth axis
-        layer_cfg = dataclasses.replace(cfg, depth=1)
-        keys = jax.random.split(key, cfg.depth)
-        per = [init_params(k, layer_cfg, n_experts) for k in keys]
-        return {name: jnp.stack([p[name] for p in per]) for name in per[0]}
+        # per-layer init then stack (fan-in scaling ignores the depth
+        # axis) — exactly the pipeline's per-stage init
+        return init_stack_params(
+            key, dataclasses.replace(cfg, depth=1), cfg.depth, n_experts
+        )
     dtype = jnp.dtype(cfg.dtype)
     params = {}
     for name, (shape, _) in param_specs(cfg, n_experts).items():
@@ -366,6 +366,7 @@ def make_zero_train_step(
     lr: float = 1e-3,
     x_spec: P | None = None,
     optimizer: str = "adam",
+    offload_state: bool = False,
 ):
     """ZeRO-1 twin of :func:`make_train_step` (parallel/zero.py).
 
@@ -384,6 +385,13 @@ def make_zero_train_step(
     shard/state leaves are stacked ``[n_devices, ...]`` in mesh-axis order.
     ``gather_fn(param_shards) -> params`` rebuilds full (replicated) params
     for evaluation; it is returned as ``step.gather``.
+
+    ``offload_state=True`` pins the optimizer state to ``pinned_host``
+    memory via sharding memory kinds (the same kind taxonomy as the
+    concurrency suite's H buffers, concurrency/commands.py): the moments
+    leave HBM entirely between steps, XLA inserting the host<->device DMA
+    around the shard update — ZeRO-1 composed with host offload, the
+    second standard optimizer-memory lever.
     """
     import optax
 
@@ -480,7 +488,7 @@ def make_zero_train_step(
             _stack(tx.init(shards), state_specs),
         )
 
-    init = jax.jit(
+    raw_init = jax.jit(
         jax.shard_map(
             init_fn,
             mesh=mesh,
@@ -488,6 +496,29 @@ def make_zero_train_step(
             out_specs=(shard_specs, state_specs),
         )
     )
+    if offload_state:
+        # The moments live in pinned_host memory between steps (sharding
+        # memory kinds, the concurrency suite's H taxonomy).  The transfer
+        # is staged EXPLICITLY around the compiled step via device_put
+        # rather than baked in with jit out_shardings: XLA's placement
+        # annotation is unimplemented for partially-replicated shardings
+        # ("Side-effect ops cannot be replicated"), and the state is
+        # deliberately sp-replicated (claiming sp would poison the shard
+        # vma and break the implicit sp gradient sync).
+        host_state_shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s, memory_kind="pinned_host"),
+            state_specs,
+        )
+        dev_state_shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), state_specs
+        )
+
+        def init(params):
+            ps, st = raw_init(params)
+            return ps, jax.device_put(st, host_state_shardings)
+
+    else:
+        init = raw_init
 
     def _gather(k, shard):
         return zero.unshard(
@@ -524,7 +555,17 @@ def make_zero_train_step(
         in_specs=(shard_specs, state_specs, x_spec),
         out_specs=(shard_specs, state_specs, P()),
     )
-    step_fn = jax.jit(sharded)
+    raw_step = jax.jit(sharded)
+    if offload_state:
+
+        def step_fn(pshards, opt_state, x):
+            st = jax.device_put(opt_state, dev_state_shardings)
+            ps, st, loss = raw_step(pshards, st, x)
+            return ps, jax.device_put(st, host_state_shardings), loss
+
+        step_fn.jitted = raw_step  # the compiled core, for memory analysis
+    else:
+        step_fn = raw_step
 
     # jitted ONCE here; a per-call jit(shard_map(...)) would retrace and
     # recompile on every gather
@@ -598,7 +639,9 @@ class FlagshipConfig:
     attn: str = "pallas"  # "xla" | "pallas"
     attn_layout: str = "contiguous"
     moe: bool = False
-    optimizer: str = "sgd"  # sgd | zero-sgd | zero-adam (sharded optimizer)
+    # sgd | zero-sgd | zero-adam (sharded optimizer) | zero-adam-offload
+    # (sharded + moments pinned to host memory between steps)
+    optimizer: str = "sgd"
     remat: bool = False  # jax.checkpoint each block (FLOPs for HBM)
     depth: int = 1  # stacked blocks applied by lax.scan
     reps: int = 10
@@ -674,9 +717,16 @@ def run_flagship(mesh: Mesh, cfg: FlagshipConfig, writer) -> list:
     # diverge the unnormalized objective) but non-zero so XLA cannot fold
     # the update away and DCE the entire backward.
     sx = jax.device_put(x, NamedSharding(mesh, P("dp", "sp", None)))
-    if cfg.optimizer.startswith("zero"):
+    zero_opts = {
+        f"zero-{base}{suffix}"
+        for base in ("sgd", "adam")
+        for suffix in ("", "-offload")
+    }
+    if cfg.optimizer in zero_opts:
+        offload = cfg.optimizer.endswith("-offload")
+        base = cfg.optimizer.removesuffix("-offload").split("-", 1)[1]
         zstep, zinit, _ = make_zero_train_step(
-            mesh, mcfg, lr=1e-30, optimizer=cfg.optimizer.split("-", 1)[1]
+            mesh, mcfg, lr=1e-30, optimizer=base, offload_state=offload
         )
         shards0, state0 = zinit(shard_params(params, mesh, mcfg))
 
@@ -686,14 +736,27 @@ def run_flagship(mesh: Mesh, cfg: FlagshipConfig, writer) -> list:
             return (sh, st), loss
 
         p = (shards0, state0)
-        mem = _memory_metrics(zstep, shards0, state0, sx)
+        # for the offload wrapper, analyze its compiled core (.jitted) with
+        # device-sharded abstract state (the host-pinned concrete arrays
+        # would bake the unsupported placement into the analysis lowering)
+        state_abs = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(
+                a.shape, a.dtype,
+                sharding=NamedSharding(a.sharding.mesh, a.sharding.spec),
+            ),
+            state0,
+        )
+        mem = _memory_metrics(
+            getattr(zstep, "jitted", zstep), shards0, state_abs, sx
+        )
     elif cfg.optimizer == "sgd":
         step, _ = make_train_step(mesh, mcfg, lr=1e-30)
         p = shard_params(params, mesh, mcfg)
         mem = _memory_metrics(step, p, sx)
     else:
         raise ValueError(
-            f"unknown optimizer {cfg.optimizer!r}; want sgd|zero-sgd|zero-adam"
+            f"unknown optimizer {cfg.optimizer!r}; want "
+            "sgd|zero-sgd|zero-adam|zero-{sgd,adam}-offload"
         )
 
     def build_chain(k: int):
