@@ -123,6 +123,22 @@ class TestCommands:
         }
         assert all(r["verdict"] == "SUCCESS" for r in recs)
 
+    def test_flagship_zero_offload(self, tmp_path):
+        # the full offload path: zero_opts parsing, pinned_host state
+        # staging, and the .jitted/abstract-state memory-analysis branch
+        jl = tmp_path / "flag.jsonl"
+        rc = main(
+            ["--jsonl", str(jl), "flagship", "--attn", "xla",
+             "--optimizer", "zero-adam-offload", "--dp", "2",
+             "--embed", "64", "--head_dim", "8", "--seq", "128",
+             "--batch", "4", "--dtype", "float32", "--reps", "2"]
+        )
+        assert rc == 0
+        (rec,) = _read_jsonl(jl)
+        assert rec["mode"] == "xla_zero-adam-offload"
+        assert rec["verdict"] == "SUCCESS"
+        assert rec["metrics"].get("peak_temp_MB", 0) > 0
+
     def test_report(self, tmp_path, capsys):
         log = tmp_path / "x.log"
         log.write_text(
@@ -178,16 +194,18 @@ class TestSweep:
             if "flash" in s.name:
                 i = s.argv.index("--devices")
                 assert s.argv[i + 1] == "1", s.name
+        tune = sweep.specs_for("tune", quick=True)
+        assert len(tune) == 7  # 4 chunk counts + 3 block sizes
         # 'all' must be exactly these suites, independently summed
         assert set(sweep.SUITES) == {
-            "p2p", "hier", "measured", "concurrency", "allreduce",
+            "p2p", "hier", "measured", "tune", "concurrency", "allreduce",
             "longctx", "parallel",
         }
         assert len(sweep.specs_for("all", quick=True)) == len(p2p) + len(
             con
         ) + len(sweep.specs_for("allreduce", quick=True)) + len(lc) + len(
             par
-        ) + len(hier) + len(meas)
+        ) + len(hier) + len(meas) + len(tune)
 
     def test_unknown_name_filter(self, tmp_path):
         with pytest.raises(ValueError, match="unknown cell name"):

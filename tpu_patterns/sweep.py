@@ -416,10 +416,48 @@ def measured_specs(quick: bool = False) -> list[SweepSpec]:
     return specs
 
 
+def tune_specs(quick: bool = False) -> list[SweepSpec]:
+    """DMA-schedule parameter search for the single-chip HBM-copy headline
+    (bench.py's 1-device metric): outstanding-DMA count for the multi
+    kernel x VMEM block size for the streamed kernel.  Run on a live chip,
+    promote the winner to the OneSidedConfig defaults."""
+    base = ("p2p", "--transport", "one_sided", "--devices", "1")
+    # quick count keeps rows (count/512) >= 2048 so the three block-size
+    # cells stay distinct configurations (the divisor clamp would fold a
+    # smaller buffer's 512/1024/2048 all to the same block)
+    size = ("--count", "1048576", "--reps", "2") if quick else ("--reps", "5")
+    env = (("TPU_PATTERNS_SWEEP_CONFIG", "tune"),)
+    specs = []
+    for chunks in (4, 8, 16, 32):
+        specs.append(
+            SweepSpec(
+                name=f"tune.multi.chunks{chunks}",
+                argv=(
+                    *base, "--put-kernel", "multi",
+                    "--chunks", str(chunks), *size,
+                ),
+                env=env,
+            )
+        )
+    for rows in (512, 1024, 2048):
+        specs.append(
+            SweepSpec(
+                name=f"tune.streamed.rows{rows}",
+                argv=(
+                    *base, "--put-kernel", "streamed",
+                    "--block-rows", str(rows), *size,
+                ),
+                env=env,
+            )
+        )
+    return specs
+
+
 SUITES = {
     "p2p": p2p_specs,
     "hier": hier_specs,
     "measured": measured_specs,
+    "tune": tune_specs,
     "concurrency": concurrency_specs,
     "allreduce": allreduce_specs,
     "longctx": longctx_specs,
