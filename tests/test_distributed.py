@@ -4,9 +4,13 @@ The reference's multi-node story is `mpirun -n N` + MPI_Init
 (SURVEY.md §4: no cluster-free mode exists there).  Here the same contract
 — launcher env -> bootstrap() -> global collectives — runs as two actual
 OS processes joined through jax.distributed over a localhost coordinator,
-with a psum and a cross-process ppermute ring verified on the global mesh.
-CPU devices, Gloo collectives: no hardware needed, exactly the
-cluster-free distributed mode the reference lacks.
+verifying on the global mesh: a psum, a cross-process ppermute ring, the
+hierarchical (dcn x ici) allreduce with the process boundary as the real
+dcn tier, and the FULL flagship training step with its sp axis spanning
+the processes — the ring-attention ppermutes and the sp loss psum ride
+gloo, while tp pairs stay intra-process — loss matching the
+single-device reference exactly.  CPU devices, Gloo collectives: no
+hardware needed — the cluster-free distributed mode the reference lacks.
 """
 
 import os
@@ -90,6 +94,40 @@ WORKER = textwrap.dedent(
     local = np.asarray(hfn().addressable_shards[0].data)[0, 0]
     # sum over ranks r=0..3 of (r + j) = 6 + 4j
     assert np.allclose(local, 6.0 + 4.0 * np.arange(hn)), local
+
+    # The flagship training step ACROSS the process boundary: a
+    # ("dp","sp","tp") mesh whose sp axis spans the two processes, so the
+    # ring-attention ppermutes and the sp loss psum ride gloo (tp pairs
+    # stay intra-process) — the full model-training analogue of the
+    # reference's multi-node mpirun story.
+    from tpu_patterns.models import ModelConfig, init_params, make_train_step
+    from tpu_patterns.models.transformer import forward_shard
+
+    cfg = ModelConfig(embed=32, heads=4, head_dim=8, dtype="float32")
+    m3 = Mesh(np.array(jax.devices()).reshape(1, 2, 2), ("dp", "sp", "tp"))
+    params = init_params(jax.random.key(0), cfg)  # deterministic: all ranks agree
+    x_np = np.asarray(
+        jax.random.normal(jax.random.key(1), (2, 16, 32), jnp.float32)
+    )
+    step, pspecs = make_train_step(m3, cfg, lr=0.0)
+
+    def put_global(arr, spec):
+        from jax.sharding import NamedSharding
+
+        sh = NamedSharding(m3, spec)
+        return jax.make_array_from_callback(
+            arr.shape, sh, lambda idx: np.asarray(arr)[idx]
+        )
+
+    gp = {k: put_global(np.asarray(v), pspecs[k]) for k, v in params.items()}
+    gx = put_global(x_np, P("dp", "sp", None))
+    _, loss = step(gp, gx)
+    # single-device reference on the full arrays (pure local math)
+    ref = forward_shard(params, jnp.asarray(x_np), cfg)
+    want_loss = float(jnp.sum(ref.astype(jnp.float32) ** 2))
+    assert np.isclose(float(loss), want_loss, rtol=1e-5), (
+        float(loss), want_loss,
+    )
     print(f"rank {info.process_id} OK", flush=True)
     """
 )

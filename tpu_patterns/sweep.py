@@ -426,28 +426,32 @@ def tune_specs(quick: bool = False) -> list[SweepSpec]:
     # cells stay distinct configurations (the divisor clamp would fold a
     # smaller buffer's 512/1024/2048 all to the same block)
     size = ("--count", "1048576", "--reps", "2") if quick else ("--reps", "5")
-    env = (("TPU_PATTERNS_SWEEP_CONFIG", "tune"),)
     specs = []
     for chunks in (4, 8, 16, 32):
+        name = f"tune.multi.chunks{chunks}"
         specs.append(
             SweepSpec(
-                name=f"tune.multi.chunks{chunks}",
+                name=name,
                 argv=(
                     *base, "--put-kernel", "multi",
                     "--chunks", str(chunks), *size,
                 ),
-                env=env,
+                # per-cell config tag: record mode/commands are identical
+                # across cells, so the report keys rows by THIS (the same
+                # collision-avoidance as p2p_specs)
+                env=(("TPU_PATTERNS_SWEEP_CONFIG", name),),
             )
         )
     for rows in (512, 1024, 2048):
+        name = f"tune.streamed.rows{rows}"
         specs.append(
             SweepSpec(
-                name=f"tune.streamed.rows{rows}",
+                name=name,
                 argv=(
                     *base, "--put-kernel", "streamed",
                     "--block-rows", str(rows), *size,
                 ),
-                env=env,
+                env=(("TPU_PATTERNS_SWEEP_CONFIG", name),),
             )
         )
     return specs
