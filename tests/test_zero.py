@@ -130,7 +130,17 @@ class TestMemoryModel:
 
 
 class TestZeroTrainStep:
-    def test_matches_plain_sgd_train_step(self, devices):
+    @pytest.mark.parametrize(
+        "extra",
+        [
+            {},  # single block
+            # ZeRO over STACKED params (depth via lax.scan, per-layer
+            # remat): the shard machinery flattens whole stacked leaves
+            {"depth": 2, "remat": True},
+        ],
+        ids=["plain", "depth_remat"],
+    )
+    def test_matches_plain_sgd_train_step(self, devices, extra):
         # the composition gate: one ZeRO-sgd step == make_train_step's SGD
         # (same summed-grad math via scatter instead of psum transpose)
         from tpu_patterns.models import (
@@ -142,9 +152,13 @@ class TestZeroTrainStep:
         )
 
         mesh = Mesh(np.array(devices[:8]).reshape(2, 2, 2), ("dp", "sp", "tp"))
-        cfg = ModelConfig(embed=64, heads=8, head_dim=8, dtype="float32")
+        cfg = ModelConfig(
+            embed=64, heads=8, head_dim=8, dtype="float32", **extra
+        )
         lr = 1e-3
         params = init_params(jax.random.key(0), cfg)
+        if cfg.depth > 1:
+            assert params["wqkv"].shape[0] == cfg.depth  # stacked
         x = jax.random.normal(jax.random.key(1), (4, 32, 64), jnp.float32)
         sx = jax.device_put(x, NamedSharding(mesh, P("dp", "sp", None)))
 
