@@ -194,8 +194,9 @@ def longctx_specs(quick: bool = False) -> list[SweepSpec]:
             env=(("TPU_PATTERNS_SWEEP_CONFIG", "longctx"),),
         )
     )
-    # backward cells: fwd+bwd measured with gradient gates
-    for strategy in ("ring", "ring_pallas"):
+    # backward cells: fwd+bwd measured with gradient gates (ulysses'
+    # backward is the all_to_all transpose — free from autodiff)
+    for strategy in ("ring", "ring_pallas", "ulysses"):
         specs.append(
             SweepSpec(
                 name=f"longctx.grad.{strategy}",
