@@ -403,6 +403,18 @@ def _cmd_topo(args, writer: ResultWriter) -> None:
     print(topo.describe())  # ≙ plane dump (:92-97)
     for mode in PlacementMode:
         print(f"placement {mode.value}: {order_devices(topo, mode)}")
+    # the slice/process tier (what `hier --dcn 0` auto-detects): the
+    # fabric boundary ABOVE the ICI planes
+    import jax
+
+    from tpu_patterns.comm.hierarchical import detect_hierarchy
+
+    try:
+        n_groups, _ = detect_hierarchy(jax.devices())
+        print(f"hierarchy: {n_groups} slice group(s) "
+              f"({len(jax.devices())} devices)")
+    except ValueError as e:  # unequal groups: report, don't crash the probe
+        print(f"hierarchy: irregular ({e})")
 
 
 def _cmd_interop(args, writer: ResultWriter) -> None:
