@@ -286,6 +286,21 @@ class TestSweep:
         assert calls == [name, name]  # re-ran after the timeout
         assert rc == 0
 
+    def test_sweep_crash_cell_not_checkpointed(self, tmp_path):
+        # a REAL crashing subprocess (traceback, no records) must be
+        # recorded completed=False so --resume retries it
+        env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+        env["TPU_PATTERNS_PLATFORM"] = "bogus_platform"  # backend init dies
+        name = "p2p.compact.mesh.two_sided.n2"
+        rc = sweep.run_sweep(
+            "p2p", out_dir=str(tmp_path), quick=True, names=[name],
+            base_env=env,
+        )
+        assert rc == 1
+        st = sweep.load_sweep_state(str(tmp_path))
+        assert st[name]["rc"] != 0
+        assert st[name]["completed"] is False
+
     def test_sweep_resume_skips_passed_cells(self, tmp_path, capsys):
         env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
         env["JAX_PLATFORMS"] = "cpu"
