@@ -253,7 +253,7 @@ class TestSweep:
         monkeypatch.setattr(
             sweep,
             "run_spec",
-            lambda spec, out, base_env=None: calls.append(spec.name)
+            lambda spec, out, base_env=None, timeout=None: calls.append(spec.name)
             or (1, True),  # completed, verdict FAILURE
         )
         rc = sweep.run_sweep(
@@ -275,7 +275,7 @@ class TestSweep:
         monkeypatch.setattr(
             sweep,
             "run_spec",
-            lambda spec, out, base_env=None: calls.append(spec.name)
+            lambda spec, out, base_env=None, timeout=None: calls.append(spec.name)
             or next(results),
         )
         sweep.run_sweep("p2p", out_dir=str(tmp_path), quick=True, names=[name])
@@ -343,7 +343,7 @@ class TestSweep:
         assert st[name] == {"rc": 1, "sig": "x", "completed": False}
         calls = []
         monkeypatch.setattr(
-            sweep, "run_spec", lambda spec, out, base_env=None: calls.append(
+            sweep, "run_spec", lambda spec, out, base_env=None, timeout=None: calls.append(
                 spec.name
             ) or (0, True),
         )
@@ -367,7 +367,7 @@ class TestSweep:
         name = "p2p.compact.mesh.two_sided.n2"
         calls = []
         monkeypatch.setattr(
-            sweep, "run_spec", lambda spec, out, base_env=None: calls.append(
+            sweep, "run_spec", lambda spec, out, base_env=None, timeout=None: calls.append(
                 spec.name
             ) or (0, True),
         )
@@ -394,7 +394,7 @@ class TestSweep:
         rcs = iter([0, 1])
         calls = []
         monkeypatch.setattr(
-            sweep, "run_spec", lambda spec, out, base_env=None: calls.append(
+            sweep, "run_spec", lambda spec, out, base_env=None, timeout=None: calls.append(
                 spec.name
             ) or (next(rcs), False),
         )
@@ -405,7 +405,7 @@ class TestSweep:
         assert sweep.load_sweep_state(str(tmp_path))[name]["rc"] == 1
         # 'all --resume' sees the latest (failed) state and re-runs
         monkeypatch.setattr(
-            sweep, "run_spec", lambda spec, out, base_env=None: calls.append(
+            sweep, "run_spec", lambda spec, out, base_env=None, timeout=None: calls.append(
                 spec.name
             ) or (0, True),
         )
@@ -435,7 +435,7 @@ class TestSweep:
             ) + "\n")
         calls = []
         monkeypatch.setattr(
-            sweep, "run_spec", lambda spec, out, base_env=None: calls.append(
+            sweep, "run_spec", lambda spec, out, base_env=None, timeout=None: calls.append(
                 spec.name
             ) or (0, True),
         )
@@ -458,7 +458,7 @@ class TestSweep:
         name = "p2p.compact.mesh.two_sided.n2"
         calls = []
         monkeypatch.setattr(
-            sweep, "run_spec", lambda spec, out, base_env=None: calls.append(
+            sweep, "run_spec", lambda spec, out, base_env=None, timeout=None: calls.append(
                 spec.name
             ) or (0, True),
         )

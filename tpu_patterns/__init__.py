@@ -27,4 +27,4 @@ Layer map (mirrors SURVEY.md §1):
   cli.py       launcher / sweep / report            (ref: run*.sh, parse.py)
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"  # keep in sync with pyproject.toml

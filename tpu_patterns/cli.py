@@ -496,7 +496,8 @@ def _cmd_sweep(args, writer: ResultWriter) -> int:
     from tpu_patterns import sweep
 
     return sweep.run_sweep(
-        args.suite, out_dir=args.out, quick=args.quick, resume=args.resume
+        args.suite, out_dir=args.out, quick=args.quick, resume=args.resume,
+        cell_timeout=args.cell_timeout,
     )
 
 
@@ -672,6 +673,15 @@ def build_parser() -> argparse.ArgumentParser:
         "--resume",
         action="store_true",
         help="skip cells already passed in a previous (interrupted) run",
+    )
+    from tpu_patterns.sweep import DEFAULT_CELL_TIMEOUT
+
+    s.add_argument(
+        "--cell-timeout",
+        type=float,
+        default=DEFAULT_CELL_TIMEOUT,
+        help="per-cell subprocess deadline in seconds; <= 0 disables it "
+        "(a timed-out cell is not completed: --resume retries it)",
     )
 
     r = sub.add_parser("report", help="tabulate logs (≙ parse.py)")

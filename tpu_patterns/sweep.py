@@ -476,11 +476,16 @@ def specs_for(suite: str, quick: bool = False) -> list[SweepSpec]:
     return SUITES[suite](quick)
 
 
+# One shared default for run_spec, run_sweep, and the CLI flag; <= 0
+# means "no deadline".
+DEFAULT_CELL_TIMEOUT = 1800.0
+
+
 def run_spec(
     spec: SweepSpec,
     out_dir: str,
     base_env: Mapping[str, str] | None = None,
-    timeout: float = 1800.0,
+    timeout: float = DEFAULT_CELL_TIMEOUT,
 ) -> tuple[int, bool]:
     """Run one cell: subprocess CLI, log tee'd to ``<name>.log``, JSONL to
     ``<name>.jsonl`` (≙ ``|& tee -a $log``, run_omp.sh:26).  Returns
@@ -499,7 +504,7 @@ def run_spec(
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
-            timeout=timeout,
+            timeout=timeout if timeout > 0 else None,  # <= 0: no deadline
         )
         stdout, rc = proc.stdout, proc.returncode
         timed_out = False
@@ -706,6 +711,7 @@ def run_sweep(
     names: Sequence[str] | None = None,
     base_env: Mapping[str, str] | None = None,
     resume: bool = False,
+    cell_timeout: float = DEFAULT_CELL_TIMEOUT,
 ) -> int:
     """Run a suite's matrix; print the tabulated report; return the
     aggregated exit code (any FAILURE -> 1).
@@ -753,7 +759,9 @@ def run_sweep(
                 rc = 1
             continue
         print(f"# sweep cell: {spec.name}", flush=True)
-        cell_rc, completed = run_spec(spec, out_dir, base_env=base_env)
+        cell_rc, completed = run_spec(
+            spec, out_dir, base_env=base_env, timeout=cell_timeout
+        )
         _record_cell(out_dir, suite, spec.name, cell_rc, sig, completed)
         print(f"# -> exit {cell_rc}", flush=True)
         if cell_rc != 0:  # incl. negative (signal-killed) returncodes
