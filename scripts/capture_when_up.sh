@@ -1,0 +1,23 @@
+#!/bin/bash
+# Poll the TPU tunnel; when it answers, capture the measurement matrix.
+# Each stage is resumable / deadline-bounded, so a mid-capture hang costs
+# one cell, not the session.  Run from the repo root:
+#   nohup bash scripts/capture_when_up.sh > /tmp/capture.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+OUT=docs/measured/r2live
+mkdir -p "$OUT"
+while true; do
+  if timeout 90 python -c "import jax; jax.block_until_ready(jax.numpy.ones((256,256))@jax.numpy.ones((256,256))); print('up', jax.devices())" >/dev/null 2>&1; then
+    echo "[$(date +%H:%M:%S)] tunnel up — capturing"
+    TPU_PATTERNS_BENCH_TIMEOUT=700 python bench.py > "$OUT/bench_$(date +%H%M%S).json" 2>> "$OUT/bench.log"
+    echo "[$(date +%H:%M:%S)] bench done: $(tail -c 300 "$OUT"/bench_*.json | tail -1)"
+    timeout 2400 python -m tpu_patterns sweep tune --out "$OUT/tune" --resume --cell-timeout 420 >> "$OUT/tune.log" 2>&1
+    echo "[$(date +%H:%M:%S)] tune done rc=$?"
+    timeout 3600 python -m tpu_patterns sweep measured --out "$OUT/measured" --resume --cell-timeout 420 >> "$OUT/measured.log" 2>&1
+    echo "[$(date +%H:%M:%S)] measured done rc=$?"
+    break
+  fi
+  echo "[$(date +%H:%M:%S)] tunnel down"
+  sleep 240
+done

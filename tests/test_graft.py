@@ -76,15 +76,20 @@ class TestBench:
         assert bench._spec(bench.HBM_SPEC, "TPU v5p chip") == 2765.0
         assert bench._spec(bench.HBM_SPEC, "unknown") is None
 
-    def test_bench_emits_one_json_line(self):
+    @pytest.mark.parametrize("watchdog", [True, False])
+    def test_bench_emits_one_json_line(self, watchdog):
         # Subprocess on the CPU-simulated mesh: stdout must be exactly one
-        # parsable JSON line with the driver's schema.
+        # parsable JSON line with the driver's schema — with the watchdog
+        # parent filtering (default) AND with the watchdog disabled, where
+        # _child_main runs in-process and must not emit the quick line.
         import os
 
         env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
         env["JAX_PLATFORMS"] = "cpu"
         env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
         env["TPU_PATTERNS_COUNT"] = "65536"  # small workload for CI
+        if not watchdog:
+            env["TPU_PATTERNS_BENCH_TIMEOUT"] = "0"
         proc = subprocess.run(
             [sys.executable, str(ROOT / "bench.py")],
             env=env,
@@ -100,6 +105,73 @@ class TestBench:
         assert set(rec) >= {"metric", "value", "unit", "vs_baseline"}
         assert rec["metric"] != "bench_error", rec
         assert rec["value"] > 0
+
+    def test_last_metric_line_selection(self):
+        # The parent's salvage helper must pick the LAST driver-schema
+        # line and ignore non-JSON chatter and schema-less scalars.
+        bench = _load("bench")
+        sample = "\n".join(
+            [
+                "42",  # parseable but schema-less: must be skipped
+                json.dumps({"metric": "m", "value": 1, "stage": "quick"}),
+                "not json",
+                json.dumps({"metric": "m", "value": 2}),
+                "trailing noise",
+            ]
+        )
+        assert json.loads(bench.last_metric_line(sample)) == {
+            "metric": "m",
+            "value": 2,
+        }
+        assert bench.last_metric_line("chatter\n42\n") is None
+        assert bench.last_metric_line("") is None
+
+    def test_bench_salvages_provisional_line_on_hang(self, tmp_path):
+        # A child that prints a provisional quick-pass line and then hangs
+        # must yield that line (plus a hang note), not a bare error.
+        import os
+        import textwrap
+
+        fake_repo = tmp_path / "fakebench"
+        fake_repo.mkdir()
+        bench_src = (ROOT / "bench.py").read_text()
+        # swap the real measurement for a scripted child: the watchdog
+        # machinery (preflight, ladder salvage) is what's under test
+        stub = textwrap.dedent(
+            '''
+            def _child_main() -> int:
+                import json, sys, time
+                print(json.dumps({"metric": "hbm_copy", "value": 12.3,
+                                  "unit": "GB/s", "vs_baseline": 0.5,
+                                  "stage": "quick"}), flush=True)
+                time.sleep(3600)  # full-size pass "hangs"
+                return 0
+
+            def _preflight_main() -> int:
+                print("preflight_ok stub")
+                return 0
+            '''
+        )
+        marker = "def main() -> int:"
+        head, tail = bench_src.split(marker, 1)
+        (fake_repo / "bench.py").write_text(head + stub + marker + tail)
+        env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+        env["TPU_PATTERNS_BENCH_PREFLIGHT"] = "30"
+        env["TPU_PATTERNS_BENCH_TIMEOUT"] = "6"
+        proc = subprocess.run(
+            [sys.executable, str(fake_repo / "bench.py")],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=120,
+            cwd=fake_repo,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+        assert len(lines) == 1, proc.stdout
+        rec = json.loads(lines[0])
+        assert rec["metric"] == "hbm_copy" and rec["value"] == 12.3
+        assert "provisional" in rec["error"]
 
     def test_bench_preflight_failure_is_fast_and_distinguishable(self):
         # A broken device backend must cost ~2 preflight deadlines, not the

@@ -509,7 +509,14 @@ def run_spec(
         stdout, rc = proc.stdout, proc.returncode
         timed_out = False
     except subprocess.TimeoutExpired as e:
-        stdout = (e.stdout or "") if isinstance(e.stdout, str) else ""
+        # TimeoutExpired carries the child's partial output as BYTES even
+        # in text mode — decode it so the lines before the hang (the
+        # diagnostic that says where it hung) reach the cell log.
+        partial = e.stdout or b""
+        stdout = (
+            partial if isinstance(partial, str)
+            else partial.decode(errors="replace")
+        )
         stdout += f"\n## {spec.name} | timeout | FAILURE\n"
         rc, timed_out = 1, True
     with open(log_path, "w") as f:
