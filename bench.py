@@ -296,6 +296,18 @@ def main() -> int:
             )
             return 0
 
+    def annotate_salvaged(line: str, quick_msg: str, full_msg: str) -> str:
+        """Mark a salvaged line so it never reads as a clean run; a line
+        already carrying structured error detail (a child bench_error
+        printed before the hang/crash) passes through verbatim."""
+        rec = json.loads(line)
+        if "error" not in rec:
+            rec["error"] = (
+                quick_msg if rec.get("stage") == "quick" else full_msg
+            )
+            return json.dumps(rec)
+        return line
+
     proc, stdout = run_child("_TPU_PATTERNS_BENCH_CHILD", timeout_s)
     salvaged = last_metric_line(stdout)
     if proc is None:
@@ -303,22 +315,13 @@ def main() -> int:
             # a measurement landed before the hang — a real number beats
             # an error line.  Distinguish a salvaged small-workload quick
             # pass from a full measurement whose process hung at teardown.
-            # A line already carrying structured error detail (a child
-            # bench_error before the hang) passes through verbatim.
-            rec = json.loads(salvaged)
-            if "error" in rec:
-                pass
-            elif rec.get("stage") == "quick":
-                rec["error"] = (
-                    f"full-size pass exceeded {timeout_s}s; provisional "
-                    "quick-pass measurement salvaged"
-                )
-            else:
-                rec["error"] = (
-                    f"child hung past {timeout_s}s after completing the "
-                    "full measurement (teardown hang); result salvaged"
-                )
-            out = json.dumps(rec)
+            out = annotate_salvaged(
+                salvaged,
+                f"full-size pass exceeded {timeout_s}s; provisional "
+                "quick-pass measurement salvaged",
+                f"child hung past {timeout_s}s after completing the "
+                "full measurement (teardown hang); result salvaged",
+            )
         else:
             out = error_line(
                 f"bench exceeded {timeout_s}s after a clean preflight "
@@ -336,18 +339,16 @@ def main() -> int:
                 f"child exited {proc.returncode}; last output "
                 f"{lines[-1][:120] if lines else '<none>'!r}"
             )
-        elif proc.returncode != 0 and "error" not in json.loads(out):
+        elif proc.returncode != 0:
             # native crash after the last good line: never present a
-            # salvaged (possibly quick-pass) number as a clean run (a
-            # line already carrying structured error detail passes as-is)
-            rec = json.loads(out)
-            rec["error"] = (
+            # salvaged (possibly quick-pass) number as a clean run
+            out = annotate_salvaged(
+                out,
                 f"child exited {proc.returncode} after this line; "
-                + ("provisional quick-pass measurement salvaged"
-                   if rec.get("stage") == "quick"
-                   else "crash after measurement; result salvaged")
+                "provisional quick-pass measurement salvaged",
+                f"child exited {proc.returncode} after this line; "
+                "crash after measurement; result salvaged",
             )
-            out = json.dumps(rec)
     print(out, flush=True)
     return 0
 

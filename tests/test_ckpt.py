@@ -1,0 +1,245 @@
+"""Checkpoint/resume: atomic sharded save, elastic restore, resumed
+training equivalence (ckpt/checkpoint.py + models/train_loop.py)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_patterns import ckpt
+from tpu_patterns.models.train_loop import TrainLoopConfig, train
+
+
+@pytest.fixture(scope="module")
+def mesh2d(devices):
+    return Mesh(np.array(devices[:8]).reshape(4, 2), ("dp", "tp"))
+
+
+def _tree(mesh):
+    """Mixed pytree: sharded matrix, replicated vector, bf16, scalar."""
+    w = jax.device_put(
+        jnp.arange(64 * 32, dtype=jnp.float32).reshape(64, 32),
+        NamedSharding(mesh, P("dp", "tp")),
+    )
+    b = jax.device_put(
+        jnp.linspace(0, 1, 32, dtype=jnp.float32),
+        NamedSharding(mesh, P()),
+    )
+    h = jax.device_put(
+        (jnp.arange(16, dtype=jnp.bfloat16) / 7).reshape(4, 4),
+        NamedSharding(mesh, P("dp", None)),
+    )
+    step = jax.device_put(
+        jnp.asarray(3, jnp.int32), NamedSharding(mesh, P())
+    )
+    return {"w": w, "inner": {"b": b, "h": h}, "step": step}
+
+
+def _assert_tree_equal(a, b):
+    la = jax.tree_util.tree_leaves_with_path(a)
+    lb = {jax.tree_util.keystr(p): v for p, v in
+          jax.tree_util.tree_leaves_with_path(b)}
+    assert {jax.tree_util.keystr(p) for p, _ in la} == set(lb)
+    for p, va in la:
+        vb = lb[jax.tree_util.keystr(p)]
+        assert va.dtype == vb.dtype, p
+        np.testing.assert_array_equal(
+            np.atleast_1d(np.asarray(va)).view(np.uint8),
+            np.atleast_1d(np.asarray(vb)).view(np.uint8),
+        )
+
+
+class TestRoundTrip:
+    def test_same_mesh_bitwise(self, mesh2d, tmp_path):
+        tree = _tree(mesh2d)
+        ckpt.save(str(tmp_path), 3, tree)
+        back = ckpt.restore(str(tmp_path), tree)
+        _assert_tree_equal(tree, back)
+        # restored leaves carry the template's sharding
+        assert back["w"].sharding == tree["w"].sharding
+
+    def test_elastic_restore_different_mesh(self, devices, tmp_path):
+        save_mesh = Mesh(np.array(devices[:8]).reshape(4, 2), ("dp", "tp"))
+        tree = _tree(save_mesh)
+        ckpt.save(str(tmp_path), 1, tree)
+        # new topology: 2x4, transposed layout for w
+        new_mesh = Mesh(np.array(devices[:8]).reshape(2, 4), ("dp", "tp"))
+        template = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(
+                a.shape, a.dtype,
+                sharding=NamedSharding(new_mesh, a.sharding.spec),
+            ),
+            tree,
+        )
+        back = ckpt.restore(str(tmp_path), template)
+        _assert_tree_equal(tree, back)
+        assert back["w"].sharding.mesh.shape["dp"] == 2
+
+    def test_restore_subset_template_by_keypath(self, mesh2d, tmp_path):
+        tree = _tree(mesh2d)
+        ckpt.save(str(tmp_path), 1, tree)
+        sub = {"inner": {"h": tree["inner"]["h"]}}
+        back = ckpt.restore(str(tmp_path), sub)
+        _assert_tree_equal(sub, back)
+
+    def test_schema_mismatch_is_an_error(self, mesh2d, tmp_path):
+        tree = _tree(mesh2d)
+        ckpt.save(str(tmp_path), 1, tree)
+        with pytest.raises(KeyError, match="not in checkpoint"):
+            ckpt.restore(str(tmp_path), {"nope": tree["w"]})
+        wrong = {"w": jax.ShapeDtypeStruct(
+            (8, 8), jnp.float32, sharding=tree["w"].sharding
+        )}
+        with pytest.raises(ValueError, match="shape"):
+            ckpt.restore(str(tmp_path), wrong)
+
+    def test_replicated_leaves_written_once(self, mesh2d, tmp_path):
+        # b is fully replicated over 8 devices: exactly ONE shard entry
+        tree = _tree(mesh2d)
+        path = ckpt.save(str(tmp_path), 1, tree)
+        with open(os.path.join(path, "shards_proc0.json")) as f:
+            table = json.load(f)
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+        leaf_of = {info["key"]: info["leaf"] for info in manifest["leaves"]}
+        b_shards = [e for e in table
+                    if e["leaf"] == leaf_of["['inner']['b']"]]
+        assert len(b_shards) == 1
+        # w is fully sharded 4x2: all 8 shards present
+        w_shards = [e for e in table if e["leaf"] == leaf_of["['w']"]]
+        assert len(w_shards) == 8
+
+
+class TestAtomicity:
+    def test_crashed_save_is_invisible_and_swept(self, mesh2d, tmp_path):
+        tree = _tree(mesh2d)
+        ckpt.save(str(tmp_path), 1, tree)
+        # simulate a crash mid-save: a torn tmp dir with partial files
+        torn = tmp_path / ".tmp.step_2"
+        torn.mkdir()
+        (torn / "proc0.npz").write_bytes(b"garbage")
+        assert ckpt.latest_step(str(tmp_path)) == 1
+        back = ckpt.restore(str(tmp_path), tree)
+        _assert_tree_equal(tree, back)
+        # next save sweeps the torn dir
+        ckpt.save(str(tmp_path), 2, tree)
+        assert not torn.exists()
+        assert ckpt.latest_step(str(tmp_path)) == 2
+
+    def test_manifest_is_the_commit_marker(self, mesh2d, tmp_path):
+        tree = _tree(mesh2d)
+        path = ckpt.save(str(tmp_path), 5, tree)
+        os.unlink(os.path.join(path, "manifest.json"))
+        assert ckpt.available_steps(str(tmp_path)) == []
+        with pytest.raises(FileNotFoundError):
+            ckpt.restore(str(tmp_path), tree)
+
+    def test_partial_shard_coverage_detected(self, mesh2d, tmp_path):
+        tree = _tree(mesh2d)
+        path = ckpt.save(str(tmp_path), 1, tree)
+        # drop half of w's shards from the table: restore must refuse
+        with open(os.path.join(path, "shards_proc0.json")) as f:
+            table = json.load(f)
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+        w_leaf = next(i["leaf"] for i in manifest["leaves"]
+                      if i["key"] == "['w']")
+        kept = [e for e in table
+                if e["leaf"] != w_leaf or e["index"][0][0] == 0]
+        with open(os.path.join(path, "shards_proc0.json"), "w") as f:
+            json.dump(kept, f)
+        with pytest.raises(ValueError, match="missing shards"):
+            ckpt.restore(str(tmp_path), tree)
+
+    def test_same_step_overwrite_never_deletes_before_commit(
+        self, mesh2d, tmp_path
+    ):
+        # a resumed run re-saving its own step: new content wins, the old
+        # dir was renamed aside (never rmtree'd pre-commit) and swept
+        tree = _tree(mesh2d)
+        ckpt.save(str(tmp_path), 1, tree)
+        bumped = dict(tree, w=tree["w"] + 1)
+        ckpt.save(str(tmp_path), 1, bumped)
+        back = ckpt.restore(str(tmp_path), tree)
+        _assert_tree_equal(bumped, back)
+        leftovers = [n for n in os.listdir(tmp_path) if n.startswith(".old.")]
+        assert leftovers == []
+
+    def test_retention_prunes_oldest(self, mesh2d, tmp_path):
+        tree = _tree(mesh2d)
+        for s in (1, 2, 3, 4):
+            ckpt.save(str(tmp_path), s, tree, keep=2)
+        assert ckpt.available_steps(str(tmp_path)) == [3, 4]
+        assert ckpt.latest_step(str(tmp_path)) == 4
+
+    def test_non_array_leaf_rejected(self, mesh2d, tmp_path):
+        with pytest.raises(TypeError, match="jax.Array"):
+            ckpt.save(str(tmp_path), 1, {"x": 3.14})
+
+
+MESH_AXES = ("dp", "sp", "tp")
+
+
+@pytest.fixture(scope="module")
+def mesh3d(devices):
+    return Mesh(np.array(devices[:8]).reshape(2, 2, 2), MESH_AXES)
+
+
+def _loop_cfg(tmp, **kw):
+    base = dict(
+        embed=64, heads=8, head_dim=8, seq=32, batch=4, steps=6,
+        lr=1e-4, ckpt_dir=str(tmp), ckpt_every=2,
+    )
+    base.update(kw)
+    return TrainLoopConfig(**base)
+
+
+class TestResume:
+    @pytest.mark.parametrize("opt", ["sgd", "zero-adam"])
+    def test_killed_run_resumes_bit_exact(self, mesh3d, tmp_path, opt):
+        # straight 6-step run (checkpointing on: saves must not perturb)
+        ref = train(mesh3d, _loop_cfg(tmp_path / "a", optimizer=opt))
+        # "killed" after 4 steps...
+        train(mesh3d, _loop_cfg(tmp_path / "b", optimizer=opt, steps=4))
+        # ...resumed to 6
+        res = train(
+            mesh3d,
+            _loop_cfg(tmp_path / "b", optimizer=opt, resume=True),
+        )
+        assert res["start_step"] == 4
+        # finite FIRST: two nan-diverged runs would match bitwise too
+        assert np.isfinite(res["loss"]), res["loss"]
+        assert ref["loss"] == res["loss"]
+        _assert_tree_equal(ref["state"], res["state"])
+
+    def test_resume_without_checkpoint_starts_fresh(self, mesh3d, tmp_path):
+        out = train(
+            mesh3d,
+            _loop_cfg(tmp_path, steps=2, resume=True, ckpt_every=0),
+        )
+        assert out["start_step"] == 0
+        assert np.isfinite(out["loss"])
+
+    def test_fresh_run_into_used_dir_refused(self, mesh3d, tmp_path):
+        # without resume, a dir holding another run's committed steps
+        # must be an error (stale steps would poison retention + resume)
+        train(mesh3d, _loop_cfg(tmp_path, steps=2))
+        with pytest.raises(ValueError, match="already holds committed"):
+            train(mesh3d, _loop_cfg(tmp_path, steps=2))
+
+    def test_noop_resume_of_complete_run(self, mesh3d, tmp_path):
+        # resuming a finished run must not fabricate a loss
+        train(mesh3d, _loop_cfg(tmp_path, steps=2))
+        out = train(mesh3d, _loop_cfg(tmp_path, steps=2, resume=True))
+        assert out["start_step"] == 2
+        assert out["loss"] is None
+
+    def test_training_moves_params(self, mesh3d, tmp_path):
+        cfg = _loop_cfg(tmp_path, steps=2, ckpt_every=0)
+        out = train(mesh3d, cfg)
+        assert int(np.asarray(out["state"]["step"])) == 2
+        assert np.isfinite(out["loss"])

@@ -1,0 +1,24 @@
+"""Sharded checkpoint / resume.
+
+The aux subsystem the reference suite leaves to the job scheduler
+(SURVEY §5: no checkpoint/resume in `/root/reference`) — but a framework
+whose flagship is a distributed training step needs one: a sweep cell or
+training run killed by a dead device tunnel must resume from its last
+committed state, not restart (the same crash-vs-result discipline as
+``sweep.py --resume``).
+
+TPU-native design: leaves are ``jax.Array``s laid out by
+``NamedSharding`` over a mesh; save writes only addressable replica-0
+shards (no gather, no host round trip of replicated copies), and restore
+rebuilds arrays for ANY target sharding — the saved mesh and the restore
+mesh need not match (elastic restore onto a different topology).
+"""
+
+from tpu_patterns.ckpt.checkpoint import (
+    available_steps,
+    latest_step,
+    restore,
+    save,
+)
+
+__all__ = ["available_steps", "latest_step", "restore", "save"]

@@ -1,0 +1,343 @@
+"""Sharding-aware atomic checkpoints for pytrees of ``jax.Array``.
+
+Layout of one committed checkpoint (``<root>/step_<N>/``):
+
+    manifest.json        leaf table: keypath -> shape/dtype/spec, mesh info
+    proc0.npz            this process's replica-0 shards, one entry per
+    proc1.npz ...        (leaf, shard) with its index recorded in the
+                         per-process shard table inside manifest_procN.json
+
+Commit protocol (crash-safe, ≙ the exit-code-is-the-verdict discipline of
+the reference harness — an artifact either exists complete or not at all):
+
+    1. all processes write shard files into ``<root>/.tmp.step_<N>``
+    2. barrier; process 0 writes ``manifest.json`` LAST, fsyncs, then
+       ``os.replace``-renames the tmp dir to ``step_<N>`` (atomic on
+       POSIX) and rewrites ``LATEST`` via the same tmp+replace dance
+    3. stale ``.tmp.*`` dirs from crashed saves are ignored by restore
+       and swept by the next successful save
+
+Restore fills a caller-provided **template** tree (concrete arrays or
+``jax.ShapeDtypeStruct`` with ``.sharding``): values come from the
+checkpoint, placement from the template.  This is what makes restore
+elastic — build the template on the new mesh and the saved shards are
+resharded on the way in, whatever mesh they were written from.  (A dp=4
+ZeRO state restores onto a dp=2 mesh without a separate repartition
+step.)
+
+Multi-process saves assume a shared filesystem (every HPC scheduler the
+reference targets provides one).  Restore assembles each leaf's FULL
+global array on every process's host before device placement slices out
+the addressable shards — simple and correct at pattern scale; a
+host-memory-bound deployment would intersect saved shard indices with
+the template's addressable slices instead (noted, not implemented).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+FORMAT_VERSION = 1
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a dtype name, including the ml_dtypes extension types
+    (``np.dtype("bfloat16")`` raises; jax arrays report exactly that)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _to_bytes_view(arr: np.ndarray) -> np.ndarray:
+    """Flat uint8 view: npz silently degrades extension dtypes (bfloat16
+    -> void), so every shard is stored as raw bytes and the dtype lives
+    in the manifest."""
+    return np.ascontiguousarray(arr).reshape(-1).view(np.uint8)
+
+
+def _keystr(path) -> str:
+    return jax.tree_util.keystr(path)
+
+
+def _spec_to_json(sharding) -> list:
+    """PartitionSpec -> JSON (informational; restore uses the template)."""
+    if not isinstance(sharding, NamedSharding):
+        return []
+    out = []
+    for entry in sharding.spec:
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            out.append(list(entry))
+        else:
+            out.append(entry)
+    return out
+
+
+def _barrier(tag: str) -> None:
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _step_dir(root: str, step: int) -> str:
+    return os.path.join(root, f"step_{step}")
+
+
+def available_steps(root: str) -> list[int]:
+    """Committed steps, ascending.  ``.tmp.*`` (crashed saves) excluded."""
+    if not os.path.isdir(root):
+        return []
+    steps = []
+    for name in os.listdir(root):
+        if name.startswith("step_") and os.path.isfile(
+            os.path.join(root, name, "manifest.json")
+        ):
+            try:
+                steps.append(int(name[len("step_"):]))
+            except ValueError:
+                continue
+    return sorted(steps)
+
+
+def latest_step(root: str) -> int | None:
+    """Newest committed step, by scan.  The ``LATEST`` pointer file is
+    written for humans and external tools; the scan is authoritative
+    because a crash between dir-rename and pointer-rewrite leaves a
+    committed step the pointer missed."""
+    steps = available_steps(root)
+    return max(steps) if steps else None
+
+
+def save(
+    root: str,
+    step: int,
+    tree,
+    *,
+    keep: int | None = None,
+) -> str:
+    """Write one atomic checkpoint of ``tree`` at ``step``.
+
+    Every leaf must be a ``jax.Array`` (committed data only — host
+    scalars belong in the caller's own metadata, passed through
+    ``manifest.json`` is deliberately NOT extensible to keep the format
+    auditable).  Returns the committed directory.  ``keep=k`` prunes all
+    but the newest k committed steps after a successful commit.
+    """
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    proc = jax.process_index()
+    tmp = os.path.join(root, f".tmp.step_{step}")
+    if proc == 0:
+        os.makedirs(root, exist_ok=True)
+        # a re-save of the same step (resumed run overwriting its own
+        # crash) must start clean
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+    _barrier(f"ckpt_mkdir_{step}")
+
+    shard_table = []
+    arrays = {}
+    manifest_leaves = []
+    for leaf_id, (path, leaf) in enumerate(leaves):
+        if not isinstance(leaf, jax.Array):
+            raise TypeError(
+                f"checkpoint leaf {_keystr(path)} is {type(leaf).__name__}; "
+                "only jax.Array leaves are checkpointable"
+            )
+        # jax.block_until_ready'd implicitly by np.asarray below
+        for shard_id, shard in enumerate(leaf.addressable_shards):
+            if shard.replica_id != 0:
+                continue  # replicated copies: one writer is enough
+            name = f"{leaf_id}.{shard_id}"
+            arrays[name] = _to_bytes_view(np.asarray(shard.data))
+            shard_table.append(
+                {
+                    "leaf": leaf_id,
+                    "name": name,
+                    # slice per dim as [start, stop] with None -> full
+                    "index": [
+                        [s.start, s.stop] for s in shard.index
+                    ],
+                }
+            )
+        if proc == 0:
+            manifest_leaves.append(
+                {
+                    "key": _keystr(path),
+                    "leaf": leaf_id,
+                    "shape": list(leaf.shape),
+                    "dtype": str(leaf.dtype),
+                    "spec": _spec_to_json(leaf.sharding),
+                }
+            )
+
+    with open(os.path.join(tmp, f"proc{proc}.npz"), "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    with open(os.path.join(tmp, f"shards_proc{proc}.json"), "w") as f:
+        json.dump(shard_table, f)
+        f.flush()
+        os.fsync(f.fileno())
+
+    _barrier(f"ckpt_written_{step}")
+    if proc == 0:
+        manifest = {
+            "format": FORMAT_VERSION,
+            "step": step,
+            "process_count": jax.process_count(),
+            "leaves": manifest_leaves,
+        }
+        # manifest LAST: its presence is the commit marker for a scan
+        with open(os.path.join(tmp, "manifest.json"), "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        final = _step_dir(root, step)
+        aside = os.path.join(root, f".old.step_{step}")
+        # Overwriting a committed step (a resumed run re-saving its own
+        # step) must never pass through a state where NO committed data
+        # for earlier steps exists: the old dir is atomically renamed
+        # aside (not deleted) before the new one lands, so the only
+        # possible crash loss is this same step — restore then falls back
+        # to the previous committed step, never to a torn directory.
+        shutil.rmtree(aside, ignore_errors=True)
+        if os.path.isdir(final):
+            os.rename(final, aside)
+        os.replace(tmp, final)
+        shutil.rmtree(aside, ignore_errors=True)
+        _fsync_dir(root)
+        ptr_tmp = os.path.join(root, ".LATEST.tmp")
+        with open(ptr_tmp, "w") as f:
+            f.write(str(step))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(ptr_tmp, os.path.join(root, "LATEST"))
+        # sweep: crashed saves' tmp/aside dirs and out-of-retention steps
+        for name in os.listdir(root):
+            if (
+                name.startswith((".tmp.step_", ".old.step_"))
+                and name != os.path.basename(tmp)
+            ):
+                shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+        if keep is not None and keep > 0:
+            for old in available_steps(root)[:-keep]:
+                shutil.rmtree(_step_dir(root, old), ignore_errors=True)
+    _barrier(f"ckpt_committed_{step}")
+    return _step_dir(root, step)
+
+
+class _ShardReader:
+    """Every process's shard table + npz handle, opened ONCE for a whole
+    restore (per-leaf reopening would cost O(leaves x processes) file
+    opens — a network round trip each on the shared filesystems
+    multi-process saves target)."""
+
+    def __init__(self, step_path: str, process_count: int):
+        self.step_path = step_path
+        self.by_leaf: dict[int, list[tuple[int, dict]]] = {}
+        self.z = {}
+        for p in range(process_count):
+            with open(
+                os.path.join(step_path, f"shards_proc{p}.json")
+            ) as f:
+                for e in json.load(f):
+                    self.by_leaf.setdefault(e["leaf"], []).append((p, e))
+            self.z[p] = np.load(os.path.join(step_path, f"proc{p}.npz"))
+
+    def close(self) -> None:
+        for z in self.z.values():
+            z.close()
+
+    def load_global(self, manifest: dict, leaf_id: int) -> np.ndarray:
+        """Assemble one leaf's global array from all processes' shards."""
+        info = manifest["leaves"][leaf_id]
+        dtype = _np_dtype(info["dtype"])
+        out = np.empty(tuple(info["shape"]), dtype=dtype)
+        filled = np.zeros(out.shape, dtype=bool) if out.size else None
+        for p, e in self.by_leaf.get(leaf_id, ()):
+            idx = tuple(slice(a, b) for a, b in e["index"])
+            shard_shape = out[idx].shape
+            out[idx] = self.z[p][e["name"]].view(dtype).reshape(shard_shape)
+            if filled is not None:
+                filled[idx] = True
+        if filled is not None and not filled.all():
+            raise ValueError(
+                f"checkpoint {self.step_path} is missing shards for leaf "
+                f"{info['key']}: only {int(filled.sum())}/{filled.size} "
+                "elements present (partial or corrupted save?)"
+            )
+        return out
+
+
+def restore(root: str, like, *, step: int | None = None):
+    """Fill the ``like`` template from the checkpoint at ``step``
+    (default: latest committed).
+
+    ``like`` leaves supply target dtype/shape/sharding — ``jax.Array`` or
+    ``ShapeDtypeStruct`` with a ``.sharding``; leaves are matched to
+    saved entries by tree keypath, and every template leaf must be
+    present in the checkpoint (a schema mismatch is an error, not a
+    silent partial restore).
+    """
+    if step is None:
+        step = latest_step(root)
+        if step is None:
+            raise FileNotFoundError(f"no committed checkpoint under {root}")
+    step_path = _step_dir(root, step)
+    with open(os.path.join(step_path, "manifest.json")) as f:
+        manifest = json.load(f)
+    by_key = {info["key"]: info for info in manifest["leaves"]}
+
+    paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(like)
+    reader = _ShardReader(step_path, manifest["process_count"])
+    try:
+        out_leaves = []
+        for path, leaf in paths_and_leaves:
+            key = _keystr(path)
+            info = by_key.get(key)
+            if info is None:
+                raise KeyError(
+                    f"template leaf {key} not in checkpoint step {step} "
+                    f"(has: {sorted(by_key)[:8]}...)"
+                )
+            if tuple(info["shape"]) != tuple(leaf.shape):
+                raise ValueError(
+                    f"{key}: checkpoint shape {tuple(info['shape'])} != "
+                    f"template shape {tuple(leaf.shape)}"
+                )
+            hostval = reader.load_global(manifest, info["leaf"]).astype(
+                _np_dtype(str(leaf.dtype)), copy=False
+            )
+            sharding = getattr(leaf, "sharding", None)
+            if sharding is None:
+                sharding = NamedSharding(  # pragma: no cover - convenience
+                    jax.sharding.Mesh(np.array(jax.devices()[:1]), ("_",)),
+                    P(),
+                )
+            out_leaves.append(
+                jax.make_array_from_callback(
+                    hostval.shape, sharding, lambda idx, h=hostval: h[idx]
+                )
+            )
+    finally:
+        reader.close()
+    return jax.tree_util.tree_unflatten(treedef, out_leaves)

@@ -300,30 +300,41 @@ def _cmd_longctx(args, writer: ResultWriter) -> None:
     run_longctx(mesh, cfg, writer)
 
 
-def _cmd_flagship(args, writer: ResultWriter) -> None:
-    import dataclasses
-
+def _mesh3d_from_args(args):
+    """The dp x sp x tp mesh the model commands share: --dp/--tp fixed,
+    remaining devices go to sp."""
     import jax
     import numpy as np
     from jax.sharding import Mesh
-
-    from tpu_patterns.models.transformer import FlagshipConfig, run_flagship
 
     n = args.devices or len(jax.devices())
     dp, tp = args.dp, args.tp
     if n % (dp * tp):
         raise SystemExit(f"devices {n} not divisible by dp*tp = {dp * tp}")
     sp = n // (dp * tp)
-    mesh = Mesh(
+    return Mesh(
         np.array(jax.devices()[:n]).reshape(dp, sp, tp), ("dp", "sp", "tp")
     )
-    cfg = FlagshipConfig(
-        **{
-            f.name: getattr(args, f.name)
-            for f in dataclasses.fields(FlagshipConfig)
-        }
+
+
+def _cfg_from_args(cls, args):
+    import dataclasses
+
+    return cls(
+        **{f.name: getattr(args, f.name) for f in dataclasses.fields(cls)}
     )
-    run_flagship(mesh, cfg, writer)
+
+
+def _cmd_flagship(args, writer: ResultWriter) -> None:
+    from tpu_patterns.models.transformer import FlagshipConfig, run_flagship
+
+    run_flagship(_mesh3d_from_args(args), _cfg_from_args(FlagshipConfig, args), writer)
+
+
+def _cmd_train(args, writer: ResultWriter) -> None:
+    from tpu_patterns.models.train_loop import TrainLoopConfig, train
+
+    train(_mesh3d_from_args(args), _cfg_from_args(TrainLoopConfig, args), writer)
 
 
 def _cmd_pipeline(args, writer: ResultWriter) -> None:
@@ -621,10 +632,25 @@ def build_parser() -> argparse.ArgumentParser:
     )
     from tpu_patterns.models.transformer import FlagshipConfig
 
+    def _add_mesh3d_args(p):
+        p.add_argument("--devices", type=int, default=0, help="0 = all")
+        p.add_argument("--dp", type=int, default=1)
+        p.add_argument(
+            "--tp", type=int, default=1, help="remaining devices go to sp"
+        )
+
     add_config_args(fl, FlagshipConfig)
-    fl.add_argument("--devices", type=int, default=0, help="0 = all")
-    fl.add_argument("--dp", type=int, default=1)
-    fl.add_argument("--tp", type=int, default=1, help="remaining devices go to sp")
+    _add_mesh3d_args(fl)
+
+    tr = sub.add_parser(
+        "train",
+        help="resumable training loop with sharded checkpoints "
+        "(--ckpt_dir/--ckpt_every/--resume)",
+    )
+    from tpu_patterns.models.train_loop import TrainLoopConfig
+
+    add_config_args(tr, TrainLoopConfig)
+    _add_mesh3d_args(tr)
 
     pl = sub.add_parser(
         "pipeline", help="GPipe vs 1F1B schedule benchmark (bubble + memory)"
@@ -703,6 +729,7 @@ def main(argv: list[str] | None = None) -> int:
         "allreduce": _cmd_allreduce,
         "longctx": _cmd_longctx,
         "flagship": _cmd_flagship,
+        "train": _cmd_train,
         "pipeline": _cmd_pipeline,
         "moe": _cmd_moe,
         "miniapps": _cmd_miniapps,

@@ -309,14 +309,20 @@ def _interpret() -> bool:
 
 
 def make_train_step(
-    mesh: Mesh, cfg: ModelConfig, lr: float = 1e-3, x_spec: P | None = None
+    mesh: Mesh,
+    cfg: ModelConfig,
+    lr: float = 1e-3,
+    x_spec: P | None = None,
+    n_global: float = 1.0,
 ):
     """jit-compiled full training step (fwd + bwd + SGD) over the mesh.
 
     Returns ``step(params, x) -> (params, loss)`` with params sharded per
     ``param_specs`` and x sharded [dp, sp, -] — ONE compiled program
     containing the ring attention ppermutes, tp psums, and dp/sp gradient
-    reductions.
+    reductions.  ``n_global`` normalizes the summed objective (1.0 for
+    the bench, where the lr underflows anyway; the element count for real
+    training so lr scales don't depend on batch/seq).
     """
     x_spec = x_spec or P("dp", "sp", None)
     axes = ("dp", "sp")  # tp is already reduced inside the forward
@@ -325,7 +331,6 @@ def make_train_step(
     pspecs = {k: s for k, (_, s) in specs.items()}
 
     def step(params, x):
-        n_global = 1.0  # normalizer folded into grads uniformly
         loss, grads = jax.value_and_grad(loss_shard)(
             params,
             x,
@@ -367,6 +372,7 @@ def make_zero_train_step(
     x_spec: P | None = None,
     optimizer: str = "adam",
     offload_state: bool = False,
+    n_global: float = 1.0,
 ):
     """ZeRO-1 twin of :func:`make_train_step` (parallel/zero.py).
 
@@ -531,7 +537,7 @@ def make_zero_train_step(
             params,
             x,
             cfg,
-            1.0,
+            n_global,
             axes=("dp", "sp"),  # same global objective as make_train_step
             sp_axis="sp",
             sp_size=sp,
@@ -580,6 +586,10 @@ def make_zero_train_step(
     )
 
     step_fn.gather = gather_fn
+    # spec trees attached for callers that need abstract state templates
+    # (ckpt restore builds ShapeDtypeStructs instead of initializing)
+    step_fn.state_specs = state_specs
+    init.state_specs = state_specs
     return step_fn, init, shard_specs
 
 
