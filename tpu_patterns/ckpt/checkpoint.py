@@ -102,6 +102,20 @@ def _step_dir(root: str, step: int) -> str:
     return os.path.join(root, f"step_{step}")
 
 
+def _remove_step(root: str, step: int) -> None:
+    """Delete a committed step so a crash mid-delete can never leave a
+    torn dir that still LOOKS committed: the commit marker
+    (manifest.json) is unlinked first, then the rest — rmtree's deletion
+    order is arbitrary, so deleting the marker last is not guaranteed
+    without this."""
+    path = _step_dir(root, step)
+    try:
+        os.unlink(os.path.join(path, "manifest.json"))
+    except OSError:
+        pass
+    shutil.rmtree(path, ignore_errors=True)
+
+
 def available_steps(root: str) -> list[int]:
     """Committed steps, ascending.  ``.tmp.*`` (crashed saves) excluded."""
     if not os.path.isdir(root):
@@ -211,6 +225,11 @@ def save(
             json.dump(manifest, f)
             f.flush()
             os.fsync(f.fileno())
+        # the tmp dir's ENTRIES must be durable before the rename makes
+        # them reachable: fsyncing file contents alone leaves the dirents
+        # in an unsynced inode, and a power loss could then surface a
+        # committed-looking step missing its shard files
+        _fsync_dir(tmp)
         final = _step_dir(root, step)
         aside = os.path.join(root, f".old.step_{step}")
         # Overwriting a committed step (a resumed run re-saving its own
@@ -240,7 +259,7 @@ def save(
                 shutil.rmtree(os.path.join(root, name), ignore_errors=True)
         if keep is not None and keep > 0:
             for old in available_steps(root)[:-keep]:
-                shutil.rmtree(_step_dir(root, old), ignore_errors=True)
+                _remove_step(root, old)
     _barrier(f"ckpt_committed_{step}")
     return _step_dir(root, step)
 

@@ -337,6 +337,12 @@ def _cmd_train(args, writer: ResultWriter) -> None:
     train(_mesh3d_from_args(args), _cfg_from_args(TrainLoopConfig, args), writer)
 
 
+def _cmd_decode(args, writer: ResultWriter) -> None:
+    from tpu_patterns.models.decode import DecodeConfig, run_decode
+
+    run_decode(_mesh3d_from_args(args), _cfg_from_args(DecodeConfig, args), writer)
+
+
 def _cmd_pipeline(args, writer: ResultWriter) -> None:
     import dataclasses
 
@@ -652,6 +658,16 @@ def build_parser() -> argparse.ArgumentParser:
     add_config_args(tr, TrainLoopConfig)
     _add_mesh3d_args(tr)
 
+    dc = sub.add_parser(
+        "decode",
+        help="autoregressive decode with a sequence-parallel KV cache "
+        "(long-context inference twin of longctx)",
+    )
+    from tpu_patterns.models.decode import DecodeConfig
+
+    add_config_args(dc, DecodeConfig)
+    _add_mesh3d_args(dc)
+
     pl = sub.add_parser(
         "pipeline", help="GPipe vs 1F1B schedule benchmark (bubble + memory)"
     )
@@ -730,6 +746,7 @@ def main(argv: list[str] | None = None) -> int:
         "longctx": _cmd_longctx,
         "flagship": _cmd_flagship,
         "train": _cmd_train,
+        "decode": _cmd_decode,
         "pipeline": _cmd_pipeline,
         "moe": _cmd_moe,
         "miniapps": _cmd_miniapps,

@@ -1,0 +1,509 @@
+"""Autoregressive decode with a sequence-parallel KV cache.
+
+The training side of long context is ring attention (longctx/); this is
+the inference side: the attended-over context lives SHARDED along the
+sequence axis ("sp"), each rank holding a contiguous chunk of the K/V
+cache, and every decode step is a distributed flash-decode —
+
+    local masked scores -> pmax(sp) running max -> exp -> psum(sp) of
+    (normalizer, weighted values) -> combine
+
+so attending over an L-token context costs O(L/sp) memory and FLOPs per
+rank and two tiny collectives per layer, instead of gathering the cache
+anywhere.  tp shards heads exactly as in training (out-projection psum),
+dp shards batch.  Everything — prefill, cache writes, the whole
+generation rollout — is ONE compiled program (lax.scan over layers and
+over steps; no per-token dispatch, no dynamic shapes).
+
+Cache writes are SPMD: position t lands on exactly one sp rank; every
+rank computes the clamped dynamic_update_slice and keeps it only where
+``0 <= t - rank*chunk < chunk`` (a select, not host control flow).
+
+Correctness gate (the KV-cache invariant): teacher-forced decode — feed
+the training forward's inputs token by token through the cache path —
+must reproduce ``forward_shard``'s causal output at every position.
+Reference analogue: the checksum-after-transfer discipline
+(`/root/reference/p2p/peer2pear.cpp:55-63`) applied to cache routing —
+a misaddressed cache write or a wrong mask shows up in the gate, not in
+a silent perf number.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_patterns.models.transformer import (
+    ModelConfig,
+    init_params,
+    param_specs,
+)
+
+
+def _neg_inf(dtype) -> jax.Array:
+    return jnp.asarray(jnp.finfo(dtype).min, dtype)
+
+
+def _stacked_params(key, cfg: ModelConfig):
+    """Params with a leading [depth] axis even at depth 1 (one scan body
+    serves every depth)."""
+    if cfg.depth > 1:
+        return init_params(key, cfg)
+    flat = init_params(key, cfg)
+    return {k: v[None] for k, v in flat.items()}
+
+
+def _stacked_specs(cfg: ModelConfig) -> dict[str, P]:
+    """Specs for [depth]-stacked params: layers replicated (scanned over,
+    NOT pipeline-sharded — decode has no pp axis)."""
+    flat = param_specs(dataclasses.replace(cfg, depth=1))
+    return {k: P(None, *tuple(s)) for k, (_, s) in flat.items()}
+
+
+def _mlp(params, y, tp_axis):
+    hidden = jax.nn.relu(jnp.einsum("ble,ef->blf", y, params["w1"]))
+    m = jnp.einsum("blf,fe->ble", hidden, params["w2"])
+    if tp_axis is not None:
+        m = lax.psum(m, tp_axis)
+    return y + m
+
+
+class _CacheLayout:
+    """Two-segment per-rank cache slots with closed-form global positions.
+
+    The prompt arrives sp-sharded in CONTIGUOUS chunks of ``lp_loc =
+    prefill/sp`` (the training data layout), so those k/v must be cached
+    where they land — rank r's slots [0, lp_loc) hold global positions
+    [r*lp_loc, (r+1)*lp_loc).  Generated tokens then fill each rank's
+    second segment in rank order: slots [lp_loc, lp_loc+lg_loc) on rank r
+    hold positions [prefill + r*lg_loc, ...).  Every slot's global
+    position is a closed-form function of (rank, slot), so the causal
+    mask needs no stored position table, and slots never written sit at
+    FUTURE positions — automatically invisible to every causal query.
+    """
+
+    def __init__(self, prefill: int, gen_cap: int, sp: int):
+        if prefill % sp or gen_cap % sp:
+            raise ValueError(
+                f"prefill {prefill} and gen capacity {gen_cap} must both "
+                f"divide over sp={sp}"
+            )
+        self.prefill, self.gen_cap, self.sp = prefill, gen_cap, sp
+        self.lp_loc = prefill // sp
+        self.lg_loc = gen_cap // sp
+        self.lc_loc = self.lp_loc + self.lg_loc
+
+    def kv_positions(self, sp_axis: str | None) -> jax.Array:
+        """[lc_loc] global position of each local slot."""
+        r = lax.axis_index(sp_axis) if sp_axis is not None else 0
+        prompt = r * self.lp_loc + jnp.arange(self.lp_loc, dtype=jnp.int32)
+        gen = (
+            self.prefill
+            + r * self.lg_loc
+            + jnp.arange(self.lg_loc, dtype=jnp.int32)
+        )
+        return jnp.concatenate([prompt, gen])
+
+    def write_offset(self, t, sp_axis: str | None):
+        """(local slot, valid) for a decode write at global position t."""
+        r = lax.axis_index(sp_axis) if sp_axis is not None else 0
+        rel = t - self.prefill - r * self.lg_loc
+        return self.lp_loc + rel, (rel >= 0) & (rel < self.lg_loc)
+
+
+def _prefill_layer(params, x, cache_k, cache_v, layout, sp_axis, tp_axis):
+    """One layer over the FULL prompt shard: compute k/v for every prompt
+    position, write them into segment 0 of the local cache, and return
+    the layer output.  x: [B, lp_loc, E] (sequence sp-sharded, like
+    training); caches: [B, H_local, lc_loc, D].
+
+    Prefill queries are sp-VARYING (each rank owns different prompt
+    positions), so the replicated-query psum combine used at decode time
+    does not apply — the causal attention here is the training path's
+    ring attention (longctx/ring_attention.py), k/v chunks traveling the
+    sp ring.  Decode's combine needs replicated queries; prefill's needs
+    traveling k/v: the two halves of sequence parallelism.
+    """
+    from tpu_patterns.models.transformer import _interpret
+    from tpu_patterns.longctx.ring_attention import ring_attention
+
+    qkv = jnp.einsum("ble,cehd->cblhd", x, params["wqkv"])
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    kt = k.transpose(0, 2, 1, 3)  # [B, H, lp_loc, D]
+    vt = v.transpose(0, 2, 1, 3)
+    cache_k = lax.dynamic_update_slice(cache_k, kt, (0, 0, 0, 0))
+    cache_v = lax.dynamic_update_slice(cache_v, vt, (0, 0, 0, 0))
+
+    if sp_axis is not None:
+        b, lp, h, d = q.shape
+
+        def fold(a):  # [B, L, H, D] -> [L, B*H, D], as forward_shard
+            return a.transpose(1, 0, 2, 3).reshape(lp, b * h, d)
+
+        attn = ring_attention(
+            fold(q), fold(k), fold(v),
+            axis_name=sp_axis,
+            axis_size=layout.sp,
+            causal=True,
+            block_impl="xla",
+            interpret=_interpret(),
+            layout="contiguous",
+        ).reshape(lp, b, h, d).transpose(1, 0, 2, 3)
+    else:
+        q_pos = jnp.arange(layout.lp_loc, dtype=jnp.int32)
+        attn = _distributed_attention(
+            q, cache_k, cache_v, q_pos, layout.kv_positions(None), None
+        )
+    o = jnp.einsum("blhd,hde->ble", attn, params["wo"])
+    if tp_axis is not None:
+        o = lax.psum(o, tp_axis)
+    y = x + o
+    return _mlp(params, y, tp_axis), cache_k, cache_v
+
+
+def _distributed_attention(q, cache_k, cache_v, q_pos, kv_pos, sp_axis):
+    """Masked softmax attention of q against the sp-sharded cache.
+
+    q: [B, Lq, H, D] with global query positions ``q_pos`` [Lq];
+    caches: [B, H, lc_loc, D] whose slots sit at global positions
+    ``kv_pos`` [lc_loc].  Causal: slot p visible to query qp iff p <= qp
+    (unwritten slots carry future positions, so they are masked for
+    free).  Stable online-softmax combine across sp: pmax for the
+    running max, psum for normalizer and weighted values.
+    """
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bhld->bhql", q, cache_k) * (d ** -0.5)
+    mask = kv_pos[None, :] <= q_pos[:, None]  # [Lq, lc_loc]
+    s = jnp.where(mask[None, None], s, _neg_inf(s.dtype))
+    m = jnp.max(s, axis=-1, keepdims=True)
+    if sp_axis is not None:
+        m = lax.pmax(m, sp_axis)
+    # guard: a query with NO visible slot on any rank would give
+    # exp(-inf - -inf) = nan; clamp m so such rows produce 0/eps instead
+    m = jnp.maximum(m, _neg_inf(s.dtype) / 2)
+    p = jnp.exp(s - m)
+    denom = jnp.sum(p, axis=-1, keepdims=True)  # [B, H, Lq, 1]
+    numer = jnp.einsum("bhql,bhld->bhqd", p, cache_v)
+    if sp_axis is not None:
+        denom = lax.psum(denom, sp_axis)
+        numer = lax.psum(numer, sp_axis)
+    out = numer / jnp.maximum(denom, jnp.asarray(1e-30, denom.dtype))
+    return out.transpose(0, 2, 1, 3)  # [B, Lq, H, D]
+
+
+def _decode_layer(params, x, cache_k, cache_v, t, layout, sp_axis, tp_axis):
+    """One layer for ONE new token at global position t.
+
+    x: [B, 1, E] (sp-replicated); caches [B, H, lc_loc, D].  Writes k/v
+    into the gen segment on the owning sp rank, attends over [0, t],
+    returns the block output.
+    """
+    qkv = jnp.einsum("ble,cehd->cblhd", x, params["wqkv"])
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    off, valid = layout.write_offset(t, sp_axis)
+    kt = k.transpose(0, 2, 1, 3)  # [B, H, 1, D]
+    vt = v.transpose(0, 2, 1, 3)
+    # dynamic_update_slice clamps the start index; the select keeps the
+    # write only on the owning rank (SPMD — no rank-dependent control flow)
+    ck = lax.dynamic_update_slice(cache_k, kt, (0, 0, off, 0))
+    cv = lax.dynamic_update_slice(cache_v, vt, (0, 0, off, 0))
+    cache_k = jnp.where(valid, ck, cache_k)
+    cache_v = jnp.where(valid, cv, cache_v)
+
+    out = _distributed_attention(
+        q, cache_k, cache_v,
+        jnp.reshape(t, (1,)).astype(jnp.int32),
+        layout.kv_positions(sp_axis),
+        sp_axis,
+    )
+    o = jnp.einsum("blhd,hde->ble", out, params["wo"])
+    if tp_axis is not None:
+        o = lax.psum(o, tp_axis)
+    y = x + o
+    return _mlp(params, y, tp_axis), cache_k, cache_v
+
+
+def make_decoder(
+    mesh: Mesh, cfg: ModelConfig, batch: int, prefill_len: int, gen_cap: int
+):
+    """Build the jitted (prefill, generate) pair over a dp x sp x tp mesh.
+
+    * ``prefill(params, x) -> (caches, y_last)``: run the prompt
+      [batch, prefill_len, E] through every layer, filling each rank's
+      prompt segment; returns the caches and the LAST prompt position's
+      block output [batch, 1, E] (the first decode input).
+    * ``generate(params, caches, y0, t0, n_steps) -> (caches, ys)``:
+      scan n_steps of self-feeding decode; ys: [batch, n_steps, E].
+      Total decoded positions must stay within ``gen_cap`` — a write
+      past capacity is silently dropped (the slot select never fires).
+
+    Caches are stacked [depth, B, H, lc, D], sharded
+    P(None, dp, tp, sp, None) over the two-segment layout
+    (:class:`_CacheLayout`).  ``n_steps`` is static (compiled into the
+    scan); t0 is a traced scalar.
+    """
+    if cfg.moe:
+        raise NotImplementedError("decode pattern covers the dense block")
+    dp = int(mesh.shape["dp"])
+    sp = int(mesh.shape["sp"])
+    if batch % dp:
+        raise ValueError(f"batch {batch} % dp={dp} != 0")
+    layout = _CacheLayout(prefill_len, gen_cap, sp)
+    sp_axis = "sp" if sp > 1 else None
+    tp_axis = "tp" if int(mesh.shape["tp"]) > 1 else None
+    pspecs = _stacked_specs(cfg)
+    cache_spec = P(None, "dp", "tp", "sp", None)
+
+    def prefill_shard(params, x):
+        def layer(carry, xs):
+            y = carry
+            p_l, ck_l, cv_l = xs
+            y, ck_l, cv_l = _prefill_layer(
+                p_l, y, ck_l, cv_l, layout, sp_axis, tp_axis
+            )
+            return y, (ck_l, cv_l)
+
+        depth = next(iter(params.values())).shape[0]
+        h = cfg.heads // int(mesh.shape["tp"])
+        shape = (depth, x.shape[0], h, layout.lc_loc, cfg.head_dim)
+        zeros = jnp.zeros(shape, x.dtype)
+        y, (ck, cv) = lax.scan(layer, x, (params, zeros, zeros))
+        # the last GLOBAL prompt position's output lives on the last sp
+        # rank's local tail; broadcast it to every rank (decode inputs
+        # are sp-replicated)
+        y_last = y[:, -1:, :]
+        if sp_axis is not None:
+            # psum-select: only the last rank contributes
+            is_last = lax.axis_index(sp_axis) == sp - 1
+            y_last = lax.psum(
+                jnp.where(is_last, y_last, jnp.zeros_like(y_last)), sp_axis
+            )
+        return (ck, cv), y_last
+
+    def generate_shard(params, caches, y0, t0, *, n_steps):
+        ck, cv = caches
+
+        def step(carry, _):
+            ck, cv, y, t = carry
+
+            def layer(c2, xs):
+                yy = c2
+                p_l, ck_l, cv_l = xs
+                yy, ck_l, cv_l = _decode_layer(
+                    p_l, yy, ck_l, cv_l, t, layout, sp_axis, tp_axis
+                )
+                return yy, (ck_l, cv_l)
+
+            y2, (ck, cv) = lax.scan(layer, y, (params, ck, cv))
+            return (ck, cv, y2, t + 1), y2[:, 0, :]
+
+        (ck, cv, _, _), ys = lax.scan(
+            step, (ck, cv, y0, t0), None, length=n_steps
+        )
+        return (ck, cv), ys.transpose(1, 0, 2)  # [B, n_steps, E]
+
+    x_spec = P("dp", "sp", None)
+    tok_spec = P("dp", None, None)
+    prefill = jax.jit(
+        jax.shard_map(
+            prefill_shard,
+            mesh=mesh,
+            in_specs=(pspecs, x_spec),
+            out_specs=((cache_spec, cache_spec), tok_spec),
+            check_vma=False,  # y_last is made sp-invariant by the psum
+        )
+    )
+
+    @functools.lru_cache(maxsize=None)
+    def _gen_compiled(n_steps: int):
+        # one compiled program per generation length (the scan bound is
+        # static); cached so repeated calls never retrace
+        return jax.jit(
+            jax.shard_map(
+                functools.partial(generate_shard, n_steps=n_steps),
+                mesh=mesh,
+                in_specs=(pspecs, (cache_spec, cache_spec), tok_spec, P()),
+                out_specs=((cache_spec, cache_spec), tok_spec),
+                check_vma=False,
+            ),
+        )
+
+    def _gen(params, caches, y0, t0, n_steps):
+        return _gen_compiled(int(n_steps))(params, caches, y0, t0)
+
+    return prefill, _gen
+
+
+@dataclasses.dataclass
+class DecodeConfig:
+    """CLI ``decode`` subcommand."""
+
+    embed: int = 1024
+    heads: int = 8
+    head_dim: int = 128
+    mlp_mult: int = 4
+    dtype: str = "bfloat16"
+    depth: int = 4
+    batch: int = 8
+    prefill: int = 4096  # prompt tokens (the long-context side)
+    gen: int = 128  # generated tokens per rep
+    reps: int = 5
+    warmup: int = 1
+    min_tokens_per_s: float = -1.0
+    seed: int = 0
+
+
+def run_decode(mesh: Mesh, cfg: DecodeConfig, writer) -> list:
+    """Measured pattern: prefill a long context, then time the
+    self-feeding generation scan.  Gate: teacher-forced decode equals the
+    training forward (run on a small probe shape, every position)."""
+    from tpu_patterns.core import timing
+    from tpu_patterns.core.results import Record, Verdict
+
+    mcfg = ModelConfig(
+        embed=cfg.embed,
+        heads=cfg.heads,
+        head_dim=cfg.head_dim,
+        mlp_mult=cfg.mlp_mult,
+        causal=True,
+        dtype=cfg.dtype,
+        depth=cfg.depth,
+    )
+    sp = int(mesh.shape["sp"])
+    gen_cap = cfg.gen + (-cfg.gen % sp)
+    prefill, generate = make_decoder(
+        mesh, mcfg, cfg.batch, cfg.prefill, gen_cap
+    )
+    max_len = cfg.prefill + gen_cap
+    params = jax.device_put(
+        _stacked_params(jax.random.key(cfg.seed), mcfg),
+        {k: NamedSharding(mesh, s) for k, s in _stacked_specs(mcfg).items()},
+    )
+    x = jax.device_put(
+        jax.random.normal(
+            jax.random.key(cfg.seed + 1),
+            (cfg.batch, cfg.prefill, cfg.embed),
+            jnp.dtype(cfg.dtype),
+        ),
+        NamedSharding(mesh, P("dp", "sp", None)),
+    )
+    caches, y0 = prefill(params, x)
+    jax.block_until_ready(y0)
+
+    gate = _teacher_forcing_gate(mesh, mcfg)
+
+    t0 = jnp.asarray(cfg.prefill, jnp.int32)
+
+    def build_chain(k: int):
+        def run():
+            # every iteration regenerates the SAME positions (t0 fixed, so
+            # work per iter is identical and capacity is never exceeded);
+            # data dependence flows through caches and the fed-back token
+            c, y, out = caches, y0, None
+            for _ in range(k):
+                c, out = generate(params, c, y, t0, cfg.gen)
+                y = out[:, -1:, :]
+            return np.asarray(out[0, -1, 0])
+
+        return run
+
+    res = timing.measure_chain(
+        build_chain, reps=cfg.reps, warmup=cfg.warmup, label="decode"
+    )
+    tokens = cfg.batch * cfg.gen
+    sec = res.per_op_ns * 1e-9
+    tps = tokens / sec if sec > 0 else 0.0
+    cache_mb = (
+        2 * cfg.depth * cfg.batch * cfg.heads * max_len * cfg.head_dim
+        * jnp.dtype(cfg.dtype).itemsize / 1e6
+    )
+    ok = gate and np.isfinite(tps) and tps > 0
+    if cfg.min_tokens_per_s > 0:
+        ok = ok and tps >= cfg.min_tokens_per_s
+    rec = Record(
+        pattern="decode",
+        mode=f"sp{sp}",
+        commands=(
+            f"B{cfg.batch} prefill{cfg.prefill} gen{cfg.gen} "
+            f"depth{cfg.depth} {cfg.dtype}"
+        ),
+        metrics={
+            "tokens_per_s": round(tps, 1),
+            "ms_per_token": round(1e3 * sec / cfg.gen, 3),
+            "cache_MB": round(cache_mb, 3),
+            "prefill_context": float(cfg.prefill),
+        },
+        verdict=Verdict.SUCCESS if ok else Verdict.FAILURE,
+    )
+    if not gate:
+        rec.notes.append("teacher-forcing gate FAILED: cache path diverges")
+    writer.record(rec)
+    return [rec]
+
+
+def _teacher_forcing_gate(mesh: Mesh, big: ModelConfig) -> bool:
+    """Decode-vs-training-forward equivalence on a probe shape.
+
+    Feeds the SAME inputs through (a) the training causal forward and
+    (b) prefill of the first half + token-by-token decode of the second;
+    every decoded position must match the full forward (f32, tolerance
+    scaled to output magnitude).  The probe shape scales with the mesh
+    (batch with dp, heads with tp, sequence with sp) so the gate runs on
+    any layout the measured config itself accepts.
+    """
+    from tpu_patterns.models.transformer import forward_stack
+
+    dp = int(mesh.shape["dp"])
+    sp = int(mesh.shape["sp"])
+    tp = int(mesh.shape["tp"])
+    heads = 8 if 8 % tp == 0 else tp
+    b = 2 * dp
+    l = 32 if 32 % (2 * sp) == 0 else 4 * sp
+    cfg = dataclasses.replace(
+        big, embed=64, heads=heads, head_dim=8, dtype="float32", causal=True
+    )
+    key = jax.random.key(17)
+    params = _stacked_params(key, cfg)
+    x = jax.random.normal(jax.random.key(18), (b, l, cfg.embed), jnp.float32)
+
+    # (a) training forward over the full sequence (stacked layers)
+    flat = {k: (v if cfg.depth > 1 else v[0]) for k, v in params.items()}
+    if cfg.depth > 1:
+        want = forward_stack(flat, x, cfg)
+    else:
+        from tpu_patterns.models.transformer import forward_shard
+
+        want = forward_shard(flat, x, cfg)
+
+    # (b) prefill half, decode the rest teacher-forced
+    half = (l // 2 // sp) * sp or sp
+    prefill, generate = make_decoder(mesh, cfg, b, half, l - half)
+    sharded_params = jax.device_put(
+        params,
+        {k: NamedSharding(mesh, s) for k, s in _stacked_specs(cfg).items()},
+    )
+    xs = jax.device_put(
+        x[:, :half], NamedSharding(mesh, P("dp", "sp", None))
+    )
+    caches, y_last = prefill(sharded_params, xs)
+    got = [np.asarray(y_last)[:, 0]]  # output at position half-1
+    c = caches
+    for t in range(half, l):
+        # teacher forcing: the NEXT input is the true x[t], not the model
+        # output — so every step is checked against the full forward
+        tok = jax.device_put(
+            x[:, t:t + 1], NamedSharding(mesh, P("dp", None, None))
+        )
+        c, ys = generate(sharded_params, c, tok, jnp.asarray(t, jnp.int32), 1)
+        got.append(np.asarray(ys)[:, 0])
+    wantn = np.asarray(want, np.float32)
+    gotn = np.stack(got, axis=1)  # positions [half-1, l)
+    ref = wantn[:, half - 1:]
+    tol = 64 * np.finfo(np.float32).eps * max(1.0, np.abs(ref).max())
+    return bool(np.abs(gotn - ref).max() <= tol)
