@@ -180,14 +180,14 @@ class TestSweep:
         assert any("grad" in s.name for s in lc)
         par = sweep.specs_for("parallel", quick=True)
         assert {s.name.split(".")[0] for s in par} == {
-            "pipeline", "moe", "flagship"
+            "pipeline", "moe", "flagship", "decode"
         }
         hier = sweep.specs_for("hier", quick=True)
         assert len(hier) == 2  # 2 dcn splits x 1 dtype
         meas = sweep.specs_for("measured", quick=True)
         assert {s.name.split(".")[0] for s in meas} == {"measured"}
-        # onesided + interop + 6 concurrency + 4 flash + 5 flagship
-        assert len(meas) == 17
+        # onesided + interop + 6 concurrency + 4 flash + 5 flagship + decode
+        assert len(meas) == 18
         # every flash cell pins --devices to exactly 1 (any other world
         # would silently SKIP the cell and checkpoint it as passed)
         for s in meas:

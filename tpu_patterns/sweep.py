@@ -165,6 +165,11 @@ QUICK_FLAGSHIP = (
     "--embed", "64", "--head_dim", "8", "--seq", "128", "--batch", "2",
     "--dtype", "float32", "--reps", "2",
 )
+QUICK_DECODE = (
+    "--prefill", "16", "--gen", "8", "--batch", "2", "--embed", "64",
+    "--head_dim", "8", "--depth", "1", "--dtype", "float32",
+    "--reps", "2", "--warmup", "1",
+)
 
 
 def longctx_specs(quick: bool = False) -> list[SweepSpec]:
@@ -252,6 +257,21 @@ def parallel_specs(quick: bool = False) -> list[SweepSpec]:
                 "--capacity_factor", "1.0", *moe_small,
             ),
             env=(("TPU_PATTERNS_SWEEP_CONFIG", "moe"),),
+        )
+    )
+    # long-context decode: tokens/s of the KV-cache rollout (the gate
+    # inside run_decode re-checks cache-path == training forward)
+    decode_small = (
+        QUICK_DECODE
+        if quick
+        else ("--prefill", "4096", "--gen", "64", "--batch", "4",
+              "--depth", "2")
+    )
+    specs.append(
+        SweepSpec(
+            name="decode.kv_cache",
+            argv=("decode", *decode_small),
+            env=(("TPU_PATTERNS_SWEEP_CONFIG", "decode"),),
         )
     )
     flag_small = QUICK_FLAGSHIP if quick else ("--seq", "4096", "--batch", "2")
@@ -414,6 +434,22 @@ def measured_specs(quick: bool = False) -> list[SweepSpec]:
                 env=env,
             )
         )
+    # long-context decode throughput, pinned to ONE chip like the flash
+    # cells (the committed record must not vary with world size; the
+    # multi-rank path is the parallel suite's decode cell)
+    decode_args = (
+        QUICK_DECODE
+        if quick
+        else ("--prefill", "8192", "--gen", "128", "--batch", "4",
+              "--depth", "4")
+    )
+    specs.append(
+        SweepSpec(
+            name="measured.decode_kv_cache",
+            argv=("decode", "--devices", "1", *decode_args),
+            env=env,
+        )
+    )
     return specs
 
 
