@@ -244,6 +244,13 @@ def _cmd_allreduce(args, writer: ResultWriter) -> None:
     )
 
 
+def _cmd_overlap(args, writer: ResultWriter) -> None:
+    from tpu_patterns.parallel.overlap import OverlapConfig, run_overlap
+
+    mesh = _build_mesh(args.devices, args.placement, args.mechanism)
+    run_overlap(mesh, _cfg_from_args(OverlapConfig, args), writer)
+
+
 def _cmd_longctx(args, writer: ResultWriter) -> None:
     import jax
 
@@ -608,6 +615,16 @@ def build_parser() -> argparse.ArgumentParser:
         "--no_tuning", action="store_true", help="skip auto-tune (ref flag)"
     )
 
+    ov = sub.add_parser(
+        "overlap",
+        help="collective matmul: decomposed ppermute-ring all-gather/"
+        "reduce-scatter matmuls vs the XLA collective baseline",
+    )
+    from tpu_patterns.parallel.overlap import OverlapConfig
+
+    add_config_args(ov, OverlapConfig)
+    _add_mesh_args(ov)
+
     a = sub.add_parser("allreduce", help="ring-allreduce miniapp")
     from tpu_patterns.miniapps.apps.allreduce import AllreduceConfig
 
@@ -743,6 +760,7 @@ def main(argv: list[str] | None = None) -> int:
         "hier": _cmd_hier,
         "concurrency": _cmd_concurrency,
         "allreduce": _cmd_allreduce,
+        "overlap": _cmd_overlap,
         "longctx": _cmd_longctx,
         "flagship": _cmd_flagship,
         "train": _cmd_train,

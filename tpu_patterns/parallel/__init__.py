@@ -9,9 +9,14 @@ reduce-scatter/all-gather decomposition (``zero``).
 """
 
 from tpu_patterns.parallel.moe import moe_apply, top1_route
+from tpu_patterns.parallel.overlap import (
+    allgather_matmul,
+    matmul_reducescatter,
+)
 from tpu_patterns.parallel.pipeline import pipeline_apply
 from tpu_patterns.parallel.zero import zero_apply, zero_init
 
 __all__ = [
-    "moe_apply", "pipeline_apply", "top1_route", "zero_apply", "zero_init",
+    "allgather_matmul", "matmul_reducescatter", "moe_apply",
+    "pipeline_apply", "top1_route", "zero_apply", "zero_init",
 ]

@@ -1,0 +1,271 @@
+"""Collective matmul: communication/computation overlap for tensor
+parallelism.
+
+The concurrency suite asks "can the runtime overlap two independent
+commands?" (concurrency/harness.py); this pattern asks the question that
+decides tensor-parallel efficiency at scale: can the COLLECTIVE hide
+behind the matmul it feeds?  XLA emits all_gather -> dot as two
+sequential ops (latency-hiding scheduling may or may not overlap them);
+the decomposed form makes the overlap explicit and compiler-independent:
+chunk the collective into a ppermute ring and interleave one matmul
+per hop, so every hop's transfer rides under the previous hop's compute.
+
+Two duals (the two Megatron-style TP matmuls):
+
+* ``allgather_matmul``   — column-parallel Y = X @ W_col with X sharded
+  over the axis: instead of all_gather(X) then dot, each rank's X chunk
+  travels the ring and is multiplied on arrival.
+* ``matmul_reducescatter`` — row-parallel Y = sum_r X_r @ W_row with the
+  output scattered: the accumulator travels the ring, each rank adding
+  its partial product for the chunk's final owner just before passing it
+  on (the reduce-scatter half of comm/ring.py's optimal allreduce, with
+  a matmul fused into every hop).
+
+Both are verified against the undecomposed XLA collective per element,
+and measured as a contrast pair (Record speedup = baseline/decomposed),
+≙ the serial-vs-concurrent SUCCESS criterion of the reference harness
+(`/root/reference/concurency/main.cpp:281-293`) transplanted to the
+collective-hiding question.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+from tpu_patterns.comm.ring import ring_perm
+
+
+def allgather_matmul(
+    x: jax.Array,
+    w: jax.Array,
+    axis_name: str,
+    axis_size: int,
+    decomposed: bool = True,
+) -> jax.Array:
+    """Column-parallel collective matmul inside shard_map.
+
+    x: [B_local, E] (rows sharded over ``axis_name``), w: [E, F_local]
+    (columns sharded).  Returns [B_global, F_local]: every rank needs
+    EVERY row of x against its local columns.
+
+    decomposed=False: the XLA baseline — ``all_gather`` then one dot.
+    decomposed=True: x chunks ride a ppermute ring; hop i multiplies the
+    chunk that originated at rank (r - i) mod n while the next chunk is
+    in flight.  Chunks are written into their origin's row block, so the
+    result is bitwise comparable to the baseline (same dot shapes, same
+    accumulation order per block).
+    """
+    n = axis_size
+    if not decomposed:
+        x_full = lax.all_gather(x, axis_name, axis=0, tiled=True)
+        return x_full @ w
+
+    from tpu_patterns.parallel.pipeline import _vary
+
+    r = lax.axis_index(axis_name)
+    bl = x.shape[0]
+    # varying over the axis from the start: each rank fills DIFFERENT row
+    # blocks orders (scan carry types must be stable)
+    out = _vary(jnp.zeros((n * bl, w.shape[1]), x.dtype), axis_name)
+
+    def hop(carry, i):
+        chunk, out = carry
+        src = (r - i) % n  # the rank this chunk's rows belong to
+        part = chunk @ w
+        out = lax.dynamic_update_slice(out, part, (src * bl, 0))
+        # n multiplies need only n-1 transfers: nothing travels after the
+        # last multiply (a drain hop would sit un-hidden on the critical
+        # path and skew the contrast against the decomposed form)
+        chunk = lax.cond(
+            i < n - 1,
+            lambda c: lax.ppermute(c, axis_name, ring_perm(n)),
+            lambda c: c,
+            chunk,
+        )
+        return (chunk, out), None
+
+    (_, out), _ = lax.scan(hop, (x, out), jnp.arange(n))
+    return out
+
+
+def matmul_reducescatter(
+    x: jax.Array,
+    w: jax.Array,
+    axis_name: str,
+    axis_size: int,
+    decomposed: bool = True,
+) -> jax.Array:
+    """Row-parallel collective matmul inside shard_map.
+
+    x: [B, E_local] (contraction dim sharded), w: [E_local, F].  The full
+    product is sum over ranks of x_r @ w_r; each rank keeps only its
+    [B_local, F] row block of the sum (B_local = B / axis_size).
+
+    decomposed=False: one local dot, then ``psum_scatter``.
+    decomposed=True: the accumulator travels the reduce-scatter ring;
+    at each hop a rank computes ONLY the partial product for the block's
+    final owner and adds it — n-1 transfers hiding under n matmul chunks.
+    """
+    n = axis_size
+    bl = x.shape[0] // n
+
+    def partial_for(dst):
+        # rows of the output block owned by rank ``dst``
+        rows = lax.dynamic_slice(x, (dst * bl, 0), (bl, x.shape[1]))
+        return rows @ w
+
+    if not decomposed:
+        return lax.psum_scatter(x @ w, axis_name, scatter_dimension=0, tiled=True)
+
+    r = lax.axis_index(axis_name)
+
+    def hop(carry, i):
+        acc = carry
+        # hop i: I add my partial for the block that is (n-1-i) hops
+        # upstream of its owner; after n hops the block lands complete
+        # on its owner — the classic ring reduce-scatter schedule
+        dst = (r + (n - 1) - i) % n
+        acc = acc + partial_for(dst)
+        acc = lax.cond(
+            i < n - 1,
+            lambda a: lax.ppermute(a, axis_name, ring_perm(n)),
+            lambda a: a,
+            acc,
+        )
+        return acc, None
+
+    from tpu_patterns.parallel.pipeline import _vary
+
+    acc0 = _vary(jnp.zeros((bl, w.shape[1]), x.dtype), axis_name)
+    acc, _ = lax.scan(hop, acc0, jnp.arange(n))
+    return acc
+
+
+@dataclasses.dataclass
+class OverlapConfig:
+    """CLI ``overlap`` subcommand."""
+
+    rows: int = 1024  # per-rank rows of x (AG) / output rows (RS)
+    contract: int = 4096  # contraction dim E
+    cols: int = 4096  # per-rank output columns F
+    dtype: str = "bfloat16"
+    pattern: str = "both"  # ag | rs | both
+    reps: int = 5
+    warmup: int = 2
+    min_speedup: float = -1.0  # <0: speedup is informational only
+    seed: int = 0
+
+
+def _run_one(mesh: Mesh, cfg: OverlapConfig, kind: str, writer) -> "Record":
+    from tpu_patterns.core import timing
+    from tpu_patterns.core.results import Record, Verdict
+
+    n = int(np.prod(list(mesh.shape.values())))
+    axis = mesh.axis_names[0]
+    dtype = jnp.dtype(cfg.dtype)
+    key = jax.random.key(cfg.seed)
+    if kind == "ag":
+        fn = allgather_matmul
+        x = jax.random.normal(key, (n * cfg.rows, cfg.contract), dtype)
+        # global W is column-sharded: each rank owns a [E, cols] block
+        w = jax.random.normal(
+            jax.random.key(cfg.seed + 1), (cfg.contract, n * cfg.cols), dtype
+        )
+        in_specs = (P(axis, None), P(None, axis))
+        out_specs = P(None, axis)  # all rows x THIS rank's column block
+        # FLOPs per rank: full rows x local cols
+        flops = 2.0 * (n * cfg.rows) * cfg.contract * cfg.cols
+        moved = (n - 1) * cfg.rows * cfg.contract * dtype.itemsize
+    elif kind == "rs":
+        fn = matmul_reducescatter
+        x = jax.random.normal(key, (n * cfg.rows, cfg.contract), dtype)
+        w = jax.random.normal(
+            jax.random.key(cfg.seed + 1), (cfg.contract, cfg.cols), dtype
+        )
+        in_specs = (P(None, axis), P(axis, None))
+        out_specs = P(axis, None)
+        flops = 2.0 * (n * cfg.rows) * cfg.contract * cfg.cols / n
+        moved = (n - 1) * cfg.rows * cfg.cols * dtype.itemsize
+    else:
+        raise ValueError(f"unknown overlap pattern {kind!r}; want ag|rs")
+
+    sh_x = jax.device_put(x, NamedSharding(mesh, in_specs[0]))
+    sh_w = jax.device_put(w, NamedSharding(mesh, in_specs[1]))
+
+    def build(decomposed: bool):
+        return jax.jit(
+            jax.shard_map(
+                functools.partial(
+                    fn, axis_name=axis, axis_size=n, decomposed=decomposed
+                ),
+                mesh=mesh,
+                in_specs=in_specs,
+                out_specs=out_specs,
+            )
+        )
+
+    base_fn, dec_fn = build(False), build(True)
+    base = jax.block_until_ready(base_fn(sh_x, sh_w))
+    dec = jax.block_until_ready(dec_fn(sh_x, sh_w))
+    # correctness: decomposed == undecomposed XLA collective, elementwise
+    # (tolerance scaled to magnitude: the per-block dot order matches, but
+    # reduction order across ranks may differ in rs)
+    b_np, d_np = np.asarray(base, np.float32), np.asarray(dec, np.float32)
+    scale = max(1.0, float(np.abs(b_np).max()))
+    tol = (64 if dtype == jnp.float32 else 16) * float(
+        jnp.finfo(dtype).eps
+    ) * scale
+    exact_ok = bool(np.abs(b_np - d_np).max() <= tol)
+
+    times = {}
+    for name, f in (("baseline", base_fn), ("decomposed", dec_fn)):
+        def chain(k, f=f):
+            def run():
+                out = None
+                for _ in range(k):
+                    out = f(sh_x, sh_w)
+                # ONE tiny fetch at the end: k dispatches execute in
+                # enqueue order on device; the chain amortizes the fetch
+                # round trip (core/timing.py discipline)
+                return np.asarray(out[0, 0])
+
+            return run
+
+        times[name] = timing.measure_chain(
+            chain, reps=cfg.reps, warmup=cfg.warmup, label=f"overlap:{kind}:{name}"
+        ).per_op_ns
+
+    speedup = times["baseline"] / times["decomposed"] if times["decomposed"] else 0.0
+    perf_ok = cfg.min_speedup < 0 or speedup >= cfg.min_speedup
+    rec = Record(
+        pattern="overlap",
+        mode=kind,
+        commands=f"{n}dev rows{cfg.rows} E{cfg.contract} F{cfg.cols} {cfg.dtype}",
+        metrics={
+            "baseline_us": round(times["baseline"] / 1e3, 2),
+            "decomposed_us": round(times["decomposed"] / 1e3, 2),
+            "speedup": round(speedup, 4),
+            "tflops_decomposed": round(
+                flops / times["decomposed"] / 1e3, 2
+            ) if times["decomposed"] else 0.0,
+            "ring_bytes": float(moved),
+        },
+        verdict=Verdict.SUCCESS if (exact_ok and perf_ok) else Verdict.FAILURE,
+    )
+    if not exact_ok:
+        rec.notes.append("decomposed result diverges from XLA collective")
+    writer.record(rec)
+    return rec
+
+
+def run_overlap(mesh: Mesh, cfg: OverlapConfig, writer) -> list:
+    kinds = ("ag", "rs") if cfg.pattern == "both" else (cfg.pattern,)
+    return [_run_one(mesh, cfg, k, writer) for k in kinds]

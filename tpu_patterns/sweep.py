@@ -274,6 +274,20 @@ def parallel_specs(quick: bool = False) -> list[SweepSpec]:
             env=(("TPU_PATTERNS_SWEEP_CONFIG", "decode"),),
         )
     )
+    # collective matmul: decomposed ring vs XLA collective, both duals
+    overlap_small = (
+        ("--rows", "16", "--contract", "64", "--cols", "32",
+         "--dtype", "float32", "--reps", "2", "--warmup", "1")
+        if quick
+        else ("--rows", "512", "--contract", "4096", "--cols", "2048")
+    )
+    specs.append(
+        SweepSpec(
+            name="overlap.collective_matmul",
+            argv=("overlap", *overlap_small),
+            env=(("TPU_PATTERNS_SWEEP_CONFIG", "overlap"),),
+        )
+    )
     flag_small = QUICK_FLAGSHIP if quick else ("--seq", "4096", "--batch", "2")
     for attn in ("xla", "pallas"):
         specs.append(
