@@ -8,7 +8,9 @@ cd "$(dirname "$0")/.."
 OUT=docs/measured/r2live
 mkdir -p "$OUT"
 while true; do
-  if timeout 90 python -c "import jax; jax.block_until_ready(jax.numpy.ones((256,256))@jax.numpy.ones((256,256))); print('up', jax.devices())" >/dev/null 2>&1; then
+  # -k: a tunnel hang sits in native code holding the GIL and shrugs off
+  # SIGTERM; escalate to SIGKILL so the watcher itself can never wedge
+  if timeout -k 10 90 python -c "import jax; jax.block_until_ready(jax.numpy.ones((256,256))@jax.numpy.ones((256,256))); print('up', jax.devices())" >/dev/null 2>&1; then
     echo "[$(date +%H:%M:%S)] tunnel up — capturing"
     TPU_PATTERNS_BENCH_TIMEOUT=700 python bench.py > "$OUT/bench_$(date +%H%M%S).json" 2>> "$OUT/bench.log"
     echo "[$(date +%H:%M:%S)] bench done: $(tail -c 300 "$OUT"/bench_*.json | tail -1)"

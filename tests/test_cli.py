@@ -186,8 +186,9 @@ class TestSweep:
         assert len(hier) == 2  # 2 dcn splits x 1 dtype
         meas = sweep.specs_for("measured", quick=True)
         assert {s.name.split(".")[0] for s in meas} == {"measured"}
-        # onesided + interop + 6 concurrency + 4 flash + 5 flagship + decode
-        assert len(meas) == 18
+        # onesided + interop + 6 concurrency + 4 flash + 5 flagship
+        # + decode (mha + gqa)
+        assert len(meas) == 19
         # every flash cell pins --devices to exactly 1 (any other world
         # would silently SKIP the cell and checkpoint it as passed)
         for s in meas:

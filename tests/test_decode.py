@@ -73,6 +73,99 @@ def mesh3d(devices):
     return Mesh(np.array(devices[:8]).reshape(2, 2, 2), ("dp", "sp", "tp"))
 
 
+class TestGQA:
+    @pytest.mark.parametrize("shape", [(2, 2, 2), (1, 4, 1)])
+    def test_gqa_decode_matches_training_forward(self, devices, shape):
+        # the KV-cache invariant under grouped K/V heads (cache at Hkv)
+        n = int(np.prod(shape))
+        mesh = Mesh(np.array(devices[:n]).reshape(shape), ("dp", "sp", "tp"))
+        assert _teacher_forcing_gate(
+            mesh, ModelConfig(**CFG, depth=2, kv_heads=2)
+        )
+
+    def test_gqa_equals_mha_when_groups_degenerate(self):
+        # kv_heads == heads with wkv == the wqkv k/v slices must produce
+        # the SAME forward as the fused MHA layout
+        from tpu_patterns.models.transformer import (
+            forward_shard,
+            init_params,
+        )
+
+        mha = ModelConfig(**CFG)
+        gqa = ModelConfig(**CFG, kv_heads=CFG["heads"])
+        p = init_params(jax.random.key(0), mha)
+        pg = {
+            "wq": p["wqkv"][0],
+            "wkv": p["wqkv"][1:],
+            "wo": p["wo"],
+            "w1": p["w1"],
+            "w2": p["w2"],
+        }
+        x = jax.random.normal(jax.random.key(1), (2, 16, mha.embed))
+        np.testing.assert_allclose(
+            np.asarray(forward_shard(pg, x, gqa)),
+            np.asarray(forward_shard(p, x, mha)),
+            rtol=0,
+            atol=1e-6,
+        )
+
+    def test_cache_shrinks_by_group_factor(self, devices):
+        mesh = Mesh(np.array(devices[:4]).reshape(1, 2, 2), ("dp", "sp", "tp"))
+        b, lp, gen = 2, 8, 4
+        sizes = {}
+        for kv in (0, 2):
+            cfg = ModelConfig(**CFG, dtype="float32", kv_heads=kv)
+            prefill, _ = make_decoder(mesh, cfg, b, lp, gen)
+            params = jax.device_put(
+                _stacked_params(jax.random.key(0), cfg),
+                {k: NamedSharding(mesh, s)
+                 for k, s in _stacked_specs(cfg).items()},
+            )
+            x = jax.device_put(
+                jax.random.normal(jax.random.key(1), (b, lp, cfg.embed)),
+                NamedSharding(mesh, P("dp", "sp", None)),
+            )
+            (ck, _), _ = prefill(params, x)
+            sizes[kv] = ck.size
+        assert sizes[2] * 4 == sizes[0]  # 8 heads -> 2 kv heads
+
+    def test_indivisible_kv_heads_fail_fast(self, devices):
+        # training factories must raise the clear error, not XLA's
+        from tpu_patterns.models.transformer import make_train_step
+
+        mesh = Mesh(
+            np.array(devices[:4]).reshape(1, 1, 4), ("dp", "sp", "tp")
+        )
+        with pytest.raises(ValueError, match="divide over tp"):
+            make_train_step(mesh, ModelConfig(**CFG, kv_heads=2))
+        with pytest.raises(ValueError, match="divide over tp"):
+            make_decoder(mesh, ModelConfig(**CFG, kv_heads=2), 2, 8, 4)
+
+    def test_gqa_training_step_runs(self, devices):
+        from tpu_patterns.models.transformer import (
+            init_params,
+            make_train_step,
+            shard_params,
+        )
+
+        mesh = Mesh(np.array(devices[:8]).reshape(2, 2, 2), ("dp", "sp", "tp"))
+        cfg = ModelConfig(**CFG, kv_heads=4)
+        step, _ = make_train_step(mesh, cfg, lr=1e-3)
+        params = shard_params(
+            init_params(jax.random.key(0), cfg), mesh, cfg
+        )
+        x = jax.device_put(
+            jax.random.normal(jax.random.key(1), (4, 32, cfg.embed)),
+            NamedSharding(mesh, P("dp", "sp", None)),
+        )
+        new, loss = step(params, x)
+        assert np.isfinite(float(loss))
+        # the grouped projections receive gradient
+        assert not np.allclose(
+            np.asarray(new["wkv"]), np.asarray(params["wkv"])
+        )
+
+
 class TestRollout:
     def test_self_feeding_rollout_is_deterministic(self, mesh3d):
         cfg = ModelConfig(**CFG, dtype="float32", causal=True, depth=2)

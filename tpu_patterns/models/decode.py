@@ -43,6 +43,7 @@ from tpu_patterns.models.transformer import (
     ModelConfig,
     init_params,
     param_specs,
+    qkv_native,
 )
 
 
@@ -133,12 +134,18 @@ def _prefill_layer(params, x, cache_k, cache_v, layout, sp_axis, tp_axis):
     from tpu_patterns.models.transformer import _interpret
     from tpu_patterns.longctx.ring_attention import ring_attention
 
-    qkv = jnp.einsum("ble,cehd->cblhd", x, params["wqkv"])
-    q, k, v = qkv[0], qkv[1], qkv[2]
-    kt = k.transpose(0, 2, 1, 3)  # [B, H, lp_loc, D]
+    q, k, v = qkv_native(params, x)
+    kt = k.transpose(0, 2, 1, 3)  # [B, Hkv, lp_loc, D]
     vt = v.transpose(0, 2, 1, 3)
     cache_k = lax.dynamic_update_slice(cache_k, kt, (0, 0, 0, 0))
     cache_v = lax.dynamic_update_slice(cache_v, vt, (0, 0, 0, 0))
+
+    # prefill attention runs at full H heads: GQA k/v broadcast for the
+    # one-shot ring pass (the PERSISTENT cache above stays at Hkv)
+    g = q.shape[2] // k.shape[2]
+    if g > 1:
+        k = jnp.repeat(k, g, axis=2)
+        v = jnp.repeat(v, g, axis=2)
 
     if sp_axis is not None:
         b, lp, h, d = q.shape
@@ -171,16 +178,22 @@ def _distributed_attention(q, cache_k, cache_v, q_pos, kv_pos, sp_axis):
     """Masked softmax attention of q against the sp-sharded cache.
 
     q: [B, Lq, H, D] with global query positions ``q_pos`` [Lq];
-    caches: [B, H, lc_loc, D] whose slots sit at global positions
-    ``kv_pos`` [lc_loc].  Causal: slot p visible to query qp iff p <= qp
+    caches: [B, Hkv, lc_loc, D] whose slots sit at global positions
+    ``kv_pos`` [lc_loc].  With GQA, Hkv < H and each cached head serves
+    H/Hkv contiguous query heads — the einsums group q as
+    [B, Lq, Hkv, g, D] so the small cache is read ONCE, never broadcast
+    to H heads in HBM.  Causal: slot p visible to query qp iff p <= qp
     (unwritten slots carry future positions, so they are masked for
     free).  Stable online-softmax combine across sp: pmax for the
     running max, psum for normalizer and weighted values.
     """
-    d = q.shape[-1]
-    s = jnp.einsum("bqhd,bhld->bhql", q, cache_k) * (d ** -0.5)
+    b, lq, h, d = q.shape
+    hkv = cache_k.shape[1]
+    g = h // hkv
+    qg = q.reshape(b, lq, hkv, g, d)
+    s = jnp.einsum("bqkgd,bkld->bkgql", qg, cache_k) * (d ** -0.5)
     mask = kv_pos[None, :] <= q_pos[:, None]  # [Lq, lc_loc]
-    s = jnp.where(mask[None, None], s, _neg_inf(s.dtype))
+    s = jnp.where(mask[None, None, None], s, _neg_inf(s.dtype))
     m = jnp.max(s, axis=-1, keepdims=True)
     if sp_axis is not None:
         m = lax.pmax(m, sp_axis)
@@ -188,26 +201,26 @@ def _distributed_attention(q, cache_k, cache_v, q_pos, kv_pos, sp_axis):
     # exp(-inf - -inf) = nan; clamp m so such rows produce 0/eps instead
     m = jnp.maximum(m, _neg_inf(s.dtype) / 2)
     p = jnp.exp(s - m)
-    denom = jnp.sum(p, axis=-1, keepdims=True)  # [B, H, Lq, 1]
-    numer = jnp.einsum("bhql,bhld->bhqd", p, cache_v)
+    denom = jnp.sum(p, axis=-1, keepdims=True)  # [B, Hkv, g, Lq, 1]
+    numer = jnp.einsum("bkgql,bkld->bkgqd", p, cache_v)
     if sp_axis is not None:
         denom = lax.psum(denom, sp_axis)
         numer = lax.psum(numer, sp_axis)
     out = numer / jnp.maximum(denom, jnp.asarray(1e-30, denom.dtype))
-    return out.transpose(0, 2, 1, 3)  # [B, Lq, H, D]
+    # [B, Hkv, g, Lq, D] -> [B, Lq, H, D]
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, lq, h, d)
 
 
 def _decode_layer(params, x, cache_k, cache_v, t, layout, sp_axis, tp_axis):
     """One layer for ONE new token at global position t.
 
-    x: [B, 1, E] (sp-replicated); caches [B, H, lc_loc, D].  Writes k/v
-    into the gen segment on the owning sp rank, attends over [0, t],
+    x: [B, 1, E] (sp-replicated); caches [B, Hkv, lc_loc, D].  Writes
+    k/v into the gen segment on the owning sp rank, attends over [0, t],
     returns the block output.
     """
-    qkv = jnp.einsum("ble,cehd->cblhd", x, params["wqkv"])
-    q, k, v = qkv[0], qkv[1], qkv[2]
+    q, k, v = qkv_native(params, x)
     off, valid = layout.write_offset(t, sp_axis)
-    kt = k.transpose(0, 2, 1, 3)  # [B, H, 1, D]
+    kt = k.transpose(0, 2, 1, 3)  # [B, Hkv, 1, D]
     vt = v.transpose(0, 2, 1, 3)
     # dynamic_update_slice clamps the start index; the select keeps the
     # write only on the owning rank (SPMD — no rank-dependent control flow)
@@ -254,6 +267,11 @@ def make_decoder(
     sp = int(mesh.shape["sp"])
     if batch % dp:
         raise ValueError(f"batch {batch} % dp={dp} != 0")
+    if cfg.kv_heads and cfg.kv_heads % int(mesh.shape["tp"]):
+        raise ValueError(
+            f"kv_heads {cfg.kv_heads} must divide over tp="
+            f"{int(mesh.shape['tp'])} (blocked head sharding)"
+        )
     layout = _CacheLayout(prefill_len, gen_cap, sp)
     sp_axis = "sp" if sp > 1 else None
     tp_axis = "tp" if int(mesh.shape["tp"]) > 1 else None
@@ -270,8 +288,8 @@ def make_decoder(
             return y, (ck_l, cv_l)
 
         depth = next(iter(params.values())).shape[0]
-        h = cfg.heads // int(mesh.shape["tp"])
-        shape = (depth, x.shape[0], h, layout.lc_loc, cfg.head_dim)
+        hkv = (cfg.kv_heads or cfg.heads) // int(mesh.shape["tp"])
+        shape = (depth, x.shape[0], hkv, layout.lc_loc, cfg.head_dim)
         zeros = jnp.zeros(shape, x.dtype)
         y, (ck, cv) = lax.scan(layer, x, (params, zeros, zeros))
         # the last GLOBAL prompt position's output lives on the last sp
@@ -350,6 +368,7 @@ class DecodeConfig:
     mlp_mult: int = 4
     dtype: str = "bfloat16"
     depth: int = 4
+    kv_heads: int = 0  # GQA: K/V heads (0 = MHA); cache shrinks H/kv-fold
     batch: int = 8
     prefill: int = 4096  # prompt tokens (the long-context side)
     gen: int = 128  # generated tokens per rep
@@ -374,6 +393,7 @@ def run_decode(mesh: Mesh, cfg: DecodeConfig, writer) -> list:
         causal=True,
         dtype=cfg.dtype,
         depth=cfg.depth,
+        kv_heads=cfg.kv_heads,
     )
     sp = int(mesh.shape["sp"])
     gen_cap = cfg.gen + (-cfg.gen % sp)
@@ -420,8 +440,8 @@ def run_decode(mesh: Mesh, cfg: DecodeConfig, writer) -> list:
     sec = res.per_op_ns * 1e-9
     tps = tokens / sec if sec > 0 else 0.0
     cache_mb = (
-        2 * cfg.depth * cfg.batch * cfg.heads * max_len * cfg.head_dim
-        * jnp.dtype(cfg.dtype).itemsize / 1e6
+        2 * cfg.depth * cfg.batch * (cfg.kv_heads or cfg.heads) * max_len
+        * cfg.head_dim * jnp.dtype(cfg.dtype).itemsize / 1e6
     )
     ok = gate and np.isfinite(tps) and tps > 0
     if cfg.min_tokens_per_s > 0:
@@ -465,8 +485,14 @@ def _teacher_forcing_gate(mesh: Mesh, big: ModelConfig) -> bool:
     heads = 8 if 8 % tp == 0 else tp
     b = 2 * dp
     l = 32 if 32 % (2 * sp) == 0 else 4 * sp
+    # GQA probe: keep the grouped layout if the measured config uses it,
+    # rescaled so kv_heads divides both the probe heads and tp
+    kv = 0
+    if big.kv_heads:
+        kv = heads // 2 if heads // 2 and (heads // 2) % tp == 0 else heads
     cfg = dataclasses.replace(
-        big, embed=64, heads=heads, head_dim=8, dtype="float32", causal=True
+        big, embed=64, heads=heads, head_dim=8, dtype="float32",
+        causal=True, kv_heads=kv,
     )
     key = jax.random.key(17)
     params = _stacked_params(key, cfg)

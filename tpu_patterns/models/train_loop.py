@@ -57,6 +57,7 @@ class TrainLoopConfig:
     moe: bool = False
     remat: bool = False
     depth: int = 1
+    kv_heads: int = 0  # GQA K/V heads (0 = MHA)
     optimizer: str = "sgd"  # sgd | zero-sgd | zero-adam
     lr: float = 1e-3
     steps: int = 10
@@ -80,6 +81,7 @@ def _model_cfg(cfg: TrainLoopConfig) -> ModelConfig:
         attn=cfg.attn,
         remat=cfg.remat,
         depth=cfg.depth,
+        kv_heads=cfg.kv_heads,
     )
 
 

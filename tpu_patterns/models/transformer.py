@@ -72,10 +72,22 @@ class ModelConfig:
     # Number of stacked transformer blocks applied by lax.scan (params get
     # a leading [depth] axis).  depth=1 keeps the single-block layout.
     depth: int = 1
+    # Grouped-query attention: number of shared K/V heads (0 = heads, the
+    # MHA layout with the fused wqkv parameter).  With kv_heads > 0 the
+    # projections split into wq [E, H, D] and wkv [2, E, Hkv, D]; each
+    # K/V head serves heads/kv_heads query heads.  The decode KV cache —
+    # the thing HBM capacity actually bounds at long context — shrinks by
+    # that same group factor.
+    kv_heads: int = 0
 
     @property
     def mlp_hidden(self) -> int:
         return self.embed * self.mlp_mult
+
+    @property
+    def group_size(self) -> int:
+        """Query heads per K/V head (1 = MHA)."""
+        return self.heads // self.kv_heads if self.kv_heads else 1
 
 
 # Per-parameter global shapes + shardings (tp shards heads / mlp hidden;
@@ -85,10 +97,21 @@ def param_specs(
     cfg: ModelConfig, n_experts: int = 0
 ) -> dict[str, tuple[tuple[int, ...], P]]:
     e, h, d, f = cfg.embed, cfg.heads, cfg.head_dim, cfg.mlp_hidden
-    specs = {
-        "wqkv": ((3, e, h, d), P(None, None, "tp", None)),
-        "wo": ((h, d, e), P("tp", None, None)),
-    }
+    if cfg.kv_heads:
+        if h % cfg.kv_heads:
+            raise ValueError(
+                f"heads {h} must divide by kv_heads {cfg.kv_heads}"
+            )
+        specs = {
+            "wq": ((e, h, d), P(None, "tp", None)),
+            "wkv": ((2, e, cfg.kv_heads, d), P(None, None, "tp", None)),
+            "wo": ((h, d, e), P("tp", None, None)),
+        }
+    else:
+        specs = {
+            "wqkv": ((3, e, h, d), P(None, None, "tp", None)),
+            "wo": ((h, d, e), P("tp", None, None)),
+        }
     if cfg.moe:
         if n_experts < 1:
             raise ValueError("moe=True needs n_experts (= tp axis size)")
@@ -130,6 +153,42 @@ def init_params(key, cfg: ModelConfig, n_experts: int = 0) -> dict[str, jax.Arra
     return params
 
 
+def qkv_native(params: dict, x: jax.Array):
+    """[B, L, *, D] projections with k/v at their NATIVE head count: Hkv
+    for the split GQA parameters, H for the fused MHA wqkv.  Dispatch is
+    by parameter key — the one place the two layouts differ."""
+    if "wqkv" in params:
+        qkv = jnp.einsum("ble,cehd->cblhd", x, params["wqkv"])
+        return qkv[0], qkv[1], qkv[2]
+    q = jnp.einsum("ble,ehd->blhd", x, params["wq"])
+    kv = jnp.einsum("ble,cehd->cblhd", x, params["wkv"])
+    return q, kv[0], kv[1]
+
+
+def _qkv(params: dict, x: jax.Array, cfg: ModelConfig):
+    """[B, L, H, D] query/key/value projections; with GQA the Hkv K/V
+    heads are broadcast to H up front (each serves ``group_size``
+    contiguous query heads — contiguous, so tp's blocked head sharding
+    keeps every group on one rank), and all downstream attention paths
+    see the MHA shape unchanged."""
+    q, k, v = qkv_native(params, x)
+    g = cfg.group_size
+    if g > 1:
+        k, v = jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2)
+    return q, k, v
+
+
+def _check_kv_heads_shardable(cfg: ModelConfig, mesh: Mesh) -> None:
+    """Fail fast with the explanation instead of an opaque XLA
+    partitioning error when wkv's head axis cannot shard over tp."""
+    tp = int(mesh.shape.get("tp", 1))
+    if cfg.kv_heads and cfg.kv_heads % tp:
+        raise ValueError(
+            f"kv_heads {cfg.kv_heads} must divide over tp={tp} "
+            "(blocked head sharding)"
+        )
+
+
 def forward_shard(
     params: dict,
     x: jax.Array,
@@ -146,8 +205,7 @@ def forward_shard(
     single-source-two-worlds discipline as the miniapps.
     """
     # Attention branch: heads are tp-local, sequence is sp-local.
-    qkv = jnp.einsum("ble,cehd->cblhd", x, params["wqkv"])
-    q, k, v = qkv[0], qkv[1], qkv[2]
+    q, k, v = _qkv(params, x, cfg)
 
     # Fold batch into the head axis ([B, L, H, D] -> [L, B*H, D]):
     # attention is independent per (batch, head), and one folded call gives
@@ -327,6 +385,7 @@ def make_train_step(
     x_spec = x_spec or P("dp", "sp", None)
     axes = ("dp", "sp")  # tp is already reduced inside the forward
     sp = int(mesh.shape["sp"])
+    _check_kv_heads_shardable(cfg, mesh)
     specs = param_specs(cfg, _n_experts(mesh, cfg))
     pspecs = {k: s for k, (_, s) in specs.items()}
 
@@ -405,6 +464,7 @@ def make_zero_train_step(
 
     x_spec = x_spec or P("dp", "sp", None)
     dp, sp = int(mesh.shape["dp"]), int(mesh.shape["sp"])
+    _check_kv_heads_shardable(cfg, mesh)
     specs = param_specs(cfg, _n_experts(mesh, cfg))
     pspecs = {k: s for k, (_, s) in specs.items()}
     if optimizer == "adam":
@@ -594,6 +654,7 @@ def make_zero_train_step(
 
 
 def shard_params(params: dict, mesh: Mesh, cfg: ModelConfig) -> dict:
+    _check_kv_heads_shardable(cfg, mesh)
     specs = param_specs(cfg, _n_experts(mesh, cfg))
     return {
         k: jax.device_put(v, NamedSharding(mesh, specs[k][1]))
@@ -654,6 +715,7 @@ class FlagshipConfig:
     optimizer: str = "sgd"
     remat: bool = False  # jax.checkpoint each block (FLOPs for HBM)
     depth: int = 1  # stacked blocks applied by lax.scan
+    kv_heads: int = 0  # GQA K/V heads (0 = MHA)
     reps: int = 10
     warmup: int = 2
     min_tflops: float = -1.0
@@ -665,7 +727,9 @@ def flagship_flops(cfg: FlagshipConfig) -> float:
     accounting): qkv/out projections, attention matmuls, MLP."""
     b, l, e = cfg.batch, cfg.seq, cfg.embed
     hd = cfg.heads * cfg.head_dim
-    proj = 2 * b * l * e * (3 * hd) + 2 * b * l * hd * e
+    # GQA shrinks the k/v projections to kv_heads (q and out stay at H)
+    kvd = (cfg.kv_heads or cfg.heads) * cfg.head_dim
+    proj = 2 * b * l * e * (hd + 2 * kvd) + 2 * b * l * hd * e
     attn = 4.0 * l * l * cfg.heads * cfg.head_dim * b / (2 if cfg.causal else 1)
     mlp = 4 * b * l * e * (e * cfg.mlp_mult)
     per_block = proj + attn + mlp
@@ -709,6 +773,7 @@ def run_flagship(mesh: Mesh, cfg: FlagshipConfig, writer) -> list:
         attn_layout=cfg.attn_layout,
         remat=cfg.remat,
         depth=cfg.depth,
+        kv_heads=cfg.kv_heads,
     )
     dp, sp = int(mesh.shape["dp"]), int(mesh.shape["sp"])
     if cfg.batch % dp or cfg.seq % sp:

@@ -464,6 +464,15 @@ def measured_specs(quick: bool = False) -> list[SweepSpec]:
             env=env,
         )
     )
+    # GQA contrast: 4x smaller cache (8 heads -> 2 kv heads), same decode
+    specs.append(
+        SweepSpec(
+            name="measured.decode_kv_cache_gqa",
+            argv=("decode", "--devices", "1", "--kv_heads", "2",
+                  *decode_args),
+            env=env,
+        )
+    )
     return specs
 
 
