@@ -1,6 +1,8 @@
 """Autoregressive decode with the sequence-parallel KV cache
 (models/decode.py): teacher-forcing equivalence, layout math, rollout."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -163,6 +165,92 @@ class TestGQA:
         # the grouped projections receive gradient
         assert not np.allclose(
             np.asarray(new["wkv"]), np.asarray(params["wkv"])
+        )
+
+
+class TestRope:
+    def test_rotation_preserves_norm_and_is_relative(self):
+        # rope is a rotation (norm-preserving), and rotated dot products
+        # depend only on the position DIFFERENCE (the relative property)
+        from tpu_patterns.models.transformer import apply_rope, rope_tables
+
+        d = 16
+        q = jax.random.normal(jax.random.key(0), (1, 1, 2, d))
+        k = jax.random.normal(jax.random.key(1), (1, 1, 2, d))
+
+        def rotated_dot(i, j):
+            ci, si = rope_tables(
+                jnp.array([i]), d, 10000.0, jnp.float32
+            )
+            cj, sj = rope_tables(
+                jnp.array([j]), d, 10000.0, jnp.float32
+            )
+            qi = apply_rope(q, ci, si)
+            kj = apply_rope(k, cj, sj)
+            return float(jnp.sum(qi * kj)), float(jnp.sum(qi * qi))
+
+        d57, nq = rotated_dot(5, 7)
+        d810, nq2 = rotated_dot(8, 10)
+        assert np.isclose(d57, d810, rtol=1e-5)  # same offset 2
+        assert np.isclose(nq, float(jnp.sum(q * q)), rtol=1e-5)
+        d59, _ = rotated_dot(5, 9)
+        assert not np.isclose(d57, d59, rtol=1e-3)  # offset matters
+
+    @pytest.mark.parametrize("layout", ["contiguous", "striped"])
+    def test_sp_rope_loss_matches_single_device(self, devices, layout):
+        # the position test the sp layouts cannot fake: with rope ON, a
+        # wrong per-shard offset changes the objective
+        from tpu_patterns.models.transformer import (
+            forward_shard,
+            init_params,
+            make_train_step,
+            shard_params,
+        )
+
+        mesh = Mesh(
+            np.array(devices[:8]).reshape(2, 2, 2), ("dp", "sp", "tp")
+        )
+        cfg = ModelConfig(**CFG, rope=True, attn_layout=layout)
+        params = init_params(jax.random.key(0), cfg)
+        x = jax.random.normal(jax.random.key(1), (4, 32, cfg.embed))
+        step, _ = make_train_step(mesh, cfg, lr=0.0)
+        sx_full = x
+        if layout == "striped":
+            sp = 2
+            sx_full = jnp.concatenate(
+                [x[:, r::sp] for r in range(sp)], axis=1
+            )
+        sx = jax.device_put(
+            sx_full, NamedSharding(mesh, P("dp", "sp", None))
+        )
+        _, loss = step(shard_params(params, mesh, cfg), sx)
+        z = forward_shard(params, x, dataclasses.replace(
+            cfg, attn_layout="contiguous"
+        ))
+        want = float(jnp.sum(z.astype(jnp.float32) ** 2))
+        assert np.isclose(float(loss), want, rtol=1e-4)
+
+    def test_rope_changes_the_forward(self):
+        from tpu_patterns.models.transformer import (
+            forward_shard,
+            init_params,
+        )
+
+        plain = ModelConfig(**CFG)
+        roped = ModelConfig(**CFG, rope=True)
+        p = init_params(jax.random.key(0), plain)
+        x = jax.random.normal(jax.random.key(1), (2, 16, plain.embed))
+        a = np.asarray(forward_shard(p, x, plain))
+        b = np.asarray(forward_shard(p, x, roped))
+        assert not np.allclose(a, b, atol=1e-3)
+
+    @pytest.mark.parametrize("kv", [0, 2])
+    def test_rope_decode_matches_training_forward(self, devices, kv):
+        mesh = Mesh(
+            np.array(devices[:8]).reshape(2, 2, 2), ("dp", "sp", "tp")
+        )
+        assert _teacher_forcing_gate(
+            mesh, ModelConfig(**CFG, depth=2, rope=True, kv_heads=kv)
         )
 
 

@@ -41,9 +41,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_patterns.models.transformer import (
     ModelConfig,
+    _check_kv_heads_shardable,
+    apply_rope,
     init_params,
     param_specs,
     qkv_native,
+    rope_tables,
 )
 
 
@@ -118,7 +121,9 @@ class _CacheLayout:
         return self.lp_loc + rel, (rel >= 0) & (rel < self.lg_loc)
 
 
-def _prefill_layer(params, x, cache_k, cache_v, layout, sp_axis, tp_axis):
+def _prefill_layer(
+    params, x, cache_k, cache_v, layout, cfg, sp_axis, tp_axis
+):
     """One layer over the FULL prompt shard: compute k/v for every prompt
     position, write them into segment 0 of the local cache, and return
     the layer output.  x: [B, lp_loc, E] (sequence sp-sharded, like
@@ -135,6 +140,14 @@ def _prefill_layer(params, x, cache_k, cache_v, layout, sp_axis, tp_axis):
     from tpu_patterns.longctx.ring_attention import ring_attention
 
     q, k, v = qkv_native(params, x)
+    if cfg.rope:
+        # rotate by the prompt's GLOBAL positions; the cache stores the
+        # ROTATED k (absolute rotary), so decode never re-touches it
+        r = lax.axis_index(sp_axis) if sp_axis is not None else 0
+        pos = r * layout.lp_loc + jnp.arange(layout.lp_loc, dtype=jnp.int32)
+        cos, sin = rope_tables(pos, cfg.head_dim, cfg.rope_theta, q.dtype)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
     kt = k.transpose(0, 2, 1, 3)  # [B, Hkv, lp_loc, D]
     vt = v.transpose(0, 2, 1, 3)
     cache_k = lax.dynamic_update_slice(cache_k, kt, (0, 0, 0, 0))
@@ -211,7 +224,9 @@ def _distributed_attention(q, cache_k, cache_v, q_pos, kv_pos, sp_axis):
     return out.transpose(0, 3, 1, 2, 4).reshape(b, lq, h, d)
 
 
-def _decode_layer(params, x, cache_k, cache_v, t, layout, sp_axis, tp_axis):
+def _decode_layer(
+    params, x, cache_k, cache_v, t, layout, cfg, sp_axis, tp_axis
+):
     """One layer for ONE new token at global position t.
 
     x: [B, 1, E] (sp-replicated); caches [B, Hkv, lc_loc, D].  Writes
@@ -219,6 +234,11 @@ def _decode_layer(params, x, cache_k, cache_v, t, layout, sp_axis, tp_axis):
     returns the block output.
     """
     q, k, v = qkv_native(params, x)
+    if cfg.rope:
+        pos = jnp.reshape(t, (1,)).astype(jnp.int32)
+        cos, sin = rope_tables(pos, cfg.head_dim, cfg.rope_theta, q.dtype)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
     off, valid = layout.write_offset(t, sp_axis)
     kt = k.transpose(0, 2, 1, 3)  # [B, Hkv, 1, D]
     vt = v.transpose(0, 2, 1, 3)
@@ -267,11 +287,7 @@ def make_decoder(
     sp = int(mesh.shape["sp"])
     if batch % dp:
         raise ValueError(f"batch {batch} % dp={dp} != 0")
-    if cfg.kv_heads and cfg.kv_heads % int(mesh.shape["tp"]):
-        raise ValueError(
-            f"kv_heads {cfg.kv_heads} must divide over tp="
-            f"{int(mesh.shape['tp'])} (blocked head sharding)"
-        )
+    _check_kv_heads_shardable(cfg, mesh)
     layout = _CacheLayout(prefill_len, gen_cap, sp)
     sp_axis = "sp" if sp > 1 else None
     tp_axis = "tp" if int(mesh.shape["tp"]) > 1 else None
@@ -283,7 +299,7 @@ def make_decoder(
             y = carry
             p_l, ck_l, cv_l = xs
             y, ck_l, cv_l = _prefill_layer(
-                p_l, y, ck_l, cv_l, layout, sp_axis, tp_axis
+                p_l, y, ck_l, cv_l, layout, cfg, sp_axis, tp_axis
             )
             return y, (ck_l, cv_l)
 
@@ -314,7 +330,7 @@ def make_decoder(
                 yy = c2
                 p_l, ck_l, cv_l = xs
                 yy, ck_l, cv_l = _decode_layer(
-                    p_l, yy, ck_l, cv_l, t, layout, sp_axis, tp_axis
+                    p_l, yy, ck_l, cv_l, t, layout, cfg, sp_axis, tp_axis
                 )
                 return yy, (ck_l, cv_l)
 
@@ -369,6 +385,7 @@ class DecodeConfig:
     dtype: str = "bfloat16"
     depth: int = 4
     kv_heads: int = 0  # GQA: K/V heads (0 = MHA); cache shrinks H/kv-fold
+    rope: bool = False  # rotary position embeddings on q/k
     batch: int = 8
     prefill: int = 4096  # prompt tokens (the long-context side)
     gen: int = 128  # generated tokens per rep
@@ -394,6 +411,7 @@ def run_decode(mesh: Mesh, cfg: DecodeConfig, writer) -> list:
         dtype=cfg.dtype,
         depth=cfg.depth,
         kv_heads=cfg.kv_heads,
+        rope=cfg.rope,
     )
     sp = int(mesh.shape["sp"])
     gen_cap = cfg.gen + (-cfg.gen % sp)
@@ -448,7 +466,9 @@ def run_decode(mesh: Mesh, cfg: DecodeConfig, writer) -> list:
         ok = ok and tps >= cfg.min_tokens_per_s
     rec = Record(
         pattern="decode",
-        mode=f"sp{sp}",
+        mode=f"sp{sp}"
+        + (f"_gqa{cfg.kv_heads}" if cfg.kv_heads else "")
+        + ("_rope" if cfg.rope else ""),
         commands=(
             f"B{cfg.batch} prefill{cfg.prefill} gen{cfg.gen} "
             f"depth{cfg.depth} {cfg.dtype}"

@@ -58,6 +58,7 @@ class TrainLoopConfig:
     remat: bool = False
     depth: int = 1
     kv_heads: int = 0  # GQA K/V heads (0 = MHA)
+    rope: bool = False  # rotary position embeddings on q/k
     optimizer: str = "sgd"  # sgd | zero-sgd | zero-adam
     lr: float = 1e-3
     steps: int = 10
@@ -82,6 +83,7 @@ def _model_cfg(cfg: TrainLoopConfig) -> ModelConfig:
         remat=cfg.remat,
         depth=cfg.depth,
         kv_heads=cfg.kv_heads,
+        rope=cfg.rope,
     )
 
 

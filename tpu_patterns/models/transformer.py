@@ -79,6 +79,14 @@ class ModelConfig:
     # the thing HBM capacity actually bounds at long context — shrinks by
     # that same group factor.
     kv_heads: int = 0
+    # Rotary position embeddings on q/k.  Positions are GLOBAL along the
+    # sequence — under sp each shard rotates by its own token positions
+    # (contiguous: r*L_loc + i; striped: r + sp*i), which is what makes
+    # rope a real test of the sequence-parallel layouts: a wrong offset
+    # changes the loss.  Rotation is absolute per token, so rotated K
+    # travels the ring / sits in the decode cache unchanged.
+    rope: bool = False
+    rope_theta: float = 10000.0
 
     @property
     def mlp_hidden(self) -> int:
@@ -165,13 +173,70 @@ def qkv_native(params: dict, x: jax.Array):
     return q, kv[0], kv[1]
 
 
-def _qkv(params: dict, x: jax.Array, cfg: ModelConfig):
+def rope_tables(
+    positions: jax.Array, head_dim: int, theta: float, dtype
+) -> tuple[jax.Array, jax.Array]:
+    """(cos, sin) [L, D/2] for the given GLOBAL token positions.
+
+    Computed in f32 (theta**(2i/D) spans orders of magnitude bf16 cannot
+    hold) and cast at the end."""
+    if head_dim % 2:
+        raise ValueError(f"rope needs an even head_dim, got {head_dim}")
+    inv_freq = theta ** (
+        -jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    )
+    ang = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    return jnp.cos(ang).astype(dtype), jnp.sin(ang).astype(dtype)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate [B, L, H, D] by per-position angles ([L, D/2] tables),
+    pairing dimension halves (x1, x2) -> (x1 c - x2 s, x2 c + x1 s)."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+def local_positions(
+    l_local: int,
+    cfg: ModelConfig,
+    sp_axis: str | None,
+    sp_size: int = 1,
+) -> jax.Array:
+    """GLOBAL positions of this shard's tokens under the sp layout."""
+    i = jnp.arange(l_local, dtype=jnp.int32)
+    if sp_axis is None or sp_size <= 1:
+        return i
+    r = lax.axis_index(sp_axis)
+    if cfg.attn_layout == "striped":
+        return r + sp_size * i
+    return r * l_local + i
+
+
+def _qkv(
+    params: dict,
+    x: jax.Array,
+    cfg: ModelConfig,
+    positions: jax.Array | None = None,
+):
     """[B, L, H, D] query/key/value projections; with GQA the Hkv K/V
     heads are broadcast to H up front (each serves ``group_size``
     contiguous query heads — contiguous, so tp's blocked head sharding
     keeps every group on one rank), and all downstream attention paths
-    see the MHA shape unchanged."""
+    see the MHA shape unchanged.  ``positions`` (global, [L]) enables
+    rope on q/k — applied BEFORE the GQA broadcast, so the rotation FLOPs
+    scale with Hkv."""
     q, k, v = qkv_native(params, x)
+    if cfg.rope:
+        if positions is None:
+            positions = jnp.arange(x.shape[1], dtype=jnp.int32)
+        cos, sin = rope_tables(
+            positions, cfg.head_dim, cfg.rope_theta, q.dtype
+        )
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
     g = cfg.group_size
     if g > 1:
         k, v = jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2)
@@ -205,7 +270,12 @@ def forward_shard(
     single-source-two-worlds discipline as the miniapps.
     """
     # Attention branch: heads are tp-local, sequence is sp-local.
-    q, k, v = _qkv(params, x, cfg)
+    pos = (
+        local_positions(x.shape[1], cfg, sp_axis, sp_size)
+        if cfg.rope
+        else None
+    )
+    q, k, v = _qkv(params, x, cfg, positions=pos)
 
     # Fold batch into the head axis ([B, L, H, D] -> [L, B*H, D]):
     # attention is independent per (batch, head), and one folded call gives
@@ -716,6 +786,7 @@ class FlagshipConfig:
     remat: bool = False  # jax.checkpoint each block (FLOPs for HBM)
     depth: int = 1  # stacked blocks applied by lax.scan
     kv_heads: int = 0  # GQA K/V heads (0 = MHA)
+    rope: bool = False  # rotary position embeddings on q/k
     reps: int = 10
     warmup: int = 2
     min_tflops: float = -1.0
@@ -774,6 +845,7 @@ def run_flagship(mesh: Mesh, cfg: FlagshipConfig, writer) -> list:
         remat=cfg.remat,
         depth=cfg.depth,
         kv_heads=cfg.kv_heads,
+        rope=cfg.rope,
     )
     dp, sp = int(mesh.shape["dp"]), int(mesh.shape["sp"])
     if cfg.batch % dp or cfg.seq % sp:
@@ -921,6 +993,7 @@ def make_pipeline_train_step(
             "pipeline stages are single blocks; express depth as pp stages "
             "(init_stack_params), not ModelConfig.depth"
         )
+    _check_kv_heads_shardable(cfg, mesh)
     from tpu_patterns.parallel.pipeline import (
         pipeline_apply,
         pipeline_train_1f1b,
