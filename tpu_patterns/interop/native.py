@@ -32,26 +32,41 @@ TARGETS = {
 }
 
 
-def _build() -> bool:
-    global _build_error
-    src = os.path.join(_CSRC, "tpu_patterns_ffi.cc")
+def build_shared_object(src_name: str, so_path: str) -> str | None:
+    """Lazy-build one csrc/ target: make on first use, cached by mtime.
+
+    Passes the .so as an EXPLICIT make target so one module's build
+    breakage cannot take down another's (the untargeted default builds
+    everything).  Returns an error string, or None on success — the
+    shared scaffolding for every native module (this FFI one,
+    io/loader.py's prefetch loader).
+    """
+    src = os.path.join(_CSRC, src_name)
     if not os.path.exists(src):
-        _build_error = f"source missing: {src}"
-        return False
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(src):
-        return True
+        return f"source missing: {src}"
+    if os.path.exists(so_path) and (
+        os.path.getmtime(so_path) >= os.path.getmtime(src)
+    ):
+        return None
     try:
         proc = subprocess.run(
-            ["make", "-C", _CSRC, "BUILD=" + _BUILD],
+            ["make", "-C", _CSRC, "BUILD=" + _BUILD, so_path],
             capture_output=True,
             text=True,
             timeout=300,
         )
     except (OSError, subprocess.TimeoutExpired) as e:  # no toolchain
-        _build_error = str(e)
-        return False
+        return str(e)
     if proc.returncode != 0:
-        _build_error = proc.stderr[-2000:]
+        return proc.stderr[-2000:]
+    return None
+
+
+def _build() -> bool:
+    global _build_error
+    err = build_shared_object("tpu_patterns_ffi.cc", _SO)
+    if err is not None:
+        _build_error = err
         return False
     return True
 

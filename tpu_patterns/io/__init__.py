@@ -1,0 +1,11 @@
+"""Input pipeline: the native prefetch loader.
+
+The host side of training IO — batches are synthesized (or, in a real
+deployment, read + decoded) by C++ producer threads into a ring of host
+buffers AHEAD of the device, crossing into JAX as zero-copy numpy views.
+Deterministic and seekable, so it composes with checkpoint/resume.
+"""
+
+from tpu_patterns.io.loader import NativeLoader, native_available
+
+__all__ = ["NativeLoader", "native_available"]

@@ -1,0 +1,149 @@
+"""ctypes binding for the native prefetch loader (csrc/loader.cc).
+
+Shares interop/native.py's lazy-build scaffolding: ``make`` on first
+use, no binaries in the repo.  When the toolchain is missing,
+``native_available()`` is False and constructing a ``NativeLoader``
+raises with the build error — ``train --data native`` reports it rather
+than silently substituting a different stream.
+
+The loader's contract, pinned by tests/test_io.py:
+
+* batch t is a pure function of (seed, t) — two instances agree element
+  for element, and ``seek(t)`` replays the stream from t (what makes a
+  resumed training run see the killed run's exact batches);
+* ``next()`` returns a read-only numpy view of a ring slot, valid until
+  the FOLLOWING ``next()``/``seek()`` — consume it (device_put) before
+  advancing;
+* producer threads fill ahead: after a few consumes, ``filled_total``
+  exceeds the consumed count (prefetch really overlaps).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+import numpy as np
+
+from tpu_patterns.interop.native import _BUILD, build_shared_object
+
+_SO = os.path.join(_BUILD, "libtpu_patterns_loader.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_build_error: str | None = None
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        err = build_shared_object("loader.cc", _SO)
+        if err is not None:
+            _build_error = err
+            return None
+        lib = ctypes.CDLL(_SO)
+        lib.tpl_create.restype = ctypes.c_void_p
+        lib.tpl_create.argtypes = [
+            ctypes.c_uint64, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.tpl_destroy.argtypes = [ctypes.c_void_p]
+        lib.tpl_next.restype = ctypes.POINTER(ctypes.c_float)
+        lib.tpl_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.tpl_seek.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.tpl_filled_total.restype = ctypes.c_int64
+        lib.tpl_filled_total.argtypes = [ctypes.c_void_p]
+        lib.tpl_fill_reference.argtypes = [
+            ctypes.c_uint64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def build_error() -> str | None:
+    return _build_error
+
+
+def fill_reference(seed: int, elems: int, step: int) -> np.ndarray:
+    """The synchronous oracle: batch ``step`` without loader state."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native loader unavailable: {_build_error}")
+    out = np.empty(elems, np.float32)
+    lib.tpl_fill_reference(
+        seed, elems, step,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    return out
+
+
+class NativeLoader:
+    """Prefetching batch stream of shape ``shape`` float32 arrays.
+
+    Single-consumer: ``next``/``seek`` must be called from one thread.
+    """
+
+    def __init__(
+        self,
+        seed: int,
+        shape: tuple[int, ...],
+        buffers: int = 4,
+        threads: int = 2,
+    ):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native loader unavailable: {_build_error}")
+        self._lib = lib
+        self.shape = tuple(shape)
+        self.elems = int(np.prod(self.shape))
+        self._ptr = lib.tpl_create(seed, self.elems, buffers, threads)
+        if not self._ptr:
+            raise ValueError(
+                f"bad loader config: elems={self.elems} buffers={buffers} "
+                f"threads={threads} (need elems>0, buffers>=2, threads>=1)"
+            )
+
+    def next(self) -> tuple[np.ndarray, int]:
+        """(batch view, step).  The view aliases a ring slot: consume it
+        (e.g. jax.device_put) before the next ``next()``/``seek()``."""
+        step = ctypes.c_int64()
+        buf = self._lib.tpl_next(self._ptr, ctypes.byref(step))
+        arr = np.ctypeslib.as_array(buf, shape=(self.elems,)).reshape(
+            self.shape
+        )
+        arr.flags.writeable = False
+        return arr, int(step.value)
+
+    def seek(self, step: int) -> None:
+        self._lib.tpl_seek(self._ptr, step)
+
+    @property
+    def filled_total(self) -> int:
+        """Batches produced so far (consumed + prefetched ahead)."""
+        return int(self._lib.tpl_filled_total(self._ptr))
+
+    def close(self) -> None:
+        if self._ptr:
+            self._lib.tpl_destroy(self._ptr)
+            self._ptr = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):  # best effort; close() is the real API
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -59,6 +59,10 @@ class TrainLoopConfig:
     depth: int = 1
     kv_heads: int = 0  # GQA K/V heads (0 = MHA)
     rope: bool = False  # rotary position embeddings on q/k
+    # batch source: "synthetic" (pure-jax PRNG) | "native" (the C++
+    # prefetch loader, io/loader.py — producer threads fill ahead of the
+    # device; same determinism/seek contract, so resume stays bit-exact)
+    data: str = "synthetic"
     optimizer: str = "sgd"  # sgd | zero-sgd | zero-adam
     lr: float = 1e-3
     steps: int = 10
@@ -89,14 +93,46 @@ def _model_cfg(cfg: TrainLoopConfig) -> ModelConfig:
 
 def _batch_for_step(cfg: TrainLoopConfig, mesh: Mesh, step: int) -> jax.Array:
     """The step's batch — pure in (seed, step), so a resumed run replays
-    the identical stream (synthetic here; a real loader would seek its
-    cursor to ``step`` the same way)."""
+    the identical stream."""
     x = jax.random.normal(
         jax.random.key(cfg.seed + 1_000_003 * (step + 1)),
         (cfg.batch, cfg.seq, cfg.embed),
         jnp.dtype(cfg.dtype),
     )
     return jax.device_put(x, NamedSharding(mesh, P("dp", "sp", None)))
+
+
+def _make_batch_source(cfg: TrainLoopConfig, mesh: Mesh, start: int):
+    """(get_batch(t), close()) for the configured data source.
+
+    The native source holds the same purity contract as the synthetic
+    one — batch t is a function of (seed, t), seek(t) repositions — so
+    checkpoint/resume equivalence is source-independent.
+    """
+    if cfg.data == "synthetic":
+        return (lambda t: _batch_for_step(cfg, mesh, t)), (lambda: None)
+    if cfg.data != "native":
+        raise ValueError(
+            f"unknown data source {cfg.data!r}; want synthetic|native"
+        )
+    from tpu_patterns.io import NativeLoader
+
+    loader = NativeLoader(cfg.seed, (cfg.batch, cfg.seq, cfg.embed))
+    loader.seek(start)
+
+    def get_batch(t: int) -> jax.Array:
+        arr, step = loader.next()
+        if step != t:  # defensive: a caller skipped steps
+            loader.seek(t)
+            arr, step = loader.next()
+        # SYNCHRONOUS host copy out of the ring view: jnp.asarray can be
+        # zero-copy on CPU backends and transfers are async, so anything
+        # short of an eager np.array would let the ring slot be recycled
+        # while the step's compute still reads it
+        x = np.array(arr, dtype=jnp.dtype(cfg.dtype))
+        return jax.device_put(x, NamedSharding(mesh, P("dp", "sp", None)))
+
+    return get_batch, loader.close
 
 
 def train(mesh: Mesh, cfg: TrainLoopConfig, writer=None) -> dict:
@@ -202,21 +238,25 @@ def train(mesh: Mesh, cfg: TrainLoopConfig, writer=None) -> dict:
         start = int(np.asarray(tree["step"]))
 
     loss = None
+    get_batch, close_source = _make_batch_source(cfg, mesh, start)
     t0 = time.perf_counter()
-    for t in range(start, cfg.steps):
-        x = _batch_for_step(cfg, mesh, t)
-        new_state, loss = one(
-            {k: v for k, v in tree.items() if k != "step"}, x
-        )
-        tree = dict(new_state, step=jnp.asarray(t + 1, jnp.int32))
-        if (
-            cfg.ckpt_dir
-            and cfg.ckpt_every > 0
-            and (t + 1) % cfg.ckpt_every == 0
-        ):
-            jax.block_until_ready(tree)
-            ckpt.save(cfg.ckpt_dir, t + 1, tree, keep=cfg.keep)
-    jax.block_until_ready(tree)
+    try:
+        for t in range(start, cfg.steps):
+            x = get_batch(t)
+            new_state, loss = one(
+                {k: v for k, v in tree.items() if k != "step"}, x
+            )
+            tree = dict(new_state, step=jnp.asarray(t + 1, jnp.int32))
+            if (
+                cfg.ckpt_dir
+                and cfg.ckpt_every > 0
+                and (t + 1) % cfg.ckpt_every == 0
+            ):
+                jax.block_until_ready(tree)
+                ckpt.save(cfg.ckpt_dir, t + 1, tree, keep=cfg.keep)
+        jax.block_until_ready(tree)
+    finally:
+        close_source()
     elapsed = time.perf_counter() - t0
     ran = cfg.steps - start
     out = {
