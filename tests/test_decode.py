@@ -303,6 +303,98 @@ class TestRollout:
         )
 
 
+class TestRagged:
+    @pytest.mark.parametrize("rope", [False, True])
+    def test_ragged_decode_matches_per_row_forward(self, devices, rope):
+        # rows with DIFFERENT prompt lengths (right-padded): teacher-
+        # forced decode of row b at gen step n must equal the plain
+        # causal forward of that row's own unpadded sequence at position
+        # lens[b] + n.  rope=True makes positions load-bearing.
+        from tpu_patterns.models.transformer import forward_shard
+
+        mesh = Mesh(
+            np.array(devices[:8]).reshape(2, 2, 2), ("dp", "sp", "tp")
+        )
+        cfg = ModelConfig(**CFG, dtype="float32", causal=True, rope=rope)
+        b, lp, gen = 4, 16, 4
+        lens_np = np.array([16, 11, 8, 3], np.int32)
+        params = _stacked_params(jax.random.key(0), cfg)
+        flat = {k: v[0] for k, v in params.items()}
+        x = jax.random.normal(
+            jax.random.key(1), (b, lp + gen, cfg.embed), jnp.float32
+        )
+        # per-row reference: forward of the row's own contiguous stream
+        # (prompt tokens then the teacher-forced continuation tokens)
+        want = np.zeros((b, lp + gen, cfg.embed), np.float32)
+        for row in range(b):
+            ln = int(lens_np[row])
+            seq = jnp.concatenate(
+                [x[row, :ln], x[row, lp:lp + gen]], axis=0
+            )[None]
+            want[row, :ln + gen] = np.asarray(
+                forward_shard(flat, seq, cfg)
+            )[0]
+
+        prefill, generate = make_decoder(mesh, cfg, b, lp, gen)
+        sp_params = jax.device_put(
+            params,
+            {k: NamedSharding(mesh, s)
+             for k, s in _stacked_specs(cfg).items()},
+        )
+        xp = jax.device_put(
+            x[:, :lp], NamedSharding(mesh, P("dp", "sp", None))
+        )
+        lens = jax.device_put(
+            jnp.asarray(lens_np), NamedSharding(mesh, P("dp"))
+        )
+        caches, y0 = prefill(sp_params, xp, lens)
+        # y0 = each row's output at its own last valid position
+        for row in range(b):
+            np.testing.assert_allclose(
+                np.asarray(y0)[row, 0],
+                want[row, lens_np[row] - 1],
+                rtol=0, atol=1e-5,
+            )
+        c = caches
+        for n in range(gen):
+            tok = jax.device_put(
+                x[:, lp + n:lp + n + 1],
+                NamedSharding(mesh, P("dp", None, None)),
+            )
+            c, ys = generate(sp_params, c, tok, (lens, n), 1)
+            for row in range(b):
+                np.testing.assert_allclose(
+                    np.asarray(ys)[row, 0],
+                    want[row, lens_np[row] + n],
+                    rtol=0, atol=1e-5,
+                    err_msg=f"row {row} gen step {n}",
+                )
+
+    def test_ragged_selffeeding_rollout_finite(self, devices):
+        mesh = Mesh(
+            np.array(devices[:4]).reshape(2, 2, 1), ("dp", "sp", "tp")
+        )
+        cfg = ModelConfig(**CFG, dtype="float32", rope=True)
+        b, lp, gen = 2, 8, 4
+        prefill, generate = make_decoder(mesh, cfg, b, lp, gen)
+        params = jax.device_put(
+            _stacked_params(jax.random.key(0), cfg),
+            {k: NamedSharding(mesh, s)
+             for k, s in _stacked_specs(cfg).items()},
+        )
+        x = jax.device_put(
+            jax.random.normal(jax.random.key(1), (b, lp, cfg.embed)),
+            NamedSharding(mesh, P("dp", "sp", None)),
+        )
+        lens = jax.device_put(
+            jnp.asarray([8, 5], jnp.int32), NamedSharding(mesh, P("dp"))
+        )
+        caches, y0 = prefill(params, x, lens)
+        _, ys = generate(params, caches, y0, (lens, 0), gen)
+        assert ys.shape == (b, gen, cfg.embed)
+        assert np.isfinite(np.asarray(ys)).all()
+
+
 class TestRunDecode:
     def test_measured_pattern_succeeds(self, mesh3d, capsys):
         from tpu_patterns.core.results import ResultWriter

@@ -114,11 +114,43 @@ class _CacheLayout:
         )
         return jnp.concatenate([prompt, gen])
 
-    def write_offset(self, t, sp_axis: str | None):
-        """(local slot, valid) for a decode write at global position t."""
+    def write_offset_gen(self, n, sp_axis: str | None):
+        """(local slot, valid) for the n-th GENERATED token.
+
+        Keyed by generation index, not global position: under ragged
+        lengths every row writes its n-th token into the SAME slot (the
+        rows' positions differ, their gen indices do not) — which is
+        what keeps ragged cache writes a single shared
+        dynamic_update_slice instead of a per-row scatter.
+        """
         r = lax.axis_index(sp_axis) if sp_axis is not None else 0
-        rel = t - self.prefill - r * self.lg_loc
+        rel = n - r * self.lg_loc
         return self.lp_loc + rel, (rel >= 0) & (rel < self.lg_loc)
+
+    def slot_meta(self, sp_axis: str | None):
+        """(prompt_pos, gen_index, is_gen), each [lc_loc].
+
+        Prompt slots carry their (shared) global position; gen slots
+        carry their generation index.  Together with per-row lengths
+        these give the ragged visibility rule in closed form:
+        a prompt slot is visible to row b iff prompt_pos < lens[b]
+        (right-padded prompts: padding slots sit at positions >= len and
+        vanish), a gen slot iff gen_index <= the current step.
+        """
+        r = lax.axis_index(sp_axis) if sp_axis is not None else 0
+        prompt_pos = jnp.concatenate([
+            r * self.lp_loc + jnp.arange(self.lp_loc, dtype=jnp.int32),
+            jnp.full((self.lg_loc,), jnp.iinfo(jnp.int32).max, jnp.int32),
+        ])
+        gen_index = jnp.concatenate([
+            jnp.full((self.lp_loc,), jnp.iinfo(jnp.int32).max, jnp.int32),
+            r * self.lg_loc + jnp.arange(self.lg_loc, dtype=jnp.int32),
+        ])
+        is_gen = jnp.concatenate([
+            jnp.zeros((self.lp_loc,), bool),
+            jnp.ones((self.lg_loc,), bool),
+        ])
+        return prompt_pos, gen_index, is_gen
 
 
 def _prefill_layer(
@@ -176,10 +208,12 @@ def _prefill_layer(
             layout="contiguous",
         ).reshape(lp, b, h, d).transpose(1, 0, 2, 3)
     else:
+        # pure causal by global positions; with right-padded ragged
+        # prompts no length mask is needed here — padding sits at
+        # positions >= every valid query's, so causality hides it
         q_pos = jnp.arange(layout.lp_loc, dtype=jnp.int32)
-        attn = _distributed_attention(
-            q, cache_k, cache_v, q_pos, layout.kv_positions(None), None
-        )
+        mask = (layout.kv_positions(None)[None, :] <= q_pos[:, None])[None]
+        attn = _distributed_attention(q, cache_k, cache_v, mask, None)
     o = jnp.einsum("blhd,hde->ble", attn, params["wo"])
     if tp_axis is not None:
         o = lax.psum(o, tp_axis)
@@ -187,26 +221,24 @@ def _prefill_layer(
     return _mlp(params, y, tp_axis), cache_k, cache_v
 
 
-def _distributed_attention(q, cache_k, cache_v, q_pos, kv_pos, sp_axis):
+def _distributed_attention(q, cache_k, cache_v, mask, sp_axis):
     """Masked softmax attention of q against the sp-sharded cache.
 
-    q: [B, Lq, H, D] with global query positions ``q_pos`` [Lq];
-    caches: [B, Hkv, lc_loc, D] whose slots sit at global positions
-    ``kv_pos`` [lc_loc].  With GQA, Hkv < H and each cached head serves
-    H/Hkv contiguous query heads — the einsums group q as
-    [B, Lq, Hkv, g, D] so the small cache is read ONCE, never broadcast
-    to H heads in HBM.  Causal: slot p visible to query qp iff p <= qp
-    (unwritten slots carry future positions, so they are masked for
-    free).  Stable online-softmax combine across sp: pmax for the
-    running max, psum for normalizer and weighted values.
+    q: [B, Lq, H, D]; caches: [B, Hkv, lc_loc, D]; ``mask``
+    [B or 1, Lq, lc_loc] says which local slots each query may see
+    (callers encode causality / per-row lengths / unwritten slots).
+    With GQA, Hkv < H and each cached head serves H/Hkv contiguous
+    query heads — the einsums group q as [B, Lq, Hkv, g, D] so the
+    small cache is read ONCE, never broadcast to H heads in HBM.
+    Stable online-softmax combine across sp: pmax for the running max,
+    psum for normalizer and weighted values.
     """
     b, lq, h, d = q.shape
     hkv = cache_k.shape[1]
     g = h // hkv
     qg = q.reshape(b, lq, hkv, g, d)
     s = jnp.einsum("bqkgd,bkld->bkgql", qg, cache_k) * (d ** -0.5)
-    mask = kv_pos[None, :] <= q_pos[:, None]  # [Lq, lc_loc]
-    s = jnp.where(mask[None, None, None], s, _neg_inf(s.dtype))
+    s = jnp.where(mask[:, None, None], s, _neg_inf(s.dtype))
     m = jnp.max(s, axis=-1, keepdims=True)
     if sp_axis is not None:
         m = lax.pmax(m, sp_axis)
@@ -225,21 +257,26 @@ def _distributed_attention(q, cache_k, cache_v, q_pos, kv_pos, sp_axis):
 
 
 def _decode_layer(
-    params, x, cache_k, cache_v, t, layout, cfg, sp_axis, tp_axis
+    params, x, cache_k, cache_v, lens, n, layout, cfg, sp_axis, tp_axis
 ):
-    """One layer for ONE new token at global position t.
+    """One layer for each row's n-th GENERATED token.
 
-    x: [B, 1, E] (sp-replicated); caches [B, Hkv, lc_loc, D].  Writes
-    k/v into the gen segment on the owning sp rank, attends over [0, t],
-    returns the block output.
+    x: [B, 1, E] (sp-replicated); caches [B, Hkv, lc_loc, D];
+    ``lens`` [B] per-row prompt lengths (ragged — lockstep is the
+    special case of equal lens); ``n`` the shared generation index.
+    Row b's token sits at global position lens[b] + n but is written to
+    the SHARED slot for gen index n (layout.write_offset_gen) — ragged
+    positions, uniform writes.  Visibility per row: prompt slots with
+    position < lens[b] (right-padding vanishes), gen slots with index
+    <= n.
     """
     q, k, v = qkv_native(params, x)
     if cfg.rope:
-        pos = jnp.reshape(t, (1,)).astype(jnp.int32)
+        pos = (lens + n).astype(jnp.int32)[:, None]  # [B, 1] per row
         cos, sin = rope_tables(pos, cfg.head_dim, cfg.rope_theta, q.dtype)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-    off, valid = layout.write_offset(t, sp_axis)
+    off, valid = layout.write_offset_gen(n, sp_axis)
     kt = k.transpose(0, 2, 1, 3)  # [B, Hkv, 1, D]
     vt = v.transpose(0, 2, 1, 3)
     # dynamic_update_slice clamps the start index; the select keeps the
@@ -249,11 +286,14 @@ def _decode_layer(
     cache_k = jnp.where(valid, ck, cache_k)
     cache_v = jnp.where(valid, cv, cache_v)
 
+    prompt_pos, gen_index, is_gen = layout.slot_meta(sp_axis)
+    mask = jnp.where(
+        is_gen[None, :],
+        gen_index[None, :] <= n,
+        prompt_pos[None, :] < lens[:, None],
+    )  # [B, lc_loc]
     out = _distributed_attention(
-        q, cache_k, cache_v,
-        jnp.reshape(t, (1,)).astype(jnp.int32),
-        layout.kv_positions(sp_axis),
-        sp_axis,
+        q, cache_k, cache_v, mask[:, None, :], sp_axis
     )
     o = jnp.einsum("blhd,hde->ble", out, params["wo"])
     if tp_axis is not None:
@@ -267,19 +307,24 @@ def make_decoder(
 ):
     """Build the jitted (prefill, generate) pair over a dp x sp x tp mesh.
 
-    * ``prefill(params, x) -> (caches, y_last)``: run the prompt
-      [batch, prefill_len, E] through every layer, filling each rank's
-      prompt segment; returns the caches and the LAST prompt position's
-      block output [batch, 1, E] (the first decode input).
+    * ``prefill(params, x, lens=None) -> (caches, y_last)``: run the
+      (right-padded) prompt [batch, prefill_len, E] through every layer,
+      filling each rank's prompt segment; ``lens`` [batch] gives per-row
+      true prompt lengths (None = all prefill_len).  Returns the caches
+      and each row's LAST VALID position's block output [batch, 1, E]
+      (the first decode input).
     * ``generate(params, caches, y0, t0, n_steps) -> (caches, ys)``:
       scan n_steps of self-feeding decode; ys: [batch, n_steps, E].
-      Total decoded positions must stay within ``gen_cap`` — a write
-      past capacity is silently dropped (the slot select never fires).
+      ``t0`` is either a scalar global position (lockstep: every row at
+      t0, i.e. lens = prefill_len and n0 = t0 - prefill_len generated
+      already) or a tuple ``(lens, n0)`` for ragged rows.  Generated
+      positions must stay within ``gen_cap`` — a write past capacity is
+      silently dropped (the slot select never fires).
 
     Caches are stacked [depth, B, H, lc, D], sharded
     P(None, dp, tp, sp, None) over the two-segment layout
     (:class:`_CacheLayout`).  ``n_steps`` is static (compiled into the
-    scan); t0 is a traced scalar.
+    scan); lens/n0 are traced.
     """
     if cfg.moe:
         raise NotImplementedError("decode pattern covers the dense block")
@@ -294,7 +339,7 @@ def make_decoder(
     pspecs = _stacked_specs(cfg)
     cache_spec = P(None, "dp", "tp", "sp", None)
 
-    def prefill_shard(params, x):
+    def prefill_shard(params, x, lens):
         def layer(carry, xs):
             y = carry
             p_l, ck_l, cv_l = xs
@@ -308,51 +353,60 @@ def make_decoder(
         shape = (depth, x.shape[0], hkv, layout.lc_loc, cfg.head_dim)
         zeros = jnp.zeros(shape, x.dtype)
         y, (ck, cv) = lax.scan(layer, x, (params, zeros, zeros))
-        # the last GLOBAL prompt position's output lives on the last sp
-        # rank's local tail; broadcast it to every rank (decode inputs
-        # are sp-replicated)
-        y_last = y[:, -1:, :]
+        # each row's LAST VALID position (lens[b]-1) lives on rank
+        # (lens[b]-1)//lp_loc; per-row gather + psum-select broadcasts it
+        # to every rank (decode inputs are sp-replicated)
+        r = lax.axis_index(sp_axis) if sp_axis is not None else 0
+        idx = lens - 1 - r * layout.lp_loc  # [B] local index of last tok
+        valid = (idx >= 0) & (idx < layout.lp_loc)
+        gathered = jnp.take_along_axis(
+            y, jnp.clip(idx, 0, layout.lp_loc - 1)[:, None, None], axis=1
+        )  # [B, 1, E]
+        y_last = jnp.where(valid[:, None, None], gathered, 0)
         if sp_axis is not None:
-            # psum-select: only the last rank contributes
-            is_last = lax.axis_index(sp_axis) == sp - 1
-            y_last = lax.psum(
-                jnp.where(is_last, y_last, jnp.zeros_like(y_last)), sp_axis
-            )
+            y_last = lax.psum(y_last, sp_axis)
         return (ck, cv), y_last
 
-    def generate_shard(params, caches, y0, t0, *, n_steps):
+    def generate_shard(params, caches, y0, lens, n0, *, n_steps):
         ck, cv = caches
 
         def step(carry, _):
-            ck, cv, y, t = carry
+            ck, cv, y, n = carry
 
             def layer(c2, xs):
                 yy = c2
                 p_l, ck_l, cv_l = xs
                 yy, ck_l, cv_l = _decode_layer(
-                    p_l, yy, ck_l, cv_l, t, layout, cfg, sp_axis, tp_axis
+                    p_l, yy, ck_l, cv_l, lens, n, layout, cfg,
+                    sp_axis, tp_axis,
                 )
                 return yy, (ck_l, cv_l)
 
             y2, (ck, cv) = lax.scan(layer, y, (params, ck, cv))
-            return (ck, cv, y2, t + 1), y2[:, 0, :]
+            return (ck, cv, y2, n + 1), y2[:, 0, :]
 
         (ck, cv, _, _), ys = lax.scan(
-            step, (ck, cv, y0, t0), None, length=n_steps
+            step, (ck, cv, y0, n0), None, length=n_steps
         )
         return (ck, cv), ys.transpose(1, 0, 2)  # [B, n_steps, E]
 
     x_spec = P("dp", "sp", None)
     tok_spec = P("dp", None, None)
-    prefill = jax.jit(
+    lens_spec = P("dp")
+    prefill_jit = jax.jit(
         jax.shard_map(
             prefill_shard,
             mesh=mesh,
-            in_specs=(pspecs, x_spec),
+            in_specs=(pspecs, x_spec, lens_spec),
             out_specs=((cache_spec, cache_spec), tok_spec),
             check_vma=False,  # y_last is made sp-invariant by the psum
         )
     )
+
+    def prefill(params, x, lens=None):
+        if lens is None:
+            lens = jnp.full((batch,), prefill_len, jnp.int32)
+        return prefill_jit(params, x, jnp.asarray(lens, jnp.int32))
 
     @functools.lru_cache(maxsize=None)
     def _gen_compiled(n_steps: int):
@@ -362,14 +416,26 @@ def make_decoder(
             jax.shard_map(
                 functools.partial(generate_shard, n_steps=n_steps),
                 mesh=mesh,
-                in_specs=(pspecs, (cache_spec, cache_spec), tok_spec, P()),
+                in_specs=(
+                    pspecs, (cache_spec, cache_spec), tok_spec,
+                    lens_spec, P(),
+                ),
                 out_specs=((cache_spec, cache_spec), tok_spec),
                 check_vma=False,
             ),
         )
 
     def _gen(params, caches, y0, t0, n_steps):
-        return _gen_compiled(int(n_steps))(params, caches, y0, t0)
+        if isinstance(t0, tuple):
+            lens, n0 = t0
+            lens = jnp.asarray(lens, jnp.int32)
+        else:
+            # scalar global position: lockstep rows, all at full prefill
+            lens = jnp.full((batch,), prefill_len, jnp.int32)
+            n0 = jnp.asarray(t0, jnp.int32) - prefill_len
+        return _gen_compiled(int(n_steps))(
+            params, caches, y0, lens, jnp.asarray(n0, jnp.int32)
+        )
 
     return prefill, _gen
 

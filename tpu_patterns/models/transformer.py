@@ -176,26 +176,31 @@ def qkv_native(params: dict, x: jax.Array):
 def rope_tables(
     positions: jax.Array, head_dim: int, theta: float, dtype
 ) -> tuple[jax.Array, jax.Array]:
-    """(cos, sin) [L, D/2] for the given GLOBAL token positions.
+    """(cos, sin) angle tables for GLOBAL token positions.
 
-    Computed in f32 (theta**(2i/D) spans orders of magnitude bf16 cannot
-    hold) and cast at the end."""
+    positions may be [L] (one sequence grid, shared over batch) or
+    [B, L] (per-row positions — ragged decode); the tables get shape
+    ``positions.shape + (D/2,)``.  Computed in f32 (theta**(2i/D) spans
+    orders of magnitude bf16 cannot hold) and cast at the end."""
     if head_dim % 2:
         raise ValueError(f"rope needs an even head_dim, got {head_dim}")
     inv_freq = theta ** (
         -jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
     )
-    ang = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    ang = positions.astype(jnp.float32)[..., None] * inv_freq
     return jnp.cos(ang).astype(dtype), jnp.sin(ang).astype(dtype)
 
 
 def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
-    """Rotate [B, L, H, D] by per-position angles ([L, D/2] tables),
-    pairing dimension halves (x1, x2) -> (x1 c - x2 s, x2 c + x1 s)."""
+    """Rotate [B, L, H, D] by per-position angles, pairing dimension
+    halves (x1, x2) -> (x1 c - x2 s, x2 c + x1 s).  Tables are [L, D/2]
+    (shared over batch) or [B, L, D/2] (per-row)."""
     half = x.shape[-1] // 2
     x1, x2 = x[..., :half], x[..., half:]
-    c = cos[None, :, None, :]
-    s = sin[None, :, None, :]
+    if cos.ndim == 2:
+        c, s = cos[None, :, None, :], sin[None, :, None, :]
+    else:
+        c, s = cos[:, :, None, :], sin[:, :, None, :]
     return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
 
 
