@@ -127,8 +127,8 @@ class TestGQA:
                 jax.random.normal(jax.random.key(1), (b, lp, cfg.embed)),
                 NamedSharding(mesh, P("dp", "sp", None)),
             )
-            (ck, _), _ = prefill(params, x)
-            sizes[kv] = ck.size
+            caches, _ = prefill(params, x)
+            sizes[kv] = caches["k"].size
         assert sizes[2] * 4 == sizes[0]  # 8 heads -> 2 kv heads
 
     def test_indivisible_kv_heads_fail_fast(self, devices):
@@ -301,6 +301,60 @@ class TestRollout:
         np.testing.assert_allclose(
             got, np.asarray(ys_once), rtol=0, atol=1e-6
         )
+
+
+class TestInt8Cache:
+    def test_quantize_roundtrip_error_bounded(self):
+        from tpu_patterns.models.decode import _quantize_kv
+
+        x = jax.random.normal(jax.random.key(0), (2, 4, 16, 32))
+        q, s = _quantize_kv(x)
+        assert q.dtype == jnp.int8 and s.shape == (2, 4, 16)
+        deq = np.asarray(q, np.float32) * np.asarray(s)[..., None]
+        err = np.abs(deq - np.asarray(x))
+        bound = np.asarray(s)[..., None] * 0.5 + 1e-7
+        assert (err <= bound).all()
+
+    @pytest.mark.parametrize("kv,rope", [(0, False), (2, True)])
+    def test_int8_gate_passes_and_float_tolerance_fails_nothing(
+        self, devices, kv, rope
+    ):
+        # the quantized cache path must stay within the quantization
+        # error bound of the training forward, across sp/tp and with
+        # GQA + rope composed in
+        mesh = Mesh(
+            np.array(devices[:8]).reshape(2, 2, 2), ("dp", "sp", "tp")
+        )
+        cfg = ModelConfig(**CFG, depth=2, kv_heads=kv, rope=rope)
+        assert _teacher_forcing_gate(mesh, cfg, cache_int8=True)
+
+    def test_int8_cache_dtype_and_scales_present(self, devices):
+        mesh = Mesh(
+            np.array(devices[:4]).reshape(1, 2, 2), ("dp", "sp", "tp")
+        )
+        cfg = ModelConfig(**CFG, dtype="float32")
+        b, lp, gen = 2, 8, 4
+        prefill, generate = make_decoder(
+            mesh, cfg, b, lp, gen, cache_int8=True
+        )
+        params = jax.device_put(
+            _stacked_params(jax.random.key(0), cfg),
+            {k: NamedSharding(mesh, s)
+             for k, s in _stacked_specs(cfg).items()},
+        )
+        x = jax.device_put(
+            jax.random.normal(jax.random.key(1), (b, lp, cfg.embed)),
+            NamedSharding(mesh, P("dp", "sp", None)),
+        )
+        caches, y0 = prefill(params, x)
+        assert caches["k"].dtype == jnp.int8
+        assert caches["ks"].dtype == jnp.float32
+        # int8 k/v + f32 scales: byte footprint ~ (1 + 4/D) per element
+        kv_bytes = caches["k"].size + caches["ks"].size * 4
+        float_bytes = caches["k"].size * 4
+        assert kv_bytes < float_bytes / 2
+        _, ys = generate(params, caches, y0, jnp.asarray(lp), gen)
+        assert np.isfinite(np.asarray(ys)).all()
 
 
 class TestRagged:

@@ -70,6 +70,20 @@ def _stacked_specs(cfg: ModelConfig) -> dict[str, P]:
     return {k: P(None, *tuple(s)) for k, (_, s) in flat.items()}
 
 
+def _quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-slot symmetric int8: x [..., L, D] -> (int8 values, f32 scale
+    [..., L]).  One scale per (row, head, slot) over the D lanes — the
+    granularity that keeps dequant a cheap per-slot multiply AFTER the
+    score einsum, so the int8 cache is read directly by the matmul and
+    never materialized at full precision."""
+    s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0
+    s = jnp.maximum(s, 1e-8)
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / s[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, s
+
+
 def _mlp(params, y, tp_axis):
     hidden = jax.nn.relu(jnp.einsum("ble,ef->blf", y, params["w1"]))
     m = jnp.einsum("blf,fe->ble", hidden, params["w2"])
@@ -153,13 +167,40 @@ class _CacheLayout:
         return prompt_pos, gen_index, is_gen
 
 
-def _prefill_layer(
-    params, x, cache_k, cache_v, layout, cfg, sp_axis, tp_axis
-):
+def _cache_write(cache: dict, kt, vt, off) -> dict:
+    """Write k/v [B, Hkv, Lw, D] at local slot ``off``; quantizing on the
+    way in when the cache is int8 (scales stored per slot alongside)."""
+    if "ks" in cache:
+        kq, ks = _quantize_kv(kt)
+        vq, vs = _quantize_kv(vt)
+        return {
+            "k": lax.dynamic_update_slice(cache["k"], kq, (0, 0, off, 0)),
+            "v": lax.dynamic_update_slice(cache["v"], vq, (0, 0, off, 0)),
+            "ks": lax.dynamic_update_slice(cache["ks"], ks, (0, 0, off)),
+            "vs": lax.dynamic_update_slice(cache["vs"], vs, (0, 0, off)),
+        }
+    return {
+        "k": lax.dynamic_update_slice(
+            cache["k"], kt.astype(cache["k"].dtype), (0, 0, off, 0)
+        ),
+        "v": lax.dynamic_update_slice(
+            cache["v"], vt.astype(cache["v"].dtype), (0, 0, off, 0)
+        ),
+    }
+
+
+def _cache_attend(cache: dict, q, mask, sp_axis):
+    return _distributed_attention(
+        q, cache["k"], cache["v"], mask, sp_axis,
+        k_scale=cache.get("ks"), v_scale=cache.get("vs"),
+    )
+
+
+def _prefill_layer(params, x, cache, layout, cfg, sp_axis, tp_axis):
     """One layer over the FULL prompt shard: compute k/v for every prompt
     position, write them into segment 0 of the local cache, and return
     the layer output.  x: [B, lp_loc, E] (sequence sp-sharded, like
-    training); caches: [B, H_local, lc_loc, D].
+    training); cache leaves: [B, H_local, lc_loc, ...].
 
     Prefill queries are sp-VARYING (each rank owns different prompt
     positions), so the replicated-query psum combine used at decode time
@@ -182,8 +223,7 @@ def _prefill_layer(
         k = apply_rope(k, cos, sin)
     kt = k.transpose(0, 2, 1, 3)  # [B, Hkv, lp_loc, D]
     vt = v.transpose(0, 2, 1, 3)
-    cache_k = lax.dynamic_update_slice(cache_k, kt, (0, 0, 0, 0))
-    cache_v = lax.dynamic_update_slice(cache_v, vt, (0, 0, 0, 0))
+    cache = _cache_write(cache, kt, vt, 0)
 
     # prefill attention runs at full H heads: GQA k/v broadcast for the
     # one-shot ring pass (the PERSISTENT cache above stays at Hkv)
@@ -210,18 +250,22 @@ def _prefill_layer(
     else:
         # pure causal by global positions; with right-padded ragged
         # prompts no length mask is needed here — padding sits at
-        # positions >= every valid query's, so causality hides it
+        # positions >= every valid query's, so causality hides it.
+        # NOTE: reads the cache (quantized if int8), so single-rank
+        # prefill sees exactly what decode will see
         q_pos = jnp.arange(layout.lp_loc, dtype=jnp.int32)
         mask = (layout.kv_positions(None)[None, :] <= q_pos[:, None])[None]
-        attn = _distributed_attention(q, cache_k, cache_v, mask, None)
+        attn = _cache_attend(cache, q, mask, None)
     o = jnp.einsum("blhd,hde->ble", attn, params["wo"])
     if tp_axis is not None:
         o = lax.psum(o, tp_axis)
     y = x + o
-    return _mlp(params, y, tp_axis), cache_k, cache_v
+    return _mlp(params, y, tp_axis), cache
 
 
-def _distributed_attention(q, cache_k, cache_v, mask, sp_axis):
+def _distributed_attention(
+    q, cache_k, cache_v, mask, sp_axis, k_scale=None, v_scale=None
+):
     """Masked softmax attention of q against the sp-sharded cache.
 
     q: [B, Lq, H, D]; caches: [B, Hkv, lc_loc, D]; ``mask``
@@ -230,14 +274,20 @@ def _distributed_attention(q, cache_k, cache_v, mask, sp_axis):
     With GQA, Hkv < H and each cached head serves H/Hkv contiguous
     query heads — the einsums group q as [B, Lq, Hkv, g, D] so the
     small cache is read ONCE, never broadcast to H heads in HBM.
-    Stable online-softmax combine across sp: pmax for the running max,
-    psum for normalizer and weighted values.
+    With an int8 cache, ``k_scale``/``v_scale`` [B, Hkv, lc_loc] fold
+    the dequant in AFTER the einsums (scores scaled per slot; v's scale
+    folded into the probabilities) — the quantized cache feeds the
+    matmul directly.  Stable online-softmax combine across sp: pmax for
+    the running max, psum for normalizer and weighted values.
     """
     b, lq, h, d = q.shape
     hkv = cache_k.shape[1]
     g = h // hkv
     qg = q.reshape(b, lq, hkv, g, d)
-    s = jnp.einsum("bqkgd,bkld->bkgql", qg, cache_k) * (d ** -0.5)
+    ck = cache_k.astype(q.dtype) if cache_k.dtype == jnp.int8 else cache_k
+    s = jnp.einsum("bqkgd,bkld->bkgql", qg, ck) * (d ** -0.5)
+    if k_scale is not None:
+        s = s * k_scale[:, :, None, None, :].astype(s.dtype)
     s = jnp.where(mask[:, None, None], s, _neg_inf(s.dtype))
     m = jnp.max(s, axis=-1, keepdims=True)
     if sp_axis is not None:
@@ -247,7 +297,10 @@ def _distributed_attention(q, cache_k, cache_v, mask, sp_axis):
     m = jnp.maximum(m, _neg_inf(s.dtype) / 2)
     p = jnp.exp(s - m)
     denom = jnp.sum(p, axis=-1, keepdims=True)  # [B, Hkv, g, Lq, 1]
-    numer = jnp.einsum("bkgql,bkld->bkgqd", p, cache_v)
+    if v_scale is not None:
+        p = p * v_scale[:, :, None, None, :].astype(p.dtype)
+    cv = cache_v.astype(p.dtype) if cache_v.dtype == jnp.int8 else cache_v
+    numer = jnp.einsum("bkgql,bkld->bkgqd", p, cv)
     if sp_axis is not None:
         denom = lax.psum(denom, sp_axis)
         numer = lax.psum(numer, sp_axis)
@@ -256,12 +309,10 @@ def _distributed_attention(q, cache_k, cache_v, mask, sp_axis):
     return out.transpose(0, 3, 1, 2, 4).reshape(b, lq, h, d)
 
 
-def _decode_layer(
-    params, x, cache_k, cache_v, lens, n, layout, cfg, sp_axis, tp_axis
-):
+def _decode_layer(params, x, cache, lens, n, layout, cfg, sp_axis, tp_axis):
     """One layer for each row's n-th GENERATED token.
 
-    x: [B, 1, E] (sp-replicated); caches [B, Hkv, lc_loc, D];
+    x: [B, 1, E] (sp-replicated); cache leaves [B, Hkv, lc_loc, ...];
     ``lens`` [B] per-row prompt lengths (ragged — lockstep is the
     special case of equal lens); ``n`` the shared generation index.
     Row b's token sits at global position lens[b] + n but is written to
@@ -281,10 +332,10 @@ def _decode_layer(
     vt = v.transpose(0, 2, 1, 3)
     # dynamic_update_slice clamps the start index; the select keeps the
     # write only on the owning rank (SPMD — no rank-dependent control flow)
-    ck = lax.dynamic_update_slice(cache_k, kt, (0, 0, off, 0))
-    cv = lax.dynamic_update_slice(cache_v, vt, (0, 0, off, 0))
-    cache_k = jnp.where(valid, ck, cache_k)
-    cache_v = jnp.where(valid, cv, cache_v)
+    written = _cache_write(cache, kt, vt, off)
+    cache = jax.tree.map(
+        lambda new, old: jnp.where(valid, new, old), written, cache
+    )
 
     prompt_pos, gen_index, is_gen = layout.slot_meta(sp_axis)
     mask = jnp.where(
@@ -292,18 +343,21 @@ def _decode_layer(
         gen_index[None, :] <= n,
         prompt_pos[None, :] < lens[:, None],
     )  # [B, lc_loc]
-    out = _distributed_attention(
-        q, cache_k, cache_v, mask[:, None, :], sp_axis
-    )
+    out = _cache_attend(cache, q, mask[:, None, :], sp_axis)
     o = jnp.einsum("blhd,hde->ble", out, params["wo"])
     if tp_axis is not None:
         o = lax.psum(o, tp_axis)
     y = x + o
-    return _mlp(params, y, tp_axis), cache_k, cache_v
+    return _mlp(params, y, tp_axis), cache
 
 
 def make_decoder(
-    mesh: Mesh, cfg: ModelConfig, batch: int, prefill_len: int, gen_cap: int
+    mesh: Mesh,
+    cfg: ModelConfig,
+    batch: int,
+    prefill_len: int,
+    gen_cap: int,
+    cache_int8: bool = False,
 ):
     """Build the jitted (prefill, generate) pair over a dp x sp x tp mesh.
 
@@ -321,10 +375,12 @@ def make_decoder(
       positions must stay within ``gen_cap`` — a write past capacity is
       silently dropped (the slot select never fires).
 
-    Caches are stacked [depth, B, H, lc, D], sharded
-    P(None, dp, tp, sp, None) over the two-segment layout
-    (:class:`_CacheLayout`).  ``n_steps`` is static (compiled into the
-    scan); lens/n0 are traced.
+    Caches are dicts of stacked [depth, B, H, lc, ...] leaves, sharded
+    P(None, dp, tp, sp, ...) over the two-segment layout
+    (:class:`_CacheLayout`).  ``cache_int8=True`` stores K/V as int8
+    with per-slot f32 scales ("ks"/"vs" leaves) — 4x (vs f32) / 2x (vs
+    bf16) less cache HBM, dequant folded into the attention einsums.
+    ``n_steps`` is static (compiled into the scan); lens/n0 are traced.
     """
     if cfg.moe:
         raise NotImplementedError("decode pattern covers the dense block")
@@ -337,22 +393,40 @@ def make_decoder(
     sp_axis = "sp" if sp > 1 else None
     tp_axis = "tp" if int(mesh.shape["tp"]) > 1 else None
     pspecs = _stacked_specs(cfg)
-    cache_spec = P(None, "dp", "tp", "sp", None)
+    kv_spec = P(None, "dp", "tp", "sp", None)
+    cache_specs = {"k": kv_spec, "v": kv_spec}
+    if cache_int8:
+        scale_spec = P(None, "dp", "tp", "sp")
+        cache_specs.update({"ks": scale_spec, "vs": scale_spec})
+
+    def _zero_cache(depth, b_loc, dtype):
+        hkv = (cfg.kv_heads or cfg.heads) // int(mesh.shape["tp"])
+        kv_shape = (depth, b_loc, hkv, layout.lc_loc, cfg.head_dim)
+        if cache_int8:
+            sc_shape = kv_shape[:-1]
+            return {
+                "k": jnp.zeros(kv_shape, jnp.int8),
+                "v": jnp.zeros(kv_shape, jnp.int8),
+                "ks": jnp.zeros(sc_shape, jnp.float32),
+                "vs": jnp.zeros(sc_shape, jnp.float32),
+            }
+        return {
+            "k": jnp.zeros(kv_shape, dtype),
+            "v": jnp.zeros(kv_shape, dtype),
+        }
 
     def prefill_shard(params, x, lens):
         def layer(carry, xs):
             y = carry
-            p_l, ck_l, cv_l = xs
-            y, ck_l, cv_l = _prefill_layer(
-                p_l, y, ck_l, cv_l, layout, cfg, sp_axis, tp_axis
+            p_l, c_l = xs
+            y, c_l = _prefill_layer(
+                p_l, y, c_l, layout, cfg, sp_axis, tp_axis
             )
-            return y, (ck_l, cv_l)
+            return y, c_l
 
         depth = next(iter(params.values())).shape[0]
-        hkv = (cfg.kv_heads or cfg.heads) // int(mesh.shape["tp"])
-        shape = (depth, x.shape[0], hkv, layout.lc_loc, cfg.head_dim)
-        zeros = jnp.zeros(shape, x.dtype)
-        y, (ck, cv) = lax.scan(layer, x, (params, zeros, zeros))
+        zeros = _zero_cache(depth, x.shape[0], x.dtype)
+        y, cache = lax.scan(layer, x, (params, zeros))
         # each row's LAST VALID position (lens[b]-1) lives on rank
         # (lens[b]-1)//lp_loc; per-row gather + psum-select broadcasts it
         # to every rank (decode inputs are sp-replicated)
@@ -365,30 +439,27 @@ def make_decoder(
         y_last = jnp.where(valid[:, None, None], gathered, 0)
         if sp_axis is not None:
             y_last = lax.psum(y_last, sp_axis)
-        return (ck, cv), y_last
+        return cache, y_last
 
-    def generate_shard(params, caches, y0, lens, n0, *, n_steps):
-        ck, cv = caches
-
+    def generate_shard(params, cache, y0, lens, n0, *, n_steps):
         def step(carry, _):
-            ck, cv, y, n = carry
+            cache, y, n = carry
 
             def layer(c2, xs):
                 yy = c2
-                p_l, ck_l, cv_l = xs
-                yy, ck_l, cv_l = _decode_layer(
-                    p_l, yy, ck_l, cv_l, lens, n, layout, cfg,
-                    sp_axis, tp_axis,
+                p_l, c_l = xs
+                yy, c_l = _decode_layer(
+                    p_l, yy, c_l, lens, n, layout, cfg, sp_axis, tp_axis
                 )
-                return yy, (ck_l, cv_l)
+                return yy, c_l
 
-            y2, (ck, cv) = lax.scan(layer, y, (params, ck, cv))
-            return (ck, cv, y2, n + 1), y2[:, 0, :]
+            y2, cache = lax.scan(layer, y, (params, cache))
+            return (cache, y2, n + 1), y2[:, 0, :]
 
-        (ck, cv, _, _), ys = lax.scan(
-            step, (ck, cv, y0, n0), None, length=n_steps
+        (cache, _, _), ys = lax.scan(
+            step, (cache, y0, n0), None, length=n_steps
         )
-        return (ck, cv), ys.transpose(1, 0, 2)  # [B, n_steps, E]
+        return cache, ys.transpose(1, 0, 2)  # [B, n_steps, E]
 
     x_spec = P("dp", "sp", None)
     tok_spec = P("dp", None, None)
@@ -398,7 +469,7 @@ def make_decoder(
             prefill_shard,
             mesh=mesh,
             in_specs=(pspecs, x_spec, lens_spec),
-            out_specs=((cache_spec, cache_spec), tok_spec),
+            out_specs=(cache_specs, tok_spec),
             check_vma=False,  # y_last is made sp-invariant by the psum
         )
     )
@@ -417,10 +488,9 @@ def make_decoder(
                 functools.partial(generate_shard, n_steps=n_steps),
                 mesh=mesh,
                 in_specs=(
-                    pspecs, (cache_spec, cache_spec), tok_spec,
-                    lens_spec, P(),
+                    pspecs, cache_specs, tok_spec, lens_spec, P(),
                 ),
-                out_specs=((cache_spec, cache_spec), tok_spec),
+                out_specs=(cache_specs, tok_spec),
                 check_vma=False,
             ),
         )
@@ -452,6 +522,7 @@ class DecodeConfig:
     depth: int = 4
     kv_heads: int = 0  # GQA: K/V heads (0 = MHA); cache shrinks H/kv-fold
     rope: bool = False  # rotary position embeddings on q/k
+    cache_int8: bool = False  # int8 K/V cache with per-slot scales
     batch: int = 8
     prefill: int = 4096  # prompt tokens (the long-context side)
     gen: int = 128  # generated tokens per rep
@@ -482,7 +553,8 @@ def run_decode(mesh: Mesh, cfg: DecodeConfig, writer) -> list:
     sp = int(mesh.shape["sp"])
     gen_cap = cfg.gen + (-cfg.gen % sp)
     prefill, generate = make_decoder(
-        mesh, mcfg, cfg.batch, cfg.prefill, gen_cap
+        mesh, mcfg, cfg.batch, cfg.prefill, gen_cap,
+        cache_int8=cfg.cache_int8,
     )
     max_len = cfg.prefill + gen_cap
     params = jax.device_put(
@@ -500,7 +572,7 @@ def run_decode(mesh: Mesh, cfg: DecodeConfig, writer) -> list:
     caches, y0 = prefill(params, x)
     jax.block_until_ready(y0)
 
-    gate = _teacher_forcing_gate(mesh, mcfg)
+    gate = _teacher_forcing_gate(mesh, mcfg, cache_int8=cfg.cache_int8)
 
     t0 = jnp.asarray(cfg.prefill, jnp.int32)
 
@@ -523,9 +595,15 @@ def run_decode(mesh: Mesh, cfg: DecodeConfig, writer) -> list:
     tokens = cfg.batch * cfg.gen
     sec = res.per_op_ns * 1e-9
     tps = tokens / sec if sec > 0 else 0.0
+    # int8: 1 byte per element + a 4-byte f32 scale per D-lane slot
+    kv_bytes = (
+        (1.0 + 4.0 / cfg.head_dim)
+        if cfg.cache_int8
+        else float(jnp.dtype(cfg.dtype).itemsize)
+    )
     cache_mb = (
         2 * cfg.depth * cfg.batch * (cfg.kv_heads or cfg.heads) * max_len
-        * cfg.head_dim * jnp.dtype(cfg.dtype).itemsize / 1e6
+        * cfg.head_dim * kv_bytes / 1e6
     )
     ok = gate and np.isfinite(tps) and tps > 0
     if cfg.min_tokens_per_s > 0:
@@ -534,7 +612,8 @@ def run_decode(mesh: Mesh, cfg: DecodeConfig, writer) -> list:
         pattern="decode",
         mode=f"sp{sp}"
         + (f"_gqa{cfg.kv_heads}" if cfg.kv_heads else "")
-        + ("_rope" if cfg.rope else ""),
+        + ("_rope" if cfg.rope else "")
+        + ("_int8" if cfg.cache_int8 else ""),
         commands=(
             f"B{cfg.batch} prefill{cfg.prefill} gen{cfg.gen} "
             f"depth{cfg.depth} {cfg.dtype}"
@@ -553,15 +632,20 @@ def run_decode(mesh: Mesh, cfg: DecodeConfig, writer) -> list:
     return [rec]
 
 
-def _teacher_forcing_gate(mesh: Mesh, big: ModelConfig) -> bool:
+def _teacher_forcing_gate(
+    mesh: Mesh, big: ModelConfig, cache_int8: bool = False
+) -> bool:
     """Decode-vs-training-forward equivalence on a probe shape.
 
     Feeds the SAME inputs through (a) the training causal forward and
     (b) prefill of the first half + token-by-token decode of the second;
     every decoded position must match the full forward (f32, tolerance
-    scaled to output magnitude).  The probe shape scales with the mesh
-    (batch with dp, heads with tp, sequence with sp) so the gate runs on
-    any layout the measured config itself accepts.
+    scaled to output magnitude — roundoff-tight for an exact cache, a
+    quantization-error bound for ``cache_int8``, which still fails hard
+    on any routing/mask bug: misaddressed slots are not 1%-level
+    errors).  The probe shape scales with the mesh (batch with dp, heads
+    with tp, sequence with sp) so the gate runs on any layout the
+    measured config itself accepts.
     """
     from tpu_patterns.models.transformer import forward_stack
 
@@ -595,7 +679,9 @@ def _teacher_forcing_gate(mesh: Mesh, big: ModelConfig) -> bool:
 
     # (b) prefill half, decode the rest teacher-forced
     half = (l // 2 // sp) * sp or sp
-    prefill, generate = make_decoder(mesh, cfg, b, half, l - half)
+    prefill, generate = make_decoder(
+        mesh, cfg, b, half, l - half, cache_int8=cache_int8
+    )
     sharded_params = jax.device_put(
         params,
         {k: NamedSharding(mesh, s) for k, s in _stacked_specs(cfg).items()},
@@ -617,5 +703,13 @@ def _teacher_forcing_gate(mesh: Mesh, big: ModelConfig) -> bool:
     wantn = np.asarray(want, np.float32)
     gotn = np.stack(got, axis=1)  # positions [half-1, l)
     ref = wantn[:, half - 1:]
-    tol = 64 * np.finfo(np.float32).eps * max(1.0, np.abs(ref).max())
+    scale = max(1.0, np.abs(ref).max())
+    tol = (
+        # int8 K and V each contribute ~1/254 relative error per slot;
+        # 8% of magnitude passes honest quantization noise while a
+        # misrouted slot (O(1) relative) still fails
+        0.08 * scale
+        if cache_int8
+        else 64 * np.finfo(np.float32).eps * scale
+    )
     return bool(np.abs(gotn - ref).max() <= tol)

@@ -473,6 +473,16 @@ def measured_specs(quick: bool = False) -> list[SweepSpec]:
             env=env,
         )
     )
+    # int8 cache contrast: 2x less cache HBM than bf16, dequant folded
+    # into the attention einsums
+    specs.append(
+        SweepSpec(
+            name="measured.decode_kv_cache_int8",
+            argv=("decode", "--devices", "1", "--cache_int8", "true",
+                  *decode_args),
+            env=env,
+        )
+    )
     return specs
 
 
