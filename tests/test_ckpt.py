@@ -243,3 +243,22 @@ class TestResume:
         out = train(mesh3d, cfg)
         assert int(np.asarray(out["state"]["step"])) == 2
         assert np.isfinite(out["loss"])
+
+    def test_log_every_emits_step_records(self, mesh3d, tmp_path):
+        from tpu_patterns.core.results import Record, ResultWriter
+
+        jsonl = tmp_path / "train.jsonl"
+        writer = ResultWriter(jsonl_path=str(jsonl))
+        cfg = _loop_cfg(
+            tmp_path / "ck", steps=4, ckpt_every=0, log_every=2
+        )
+        train(mesh3d, cfg, writer)
+        recs = [
+            Record.from_json(line)
+            for line in jsonl.read_text().splitlines()
+            if line.strip()
+        ]
+        steps = [r for r in recs if r.pattern == "train_step"]
+        assert [int(r.metrics["step"]) for r in steps] == [2, 4]
+        assert all(np.isfinite(r.metrics["loss"]) for r in steps)
+        assert any(r.pattern == "train" for r in recs)  # final summary

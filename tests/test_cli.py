@@ -180,7 +180,7 @@ class TestSweep:
         assert any("grad" in s.name for s in lc)
         par = sweep.specs_for("parallel", quick=True)
         assert {s.name.split(".")[0] for s in par} == {
-            "pipeline", "moe", "flagship", "decode", "overlap"
+            "pipeline", "moe", "flagship", "decode", "overlap", "lm"
         }
         hier = sweep.specs_for("hier", quick=True)
         assert len(hier) == 2  # 2 dcn splits x 1 dtype

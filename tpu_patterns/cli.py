@@ -350,6 +350,12 @@ def _cmd_decode(args, writer: ResultWriter) -> None:
     run_decode(_mesh3d_from_args(args), _cfg_from_args(DecodeConfig, args), writer)
 
 
+def _cmd_lm(args, writer: ResultWriter) -> None:
+    from tpu_patterns.models.lm import LMConfig, run_lm
+
+    run_lm(_mesh3d_from_args(args), _cfg_from_args(LMConfig, args), writer)
+
+
 def _cmd_pipeline(args, writer: ResultWriter) -> None:
     import dataclasses
 
@@ -685,6 +691,16 @@ def build_parser() -> argparse.ArgumentParser:
     add_config_args(dc, DecodeConfig)
     _add_mesh3d_args(dc)
 
+    lmp = sub.add_parser(
+        "lm",
+        help="token-level LM: vocab-parallel embedding/CE/argmax — train "
+        "then greedy-generate, one measured pattern",
+    )
+    from tpu_patterns.models.lm import LMConfig
+
+    add_config_args(lmp, LMConfig)
+    _add_mesh3d_args(lmp)
+
     pl = sub.add_parser(
         "pipeline", help="GPipe vs 1F1B schedule benchmark (bubble + memory)"
     )
@@ -765,6 +781,7 @@ def main(argv: list[str] | None = None) -> int:
         "flagship": _cmd_flagship,
         "train": _cmd_train,
         "decode": _cmd_decode,
+        "lm": _cmd_lm,
         "pipeline": _cmd_pipeline,
         "moe": _cmd_moe,
         "miniapps": _cmd_miniapps,

@@ -167,6 +167,46 @@ class _CacheLayout:
         return prompt_pos, gen_index, is_gen
 
 
+def _zero_cache(
+    cfg: ModelConfig, mesh: Mesh, layout, depth, b_loc, dtype, cache_int8
+) -> dict:
+    """Empty per-rank cache dict, [depth, B_loc, Hkv_loc, lc_loc, ...]."""
+    hkv = (cfg.kv_heads or cfg.heads) // int(mesh.shape["tp"])
+    kv_shape = (depth, b_loc, hkv, layout.lc_loc, cfg.head_dim)
+    if cache_int8:
+        sc_shape = kv_shape[:-1]
+        return {
+            "k": jnp.zeros(kv_shape, jnp.int8),
+            "v": jnp.zeros(kv_shape, jnp.int8),
+            "ks": jnp.zeros(sc_shape, jnp.float32),
+            "vs": jnp.zeros(sc_shape, jnp.float32),
+        }
+    return {
+        "k": jnp.zeros(kv_shape, dtype),
+        "v": jnp.zeros(kv_shape, dtype),
+    }
+
+
+def _gather_last_valid(y, lens, layout, sp_axis):
+    """[B, 1, E] output at each row's LAST VALID prompt position.
+
+    Row b's position lens[b]-1 lives on rank (lens[b]-1)//lp_loc; the
+    per-row clip-gather + psum-select broadcasts it to every rank
+    (decode inputs are sp-replicated).  Shared by the embedding-level
+    and the token-level (lm.py) prefill paths.
+    """
+    r = lax.axis_index(sp_axis) if sp_axis is not None else 0
+    idx = lens - 1 - r * layout.lp_loc  # [B] local index of last token
+    valid = (idx >= 0) & (idx < layout.lp_loc)
+    gathered = jnp.take_along_axis(
+        y, jnp.clip(idx, 0, layout.lp_loc - 1)[:, None, None], axis=1
+    )  # [B, 1, E]
+    y_last = jnp.where(valid[:, None, None], gathered, 0)
+    if sp_axis is not None:
+        y_last = lax.psum(y_last, sp_axis)
+    return y_last
+
+
 def _cache_write(cache: dict, kt, vt, off) -> dict:
     """Write k/v [B, Hkv, Lw, D] at local slot ``off``; quantizing on the
     way in when the cache is int8 (scales stored per slot alongside)."""
@@ -399,22 +439,6 @@ def make_decoder(
         scale_spec = P(None, "dp", "tp", "sp")
         cache_specs.update({"ks": scale_spec, "vs": scale_spec})
 
-    def _zero_cache(depth, b_loc, dtype):
-        hkv = (cfg.kv_heads or cfg.heads) // int(mesh.shape["tp"])
-        kv_shape = (depth, b_loc, hkv, layout.lc_loc, cfg.head_dim)
-        if cache_int8:
-            sc_shape = kv_shape[:-1]
-            return {
-                "k": jnp.zeros(kv_shape, jnp.int8),
-                "v": jnp.zeros(kv_shape, jnp.int8),
-                "ks": jnp.zeros(sc_shape, jnp.float32),
-                "vs": jnp.zeros(sc_shape, jnp.float32),
-            }
-        return {
-            "k": jnp.zeros(kv_shape, dtype),
-            "v": jnp.zeros(kv_shape, dtype),
-        }
-
     def prefill_shard(params, x, lens):
         def layer(carry, xs):
             y = carry
@@ -425,21 +449,11 @@ def make_decoder(
             return y, c_l
 
         depth = next(iter(params.values())).shape[0]
-        zeros = _zero_cache(depth, x.shape[0], x.dtype)
+        zeros = _zero_cache(
+            cfg, mesh, layout, depth, x.shape[0], x.dtype, cache_int8
+        )
         y, cache = lax.scan(layer, x, (params, zeros))
-        # each row's LAST VALID position (lens[b]-1) lives on rank
-        # (lens[b]-1)//lp_loc; per-row gather + psum-select broadcasts it
-        # to every rank (decode inputs are sp-replicated)
-        r = lax.axis_index(sp_axis) if sp_axis is not None else 0
-        idx = lens - 1 - r * layout.lp_loc  # [B] local index of last tok
-        valid = (idx >= 0) & (idx < layout.lp_loc)
-        gathered = jnp.take_along_axis(
-            y, jnp.clip(idx, 0, layout.lp_loc - 1)[:, None, None], axis=1
-        )  # [B, 1, E]
-        y_last = jnp.where(valid[:, None, None], gathered, 0)
-        if sp_axis is not None:
-            y_last = lax.psum(y_last, sp_axis)
-        return cache, y_last
+        return cache, _gather_last_valid(y, lens, layout, sp_axis)
 
     def generate_shard(params, cache, y0, lens, n0, *, n_steps):
         def step(carry, _):

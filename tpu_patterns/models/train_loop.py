@@ -72,6 +72,10 @@ class TrainLoopConfig:
     ckpt_every: int = 0
     keep: int = 2
     resume: bool = False
+    # observability: emit a train_step Record every k steps (0 = only the
+    # final summary Record) — loss curve + throughput in the same JSONL
+    # stream every pattern writes (core/results.py)
+    log_every: int = 0
 
 
 def _model_cfg(cfg: TrainLoopConfig) -> ModelConfig:
@@ -133,6 +137,28 @@ def _make_batch_source(cfg: TrainLoopConfig, mesh: Mesh, start: int):
         return jax.device_put(x, NamedSharding(mesh, P("dp", "sp", None)))
 
     return get_batch, loader.close
+
+
+def _emit_step_record(
+    writer, cfg: TrainLoopConfig, step: int, loss: float, steps_per_s: float
+) -> None:
+    from tpu_patterns.core.results import Record, Verdict
+
+    writer.record(
+        Record(
+            pattern="train_step",
+            mode=cfg.optimizer,
+            commands=f"step={step}",
+            metrics={
+                "step": float(step),
+                "loss": loss,
+                "steps_per_s": round(steps_per_s, 3),
+            },
+            verdict=(
+                Verdict.SUCCESS if np.isfinite(loss) else Verdict.FAILURE
+            ),
+        )
+    )
 
 
 def train(mesh: Mesh, cfg: TrainLoopConfig, writer=None) -> dict:
@@ -240,6 +266,7 @@ def train(mesh: Mesh, cfg: TrainLoopConfig, writer=None) -> dict:
     loss = None
     get_batch, close_source = _make_batch_source(cfg, mesh, start)
     t0 = time.perf_counter()
+    t_window, window_start = t0, start
     try:
         for t in range(start, cfg.steps):
             x = get_batch(t)
@@ -254,6 +281,31 @@ def train(mesh: Mesh, cfg: TrainLoopConfig, writer=None) -> dict:
             ):
                 jax.block_until_ready(tree)
                 ckpt.save(cfg.ckpt_dir, t + 1, tree, keep=cfg.keep)
+            if t == start and cfg.log_every > 0:
+                # restart the window AFTER the first step: it carries the
+                # jit compile, which would otherwise dominate the first
+                # window's steps_per_s (the step is excluded from both
+                # the window's clock and its step count)
+                jax.block_until_ready(loss)
+                t_window, window_start = time.perf_counter(), t + 1
+            if (
+                writer is not None
+                and cfg.log_every > 0
+                and (t + 1) % cfg.log_every == 0
+            ):
+                # fetching loss fences the window — the per-window
+                # steps_per_s is real, not dispatch rate.  A window with
+                # zero post-compile steps (log_every=1 at the first step)
+                # emits no rate record rather than a bogus one.
+                steps_in_window = t + 1 - window_start
+                if steps_in_window > 0:
+                    step_loss = float(np.asarray(loss))
+                    now = time.perf_counter()
+                    _emit_step_record(
+                        writer, cfg, t + 1, step_loss,
+                        steps_in_window / max(now - t_window, 1e-9),
+                    )
+                    t_window, window_start = now, t + 1
         jax.block_until_ready(tree)
     finally:
         close_source()

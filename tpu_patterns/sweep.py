@@ -274,6 +274,21 @@ def parallel_specs(quick: bool = False) -> list[SweepSpec]:
             env=(("TPU_PATTERNS_SWEEP_CONFIG", "decode"),),
         )
     )
+    # token-level LM: vocab-parallel embedding/CE/argmax, train + greedy
+    lm_small = (
+        ("--vocab", "64", "--embed", "64", "--head_dim", "8",
+         "--seq", "32", "--steps", "5", "--gen", "8")
+        if quick
+        else ("--vocab", "2048", "--seq", "512", "--steps", "30",
+              "--gen", "64")
+    )
+    specs.append(
+        SweepSpec(
+            name="lm.vocab_parallel",
+            argv=("lm", *lm_small),
+            env=(("TPU_PATTERNS_SWEEP_CONFIG", "lm"),),
+        )
+    )
     # collective matmul: decomposed ring vs XLA collective, both duals
     overlap_small = (
         ("--rows", "16", "--contract", "64", "--cols", "32",
