@@ -185,3 +185,121 @@ def test_two_process_bootstrap_and_collectives(tmp_path):
         out = log.read_text()
         assert p.returncode == 0, f"rank {rank} failed:\n{all_output()}"
         assert f"rank {rank} OK" in out
+
+
+CKPT_WORKER = textwrap.dedent(
+    """
+    import json
+    import os
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from tpu_patterns import ckpt
+    from tpu_patterns.topo.bootstrap import bootstrap
+
+    info = bootstrap()
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    root = os.environ["TPU_PATTERNS_TEST_CKPT_DIR"]
+
+    # globally sharded [8, 4] with distinct values per element, plus a
+    # fully replicated leaf (only ONE process holds its replica 0)
+    want = np.arange(32, dtype=np.float32).reshape(8, 4)
+    sh = NamedSharding(mesh, P("x"))
+    w = jax.make_array_from_callback(want.shape, sh, lambda idx: want[idx])
+    rep = jax.device_put(
+        jnp.asarray([3.5, -1.25]), NamedSharding(mesh, P())
+    )
+    tree = {"w": w, "rep": rep}
+    ckpt.save(root, 7, tree)  # internal barriers: all ranks participate
+
+    # each process verifies its own shard file holds ONLY local shards
+    rank = jax.process_index()
+    with open(os.path.join(root, "step_7", f"shards_proc{rank}.json")) as f:
+        table = json.load(f)
+    leaf_of = {}
+    with open(os.path.join(root, "step_7", "manifest.json")) as f:
+        for info in json.load(f)["leaves"]:
+            leaf_of[info["key"]] = info["leaf"]
+    w_rows = sorted(
+        e["index"][0][0] for e in table if e["leaf"] == leaf_of["['w']"]
+    )
+    # rank r's two local devices hold rows [4r, 4r+2) and [4r+2, 4r+4):
+    # ONLY those may appear in its file (a dedup regression writing a
+    # remote shard here must fail loudly)
+    assert w_rows == [4 * rank, 4 * rank + 2], (rank, table)
+
+    # elastic restore onto the same mesh; every process checks every
+    # ADDRESSABLE shard of the result against the truth
+    back = ckpt.restore(root, tree)
+    for shard in back["w"].addressable_shards:
+        np.testing.assert_array_equal(
+            np.asarray(shard.data), want[shard.index]
+        )
+    for shard in back["rep"].addressable_shards:
+        np.testing.assert_array_equal(
+            np.asarray(shard.data), np.asarray([3.5, -1.25], np.float32)
+        )
+    print(f"rank {rank} OK")
+    """
+)
+
+
+def test_two_process_checkpoint_roundtrip(tmp_path):
+    # the multi-process save path: per-process shard files, replica-0
+    # dedup ACROSS processes, sync barriers inside save, shared-fs commit
+    port = _free_port()
+    ckpt_dir = tmp_path / "ckpt"
+    procs, logs = [], []
+    for rank in range(2):
+        env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+        env.update(
+            {
+                "PYTHONPATH": str(ROOT),
+                "JAX_PLATFORMS": "cpu",
+                "TPU_PATTERNS_COORDINATOR": f"127.0.0.1:{port}",
+                "TPU_PATTERNS_NUM_PROCESSES": "2",
+                "TPU_PATTERNS_PROCESS_ID": str(rank),
+                "TPU_PATTERNS_TEST_CKPT_DIR": str(ckpt_dir),
+            }
+        )
+        log = tmp_path / f"ckpt_rank{rank}.log"
+        logs.append(log)
+        with open(log, "w") as f:
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, "-c", CKPT_WORKER],
+                    env=env,
+                    stdout=f,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                )
+            )
+
+    def all_output() -> str:
+        return "\n".join(
+            f"--- rank {r} ---\n{log.read_text()}"
+            for r, log in enumerate(logs)
+        )
+
+    for rank, p in enumerate(procs):
+        try:
+            p.wait(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+                q.wait()
+            pytest.fail(f"rank {rank} timed out:\n{all_output()}")
+    for rank, (p, log) in enumerate(zip(procs, logs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{all_output()}"
+        assert f"rank {rank} OK" in log.read_text()
+    # both processes' shard files exist in the committed step
+    names = sorted(os.listdir(ckpt_dir / "step_7"))
+    assert "proc0.npz" in names and "proc1.npz" in names

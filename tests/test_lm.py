@@ -150,6 +150,60 @@ class TestLMDecode:
         np.testing.assert_array_equal(outs[(2, 2, 2)][1], outs[(1, 1, 1)][1])
         assert ((outs[(1, 1, 1)][1] >= 0) & (outs[(1, 1, 1)][1] < V)).all()
 
+    def test_sampled_rollout_deterministic_and_varied(self, mesh3d):
+        # Gumbel-max sampling: same seed -> same tokens; different seeds
+        # -> (almost surely) different tokens; T->0 recovers greedy
+        cfg = ModelConfig(**CFG, rope=True)
+        params = lm.init_lm_params(jax.random.key(0), cfg, V)
+        toks = jax.random.randint(jax.random.key(1), (4, 16), 0, V)
+        pre, gen = lm.make_lm_decoder(mesh3d, cfg, V, 4, 16, 8)
+        specs = lm.lm_param_specs(cfg)
+        sp_p = {
+            k: jax.device_put(v, NamedSharding(mesh3d, specs[k]))
+            for k, v in params.items()
+        }
+        tk = jax.device_put(toks, NamedSharding(mesh3d, P("dp", "sp")))
+        caches, t0 = pre(sp_p, tk)
+        args = (sp_p, caches, t0, jnp.asarray(16), 8)
+        a1 = np.asarray(gen(*args, temperature=1.0, seed=7)[1])
+        a2 = np.asarray(gen(*args, temperature=1.0, seed=7)[1])
+        b = np.asarray(gen(*args, temperature=1.0, seed=8)[1])
+        greedy = np.asarray(gen(*args)[1])
+        cold = np.asarray(gen(*args, temperature=1e-4, seed=7)[1])
+        np.testing.assert_array_equal(a1, a2)
+        assert not np.array_equal(a1, b)
+        np.testing.assert_array_equal(cold, greedy)
+        assert ((a1 >= 0) & (a1 < V)).all()
+
+    def test_sharded_sample_matches_softmax_frequencies(self, devices):
+        # the Gumbel trick over a SHARDED vocab must sample the true
+        # softmax: 4k draws from a known 8-way distribution
+        mesh = Mesh(np.array(devices[:4]), ("tp",))
+        logits = jnp.log(
+            jnp.asarray([0.4, 0.2, 0.1, 0.1, 0.05, 0.05, 0.05, 0.05])
+        )
+        n_draws = 4096
+        lg = jnp.broadcast_to(logits, (n_draws, 8))
+
+        def body(lg_local, seeds):
+            return lm.sharded_sample(
+                lg_local, jax.random.key(seeds[0]), 1.0, "tp"
+            )
+
+        draws = _shard_map1(
+            body, mesh, (P(None, "tp"), P()), P(),
+        )(
+            jax.device_put(lg, NamedSharding(mesh, P(None, "tp"))),
+            jax.device_put(
+                jnp.asarray([123], jnp.uint32), NamedSharding(mesh, P())
+            ),
+        )
+        # NOTE: one key for all rows here — but gumbel noise is drawn per
+        # row of the [n_draws, 2]-per-rank slice, so rows are iid draws
+        freq = np.bincount(np.asarray(draws), minlength=8) / n_draws
+        want = np.exp(np.asarray(logits))
+        assert np.abs(freq - want).max() < 0.05
+
     def test_prefill_token_matches_forward_argmax(self, mesh3d):
         # the first sampled token == argmax of the training forward's
         # logits at the last prompt position

@@ -113,6 +113,26 @@ def sharded_argmax(logits_local, tp_axis):
     return lax.pmin(cand, tp_axis)
 
 
+def sharded_sample(logits_local, key, temperature, tp_axis):
+    """Sample token ids [B] from softmax(logits / T) over the SHARDED
+    vocab without gathering: the Gumbel-max trick — argmax(logits/T + G)
+    with iid Gumbel noise G samples exactly the softmax — reduces
+    sampling to :func:`sharded_argmax`.  Each rank draws its slice's
+    noise from a rank-folded key, so the joint noise is iid across the
+    global vocab and the draw is deterministic in (key, mesh).
+    ``temperature <= 0`` falls back to greedy.
+    """
+    if temperature <= 0:
+        return sharded_argmax(logits_local, tp_axis)
+    r = lax.axis_index(tp_axis) if tp_axis is not None else 0
+    g = jax.random.gumbel(
+        jax.random.fold_in(key, r), logits_local.shape, jnp.float32
+    )
+    return sharded_argmax(
+        logits_local.astype(jnp.float32) / temperature + g, tp_axis
+    )
+
+
 def lm_param_specs(cfg: ModelConfig) -> dict[str, P]:
     """Block specs + the tied embedding table, vocab-sharded over tp."""
     specs = {k: s for k, (_, s) in param_specs(cfg).items()}
@@ -273,7 +293,8 @@ class LMConfig:
     seq: int = 256  # training sequence length
     steps: int = 20
     lr: float = 0.5
-    gen: int = 32  # greedy tokens generated after training
+    gen: int = 32  # tokens generated after training
+    temperature: float = 0.0  # 0 = greedy; >0 = Gumbel-max sampling
     seed: int = 0
 
 
@@ -309,7 +330,7 @@ def run_lm(mesh: Mesh, cfg: LMConfig, writer) -> list:
     import time
 
     t0 = time.perf_counter()
-    loss = None
+    loss = first  # steps=0: report the initial loss, nothing trained
     for _ in range(cfg.steps):
         p, loss = step(p, st)
     loss = float(loss)
@@ -324,11 +345,12 @@ def run_lm(mesh: Mesh, cfg: LMConfig, writer) -> list:
     # warm the generate program first: the rollout is deterministic in
     # (caches, tok0), so the timed second call does identical work with
     # compile excluded — matching train_steps_per_s's discipline
+    gen_kw = dict(temperature=cfg.temperature, seed=cfg.seed)
     jax.block_until_ready(
-        gen(p, caches, tok0, jnp.asarray(prefill_len), cfg.gen)[1]
+        gen(p, caches, tok0, jnp.asarray(prefill_len), cfg.gen, **gen_kw)[1]
     )
     t1 = time.perf_counter()
-    _, out = gen(p, caches, tok0, jnp.asarray(prefill_len), cfg.gen)
+    _, out = gen(p, caches, tok0, jnp.asarray(prefill_len), cfg.gen, **gen_kw)
     out = np.asarray(out)
     gen_s = time.perf_counter() - t1
     tps = cfg.batch * cfg.gen / gen_s if gen_s > 0 else 0.0
@@ -429,8 +451,11 @@ def make_lm_decoder(
         tok = sharded_argmax(_logits_last(wemb, y_last), tp_axis)
         return cache, tok
 
-    def generate_shard(params, cache, tok0, lens, n0, *, n_steps):
+    def generate_shard(
+        params, cache, tok0, lens, n0, seed, *, n_steps, temperature
+    ):
         blocks, wemb = _split(params)
+        base_key = jax.random.key(seed)
 
         def step(carry, _):
             cache, tok, n = carry
@@ -447,7 +472,15 @@ def make_lm_decoder(
                 return yy, c_l
 
             y2, cache = lax.scan(layer, x, (blocks, cache))
-            nxt = sharded_argmax(_logits_last(wemb, y2), tp_axis)
+            # per-step key, folded with the dp rank (each batch shard
+            # must draw DIFFERENT noise) and again per tp rank inside
+            # the sampler; sp ranks share the key and agree on the draw
+            step_key = jax.random.fold_in(
+                jax.random.fold_in(base_key, n), lax.axis_index("dp")
+            )
+            nxt = sharded_sample(
+                _logits_last(wemb, y2), step_key, temperature, tp_axis
+            )
             return (cache, nxt, n + 1), nxt
 
         (cache, _, _), toks = lax.scan(
@@ -475,13 +508,15 @@ def make_lm_decoder(
         )
 
     @functools.lru_cache(maxsize=None)
-    def _gen_compiled(n_steps: int):
+    def _gen_compiled(n_steps: int, temperature: float):
         return jax.jit(
             jax.shard_map(
-                functools.partial(generate_shard, n_steps=n_steps),
+                functools.partial(
+                    generate_shard, n_steps=n_steps, temperature=temperature
+                ),
                 mesh=mesh,
                 in_specs=(
-                    pspecs, cache_specs, tok_spec, lens_spec, P(),
+                    pspecs, cache_specs, tok_spec, lens_spec, P(), P(),
                 ),
                 out_specs=(cache_specs, tok_spec),
                 check_vma=False,
@@ -500,16 +535,17 @@ def make_lm_decoder(
                 out[k] = v if cfg.depth > 1 else v[None]
         return out
 
-    def generate(params, caches, tok, t0, n_steps):
+    def generate(params, caches, tok, t0, n_steps, temperature=0.0, seed=0):
         if isinstance(t0, tuple):
             lens, n0 = t0
             lens = jnp.asarray(lens, jnp.int32)
         else:
             lens = jnp.full((batch,), prefill_len, jnp.int32)
             n0 = jnp.asarray(t0, jnp.int32) - prefill_len
-        return _gen_compiled(int(n_steps))(
+        return _gen_compiled(int(n_steps), float(temperature))(
             _stacked(params), caches,
             jnp.asarray(tok, jnp.int32), lens, jnp.asarray(n0, jnp.int32),
+            jnp.asarray(seed, jnp.uint32),
         )
 
     return prefill, generate
