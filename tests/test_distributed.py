@@ -226,8 +226,8 @@ CKPT_WORKER = textwrap.dedent(
         table = json.load(f)
     leaf_of = {}
     with open(os.path.join(root, "step_7", "manifest.json")) as f:
-        for info in json.load(f)["leaves"]:
-            leaf_of[info["key"]] = info["leaf"]
+        for leaf_info in json.load(f)["leaves"]:
+            leaf_of[leaf_info["key"]] = leaf_info["leaf"]
     w_rows = sorted(
         e["index"][0][0] for e in table if e["leaf"] == leaf_of["['w']"]
     )
@@ -235,6 +235,17 @@ CKPT_WORKER = textwrap.dedent(
     # ONLY those may appear in its file (a dedup regression writing a
     # remote shard here must fail loudly)
     assert w_rows == [4 * rank, 4 * rank + 2], (rank, table)
+    # replica-0 dedup ACROSS processes: the replicated leaf must appear
+    # exactly ONCE in the union of both processes' shard tables
+    rep_entries = 0
+    for p in range(2):
+        with open(
+            os.path.join(root, "step_7", f"shards_proc{p}.json")
+        ) as f:
+            rep_entries += sum(
+                1 for e in json.load(f) if e["leaf"] == leaf_of["['rep']"]
+            )
+    assert rep_entries == 1, rep_entries
 
     # elastic restore onto the same mesh; every process checks every
     # ADDRESSABLE shard of the result against the truth

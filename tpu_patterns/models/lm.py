@@ -299,8 +299,9 @@ class LMConfig:
 
 
 def run_lm(mesh: Mesh, cfg: LMConfig, writer) -> list:
-    """Measured LM pattern: train (loss must drop), then greedy-generate
-    from a prompt (rollout must be deterministic and in-vocab).
+    """Measured LM pattern: train (loss must drop), then generate from a
+    prompt — greedy at temperature 0, Gumbel-max sampled above it
+    (deterministic given the seed); generated ids must stay in-vocab.
 
     Verdict = training actually reduced the CE AND the generation gate
     holds — the LM twin of the flagship's finite-loss + consistency gate.
@@ -341,11 +342,11 @@ def run_lm(mesh: Mesh, cfg: LMConfig, writer) -> list:
         mesh, mcfg, cfg.vocab, cfg.batch, prefill_len, cfg.gen,
         cache_int8=cfg.cache_int8,
     )
-    caches, tok0 = pre(p, st)
-    # warm the generate program first: the rollout is deterministic in
-    # (caches, tok0), so the timed second call does identical work with
-    # compile excluded — matching train_steps_per_s's discipline
     gen_kw = dict(temperature=cfg.temperature, seed=cfg.seed)
+    caches, tok0 = pre(p, st, **gen_kw)
+    # warm the generate program first: the rollout is deterministic in
+    # (caches, tok0, seed), so the timed second call does identical work
+    # with compile excluded — matching train_steps_per_s's discipline
     jax.block_until_ready(
         gen(p, caches, tok0, jnp.asarray(prefill_len), cfg.gen, **gen_kw)[1]
     )
@@ -361,7 +362,12 @@ def run_lm(mesh: Mesh, cfg: LMConfig, writer) -> list:
         pattern="lm",
         mode=f"V{cfg.vocab}"
         + (f"_gqa{cfg.kv_heads}" if cfg.kv_heads else "")
-        + ("_int8" if cfg.cache_int8 else ""),
+        + ("_int8" if cfg.cache_int8 else "")
+        + (
+            f"_T{cfg.temperature}_seed{cfg.seed}"
+            if cfg.temperature > 0
+            else ""
+        ),
         commands=(
             f"B{cfg.batch} L{cfg.seq} depth{cfg.depth} E{cfg.embed} "
             f"{cfg.dtype} steps{cfg.steps} gen{cfg.gen}"
@@ -391,14 +397,18 @@ def make_lm_decoder(
     gen_cap: int,
     cache_int8: bool = False,
 ):
-    """Greedy token generation on the sequence-parallel KV cache.
+    """Token generation on the sequence-parallel KV cache.
 
-    ``prefill(params, tokens, lens=None) -> (caches, first_token)``;
-    ``generate(params, caches, token, t0, n_steps) -> (caches, tokens
-    [B, n_steps])`` — each step embeds the fed-back token
-    (vocab-parallel), runs the cached block stack, projects through the
-    tied table, and picks the next id with the sharded argmax; the whole
-    rollout is one compiled scan, tokens never leave the device.
+    ``prefill(params, tokens, lens=None, temperature=0.0, seed=0) ->
+    (caches, first_token)``;
+    ``generate(params, caches, token, t0, n_steps, temperature=0.0,
+    seed=0) -> (caches, tokens [B, n_steps])`` — each step embeds the
+    fed-back token (vocab-parallel), runs the cached block stack,
+    projects through the tied table, and picks the next id with the
+    sharded argmax (temperature 0) or Gumbel-max sampling (temperature
+    > 0; the rollout is then deterministic in (caches, tok, seed), NOT
+    in (caches, tok) alone).  The whole rollout is one compiled scan;
+    tokens never leave the device.
     """
     from tpu_patterns.models import decode as D
 
@@ -428,7 +438,7 @@ def make_lm_decoder(
     def _logits_last(wemb, y):  # y [B, 1, E] -> [B, V/tp]
         return jnp.einsum("be,ve->bv", y[:, 0, :], wemb)
 
-    def prefill_shard(params, tokens, lens):
+    def prefill_shard(params, tokens, lens, seed, *, temperature):
         blocks, wemb = _split(params)
         x = embed_tokens(wemb, tokens, tp_axis).astype(
             jnp.dtype(cfg.dtype)
@@ -448,7 +458,16 @@ def make_lm_decoder(
         )
         y, cache = lax.scan(layer, x, (blocks, zeros))
         y_last = D._gather_last_valid(y, lens, layout, sp_axis)
-        tok = sharded_argmax(_logits_last(wemb, y_last), tp_axis)
+        # the FIRST continuation token samples too; fold index 2^31-1
+        # marks the pre-generation draw, distinct from every scan step's
+        # fold n (fold data must be non-negative)
+        key = jax.random.fold_in(
+            jax.random.fold_in(jax.random.key(seed), 0x7FFFFFFF),
+            lax.axis_index("dp"),
+        )
+        tok = sharded_sample(
+            _logits_last(wemb, y_last), key, temperature, tp_axis
+        )
         return cache, tok
 
     def generate_shard(
@@ -490,21 +509,25 @@ def make_lm_decoder(
 
     tok_spec = P("dp")
     lens_spec = P("dp")
-    prefill_jit = jax.jit(
-        jax.shard_map(
-            prefill_shard,
-            mesh=mesh,
-            in_specs=(pspecs, P("dp", "sp"), lens_spec),
-            out_specs=(cache_specs, tok_spec),
-            check_vma=False,
-        )
-    )
 
-    def prefill(params, tokens, lens=None):
+    @functools.lru_cache(maxsize=None)
+    def _prefill_compiled(temperature: float):
+        return jax.jit(
+            jax.shard_map(
+                functools.partial(prefill_shard, temperature=temperature),
+                mesh=mesh,
+                in_specs=(pspecs, P("dp", "sp"), lens_spec, P()),
+                out_specs=(cache_specs, tok_spec),
+                check_vma=False,
+            )
+        )
+
+    def prefill(params, tokens, lens=None, temperature=0.0, seed=0):
         if lens is None:
             lens = jnp.full((batch,), prefill_len, jnp.int32)
-        return prefill_jit(
-            _stacked(params), tokens, jnp.asarray(lens, jnp.int32)
+        return _prefill_compiled(float(temperature))(
+            _stacked(params), tokens, jnp.asarray(lens, jnp.int32),
+            jnp.asarray(seed, jnp.uint32),
         )
 
     @functools.lru_cache(maxsize=None)
