@@ -141,32 +141,24 @@ def latest_step(root: str) -> int | None:
     return max(steps) if steps else None
 
 
-def save(
-    root: str,
-    step: int,
-    tree,
-    *,
-    keep: int | None = None,
-) -> str:
-    """Write one atomic checkpoint of ``tree`` at ``step``.
-
-    Every leaf must be a ``jax.Array`` (committed data only — host
-    scalars belong in the caller's own metadata, passed through
-    ``manifest.json`` is deliberately NOT extensible to keep the format
-    auditable).  Returns the committed directory.  ``keep=k`` prunes all
-    but the newest k committed steps after a successful commit.
-    """
-    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
-    proc = jax.process_index()
+def _prepare_tmp(root: str, step: int) -> str:
+    """Fresh tmp dir for a save (a re-save of the same step — a resumed
+    run overwriting its own crash — must start clean)."""
     tmp = os.path.join(root, f".tmp.step_{step}")
-    if proc == 0:
-        os.makedirs(root, exist_ok=True)
-        # a re-save of the same step (resumed run overwriting its own
-        # crash) must start clean
-        shutil.rmtree(tmp, ignore_errors=True)
-        os.makedirs(tmp)
-    _barrier(f"ckpt_mkdir_{step}")
+    os.makedirs(root, exist_ok=True)
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp)
+    return tmp
 
+
+def _snapshot(tree, proc: int, copy: bool = False):
+    """The tree's replica-0 shards on host + the table/manifest entries
+    describing them — everything the file-writing side needs.
+    ``copy=True`` (the async saver) detaches the buffers so a thread can
+    write them while training rebinds device state; the synchronous path
+    keeps the zero-copy views (no second host copy on its critical
+    path)."""
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
     shard_table = []
     arrays = {}
     manifest_leaves = []
@@ -176,12 +168,14 @@ def save(
                 f"checkpoint leaf {_keystr(path)} is {type(leaf).__name__}; "
                 "only jax.Array leaves are checkpointable"
             )
-        # jax.block_until_ready'd implicitly by np.asarray below
+        # jax.block_until_ready'd implicitly by np.asarray below; the
+        # np.array copy detaches the snapshot from the device buffer
         for shard_id, shard in enumerate(leaf.addressable_shards):
             if shard.replica_id != 0:
                 continue  # replicated copies: one writer is enough
             name = f"{leaf_id}.{shard_id}"
-            arrays[name] = _to_bytes_view(np.asarray(shard.data))
+            view = _to_bytes_view(np.asarray(shard.data))
+            arrays[name] = np.array(view) if copy else view
             shard_table.append(
                 {
                     "leaf": leaf_id,
@@ -202,6 +196,43 @@ def save(
                     "spec": _spec_to_json(leaf.sharding),
                 }
             )
+    return shard_table, arrays, manifest_leaves
+
+
+def save(
+    root: str,
+    step: int,
+    tree,
+    *,
+    keep: int | None = None,
+) -> str:
+    """Write one atomic checkpoint of ``tree`` at ``step``.
+
+    Every leaf must be a ``jax.Array`` (committed data only — host
+    scalars belong in the caller's own metadata, passed through
+    ``manifest.json`` is deliberately NOT extensible to keep the format
+    auditable).  Returns the committed directory.  ``keep=k`` prunes all
+    but the newest k committed steps after a successful commit.
+    """
+    proc = jax.process_index()
+    if proc == 0:
+        _prepare_tmp(root, step)
+    _barrier(f"ckpt_mkdir_{step}")
+
+    snapshot = _snapshot(tree, proc)
+    return _write_and_commit(
+        root, step, proc, jax.process_count(), snapshot, keep, _barrier
+    )
+
+
+def _write_and_commit(
+    root, step, proc, process_count, snapshot, keep, barrier
+) -> str:
+    """The file-writing + atomic-commit half of :func:`save`, operating
+    purely on a host snapshot — callable from a background thread (the
+    async saver) as well as inline."""
+    shard_table, arrays, manifest_leaves = snapshot
+    tmp = os.path.join(root, f".tmp.step_{step}")
 
     with open(os.path.join(tmp, f"proc{proc}.npz"), "wb") as f:
         np.savez(f, **arrays)
@@ -212,12 +243,12 @@ def save(
         f.flush()
         os.fsync(f.fileno())
 
-    _barrier(f"ckpt_written_{step}")
+    barrier(f"ckpt_written_{step}")
     if proc == 0:
         manifest = {
             "format": FORMAT_VERSION,
             "step": step,
-            "process_count": jax.process_count(),
+            "process_count": process_count,
             "leaves": manifest_leaves,
         }
         # manifest LAST: its presence is the commit marker for a scan
@@ -260,8 +291,65 @@ def save(
         if keep is not None and keep > 0:
             for old in available_steps(root)[:-keep]:
                 _remove_step(root, old)
-    _barrier(f"ckpt_committed_{step}")
+    barrier(f"ckpt_committed_{step}")
     return _step_dir(root, step)
+
+
+class AsyncSaver:
+    """Background checkpoint writer: ``save()`` snapshots the tree to
+    host SYNCHRONOUSLY (cheap next to a train step; the device arrays
+    are free to be mutated immediately) and commits the files from a
+    worker thread with the same atomic protocol, so training never
+    stalls on disk IO.
+
+    Single-process only: the multi-process protocol synchronizes with
+    device collectives, which must not run off the main thread —
+    ``save()`` falls back to the synchronous path when
+    ``jax.process_count() > 1``.  At most ONE save is in flight; the
+    next ``save()`` (and ``wait()``) joins the previous thread and
+    re-raises any IO error from it.
+    """
+
+    def __init__(self):
+        self._thread = None
+
+    def save(self, root: str, step: int, tree, *, keep=None) -> None:
+        import threading
+
+        self.wait()
+        if jax.process_count() > 1:
+            save(root, step, tree, keep=keep)
+            return
+        _prepare_tmp(root, step)
+        snapshot = _snapshot(tree, 0, copy=True)
+        result: dict = {}
+
+        def work():
+            try:
+                _write_and_commit(
+                    root, step, 0, 1, snapshot, keep, lambda tag: None
+                )
+            except BaseException as e:  # surfaced by the next wait()
+                result["error"] = e
+
+        self._thread = threading.Thread(target=work, daemon=True)
+        self._result = result
+        self._thread.start()
+
+    def wait(self) -> None:
+        """Join the in-flight save (if any) and re-raise its error."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+            err = self._result.pop("error", None)
+            if err is not None:
+                raise err
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.wait()
 
 
 class _ShardReader:

@@ -72,6 +72,9 @@ class TrainLoopConfig:
     ckpt_every: int = 0
     keep: int = 2
     resume: bool = False
+    # write checkpoints from a background thread (single-process): the
+    # train step after a save overlaps the disk IO instead of stalling
+    ckpt_async: bool = False
     # observability: emit a train_step Record every k steps (0 = only the
     # final summary Record) — loss curve + throughput in the same JSONL
     # stream every pattern writes (core/results.py)
@@ -265,6 +268,7 @@ def train(mesh: Mesh, cfg: TrainLoopConfig, writer=None) -> dict:
 
     loss = None
     get_batch, close_source = _make_batch_source(cfg, mesh, start)
+    saver = ckpt.AsyncSaver() if cfg.ckpt_async else None
     t0 = time.perf_counter()
     t_window, window_start = t0, start
     try:
@@ -280,7 +284,10 @@ def train(mesh: Mesh, cfg: TrainLoopConfig, writer=None) -> dict:
                 and (t + 1) % cfg.ckpt_every == 0
             ):
                 jax.block_until_ready(tree)
-                ckpt.save(cfg.ckpt_dir, t + 1, tree, keep=cfg.keep)
+                if saver is not None:
+                    saver.save(cfg.ckpt_dir, t + 1, tree, keep=cfg.keep)
+                else:
+                    ckpt.save(cfg.ckpt_dir, t + 1, tree, keep=cfg.keep)
             if t == start and cfg.log_every > 0:
                 # restart the window AFTER the first step: it carries the
                 # jit compile, which would otherwise dominate the first
@@ -308,7 +315,14 @@ def train(mesh: Mesh, cfg: TrainLoopConfig, writer=None) -> dict:
                     t_window, window_start = now, t + 1
         jax.block_until_ready(tree)
     finally:
-        close_source()
+        # join the in-flight save even when the loop raised: a completed
+        # step's checkpoint must not be abandoned mid-commit, and a
+        # stored async IO error must surface, not vanish with the thread
+        try:
+            if saver is not None:
+                saver.wait()
+        finally:
+            close_source()
     elapsed = time.perf_counter() - t0
     ran = cfg.steps - start
     out = {
