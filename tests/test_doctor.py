@@ -1,0 +1,76 @@
+"""Runtime health probes (core/doctor.py): layer classification,
+hang containment, healthy-path metrics."""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tpu_patterns.core.doctor import DoctorConfig, _probe, run_doctor
+from tpu_patterns.core.results import ResultWriter
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+class TestProbe:
+    def test_hang_is_killed_and_classified(self):
+        out = _probe("import time; time.sleep(3600)", timeout=2)
+        assert not out["ok"]
+        assert "hang" in out["error"]
+        assert out["elapsed_s"] < 10
+
+    def test_crash_is_classified_with_stderr_tail(self):
+        out = _probe("raise RuntimeError('boom')", timeout=10)
+        assert not out["ok"]
+        assert "rc=1" in out["error"] and "boom" in out["error"]
+
+    def test_garbage_output_is_an_error(self):
+        out = _probe("print('not json')", timeout=10)
+        assert not out["ok"]
+        assert "parseable" in out["error"]
+
+    def test_last_json_line_wins(self):
+        out = _probe(
+            "print('chatter'); print('{\"x\": 1}')", timeout=10
+        )
+        assert out["ok"] and out["x"] == 1
+
+
+class TestRunDoctor:
+    def test_healthy_cpu_backend(self, monkeypatch):
+        # pin the probe children to cpu unconditionally and without
+        # leaking into later tests
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        writer = ResultWriter()
+        (rec,) = run_doctor(DoctorConfig(probe_timeout=120), writer)
+        assert rec.verdict.value == "SUCCESS", rec.notes
+        assert rec.metrics["backend_init_ok"] == 1.0
+        assert rec.metrics["tiny_op_ok"] == 1.0
+        assert rec.metrics["deep_compute_ok"] == 1.0
+        assert rec.metrics["native_ffi_ok"] == 1.0
+        assert rec.metrics["native_loader_ok"] == 1.0
+        assert rec.metrics["tiny_op_compile_s"] >= 0
+
+    def test_broken_backend_names_the_layer_and_skips_the_rest(self):
+        # a bogus platform kills the first probe child fast; the doctor
+        # must name backend_init and not waste deadlines on later layers
+        env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+        env["PYTHONPATH"] = str(ROOT)
+        env["JAX_PLATFORMS"] = "no_such_platform"
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "tpu_patterns", "doctor",
+                "--probe_timeout", "60",
+            ],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=200,
+            cwd=ROOT,
+        )
+        assert proc.returncode != 0  # FAILURE verdict -> nonzero exit
+        out = proc.stdout + proc.stderr
+        assert "backend_init" in out
+        assert "skipped" in out  # deep_compute not attempted

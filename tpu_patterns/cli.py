@@ -356,6 +356,12 @@ def _cmd_lm(args, writer: ResultWriter) -> None:
     run_lm(_mesh3d_from_args(args), _cfg_from_args(LMConfig, args), writer)
 
 
+def _cmd_doctor(args, writer: ResultWriter) -> None:
+    from tpu_patterns.core.doctor import DoctorConfig, run_doctor
+
+    run_doctor(_cfg_from_args(DoctorConfig, args), writer)
+
+
 def _cmd_pipeline(args, writer: ResultWriter) -> None:
     import dataclasses
 
@@ -701,6 +707,16 @@ def build_parser() -> argparse.ArgumentParser:
     add_config_args(lmp, LMConfig)
     _add_mesh3d_args(lmp)
 
+    dr = sub.add_parser(
+        "doctor",
+        help="deadline-bounded runtime health probes (backend init / tiny "
+        "op / real compute / native modules) — names the broken layer "
+        "instead of hanging",
+    )
+    from tpu_patterns.core.doctor import DoctorConfig
+
+    add_config_args(dr, DoctorConfig)
+
     pl = sub.add_parser(
         "pipeline", help="GPipe vs 1F1B schedule benchmark (bubble + memory)"
     )
@@ -782,6 +798,7 @@ def main(argv: list[str] | None = None) -> int:
         "train": _cmd_train,
         "decode": _cmd_decode,
         "lm": _cmd_lm,
+        "doctor": _cmd_doctor,
         "pipeline": _cmd_pipeline,
         "moe": _cmd_moe,
         "miniapps": _cmd_miniapps,

@@ -1,0 +1,222 @@
+"""Failure detection: deadline-bounded health probes of the runtime.
+
+The suite's measurements die in characteristic ways — a dead device
+tunnel hangs INSIDE native backend init with the GIL held (no Python
+timeout fires), a half-alive one passes a tiny op then stalls on real
+work, a missing toolchain silently disables the native modules.  The
+``doctor`` subcommand turns the countermeasures bench.py grew
+(subprocess probes a parent can SIGKILL, escalating workload sizes)
+into a first-class diagnostic: every probe runs in a child with a hard
+deadline, so the doctor itself can NEVER hang, and the report says
+which layer broke — backend init, tiny compile, real compute, native
+build — instead of a generic timeout.
+
+Reference analogue: the exit-code-is-the-verdict discipline
+(`/root/reference/concurency/main.cpp:270,321`) applied to the runtime
+itself rather than a measurement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+@dataclasses.dataclass
+class DoctorConfig:
+    """CLI ``doctor`` subcommand."""
+
+    probe_timeout: int = 60  # per-probe deadline (s)
+    # escalate to a real-workload probe (a matmul large enough to catch
+    # the passes-preflight-then-hangs failure mode)
+    deep: bool = True
+    deep_timeout: int = 120
+
+
+# Probe scripts run in children: each prints ONE json line on success.
+# They test whatever backend the environment selects — with the caveat
+# that site-installed platform plugins can intercept backend init even
+# when JAX_PLATFORMS is set in the env, so an explicit env pin is
+# re-applied IN-PROCESS via jax.config (the only override that always
+# wins); with no pin, the default (production) backend is probed.
+_PLATFORM_PRELUDE = """
+import json, os, time
+import jax
+_p = os.environ.get("TPU_PATTERNS_PLATFORM") or os.environ.get(
+    "JAX_PLATFORMS"
+)
+if _p:
+    jax.config.update("jax_platforms", _p)
+"""
+
+_PROBE_INIT = _PLATFORM_PRELUDE + """
+t0 = time.perf_counter()
+devs = jax.devices()
+print(json.dumps({
+    "platform": devs[0].platform,
+    "device_kind": getattr(devs[0], "device_kind", devs[0].platform),
+    "device_count": len(devs),
+    "init_s": round(time.perf_counter() - t0, 2),
+}))
+"""
+
+_PROBE_TINY = _PLATFORM_PRELUDE + """
+import jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.float32)
+t0 = time.perf_counter()
+jax.block_until_ready(jnp.dot(x, x))
+compile_s = time.perf_counter() - t0
+t0 = time.perf_counter()
+for _ in range(3):
+    y = jnp.dot(x, x)
+jax.block_until_ready(y)
+print(json.dumps({
+    "compile_s": round(compile_s, 2),
+    "warm_3x_ms": round(1e3 * (time.perf_counter() - t0), 2),
+}))
+"""
+
+_PROBE_DEEP = _PLATFORM_PRELUDE + """
+import jax.numpy as jnp
+# large enough that a half-alive tunnel stalls here, small enough to be
+# cheap on a healthy chip (~0.5 GFLOP + a 64 MB transfer)
+x = jnp.ones((4096, 2048), jnp.bfloat16)
+t0 = time.perf_counter()
+y = x @ x.T
+jax.block_until_ready(y)
+import numpy as np
+s = float(np.asarray(y[0, 0], np.float32))
+print(json.dumps({
+    "deep_s": round(time.perf_counter() - t0, 2),
+    "checksum_ok": s == 2048.0,
+}))
+"""
+
+
+def _probe(script: str, timeout: int) -> dict:
+    """Run one probe in a SIGKILL-able child; classify the outcome."""
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            env=dict(os.environ),
+        )
+    except subprocess.TimeoutExpired:
+        return {
+            "ok": False,
+            "error": f"hang (killed after {timeout}s)",
+            "elapsed_s": round(time.perf_counter() - t0, 1),
+        }
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()
+        return {
+            "ok": False,
+            "error": f"rc={proc.returncode}: {tail[-1][:200] if tail else ''}",
+            "elapsed_s": round(time.perf_counter() - t0, 1),
+        }
+    for line in reversed((proc.stdout or "").strip().splitlines()):
+        try:
+            out = json.loads(line)
+            break
+        except ValueError:
+            continue
+    else:
+        return {"ok": False, "error": "no parseable probe output"}
+    out["ok"] = True
+    out["elapsed_s"] = round(time.perf_counter() - t0, 1)
+    return out
+
+
+def run_doctor(cfg: DoctorConfig, writer) -> list:
+    """Layered health report; verdict FAILURE iff a layer is broken.
+
+    Layers (each subsumes the previous): backend init -> tiny
+    compile+run -> real-workload compute (``deep``) -> native modules
+    (build-on-demand FFI + loader).  The first broken layer names the
+    failure; later layers are skipped (their result would be noise).
+    """
+    from tpu_patterns.core.results import Record, Verdict
+
+    checks: dict[str, dict] = {}
+    broken: str | None = None
+
+    for name, script, deadline, gated in (
+        ("backend_init", _PROBE_INIT, cfg.probe_timeout, True),
+        ("tiny_op", _PROBE_TINY, cfg.probe_timeout, True),
+        ("deep_compute", _PROBE_DEEP, cfg.deep_timeout, cfg.deep),
+    ):
+        if not gated or broken is not None:
+            if gated and broken is not None:
+                checks[name] = {"ok": False, "error": f"skipped: {broken}"}
+            continue
+        checks[name] = _probe(script, deadline)
+        if checks[name].get("checksum_ok") is False:
+            # completed but computed GARBAGE: the worst failure mode —
+            # never certify a runtime that returns wrong answers
+            checks[name]["ok"] = False
+            checks[name]["error"] = "checksum mismatch (wrong results)"
+        if not checks[name]["ok"]:
+            broken = f"{name} failed"
+
+    # native modules never touch the device: always probed
+    from tpu_patterns.interop import native
+    from tpu_patterns.io import loader as io_loader
+
+    # call availability ONCE each: on a broken toolchain every call
+    # re-runs make (bounded by its 300s timeout), and "never hangs"
+    # must include the build probes
+    ffi_ok = native.available()
+    checks["native_ffi"] = {
+        "ok": ffi_ok,
+        **({} if ffi_ok else {"error": str(native.build_error())}),
+    }
+    loader_ok = io_loader.native_available()
+    checks["native_loader"] = {
+        "ok": loader_ok,
+        **({} if loader_ok else {"error": str(io_loader.build_error())}),
+    }
+
+    # the layer-by-layer diagnosis is the product: print it, don't bury
+    # it in the JSONL notes
+    for name, c in checks.items():
+        status = "ok" if c.get("ok") else f"FAILED ({c.get('error', '?')})"
+        detail = " ".join(
+            f"{k}={c[k]}"
+            for k in ("platform", "device_kind", "device_count", "init_s",
+                      "compile_s", "warm_3x_ms", "deep_s")
+            if k in c
+        )
+        print(
+            f"# doctor {name}: {status}" + (f" [{detail}]" if detail else ""),
+            file=writer.stream,
+            flush=True,
+        )
+
+    healthy = all(c.get("ok") for c in checks.values())
+    metrics: dict[str, float] = {}
+    for name, c in checks.items():
+        metrics[f"{name}_ok"] = 1.0 if c.get("ok") else 0.0
+        for k in ("init_s", "compile_s", "warm_3x_ms", "deep_s", "elapsed_s"):
+            if k in c:
+                metrics[f"{name}_{k}"] = float(c[k])
+    rec = Record(
+        pattern="doctor",
+        mode=str(checks.get("backend_init", {}).get("device_kind", "down")),
+        commands=f"probe_timeout={cfg.probe_timeout}s deep={cfg.deep}",
+        metrics=metrics,
+        verdict=Verdict.SUCCESS if healthy else Verdict.FAILURE,
+        notes=[
+            f"{name}: {c['error']}"
+            for name, c in checks.items()
+            if not c.get("ok") and "error" in c
+        ],
+    )
+    writer.record(rec)
+    return [rec]
