@@ -187,8 +187,8 @@ class TestSweep:
         meas = sweep.specs_for("measured", quick=True)
         assert {s.name.split(".")[0] for s in meas} == {"measured"}
         # onesided + interop + 6 concurrency + 4 flash + 5 flagship
-        # + decode (mha + gqa + int8)
-        assert len(meas) == 20
+        # + decode (mha + gqa + int8) + lm
+        assert len(meas) == 21
         # every flash cell pins --devices to exactly 1 (any other world
         # would silently SKIP the cell and checkpoint it as passed)
         for s in meas:

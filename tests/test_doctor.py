@@ -43,6 +43,7 @@ class TestRunDoctor:
         # pin the probe children to cpu unconditionally and without
         # leaking into later tests
         monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        monkeypatch.delenv("TPU_PATTERNS_PLATFORM", raising=False)
         writer = ResultWriter()
         (rec,) = run_doctor(DoctorConfig(probe_timeout=120), writer)
         assert rec.verdict.value == "SUCCESS", rec.notes
@@ -56,7 +57,11 @@ class TestRunDoctor:
     def test_broken_backend_names_the_layer_and_skips_the_rest(self):
         # a bogus platform kills the first probe child fast; the doctor
         # must name backend_init and not waste deadlines on later layers
-        env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+        env = {
+            k: v
+            for k, v in os.environ.items()
+            if k not in ("PYTHONPATH", "TPU_PATTERNS_PLATFORM")
+        }
         env["PYTHONPATH"] = str(ROOT)
         env["JAX_PLATFORMS"] = "no_such_platform"
         proc = subprocess.run(

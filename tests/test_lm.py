@@ -111,6 +111,28 @@ class TestLMTraining:
             p, loss = step(p, st)
         assert float(loss) < 0.7 * float(first)
 
+    def test_striped_layout_loss_matches_contiguous(self, mesh3d):
+        # the striped halo (whole-block permute + last-stripe shift) must
+        # compute the SAME mean CE as the contiguous layout on the same
+        # global token stream — rope makes positions load-bearing too
+        cfg_c = ModelConfig(**CFG, rope=True)
+        cfg_s = ModelConfig(**CFG, rope=True, attn_layout="striped")
+        params = lm.init_lm_params(jax.random.key(0), cfg_c, V)
+        toks = jax.random.randint(jax.random.key(1), (4, 32), 0, V)
+        ref = float(lm.lm_loss_shard(params, toks, cfg_c))
+        step, _ = lm.make_lm_train_step(mesh3d, cfg_s, V, lr=0.0)
+        sp = 2
+        striped = jnp.concatenate(
+            [toks[:, r::sp] for r in range(sp)], axis=1
+        )
+        _, loss = step(
+            lm.shard_lm_params(params, mesh3d, cfg_s),
+            jax.device_put(striped, NamedSharding(mesh3d, P("dp", "sp"))),
+        )
+        assert np.isclose(ref, float(loss), rtol=1e-5), (
+            ref, float(loss)
+        )
+
     def test_vocab_indivisible_rejected(self, mesh3d):
         with pytest.raises(ValueError, match="vocab"):
             lm.make_lm_train_step(mesh3d, ModelConfig(**CFG), 63)

@@ -46,11 +46,23 @@ class DoctorConfig:
 _PLATFORM_PRELUDE = """
 import json, os, time
 import jax
+try:
+    # the environment the REAL runs use: TPU_PATTERNS_PLATFORM pin,
+    # simulated-mesh device count, persistent compile cache
+    from tpu_patterns.runtime import setup_jax
+    setup_jax()
+except Exception:
+    pass  # package not importable in this child: pin below still applies
+# setup_jax honors only TPU_PATTERNS_PLATFORM; a bare JAX_PLATFORMS env
+# pin must ALSO be applied in-process (site plugins intercept the env var)
 _p = os.environ.get("TPU_PATTERNS_PLATFORM") or os.environ.get(
     "JAX_PLATFORMS"
 )
 if _p:
-    jax.config.update("jax_platforms", _p)
+    try:
+        jax.config.update("jax_platforms", _p)
+    except Exception:
+        pass
 """
 
 _PROBE_INIT = _PLATFORM_PRELUDE + """

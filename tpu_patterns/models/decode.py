@@ -424,6 +424,12 @@ def make_decoder(
     """
     if cfg.moe:
         raise NotImplementedError("decode pattern covers the dense block")
+    if cfg.attn_layout != "contiguous":
+        raise NotImplementedError(
+            "decode's cache layout and prefill ring are contiguous; a "
+            "striped-trained model must decode with attn_layout="
+            "'contiguous' semantics (positions would silently be wrong)"
+        )
     dp = int(mesh.shape["dp"])
     sp = int(mesh.shape["sp"])
     if batch % dp:

@@ -17,9 +17,10 @@ distributed patterns:
   for a deterministic lowest-id tie-break.
 
 Weights are tied (the embedding table is the LM head), and the
-next-token targets cross the sp boundary by a one-column ppermute halo
-— the contiguous sequence layout's shift is rank-local except for each
-shard's last position, whose target is the NEXT rank's first token.
+next-token targets cross the sp boundary by the layout's halo exchange:
+contiguous shards need only their last column's successor (a one-column
+ppermute), striped shards' successors all live on the next stripe (a
+whole-block ppermute, the last stripe also shifting one step).
 
 Reference lineage: this stays a patterns suite — the LM is the smallest
 model that makes the vocab patterns real, not a model zoo.
@@ -172,16 +173,13 @@ def lm_loss_shard(
 ):
     """Mean next-token cross-entropy of the tied-weight LM.
 
-    tokens [B, L_local] (contiguous sp sharding).  Targets are tokens
-    shifted one left; each shard's LAST position's target is the next
-    rank's FIRST token, fetched by a one-column ppermute halo.  The
-    final global position has no target and is masked out of the mean.
+    tokens [B, L_local].  Targets are the next GLOBAL token, fetched by
+    the layout's halo exchange: contiguous shards need only their last
+    column's successor (a one-column ppermute); striped shards'
+    successors all live on the next stripe (a whole-block ppermute, the
+    last stripe also shifting one step).  The final global position has
+    no target and is masked out of the mean.
     """
-    if cfg.attn_layout != "contiguous":
-        raise NotImplementedError(
-            "lm loss supports the contiguous sequence layout (the striped "
-            "halo is a whole-block permute, not a column)"
-        )
     wemb = params["wemb"]
     x = embed_tokens(wemb, tokens, tp_axis)
     y = _blocks(
@@ -191,22 +189,34 @@ def lm_loss_shard(
 
     l_loc = tokens.shape[1]
     if sp_axis is not None and sp_size > 1:
-        # halo: my last position's target = next rank's first token.
-        # ppermute moves r's first column to r-1 (ring; rank sp-1's halo
-        # arrives from rank 0 but is masked as the final global position)
-        halo = lax.ppermute(
-            tokens[:, 0],
-            sp_axis,
-            [(r, (r - 1) % sp_size) for r in range(sp_size)],
-        )
         r = lax.axis_index(sp_axis)
+        back = [(j, (j - 1) % sp_size) for j in range(sp_size)]
+        if cfg.attn_layout == "striped":
+            # striped shard r holds global tokens r::sp: token (r, i)'s
+            # successor is (r+1, i) for r < sp-1, and (0, i+1) for the
+            # last stripe — so the halo is the NEXT stripe's whole block
+            # (one ppermute), with the last stripe also shifting by one
+            nxt = lax.ppermute(tokens, sp_axis, back)
+            shifted = jnp.concatenate(
+                [nxt[:, 1:], nxt[:, :1]], axis=1  # wrap slot is masked
+            )
+            targets = jnp.where(r == sp_size - 1, shifted, nxt)
+            gpos = r + sp_size * jnp.arange(l_loc)
+        else:
+            # contiguous: targets are rank-local except the last column,
+            # whose target is the next rank's FIRST token (column halo)
+            halo = lax.ppermute(tokens[:, 0], sp_axis, back)
+            targets = jnp.concatenate(
+                [tokens[:, 1:], halo[:, None]], axis=1
+            )
+            gpos = r * l_loc + jnp.arange(l_loc)
     else:
-        halo = tokens[:, 0]  # self; masked below
-        r = 0
-    targets = jnp.concatenate([tokens[:, 1:], halo[:, None]], axis=1)
+        targets = jnp.concatenate(
+            [tokens[:, 1:], tokens[:, :1]], axis=1  # wrap slot is masked
+        )
+        gpos = jnp.arange(l_loc)
     ce = vocab_parallel_ce(logits, targets, tp_axis)  # [B, Lloc]
     # the LAST global position predicts nothing
-    gpos = r * l_loc + jnp.arange(l_loc)
     l_global = l_loc * sp_size
     w = (gpos < l_global - 1).astype(ce.dtype)[None, :]
     num = jnp.sum(ce * w)
@@ -419,6 +429,11 @@ def make_lm_decoder(
     sp = int(mesh.shape["sp"])
     if batch % dp:
         raise ValueError(f"batch {batch} % dp={dp} != 0")
+    if cfg.attn_layout != "contiguous":
+        raise NotImplementedError(
+            "lm generation requires the contiguous layout (the decode "
+            "cache and prefill ring hardcode contiguous positions)"
+        )
     _check_kv_heads_shardable(cfg, mesh)
     layout = D._CacheLayout(prefill_len, gen_cap, sp)
     sp_axis = "sp" if sp > 1 else None

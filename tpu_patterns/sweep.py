@@ -498,6 +498,21 @@ def measured_specs(quick: bool = False) -> list[SweepSpec]:
             env=env,
         )
     )
+    # token-level LM on one chip: train steps/s + greedy tokens/s
+    lm_args = (
+        ("--vocab", "64", "--embed", "64", "--head_dim", "8",
+         "--seq", "32", "--steps", "5", "--gen", "8")
+        if quick
+        else ("--vocab", "4096", "--embed", "512", "--seq", "1024",
+              "--steps", "20", "--gen", "64", "--dtype", "bfloat16")
+    )
+    specs.append(
+        SweepSpec(
+            name="measured.lm_vocab_parallel",
+            argv=("lm", "--devices", "1", *lm_args),
+            env=env,
+        )
+    )
     return specs
 
 
