@@ -85,15 +85,22 @@ class TestVocabParallelPrimitives:
 
 
 class TestLMTraining:
-    def test_sharded_loss_matches_single_device(self, mesh3d):
+    @pytest.mark.parametrize(
+        "shape", [(2, 2, 2), (1, 1, 1), (1, 2, 1)]
+    )
+    def test_sharded_loss_matches_single_device(self, devices, shape):
+        # includes the DEGENERATE (1,1,1) mesh: size-1 axes must not trip
+        # the varying-axes check (psum over them is a no-op, not skipped)
+        n = int(np.prod(shape))
+        mesh = Mesh(np.array(devices[:n]).reshape(shape), ("dp", "sp", "tp"))
         cfg = ModelConfig(**CFG, rope=True)
         params = lm.init_lm_params(jax.random.key(0), cfg, V)
         toks = jax.random.randint(jax.random.key(1), (4, 32), 0, V)
         ref = float(lm.lm_loss_shard(params, toks, cfg))
-        step, _ = lm.make_lm_train_step(mesh3d, cfg, V, lr=0.0)
+        step, _ = lm.make_lm_train_step(mesh, cfg, V, lr=0.0)
         _, loss = step(
-            lm.shard_lm_params(params, mesh3d, cfg),
-            jax.device_put(toks, NamedSharding(mesh3d, P("dp", "sp"))),
+            lm.shard_lm_params(params, mesh, cfg),
+            jax.device_put(toks, NamedSharding(mesh, P("dp", "sp"))),
         )
         assert np.isclose(ref, float(loss), rtol=1e-5)
         # sanity: the loss is in the right ballpark of ln(V) at init

@@ -250,8 +250,11 @@ def make_lm_train_step(
         raise ValueError(f"vocab {vocab} must divide over tp={tp}")
     sp = int(mesh.shape["sp"])
     specs = lm_param_specs(cfg)
-    sp_axis = "sp" if sp > 1 else None
-    tp_axis = "tp" if tp > 1 else None
+    # axes are used UNCONDITIONALLY inside the shard_map: a psum over a
+    # size-1 axis is a no-op in XLA, while skipping it leaves values
+    # formally tp/sp-varying and fails the varying-axes check on
+    # degenerate meshes (e.g. --devices 1)
+    sp_axis, tp_axis = "sp", "tp"
 
     def step(params, tokens):
         loss, grads = jax.value_and_grad(lm_loss_shard)(
