@@ -181,6 +181,35 @@ class TestAtomicity:
             ckpt.save(str(tmp_path), 1, {"x": 3.14})
 
 
+class TestDescribe:
+    def test_describe_lists_steps_and_leaves(self, mesh2d, tmp_path):
+        tree = _tree(mesh2d)
+        ckpt.save(str(tmp_path), 1, tree)
+        ckpt.save(str(tmp_path), 5, tree)
+        info = ckpt.describe(str(tmp_path))
+        assert [s["step"] for s in info["steps"]] == [1, 5]
+        s = info["steps"][0]
+        assert s["bytes"] > 0 and s["process_count"] == 1
+        keys = {leaf["key"] for leaf in s["leaves"]}
+        assert keys == {"['w']", "['inner']['b']", "['inner']['h']", "['step']"}
+        w = next(x for x in s["leaves"] if x["key"] == "['w']")
+        assert w["shape"] == [64, 32] and w["dtype"] == "float32"
+        assert w["spec"] == ["dp", "tp"]
+
+    def test_describe_empty_dir(self, tmp_path):
+        assert ckpt.describe(str(tmp_path))["steps"] == []
+
+    def test_cli_ckpt_inspector(self, mesh2d, tmp_path, capsys):
+        from tpu_patterns.cli import main
+
+        tree = _tree(mesh2d)
+        ckpt.save(str(tmp_path), 3, tree)
+        assert main(["ckpt", str(tmp_path), "--leaves"]) == 0
+        out = capsys.readouterr().out
+        assert "step_3" in out and "latest: step_3" in out
+        assert "['w']: (64, 32) float32 spec=(dp,tp)" in out
+
+
 class TestAsyncSaver:
     def test_async_commit_matches_sync(self, mesh2d, tmp_path):
         tree = _tree(mesh2d)

@@ -17,11 +17,13 @@ mesh need not match (elastic restore onto a different topology).
 from tpu_patterns.ckpt.checkpoint import (
     AsyncSaver,
     available_steps,
+    describe,
     latest_step,
     restore,
     save,
 )
 
 __all__ = [
-    "AsyncSaver", "available_steps", "latest_step", "restore", "save",
+    "AsyncSaver", "available_steps", "describe", "latest_step",
+    "restore", "save",
 ]

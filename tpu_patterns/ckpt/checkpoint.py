@@ -448,3 +448,46 @@ def restore(root: str, like, *, step: int | None = None):
     finally:
         reader.close()
     return jax.tree_util.tree_unflatten(treedef, out_leaves)
+
+
+def describe(root: str) -> dict:
+    """Operator's view of a checkpoint directory: committed steps, and
+    per-step leaf table (key, shape, dtype, spec) + on-disk bytes.
+
+    Read-only and manifest-driven — describing never touches shard data,
+    so it is safe on checkpoints too big to load.
+    """
+    steps = available_steps(root)
+    out = {"root": os.path.abspath(root), "steps": []}
+    for step in steps:
+        path = _step_dir(root, step)
+        try:
+            with open(os.path.join(path, "manifest.json")) as f:
+                manifest = json.load(f)
+        except OSError:
+            # a trainer pruned/re-committed this step between the scan
+            # and the read — a read-only inspector skips, never crashes
+            continue
+        n_bytes = 0
+        for name in os.listdir(path):
+            try:
+                n_bytes += os.path.getsize(os.path.join(path, name))
+            except OSError:
+                pass
+        out["steps"].append(
+            {
+                "step": step,
+                "bytes": n_bytes,
+                "process_count": manifest.get("process_count", 1),
+                "leaves": [
+                    {
+                        "key": info["key"],
+                        "shape": info["shape"],
+                        "dtype": info["dtype"],
+                        "spec": info.get("spec", []),
+                    }
+                    for info in manifest["leaves"]
+                ],
+            }
+        )
+    return out

@@ -362,6 +362,36 @@ def _cmd_doctor(args, writer: ResultWriter) -> None:
     run_doctor(_cfg_from_args(DoctorConfig, args), writer)
 
 
+def _cmd_ckpt(args, writer: ResultWriter) -> None:
+    """Inspect a checkpoint directory (read-only, manifest-driven)."""
+    from tpu_patterns import ckpt
+
+    info = ckpt.describe(args.dir)
+    if not info["steps"]:
+        print(f"no committed checkpoints under {info['root']}")
+        return
+    for s in info["steps"]:
+        mb = s["bytes"] / 1e6
+        print(
+            f"step_{s['step']}: {mb:.2f} MB, "
+            f"{s['process_count']} process(es), {len(s['leaves'])} leaves"
+        )
+        if args.leaves:
+            for leaf in s["leaves"]:
+                # merged axes render as a+b, replicated dims as '-'
+                parts = [
+                    "+".join(e) if isinstance(e, list) else
+                    ("-" if e is None else str(e))
+                    for e in leaf["spec"]
+                ]
+                spec = ",".join(parts) or "-"
+                print(
+                    f"  {leaf['key']}: {tuple(leaf['shape'])} "
+                    f"{leaf['dtype']} spec=({spec})"
+                )
+    print(f"latest: step_{info['steps'][-1]['step']}")
+
+
 def _cmd_pipeline(args, writer: ResultWriter) -> None:
     import dataclasses
 
@@ -717,6 +747,16 @@ def build_parser() -> argparse.ArgumentParser:
 
     add_config_args(dr, DoctorConfig)
 
+    ck = sub.add_parser(
+        "ckpt",
+        help="inspect a checkpoint directory: committed steps, sizes, "
+        "leaf table (read-only)",
+    )
+    ck.add_argument("dir", help="checkpoint root (the train --ckpt_dir)")
+    ck.add_argument(
+        "--leaves", action="store_true", help="print the per-leaf table"
+    )
+
     pl = sub.add_parser(
         "pipeline", help="GPipe vs 1F1B schedule benchmark (bubble + memory)"
     )
@@ -799,6 +839,7 @@ def main(argv: list[str] | None = None) -> int:
         "decode": _cmd_decode,
         "lm": _cmd_lm,
         "doctor": _cmd_doctor,
+        "ckpt": _cmd_ckpt,
         "pipeline": _cmd_pipeline,
         "moe": _cmd_moe,
         "miniapps": _cmd_miniapps,
