@@ -204,6 +204,70 @@ class TestLMDecode:
         np.testing.assert_array_equal(cold, greedy)
         assert ((a1 >= 0) & (a1 < V)).all()
 
+    def test_topk_sample_matches_truncated_softmax(self, devices):
+        # top-2 of 8: only the two highest-probability ids may appear,
+        # with frequencies matching the RENORMALIZED softmax over them
+        mesh = Mesh(np.array(devices[:4]), ("tp",))
+        logits = jnp.log(
+            jnp.asarray([0.4, 0.2, 0.1, 0.1, 0.05, 0.05, 0.05, 0.05])
+        )
+        n_draws = 4096
+        lg = jnp.broadcast_to(logits, (n_draws, 8))
+
+        def body(lg_local, seeds):
+            return lm.sharded_topk_sample(
+                lg_local, jax.random.key(seeds[0]), 1.0, 2, "tp"
+            )
+
+        # check_vma off: the all_gathered candidates ARE tp-replicated,
+        # but the checker cannot infer it (same setting as the decode
+        # shard_maps that host this sampler in production)
+        draws = np.asarray(jax.jit(
+            jax.shard_map(
+                body, mesh=mesh, in_specs=(P(None, "tp"), P()),
+                out_specs=P(), check_vma=False,
+            )
+        )(
+            jax.device_put(lg, NamedSharding(mesh, P(None, "tp"))),
+            jax.device_put(
+                jnp.asarray([9], jnp.uint32), NamedSharding(mesh, P())
+            ),
+        ))
+        assert set(np.unique(draws)) <= {0, 1}
+        freq0 = (draws == 0).mean()
+        assert abs(freq0 - 0.4 / 0.6) < 0.05
+
+    def test_topk_rollout_layout_invariant(self, devices):
+        # the id-canonicalized candidate order makes top-k draws
+        # bit-identical across sp/tp layouts given the same seed.  (dp
+        # layouts legitimately differ: the noise key folds the dp rank
+        # so batch shards draw independently — "deterministic in
+        # (key, mesh)", not across dp re-shardings.)
+        cfg = ModelConfig(**CFG, rope=True)
+        params = lm.init_lm_params(jax.random.key(0), cfg, V)
+        toks = jax.random.randint(jax.random.key(1), (4, 16), 0, V)
+        outs = {}
+        for shape in [(1, 2, 4), (1, 1, 1)]:
+            n = int(np.prod(shape))
+            mesh = Mesh(
+                np.array(devices[:n]).reshape(shape), ("dp", "sp", "tp")
+            )
+            pre, gen = lm.make_lm_decoder(mesh, cfg, V, 4, 16, 8)
+            specs = lm.lm_param_specs(cfg)
+            sp_p = {
+                k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+                for k, v in params.items()
+            }
+            tk = jax.device_put(toks, NamedSharding(mesh, P("dp", "sp")))
+            caches, t0 = pre(sp_p, tk, temperature=0.7, seed=5, top_k=4)
+            _, out = gen(
+                sp_p, caches, t0, jnp.asarray(16), 8,
+                temperature=0.7, seed=5, top_k=4,
+            )
+            outs[shape] = (np.asarray(t0), np.asarray(out))
+        np.testing.assert_array_equal(outs[(1, 2, 4)][0], outs[(1, 1, 1)][0])
+        np.testing.assert_array_equal(outs[(1, 2, 4)][1], outs[(1, 1, 1)][1])
+
     def test_sharded_sample_matches_softmax_frequencies(self, devices):
         # the Gumbel trick over a SHARDED vocab must sample the true
         # softmax: 4k draws from a known 8-way distribution

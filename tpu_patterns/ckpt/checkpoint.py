@@ -469,7 +469,11 @@ def describe(root: str) -> dict:
             # and the read — a read-only inspector skips, never crashes
             continue
         n_bytes = 0
-        for name in os.listdir(path):
+        try:
+            names = os.listdir(path)
+        except OSError:
+            continue  # pruned between manifest read and size scan
+        for name in names:
             try:
                 n_bytes += os.path.getsize(os.path.join(path, name))
             except OSError:

@@ -134,6 +134,39 @@ def sharded_sample(logits_local, key, temperature, tp_axis):
     )
 
 
+def sharded_topk_sample(logits_local, key, temperature, k, tp_axis):
+    """Top-k temperature sampling over the SHARDED vocab without a full
+    gather: each rank's local top-k (any global top-k element is in its
+    owner's local top-k) is all_gathered as tiny [n*k] candidate lists,
+    the global top-k is taken everywhere, and a Gumbel draw picks among
+    the k survivors.  Candidates are re-sorted by global id first, so
+    the draw is bit-identical across tp layouts (top_k's value ordering
+    is not layout-stable under ties; ids are).  The key must NOT be
+    tp-folded — every rank holds the same candidates and must agree.
+    ``temperature <= 0`` falls back to greedy, like sharded_sample.
+    """
+    if temperature <= 0:
+        return sharded_argmax(logits_local, tp_axis)
+    f32 = logits_local.astype(jnp.float32)
+    vloc = f32.shape[-1]
+    off = _my_offset(vloc, tp_axis)
+    kk = min(k, vloc)
+    vals, idx = lax.top_k(f32, kk)
+    gids = idx.astype(jnp.int32) + off
+    if tp_axis is not None:
+        vals = lax.all_gather(vals, tp_axis, axis=-1, tiled=True)
+        gids = lax.all_gather(gids, tp_axis, axis=-1, tiled=True)
+    kfin = min(k, vals.shape[-1])
+    vals, pos = lax.top_k(vals, kfin)
+    cands = jnp.take_along_axis(gids, pos, axis=-1)
+    order = jnp.argsort(cands, axis=-1)
+    cands = jnp.take_along_axis(cands, order, axis=-1)
+    vals = jnp.take_along_axis(vals, order, axis=-1)
+    g = jax.random.gumbel(key, vals.shape, jnp.float32)
+    choice = jnp.argmax(vals / temperature + g, axis=-1)
+    return jnp.take_along_axis(cands, choice[..., None], axis=-1)[..., 0]
+
+
 def lm_param_specs(cfg: ModelConfig) -> dict[str, P]:
     """Block specs + the tied embedding table, vocab-sharded over tp."""
     specs = {k: s for k, (_, s) in param_specs(cfg).items()}
@@ -308,6 +341,7 @@ class LMConfig:
     lr: float = 0.5
     gen: int = 32  # tokens generated after training
     temperature: float = 0.0  # 0 = greedy; >0 = Gumbel-max sampling
+    top_k: int = 0  # restrict sampling to the k highest logits (0 = all)
     seed: int = 0
 
 
@@ -355,7 +389,9 @@ def run_lm(mesh: Mesh, cfg: LMConfig, writer) -> list:
         mesh, mcfg, cfg.vocab, cfg.batch, prefill_len, cfg.gen,
         cache_int8=cfg.cache_int8,
     )
-    gen_kw = dict(temperature=cfg.temperature, seed=cfg.seed)
+    gen_kw = dict(
+        temperature=cfg.temperature, seed=cfg.seed, top_k=cfg.top_k
+    )
     caches, tok0 = pre(p, st, **gen_kw)
     # warm the generate program first: the rollout is deterministic in
     # (caches, tok0, seed), so the timed second call does identical work
@@ -377,7 +413,9 @@ def run_lm(mesh: Mesh, cfg: LMConfig, writer) -> list:
         + (f"_gqa{cfg.kv_heads}" if cfg.kv_heads else "")
         + ("_int8" if cfg.cache_int8 else "")
         + (
-            f"_T{cfg.temperature}_seed{cfg.seed}"
+            f"_T{cfg.temperature}"
+            + (f"_k{cfg.top_k}" if cfg.top_k else "")
+            + f"_seed{cfg.seed}"
             if cfg.temperature > 0
             else ""
         ),
@@ -456,7 +494,7 @@ def make_lm_decoder(
     def _logits_last(wemb, y):  # y [B, 1, E] -> [B, V/tp]
         return jnp.einsum("be,ve->bv", y[:, 0, :], wemb)
 
-    def prefill_shard(params, tokens, lens, seed, *, temperature):
+    def prefill_shard(params, tokens, lens, seed, *, temperature, top_k):
         blocks, wemb = _split(params)
         x = embed_tokens(wemb, tokens, tp_axis).astype(
             jnp.dtype(cfg.dtype)
@@ -483,13 +521,15 @@ def make_lm_decoder(
             jax.random.fold_in(jax.random.key(seed), 0x7FFFFFFF),
             lax.axis_index("dp"),
         )
-        tok = sharded_sample(
-            _logits_last(wemb, y_last), key, temperature, tp_axis
-        )
+        logits = _logits_last(wemb, y_last)
+        if top_k > 0 and temperature > 0:
+            tok = sharded_topk_sample(logits, key, temperature, top_k, tp_axis)
+        else:
+            tok = sharded_sample(logits, key, temperature, tp_axis)
         return cache, tok
 
     def generate_shard(
-        params, cache, tok0, lens, n0, seed, *, n_steps, temperature
+        params, cache, tok0, lens, n0, seed, *, n_steps, temperature, top_k
     ):
         blocks, wemb = _split(params)
         base_key = jax.random.key(seed)
@@ -510,14 +550,19 @@ def make_lm_decoder(
 
             y2, cache = lax.scan(layer, x, (blocks, cache))
             # per-step key, folded with the dp rank (each batch shard
-            # must draw DIFFERENT noise) and again per tp rank inside
-            # the sampler; sp ranks share the key and agree on the draw
+            # must draw DIFFERENT noise); sp ranks share the key and
+            # agree on the draw.  Full-softmax sampling folds the tp
+            # rank internally; top-k must not (candidates replicated).
             step_key = jax.random.fold_in(
                 jax.random.fold_in(base_key, n), lax.axis_index("dp")
             )
-            nxt = sharded_sample(
-                _logits_last(wemb, y2), step_key, temperature, tp_axis
-            )
+            logits = _logits_last(wemb, y2)
+            if top_k > 0 and temperature > 0:
+                nxt = sharded_topk_sample(
+                    logits, step_key, temperature, top_k, tp_axis
+                )
+            else:
+                nxt = sharded_sample(logits, step_key, temperature, tp_axis)
             return (cache, nxt, n + 1), nxt
 
         (cache, _, _), toks = lax.scan(
@@ -529,10 +574,12 @@ def make_lm_decoder(
     lens_spec = P("dp")
 
     @functools.lru_cache(maxsize=None)
-    def _prefill_compiled(temperature: float):
+    def _prefill_compiled(temperature: float, top_k: int):
         return jax.jit(
             jax.shard_map(
-                functools.partial(prefill_shard, temperature=temperature),
+                functools.partial(
+                    prefill_shard, temperature=temperature, top_k=top_k
+                ),
                 mesh=mesh,
                 in_specs=(pspecs, P("dp", "sp"), lens_spec, P()),
                 out_specs=(cache_specs, tok_spec),
@@ -540,20 +587,22 @@ def make_lm_decoder(
             )
         )
 
-    def prefill(params, tokens, lens=None, temperature=0.0, seed=0):
+    def prefill(params, tokens, lens=None, temperature=0.0, seed=0,
+                top_k=0):
         if lens is None:
             lens = jnp.full((batch,), prefill_len, jnp.int32)
-        return _prefill_compiled(float(temperature))(
+        return _prefill_compiled(float(temperature), int(top_k))(
             _stacked(params), tokens, jnp.asarray(lens, jnp.int32),
             jnp.asarray(seed, jnp.uint32),
         )
 
     @functools.lru_cache(maxsize=None)
-    def _gen_compiled(n_steps: int, temperature: float):
+    def _gen_compiled(n_steps: int, temperature: float, top_k: int):
         return jax.jit(
             jax.shard_map(
                 functools.partial(
-                    generate_shard, n_steps=n_steps, temperature=temperature
+                    generate_shard, n_steps=n_steps,
+                    temperature=temperature, top_k=top_k,
                 ),
                 mesh=mesh,
                 in_specs=(
@@ -576,14 +625,15 @@ def make_lm_decoder(
                 out[k] = v if cfg.depth > 1 else v[None]
         return out
 
-    def generate(params, caches, tok, t0, n_steps, temperature=0.0, seed=0):
+    def generate(params, caches, tok, t0, n_steps, temperature=0.0,
+                 seed=0, top_k=0):
         if isinstance(t0, tuple):
             lens, n0 = t0
             lens = jnp.asarray(lens, jnp.int32)
         else:
             lens = jnp.full((batch,), prefill_len, jnp.int32)
             n0 = jnp.asarray(t0, jnp.int32) - prefill_len
-        return _gen_compiled(int(n_steps), float(temperature))(
+        return _gen_compiled(int(n_steps), float(temperature), int(top_k))(
             _stacked(params), caches,
             jnp.asarray(tok, jnp.int32), lens, jnp.asarray(n0, jnp.int32),
             jnp.asarray(seed, jnp.uint32),
