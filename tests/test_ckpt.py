@@ -320,6 +320,30 @@ class TestResume:
         assert ref["loss"] == res["loss"]
         _assert_tree_equal(ref["state"], res["state"])
 
+    def test_elastic_resume_on_a_different_mesh(
+        self, devices, mesh3d, tmp_path
+    ):
+        # the elastic story end to end: a run killed on the (2,2,2) mesh
+        # resumes on (4,2,1) — restore reshards the state, training
+        # continues, and EVERY param tracks the same-mesh continuation
+        # closely (bitwise equality is a same-mesh property; across
+        # meshes reduction orders differ)
+        mesh_b = Mesh(np.array(devices[:8]).reshape(4, 2, 1), MESH_AXES)
+        train(mesh3d, _loop_cfg(tmp_path, steps=4))
+        res_b = train(mesh_b, _loop_cfg(tmp_path, steps=6, resume=True))
+        assert res_b["start_step"] == 4
+        assert np.isfinite(res_b["loss"])
+        ref = train(mesh3d, _loop_cfg(tmp_path / "ref", steps=6))
+        for k, want in ref["state"]["params"].items():
+            got = res_b["state"]["params"][k]
+            np.testing.assert_allclose(
+                np.asarray(got, np.float32),
+                np.asarray(want, np.float32),
+                rtol=0, atol=1e-5, err_msg=k,
+            )
+            # and the restored placement is mesh B's
+            assert got.sharding.mesh.shape["dp"] == 4, k
+
     def test_resume_without_checkpoint_starts_fresh(self, mesh3d, tmp_path):
         out = train(
             mesh3d,
