@@ -270,6 +270,7 @@ def train(mesh: Mesh, cfg: TrainLoopConfig, writer=None) -> dict:
     get_batch, close_source = _make_batch_source(cfg, mesh, start)
     saver = ckpt.AsyncSaver() if cfg.ckpt_async else None
     t0 = time.perf_counter()
+    rate_start = start
     t_window, window_start = t0, start
     try:
         for t in range(start, cfg.steps):
@@ -288,13 +289,15 @@ def train(mesh: Mesh, cfg: TrainLoopConfig, writer=None) -> dict:
                     saver.save(cfg.ckpt_dir, t + 1, tree, keep=cfg.keep)
                 else:
                     ckpt.save(cfg.ckpt_dir, t + 1, tree, keep=cfg.keep)
-            if t == start and cfg.log_every > 0:
-                # restart the window AFTER the first step: it carries the
-                # jit compile, which would otherwise dominate the first
-                # window's steps_per_s (the step is excluded from both
-                # the window's clock and its step count)
+            if t == start:
+                # restart the clocks AFTER the first step: it carries the
+                # jit compile, which would otherwise dominate both the
+                # first window's and the SUMMARY's steps_per_s (the step
+                # is excluded from clock and count alike, so the summary
+                # rate is comparable with the bench's warmed numbers)
                 jax.block_until_ready(loss)
-                t_window, window_start = time.perf_counter(), t + 1
+                t0, rate_start = time.perf_counter(), t + 1
+                t_window, window_start = t0, t + 1
             if (
                 writer is not None
                 and cfg.log_every > 0
@@ -324,18 +327,27 @@ def train(mesh: Mesh, cfg: TrainLoopConfig, writer=None) -> dict:
         finally:
             close_source()
     elapsed = time.perf_counter() - t0
-    ran = cfg.steps - start
+    ran = cfg.steps - rate_start  # post-compile steps (0 on 1-step runs)
     out = {
         "state": tree,
         "loss": float(np.asarray(loss)) if loss is not None else None,
         "start_step": start,
         "steps_per_s": (ran / elapsed) if ran and elapsed > 0 else 0.0,
     }
+    out["tokens_per_s"] = out["steps_per_s"] * cfg.batch * cfg.seq
     if writer is not None:
         from tpu_patterns.core.results import Record, Verdict
 
+        from tpu_patterns.models.transformer import flagship_flops
+
+        # flagship_flops is duck-typed over the shared model fields, so
+        # the loop reports the same model-FLOPs accounting as the bench
         metrics = {
             "steps_per_s": round(out["steps_per_s"], 3),
+            "tokens_per_s": round(out["tokens_per_s"], 1),
+            "model_tflops_per_s": round(
+                out["steps_per_s"] * flagship_flops(cfg) / 1e12, 4
+            ),
             "resumed_from": float(start),
         }
         notes = []
