@@ -144,6 +144,27 @@ class TestLMTraining:
         with pytest.raises(ValueError, match="vocab"):
             lm.make_lm_train_step(mesh3d, ModelConfig(**CFG), 63)
 
+    def test_moe_lm_loss_matches_single_device(self, mesh3d):
+        # the MoE FFN composes with the vocab patterns: experts over
+        # the tp axis (ep ≙ tp), one per rank, same global CE as the
+        # single device running every expert
+        cfg = ModelConfig(**CFG, moe=True, rope=True)
+        params = lm.init_lm_params(jax.random.key(0), cfg, V, n_experts=2)
+        toks = jax.random.randint(jax.random.key(1), (4, 32), 0, V)
+        ref = float(lm.lm_loss_shard(params, toks, cfg))
+        step, _ = lm.make_lm_train_step(mesh3d, cfg, V, lr=0.0)
+        _, loss = step(
+            lm.shard_lm_params(params, mesh3d, cfg),
+            jax.device_put(toks, NamedSharding(mesh3d, P("dp", "sp"))),
+        )
+        assert np.isclose(ref, float(loss), rtol=1e-4)
+
+    def test_moe_lm_generation_rejected_loudly(self, mesh3d):
+        with pytest.raises(NotImplementedError, match="dense"):
+            lm.make_lm_decoder(
+                mesh3d, ModelConfig(**CFG, moe=True), V, 4, 16, 8
+            )
+
 
 class TestLMDecode:
     @pytest.mark.parametrize("kv,int8", [(0, False), (2, True)])

@@ -591,6 +591,13 @@ def run_decode(mesh: Mesh, cfg: DecodeConfig, writer) -> list:
     )
     caches, y0 = prefill(params, x)
     jax.block_until_ready(y0)
+    # time-to-first-token: a warmed prefill over the full context (the
+    # other canonical inference latency, alongside per-token decode)
+    import time as _time
+
+    t_pf = _time.perf_counter()
+    jax.block_until_ready(prefill(params, x)[1])
+    prefill_ms = 1e3 * (_time.perf_counter() - t_pf)
 
     gate = _teacher_forcing_gate(mesh, mcfg, cache_int8=cfg.cache_int8)
 
@@ -641,6 +648,7 @@ def run_decode(mesh: Mesh, cfg: DecodeConfig, writer) -> list:
         metrics={
             "tokens_per_s": round(tps, 1),
             "ms_per_token": round(1e3 * sec / cfg.gen, 3),
+            "prefill_ms": round(prefill_ms, 2),
             "cache_MB": round(cache_mb, 3),
             "prefill_context": float(cfg.prefill),
         },

@@ -40,6 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpu_patterns.models.transformer import (
     ModelConfig,
     _check_kv_heads_shardable,
+    _n_experts,
     forward_shard,
     init_params,
     param_specs,
@@ -167,16 +168,16 @@ def sharded_topk_sample(logits_local, key, temperature, k, tp_axis):
     return jnp.take_along_axis(cands, choice[..., None], axis=-1)[..., 0]
 
 
-def lm_param_specs(cfg: ModelConfig) -> dict[str, P]:
+def lm_param_specs(cfg: ModelConfig, n_experts: int = 0) -> dict[str, P]:
     """Block specs + the tied embedding table, vocab-sharded over tp."""
-    specs = {k: s for k, (_, s) in param_specs(cfg).items()}
+    specs = {k: s for k, (_, s) in param_specs(cfg, n_experts).items()}
     specs["wemb"] = P("tp", None)
     return specs
 
 
-def init_lm_params(key, cfg: ModelConfig, vocab: int) -> dict:
+def init_lm_params(key, cfg: ModelConfig, vocab: int, n_experts: int = 0):
     kb, ke = jax.random.split(key)
-    params = init_params(kb, cfg)
+    params = init_params(kb, cfg, n_experts)
     params["wemb"] = jax.random.normal(
         ke, (vocab, cfg.embed), jnp.dtype(cfg.dtype)
     ) * (cfg.embed ** -0.5)
@@ -282,7 +283,7 @@ def make_lm_train_step(
     if vocab % tp:
         raise ValueError(f"vocab {vocab} must divide over tp={tp}")
     sp = int(mesh.shape["sp"])
-    specs = lm_param_specs(cfg)
+    specs = lm_param_specs(cfg, _n_experts(mesh, cfg))
     # axes are used UNCONDITIONALLY inside the shard_map: a psum over a
     # size-1 axis is a no-op in XLA, while skipping it leaves values
     # formally tp/sp-varying and fails the varying-axes check on
@@ -314,7 +315,7 @@ def make_lm_train_step(
 
 
 def shard_lm_params(params: dict, mesh: Mesh, cfg: ModelConfig) -> dict:
-    specs = lm_param_specs(cfg)
+    specs = lm_param_specs(cfg, _n_experts(mesh, cfg))
     return {
         k: jax.device_put(v, NamedSharding(mesh, specs[k]))
         for k, v in params.items()
@@ -470,6 +471,10 @@ def make_lm_decoder(
     sp = int(mesh.shape["sp"])
     if batch % dp:
         raise ValueError(f"batch {batch} % dp={dp} != 0")
+    if cfg.moe:
+        raise NotImplementedError(
+            "lm generation covers the dense block (decode has no ep path)"
+        )
     if cfg.attn_layout != "contiguous":
         raise NotImplementedError(
             "lm generation requires the contiguous layout (the decode "
