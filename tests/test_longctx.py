@@ -425,6 +425,81 @@ def test_grad_pattern_runner_ulysses(mesh1d):
     assert recs[0].verdict is Verdict.SUCCESS, recs[0].notes
 
 
+def test_grad_records_carry_model_and_hardware_rates(mesh1d):
+    """Every grad Record reports BOTH accounting bases (VERDICT r2 weak
+    #1): `tflops` under the cross-implementation model count (3.5x fwd)
+    and `tflops_hw` under the per-strategy silicon count, with the ratio
+    pinned to the documented multipliers."""
+    from tpu_patterns.core.results import ResultWriter
+    from tpu_patterns.longctx.pattern import (
+        GRAD_FLOP_MULT,
+        GRAD_HW_FLOP_MULT,
+        GRAD_HW_FLOP_MULT_DEFAULT,
+        LongCtxConfig,
+        run_longctx_grad,
+    )
+
+    cfg = LongCtxConfig(
+        seq=64, heads=8, head_dim=16, reps=2, warmup=1,
+        strategies=("ring",),
+    )
+    rec = run_longctx_grad(mesh1d, cfg, ResultWriter())[0]
+    m = rec.metrics
+    assert m["hw_flop_mult"] == GRAD_HW_FLOP_MULT_DEFAULT
+    assert m["tflops_hw"] == pytest.approx(
+        m["tflops"] * m["hw_flop_mult"] / GRAD_FLOP_MULT
+    )
+    assert GRAD_HW_FLOP_MULT["flash"] == 4.5  # 2 fwd + 7 executed bwd
+
+
+def test_grad_chain_keeps_all_three_gradients_live():
+    """The timed chain must depend on dq, dk AND dv — feeding back only dq
+    lets XLA dead-code-eliminate the dk/dv kernel from the measured
+    program (the committed >chip-peak record's cause).  Structural check:
+    chaining a probe counting cotangent uses sees all three."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_patterns.core import timing
+
+    calls = []
+
+    @jax.custom_vjp
+    def probe(q, k, v):
+        return q
+
+    def probe_fwd(q, k, v):
+        return q, (k, v)
+
+    def probe_bwd(res, g):
+        calls.append("bwd")
+        k, v = res
+        return g, k * 0 + 1.0, v * 0 + 2.0
+
+    probe.defvjp(probe_fwd, probe_bwd)
+
+    def grad_probe(x, b, c):
+        return jax.grad(
+            lambda a, b, c: jnp.sum(probe(a, b, c)), argnums=(0, 1, 2)
+        )(x, b, c)
+
+    # mirror pattern.py's _step: the carry folds in all three grads
+    def step(x, b, c):
+        dq, dk, dv = grad_probe(x, b, c)
+        return dq + dk + dv
+
+    x = jnp.ones((4, 4))
+    out = jax.jit(
+        lambda a, b, c, n: timing.unrolled_chain(
+            lambda y: step(y, b, c), a, n
+        )
+    )(x, x, x, jnp.int32(1))
+    # Each step returns dq + dk + dv = 1 + 1 + 2 (dq = ones: grad of sum);
+    # a dq-only chain would end at 1.0 — the 4.0 proves dk and dv stayed
+    # live through the fori_loop body.
+    assert float(out[0, 0]) == pytest.approx(4.0)
+
+
 @pytest.mark.parametrize("name", ["ring_pallas", "ring_striped"])
 def test_pattern_runner_ring_variants(mesh1d, name):
     """The fused-kernel and striped-layout ring variants run through the

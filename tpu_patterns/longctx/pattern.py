@@ -221,11 +221,24 @@ def _gates(cfg: LongCtxConfig, ref: np.ndarray, depth: int = 1) -> _Gates:
     )
 
 
-# fwd = 2 matmuls (QK^T, PV); bwd = 5 (dV, dP, dS->dQ, dS->dK, one score
-# recompute) — the standard flash accounting.  The fused backward's second
-# score recompute (one per kernel) is NOT counted: reported TFLOP/s is
-# useful work, hardware does slightly more.
+# MODEL accounting (the number other flash implementations report): fwd =
+# 2 matmuls (QK^T, PV); bwd = 5 (score recompute, dV, dP, dS->dQ, dS->dK)
+# -> 7 matmul-equivalents per fwd+bwd, 3.5x the forward's 2.
 GRAD_FLOP_MULT = 3.5
+# HARDWARE accounting: what silicon actually executes, per strategy.  The
+# fused Pallas backward (flash.py::flash_block_bwd) is two kernels that
+# EACH recompute the score tile and dP (dq kernel: recompute+dP+dQ = 3;
+# dkv kernel: recompute+dP+dV+dK = 4) -> fwd 2 + bwd 7 = 9 equivalents,
+# 4.5x — this covers "flash" AND "ring_pallas", whose custom-VJP second
+# ring calls flash_block_bwd per step (ring_attention.py:197).  The
+# XLA-autodiff strategies ("ring"/"ring_striped" with block_impl="xla",
+# "ulysses") save the per-chunk probabilities as residuals instead of
+# recomputing -> bwd 4 (dV, dP, dQ, dK) = 3.0x.  Records carry BOTH
+# rates: `tflops` is model FLOPs (cross-implementation comparable),
+# `tflops_hw` is silicon throughput (must never exceed chip peak — the
+# sanity check a model-FLOPs rate cannot provide).
+GRAD_HW_FLOP_MULT = {"flash": 4.5, "ring_pallas": 4.5}
+GRAD_HW_FLOP_MULT_DEFAULT = 3.0
 
 
 def _grad_gates(cfg: LongCtxConfig, ref: np.ndarray) -> _Gates:
@@ -243,8 +256,17 @@ def _grad_gates(cfg: LongCtxConfig, ref: np.ndarray) -> _Gates:
     base = _gates(cfg, ref, depth=4)
     eps = _eps_effective(cfg) * 4
     ref_scale = float(np.max(np.abs(ref)))
+    # 8 eps (not 2): at analytic-cancellation points dS = P*(dP - delta)
+    # subtracts an in-kernel MXU reduction from an XLA einsum, and the
+    # residue's size moves with reduction order across compilations —
+    # committed captures span 0.08x..2.42x of a 2-eps allowance for the
+    # SAME config (docs/measured/flash_tpu_v5e.jsonl:8,9,12,13), i.e. the
+    # 2-eps gate sat ON the rounding boundary and its verdict flipped run
+    # to run.  8 eps clears the observed spread 1.65x while staying ~3
+    # orders below any structural error; it also matches the forward
+    # gates' 8-eps rtol headroom.
     return dataclasses.replace(
-        base, atol=max(cfg.tol, min(2 * eps, 0.125) * ref_scale)
+        base, atol=max(cfg.tol, min(8 * eps, 0.25) * ref_scale)
     )
 
 
@@ -256,7 +278,9 @@ def run_longctx_grad(
     """Measured fwd+bwd: per strategy, time value_and_grad of a fixed-
     cotangent objective and gate (dq, dk, dv) against the XLA reference
     gradients — the backward twin of :func:`run_longctx`."""
-    from tpu_patterns.runtime import use_interpret
+    from tpu_patterns.runtime import chip_peak_tflops, use_interpret
+
+    peak = chip_peak_tflops()
 
     axis = mesh.axis_names[0]
     sp = int(np.prod(mesh.devices.shape))
@@ -271,9 +295,8 @@ def run_longctx_grad(
     ct = jax.random.normal(keys[3], shape, jnp.float32)
     jax.block_until_ready((q, k, v))
 
-    flops = attention_flops(
-        cfg.seq, cfg.heads, cfg.head_dim, cfg.causal
-    ) * GRAD_FLOP_MULT
+    fwd_flops = attention_flops(cfg.seq, cfg.heads, cfg.head_dim, cfg.causal)
+    flops = fwd_flops * GRAD_FLOP_MULT
     writer.progress(
         f"longctx grad: sp={sp}, seq={cfg.seq}, heads={cfg.heads}, "
         f"head_dim={cfg.head_dim}, causal={cfg.causal}, dtype={cfg.dtype}"
@@ -320,12 +343,21 @@ def run_longctx_grad(
                 argnums=(0, 1, 2),
             )
         )
-        # Chain on dq (same shape/dtype as q): each iteration is one full
-        # fwd+bwd with a data dependence XLA cannot elide.
+        # Chain on dq + dk + dv (all the same [L, H, D] shape here): each
+        # iteration is one full fwd+bwd with a data dependence XLA cannot
+        # elide.  Feeding back ONLY dq would let dead-code elimination
+        # delete the dk/dv kernel from the timed program — the bug behind
+        # the committed 189.7 "TFLOP/s" that implied >chip-peak silicon
+        # throughput (VERDICT r2 weak #1): the chain ran ~5 of the 7
+        # credited matmul-equivalents.
+        def _step(x, b, c, _g=gfn):
+            dq, dk, dv = _g(x, b, c)
+            return dq + dk + dv
+
         chained = jax.jit(
-            lambda a, b, c, n, _g=gfn: jnp.sum(
+            lambda a, b, c, n: jnp.sum(
                 timing.unrolled_chain(
-                    lambda x: _g(x, b, c)[0], a, n
+                    lambda x: _step(x, b, c), a, n
                 ).astype(jnp.float32)
             )[None]
         )
@@ -342,6 +374,8 @@ def run_longctx_grad(
             ops_per_iter=timing.CHAIN_UNROLL,
         )
         tflops = flops / res.per_op_ns / 1e3
+        hw_mult = GRAD_HW_FLOP_MULT.get(name, GRAD_HW_FLOP_MULT_DEFAULT)
+        tflops_hw = fwd_flops * hw_mult / res.per_op_ns / 1e3
         got = gfn(qs, ks, vs)
         got_np = []
         for g in got:
@@ -360,7 +394,11 @@ def run_longctx_grad(
         err_rms = max(_rms(g - r) for g, r in zip(got_np, ref_np))
         data_ok = violation <= 1.0 and rms_ratio <= 1.0
         perf_ok = cfg.min_tflops < 0 or tflops >= cfg.min_tflops
-        writer.metric(f"{name} attention grad", tflops, "TFLOP/s")
+        # A silicon rate above chip peak cannot be a measurement of
+        # anything; fail loudly rather than commit an impossible number.
+        sane = peak is None or tflops_hw <= peak
+        writer.metric(f"{name} attention grad", tflops, "TFLOP/s (model)")
+        writer.metric(f"{name} attention grad hw", tflops_hw, "TFLOP/s (silicon)")
         rec = Record(
             pattern="longctx",
             mode=f"{name}_grad",
@@ -368,13 +406,17 @@ def run_longctx_grad(
             + (" causal" if cfg.causal else ""),
             metrics={
                 "tflops": tflops,
+                "tflops_hw": tflops_hw,
+                "hw_flop_mult": hw_mult,
                 "min_time_us": res.us(),
                 "flops": flops,
                 "gate_violation": violation,
                 "rms_err": err_rms,
                 "checksum_ok": float(data_ok),
             },
-            verdict=Verdict.SUCCESS if (data_ok and perf_ok) else Verdict.FAILURE,
+            verdict=Verdict.SUCCESS
+            if (data_ok and perf_ok and sane)
+            else Verdict.FAILURE,
         )
         if not data_ok:
             rec.notes.append(
@@ -382,6 +424,11 @@ def run_longctx_grad(
             )
         if not perf_ok:
             rec.notes.append(f"{tflops:.3f} TFLOP/s below floor {cfg.min_tflops}")
+        if not sane:
+            rec.notes.append(
+                f"hardware rate {tflops_hw:.1f} TFLOP/s exceeds chip peak "
+                f"{peak:.1f} — accounting or timing bug"
+            )
         records.append(writer.record(rec))
     return records
 
