@@ -60,12 +60,11 @@ def _quick_cfg(cls, **overrides):
 
 
 def _spec(table: dict[str, float], device_kind: str) -> float | None:
-    kind = device_kind.lower()
-    best = None
-    for key, val in table.items():
-        if key in kind and (best is None or len(key) > best[0]):
-            best = (len(key), val)
-    return best[1] if best else None
+    # one shared matcher for every chip-keyed table (HBM/ICI here, the
+    # TFLOP/s peak gate in runtime.py)
+    from tpu_patterns.runtime import match_device_spec
+
+    return match_device_spec(table, device_kind)
 
 
 def run(quick: bool = False) -> dict:

@@ -208,6 +208,52 @@ class TestSweep:
             par
         ) + len(hier) + len(meas) + len(tune)
 
+    def test_promote_tuned_picks_best_cell_per_family(self, tmp_path):
+        """`sweep promote` folds the winning chunks/block_rows of a tune
+        run into a tuned.json that OneSidedConfig reads as defaults."""
+        import json
+
+        def cell(name, gbps):
+            rec = {
+                "pattern": "onesided", "mode": "local_put",
+                "metrics": {"bandwidth_GBps": gbps}, "verdict": "SUCCESS",
+            }
+            (tmp_path / f"{name}.jsonl").write_text(json.dumps(rec) + "\n")
+
+        cell("tune.multi.chunks4", 300.0)
+        cell("tune.multi.chunks16", 360.0)
+        cell("tune.streamed.rows512", 250.0)
+        cell("tune.streamed.rows2048", 340.0)
+        dest = tmp_path / "tuned.json"
+        tuned = sweep.promote_tuned(str(tmp_path), dest=str(dest))
+        assert tuned["chunks"] == 16 and tuned["multi_GBps"] == 360.0
+        assert tuned["block_rows"] == 2048 and tuned["streamed_GBps"] == 340.0
+        on_disk = json.loads(dest.read_text())
+        assert on_disk["chunks"] == 16 and on_disk["block_rows"] == 2048
+
+    def test_promote_tuned_refuses_empty_dir(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            sweep.promote_tuned(str(tmp_path), dest=str(tmp_path / "t.json"))
+
+    def test_onesided_config_reads_tuned_file(self, tmp_path, monkeypatch):
+        """The tuned tier reaches OneSidedConfig defaults via
+        TPU_PATTERNS_TUNED (same loader as the committed comm/tuned.json)."""
+        import importlib
+        import json
+
+        from tpu_patterns.comm import onesided
+
+        p = tmp_path / "tuned.json"
+        p.write_text(json.dumps({"chunks": 32, "block_rows": 512}))
+        monkeypatch.setenv("TPU_PATTERNS_TUNED", str(p))
+        try:
+            mod = importlib.reload(onesided)
+            cfg = mod.OneSidedConfig()
+            assert cfg.chunks == 32 and cfg.block_rows == 512
+        finally:
+            monkeypatch.delenv("TPU_PATTERNS_TUNED")
+            importlib.reload(onesided)
+
     def test_unknown_name_filter(self, tmp_path):
         with pytest.raises(ValueError, match="unknown cell name"):
             sweep.run_sweep("p2p", out_dir=str(tmp_path), names=["nope"])

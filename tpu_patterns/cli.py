@@ -561,6 +561,12 @@ def _cmd_interop(args, writer: ResultWriter) -> None:
 def _cmd_sweep(args, writer: ResultWriter) -> int:
     from tpu_patterns import sweep
 
+    if args.suite == "promote":
+        # fold a completed `sweep tune --out <dir>` into the committed
+        # OneSidedConfig defaults (comm/tuned.json)
+        tuned = sweep.promote_tuned(args.out)
+        print(f"# promoted {tuned}")
+        return 0
     return sweep.run_sweep(
         args.suite, out_dir=args.out, quick=args.quick, resume=args.resume,
         cell_timeout=args.cell_timeout,
@@ -797,7 +803,12 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("sweep", help="config-matrix sweeps (≙ run*.sh)")
     from tpu_patterns.sweep import SUITES
 
-    s.add_argument("suite", choices=(*SUITES, "all"))
+    s.add_argument(
+        "suite",
+        choices=(*SUITES, "all", "promote"),
+        help="a sweep suite; 'promote' folds a finished tune run (--out "
+        "points at its directory) into the OneSidedConfig defaults",
+    )
     s.add_argument("--out", default="results", help="log/JSONL directory")
     s.add_argument("--quick", action="store_true", help="tiny workloads")
     s.add_argument(

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -182,6 +183,27 @@ def local_put_multi(x: jax.Array, chunks: int = 8, interpret: bool = False):
     )(x)
 
 
+# Hardware-tuned DMA-schedule defaults, written by ``sweep promote`` from a
+# ``sweep tune`` run on a live chip (sweep.py::promote_tuned) and committed
+# with the measurement records.  Absent file -> the hand-picked fallbacks
+# below; TPU_PATTERNS_TUNED overrides the path (=/dev/null disables).
+TUNED_PATH = os.path.join(os.path.dirname(__file__), "tuned.json")
+
+
+def _load_tuned() -> dict:
+    import json
+
+    path = os.environ.get("TPU_PATTERNS_TUNED", TUNED_PATH)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+_TUNED = _load_tuned()
+
+
 @dataclasses.dataclass
 class OneSidedConfig:
     count: int = 1179648 * 40  # elements; reference message size (≙ C1)
@@ -191,10 +213,13 @@ class OneSidedConfig:
     min_bandwidth: float = -1.0
     seed: int = 0
     # single-device kernel schedule: auto | streamed | multi | mono
-    # (auto measures streamed + multi and reports the winner)
+    # (auto measures streamed + multi with the tuned knobs below and
+    # reports the winner)
     kernel: str = "auto"
-    block_rows: int = 1024  # streamed: rows per VMEM block
-    chunks: int = 8  # multi: concurrent outstanding DMAs
+    # streamed: rows per VMEM block; multi: concurrent outstanding DMAs —
+    # defaults come from the promoted tune run when one is committed
+    block_rows: int = _TUNED.get("block_rows", 1024)
+    chunks: int = _TUNED.get("chunks", 8)
 
 
 

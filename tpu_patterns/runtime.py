@@ -30,22 +30,31 @@ _CHIP_PEAK_TFLOPS = {
 }
 
 
-def chip_peak_tflops() -> float | None:
-    """bf16 peak of device 0, or None off-TPU / unknown kind.
+def match_device_spec(
+    table: dict[str, float], device_kind: str
+) -> float | None:
+    """Longest-substring lookup of a chip-keyed spec table (so "v5 lite"
+    cannot be shadowed by a shorter key).  THE spec matcher — bench.py's
+    HBM/ICI tables and the peak gate share it so a new device_kind
+    spelling is fixed in one place."""
+    kind = device_kind.lower()
+    best = None
+    for key, val in table.items():
+        if key in kind and (best is None or len(key) > best[0]):
+            best = (len(key), val)
+    return best[1] if best else None
 
-    Longest-substring match (bench.py::_spec discipline) so "v5 lite"
-    cannot be shadowed by a shorter key."""
+
+def chip_peak_tflops() -> float | None:
+    """bf16 peak of device 0, or None off-TPU / unknown kind."""
     import jax
 
     dev = jax.devices()[0]
     if dev.platform != "tpu":
         return None
-    kind = getattr(dev, "device_kind", "").lower()
-    best = None
-    for key, peak in _CHIP_PEAK_TFLOPS.items():
-        if key in kind and (best is None or len(key) > best[0]):
-            best = (len(key), peak)
-    return best[1] if best else None
+    return match_device_spec(
+        _CHIP_PEAK_TFLOPS, getattr(dev, "device_kind", "")
+    )
 
 
 def _backends_initialized() -> bool:

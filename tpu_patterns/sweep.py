@@ -557,6 +557,64 @@ def tune_specs(quick: bool = False) -> list[SweepSpec]:
     return specs
 
 
+def promote_tuned(tune_dir: str, dest: str | None = None) -> dict:
+    """Fold a ``sweep tune`` run into :class:`~..comm.onesided.OneSidedConfig`
+    defaults — the missing link between "the DMA-knob search is coded" and
+    "the headline benchmark benefits from it" (VERDICT r2 next #2).
+
+    Reads every ``tune.*.jsonl`` under ``tune_dir``, takes the best
+    ``bandwidth_GBps`` per kernel family (multi: chunks axis; streamed:
+    block_rows axis), and writes the winners to ``dest`` (default: the
+    package's ``comm/tuned.json``, which OneSidedConfig reads at import).
+    Returns the promoted dict; raises FileNotFoundError when the dir holds
+    no completed tune cells (promotion must never silently no-op)."""
+    import glob
+    import json
+    import re
+
+    best: dict[str, tuple[float, int]] = {}  # family -> (gbps, knob)
+    for path in sorted(glob.glob(os.path.join(tune_dir, "tune.*.jsonl"))):
+        m = re.match(r"tune\.(multi|streamed)\.(?:chunks|rows)(\d+)$",
+                     os.path.basename(path)[: -len(".jsonl")])
+        if not m:
+            continue
+        family, knob = m.group(1), int(m.group(2))
+        with open(path) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                rec = json.loads(line)
+                gbps = rec.get("metrics", {}).get("bandwidth_GBps")
+                # only SUCCESS cells may become defaults: a FAILURE cell
+                # (e.g. checksum gate tripped by racing DMAs) must not be
+                # institutionalized however fast it ran
+                if gbps is None or rec.get("verdict") != "SUCCESS":
+                    continue
+                if family not in best or gbps > best[family][0]:
+                    best[family] = (gbps, knob)
+    if not best:
+        raise FileNotFoundError(
+            f"no completed tune.*.jsonl cells with bandwidth under {tune_dir}"
+        )
+    tuned: dict = {"source": os.path.abspath(tune_dir)}
+    if "multi" in best:
+        tuned["chunks"] = best["multi"][1]
+        tuned["multi_GBps"] = best["multi"][0]
+    if "streamed" in best:
+        tuned["block_rows"] = best["streamed"][1]
+        tuned["streamed_GBps"] = best["streamed"][0]
+    if dest is None:
+        from tpu_patterns.comm import onesided
+
+        dest = onesided.TUNED_PATH
+    tmp = dest + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(tuned, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, dest)
+    return tuned
+
+
 SUITES = {
     "p2p": p2p_specs,
     "hier": hier_specs,
