@@ -12,29 +12,36 @@ while true; do
   # SIGTERM; escalate to SIGKILL so the watcher itself can never wedge
   if timeout -k 10 90 python -c "import jax; jax.block_until_ready(jax.numpy.ones((256,256))@jax.numpy.ones((256,256))); print('up', jax.devices())" >/dev/null 2>&1; then
     echo "[$(date +%H:%M:%S)] tunnel up — capturing r3 ladder"
+    # every stage escalates to SIGKILL (-k): a tunnel hang in native code
+    # ignores the TERM that plain `timeout` stops at, and GNU timeout then
+    # waits forever — the watcher itself must never wedge
     # 1. baseline bench (pre-tune number, salvage ladder inside)
     TPU_PATTERNS_BENCH_TIMEOUT=700 timeout -k 30 900 \
-      python bench.py > "$OUT/bench_pre_$(date +%H%M%S).json" 2>> "$OUT/bench.log"
-    echo "[$(date +%H:%M:%S)] bench(pre) done: $(tail -1 "$OUT"/bench_pre_*.json 2>/dev/null | tail -c 300)"
+      python bench.py > "$OUT/bench_pre_$(date +%Y%m%d_%H%M%S).json" 2>> "$OUT/bench.log"
+    echo "[$(date +%H:%M:%S)] bench(pre) done: $(ls -t "$OUT"/bench_pre_*.json 2>/dev/null | head -1 | xargs tail -1 2>/dev/null | tail -c 300)"
     # 2. DMA-knob search (VERDICT r2 next #2)
-    timeout 2400 python -m tpu_patterns sweep tune --out "$OUT/tune" --resume --cell-timeout 420 >> "$OUT/tune.log" 2>&1
+    timeout -k 30 2400 python -m tpu_patterns sweep tune --out "$OUT/tune" --resume --cell-timeout 420 >> "$OUT/tune.log" 2>&1
     echo "[$(date +%H:%M:%S)] tune done rc=$?"
     # 3. promote winners into OneSidedConfig defaults (comm/tuned.json)
-    timeout 120 python -m tpu_patterns sweep promote --out "$OUT/tune" >> "$OUT/tune.log" 2>&1
+    timeout -k 30 120 python -m tpu_patterns sweep promote --out "$OUT/tune" >> "$OUT/tune.log" 2>&1
     echo "[$(date +%H:%M:%S)] promote done rc=$?"
     # 4. the full 21-cell measured matrix, incl. decode MHA/GQA/int8 + LM
     #    (VERDICT r2 next #1: zero skipped-for-hardware cells)
-    timeout 7200 python -m tpu_patterns sweep measured --out "$OUT/measured" --resume --cell-timeout 600 >> "$OUT/measured.log" 2>&1
+    timeout -k 30 7200 python -m tpu_patterns sweep measured --out "$OUT/measured" --resume --cell-timeout 600 >> "$OUT/measured.log" 2>&1
     echo "[$(date +%H:%M:%S)] measured done rc=$?"
     # 5. post-tune bench: the number the driver should reproduce
     TPU_PATTERNS_BENCH_TIMEOUT=700 timeout -k 30 900 \
-      python bench.py > "$OUT/bench_post_$(date +%H%M%S).json" 2>> "$OUT/bench.log"
-    echo "[$(date +%H:%M:%S)] bench(post) done: $(tail -1 "$OUT"/bench_post_*.json 2>/dev/null | tail -c 300)"
+      python bench.py > "$OUT/bench_post_$(date +%Y%m%d_%H%M%S).json" 2>> "$OUT/bench.log"
+    echo "[$(date +%H:%M:%S)] bench(post) done: $(ls -t "$OUT"/bench_post_*.json 2>/dev/null | head -1 | xargs tail -1 2>/dev/null | tail -c 300)"
     # done only if the post-tune bench produced a numeric value; otherwise
     # the tunnel died mid-capture — keep polling and resume
     if python - "$OUT" <<'EOF'
-import glob, json, sys
-files = sorted(glob.glob(sys.argv[1] + "/bench_post_*.json"))
+import glob, json, os, sys
+# newest by mtime, not name: HHMMSS-sorted names lie across midnight and
+# across watcher restarts reusing the same $OUT
+files = sorted(
+    glob.glob(sys.argv[1] + "/bench_post_*.json"), key=os.path.getmtime
+)
 ok = False
 for f in files[-1:]:
     try:

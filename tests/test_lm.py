@@ -159,11 +159,64 @@ class TestLMTraining:
         )
         assert np.isclose(ref, float(loss), rtol=1e-4)
 
-    def test_moe_lm_generation_rejected_loudly(self, mesh3d):
-        with pytest.raises(NotImplementedError, match="dense"):
-            lm.make_lm_decoder(
-                mesh3d, ModelConfig(**CFG, moe=True), V, 4, 16, 8
+    def test_moe_lm_generation_mesh_invariant(self, devices):
+        # moe generation (VERDICT r2 #4): the 2-expert model produces
+        # the SAME greedy ids on the dp x sp x tp mesh (one expert per
+        # tp rank) as on one device running every expert
+        cfg = ModelConfig(**CFG, moe=True, rope=True)
+        params = lm.init_lm_params(jax.random.key(0), cfg, V, n_experts=2)
+        toks = jax.random.randint(jax.random.key(1), (4, 16), 0, V)
+        outs = {}
+        for shape in [(2, 2, 2), (1, 1, 1)]:
+            n = int(np.prod(shape))
+            mesh = Mesh(
+                np.array(devices[:n]).reshape(shape), ("dp", "sp", "tp")
             )
+            pre, gen = lm.make_lm_decoder(mesh, cfg, V, 4, 16, 8)
+            specs = lm.lm_param_specs(cfg, n_experts=2)
+            sp_p = {
+                k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+                for k, v in params.items()
+            }
+            tk = jax.device_put(toks, NamedSharding(mesh, P("dp", "sp")))
+            caches, t0 = pre(sp_p, tk)
+            _, out = gen(sp_p, caches, t0, jnp.asarray(16), 8)
+            outs[shape] = (np.asarray(t0), np.asarray(out))
+        np.testing.assert_array_equal(outs[(2, 2, 2)][0], outs[(1, 1, 1)][0])
+        np.testing.assert_array_equal(outs[(2, 2, 2)][1], outs[(1, 1, 1)][1])
+        assert ((outs[(1, 1, 1)][1] >= 0) & (outs[(1, 1, 1)][1] < V)).all()
+
+    def test_striped_lm_generation_mesh_invariant(self, devices):
+        # striped generation (VERDICT r2 #4): prompts arrive pre-striped
+        # (shard r holds tokens r::sp, the training contract); greedy
+        # ids must equal the single-device rollout
+        cfg = ModelConfig(**CFG, rope=True, attn_layout="striped")
+        params = lm.init_lm_params(jax.random.key(0), cfg, V)
+        toks = jax.random.randint(jax.random.key(1), (4, 16), 0, V)
+        outs = {}
+        for shape in [(2, 2, 2), (1, 1, 1)]:
+            n = int(np.prod(shape))
+            sp = shape[1]
+            mesh = Mesh(
+                np.array(devices[:n]).reshape(shape), ("dp", "sp", "tp")
+            )
+            pre, gen = lm.make_lm_decoder(mesh, cfg, V, 4, 16, 8)
+            specs = lm.lm_param_specs(cfg)
+            sp_p = {
+                k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+                for k, v in params.items()
+            }
+            tks = (
+                jnp.concatenate([toks[:, r::sp] for r in range(sp)], axis=1)
+                if sp > 1
+                else toks
+            )
+            tk = jax.device_put(tks, NamedSharding(mesh, P("dp", "sp")))
+            caches, t0 = pre(sp_p, tk)
+            _, out = gen(sp_p, caches, t0, jnp.asarray(16), 8)
+            outs[shape] = (np.asarray(t0), np.asarray(out))
+        np.testing.assert_array_equal(outs[(2, 2, 2)][0], outs[(1, 1, 1)][0])
+        np.testing.assert_array_equal(outs[(2, 2, 2)][1], outs[(1, 1, 1)][1])
 
 
 class TestLMDecode:

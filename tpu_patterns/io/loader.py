@@ -112,11 +112,22 @@ class NativeLoader:
                 f"threads={threads} (need elems>0, buffers>=2, threads>=1)"
             )
 
+    def _handle(self):
+        # a NULL handle passed into the C library is a segfault, not an
+        # exception — guard every entry point after close()
+        if not self._ptr:
+            raise RuntimeError("loader is closed")
+        return self._ptr
+
     def next(self) -> tuple[np.ndarray, int]:
         """(batch view, step).  The view aliases a ring slot: consume it
         (e.g. jax.device_put) before the next ``next()``/``seek()``."""
         step = ctypes.c_int64()
-        buf = self._lib.tpl_next(self._ptr, ctypes.byref(step))
+        buf = self._lib.tpl_next(self._handle(), ctypes.byref(step))
+        if not buf:
+            # tpl_next returns NULL only when the stream is shut down
+            # (e.g. destroy racing next); as_array on it would segfault
+            raise RuntimeError("loader stream terminated")
         arr = np.ctypeslib.as_array(buf, shape=(self.elems,)).reshape(
             self.shape
         )
@@ -124,12 +135,12 @@ class NativeLoader:
         return arr, int(step.value)
 
     def seek(self, step: int) -> None:
-        self._lib.tpl_seek(self._ptr, step)
+        self._lib.tpl_seek(self._handle(), step)
 
     @property
     def filled_total(self) -> int:
         """Batches produced so far (consumed + prefetched ahead)."""
-        return int(self._lib.tpl_filled_total(self._ptr))
+        return int(self._lib.tpl_filled_total(self._handle()))
 
     def close(self) -> None:
         if self._ptr:

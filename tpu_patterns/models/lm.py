@@ -336,6 +336,8 @@ class LMConfig:
     rope: bool = True
     kv_heads: int = 0
     cache_int8: bool = False
+    layout: str = "contiguous"  # token/KV-cache layout (or "striped")
+    moe: bool = False  # top-1 mixture FFN, experts one per tp rank
     batch: int = 4
     seq: int = 256  # training sequence length
     steps: int = 20
@@ -366,11 +368,22 @@ def run_lm(mesh: Mesh, cfg: LMConfig, writer) -> list:
         depth=cfg.depth,
         rope=cfg.rope,
         kv_heads=cfg.kv_heads,
+        attn_layout=cfg.layout,
+        moe=cfg.moe,
     )
-    params = init_lm_params(jax.random.key(cfg.seed), mcfg, cfg.vocab)
+    sp = int(mesh.shape["sp"])
+    params = init_lm_params(
+        jax.random.key(cfg.seed), mcfg, cfg.vocab, _n_experts(mesh, mcfg)
+    )
     toks = jax.random.randint(
         jax.random.key(cfg.seed + 1), (cfg.batch, cfg.seq), 0, cfg.vocab
     )
+    if cfg.layout == "striped" and sp > 1:
+        # the caller stripes: shard r holds tokens r::sp (training loss
+        # halo and the decode cache both assume it)
+        toks = jnp.concatenate(
+            [toks[:, r::sp] for r in range(sp)], axis=1
+        )
     step, _ = make_lm_train_step(mesh, mcfg, cfg.vocab, lr=cfg.lr)
     p = shard_lm_params(params, mesh, mcfg)
     st = jax.device_put(toks, NamedSharding(mesh, P("dp", "sp")))
@@ -386,8 +399,11 @@ def run_lm(mesh: Mesh, cfg: LMConfig, writer) -> list:
     train_s = time.perf_counter() - t0
 
     prefill_len = cfg.seq  # generate from the training context
+    # capacity padded up to a multiple of sp (the cache layout divides
+    # the gen segment over sp); still generate exactly cfg.gen tokens
+    gen_cap = cfg.gen + (-cfg.gen % sp)
     pre, gen = make_lm_decoder(
-        mesh, mcfg, cfg.vocab, cfg.batch, prefill_len, cfg.gen,
+        mesh, mcfg, cfg.vocab, cfg.batch, prefill_len, gen_cap,
         cache_int8=cfg.cache_int8,
     )
     gen_kw = dict(
@@ -413,6 +429,8 @@ def run_lm(mesh: Mesh, cfg: LMConfig, writer) -> list:
         mode=f"V{cfg.vocab}"
         + (f"_gqa{cfg.kv_heads}" if cfg.kv_heads else "")
         + ("_int8" if cfg.cache_int8 else "")
+        + ("_striped" if cfg.layout == "striped" else "")
+        + ("_moe" if cfg.moe else "")
         + (
             f"_T{cfg.temperature}"
             + (f"_k{cfg.top_k}" if cfg.top_k else "")
@@ -461,6 +479,11 @@ def make_lm_decoder(
     > 0; the rollout is then deterministic in (caches, tok, seed), NOT
     in (caches, tok) alone).  The whole rollout is one compiled scan;
     tokens never leave the device.
+
+    ``cfg.attn_layout="striped"`` decodes over the striped cache layout
+    (prompt tokens arrive pre-striped, x_global[:, r::sp] per shard —
+    the training data contract); ``cfg.moe=True`` generates through the
+    training path's top-1 expert routing (decode._mlp).
     """
     from tpu_patterns.models import decode as D
 
@@ -471,21 +494,15 @@ def make_lm_decoder(
     sp = int(mesh.shape["sp"])
     if batch % dp:
         raise ValueError(f"batch {batch} % dp={dp} != 0")
-    if cfg.moe:
-        raise NotImplementedError(
-            "lm generation covers the dense block (decode has no ep path)"
-        )
-    if cfg.attn_layout != "contiguous":
-        raise NotImplementedError(
-            "lm generation requires the contiguous layout (the decode "
-            "cache and prefill ring hardcode contiguous positions)"
-        )
     _check_kv_heads_shardable(cfg, mesh)
-    layout = D._CacheLayout(prefill_len, gen_cap, sp)
+    n_exp = _n_experts(mesh, cfg)
+    layout = D._CacheLayout(prefill_len, gen_cap, sp, cfg.attn_layout)
     sp_axis = "sp" if sp > 1 else None
     tp_axis = "tp" if tp > 1 else None
     lcfg = dataclasses.replace(cfg, depth=1)
-    pspecs = dict(D._stacked_specs(cfg), wemb=P(None, "tp", None))
+    pspecs = dict(
+        D._stacked_specs(cfg, n_exp), wemb=P(None, "tp", None)
+    )
     kv_spec = P(None, "dp", "tp", "sp", None)
     cache_specs = {"k": kv_spec, "v": kv_spec}
     if cache_int8:

@@ -327,7 +327,10 @@ def train(mesh: Mesh, cfg: TrainLoopConfig, writer=None) -> dict:
         finally:
             close_source()
     elapsed = time.perf_counter() - t0
-    ran = cfg.steps - rate_start  # post-compile steps (0 on 1-step runs)
+    # post-compile steps (0 on 1-step runs); clamped: a resumed
+    # checkpoint whose step already exceeds cfg.steps runs nothing, and
+    # a negative count must not become a negative throughput
+    ran = max(0, cfg.steps - rate_start)
     out = {
         "state": tree,
         "loss": float(np.asarray(loss)) if loss is not None else None,
