@@ -29,6 +29,10 @@ while true; do
     #    (VERDICT r2 next #1: zero skipped-for-hardware cells)
     timeout -k 30 7200 python -m tpu_patterns sweep measured --out "$OUT/measured" --resume --cell-timeout 600 >> "$OUT/measured.log" 2>&1
     echo "[$(date +%H:%M:%S)] measured done rc=$?"
+    # 4b. genuine runtime-knob sweep (C12 full: latency-hiding scheduler,
+    #     async-collective fusion, scoped VMEM, matmul precision, cache)
+    timeout -k 30 5400 python -m tpu_patterns sweep runtime --out "$OUT/runtime" --resume --cell-timeout 420 >> "$OUT/runtime.log" 2>&1
+    echo "[$(date +%H:%M:%S)] runtime done rc=$?"
     # 5. post-tune bench: the number the driver should reproduce
     TPU_PATTERNS_BENCH_TIMEOUT=700 timeout -k 30 900 \
       python bench.py > "$OUT/bench_post_$(date +%Y%m%d_%H%M%S).json" 2>> "$OUT/bench.log"

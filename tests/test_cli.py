@@ -197,16 +197,31 @@ class TestSweep:
                 assert s.argv[i + 1] == "1", s.name
         tune = sweep.specs_for("tune", quick=True)
         assert len(tune) == 7  # 4 chunk counts + 3 block sizes
+        rt = sweep.specs_for("runtime", quick=True)
+        # >= 4 GENUINE runtime configs (C12 bar), each a real XLA/libtpu/
+        # JAX knob — not a framework-internal timing mode
+        cfgs = {s.name.split(".")[1] for s in rt}
+        assert len(cfgs) >= 4
+        real_knobs = {"LIBTPU_INIT_ARGS", "JAX_DEFAULT_MATMUL_PRECISION",
+                      "JAX_ENABLE_COMPILATION_CACHE"}
+        non_default = [s for s in rt if s.name.split(".")[1] != "default"]
+        assert non_default and all(
+            real_knobs & {k for k, _ in s.env} for s in non_default
+        )
+        # both pattern families appear (the reference sweeps env configs
+        # over its bench AND its command mixes)
+        assert any(s.argv[0] == "concurrency" for s in rt)
+        assert any(s.argv[0] == "flagship" for s in rt)
         # 'all' must be exactly these suites, independently summed
         assert set(sweep.SUITES) == {
-            "p2p", "hier", "measured", "tune", "concurrency", "allreduce",
-            "longctx", "parallel",
+            "p2p", "hier", "measured", "tune", "concurrency", "runtime",
+            "allreduce", "longctx", "parallel",
         }
         assert len(sweep.specs_for("all", quick=True)) == len(p2p) + len(
             con
         ) + len(sweep.specs_for("allreduce", quick=True)) + len(lc) + len(
             par
-        ) + len(hier) + len(meas) + len(tune)
+        ) + len(hier) + len(meas) + len(tune) + len(rt)
 
     def test_promote_tuned_picks_best_cell_per_family(self, tmp_path):
         """`sweep promote` folds the winning chunks/block_rows of a tune

@@ -7,6 +7,7 @@ import sys
 from pathlib import Path
 
 import jax
+import numpy as np
 import pytest
 
 ROOT = Path(__file__).resolve().parent.parent
@@ -34,6 +35,41 @@ class TestGraftEntry:
     def test_dryrun_multichip(self, devices, n):
         graft = _load("__graft_entry__")
         graft.dryrun_multichip(n)  # raises on compile or numeric failure
+
+    @pytest.mark.parametrize("n", [16, 32])
+    def test_dryrun_multichip_large_fresh_process(self, n):
+        # 16 (the v5p-16 target shape) and 32 need more virtual devices
+        # than the pytest backend holds — run in a fresh process, where
+        # _force_cpu_platform provisions them.  Each n runs ALL its mesh
+        # factorizations (VERDICT r2 next #7).
+        import os
+
+        env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+        env.pop("JAX_PLATFORMS", None)
+        env.pop("XLA_FLAGS", None)
+        proc = subprocess.run(
+            [sys.executable, str(ROOT / "__graft_entry__.py"), "dryrun", str(n)],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=560,
+            cwd=ROOT,
+        )
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        assert f"dryrun_multichip({n}) ok" in proc.stdout
+
+    def test_factorizations_cover_multiple_splits(self):
+        graft = _load("__graft_entry__")
+        for n in (8, 16, 32):
+            facts = graft._factorizations(n)
+            assert len(facts) >= 2, n
+            for dp, sp, tp, pp in facts:
+                assert dp * sp * tp * pp == n
+                assert 8 % tp == 0  # probe heads/vocab divide over tp
+            assert len(set(facts)) == len(facts)
+        # unknown n: greedy single split, still a valid factorization
+        (f,) = graft._factorizations(6)
+        assert int(np.prod(f)) == 6
 
     def test_dryrun_too_many_devices(self, devices):
         graft = _load("__graft_entry__")

@@ -64,6 +64,8 @@ _CONTEXT_ENV_VARS = (
     "JAX_PLATFORMS",
     "XLA_FLAGS",
     "LIBTPU_INIT_ARGS",
+    "JAX_DEFAULT_MATMUL_PRECISION",
+    "JAX_ENABLE_COMPILATION_CACHE",
     "TPU_PATTERNS_SWEEP_CONFIG",
 )
 

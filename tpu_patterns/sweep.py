@@ -31,15 +31,104 @@ class SweepSpec:
         return dataclasses.replace(self, env=self.env + tuple(kv.items()))
 
 
-# Runtime-knob configs (≙ the env sweeps of run_omp.sh:14-18 — immediate
-# command lists, copy-engine selection etc. — mapped to this framework's
-# runtime knobs).  Each is tagged via TPU_PATTERNS_SWEEP_CONFIG so
-# results.context_env() keys report tables by it.
+# Framework-internal measurement-mode configs (the original C12 sweep).
+# Each is tagged via TPU_PATTERNS_SWEEP_CONFIG so results.context_env()
+# keys report tables by it.
 CONCURRENCY_ENV_CONFIGS: dict[str, dict[str, str]] = {
     "default": {},
     "direct_timing": {"TPU_PATTERNS_TIMING": "direct"},
     "amortized_timing": {"TPU_PATTERNS_TIMING": "amortized"},
 }
+
+# GENUINE runtime-knob configs (C12 to full — ≙ run_omp.sh:14-18 /
+# run_sycl.sh:13-16, whose env sweeps toggle immediate command lists and
+# copy-engine selection in the GPU runtime): each entry here toggles real
+# XLA:TPU / libtpu / JAX runtime behavior, not a framework knob.
+# name -> (env, patterns the knob meaningfully targets).
+# LIBTPU_INIT_ARGS reaches the TPU compiler/runtime at backend init
+# (inert on the CPU simulator, where the cells still validate the sweep
+# mechanism end-to-end); JAX_* envs apply on every platform.  All three
+# flags are public knobs from the JAX/Cloud-TPU performance docs:
+# latency-hiding scheduler (overlap compute with async collectives/DMA),
+# async-collective fusion, and the scoped-VMEM budget that bounds how
+# much VMEM the scheduler may use for prefetch/double-buffering.
+RUNTIME_ENV_CONFIGS: dict[str, tuple[dict[str, str], frozenset]] = {
+    "default": ({}, frozenset({"concurrency", "flagship"})),
+    "no_latency_hiding": (
+        {"LIBTPU_INIT_ARGS": "--xla_tpu_enable_latency_hiding_scheduler=false"},
+        frozenset({"concurrency", "flagship"}),
+    ),
+    "sync_collective_fusion": (
+        {"LIBTPU_INIT_ARGS": "--xla_tpu_enable_async_collective_fusion=false"},
+        frozenset({"flagship"}),
+    ),
+    "scoped_vmem_16m": (
+        {"LIBTPU_INIT_ARGS": "--xla_tpu_scoped_vmem_limit_kib=16384"},
+        frozenset({"concurrency", "flagship"}),
+    ),
+    "scoped_vmem_64m": (
+        {"LIBTPU_INIT_ARGS": "--xla_tpu_scoped_vmem_limit_kib=65536"},
+        frozenset({"concurrency", "flagship"}),
+    ),
+    "matmul_highest": (
+        # 3-pass bf16 MXU emulation of f32: a real speed/accuracy knob
+        # for every matmul in the flagship step
+        {"JAX_DEFAULT_MATMUL_PRECISION": "highest"},
+        frozenset({"flagship"}),
+    ),
+    "cold_compile": (
+        # compilation cache off: exposes dispatch/compile overheads the
+        # warm-cache cells amortize away
+        {"JAX_ENABLE_COMPILATION_CACHE": "false"},
+        frozenset({"concurrency"}),
+    ),
+}
+
+
+def runtime_specs(quick: bool = False) -> list[SweepSpec]:
+    """Real runtime-knob sweep: RUNTIME_ENV_CONFIGS x {the three
+    hardware-meaningful concurrency modes, the flagship pallas train
+    step}.  The report (keyed by LIBTPU_INIT_ARGS/JAX_* context) shows
+    one table per config — the reference's per-env-config tables
+    (parse.py) over genuine runtime toggles."""
+    conc = (
+        ("--elements", "4096", "--copy_elements", "16384",
+         "--tripcount", "64", "--reps", "2")
+        if quick
+        else ("--reps", "10")
+    )
+    flag = QUICK_FLAGSHIP if quick else (
+        "--seq", "4096", "--batch", "2", "--reps", "5", "--attn", "pallas"
+    )
+    conc_modes = (
+        ("xla", "concurrent", "C H2D"),
+        ("xla", "dispatch_async", "C C"),
+        ("pallas", "dma_overlap", "C C"),
+    )
+    specs = []
+    for cfg_name, (env, targets) in RUNTIME_ENV_CONFIGS.items():
+        tag = {"TPU_PATTERNS_SWEEP_CONFIG": f"runtime.{cfg_name}"}
+        if "concurrency" in targets:
+            for backend, mode, mix in conc_modes:
+                specs.append(
+                    SweepSpec(
+                        name=f"runtime.{cfg_name}.{backend}.{mode}",
+                        argv=(
+                            "concurrency", "--backend", backend,
+                            "--mode", mode, "--commands", mix, *conc,
+                        ),
+                        env=tuple({**env, **tag}.items()),
+                    )
+                )
+        if "flagship" in targets:
+            specs.append(
+                SweepSpec(
+                    name=f"runtime.{cfg_name}.flagship",
+                    argv=("flagship", *flag),
+                    env=tuple({**env, **tag}.items()),
+                )
+            )
+    return specs
 
 # The five command mixes of run_omp.sh:9 — with the M (pageable host) mixes
 # routed through dispatch modes, since pageable memory cannot live inside a
@@ -621,6 +710,7 @@ SUITES = {
     "measured": measured_specs,
     "tune": tune_specs,
     "concurrency": concurrency_specs,
+    "runtime": runtime_specs,
     "allreduce": allreduce_specs,
     "longctx": longctx_specs,
     "parallel": parallel_specs,
