@@ -33,6 +33,17 @@ while true; do
     #     async-collective fusion, scoped VMEM, matmul precision, cache)
     timeout -k 30 5400 python -m tpu_patterns sweep runtime --out "$OUT/runtime" --resume --cell-timeout 420 >> "$OUT/runtime.log" 2>&1
     echo "[$(date +%H:%M:%S)] runtime done rc=$?"
+    # 4c. profiled flagship + longctx: the parsed trace becomes a
+    #     profile_breakdown Record (compute/collective/DMA/idle) in the
+    #     same JSONL — the diagnosis for the MFU gap (VERDICT r2 #6)
+    timeout -k 30 900 python -m tpu_patterns --enable_profiling \
+      --profile_dir "$OUT/profile/flagship" --jsonl "$OUT/flagship_profiled.jsonl" \
+      flagship --attn pallas --seq 4096 --batch 2 --reps 3 >> "$OUT/profile.log" 2>&1
+    echo "[$(date +%H:%M:%S)] flagship profile done rc=$?"
+    timeout -k 30 900 python -m tpu_patterns --enable_profiling \
+      --profile_dir "$OUT/profile/longctx" --jsonl "$OUT/longctx_profiled.jsonl" \
+      longctx --devices 1 --strategy flash --dtype bfloat16 --seq 4096 --reps 3 >> "$OUT/profile.log" 2>&1
+    echo "[$(date +%H:%M:%S)] longctx profile done rc=$?"
     # 5. post-tune bench: the number the driver should reproduce
     TPU_PATTERNS_BENCH_TIMEOUT=700 timeout -k 30 900 \
       python bench.py > "$OUT/bench_post_$(date +%Y%m%d_%H%M%S).json" 2>> "$OUT/bench.log"

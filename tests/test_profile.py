@@ -1,0 +1,225 @@
+"""Profile-trace reader (core/profile.py): wire-format parsing against
+synthetically encoded XSpace bytes, op classification, breakdown math,
+and the live jax.profiler round trip."""
+
+import os
+
+import pytest
+
+from tpu_patterns.core import profile as prof
+
+
+# -- tiny protobuf wire encoder (the test's independent implementation:
+#    the parser must agree with bytes produced from the schema, not with
+#    itself) --------------------------------------------------------------
+
+
+def _varint(v: int) -> bytes:
+    out = b""
+    while True:
+        b7 = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b7 | 0x80])
+        else:
+            return out + bytes([b7])
+
+
+def _field(num: int, wire: int, payload: bytes) -> bytes:
+    head = _varint((num << 3) | wire)
+    if wire == 2:
+        return head + _varint(len(payload)) + payload
+    return head + payload
+
+
+def _msg(num: int, payload: bytes) -> bytes:
+    return _field(num, 2, payload)
+
+
+def _str(num: int, s: str) -> bytes:
+    return _field(num, 2, s.encode())
+
+
+def _int(num: int, v: int) -> bytes:
+    return _field(num, 0, _varint(v))
+
+
+def _event(mid: int, off_ps: int, dur_ps: int) -> bytes:
+    return _int(1, mid) + _int(2, off_ps) + _int(3, dur_ps)
+
+
+def _event_meta(mid: int, name: str) -> bytes:
+    return _int(1, mid) + _str(2, name)
+
+
+def _space(planes: list[bytes]) -> bytes:
+    return b"".join(_msg(1, p) for p in planes)
+
+
+def _tpu_plane() -> bytes:
+    """A /device:TPU:0 plane: one op line with one event per category,
+    plus a 'Steps' line that re-aggregates and must be skipped."""
+    metas = {
+        1: "fusion.42",
+        2: "all-reduce.3",
+        3: "copy-start.7",
+        4: "outfeed",
+        5: "custom-thing",
+    }
+    meta_entries = b"".join(
+        _msg(4, _int(1, mid) + _msg(2, _event_meta(mid, name)))
+        for mid, name in metas.items()
+    )
+    # op line at timestamp 1000 ns: fusion 4ms, all-reduce 2ms, copy 1ms,
+    # outfeed 0.5ms, other 0.5ms; gap of 2ms before the last event
+    ms = 10**9  # ps per ms
+    events = (
+        _msg(4, _event(1, 0, 4 * ms))
+        + _msg(4, _event(2, 4 * ms, 2 * ms))
+        + _msg(4, _event(3, 6 * ms, 1 * ms))
+        + _msg(4, _event(4, 7 * ms, ms // 2))
+        + _msg(4, _event(5, 9 * ms + ms // 2, ms // 2))
+    )
+    op_line = _int(1, 1) + _str(2, "XLA Ops") + _int(3, 1000) + events
+    steps_line = (
+        _int(1, 2) + _str(2, "Steps") + _int(3, 1000)
+        + _msg(4, _event(1, 0, 10 * ms))
+    )
+    return (
+        _int(1, 7)
+        + _str(2, "/device:TPU:0")
+        + _msg(3, op_line)
+        + _msg(3, steps_line)
+        + meta_entries
+    )
+
+
+def _host_plane() -> bytes:
+    return _int(1, 9) + _str(2, "/host:CPU") + _msg(
+        3, _int(1, 1) + _str(2, "python") + _msg(4, _event(1, 0, 123))
+    )
+
+
+class TestWireParser:
+    def test_roundtrip_planes_lines_events(self, tmp_path):
+        p = tmp_path / "t.xplane.pb"
+        p.write_bytes(_space([_tpu_plane(), _host_plane()]))
+        planes = prof.parse_xspace(str(p))
+        assert [pl.name for pl in planes] == ["/device:TPU:0", "/host:CPU"]
+        tpu = planes[0]
+        assert [ln.name for ln in tpu.lines] == ["XLA Ops", "Steps"]
+        ops = tpu.lines[0]
+        assert ops.timestamp_ns == 1000
+        assert [e.name for e in ops.events] == [
+            "fusion.42", "all-reduce.3", "copy-start.7", "outfeed",
+            "custom-thing",
+        ]
+        assert ops.events[0].duration_ps == 4 * 10**9
+
+    def test_unknown_fields_skipped(self, tmp_path):
+        # forward compatibility: an extra length-delimited field (99) and
+        # an extra varint (98) inside the plane must not break parsing
+        plane = _tpu_plane() + _str(99, "future") + _int(98, 7)
+        p = tmp_path / "t.xplane.pb"
+        p.write_bytes(_space([plane]))
+        (tpu,) = prof.parse_xspace(str(p))
+        assert tpu.name == "/device:TPU:0"
+        assert len(tpu.lines[0].events) == 5
+
+
+class TestClassify:
+    @pytest.mark.parametrize(
+        "name,cat",
+        [
+            ("fusion.123", "compute"),
+            ("dot.7", "compute"),
+            ("all-reduce.1", "collective"),
+            ("reduce-scatter.2", "collective"),  # not plain 'reduce'
+            ("all-to-all", "collective"),
+            ("collective-permute-start", "collective"),
+            ("copy.3", "dma"),
+            ("dynamic-update-slice-fusion", "dma"),
+            ("outfeed", "infeed_outfeed"),
+            ("reduce.9", "compute"),
+            ("some-custom-call", "other"),
+        ],
+    )
+    def test_rules(self, name, cat):
+        assert prof.classify(name) == cat
+
+
+class TestBreakdown:
+    def test_categories_and_idle(self, tmp_path):
+        run = tmp_path / "plugins" / "profile" / "run1"
+        os.makedirs(run)
+        (run / "host.xplane.pb").write_bytes(
+            _space([_tpu_plane(), _host_plane()])
+        )
+        bd = prof.breakdown(str(tmp_path))
+        assert bd is not None
+        assert bd["compute_ms"] == pytest.approx(4.0)
+        assert bd["collective_ms"] == pytest.approx(2.0)
+        assert bd["dma_ms"] == pytest.approx(1.0)
+        assert bd["infeed_outfeed_ms"] == pytest.approx(0.5)
+        assert bd["other_ms"] == pytest.approx(0.5)
+        assert bd["busy_ms"] == pytest.approx(8.0)
+        # wall spans first start .. last end = 10 ms; idle = 2 ms gap
+        assert bd["wall_ms"] == pytest.approx(10.0)
+        assert bd["idle_ms"] == pytest.approx(2.0)
+        assert bd["compute_frac"] == pytest.approx(0.5)
+        # the Steps line (re-aggregation) must NOT be double counted
+        assert bd["busy_ms"] < 10.0 + 1e-6
+
+    def test_multi_plane_idle_sums_per_chip(self, tmp_path):
+        # two chips, each 8ms busy over a 10ms span: idle must be 2+2=4,
+        # not max(0, 10 - 16) = 0 (the shared-wall undercount)
+        run = tmp_path / "plugins" / "profile" / "run1"
+        os.makedirs(run)
+        (run / "host.xplane.pb").write_bytes(
+            _space([_tpu_plane(), _tpu_plane()])
+        )
+        bd = prof.breakdown(str(tmp_path))
+        assert bd["busy_ms"] == pytest.approx(16.0)
+        assert bd["idle_ms"] == pytest.approx(4.0)
+        assert bd["wall_ms"] == pytest.approx(10.0)
+        assert bd["n_device_planes"] == 2.0
+
+    def test_truncated_file_raises_not_hangs(self, tmp_path):
+        # the CLI catches parser exceptions; the parser's contract is to
+        # RAISE on truncation, never to loop or return silently-wrong data
+        blob = _space([_tpu_plane()])
+        p = tmp_path / "t.xplane.pb"
+        p.write_bytes(blob[: len(blob) // 2])
+        with pytest.raises(Exception):
+            prof.parse_xspace(str(p))
+
+    def test_no_device_plane_returns_none(self, tmp_path):
+        run = tmp_path / "plugins" / "profile" / "run1"
+        os.makedirs(run)
+        (run / "host.xplane.pb").write_bytes(_space([_host_plane()]))
+        assert prof.breakdown(str(tmp_path)) is None
+
+    def test_empty_dir_returns_none(self, tmp_path):
+        assert prof.breakdown(str(tmp_path)) is None
+
+
+class TestLiveTrace:
+    def test_jax_trace_parses(self, tmp_path, devices):
+        # the real jax.profiler writes a parsable xplane file; on the CPU
+        # platform there is no device plane, so breakdown is honestly None
+        import jax
+        import jax.numpy as jnp
+        import glob
+
+        with jax.profiler.trace(str(tmp_path)):
+            f = jax.jit(lambda a: (a @ a).sum())
+            jax.block_until_ready(f(jnp.ones((128, 128))))
+        files = glob.glob(
+            str(tmp_path / "**" / "*.xplane.pb"), recursive=True
+        )
+        assert files, "jax.profiler wrote no xplane file"
+        planes = prof.parse_xspace(files[0])
+        assert planes and any(
+            ln.events for p in planes for ln in p.lines
+        )
+        assert prof.breakdown(str(tmp_path)) is None

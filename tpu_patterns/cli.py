@@ -872,7 +872,10 @@ def main(argv: list[str] | None = None) -> int:
         return _cmd_sweep(args, writer)
     if args.enable_profiling:
         # ≙ plumbing enable_profiling into queue construction
-        # (bench_sycl.cpp:39-45) — here the whole pattern run is traced.
+        # (bench_sycl.cpp:39-45) — but unlike the reference, whose queue
+        # event timestamps are never read (SURVEY §5), the trace is
+        # PARSED: a breakdown Record says where the step's device time
+        # went (compute vs collective vs DMA vs idle).
         import os
 
         import jax
@@ -881,6 +884,28 @@ def main(argv: list[str] | None = None) -> int:
         with jax.profiler.trace(args.profile_dir):
             handlers[args.cmd](args, writer)
         writer.progress(f"profile trace written under {args.profile_dir}")
+        from tpu_patterns.core import profile as profile_mod
+        from tpu_patterns.core.results import Record, Verdict
+
+        try:
+            bd = profile_mod.breakdown(args.profile_dir)
+        except Exception as e:  # truncated/corrupt trace file: the
+            # pattern run itself succeeded — its verdict must survive
+            writer.progress(f"trace unparsable ({type(e).__name__}: {e})")
+            bd = None
+        if bd is None:
+            writer.progress(
+                "no device plane in the trace (host-only run?) — "
+                "no breakdown Record"
+            )
+        else:
+            writer.record(Record(
+                pattern=args.cmd,
+                mode="profile_breakdown",
+                commands=args.profile_dir,
+                metrics={k: round(v, 4) for k, v in bd.items()},
+                verdict=Verdict.SUCCESS,
+            ))
     else:
         handlers[args.cmd](args, writer)
     return writer.exit_code
