@@ -25,7 +25,8 @@ while true; do
     # 3. promote winners into OneSidedConfig defaults (comm/tuned.json)
     timeout -k 30 120 python -m tpu_patterns sweep promote --out "$OUT/tune" >> "$OUT/tune.log" 2>&1
     echo "[$(date +%H:%M:%S)] promote done rc=$?"
-    # 4. the full 21-cell measured matrix, incl. decode MHA/GQA/int8 + LM
+    # 4. the full 25-cell measured matrix, incl. decode MHA/GQA/int8 + LM
+    #    and the flagship remat/depth/GQA/rope feature cells
     #    (VERDICT r2 next #1: zero skipped-for-hardware cells)
     timeout -k 30 7200 python -m tpu_patterns sweep measured --out "$OUT/measured" --resume --cell-timeout 600 >> "$OUT/measured.log" 2>&1
     echo "[$(date +%H:%M:%S)] measured done rc=$?"

@@ -186,9 +186,10 @@ class TestSweep:
         assert len(hier) == 2  # 2 dcn splits x 1 dtype
         meas = sweep.specs_for("measured", quick=True)
         assert {s.name.split(".")[0] for s in meas} == {"measured"}
-        # onesided + interop + 6 concurrency + 4 flash + 5 flagship
+        # onesided + interop + 6 concurrency + 4 flash + 9 flagship
+        # (incl. the r3 remat/depth4/gqa/rope feature cells)
         # + decode (mha + gqa + int8) + lm
-        assert len(meas) == 21
+        assert len(meas) == 25
         # every flash cell pins --devices to exactly 1 (any other world
         # would silently SKIP the cell and checkpoint it as passed)
         for s in meas:

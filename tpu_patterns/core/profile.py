@@ -53,6 +53,8 @@ def _fields(buf: bytes):
         if wire == 0:  # varint
             val, i = _read_varint(buf, i)
         elif wire == 1:  # fixed64
+            if i + 8 > n:
+                raise ValueError(f"truncated fixed64 field {field}")
             val = int.from_bytes(buf[i:i + 8], "little")
             i += 8
         elif wire == 2:  # length-delimited
@@ -66,6 +68,8 @@ def _fields(buf: bytes):
                 )
             i += ln
         elif wire == 5:  # fixed32
+            if i + 4 > n:
+                raise ValueError(f"truncated fixed32 field {field}")
             val = int.from_bytes(buf[i:i + 4], "little")
             i += 4
         else:  # group wires (3/4): not produced by xplane writers
@@ -194,7 +198,12 @@ def classify(name: str) -> str:
     return "other"
 
 
-_DEVICE_PLANE_MARKERS = ("/device:tpu", "/device:gpu")
+# TPU only: the breakdown's serial-op-line model (busy = sum of event
+# durations) holds for the TPU device plane; GPU planes carry one line
+# per stream with OVERLAPPING events, where that sum would exceed wall
+# and clamp idle to a silently wrong 0 — better no Record than a wrong
+# one on a platform this suite does not target.
+_DEVICE_PLANE_MARKERS = ("/device:tpu",)
 # lines that re-aggregate the same ops (steps, modules, scopes) — summing
 # them alongside the op line would double-count
 _SKIP_LINES = ("step", "module", "scope", "framework", "source")

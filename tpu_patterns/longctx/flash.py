@@ -60,6 +60,15 @@ def _auto_block(lq: int, lk: int, d: int, in_bytes: int, score_tiles: int,
     return max(bq, 128) if lq >= 128 else bq, max(bk, 128) if lk >= 128 else bk
 
 
+# Every kernel here runs a (head, block-row, accumulation) grid: the
+# first two dims are independent — telling Mosaic so lets it reorder and
+# split them (e.g. across megacore halves on v4/v5p) — while the last
+# revisits VMEM scratch accumulators and must execute in order.
+_DIM_SEMANTICS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary")
+)
+
+
 def _sds(shape, dtype, vma):
     """ShapeDtypeStruct carrying the caller's varying-manual-axes when set
     (required for pallas_call outputs inside shard_map)."""
@@ -328,6 +337,7 @@ def flash_block_bwd(
         out_shape=_sds((h, lq, d), jnp.float32, vma),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
+        compiler_params=_DIM_SEMANTICS,
     )(offs, qt, kt, vt, dot, lse3, delta3)
 
     # dk/dv: transposed nest — grid walks q-blocks innermost per k-block.
@@ -351,6 +361,7 @@ def flash_block_bwd(
             pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=interpret,
+        compiler_params=_DIM_SEMANTICS,
     )(offs, qt, kt, vt, dot, lse3, delta3)
     return dq.swapaxes(0, 1), dk.swapaxes(0, 1), dv.swapaxes(0, 1)
 
@@ -536,6 +547,7 @@ def flash_block(
             pltpu.VMEM((bq, d), jnp.float32),
         ],
         interpret=interpret,
+        compiler_params=_DIM_SEMANTICS,
     )(offs, qt, kt, vt)
     return o.swapaxes(0, 1), m[..., 0], l[..., 0]
 
@@ -590,5 +602,6 @@ def flash_attention(
             pltpu.VMEM((bq, d), jnp.float32),
         ],
         interpret=interpret,
+        compiler_params=_DIM_SEMANTICS,
     )(qt, kt, vt)
     return out.swapaxes(0, 1)
