@@ -197,7 +197,7 @@ class TestSweep:
                 i = s.argv.index("--devices")
                 assert s.argv[i + 1] == "1", s.name
         tune = sweep.specs_for("tune", quick=True)
-        assert len(tune) == 7  # 4 chunk counts + 3 block sizes
+        assert len(tune) == 8  # 5 chunk counts + 3 block sizes
         rt = sweep.specs_for("runtime", quick=True)
         # >= 4 GENUINE runtime configs (C12 bar), each a real XLA/libtpu/
         # JAX knob — not a framework-internal timing mode

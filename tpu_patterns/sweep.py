@@ -621,10 +621,12 @@ def tune_specs(quick: bool = False) -> list[SweepSpec]:
     base = ("p2p", "--transport", "one_sided", "--devices", "1")
     # quick count keeps rows (count/512) >= 2048 so the three block-size
     # cells stay distinct configurations (the divisor clamp would fold a
-    # smaller buffer's 512/1024/2048 all to the same block)
+    # smaller buffer's 512/1024/2048 all to the same block).  2048 is
+    # also the streamed kernel's hard VMEM ceiling (4 MB block x double
+    # buffering), so there is no larger cell to search.
     size = ("--count", "1048576", "--reps", "2") if quick else ("--reps", "5")
     specs = []
-    for chunks in (4, 8, 16, 32):
+    for chunks in (4, 8, 16, 32, 64):
         name = f"tune.multi.chunks{chunks}"
         specs.append(
             SweepSpec(
