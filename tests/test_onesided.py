@@ -118,8 +118,29 @@ class TestRunOneSided:
         (rec,) = run_onesided(mesh, OneSidedConfig(count=2048, reps=2, warmup=1))
         assert rec.verdict is Verdict.SUCCESS, rec.notes
         assert any("multi failed: RuntimeError" in n for n in rec.notes)
-        assert any(n == "auto-selected kernel: streamed" for n in rec.notes)
+        # one of the surviving candidates (streamed or the xla rotation)
+        # wins; which one is a measurement, not a contract
+        assert any(
+            n in ("auto-selected kernel: streamed",
+                  "auto-selected kernel: xla")
+            for n in rec.notes
+        )
         assert "bandwidth_GBps_multi" not in rec.metrics
+
+    def test_explicit_xla_kernel_verifies_rotation(self, devices):
+        # the compiler-scheduled candidate: a one-row rotation whose
+        # output is checked against np.roll (the ring_put discipline) —
+        # a wrong-offset "copy" fails the data gate
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(devices[:1]), ("x",))
+        (rec,) = run_onesided(
+            mesh,
+            OneSidedConfig(count=2048, reps=2, warmup=1, kernel="xla"),
+        )
+        assert rec.verdict is Verdict.SUCCESS, rec.notes
+        assert rec.metrics["checksum_ok"] == 1.0
+        assert rec.metrics["bandwidth_GBps"] > 0
 
     def test_explicit_broken_kernel_raises(self, devices, monkeypatch):
         from jax.sharding import Mesh

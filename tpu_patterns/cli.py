@@ -613,9 +613,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--put-kernel",
-        choices=("auto", "streamed", "multi", "mono"),
+        choices=("auto", "streamed", "multi", "mono", "xla"),
         default="auto",
-        help="one_sided single-chip DMA schedule (auto = measure and pick)",
+        help="one_sided single-chip copy schedule (auto = measure "
+        "streamed, multi, and the XLA-scheduled rotation, then pick)",
     )
     p.add_argument(
         "--chunks",

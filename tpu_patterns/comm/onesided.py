@@ -22,9 +22,12 @@ Four kernels:
   ``MPI_Put`` in one epoch, fenced together): deeper engine occupancy than
   the single monolithic DMA without the VMEM bounce.
 
-On one device ``run_onesided`` auto-selects the faster of the streamed and
-multi schedules (``OneSidedConfig.kernel="auto"``) — the measured winner is
-the chip's HBM copy headline (hence ``bench.py`` on a 1-chip host).
+On one device ``run_onesided`` auto-selects the fastest of the streamed
+and multi Pallas schedules plus an XLA-scheduled contrast (a one-row
+rotation copy the compiler lowers itself — "let XLA do it" raced against
+the hand-written DMA schedules) under ``OneSidedConfig.kernel="auto"`` —
+the measured winner is the chip's HBM copy headline (hence ``bench.py``
+on a 1-chip host).
 """
 
 from __future__ import annotations
@@ -212,9 +215,9 @@ class OneSidedConfig:
     warmup: int = 2
     min_bandwidth: float = -1.0
     seed: int = 0
-    # single-device kernel schedule: auto | streamed | multi | mono
-    # (auto measures streamed + multi with the tuned knobs below and
-    # reports the winner)
+    # single-device kernel schedule: auto | streamed | multi | mono | xla
+    # (auto measures streamed + multi + the XLA-scheduled rotation copy
+    # with the tuned knobs below and reports the winner)
     kernel: str = "auto"
     # streamed: rows per VMEM block; multi: concurrent outstanding DMAs —
     # defaults come from the promoted tune run when one is committed
@@ -235,12 +238,12 @@ def run_onesided(
 
     setup_jax()
     cfg = cfg or OneSidedConfig()
-    if cfg.kernel not in ("auto", "streamed", "multi", "mono"):
+    if cfg.kernel not in ("auto", "streamed", "multi", "mono", "xla"):
         # validated regardless of mesh size: a typo must not be silently
         # dropped just because the multi-device ring path ignores it
         raise ValueError(
             f"unknown onesided kernel {cfg.kernel!r}; "
-            "want auto|streamed|multi|mono"
+            "want auto|streamed|multi|mono|xla"
         )
     writer = writer or ResultWriter()
     interpret = use_interpret()
@@ -293,25 +296,50 @@ def run_onesided(
         mode = "local_put"
         x = verify.fill_randomly(count, cfg.dtype, cfg.seed).reshape(rows, cols)
 
+        # Each candidate: (put fn, expected output fn).  The Pallas
+        # schedules copy in place (out == in); "xla" is the
+        # compiler-scheduled contrast — a one-row rotation (the
+        # single-device twin of ring_put's neighbor write, verified the
+        # same np.roll way) that XLA lowers to its own fused HBM
+        # read+write.  Rotation (not identity copy) + the
+        # optimization_barrier below keep the chained measurement honest:
+        # a chained identity copy would simplify away, and without the
+        # barrier XLA's algebraic simplifier could fold 8 chained
+        # one-row rolls into a single roll-by-8 (slice-of-concat /
+        # concat-of-concat folding), crediting 8 copies for one.
+        roll_axis = 0 if rows > 1 else 1  # rows==1: roll-by-row = identity
         puts = {
-            "streamed": lambda b: local_put_streamed(
-                b, block_rows=cfg.block_rows, interpret=interpret
+            "streamed": (
+                lambda b: local_put_streamed(
+                    b, block_rows=cfg.block_rows, interpret=interpret
+                ),
+                lambda a: a,
             ),
-            "multi": lambda b: local_put_multi(
-                b, chunks=cfg.chunks, interpret=interpret
+            "multi": (
+                lambda b: local_put_multi(
+                    b, chunks=cfg.chunks, interpret=interpret
+                ),
+                lambda a: a,
             ),
-            "mono": lambda b: local_put(b, interpret=interpret),
+            "mono": (lambda b: local_put(b, interpret=interpret),
+                     lambda a: a),
+            "xla": (lambda b: jnp.roll(b, 1, axis=roll_axis),
+                    lambda a: np.roll(a, 1, axis=roll_axis)),
         }
         if cfg.kernel == "auto":
-            candidates = {k: puts[k] for k in ("streamed", "multi")}
+            candidates = {k: puts[k] for k in ("streamed", "multi", "xla")}
         else:
             candidates = {cfg.kernel: puts[cfg.kernel]}
 
         def one_kernel(put):
             fn = jax.jit(put)
+            # barrier per chain step: each put must materialize — XLA may
+            # not algebraically merge consecutive steps (see the "xla"
+            # candidate note above; a no-op for the opaque Pallas calls)
+            step = lambda b: lax.optimization_barrier(put(b))  # noqa: E731
             chained = jax.jit(
                 lambda a, k: jnp.sum(
-                    timing.unrolled_chain(put, a, k).astype(jnp.float32)
+                    timing.unrolled_chain(step, a, k).astype(jnp.float32)
                 )
             )
             build = lambda k: (lambda: chained(x, jnp.int32(k)))  # noqa: E731
@@ -342,7 +370,7 @@ def run_onesided(
         # zero the headline; an explicitly requested kernel still raises.
         best = None
         errors: list[BaseException] = []
-        for name, put in candidates.items():
+        for name, (put, want_fn) in candidates.items():
             try:
                 kfn, kbuild = one_kernel(put)
                 kres = timing.measure_chain(
@@ -363,10 +391,10 @@ def run_onesided(
             extra_metrics[f"bandwidth_GBps_{name}"] = kgbps
             writer.progress(f"onesided local_put[{name}]: {kgbps:.1f} GB/s")
             if best is None or kgbps > best[2]:
-                best = (name, kfn, kgbps, kres)
+                best = (name, kfn, kgbps, kres, want_fn)
         if best is None:
             raise errors[0]
-        name, fn, gbps, res = best
+        name, fn, gbps, res, want_fn = best
         if len(candidates) > 1:
             notes.append(f"auto-selected kernel: {name}")
 
@@ -375,7 +403,7 @@ def run_onesided(
         want = np.roll(np.asarray(x), shift=rows, axis=0)  # shard i -> i+1
         data_ok = bool((out == want).all())
     else:
-        data_ok = bool((out == np.asarray(x)).all())
+        data_ok = bool((out == want_fn(np.asarray(x))).all())
     bw_ok = cfg.min_bandwidth < 0 or gbps >= cfg.min_bandwidth
 
     verdict = Verdict.SUCCESS if (data_ok and bw_ok) else Verdict.FAILURE
