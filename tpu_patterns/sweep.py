@@ -363,6 +363,27 @@ def parallel_specs(quick: bool = False) -> list[SweepSpec]:
             env=(("TPU_PATTERNS_SWEEP_CONFIG", "decode"),),
         )
     )
+    # the layout x feature matrix over a REAL sp axis: striped cache
+    # placement and moe expert routing only differ from the base cell
+    # when sp/tp exceed 1 — which is exactly what this multi-device
+    # suite provides (the single-chip measured suite cannot)
+    specs.append(
+        SweepSpec(
+            name="decode.kv_cache_striped",
+            argv=("decode", "--layout", "striped", *decode_small),
+            env=(("TPU_PATTERNS_SWEEP_CONFIG", "decode"),),
+        )
+    )
+    specs.append(
+        SweepSpec(
+            name="decode.kv_cache_moe",
+            # --tp 2: experts ride the tp axis (one per rank) — without
+            # it the CLI gives every device to sp and the "moe" cell
+            # degenerates to a single-expert FFN
+            argv=("decode", "--moe", "true", "--tp", "2", *decode_small),
+            env=(("TPU_PATTERNS_SWEEP_CONFIG", "decode"),),
+        )
+    )
     # token-level LM: vocab-parallel embedding/CE/argmax, train + greedy
     lm_small = (
         ("--vocab", "64", "--embed", "64", "--head_dim", "8",
