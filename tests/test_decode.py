@@ -441,18 +441,29 @@ class TestInt8Cache:
 
 
 class TestRagged:
-    @pytest.mark.parametrize("rope", [False, True])
-    def test_ragged_decode_matches_per_row_forward(self, devices, rope):
+    @pytest.mark.parametrize(
+        "rope,layout",
+        [(False, "contiguous"), (True, "contiguous"), (True, "striped")],
+    )
+    def test_ragged_decode_matches_per_row_forward(
+        self, devices, rope, layout
+    ):
         # rows with DIFFERENT prompt lengths (right-padded): teacher-
         # forced decode of row b at gen step n must equal the plain
         # causal forward of that row's own unpadded sequence at position
-        # lens[b] + n.  rope=True makes positions load-bearing.
+        # lens[b] + n.  rope=True makes positions load-bearing; the
+        # striped case additionally proves ragged masks/gathers against
+        # the striped slot placement (rows' valid tokens scatter across
+        # ranks instead of filling them in order).
         from tpu_patterns.models.transformer import forward_shard
 
         mesh = Mesh(
             np.array(devices[:8]).reshape(2, 2, 2), ("dp", "sp", "tp")
         )
-        cfg = ModelConfig(**CFG, dtype="float32", causal=True, rope=rope)
+        cfg = ModelConfig(
+            **CFG, dtype="float32", causal=True, rope=rope,
+            attn_layout=layout,
+        )
         b, lp, gen = 4, 16, 4
         lens_np = np.array([16, 11, 8, 3], np.int32)
         params = _stacked_params(jax.random.key(0), cfg)
@@ -478,8 +489,16 @@ class TestRagged:
             {k: NamedSharding(mesh, s)
              for k, s in _stacked_specs(cfg).items()},
         )
+        xp_np = np.asarray(x[:, :lp])
+        if layout == "striped":
+            # the caller stripes (shard r holds tokens r::sp); padding
+            # stripes with everything else
+            sp = int(mesh.shape["sp"])
+            xp_np = np.concatenate(
+                [xp_np[:, r::sp] for r in range(sp)], axis=1
+            )
         xp = jax.device_put(
-            x[:, :lp], NamedSharding(mesh, P("dp", "sp", None))
+            xp_np, NamedSharding(mesh, P("dp", "sp", None))
         )
         lens = jax.device_put(
             jnp.asarray(lens_np), NamedSharding(mesh, P("dp"))
