@@ -213,7 +213,8 @@ def test_ring_attention_striped_layout(mesh1d, qkv, block_impl):
 
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from tpu_patterns.longctx.pattern import _stripe, _unstripe
+    from tpu_patterns.longctx.attention import stripe as _stripe
+    from tpu_patterns.longctx.pattern import _unstripe
 
     q, k, v = qkv
     # stripe: concatenate [x[r::sp] for r] so contiguous shard r == stripe r
@@ -300,7 +301,8 @@ def test_ring_flash_gradients_match_reference(mesh1d, qkv, causal, layout):
 
     from jax.sharding import PartitionSpec as P
 
-    from tpu_patterns.longctx.pattern import _stripe, _unstripe
+    from tpu_patterns.longctx.attention import stripe as _stripe
+    from tpu_patterns.longctx.pattern import _unstripe
 
     q, k, v = qkv
     stripe = lambda x: jnp.asarray(_stripe(np.asarray(x), SP))  # noqa: E731

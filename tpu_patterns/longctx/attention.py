@@ -29,6 +29,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 # Finite stand-in for -inf: keeps exp() exactly 0 without NaNs from
 # (-inf) - (-inf) when a whole block is masked out.  -1e30 is exact in
@@ -44,6 +45,25 @@ def neg_inf(dtype) -> float:
 
 def _scale(q, scale):
     return float(scale) if scale is not None else q.shape[-1] ** -0.5
+
+
+def stripe(a, sp: int, axis: int = 0):
+    """Global token order -> the striped shard layout along ``axis``.
+
+    Lays the array out stripe-major so a contiguous sp-way sharding of
+    the result gives shard r exactly tokens ``r::sp`` — THE caller-side
+    transform every striped consumer assumes (ring_attention
+    layout="striped", the striped KV cache, the LM halo).  numpy in ->
+    numpy out, jax in -> jax out; ``sp <= 1`` is the identity."""
+    if sp <= 1:
+        return a
+    xp = jnp if isinstance(a, jax.Array) else np
+    sl = [slice(None)] * a.ndim
+    parts = []
+    for r in range(sp):
+        sl[axis] = slice(r, None, sp)
+        parts.append(a[tuple(sl)])
+    return xp.concatenate(parts, axis=axis)
 
 
 def causal_mask(q_pos: jax.Array, k_pos: jax.Array) -> jax.Array:

@@ -144,11 +144,6 @@ def reference_blockwise(q, k, v, causal: bool) -> np.ndarray:
     return np.concatenate(outs, axis=0)
 
 
-def _stripe(a: np.ndarray, sp: int) -> np.ndarray:
-    """Global token order -> striped shard order (shard r = tokens r::sp)."""
-    return np.concatenate([a[r::sp] for r in range(sp)])
-
-
 def _unstripe(a: np.ndarray, sp: int) -> np.ndarray:
     out = np.empty_like(a)
     lq = a.shape[0] // sp
@@ -329,7 +324,7 @@ def run_longctx_grad(
         striped = name in STRIPED and sp > 1
         if striped:
             qs, ks, vs, cts = (
-                jax.device_put(_stripe(np.asarray(a), sp), sharding)
+                jax.device_put(att.stripe(np.asarray(a), sp), sharding)
                 for a in (q, k, v, ct)
             )
         else:
@@ -496,7 +491,7 @@ def run_longctx(
         striped = name in STRIPED and sp > 1
         if striped:
             qs, ks, vs = (
-                jax.device_put(_stripe(np.asarray(a), sp), sharding)
+                jax.device_put(att.stripe(np.asarray(a), sp), sharding)
                 for a in (q, k, v)
             )
         else:

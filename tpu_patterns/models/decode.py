@@ -84,14 +84,14 @@ def _quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
     return q, s
 
 
-def _mlp(params, y, tp_axis, cfg: ModelConfig | None = None):
+def _mlp(params, y, tp_axis, cfg: ModelConfig):
     """The block's FFN: dense column/row-parallel MLP, or — when the
     model is a mixture — the training path's top-1 MoE (experts one per
     tp rank, transformer._moe_ffn).  Decode activations are already
     tp-replicated after the attention psum, which is exactly the
     dispatch precondition _moe_ffn assumes, so the SAME expert routing
     serves training and generation (ep-aware decode, VERDICT r2 #4)."""
-    if cfg is not None and cfg.moe:
+    if cfg.moe:
         from tpu_patterns.models.transformer import _moe_ffn
 
         return y + _moe_ffn(params, y, tp_axis, cfg.capacity_factor)
@@ -800,10 +800,11 @@ def _teacher_forcing_gate(
         },
     )
     xp = np.asarray(x[:, :half])
-    if cfg.attn_layout == "striped" and sp > 1:
-        # the caller stripes: shard r must receive tokens r::sp, so lay
-        # the array out stripe-major before the contiguous sp chunking
-        xp = np.concatenate([xp[:, r::sp] for r in range(sp)], axis=1)
+    if cfg.attn_layout == "striped":
+        # the caller stripes: shard r must receive tokens r::sp
+        from tpu_patterns.longctx.attention import stripe
+
+        xp = stripe(xp, sp, axis=1)
     xs = jax.device_put(xp, NamedSharding(mesh, P("dp", "sp", None)))
     caches, y_last = prefill(sharded_params, xs)
     got = [np.asarray(y_last)[:, 0]]  # output at position half-1

@@ -37,6 +37,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_patterns.longctx import attention
 from tpu_patterns.models.transformer import (
     ModelConfig,
     _check_kv_heads_shardable,
@@ -378,12 +379,10 @@ def run_lm(mesh: Mesh, cfg: LMConfig, writer) -> list:
     toks = jax.random.randint(
         jax.random.key(cfg.seed + 1), (cfg.batch, cfg.seq), 0, cfg.vocab
     )
-    if cfg.layout == "striped" and sp > 1:
+    if cfg.layout == "striped":
         # the caller stripes: shard r holds tokens r::sp (training loss
         # halo and the decode cache both assume it)
-        toks = jnp.concatenate(
-            [toks[:, r::sp] for r in range(sp)], axis=1
-        )
+        toks = attention.stripe(toks, sp, axis=1)
     step, _ = make_lm_train_step(mesh, mcfg, cfg.vocab, lr=cfg.lr)
     p = shard_lm_params(params, mesh, mcfg)
     st = jax.device_put(toks, NamedSharding(mesh, P("dp", "sp")))

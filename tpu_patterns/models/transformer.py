@@ -864,7 +864,9 @@ def run_flagship(mesh: Mesh, cfg: FlagshipConfig, writer) -> list:
         jax.random.key(cfg.seed + 1), (cfg.batch, cfg.seq, cfg.embed), dtype
     )
     if cfg.attn_layout == "striped":
-        x = jnp.concatenate([x[:, r::sp] for r in range(sp)], axis=1)
+        from tpu_patterns.longctx.attention import stripe
+
+        x = stripe(x, sp, axis=1)
     # Timing lr: small enough that p - lr*g underflows to p (reps cannot
     # diverge the unnormalized objective) but non-zero so XLA cannot fold
     # the update away and DCE the entire backward.
