@@ -255,3 +255,28 @@ class TestMeasureChain:
         )
         assert m.lengths[1] <= 32
         assert m.per_op_ns >= 0
+
+
+class TestChipPeak:
+    def test_dtype_scales_peak(self, monkeypatch):
+        """float32 issues through the MXU at half the bf16 rate: the
+        sanity ceiling must halve with it, or an f32 accounting bug of
+        up to 2x sails under a bf16 gate (ADVICE r3)."""
+        import jax
+
+        from tpu_patterns import runtime
+
+        class _Dev:
+            platform = "tpu"
+            device_kind = "TPU v5 lite"
+
+        monkeypatch.setattr(jax, "devices", lambda: [_Dev()])
+        assert runtime.chip_peak_tflops() == 197.0
+        assert runtime.chip_peak_tflops("bfloat16") == 197.0
+        assert runtime.chip_peak_tflops("float32") == 98.5
+        assert runtime.chip_peak_tflops("int8") == 197.0
+
+    def test_off_tpu_is_none(self):
+        from tpu_patterns import runtime
+
+        assert runtime.chip_peak_tflops("float32") is None

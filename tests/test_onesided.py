@@ -214,3 +214,28 @@ class TestLocalPutStreamedEdges:
 
     def test_1d_input(self):
         self._roundtrip((4096,))
+
+
+class TestTunedDefaults:
+    def test_tuned_defaults_resolve_lazily(self, tmp_path, monkeypatch):
+        """Promoted/overridden tuned knobs must affect the NEXT config
+        built in this process, not the next interpreter (ADVICE r3):
+        defaults are default_factory-resolved, not baked at class
+        definition."""
+        import json
+
+        tuned = tmp_path / "tuned.json"
+        tuned.write_text(
+            json.dumps({"block_rows": 7777, "chunks": 31})
+        )
+        monkeypatch.setenv("TPU_PATTERNS_TUNED", str(tuned))
+        after = OneSidedConfig()
+        assert (after.block_rows, after.chunks) == (7777, 31)
+        # pointing at /dev/null disables tuning -> hand-picked fallbacks
+        monkeypatch.setenv("TPU_PATTERNS_TUNED", "/dev/null")
+        assert (OneSidedConfig().block_rows, OneSidedConfig().chunks) == (
+            1024,
+            8,
+        )
+        # explicit values always win over tuned defaults
+        assert OneSidedConfig(block_rows=3, chunks=2).block_rows == 3

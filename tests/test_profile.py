@@ -138,9 +138,20 @@ class TestClassify:
             ("all-to-all", "collective"),
             ("collective-permute-start", "collective"),
             ("copy.3", "dma"),
-            ("dynamic-update-slice-fusion", "dma"),
+            ("copy-start.1", "dma"),
+            # in-place fused update loop: compute on TPU, not DMA-engine
+            # time (VERDICT r3 weak #4)
+            ("dynamic-update-slice-fusion", "compute"),
+            ("transpose.4", "compute"),  # VPU, not a copy engine
+            # a fusion wrapping a copy is still a compute loop
+            ("loop_copy_fusion.2", "compute"),
             ("outfeed", "infeed_outfeed"),
             ("reduce.9", "compute"),
+            ("send.2", "collective"),
+            # word boundaries: collective tokens must not fire inside
+            # unrelated op names (ADVICE r3)
+            ("condsend-custom-call", "other"),
+            ("wrecv_thing", "other"),
             ("some-custom-call", "other"),
         ],
     )

@@ -121,6 +121,14 @@ def _cmd_p2p(args, writer: ResultWriter) -> None:
         return
     mesh = _build_mesh(args.devices, args.placement, args.mechanism)
     if args.transport == "one_sided":  # ≙ the -DUSE_WIN build (run.sh:5)
+        tuned_overrides = {
+            k: v
+            for k, v in (
+                ("chunks", args.chunks),
+                ("block_rows", args.block_rows),
+            )
+            if v is not None
+        }
         cfg = OneSidedConfig(
             count=args.count,
             dtype=args.dtype,
@@ -129,8 +137,7 @@ def _cmd_p2p(args, writer: ResultWriter) -> None:
             min_bandwidth=args.min_bandwidth,
             seed=args.seed,
             kernel=args.put_kernel,
-            chunks=args.chunks,
-            block_rows=args.block_rows,
+            **tuned_overrides,
         )
         run_onesided(mesh, cfg, writer)
     else:
@@ -618,17 +625,21 @@ def build_parser() -> argparse.ArgumentParser:
         help="one_sided single-chip copy schedule (auto = measure "
         "streamed, multi, and the XLA-scheduled rotation, then pick)",
     )
+    # default=None so the promoted tuned.json defaults (resolved inside
+    # OneSidedConfig) apply unless the flag is given explicitly
     p.add_argument(
         "--chunks",
         type=int,
-        default=8,
-        help="one_sided multi: concurrent outstanding DMAs",
+        default=None,
+        help="one_sided multi: concurrent outstanding DMAs "
+        "(default: tuned.json, else 8)",
     )
     p.add_argument(
         "--block-rows",
         type=int,
-        default=1024,
-        help="one_sided streamed: rows per VMEM block",
+        default=None,
+        help="one_sided streamed: rows per VMEM block "
+        "(default: tuned.json, else 1024)",
     )
     _add_mesh_args(p)
 

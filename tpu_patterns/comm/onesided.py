@@ -204,9 +204,6 @@ def _load_tuned() -> dict:
         return {}
 
 
-_TUNED = _load_tuned()
-
-
 @dataclasses.dataclass
 class OneSidedConfig:
     count: int = 1179648 * 40  # elements; reference message size (≙ C1)
@@ -220,9 +217,22 @@ class OneSidedConfig:
     # with the tuned knobs below and reports the winner)
     kernel: str = "auto"
     # streamed: rows per VMEM block; multi: concurrent outstanding DMAs —
-    # defaults come from the promoted tune run when one is committed
-    block_rows: int = _TUNED.get("block_rows", 1024)
-    chunks: int = _TUNED.get("chunks", 8)
+    # defaults come from the promoted tune run when one is committed.
+    # Resolved lazily per-instance in __post_init__ (one tuned.json read
+    # covers both knobs, so a mid-build rewrite cannot mix two tune
+    # runs), NOT at class definition: `sweep promote` or
+    # TPU_PATTERNS_TUNED set mid-process must affect the next config
+    # built, not the next interpreter (ADVICE r3).
+    block_rows: int | None = None
+    chunks: int | None = None
+
+    def __post_init__(self):
+        if self.block_rows is None or self.chunks is None:
+            tuned = _load_tuned()
+            if self.block_rows is None:
+                self.block_rows = tuned.get("block_rows", 1024)
+            if self.chunks is None:
+                self.chunks = tuned.get("chunks", 8)
 
 
 

@@ -275,7 +275,7 @@ def run_longctx_grad(
     gradients — the backward twin of :func:`run_longctx`."""
     from tpu_patterns.runtime import chip_peak_tflops, use_interpret
 
-    peak = chip_peak_tflops()
+    peak = chip_peak_tflops(cfg.dtype)
 
     axis = mesh.axis_names[0]
     sp = int(np.prod(mesh.devices.shape))
@@ -389,9 +389,13 @@ def run_longctx_grad(
         err_rms = max(_rms(g - r) for g, r in zip(got_np, ref_np))
         data_ok = violation <= 1.0 and rms_ratio <= 1.0
         perf_ok = cfg.min_tflops < 0 or tflops >= cfg.min_tflops
-        # A silicon rate above chip peak cannot be a measurement of
-        # anything; fail loudly rather than commit an impossible number.
-        sane = peak is None or tflops_hw <= peak
+        # A silicon rate above the participating chips' aggregate peak
+        # cannot be a measurement of anything; fail loudly rather than
+        # commit an impossible number.  tflops_hw is a GLOBAL rate (all
+        # attention FLOPs over wall time) while the multi-device cells
+        # (ring/ulysses, sp>1) spread those FLOPs over sp chips — the
+        # bound is sp * per-chip peak, not one chip's (ADVICE r3 medium).
+        sane = peak is None or tflops_hw <= peak * sp
         writer.metric(f"{name} attention grad", tflops, "TFLOP/s (model)")
         writer.metric(f"{name} attention grad hw", tflops_hw, "TFLOP/s (silicon)")
         rec = Record(
@@ -421,8 +425,8 @@ def run_longctx_grad(
             rec.notes.append(f"{tflops:.3f} TFLOP/s below floor {cfg.min_tflops}")
         if not sane:
             rec.notes.append(
-                f"hardware rate {tflops_hw:.1f} TFLOP/s exceeds chip peak "
-                f"{peak:.1f} — accounting or timing bug"
+                f"hardware rate {tflops_hw:.1f} TFLOP/s exceeds "
+                f"{sp}-chip peak {peak * sp:.1f} — accounting or timing bug"
             )
         records.append(writer.record(rec))
     return records

@@ -45,16 +45,25 @@ def match_device_spec(
     return best[1] if best else None
 
 
-def chip_peak_tflops() -> float | None:
-    """bf16 peak of device 0, or None off-TPU / unknown kind."""
+def chip_peak_tflops(dtype=None) -> float | None:
+    """Dense peak of device 0 for ``dtype``, or None off-TPU / unknown
+    kind.  The table holds bf16 peaks; float32 issues through the MXU at
+    half rate, so its ceiling is peak/2 — gating an f32 cell against the
+    bf16 number would let a 2x accounting bug pass as "sane" (ADVICE r3)."""
     import jax
 
     dev = jax.devices()[0]
     if dev.platform != "tpu":
         return None
-    return match_device_spec(
+    peak = match_device_spec(
         _CHIP_PEAK_TFLOPS, getattr(dev, "device_kind", "")
     )
+    if peak is not None and dtype is not None:
+        import numpy as np
+
+        if np.dtype(dtype).itemsize >= 4:
+            peak /= 2.0
+    return peak
 
 
 def _backends_initialized() -> bool:

@@ -685,7 +685,8 @@ def promote_tuned(tune_dir: str, dest: str | None = None) -> dict:
     Reads every ``tune.*.jsonl`` under ``tune_dir``, takes the best
     ``bandwidth_GBps`` per kernel family (multi: chunks axis; streamed:
     block_rows axis), and writes the winners to ``dest`` (default: the
-    package's ``comm/tuned.json``, which OneSidedConfig reads at import).
+    package's ``comm/tuned.json``, which OneSidedConfig reads each time
+    a config is built — promotion takes effect in-process).
     Returns the promoted dict; raises FileNotFoundError when the dir holds
     no completed tune cells (promotion must never silently no-op)."""
     import glob
