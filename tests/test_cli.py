@@ -1,5 +1,6 @@
 """CLI + sweep drivers (SURVEY.md C7, C11, C12)."""
 
+import dataclasses
 import json
 import os
 import sys
@@ -30,6 +31,7 @@ class TestParser:
             ["interop"],
             ["sweep", "p2p", "--quick"],
             ["report", "x.log"],
+            ["hlocheck", "--seq", "1024", "--depth", "2"],
         ):
             args = p.parse_args(argv)
             assert args.cmd == argv[0]
@@ -149,6 +151,64 @@ class TestCommands:
         assert main(["report", str(log)]) == 0
         out = capsys.readouterr().out
         assert "SUCCESS" in out and "FAILURE" in out and "cfg1" in out
+
+    def test_report_refuses_unmarked_prefix_grad_records(
+        self, tmp_path, capsys
+    ):
+        """A grad rate captured before the FLOP-accounting fix credits
+        dead-code-eliminated kernels; `report` must refuse it unless the
+        archive marks the row superseded (VERDICT r3 next #8)."""
+        import pytest
+
+        from tpu_patterns.core.results import (
+            GRAD_ACCOUNTING_FIX_TS,
+            Record,
+            Verdict,
+        )
+
+        stale = Record(
+            pattern="longctx",
+            mode="flash_grad",
+            commands="sp1 L4096 grad",
+            metrics={"tflops": 189.7},
+            timestamp=GRAD_ACCOUNTING_FIX_TS - 100.0,
+        )
+        log = tmp_path / "grad.jsonl"
+        log.write_text(stale.to_json() + "\n")
+        with pytest.raises(SystemExit) as ei:
+            main(["report", str(log)])
+        assert ei.value.code == 2
+        assert "REFUSED" in capsys.readouterr().err
+        # marked superseded -> tabulated, but branded as provenance
+        marked = dataclasses.replace(stale, superseded=True)
+        log.write_text(marked.to_json() + "\n")
+        assert main(["report", str(log)]) == 0
+        assert "SUPERSEDED" in capsys.readouterr().out
+        # post-fix grad records tabulate normally
+        clean = dataclasses.replace(
+            stale, timestamp=GRAD_ACCOUNTING_FIX_TS + 100.0
+        )
+        log.write_text(clean.to_json() + "\n")
+        assert main(["report", str(log)]) == 0
+        assert "SUPERSEDED" not in capsys.readouterr().out
+
+    def test_committed_grad_archive_is_marked(self):
+        """The six retracted rows in the committed archive must stay
+        marked — report over the real file must not refuse."""
+        import pathlib
+
+        from tpu_patterns.core.results import parse_log, stale_grad_records
+
+        path = (
+            pathlib.Path(__file__).parent.parent
+            / "docs"
+            / "measured"
+            / "flash_tpu_v5e.jsonl"
+        )
+        records = parse_log(path.read_text().splitlines())
+        assert len(records) == 13
+        assert stale_grad_records(records) == []
+        assert sum(r.superseded for r in records) == 6
 
 
 class TestProfiling:

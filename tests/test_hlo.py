@@ -1,0 +1,236 @@
+"""Compiled-program structural assertions (core/hlo.py).
+
+The tunnel-independent perf-evidence tier (VERDICT r3 next #2): these
+tests fail — with no TPU attached — if XLA ever serializes the
+decomposed collective-matmul ring into collect-then-compute, or if
+remat stops shrinking the compiled buffer assignment at long-context
+shapes.  The async start/done overlap check is exercised against a
+synthetic scheduled module here (CPU keeps collective-permute
+synchronous); the hardware ladder runs the same helper on real TPU HLO.
+"""
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_patterns.core import hlo
+from tpu_patterns.parallel.overlap import (
+    allgather_matmul,
+    matmul_reducescatter,
+)
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def mesh(devices):
+    return Mesh(np.array(devices[:N]), ("tp",))
+
+
+def _ag(mesh, decomposed):
+    return shard_map(
+        partial(
+            allgather_matmul, axis_name="tp", axis_size=N,
+            decomposed=decomposed,
+        ),
+        mesh=mesh,
+        in_specs=(P("tp", None), P(None, "tp")),
+        out_specs=P(None, "tp"),
+    )
+
+
+def _rs(mesh, decomposed):
+    return shard_map(
+        partial(
+            matmul_reducescatter, axis_name="tp", axis_size=N,
+            decomposed=decomposed,
+        ),
+        mesh=mesh,
+        in_specs=(P(None, "tp"), P("tp", None)),
+        out_specs=P("tp", None),
+    )
+
+
+class TestRingInterleaved:
+    """The decomposed collective matmul must keep transfer and matmul in
+    ONE loop body — the structure the overlap claim rests on."""
+
+    X_AG = jax.ShapeDtypeStruct((N * 16, 64), jnp.float32)
+    W_AG = jax.ShapeDtypeStruct((64, N * 32), jnp.float32)
+    X_RS = jax.ShapeDtypeStruct((N * 16, N * 64), jnp.float32)
+    W_RS = jax.ShapeDtypeStruct((N * 64, 32), jnp.float32)
+
+    def test_allgather_matmul_ring_survives_compilation(self, mesh):
+        txt = hlo.optimized_hlo(_ag(mesh, True), self.X_AG, self.W_AG)
+        assert hlo.ring_interleaved(txt), (
+            "XLA serialized the decomposed all-gather matmul: no loop "
+            "body carries both a collective-permute and a dot"
+        )
+        # and the collective really was decomposed away
+        assert hlo.opcode_counts(txt, ["all-gather"])["all-gather"] == 0
+
+    def test_reducescatter_matmul_ring_survives_compilation(self, mesh):
+        txt = hlo.optimized_hlo(_rs(mesh, True), self.X_RS, self.W_RS)
+        assert hlo.ring_interleaved(txt)
+        assert (
+            hlo.opcode_counts(txt, ["reduce-scatter"])["reduce-scatter"]
+            == 0
+        )
+
+    def test_baselines_are_not_interleaved(self, mesh):
+        """The undecomposed forms must NOT satisfy the predicate — that
+        is what makes a True from the decomposed form evidence rather
+        than vacuity."""
+        ag = hlo.optimized_hlo(_ag(mesh, False), self.X_AG, self.W_AG)
+        rs = hlo.optimized_hlo(_rs(mesh, False), self.X_RS, self.W_RS)
+        assert not hlo.ring_interleaved(ag)
+        assert not hlo.ring_interleaved(rs)
+        assert hlo.opcode_counts(ag, ["all-gather"])["all-gather"] >= 1
+        assert (
+            hlo.opcode_counts(rs, ["reduce-scatter"])["reduce-scatter"]
+            >= 1
+        )
+
+
+# A hand-written scheduled module in the two shapes that matter: the
+# start/done pair with compute between (overlap) and without (serial).
+# Shapes/operands mimic real TPU scheduled dumps, incl. tuple types with
+# /*index=N*/ comments that contain '=' inside the type expression.
+_OVERLAPPED = """\
+HloModule m
+
+%body (p: (f32[128,64], f32[128,64])) -> (f32[128,64], f32[128,64]) {
+  %p = (f32[128,64]{1,0}, f32[128,64]{1,0}) parameter(0)
+  %gte.0 = f32[128,64]{1,0} get-tuple-element(%p), index=0
+  %gte.1 = f32[128,64]{1,0} get-tuple-element(%p), index=1
+  %cp-start = (f32[128,64]{1,0}, f32[128,64]{1,0}, u32[], /*index=3*/u32[]) collective-permute-start(%gte.0), source_target_pairs={{0,1},{1,0}}
+  %dot.0 = f32[128,64]{1,0} dot(%gte.1, %gte.1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %fusion.0 = f32[128,64]{1,0} fusion(%dot.0), kind=kLoop, calls=%fc
+  %cp-done = f32[128,64]{1,0} collective-permute-done(%cp-start)
+  ROOT %tuple.0 = (f32[128,64]{1,0}, f32[128,64]{1,0}) tuple(%cp-done, %fusion.0)
+}
+
+ENTRY %main (a: f32[128,64], b: f32[128,64]) -> (f32[128,64], f32[128,64]) {
+  %a = f32[128,64]{1,0} parameter(0)
+  %b = f32[128,64]{1,0} parameter(1)
+  %t = (f32[128,64]{1,0}, f32[128,64]{1,0}) tuple(%a, %b)
+  ROOT %call.0 = (f32[128,64]{1,0}, f32[128,64]{1,0}) call(%t), to_apply=%body
+}
+"""
+
+_SERIALIZED = _OVERLAPPED.replace(
+    """%cp-start = (f32[128,64]{1,0}, f32[128,64]{1,0}, u32[], /*index=3*/u32[]) collective-permute-start(%gte.0), source_target_pairs={{0,1},{1,0}}
+  %dot.0 = f32[128,64]{1,0} dot(%gte.1, %gte.1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %fusion.0 = f32[128,64]{1,0} fusion(%dot.0), kind=kLoop, calls=%fc
+  %cp-done = f32[128,64]{1,0} collective-permute-done(%cp-start)""",
+    """%cp-start = (f32[128,64]{1,0}, f32[128,64]{1,0}, u32[], /*index=3*/u32[]) collective-permute-start(%gte.0), source_target_pairs={{0,1},{1,0}}
+  %cp-done = f32[128,64]{1,0} collective-permute-done(%cp-start)
+  %dot.0 = f32[128,64]{1,0} dot(%gte.1, %gte.1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %fusion.0 = f32[128,64]{1,0} fusion(%dot.0), kind=kLoop, calls=%fc""",
+)
+
+
+class TestAsyncOverlapSpans:
+    def test_overlapped_schedule_counts_compute(self):
+        spans = hlo.async_overlap_spans(_OVERLAPPED)
+        assert spans == [("%cp-start", 2)]
+
+    def test_serialized_schedule_counts_zero(self):
+        spans = hlo.async_overlap_spans(_SERIALIZED)
+        assert spans == [("%cp-start", 0)]
+        assert not any(n > 0 for _, n in spans), (
+            "a start immediately awaited hides nothing"
+        )
+
+    def test_sync_modules_have_no_spans(self):
+        # CPU modules (sync collective-permute) -> "not applicable"
+        assert hlo.async_overlap_spans(_OVERLAPPED.replace("-start", "")
+                                       .replace("-done", "")) == []
+
+    def test_prefix_names_pair_correctly(self):
+        """'%cp-start.1' must not close on the done of '%cp-start.12' —
+        pairing is by whole operand name, not substring."""
+        mod = """\
+HloModule m
+
+ENTRY %main (a: f32[8,128]) -> f32[8,128] {
+  %a = f32[8,128]{1,0} parameter(0)
+  %cp-start.1 = (f32[8,128]{1,0}, f32[8,128]{1,0}) collective-permute-start(%a), source_target_pairs={{0,1}}
+  %cp-start.12 = (f32[8,128]{1,0}, f32[8,128]{1,0}) collective-permute-start(%a), source_target_pairs={{1,0}}
+  %cp-done.12 = f32[8,128]{1,0} collective-permute-done(%cp-start.12)
+  %dot.1 = f32[8,128]{1,0} dot(%a, %a), lhs_contracting_dims={1}, rhs_contracting_dims={1}
+  %cp-done.1 = f32[8,128]{1,0} collective-permute-done(%cp-start.1)
+  ROOT %add.1 = f32[8,128]{1,0} add(%cp-done.1, %dot.1)
+}
+"""
+        spans = dict(hlo.async_overlap_spans(mod))
+        assert spans == {"%cp-start.1": 1, "%cp-start.12": 0}
+
+
+class TestRematBufferAssignment:
+    def test_remat_shrinks_temp_at_longctx_shapes(self, mesh):
+        """depth=4, L=4096: the compiled buffer assignment itself must
+        shrink under remat — the claim is about the executable, not a
+        runtime sample, so an XLA regression that silently keeps the
+        full activation stash fails CI with no TPU (VERDICT r3 next #2b).
+        AOT: lower on ShapeDtypeStructs, nothing is executed."""
+        from tpu_patterns.models import (
+            ModelConfig,
+            init_params,
+            make_train_step,
+            shard_params,
+        )
+
+        mesh3d = Mesh(
+            np.asarray(mesh.devices).reshape(2, 2, 2), ("dp", "sp", "tp")
+        )
+        L = 4096
+        temps = {}
+        for remat in (False, True):
+            cfg = ModelConfig(
+                embed=128, heads=4, head_dim=32, depth=4, remat=remat
+            )
+            step, _ = make_train_step(mesh3d, cfg, lr=1e-3)
+            p = shard_params(
+                init_params(jax.random.key(0), cfg), mesh3d, cfg
+            )
+            x = jax.device_put(
+                jnp.zeros((2, L, cfg.embed), jnp.float32),
+                NamedSharding(mesh3d, P("dp", "sp", None)),
+            )
+            temps[remat] = hlo.temp_bytes(step, p, x)
+        if temps[False] is None or temps[True] is None:
+            pytest.skip("backend exposes no memory analysis")
+        # the stash is O(depth * L * E); remat must reclaim most of it,
+        # not merely win a rounding error
+        assert temps[True] < 0.8 * temps[False], temps
+
+
+class TestHloCheckPattern:
+    def test_cells_emit_expected_verdicts(self, tmp_path):
+        """The CLI-facing pattern: ring cells pass on the CPU mesh, the
+        TPU-oracle cells are SKIPPED (never silently passed)."""
+        from tpu_patterns.core.results import ResultWriter, Verdict
+        from tpu_patterns.hlocheck import HloCheckConfig, run_hlocheck
+
+        writer = ResultWriter(jsonl_path=tmp_path / "hlo.jsonl")
+        records = run_hlocheck(
+            None,
+            HloCheckConfig(
+                rows=8, contract=128, cols=128, seq=512, depth=2, embed=64
+            ),
+            writer,
+        )
+        verdicts = {r.mode: r.verdict for r in records}
+        assert verdicts["ring_ag"] is Verdict.SUCCESS
+        assert verdicts["ring_rs"] is Verdict.SUCCESS
+        assert verdicts["remat_temp"] is Verdict.SUCCESS
+        assert verdicts["async_overlap"] is Verdict.SKIPPED
+        assert verdicts["vmem_boundary"] is Verdict.SKIPPED
+        assert writer.exit_code == 0

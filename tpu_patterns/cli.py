@@ -258,6 +258,13 @@ def _cmd_overlap(args, writer: ResultWriter) -> None:
     run_overlap(mesh, _cfg_from_args(OverlapConfig, args), writer)
 
 
+def _cmd_hlocheck(args, writer: ResultWriter) -> None:
+    from tpu_patterns.hlocheck import HloCheckConfig, run_hlocheck
+
+    mesh = _build_mesh(args.devices, args.placement, args.mechanism)
+    run_hlocheck(mesh, _cfg_from_args(HloCheckConfig, args), writer)
+
+
 def _cmd_longctx(args, writer: ResultWriter) -> None:
     import jax
 
@@ -581,13 +588,31 @@ def _cmd_sweep(args, writer: ResultWriter) -> int:
 
 
 def _cmd_report(args, writer: ResultWriter) -> None:
-    from tpu_patterns.core.results import parse_log, tabulate_records
+    from tpu_patterns.core.results import (
+        parse_log,
+        stale_grad_records,
+        tabulate_records,
+    )
 
     lines: list[str] = []
     for path in args.paths:
         with open(path) as f:
             lines.extend(f.readlines())
-    print(tabulate_records(parse_log(lines)))
+    records = parse_log(lines)
+    stale = stale_grad_records(records)
+    if stale:
+        # grad rates captured before the FLOP-accounting fix credit
+        # kernels that were dead-code-eliminated from the timed program;
+        # they may only appear in a table once explicitly marked
+        # superseded in the archive (VERDICT r3 next #8)
+        for r in stale:
+            print(
+                f"# REFUSED: {r.mode} | {r.commands} predates the grad "
+                "accounting fix and is not marked superseded",
+                file=sys.stderr,
+            )
+        raise SystemExit(2)
+    print(tabulate_records(records))
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -684,6 +709,17 @@ def build_parser() -> argparse.ArgumentParser:
 
     add_config_args(ov, OverlapConfig)
     _add_mesh_args(ov)
+
+    hc = sub.add_parser(
+        "hlocheck",
+        help="compiled-program assertions: ring interleave, async "
+        "overlap schedule, remat buffer shrink, VMEM-estimator boundary "
+        "— perf evidence that needs no live run",
+    )
+    from tpu_patterns.hlocheck import HloCheckConfig
+
+    add_config_args(hc, HloCheckConfig)
+    _add_mesh_args(hc)
 
     a = sub.add_parser("allreduce", help="ring-allreduce miniapp")
     from tpu_patterns.miniapps.apps.allreduce import AllreduceConfig
@@ -856,6 +892,7 @@ def main(argv: list[str] | None = None) -> int:
         "concurrency": _cmd_concurrency,
         "allreduce": _cmd_allreduce,
         "overlap": _cmd_overlap,
+        "hlocheck": _cmd_hlocheck,
         "longctx": _cmd_longctx,
         "flagship": _cmd_flagship,
         "train": _cmd_train,

@@ -1,0 +1,189 @@
+"""Compiled-program structural assertions: perf evidence without a chip.
+
+The reference's L5 turns a *measurement* into a verdict ("the runtime
+must demonstrably overlap", /root/reference/concurency/main.cpp:314-318).
+Measurement needs silicon; the *schedule* does not — XLA's optimized HLO
+is available on any backend, and the properties our perf claims rest on
+are visible in it:
+
+* the decomposed collective matmul (`parallel/overlap.py`) only hides
+  its transfers if transfer and matmul share one loop body — if XLA ever
+  re-serializes the ring into collect-then-compute, the claim is dead
+  long before a benchmark would notice;
+* on TPU the scheduled module makes overlap explicit as
+  ``collective-permute-start`` / ``-done`` pairs with compute scheduled
+  between them;
+* remat's whole point is a smaller buffer assignment — the compiled
+  module's temp-allocation size, not a runtime number.
+
+These helpers parse `compiled.as_text()` / `memory_analysis()` so CI can
+fail on an XLA regression (ring serialized, remat re-materialized) with
+no TPU attached (VERDICT r3 next #2).  Text parsing is intentionally
+line-oriented and conservative: HLO's grammar here is one instruction
+per line, `%name = type opcode(...)`.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Iterable
+
+import jax
+
+
+def optimized_hlo(fn: Callable[..., Any] | Any, *args: Any) -> str:
+    """Post-optimization HLO text of ``fn`` compiled for ``args``.
+
+    ``args`` may be real arrays or ``jax.ShapeDtypeStruct``s (AOT — no
+    data materialized, which keeps flagship-shape compiles cheap enough
+    for CI).  ``fn`` may already be jitted; plain callables are wrapped.
+    """
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    return jitted.lower(*args).compile().as_text()
+
+
+def temp_bytes(fn: Callable[..., Any] | Any, *args: Any) -> int | None:
+    """Temp-buffer size of the compiled module (the activation stash the
+    remat lever targets), or None when the backend has no analysis."""
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    try:
+        ma = jitted.lower(*args).compile().memory_analysis()
+        return int(ma.temp_size_in_bytes)
+    except (AttributeError, NotImplementedError, jax.errors.JaxRuntimeError):
+        return None
+
+
+# one HLO computation: "%name (params) -> type {\n  instructions...\n}" —
+# body lines are indented, the closing brace is column 0
+_COMPUTATION_RE = re.compile(
+    r"^(?:%|ENTRY\s+%?)(?P<name>[\w.\-]+)[^\n{]*\{\n(?P<body>.*?)^\}",
+    re.M | re.S,
+)
+# `%name = TYPE opcode(operands...), attrs...` — TYPE may be a tuple
+# containing commas, layouts, and `/*index=N*/` comments (which contain
+# `=`), so the opcode is located as the first lowercase token whose `(`
+# opens an operand list (starts with `%` or is empty) rather than by
+# consuming the type.  Operand-less literal ops (`constant(0)`, `iota`)
+# are intentionally not matched; the structural checks here never need
+# them.
+_INSTR_RE = re.compile(
+    r"^\s+(?:ROOT\s+)?(?P<name>%?[\w.\-]+)\s*="
+    r".*?\s(?P<op>[a-z][\w\-]*)\((?=%|\))(?P<rest>[^\n]*)"
+)
+
+
+def computations(txt: str) -> dict[str, str]:
+    """Map computation name -> body text of an HLO module dump."""
+    return {
+        m.group("name"): m.group("body")
+        for m in _COMPUTATION_RE.finditer(txt)
+    }
+
+
+def body_instructions(body: str) -> list[tuple[str, str, str]]:
+    """``(result_name, opcode, rest_of_line)`` per instruction, in
+    textual order — which in a scheduled module IS the schedule."""
+    out = []
+    for line in body.splitlines():
+        m = _INSTR_RE.match(line)
+        if m:
+            out.append((m.group("name"), m.group("op"), m.group("rest")))
+    return out
+
+
+def body_opcodes(body: str) -> list[str]:
+    """Opcodes of a computation body, in textual (= schedule) order."""
+    return [op for _, op, _ in body_instructions(body)]
+
+
+_PERM_OPS = ("collective-permute", "collective-permute-start")
+
+
+def _reachable_opcodes(
+    name: str, comps: dict[str, str], memo: dict[str, set[str]]
+) -> set[str]:
+    """Opcodes of ``name``'s body plus everything it calls (fusions,
+    conditional branches, nested loops) — the per-iteration op set."""
+    if name in memo:
+        return memo[name]
+    memo[name] = set()  # cycle guard; HLO call graphs are acyclic anyway
+    body = comps.get(name, "")
+    ops = set(body_opcodes(body))
+    for other in comps:
+        if other != name and re.search(
+            r"%" + re.escape(other) + r"(?![\w.\-])", body
+        ):
+            ops |= _reachable_opcodes(other, comps, memo)
+    memo[name] = ops
+    return ops
+
+
+def ring_interleaved(txt: str) -> bool:
+    """True iff some loop body issues BOTH a collective-permute (sync or
+    async-start) and a dot per iteration — transfer and matmul share one
+    loop, the structure that lets a scheduler hide the hop.  Call edges
+    (fusions, `lax.cond` branches) are followed, since the final hop's
+    permute typically sits under a conditional.  False means the ring
+    was serialized into collect-everything-then-compute (the regression
+    this assertion exists to catch)."""
+    comps = computations(txt)
+    memo: dict[str, set[str]] = {}
+    for body in comps.values():
+        for _, op, rest in body_instructions(body):
+            if op != "while":
+                continue
+            m = re.search(r"body=%([\w.\-]+)", rest)
+            if not m:
+                continue
+            ops = _reachable_opcodes(m.group(1), comps, memo)
+            if any(p in ops for p in _PERM_OPS) and "dot" in ops:
+                return True
+    return False
+
+
+def async_overlap_spans(
+    txt: str,
+    compute_ops: tuple[str, ...] = ("fusion", "dot", "convolution"),
+) -> list[tuple[str, int]]:
+    """For each async collective-permute pair in a SCHEDULED module,
+    count compute instructions issued between start and done.
+
+    In a scheduled HLO dump the textual instruction order within a
+    computation IS the schedule, so ``n_between > 0`` means the DMA has
+    compute to hide under; all-zero means the schedule serialized every
+    hop (start immediately awaited).  Returns ``[(start_name, n), ...]``
+    across all computations; empty when the module has no async pairs
+    (e.g. CPU, where collective-permute stays synchronous — callers
+    should treat that as "not applicable", not success).
+    """
+    spans: list[tuple[str, int]] = []
+    for body in computations(txt).values():
+        insts = body_instructions(body)
+        for i, (name, op, _) in enumerate(insts):
+            if op != "collective-permute-start":
+                continue
+            # boundary-guarded: '%cp-start.1' must not close on the done
+            # of '%cp-start.12'
+            ref = re.compile(re.escape(name) + r"(?![\w.\-])")
+            n_compute = 0
+            for j in range(i + 1, len(insts)):
+                dname, dop, doperands = insts[j]
+                if dop == "collective-permute-done" and ref.search(
+                    doperands
+                ):
+                    spans.append((name, n_compute))
+                    break
+                if dop in compute_ops:
+                    n_compute += 1
+    return spans
+
+
+def opcode_counts(txt: str, ops: Iterable[str]) -> dict[str, int]:
+    """How many times each opcode in ``ops`` is issued module-wide."""
+    wanted = set(ops)
+    counts = {o: 0 for o in wanted}
+    for body in computations(txt).values():
+        for op in body_opcodes(body):
+            if op in wanted:
+                counts[op] += 1
+    return counts

@@ -45,6 +45,10 @@ class Record:
     env: dict[str, str] = dataclasses.field(default_factory=dict)
     timestamp: float = dataclasses.field(default_factory=time.time)
     notes: list[str] = dataclasses.field(default_factory=list)
+    # True marks a committed record whose number was invalidated by a
+    # later accounting/measurement fix: it stays in the archive as
+    # provenance but must never be tabulated as a result.
+    superseded: bool = False
 
     def to_json(self) -> str:
         d = dataclasses.asdict(self)
@@ -124,6 +128,28 @@ class ResultWriter:
         return 1 if self._failures else 0
 
 
+# Commit epoch of the flash-grad FLOP-accounting fix (the amortized
+# timing chain used to feed back only dq, so dk/dv were dead-code
+# eliminated from the timed program — every *_grad rate captured before
+# this instant credits FLOPs silicon never ran).  Grad records older
+# than this are quotable only as provenance, never as results: `report`
+# refuses to tabulate them unless they carry ``superseded: true``
+# (VERDICT r3 next #8).
+GRAD_ACCOUNTING_FIX_TS = 1785446857.0
+
+
+def stale_grad_records(records: Iterable[Record]) -> list[Record]:
+    """Grad records that predate the accounting fix and are not marked
+    superseded — the rows ``report`` must refuse."""
+    return [
+        r
+        for r in records
+        if r.mode.endswith("_grad")
+        and r.timestamp < GRAD_ACCOUNTING_FIX_TS
+        and not r.superseded
+    ]
+
+
 _VERDICT_RE = re.compile(
     r"^##\s*(?P<mode>[^|]+?)\s*\|\s*(?P<commands>[^|]+?)\s*\|\s*(?P<verdict>SUCCESS|FAILURE|WARNING|SKIPPED)\s*$"
 )
@@ -194,6 +220,10 @@ def tabulate_records(records: list[Record]) -> str:
         if rec.metrics:
             main_metric = next(iter(rec.metrics.items()))
             cell = f"{rec.verdict.value} ({main_metric[0]}={main_metric[1]:.4g})"
+        if rec.superseded:
+            # provenance, not a result: the number stays visible but can
+            # never be quoted as a current measurement
+            cell = f"SUPERSEDED [{cell}]"
         by_env.setdefault(env_key, {}).setdefault(rec.commands, {})[rec.mode] = cell
     chunks = []
     for env_key, rows in by_env.items():

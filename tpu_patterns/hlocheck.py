@@ -1,0 +1,340 @@
+"""Compiled-program assertions as a measured pattern: `hlocheck`.
+
+The reference's L5 verdict asks "does the runtime overlap?" at run time
+(/root/reference/concurency/main.cpp:314-318).  This pattern asks the
+same questions of the COMPILED program, so the perf claims have an
+evidence tier that needs no live chip (VERDICT r3 next #2):
+
+* ``ring_ag`` / ``ring_rs`` — the decomposed collective matmul keeps
+  transfer and matmul in one loop body after XLA optimization;
+* ``async_overlap`` — on TPU (>=2 chips), the scheduled module issues
+  ``collective-permute-start``/``done`` pairs with compute between them;
+* ``remat_temp`` — remat at long-context shapes shrinks the compiled
+  buffer assignment (the executable's temp allocation, not a runtime
+  sample);
+* ``vmem_boundary`` — the flash kernels' VMEM estimator agrees with
+  Mosaic's actual accept/reject at the budget boundary (TPU-only:
+  Mosaic is the oracle).
+
+Every cell emits a Record with the same SUCCESS/FAILURE discipline as
+the runtime suites; cells whose oracle is absent on this backend are
+SKIPPED, never silently passed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_patterns.core import hlo
+from tpu_patterns.core.results import Record, ResultWriter, Verdict
+
+
+@dataclasses.dataclass
+class HloCheckConfig:
+    rows: int = 16  # per-rank rows for the ring cells (compile-only)
+    contract: int = 256
+    cols: int = 128
+    seq: int = 4096  # remat / vmem cells run at long-context length
+    embed: int = 128
+    depth: int = 4
+    dtype: str = "float32"
+    # remat must reclaim most of the stash, not a rounding error
+    max_temp_ratio: float = 0.8
+
+
+def _compile_ring(mesh: Mesh, cfg: HloCheckConfig, kind: str) -> str:
+    """Optimized HLO of the decomposed ``kind`` collective matmul."""
+    from tpu_patterns.parallel.overlap import (
+        allgather_matmul,
+        matmul_reducescatter,
+    )
+
+    n = int(np.prod(mesh.devices.shape))
+    axis = mesh.axis_names[0]
+    dtype = jnp.dtype(cfg.dtype)
+    if kind == "ag":
+        fn, in_specs, out_specs = (
+            allgather_matmul,
+            (P(axis, None), P(None, axis)),
+            P(None, axis),
+        )
+        x = jax.ShapeDtypeStruct((n * cfg.rows, cfg.contract), dtype)
+        w = jax.ShapeDtypeStruct((cfg.contract, n * cfg.cols), dtype)
+    else:
+        fn, in_specs, out_specs = (
+            matmul_reducescatter,
+            (P(None, axis), P(axis, None)),
+            P(axis, None),
+        )
+        x = jax.ShapeDtypeStruct((n * cfg.rows, n * cfg.contract), dtype)
+        w = jax.ShapeDtypeStruct((n * cfg.contract, cfg.cols), dtype)
+    sm = shard_map(
+        partial(fn, axis_name=axis, axis_size=n, decomposed=True),
+        mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+    )
+    return hlo.optimized_hlo(sm, x, w)
+
+
+def _ring_cell(
+    mesh: Mesh,
+    cfg: HloCheckConfig,
+    kind: str,
+    writer: ResultWriter,
+    txt: str | None = None,
+) -> Record:
+    n = int(np.prod(mesh.devices.shape))
+    library_op = "all-gather" if kind == "ag" else "reduce-scatter"
+    if txt is None:
+        txt = _compile_ring(mesh, cfg, kind)
+    interleaved = hlo.ring_interleaved(txt)
+    counts = hlo.opcode_counts(
+        txt, ["collective-permute", "collective-permute-start", library_op]
+    )
+    decomposed_away = counts[library_op] == 0
+    spans = hlo.async_overlap_spans(txt)
+    rec = Record(
+        pattern="hlocheck",
+        mode=f"ring_{kind}",
+        commands=f"n{n} {cfg.rows}x{cfg.contract}x{cfg.cols} {cfg.dtype}",
+        metrics={
+            "interleaved": float(interleaved),
+            "library_collectives": float(counts[library_op]),
+            "permutes": float(
+                counts["collective-permute"]
+                + counts["collective-permute-start"]
+            ),
+            "async_pairs": float(len(spans)),
+        },
+        verdict=Verdict.SUCCESS
+        if (interleaved and decomposed_away)
+        else Verdict.FAILURE,
+    )
+    if not interleaved:
+        rec.notes.append(
+            "XLA serialized the ring: no loop body carries both a "
+            "collective-permute and a dot"
+        )
+    if not decomposed_away:
+        rec.notes.append(f"{library_op} survived the decomposition")
+    return writer.record(rec)
+
+
+def _async_cell(
+    mesh: Mesh, cfg: HloCheckConfig, writer: ResultWriter, txt: str
+) -> Record:
+    """Reads the SAME compiled module as the ``ring_ag`` cell (passed in
+    — the multi-second XLA compile is paid once, not twice)."""
+    n = int(np.prod(mesh.devices.shape))
+    commands = f"n{n} {cfg.rows}x{cfg.contract}x{cfg.cols}"
+    if jax.default_backend() != "tpu" or n < 2:
+        return writer.record(
+            Record(
+                pattern="hlocheck",
+                mode="async_overlap",
+                commands=commands,
+                verdict=Verdict.SKIPPED,
+                notes=[
+                    "needs a >=2-chip TPU schedule: CPU keeps "
+                    "collective-permute synchronous"
+                ],
+            )
+        )
+    spans = hlo.async_overlap_spans(txt)
+    overlapped = [s for s in spans if s[1] > 0]
+    ok = bool(spans) and bool(overlapped)
+    rec = Record(
+        pattern="hlocheck",
+        mode="async_overlap",
+        commands=commands,
+        metrics={
+            "async_pairs": float(len(spans)),
+            "overlapped_pairs": float(len(overlapped)),
+            "max_compute_between": float(
+                max((s[1] for s in spans), default=0)
+            ),
+        },
+        verdict=Verdict.SUCCESS if ok else Verdict.FAILURE,
+    )
+    if not spans:
+        rec.notes.append("TPU schedule emitted no async permute pairs")
+    elif not overlapped:
+        rec.notes.append(
+            "every permute-start is awaited before any compute issues: "
+            "the schedule hides nothing"
+        )
+    return writer.record(rec)
+
+
+def _remat_cell(
+    devices: list, cfg: HloCheckConfig, writer: ResultWriter
+) -> Record:
+    from tpu_patterns.models import (
+        ModelConfig,
+        init_params,
+        make_train_step,
+        shard_params,
+    )
+
+    n = len(devices)
+    shape = (2, 2, 2) if n >= 8 else (1, 1, 1)
+    mesh = Mesh(
+        np.array(devices[: int(np.prod(shape))]).reshape(shape),
+        ("dp", "sp", "tp"),
+    )
+    temps = {}
+    for remat in (False, True):
+        mcfg = ModelConfig(
+            embed=cfg.embed, heads=4, head_dim=cfg.embed // 4,
+            depth=cfg.depth, remat=remat,
+        )
+        step, _ = make_train_step(mesh, mcfg, lr=1e-3)
+        p = shard_params(init_params(jax.random.key(0), mcfg), mesh, mcfg)
+        x = jax.device_put(
+            jnp.zeros((2, cfg.seq, mcfg.embed), jnp.float32),
+            NamedSharding(mesh, P("dp", "sp", None)),
+        )
+        temps[remat] = hlo.temp_bytes(step, p, x)
+    if temps[False] is None or temps[True] is None:
+        return writer.record(
+            Record(
+                pattern="hlocheck",
+                mode="remat_temp",
+                commands=f"depth{cfg.depth} L{cfg.seq}",
+                verdict=Verdict.SKIPPED,
+                notes=["backend exposes no memory analysis"],
+            )
+        )
+    ratio = temps[True] / max(temps[False], 1)
+    ok = ratio < cfg.max_temp_ratio
+    rec = Record(
+        pattern="hlocheck",
+        mode="remat_temp",
+        commands=f"depth{cfg.depth} L{cfg.seq} E{cfg.embed}",
+        metrics={
+            "temp_MB": temps[False] / 1e6,
+            "temp_remat_MB": temps[True] / 1e6,
+            "ratio": ratio,
+        },
+        verdict=Verdict.SUCCESS if ok else Verdict.FAILURE,
+    )
+    if not ok:
+        rec.notes.append(
+            f"remat kept {ratio:.2f} of the temp allocation "
+            f"(budget {cfg.max_temp_ratio}): the stash is not being "
+            "rematerialized"
+        )
+    return writer.record(rec)
+
+
+def _vmem_cell(cfg: HloCheckConfig, writer: ResultWriter) -> Record:
+    from tpu_patterns.longctx.flash import vmem_boundary_probe
+
+    commands = f"L{cfg.seq} D128 bf16"
+    if jax.default_backend() != "tpu":
+        return writer.record(
+            Record(
+                pattern="hlocheck",
+                mode="vmem_boundary",
+                commands=commands,
+                verdict=Verdict.SKIPPED,
+                notes=["Mosaic is the oracle; interpret mode proves nothing"],
+            )
+        )
+    probe = vmem_boundary_probe(seq=cfg.seq)
+    # an estimator that admits blocks Mosaic rejects crashes real runs:
+    # FAILURE.  One that rejects blocks Mosaic would take leaves MXU
+    # utilization on the table: WARNING, worth a look, not a crash.
+    # rejected_fails is None when the whole sequence fits the budget
+    # (no over-budget pair exists) — that is agreement, not drift.
+    verdict = (
+        Verdict.SUCCESS
+        if probe["accepted_ok"] and probe["rejected_fails"] is not False
+        else (Verdict.WARNING if probe["accepted_ok"] else Verdict.FAILURE)
+    )
+    rec = Record(
+        pattern="hlocheck",
+        mode="vmem_boundary",
+        commands=commands,
+        metrics={
+            "accepted_ok": float(probe["accepted_ok"]),
+            "rejected_fails": float(
+                -1.0
+                if probe["rejected_fails"] is None
+                else probe["rejected_fails"]
+            ),
+            "est_accepted_MB": probe["est_accepted_MB"],
+            "est_rejected_MB": probe["est_rejected_MB"],
+            "accepted_bq": float(probe["accepted_blocks"][0]),
+            "accepted_bk": float(probe["accepted_blocks"][1]),
+        },
+        verdict=verdict,
+    )
+    if not probe["accepted_ok"]:
+        rec.notes.append(
+            f"estimator admitted {probe['accepted_blocks']} "
+            f"({probe['est_accepted_MB']:.1f} MB) but Mosaic rejected it: "
+            f"{probe['accepted_error'][:200]}"
+        )
+    if probe["rejected_fails"] is None:
+        rec.notes.append(
+            "whole sequence fits the budget: no over-budget pair to test"
+        )
+    elif probe["accepted_ok"] and not probe["rejected_fails"]:
+        if probe["rejected_error"]:
+            rec.notes.append(
+                f"rejected pair {probe['rejected_blocks']} failed for a "
+                f"non-resource reason (inconclusive): "
+                f"{probe['rejected_error'][:200]}"
+            )
+        else:
+            rec.notes.append(
+                f"estimator refused {probe['rejected_blocks']} "
+                f"({probe['est_rejected_MB']:.1f} MB) but Mosaic accepts "
+                "it — budget may be too conservative"
+            )
+    return writer.record(rec)
+
+
+def run_hlocheck(
+    mesh: Mesh | None,
+    cfg: HloCheckConfig | None = None,
+    writer: ResultWriter | None = None,
+) -> list[Record]:
+    """All compiled-program assertion cells available on this backend."""
+    from tpu_patterns.runtime import setup_jax
+
+    setup_jax()
+    cfg = cfg or HloCheckConfig()
+    writer = writer or ResultWriter()
+    devices = list(mesh.devices.flat) if mesh is not None else jax.devices()
+    records = []
+    if len(devices) >= 2:
+        ring_mesh = Mesh(np.array(devices), ("x",))
+        # ring_ag and async_overlap read the same compiled module
+        ag_txt = _compile_ring(ring_mesh, cfg, "ag")
+        records.append(_ring_cell(ring_mesh, cfg, "ag", writer, txt=ag_txt))
+        records.append(_ring_cell(ring_mesh, cfg, "rs", writer))
+        records.append(_async_cell(ring_mesh, cfg, writer, ag_txt))
+    else:
+        for kind in ("ring_ag", "ring_rs", "async_overlap"):
+            records.append(
+                writer.record(
+                    Record(
+                        pattern="hlocheck",
+                        mode=kind,
+                        commands="n1",
+                        verdict=Verdict.SKIPPED,
+                        notes=["needs >=2 devices for a ring"],
+                    )
+                )
+            )
+    records.append(_remat_cell(devices, cfg, writer))
+    records.append(_vmem_cell(cfg, writer))
+    return records

@@ -36,20 +36,29 @@ NEG_INF = -1e30
 VMEM_BUDGET = 14 * 1024 * 1024
 
 
+def _vmem_estimate(bq: int, bk: int, d: int, in_bytes: int,
+                   score_tiles: int) -> int:
+    """Predicted VMEM working set of one kernel instance at (bq, bk).
+    ``score_tiles`` counts the live f32 [bq, bk] temporaries of the
+    kernel body (2 for the forward's s/p, 4 for the backward's
+    s/p/dp/ds).  The hardware ladder checks this model against Mosaic's
+    actual accept/reject at the budget boundary
+    (:func:`vmem_boundary_probe`)."""
+    score = score_tiles * bq * bk * 4
+    # in/out blocks (q-sized + 2 k-sized inputs, q-sized out) double-
+    # buffered by the pipeline, + f32 accumulator scratch + stats.
+    io = 2 * ((bq + 2 * bk) * d * in_bytes + bq * d * 4)
+    scratch = (bq + bk) * d * 4 + 2 * bq * LANES * 4
+    return score + io + scratch
+
+
 def _auto_block(lq: int, lk: int, d: int, in_bytes: int, score_tiles: int,
                 block_q: int, block_k: int) -> tuple[int, int]:
     """Largest (block_q, block_k) pair <= the requested sizes whose VMEM
-    working set fits the budget.  ``score_tiles`` counts the live f32
-    [bq, bk] temporaries of the kernel body (2 for the forward's s/p, 4
-    for the backward's s/p/dp/ds)."""
+    working set (:func:`_vmem_estimate`) fits the budget."""
 
     def est(bq: int, bk: int) -> int:
-        score = score_tiles * bq * bk * 4
-        # in/out blocks (q-sized + 2 k-sized inputs, q-sized out) double-
-        # buffered by the pipeline, + f32 accumulator scratch + stats.
-        io = 2 * ((bq + 2 * bk) * d * in_bytes + bq * d * 4)
-        scratch = (bq + bk) * d * 4 + 2 * bq * LANES * 4
-        return score + io + scratch
+        return _vmem_estimate(bq, bk, d, in_bytes, score_tiles)
 
     bq, bk = min(block_q, lq), min(block_k, lk)
     while est(bq, bk) > VMEM_BUDGET and max(bq, bk) > 128:
@@ -492,18 +501,25 @@ def flash_block(
     block_k: int = 1024,
     interpret: bool = False,
     pos_stride: jax.Array | int = 1,
+    clamp: bool = True,
 ):
     """Fused ``attention.block_attention``: returns the (o, m, l) partial
     triple (o unnormalized f32 [Lq, H, D]; m, l f32 [H, Lq]) for
     ``attention.combine_blocks``.  ``q_off``/``k_off`` are the global
     sequence positions of these shards (traced values inside the ring);
     ``pos_stride`` is the position step between consecutive shard tokens
-    (sp for the striped layout).
+    (sp for the striped layout).  ``clamp=False`` honors
+    ``block_q``/``block_k`` exactly, skipping the ``_auto_block`` VMEM
+    clamp — only the boundary probe uses it, to test the estimator
+    against Mosaic's actual verdict.
     """
     lq, h, d = q.shape
     lk = k.shape[0]
     scale = float(scale) if scale is not None else d**-0.5
-    bq, bk = _auto_block(lq, lk, d, q.dtype.itemsize, 2, block_q, block_k)
+    if clamp:
+        bq, bk = _auto_block(lq, lk, d, q.dtype.itemsize, 2, block_q, block_k)
+    else:
+        bq, bk = min(block_q, lq), min(block_k, lk)
     if lq % bq or lk % bk:
         raise ValueError(
             f"block sizes ({bq}, {bk}) must divide the shard lengths ({lq}, {lk})"
@@ -605,3 +621,85 @@ def flash_attention(
         compiler_params=_DIM_SEMANTICS,
     )(qt, kt, vt)
     return out.swapaxes(0, 1)
+
+
+def vmem_boundary_probe(
+    seq: int = 4096, heads: int = 1, head_dim: int = 128,
+    dtype=jnp.bfloat16,
+) -> dict:
+    """Does :func:`_vmem_estimate` agree with Mosaic at the budget
+    boundary?  TPU-only (Mosaic lowering is the oracle; interpret mode
+    proves nothing).
+
+    Compiles the forward kernel twice with the clamp disabled:
+
+    * ``accepted``: the largest (bq, bk) the estimator admits under
+      ``VMEM_BUDGET`` — Mosaic MUST compile it (an estimator that
+      admits blocks the hardware rejects crashes real runs: FAILURE);
+    * ``rejected``: the first power-of-two escalation the estimator
+      refuses — Mosaic SHOULD reject it (if it compiles, the estimator
+      is leaving block size — i.e. MXU utilization — on the table:
+      drift worth flagging, not a crash).
+
+    Returns ``{accepted_ok, rejected_fails, accepted_blocks,
+    rejected_blocks, est_accepted_MB, est_rejected_MB, accepted_error,
+    rejected_error}``.  When the whole sequence fits the budget there is
+    no over-budget pair to test: ``rejected_blocks`` is None and
+    ``rejected_fails`` is None ("not applicable" — callers must not read
+    it as drift).
+    """
+    in_bytes = jnp.dtype(dtype).itemsize
+    bq, bk = _auto_block(seq, seq, head_dim, in_bytes, 2, seq, seq)
+    est = functools.partial(
+        _vmem_estimate, d=head_dim, in_bytes=in_bytes, score_tiles=2
+    )
+    # escalate the accepted pair until the estimator refuses it; blocks
+    # cannot exceed the shard length, so a small seq may never produce a
+    # refusable pair
+    rq, rk = bq, bk
+    while est(rq, rk) <= VMEM_BUDGET and max(rq, rk) < seq:
+        if rq <= rk:
+            rq *= 2
+        else:
+            rk *= 2
+    has_rejected = est(rq, rk) > VMEM_BUDGET
+
+    def compiles(bq_, bk_) -> tuple[bool, str]:
+        q = jax.ShapeDtypeStruct((seq, heads, head_dim), dtype)
+        off = jax.ShapeDtypeStruct((), jnp.int32)
+        fn = functools.partial(
+            flash_block, causal=False, block_q=bq_, block_k=bk_,
+            clamp=False,
+        )
+        try:
+            jax.jit(fn).lower(q, q, q, off, off).compile()
+            return True, ""
+        except Exception as e:  # noqa: BLE001 — error text is inspected
+            return False, f"{type(e).__name__}: {e}"
+
+    def is_resource_error(msg: str) -> bool:
+        low = msg.lower()
+        return any(
+            tok in low
+            for tok in ("vmem", "resource_exhausted", "exceeds", "memory")
+        )
+
+    accepted_ok, accepted_error = compiles(bq, bk)
+    rejected_fails: bool | None = None
+    rejected_error = ""
+    if has_rejected:
+        ok, rejected_error = compiles(rq, rk)
+        # only a genuine resource rejection counts as agreement — an
+        # unrelated compile error must not let the probe vouch for the
+        # estimator with zero evidence
+        rejected_fails = (not ok) and is_resource_error(rejected_error)
+    return {
+        "accepted_blocks": (bq, bk),
+        "rejected_blocks": (rq, rk) if has_rejected else None,
+        "est_accepted_MB": est(bq, bk) / 1e6,
+        "est_rejected_MB": est(rq, rk) / 1e6 if has_rejected else 0.0,
+        "accepted_ok": accepted_ok,
+        "accepted_error": accepted_error,
+        "rejected_fails": rejected_fails,
+        "rejected_error": rejected_error,
+    }
