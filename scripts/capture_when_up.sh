@@ -1,69 +1,87 @@
 #!/bin/bash
-# Poll the TPU tunnel; when it answers, capture the round-3 measurement
+# Poll the TPU tunnel; when it answers, capture the ROUND-4 measurement
 # ladder.  Each stage is resumable / deadline-bounded, so a mid-capture
 # hang costs one cell, not the session.  Run from the repo root:
 #   nohup bash scripts/capture_when_up.sh > /tmp/capture.log 2>&1 &
+#
+# r4 ladder (VERDICT r3 next #1/#3/#5/#6/#7):
+#   bench(pre) -> tune -> promote -> measured(25) -> gates(30: 10x grad
+#   runs per config for the gate refit) -> runtime(+inertness guard) ->
+#   hlocheck (vmem boundary + remat on silicon) -> profiled flagship +
+#   longctx GRAD runs -> profilecheck (real-op-name fixture + the
+#   tflops_hw-vs-compute-time crosscheck) -> bench(post).
+# Completion (ADVICE r3): bench(post) numeric AND every resumable
+# suite's cells completed — not just the final bench.
 set -u
 cd "$(dirname "$0")/.."
-OUT=docs/measured/r3live
+OUT=docs/measured/r4live
 mkdir -p "$OUT"
 while true; do
   # -k: a tunnel hang sits in native code holding the GIL and shrugs off
   # SIGTERM; escalate to SIGKILL so the watcher itself can never wedge
   if timeout -k 10 90 python -c "import jax; jax.block_until_ready(jax.numpy.ones((256,256))@jax.numpy.ones((256,256))); print('up', jax.devices())" >/dev/null 2>&1; then
-    echo "[$(date +%H:%M:%S)] tunnel up — capturing r3 ladder"
-    # every stage escalates to SIGKILL (-k): a tunnel hang in native code
-    # ignores the TERM that plain `timeout` stops at, and GNU timeout then
-    # waits forever — the watcher itself must never wedge
+    echo "[$(date +%H:%M:%S)] tunnel up — capturing r4 ladder"
     # 1. baseline bench (pre-tune number, salvage ladder inside)
     TPU_PATTERNS_BENCH_TIMEOUT=700 timeout -k 30 900 \
       python bench.py > "$OUT/bench_pre_$(date +%Y%m%d_%H%M%S).json" 2>> "$OUT/bench.log"
     echo "[$(date +%H:%M:%S)] bench(pre) done: $(ls -t "$OUT"/bench_pre_*.json 2>/dev/null | head -1 | xargs tail -1 2>/dev/null | tail -c 300)"
-    # 2. DMA-knob search (VERDICT r2 next #2)
+    # 2. DMA-knob search + promote winners into OneSidedConfig defaults
     timeout -k 30 2400 python -m tpu_patterns sweep tune --out "$OUT/tune" --resume --cell-timeout 420 >> "$OUT/tune.log" 2>&1
     echo "[$(date +%H:%M:%S)] tune done rc=$?"
-    # 3. promote winners into OneSidedConfig defaults (comm/tuned.json)
     timeout -k 30 120 python -m tpu_patterns sweep promote --out "$OUT/tune" >> "$OUT/tune.log" 2>&1
     echo "[$(date +%H:%M:%S)] promote done rc=$?"
-    # 4. the full 25-cell measured matrix, incl. decode MHA/GQA/int8 + LM
-    #    and the flagship remat/depth/GQA/rope feature cells
-    #    (VERDICT r2 next #1: zero skipped-for-hardware cells)
+    # 3. the full 25-cell measured matrix (zero skipped-for-hardware)
     timeout -k 30 7200 python -m tpu_patterns sweep measured --out "$OUT/measured" --resume --cell-timeout 600 >> "$OUT/measured.log" 2>&1
     echo "[$(date +%H:%M:%S)] measured done rc=$?"
-    # 4b. genuine runtime-knob sweep (C12 full: latency-hiding scheduler,
-    #     async-collective fusion, scoped VMEM, matmul precision, cache)
+    # 4. grad-gate re-derivation: 10 consecutive clean runs per config,
+    #    refit written to gates_fit.json (VERDICT r3 next #3)
+    timeout -k 30 3600 python -m tpu_patterns sweep gates --out "$OUT/gates" --resume --cell-timeout 420 >> "$OUT/gates.log" 2>&1
+    echo "[$(date +%H:%M:%S)] gates done rc=$? fit=$(tail -c 200 "$OUT/gates/gates_fit.json" 2>/dev/null)"
+    # 5. runtime-knob sweep; the built-in bite guard flags an all-inert
+    #    sweep (silently-ignored flag strings, VERDICT r3 next #7)
     timeout -k 30 5400 python -m tpu_patterns sweep runtime --out "$OUT/runtime" --resume --cell-timeout 420 >> "$OUT/runtime.log" 2>&1
     echo "[$(date +%H:%M:%S)] runtime done rc=$?"
-    # 4c. profiled flagship + longctx: the parsed trace becomes a
-    #     profile_breakdown Record (compute/collective/DMA/idle) in the
-    #     same JSONL — the diagnosis for the MFU gap (VERDICT r2 #6)
+    # 6. compiled-program assertions ON SILICON: Mosaic vmem boundary,
+    #    remat buffer shrink (ring cells need >1 chip and self-skip)
+    timeout -k 30 900 python -m tpu_patterns --jsonl "$OUT/hlocheck.jsonl" hlocheck >> "$OUT/hlocheck.log" 2>&1
+    echo "[$(date +%H:%M:%S)] hlocheck done rc=$?"
+    # 7. profiled runs: flagship step + longctx GRAD (grad so the stream
+    #    carries tflops_hw for the crosscheck), then profilecheck each —
+    #    real-op-name fixture + unclassified-time gate + the
+    #    tflops_hw-vs-compute-time coherence check (next #3/#5/#6)
     timeout -k 30 900 python -m tpu_patterns --enable_profiling \
       --profile_dir "$OUT/profile/flagship" --jsonl "$OUT/flagship_profiled.jsonl" \
       flagship --attn pallas --seq 4096 --batch 2 --reps 3 >> "$OUT/profile.log" 2>&1
     echo "[$(date +%H:%M:%S)] flagship profile done rc=$?"
     timeout -k 30 900 python -m tpu_patterns --enable_profiling \
-      --profile_dir "$OUT/profile/longctx" --jsonl "$OUT/longctx_profiled.jsonl" \
-      longctx --devices 1 --strategy flash --dtype bfloat16 --seq 4096 --reps 3 >> "$OUT/profile.log" 2>&1
-    echo "[$(date +%H:%M:%S)] longctx profile done rc=$?"
-    # 5. post-tune bench: the number the driver should reproduce
+      --profile_dir "$OUT/profile/longctx_grad" --jsonl "$OUT/longctx_grad_profiled.jsonl" \
+      longctx --devices 1 --strategy flash --grad true --dtype bfloat16 --seq 4096 --reps 3 >> "$OUT/profile.log" 2>&1
+    echo "[$(date +%H:%M:%S)] longctx grad profile done rc=$?"
+    timeout -k 30 300 python -m tpu_patterns --jsonl "$OUT/profilecheck.jsonl" \
+      profilecheck "$OUT/profile/flagship" \
+      --snapshot-out "$OUT/op_names_flagship.json" >> "$OUT/profile.log" 2>&1
+    echo "[$(date +%H:%M:%S)] profilecheck(flagship) rc=$?"
+    timeout -k 30 300 python -m tpu_patterns --jsonl "$OUT/profilecheck.jsonl" \
+      profilecheck "$OUT/profile/longctx_grad" \
+      --snapshot-out "$OUT/op_names_longctx.json" \
+      --rates-jsonl "$OUT/longctx_grad_profiled.jsonl" >> "$OUT/profile.log" 2>&1
+    echo "[$(date +%H:%M:%S)] profilecheck(longctx grad) rc=$?"
+    # 8. post-tune bench: the number the driver should reproduce
     TPU_PATTERNS_BENCH_TIMEOUT=700 timeout -k 30 900 \
       python bench.py > "$OUT/bench_post_$(date +%Y%m%d_%H%M%S).json" 2>> "$OUT/bench.log"
     echo "[$(date +%H:%M:%S)] bench(post) done: $(ls -t "$OUT"/bench_post_*.json 2>/dev/null | head -1 | xargs tail -1 2>/dev/null | tail -c 300)"
-    # done only if the post-tune bench produced a numeric value; otherwise
-    # the tunnel died mid-capture — keep polling and resume
+    # done iff bench(post) is numeric AND every resumable suite finished
+    # every cell (ADVICE r3: a bench-only test declared victory while
+    # measured/runtime cells were still dead)
     if python - "$OUT" <<'EOF'
 import glob, json, os, sys
-# newest by mtime, not name: HHMMSS-sorted names lie across midnight and
-# across watcher restarts reusing the same $OUT
-files = sorted(
-    glob.glob(sys.argv[1] + "/bench_post_*.json"), key=os.path.getmtime
-)
+
+out = sys.argv[1]
 ok = False
+files = sorted(glob.glob(out + "/bench_post_*.json"), key=os.path.getmtime)
 for f in files[-1:]:
     try:
         rec = json.loads(open(f).read().strip().splitlines()[-1])
-        # a real full measurement, not bench.py's error line or a salvaged
-        # quick-pass (those carry an "error" field alongside the value)
         ok = (
             isinstance(rec.get("value"), (int, float))
             and rec.get("metric") != "bench_error"
@@ -71,10 +89,21 @@ for f in files[-1:]:
         )
     except Exception:
         pass
+if ok:
+    from tpu_patterns import sweep
+    for suite, sub in (("tune", "tune"), ("measured", "measured"),
+                       ("gates", "gates"), ("runtime", "runtime")):
+        if not sweep.suite_complete(os.path.join(out, sub), suite):
+            print(f"# suite incomplete: {suite}", flush=True)
+            ok = False
+    for fixture in ("op_names_flagship.json", "op_names_longctx.json"):
+        if not os.path.exists(os.path.join(out, fixture)):
+            print(f"# missing fixture: {fixture}", flush=True)
+            ok = False
 sys.exit(0 if ok else 1)
 EOF
     then
-      echo "[$(date +%H:%M:%S)] r3 capture complete"
+      echo "[$(date +%H:%M:%S)] r4 capture complete"
       break
     fi
     echo "[$(date +%H:%M:%S)] capture incomplete — will retry"

@@ -275,14 +275,16 @@ class TestSweep:
         assert any(s.argv[0] == "flagship" for s in rt)
         # 'all' must be exactly these suites, independently summed
         assert set(sweep.SUITES) == {
-            "p2p", "hier", "measured", "tune", "concurrency", "runtime",
-            "allreduce", "longctx", "parallel",
+            "p2p", "hier", "measured", "tune", "gates", "concurrency",
+            "runtime", "allreduce", "longctx", "parallel",
         }
         assert len(sweep.specs_for("all", quick=True)) == len(p2p) + len(
             con
         ) + len(sweep.specs_for("allreduce", quick=True)) + len(lc) + len(
             par
-        ) + len(hier) + len(meas) + len(tune) + len(rt)
+        ) + len(hier) + len(meas) + len(tune) + len(rt) + len(
+            sweep.specs_for("gates", quick=True)
+        )
 
     def test_promote_tuned_picks_best_cell_per_family(self, tmp_path):
         """`sweep promote` folds the winning chunks/block_rows of a tune
@@ -600,3 +602,167 @@ class TestSweep:
             base_env={}, resume=True,
         )
         assert calls == [name, name]  # same env -> skipped
+
+
+class TestGatesSuite:
+    def test_spec_matrix_runs_configs_repeatedly(self):
+        specs = sweep.specs_for("gates", quick=True)
+        # quick: 2 configs x 2 consecutive runs
+        assert len(specs) == 4
+        names = {s.name.rsplit(".", 1)[0] for s in specs}
+        assert names == {"gates.flash_bf16_causal", "gates.flash_f32_causal"}
+        full = sweep.specs_for("gates")
+        # full: 3 configs x 10 consecutive runs (VERDICT r3 next #3)
+        assert len(full) == 30
+
+    def test_fit_gates_refits_width_from_spread(self, tmp_path):
+        import json
+
+        from tpu_patterns.core.results import Record
+
+        def write(cfg, violations):
+            path = tmp_path / f"gates.{cfg}.r0.jsonl"
+            with open(path, "w") as f:
+                for i, v in enumerate(violations):
+                    f.write(
+                        Record(
+                            pattern="longctx",
+                            mode="flash_grad",
+                            commands=f"run {i}",
+                            metrics={"gate_violation": v},
+                        ).to_json()
+                        + "\n"
+                    )
+
+        write("clean", [0.3, 0.5, 0.6])
+        write("tight", [0.05, 0.08])
+        fit = sweep.fit_gates(str(tmp_path))
+        clean = fit["configs"]["gates.clean"]
+        # worst clean run 0.6 of the 8-eps gate -> 8*0.6*1.5 = 7.2 -> 8
+        assert clean["recommended_width_eps"] == 8
+        assert not clean["defect"]
+        tight = fit["configs"]["gates.tight"]
+        assert tight["gate_loose_10x"]
+        assert tight["recommended_width_eps"] == 2  # floor
+        assert fit["recommended_width_eps"] == 8
+        on_disk = json.loads((tmp_path / "gates_fit.json").read_text())
+        assert on_disk["current_width_eps"] == 8
+
+    def test_fit_gates_flags_defect(self, tmp_path):
+        from tpu_patterns.core.results import Record
+
+        with open(tmp_path / "gates.bad.r0.jsonl", "w") as f:
+            f.write(
+                Record(
+                    pattern="longctx",
+                    mode="flash_grad",
+                    commands="x",
+                    metrics={"gate_violation": 1.4},
+                ).to_json()
+                + "\n"
+            )
+        fit = sweep.fit_gates(str(tmp_path))
+        assert fit["configs"]["gates.bad"]["defect"]
+
+    def test_fit_gates_refuses_empty(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            sweep.fit_gates(str(tmp_path))
+
+
+class TestRuntimeBite:
+    def _write(self, tmp_path, cfg, target, value, platform="tpu"):
+        from tpu_patterns.core.results import Record
+
+        path = tmp_path / f"runtime.{cfg}.{target}.jsonl"
+        with open(path, "w") as f:
+            f.write(
+                Record(
+                    pattern="x",
+                    mode=target,
+                    commands="c",
+                    metrics={"tflops": value},
+                    env={"JAX_PLATFORMS": platform},
+                ).to_json()
+                + "\n"
+            )
+
+    def test_biting_knob_is_success(self, tmp_path):
+        from tpu_patterns.core.results import Verdict
+
+        self._write(tmp_path, "default", "flagship", 100.0)
+        self._write(tmp_path, "no_latency_hiding", "flagship", 80.0)
+        rec = sweep.check_runtime_bite(str(tmp_path), platform="tpu")
+        assert rec.verdict is Verdict.SUCCESS
+        assert rec.metrics["biting_targets"] == 1.0
+        assert rec.metrics["max_rel_move"] == pytest.approx(0.2)
+
+    def test_inert_knobs_flagged_on_tpu(self, tmp_path):
+        from tpu_patterns.core.results import Verdict
+
+        self._write(tmp_path, "default", "flagship", 100.0)
+        self._write(tmp_path, "no_latency_hiding", "flagship", 100.5)
+        rec = sweep.check_runtime_bite(str(tmp_path), platform="tpu")
+        assert rec.verdict is Verdict.WARNING
+        assert "silently ignored" in rec.notes[0]
+
+    def test_cpu_records_are_skipped_not_flagged(self, tmp_path):
+        from tpu_patterns.core.results import Verdict
+
+        self._write(tmp_path, "default", "flagship", 100.0, platform="cpu")
+        self._write(
+            tmp_path, "no_latency_hiding", "flagship", 100.0, platform="cpu"
+        )
+        # platform defaults to this process's live backend (cpu here):
+        # record env vars are NOT trusted — on real hardware
+        # JAX_PLATFORMS is typically unset
+        rec = sweep.check_runtime_bite(str(tmp_path))
+        assert rec.verdict is Verdict.SKIPPED
+
+
+class TestSuiteComplete:
+    def test_requires_completion_and_matching_sig(self, tmp_path):
+        """The capture watcher's gate: every cell completed UNDER THE
+        CURRENT signature — state seeded by a quick/different-env run
+        must not satisfy a full hardware capture (ADVICE r3)."""
+        import json
+
+        from tpu_patterns.sweep import _spec_sig
+
+        specs = sweep.specs_for("tune")
+        assert not sweep.suite_complete(str(tmp_path), "tune")
+        state = tmp_path / "sweep-state.jsonl"
+        with open(state, "w") as f:
+            for s in specs:
+                f.write(
+                    json.dumps(
+                        {"cell": s.name, "rc": 0,
+                         "sig": _spec_sig(s, None), "completed": True}
+                    )
+                    + "\n"
+                )
+        assert sweep.suite_complete(str(tmp_path), "tune")
+        # a later incomplete row for one cell flips it (latest wins)
+        with open(state, "a") as f:
+            f.write(
+                json.dumps(
+                    {"cell": specs[0].name, "rc": 1,
+                     "sig": _spec_sig(specs[0], None), "completed": False}
+                )
+                + "\n"
+            )
+        assert not sweep.suite_complete(str(tmp_path), "tune")
+
+    def test_foreign_sig_does_not_satisfy(self, tmp_path):
+        import json
+
+        specs = sweep.specs_for("tune")
+        with open(tmp_path / "sweep-state.jsonl", "w") as f:
+            for s in specs:
+                f.write(
+                    json.dumps(
+                        {"cell": s.name, "rc": 0, "sig": "other-env",
+                         "completed": True}
+                    )
+                    + "\n"
+                )
+        assert not sweep.suite_complete(str(tmp_path), "tune")

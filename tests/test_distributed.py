@@ -314,3 +314,21 @@ def test_two_process_checkpoint_roundtrip(tmp_path):
     # both processes' shard files exist in the committed step
     names = sorted(os.listdir(ckpt_dir / "step_7"))
     assert "proc0.npz" in names and "proc1.npz" in names
+
+
+def test_four_process_dryrun():
+    """The dryrun's multi-process mode at 4 OS processes x 2 devices:
+    the flagship pipelined step, elastic checkpoint, decode
+    teacher-forcing gate, and LM train+rollout with the sp axis crossing
+    THREE process boundaries over gloo (VERDICT r3 next #4: the
+    reference's every-test-is-mpirun discipline applied to the driver's
+    own correctness artifact).  The spawner raises with full worker logs
+    on any failure."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", ROOT / "__graft_entry__.py"
+    )
+    graft = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(graft)
+    graft.dryrun_multiprocess(n_processes=4, n_local=2, timeout=480.0)

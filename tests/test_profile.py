@@ -234,3 +234,113 @@ class TestLiveTrace:
             ln.events for p in planes for ln in p.lines
         )
         assert prof.breakdown(str(tmp_path)) is None
+
+
+class TestOpNameSnapshot:
+    def test_names_counts_and_categories(self, tmp_path):
+        run = tmp_path / "plugins" / "profile" / "run1"
+        os.makedirs(run)
+        (run / "host.xplane.pb").write_bytes(
+            _space([_tpu_plane(), _host_plane()])
+        )
+        names = prof.op_name_snapshot(str(tmp_path))
+        assert names is not None
+        assert names["fusion.42"]["category"] == "compute"
+        assert names["fusion.42"]["count"] == 1
+        assert names["all-reduce.3"]["category"] == "collective"
+        assert names["custom-thing"]["category"] == "other"
+        # Steps-line re-aggregation and the host plane must not appear
+        assert "python" not in names
+
+    def test_no_trace_is_none(self, tmp_path):
+        assert prof.op_name_snapshot(str(tmp_path)) is None
+
+
+class TestCrosscheckRate:
+    BD = {"compute_ms": 4.0, "busy_ms": 8.0, "wall_ms": 10.0,
+          "idle_ms": 2.0}
+
+    def test_coherent_rate(self):
+        # 60 TFLOP/s over wall with 40% compute-of-wall -> implied 150,
+        # under a 197 peak: the accountings cohere
+        cc = prof.crosscheck_rate(60.0, self.BD, 197.0)
+        assert cc["implied_mxu_tflops"] == pytest.approx(150.0)
+        assert cc["coherent"] == 1.0
+
+    def test_incoherent_rate_flagged(self):
+        # 120 TFLOP/s over wall with 40% compute -> implied 300 > 1.1*197:
+        # the FLOP multiplier or the classifier is wrong
+        cc = prof.crosscheck_rate(120.0, self.BD, 197.0)
+        assert cc["implied_mxu_tflops"] == pytest.approx(300.0)
+        assert cc["coherent"] == 0.0
+
+    def test_multi_chip_bound_scales(self):
+        cc = prof.crosscheck_rate(120.0, self.BD, 197.0, n_chips=2)
+        assert cc["coherent"] == 1.0
+
+    def test_no_peak_no_verdict(self):
+        cc = prof.crosscheck_rate(120.0, self.BD, None)
+        assert "coherent" not in cc
+
+
+class TestProfileCheckCLI:
+    def test_snapshot_gate_and_crosscheck(self, tmp_path, capsys):
+        import json
+
+        from tpu_patterns.cli import main
+        from tpu_patterns.core.results import Record
+
+        run = tmp_path / "plugins" / "profile" / "run1"
+        os.makedirs(run)
+        (run / "host.xplane.pb").write_bytes(
+            _space([_tpu_plane(), _host_plane()])
+        )
+        rates = tmp_path / "rates.jsonl"
+        rates.write_text(
+            Record(
+                pattern="longctx",
+                mode="flash_grad",
+                commands="x",
+                metrics={"tflops_hw": 60.0},
+            ).to_json()
+            + "\n"
+        )
+        snap = tmp_path / "ops.json"
+        jl = tmp_path / "out.jsonl"
+        rc = main(
+            ["--jsonl", str(jl), "profilecheck", str(tmp_path),
+             "--snapshot-out", str(snap), "--rates-jsonl", str(rates)]
+        )
+        assert rc == 0
+        fixture = json.loads(snap.read_text())
+        assert fixture["fusion.42"]["category"] == "compute"
+        with open(jl) as f:
+            recs = [json.loads(ln) for ln in f]
+        by_mode = {r["mode"]: r for r in recs}
+        assert by_mode["profile_ops"]["metrics"]["unique_names"] == 5.0
+        # other = 0.5 of 8ms busy -> 6.25%, under the 20% gate
+        assert by_mode["profile_ops"]["verdict"] == "SUCCESS"
+        # off-TPU there is no peak: crosscheck reports, verdict SUCCESS
+        # (coherent is absent, not failed)
+        assert by_mode["profile_crosscheck"]["verdict"] == "SUCCESS"
+        assert by_mode["profile_crosscheck"]["metrics"][
+            "compute_frac_of_wall"
+        ] == pytest.approx(0.4)
+
+    def test_empty_dir_is_skipped(self, tmp_path):
+        from tpu_patterns.cli import main
+
+        rc = main(["profilecheck", str(tmp_path)])
+        assert rc == 0
+
+
+class TestCrosscheckZeroCompute:
+    def test_positive_rate_with_zero_compute_is_incoherent(self):
+        bd = {"compute_ms": 0.0, "busy_ms": 8.0, "wall_ms": 10.0}
+        cc = prof.crosscheck_rate(60.0, bd, None)
+        assert cc["coherent"] == 0.0  # even with no peak known
+
+    def test_zero_rate_zero_compute_is_fine(self):
+        bd = {"compute_ms": 0.0, "busy_ms": 8.0, "wall_ms": 10.0}
+        cc = prof.crosscheck_rate(0.0, bd, 197.0)
+        assert "coherent" not in cc

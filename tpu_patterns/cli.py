@@ -581,10 +581,134 @@ def _cmd_sweep(args, writer: ResultWriter) -> int:
         tuned = sweep.promote_tuned(args.out)
         print(f"# promoted {tuned}")
         return 0
-    return sweep.run_sweep(
+    rc = sweep.run_sweep(
         args.suite, out_dir=args.out, quick=args.quick, resume=args.resume,
         cell_timeout=args.cell_timeout,
     )
+    if args.suite == "gates":
+        # refit the grad-gate width from the clean-run spread
+        fit = sweep.fit_gates(args.out)
+        print(f"# gates fit: {fit}")
+        if any(c["defect"] for c in fit["configs"].values()):
+            rc = 1  # clean code over the gate = kernel defect, not noise
+    elif args.suite == "runtime":
+        # flag a sweep whose knobs all measured inert (silently-ignored
+        # flag strings must not pass as C12 coverage)
+        writer.record(sweep.check_runtime_bite(args.out))
+    return rc
+
+
+def _cmd_profilecheck(args, writer: ResultWriter) -> int:
+    """Validate a captured trace: snapshot its REAL op names (the
+    classifier fixture, VERDICT r3 next #6), gate on the share of busy
+    time booked as ``other``, and — when ``--rates-jsonl`` names a
+    Record stream with a ``tflops_hw`` rate — cross-check that rate
+    against the breakdown's measured compute time (VERDICT r3 next #3:
+    the two accountings must cohere or one is wrong)."""
+    import json
+
+    from tpu_patterns.core import profile as profile_mod
+    from tpu_patterns.core.results import Record, Verdict, parse_log
+    from tpu_patterns.runtime import chip_peak_tflops
+
+    names = profile_mod.op_name_snapshot(args.profile_dir)
+    if names is None:
+        writer.record(
+            Record(
+                pattern="profilecheck",
+                mode="profile_ops",
+                commands=args.profile_dir,
+                verdict=Verdict.SKIPPED,
+                notes=["no device plane under the trace dir"],
+            )
+        )
+        return writer.exit_code
+    if args.snapshot_out:
+        with open(args.snapshot_out, "w") as f:
+            json.dump(names, f, indent=1, sort_keys=True)
+        writer.progress(f"op-name fixture written to {args.snapshot_out}")
+    total_ps = sum(d["duration_ps"] for d in names.values()) or 1
+    other_ps = sum(
+        d["duration_ps"]
+        for d in names.values()
+        if d["category"] == "other"
+    )
+    frac_other = other_ps / total_ps
+    rec = Record(
+        pattern="profilecheck",
+        mode="profile_ops",
+        commands=args.profile_dir,
+        metrics={
+            "unique_names": float(len(names)),
+            "frac_other_time": round(frac_other, 4),
+        },
+        # an unclassified hot op silently skews every breakdown fraction
+        verdict=Verdict.SUCCESS if frac_other <= 0.2 else Verdict.WARNING,
+    )
+    if frac_other > 0.2:
+        worst = sorted(
+            (n for n, d in names.items() if d["category"] == "other"),
+            key=lambda n: -names[n]["duration_ps"],
+        )[:5]
+        rec.notes.append(
+            f"{frac_other:.0%} of busy time unclassified; top: {worst}"
+        )
+    writer.record(rec)
+
+    if args.rates_jsonl:
+        bd = profile_mod.breakdown(args.profile_dir)
+        with open(args.rates_jsonl) as f:
+            rate_recs = [
+                r
+                for r in parse_log(f.readlines())
+                if "tflops_hw" in r.metrics
+            ]
+        if bd is None or not rate_recs:
+            writer.record(
+                Record(
+                    pattern="profilecheck",
+                    mode="profile_crosscheck",
+                    commands=args.rates_jsonl,
+                    verdict=Verdict.SKIPPED,
+                    notes=["no breakdown or no tflops_hw record to check"],
+                )
+            )
+        else:
+            r = rate_recs[-1]  # newest rate in the stream
+            # dtype-aware ceiling: gating an f32 capture against the
+            # bf16 peak would pass a 2x FLOP overcount (ADVICE r3)
+            cc = profile_mod.crosscheck_rate(
+                r.metrics["tflops_hw"],
+                bd,
+                chip_peak_tflops(r.config.get("dtype")),
+                n_chips=int(bd.get("n_device_planes", 1)),
+            )
+            coherent = cc.get("coherent")
+            rec = Record(
+                pattern="profilecheck",
+                mode="profile_crosscheck",
+                commands=f"{r.mode} | {r.commands}",
+                metrics={k: round(v, 4) for k, v in cc.items()},
+                verdict=Verdict.SUCCESS
+                if coherent != 0.0
+                else Verdict.FAILURE,
+            )
+            if coherent == 0.0:
+                if "implied_mxu_tflops" in cc:
+                    rec.notes.append(
+                        f"implied on-compute rate "
+                        f"{cc['implied_mxu_tflops']:.1f} TFLOP/s exceeds "
+                        f"{cc['peak_bound_tflops']:.1f} — FLOP multiplier "
+                        "or classifier accounting is wrong"
+                    )
+                else:
+                    rec.notes.append(
+                        "positive tflops_hw with ZERO classified compute "
+                        "time — the classifier books every hot op outside "
+                        "'compute'"
+                    )
+            writer.record(rec)
+    return writer.exit_code
 
 
 def _cmd_report(args, writer: ResultWriter) -> None:
@@ -877,6 +1001,24 @@ def build_parser() -> argparse.ArgumentParser:
     r = sub.add_parser("report", help="tabulate logs (≙ parse.py)")
     r.add_argument("paths", nargs="+")
 
+    pc = sub.add_parser(
+        "profilecheck",
+        help="validate a captured trace: real-op-name fixture snapshot, "
+        "unclassified-time gate, and tflops_hw-vs-compute-time crosscheck",
+    )
+    pc.add_argument("profile_dir", help="jax.profiler trace directory")
+    pc.add_argument(
+        "--snapshot-out",
+        default=None,
+        help="write the {op name -> count/duration/category} fixture here",
+    )
+    pc.add_argument(
+        "--rates-jsonl",
+        default=None,
+        help="Record stream holding a tflops_hw rate to cross-check "
+        "against the breakdown's compute time",
+    )
+
     return parser
 
 
@@ -906,6 +1048,7 @@ def main(argv: list[str] | None = None) -> int:
         "topo": _cmd_topo,
         "interop": _cmd_interop,
         "report": _cmd_report,
+        "profilecheck": _cmd_profilecheck,
     }
     if args.cmd == "sweep":
         if args.jsonl:

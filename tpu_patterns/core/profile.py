@@ -298,3 +298,80 @@ def breakdown(trace_dir: str) -> dict[str, float] | None:
     out = breakdown_planes(planes)
     out["n_device_planes"] = float(len(planes))
     return out
+
+
+def op_name_snapshot(trace_dir: str) -> dict | None:
+    """Unique device-plane op names of the newest trace, with count,
+    total duration, and the category :func:`classify` books them under.
+
+    Two consumers: the hardware ladder snapshots REAL op names into a
+    committed fixture so the classifier is tested against silicon
+    vocabulary instead of synthetic strings (VERDICT r3 next #6), and
+    ``profilecheck`` gates on the share of busy time falling into
+    ``other`` (an unclassified hot op would silently skew every
+    breakdown fraction).  None when the dir has no device plane."""
+    files = glob.glob(
+        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
+    )
+    if not files:
+        return None
+    newest = max(files, key=os.path.getmtime)
+    planes = device_planes(parse_xspace(newest))
+    names: dict[str, dict] = {}
+    for plane in planes:
+        for line in plane.lines:
+            if any(s in line.name.lower() for s in _SKIP_LINES):
+                continue
+            for ev in line.events:
+                d = names.setdefault(
+                    ev.name,
+                    {"count": 0, "duration_ps": 0,
+                     "category": classify(ev.name)},
+                )
+                d["count"] += 1
+                d["duration_ps"] += ev.duration_ps
+    return names or None
+
+
+def crosscheck_rate(
+    tflops_hw: float,
+    bd: dict[str, float],
+    peak_tflops: float | None,
+    n_chips: int = 1,
+) -> dict[str, float]:
+    """Do the wall-clock FLOP accounting and the profile's measured
+    compute time cohere?  (VERDICT r3 next #3's cross-check.)
+
+    ``tflops_hw`` is silicon FLOPs over wall time; the breakdown's
+    ``compute_frac`` bounds how much of that wall was MXU-busy.  The
+    implied on-compute rate ``tflops_hw / compute_frac`` must fit under
+    the participating chips' peak (with 10% tolerance for trace skew) —
+    above it, either the FLOP multiplier overcounts or the classifier
+    is booking compute time elsewhere; one of the two accountings is
+    wrong."""
+    busy = bd.get("busy_ms", 0.0)
+    wall = bd.get("wall_ms", 0.0)
+    # compute share of WALL, not of busy: idle wall still elapsed, and
+    # the rate under test divided by wall time
+    compute_frac_of_wall = (
+        min(1.0, bd.get("compute_ms", 0.0) / wall) if wall else 0.0
+    )
+    out = {
+        "tflops_hw": tflops_hw,
+        "compute_frac_of_wall": compute_frac_of_wall,
+        "busy_ms": busy,
+        "wall_ms": wall,
+    }
+    if compute_frac_of_wall > 0:
+        implied = tflops_hw / compute_frac_of_wall
+        out["implied_mxu_tflops"] = implied
+        if peak_tflops is not None:
+            bound = 1.1 * peak_tflops * n_chips
+            out["peak_bound_tflops"] = bound
+            out["coherent"] = float(implied <= bound)
+    elif tflops_hw > 0:
+        # a positive FLOP rate with ZERO classified compute time is the
+        # maximal incoherence this check exists for (every hot op booked
+        # outside 'compute') — incoherent regardless of peak knowledge
+        out["coherent"] = 0.0
+    return out

@@ -403,6 +403,9 @@ def run_longctx_grad(
             mode=f"{name}_grad",
             commands=f"sp{sp} L{cfg.seq} H{cfg.heads} D{cfg.head_dim} grad"
             + (" causal" if cfg.causal else ""),
+            # dtype travels with the record so downstream peak gates
+            # (profilecheck's crosscheck) use the right MXU ceiling
+            config={"dtype": cfg.dtype},
             metrics={
                 "tflops": tflops,
                 "tflops_hw": tflops_hw,
