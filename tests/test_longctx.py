@@ -168,6 +168,54 @@ def test_flash_strategy_single_device():
     assert recs[0].verdict is Verdict.SUCCESS
 
 
+@pytest.mark.parametrize("bq,bk", [(16, 32), (32, 16)])
+def test_flash_asymmetric_blocks_match_reference(bq, bk):
+    """The block-aspect lever (measured.flash_blocks cells): asymmetric
+    (block_q, block_k) tiles must be exactly as correct as the square
+    default, forward and backward."""
+    from tpu_patterns.longctx.flash import flash_attention_diff
+
+    q, k, v = _qkv(11)
+    want = att.attention_reference(q, k, v, causal=True)
+    got = flash_attention_diff(q, k, v, True, None, bq, bk, True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+    g_flash = jax.grad(
+        lambda a, b, c: jnp.sum(
+            flash_attention_diff(a, b, c, True, None, bq, bk, True).astype(
+                jnp.float32
+            )
+            ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g_ref = jax.grad(
+        lambda a, b, c: jnp.sum(
+            att.attention_reference(a, b, c, causal=True).astype(jnp.float32)
+            ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_flash_strategy_block_shape_config():
+    """LongCtxConfig.block_q/block_k thread through the pattern runner to
+    the kernel (the CLI surface the measured block cells drive)."""
+    from jax.sharding import Mesh
+
+    from tpu_patterns.core.results import Verdict
+    from tpu_patterns.longctx.pattern import LongCtxConfig, run_longctx
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("x",))
+    cfg = LongCtxConfig(
+        seq=64, heads=8, head_dim=16, reps=2, warmup=1,
+        strategies=("flash",), block_q=16, block_k=32,
+    )
+    recs = run_longctx(mesh, cfg)
+    assert recs[0].verdict is Verdict.SUCCESS
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_attention_pallas_block(mesh1d, qkv, causal):
     """The fused flash_block inside the ring (interpret mode on CPU) must
@@ -537,3 +585,112 @@ def test_pattern_runner_ring_variants(mesh1d, name):
     assert [r.mode for r in recs] == ["ring", name, "agreement"]
     for r in recs:
         assert r.verdict is Verdict.SUCCESS, (r.mode, r.notes)
+
+
+class TestCompactCausalGrid:
+    """grid_mode="compact": the scalar-prefetch pair grid must be exactly
+    as correct as the dense grid it outruns (masked tiles' k/v DMAs
+    never issue on it)."""
+
+    def test_pair_table_shape_and_flags(self):
+        from tpu_patterns.longctx.flash import _causal_pair_table
+
+        tab = _causal_pair_table(4, 4, 16, 16)
+        # 1+2+3+4 live tiles of the 16-tile rectangle
+        assert tab.shape == (4, 10)
+        iq, ik, first, last = tab
+        # every pair is causally live, rows iq-major/ik-ascending
+        assert all(k <= q for q, k in zip(iq, ik))
+        assert list(iq) == sorted(iq)
+        # one first and one last per q row
+        assert sum(first) == 4 and sum(last) == 4
+
+    def test_pair_table_mixed_blocks(self):
+        from tpu_patterns.longctx.flash import _causal_pair_table
+
+        # bq=32, bk=16, 64x64: q row 0 covers k blocks 0..1, row 1 0..3
+        tab = _causal_pair_table(2, 4, 32, 16)
+        assert tab.shape == (4, 6)
+        assert list(tab[1]) == [0, 1, 0, 1, 2, 3]
+
+    @pytest.mark.parametrize("bq,bk", [(16, 16), (32, 16), (16, 32)])
+    def test_matches_reference(self, bq, bk):
+        from tpu_patterns.longctx.flash import flash_attention
+
+        q, k, v = _qkv(13)
+        want = att.attention_reference(q, k, v, causal=True)
+        got = flash_attention(
+            q, k, v, causal=True, block_q=bq, block_k=bk,
+            interpret=True, grid_mode="compact",
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5
+        )
+
+    def test_noncausal_falls_back_to_dense(self):
+        from tpu_patterns.longctx.flash import flash_attention
+
+        q, k, v = _qkv(14)
+        want = att.attention_reference(q, k, v, causal=False)
+        got = flash_attention(
+            q, k, v, causal=False, block_q=16, block_k=16,
+            interpret=True, grid_mode="compact",
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5
+        )
+
+    def test_rejects_unknown_grid_mode(self):
+        from tpu_patterns.longctx.flash import flash_attention
+
+        q, k, v = _qkv(15)
+        with pytest.raises(ValueError, match="grid_mode"):
+            flash_attention(q, k, v, grid_mode="sparse", interpret=True)
+
+    def test_pattern_runner_compact_strategy(self):
+        from jax.sharding import Mesh
+
+        from tpu_patterns.core.results import Verdict
+        from tpu_patterns.longctx.pattern import LongCtxConfig, run_longctx
+
+        mesh = Mesh(np.array(jax.devices()[:1]), ("x",))
+        cfg = LongCtxConfig(
+            seq=64, heads=8, head_dim=16, reps=2, warmup=1,
+            strategies=("flash",), block_q=16, block_k=16,
+            causal_grid="compact",
+        )
+        recs = run_longctx(mesh, cfg)
+        assert recs[0].verdict is Verdict.SUCCESS
+
+
+def test_longctx_cli_threads_kernel_flags():
+    """The CLI must deliver --block_q/--block_k to the kernel: an
+    indivisible block size can only raise if the flag actually arrived
+    (this exact wiring was silently dropped once)."""
+    from tpu_patterns.cli import main
+
+    with pytest.raises(ValueError, match="divide"):
+        main(
+            ["longctx", "--devices", "1", "--strategy", "flash",
+             "--seq", "64", "--heads", "8", "--head_dim", "16",
+             "--reps", "2", "--warmup", "1",
+             "--block_q", "48", "--block_k", "48"]
+        )
+
+
+def test_compact_grid_rejected_on_grad_path():
+    """causal_grid='compact' is forward-only; a grad run must refuse it
+    rather than emit a compact-labeled record timing the dense grid."""
+    from jax.sharding import Mesh
+
+    from tpu_patterns.longctx.pattern import LongCtxConfig, run_longctx_grad
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("x",))
+    cfg = LongCtxConfig(
+        seq=64, heads=8, head_dim=16, reps=2, warmup=1,
+        strategies=("flash",), causal_grid="compact",
+    )
+    with pytest.raises(ValueError, match="forward-only"):
+        run_longctx_grad(mesh, cfg, __import__(
+            "tpu_patterns.core.results", fromlist=["ResultWriter"]
+        ).ResultWriter())

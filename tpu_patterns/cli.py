@@ -304,19 +304,19 @@ def _cmd_longctx(args, writer: ResultWriter) -> None:
         )
         return
     mesh = _build_mesh(args.devices, args.placement, args.mechanism)
+    # all dataclass fields come from the parsed args (add_config_args
+    # generated a flag per field) — an explicit field list here silently
+    # dropped new flags once already (the r4 block-shape lever).
+    # `strategies` is the one skip= field: it comes from --strategy.
+    import dataclasses
+
     cfg = LongCtxConfig(
-        seq=args.seq,
-        heads=args.heads,
-        head_dim=args.head_dim,
-        dtype=args.dtype,
-        causal=args.causal,
-        reps=args.reps,
-        warmup=args.warmup,
-        min_tflops=args.min_tflops,
-        tol=args.tol,
+        **{
+            f.name: getattr(args, f.name)
+            for f in dataclasses.fields(LongCtxConfig)
+            if f.name != "strategies"
+        },
         strategies=strategies,
-        seed=args.seed,
-        grad=args.grad,
     )
     run_longctx(mesh, cfg, writer)
 

@@ -32,11 +32,15 @@ from tpu_patterns.longctx.ring_attention import ring_attention
 from tpu_patterns.longctx.ulysses import ulysses_attention
 
 
-def flash_local(q, k, v, axis_name=None, axis_size=1, causal=False, scale=None):
+def flash_local(q, k, v, axis_name=None, axis_size=1, causal=False,
+                scale=None, block_q=1024, block_k=1024,
+                grid_mode="dense"):
     """The fused Mosaic kernel as a single-device "strategy": the hot-op
     contrast to the XLA lineages (sp must be 1 — it has no comm).  The
     differentiable wrapper costs nothing forward and gives the grad runner
-    the fused Pallas backward."""
+    the fused Pallas backward.  ``block_q``/``block_k`` expose the VMEM
+    tile shape — the MXU-aspect lever the measured block-shape cells
+    sweep (still clamped to the VMEM budget by ``_auto_block``)."""
     from tpu_patterns.longctx.flash import flash_attention_diff
     from tpu_patterns.runtime import use_interpret
 
@@ -44,7 +48,8 @@ def flash_local(q, k, v, axis_name=None, axis_size=1, causal=False, scale=None):
         raise ValueError("flash strategy is single-device (sp must be 1)")
     scale = float(scale) if scale is not None else None
     return flash_attention_diff(
-        q, k, v, causal, scale, 1024, 1024, use_interpret()
+        q, k, v, causal, scale, block_q, block_k, use_interpret(),
+        grid_mode,
     )
 
 
@@ -102,6 +107,38 @@ class LongCtxConfig:
     # fixed-cotangent objective), validated against the XLA reference
     # gradients; TFLOP/s counts the standard fwd 2 + bwd 5 matmul model
     grad: bool = False
+    # flash strategy's VMEM tile shape (the MXU-aspect lever): the qk^t
+    # tile is [block_q, block_k] and p@v contracts over block_k, so the
+    # aspect trades score-tile VMEM against p@v contraction depth.
+    # Still clamped to the VMEM budget by flash.py::_auto_block.
+    block_q: int = 1024
+    block_k: int = 1024
+    # flash causal grid: "dense" (rectangular, pl.when skip) or
+    # "compact" (scalar-prefetch table of live tiles — masked tiles'
+    # k/v DMAs never issue; forward-only)
+    causal_grid: str = "dense"
+
+
+
+def _resolve_strategy(name: str, cfg: "LongCtxConfig", grad: bool = False):
+    """Strategy callable with cfg's kernel knobs applied — ONE place for
+    the flash tile-lever wiring so the grad and non-grad runners cannot
+    silently diverge.  Rejects forward-only knobs on the grad path: the
+    fused backward runs the dense grid, and a compact-labeled grad
+    Record would measure something other than its name."""
+    strat = STRATEGIES[name]
+    if name == "flash":
+        if grad and cfg.causal_grid != "dense":
+            raise ValueError(
+                "causal_grid='compact' is forward-only; grad runs must "
+                "use the dense grid (the record would otherwise be "
+                "labeled compact while timing dense DMAs)"
+            )
+        strat = functools.partial(
+            strat, block_q=cfg.block_q, block_k=cfg.block_k,
+            grid_mode=cfg.causal_grid,
+        )
+    return strat
 
 
 def attention_flops(seq: int, heads: int, head_dim: int, causal: bool) -> float:
@@ -319,7 +356,7 @@ def run_longctx_grad(
     interp = use_interpret()
     records = []
     for name in cfg.strategies:
-        strat = STRATEGIES[name]
+        strat = _resolve_strategy(name, cfg, grad=True)
         vma = name not in VMA_OFF or not interp
         striped = name in STRIPED and sp > 1
         if striped:
@@ -490,7 +527,7 @@ def run_longctx(
 
     interp = use_interpret()
     for name in cfg.strategies:
-        strat = STRATEGIES[name]
+        strat = _resolve_strategy(name, cfg)
         body = functools.partial(
             strat, axis_name=axis, axis_size=sp, causal=cfg.causal
         )

@@ -87,6 +87,12 @@ class ModelConfig:
     # travels the ring / sits in the decode cache unchanged.
     rope: bool = False
     rope_theta: float = 10000.0
+    # Flash-kernel VMEM tile shape on the single-chip fused path (the
+    # MFU block-aspect lever; longctx.flash._auto_block still clamps to
+    # the VMEM budget).  The multi-chip ring keeps kernel defaults — its
+    # per-shard lengths are already block-scale.
+    block_q: int = 1024
+    block_k: int = 1024
 
     @property
     def mlp_hidden(self) -> int:
@@ -310,8 +316,8 @@ def forward_shard(
 
         attn = unfold(
             flash_attention_diff(
-                fold(q), fold(k), fold(v), cfg.causal, None, 1024, 1024,
-                False,
+                fold(q), fold(k), fold(v), cfg.causal, None,
+                cfg.block_q, cfg.block_k, False,
             )
         )
     else:
@@ -784,6 +790,9 @@ class FlagshipConfig:
     causal: bool = True
     attn: str = "pallas"  # "xla" | "pallas"
     attn_layout: str = "contiguous"
+    # single-chip fused-attention tile shape (see ModelConfig.block_q)
+    block_q: int = 1024
+    block_k: int = 1024
     moe: bool = False
     # sgd | zero-sgd | zero-adam (sharded optimizer) | zero-adam-offload
     # (sharded + moments pinned to host memory between steps)
@@ -851,6 +860,8 @@ def run_flagship(mesh: Mesh, cfg: FlagshipConfig, writer) -> list:
         depth=cfg.depth,
         kv_heads=cfg.kv_heads,
         rope=cfg.rope,
+        block_q=cfg.block_q,
+        block_k=cfg.block_k,
     )
     dp, sp = int(mesh.shape["dp"]), int(mesh.shape["sp"])
     if cfg.batch % dp or cfg.seq % sp:

@@ -558,6 +558,64 @@ def measured_specs(quick: bool = False) -> list[SweepSpec]:
             env=env,
         )
     )
+    # MFU-push block-shape cells (VERDICT r3 next #5): the flash tile
+    # aspect trades score-tile VMEM against p@v contraction depth —
+    # (512, 2048) doubles the p@v contraction at the same 13.1 MB
+    # estimate as the (1024, 1024) default (the measured.flash_* cells
+    # above), (1024, 512) is the backward's widest in-budget q tile.
+    # All shapes verified in-budget by flash._vmem_estimate, so
+    # _auto_block does not silently clamp the cells into one another.
+    for name, bq, bk, grad in (
+        ("fwd_bq512_bk2048", "512", "2048", None),
+        ("fwd_bq512_bk1024", "512", "1024", None),
+        ("grad_bq1024_bk512", "1024", "512", "true"),
+    ):
+        specs.append(
+            SweepSpec(
+                name=f"measured.flash_blocks.{name}",
+                argv=(
+                    "longctx", "--devices", "1", "--strategy", "flash",
+                    "--dtype", "bfloat16", "--causal", "true",
+                    "--block_q", bq, "--block_k", bk,
+                    *(("--grad", grad) if grad else ()),
+                    *flash,
+                ),
+                env=env,
+            )
+        )
+    # ...and the same lever at the flagship level, paired against
+    # measured.flagship.pallas as a before/after Record.  (512, 1024) is
+    # in-budget for BOTH directions — the flagship step runs fwd+bwd,
+    # and the backward's score_tiles=4 estimate would silently clamp a
+    # (512, 2048) request to (512, 1024), making the cell name a lie;
+    # the deep-contraction (512, 2048) exploration stays on the
+    # forward-only flash_blocks cells where it runs unclamped.
+    specs.append(
+        SweepSpec(
+            name="measured.flagship.pallas_bq512_bk1024",
+            argv=(
+                "flagship", "--attn", "pallas",
+                "--block_q", "512", "--block_k", "1024", *flagship,
+            ),
+            env=env,
+        )
+    )
+    # causal grid compaction: masked tiles' k/v DMAs never issue — pairs
+    # against measured.flash_bf16_L{4096,8192}_causal_true (the dense
+    # grid) to measure the fetch-traffic share of the causal gap
+    for args in (flash, flash_long):
+        seq = args[args.index("--seq") + 1]
+        specs.append(
+            SweepSpec(
+                name=f"measured.flash_compact_L{seq}",
+                argv=(
+                    "longctx", "--devices", "1", "--strategy", "flash",
+                    "--dtype", "bfloat16", "--causal", "true",
+                    "--causal_grid", "compact", *args,
+                ),
+                env=env,
+            )
+        )
     for variant, extra, sizes in (
         ("xla", (), flagship),
         ("pallas", (), flagship),
