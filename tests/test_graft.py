@@ -108,9 +108,11 @@ class TestGraftEntry:
 class TestBench:
     def test_spec_lookup(self):
         bench = _load("bench")
-        assert bench._spec(bench.HBM_SPEC, "TPU v5 lite") == 819.0
-        assert bench._spec(bench.HBM_SPEC, "TPU v5p chip") == 2765.0
-        assert bench._spec(bench.HBM_SPEC, "unknown") is None
+        hbm, ici = bench._spec_tables()
+        assert bench._spec(hbm, "TPU v5 lite") == 819.0
+        assert bench._spec(hbm, "TPU v5p chip") == 2765.0
+        assert bench._spec(hbm, "unknown") is None
+        assert bench._spec(ici, "TPU v5 lite") == 50.0
 
     @pytest.mark.parametrize("watchdog", [True, False])
     def test_bench_emits_one_json_line(self, watchdog):

@@ -79,6 +79,9 @@ class TestRunOneSided:
         assert rec.mode == "ring_put"
         assert rec.verdict is Verdict.SUCCESS, rec.notes
         assert rec.metrics["bandwidth_GBps"] > 0
+        # the HBM gate does not apply on the ICI path: no un-checked
+        # "plausible" claim may appear in the record
+        assert "hbm_plausible" not in rec.metrics
 
     def test_single_device(self, devices):
         from jax.sharding import Mesh
@@ -163,6 +166,46 @@ class TestRunOneSided:
         mesh = Mesh(np.array(devices[:1]), ("x",))
         with pytest.raises(ValueError, match="unknown onesided kernel"):
             run_onesided(mesh, OneSidedConfig(count=2048, kernel="bogus"))
+
+
+class TestHbmPlausibility:
+    """The copy rate must be carryable by HBM (every byte = 1 read + 1
+    write).  Observed live on v5e: the bench quick tier's 4.7 MB buffer
+    stayed VMEM-resident and 'copied' at 103 TB/s — SUCCESS with a
+    126x-over-spec headline, which this gate now forbids."""
+
+    def test_pure_function(self):
+        from tpu_patterns.comm.onesided import hbm_plausible
+
+        assert hbm_plausible(335.6, 819.0)  # the real v5e measurement
+        assert not hbm_plausible(103523.6, 819.0)  # the VMEM artifact
+        assert not hbm_plausible(475.0, 819.0)  # just past spec/2 * margin
+        assert hbm_plausible(12345.0, None)  # unknown chip: no gate
+
+    def _run(self, devices, spec, monkeypatch):
+        from jax.sharding import Mesh
+
+        from tpu_patterns import runtime
+
+        monkeypatch.setattr(runtime, "chip_hbm_gbps", lambda: spec)
+        mesh = Mesh(np.array(devices[:1]), ("x",))
+        (rec,) = run_onesided(
+            mesh, OneSidedConfig(count=2048, reps=2, warmup=1)
+        )
+        return rec
+
+    def test_implausible_rate_fails_verdict(self, devices, monkeypatch):
+        # a spec no real copy can stay under: every candidate is flagged,
+        # the winner is recorded, but the verdict is FAILURE
+        rec = self._run(devices, 1e-9, monkeypatch)
+        assert rec.verdict is Verdict.FAILURE
+        assert rec.metrics["hbm_plausible"] == 0.0
+        assert any("faster tier" in n for n in rec.notes)
+
+    def test_plausible_rate_passes(self, devices, monkeypatch):
+        rec = self._run(devices, 1e12, monkeypatch)
+        assert rec.verdict is Verdict.SUCCESS, rec.notes
+        assert rec.metrics["hbm_plausible"] == 1.0
 
 
 class TestLocalPutStreamedEdges:

@@ -107,6 +107,20 @@ def _copy_block_kernel(x_ref, out_ref):
     out_ref[...] = x_ref[...]
 
 
+# Copy rates only slightly above spec are calibration slack; far above it
+# the buffer never touched HBM at all (small loop-carried buffers go
+# VMEM-resident and "copy" at ~100 TB/s — observed live on v5e).
+_HBM_PLAUSIBILITY_MARGIN = 1.15
+
+
+def hbm_plausible(gbps: float, spec_gbps: float | None) -> bool:
+    """Whether a measured copy rate can have gone through HBM: every
+    copied byte is one HBM read + one write, so traffic = 2x the copy
+    rate, bounded by the chip's published HBM bandwidth (≙ the
+    tflops_hw <= chip-peak gate of longctx/pattern.py, applied to DMA)."""
+    return spec_gbps is None or 2.0 * gbps <= _HBM_PLAUSIBILITY_MARGIN * spec_gbps
+
+
 def _largest_divisor_at_most(rows: int, k: int) -> int:
     """Largest divisor of ``rows`` that is <= ``k`` (>= 1): both DMA
     schedules need their row-slices to tile the buffer exactly."""
@@ -370,6 +384,7 @@ def run_onesided(
             direct_fn=lambda: fn(x), ops_per_iter=timing.CHAIN_UNROLL,
         )
         gbps = res.gbps(shard_bytes * num_transfers)
+        plausible = None  # ICI-path rate; the HBM gate applies to local_put
     else:
         # Auto-select: measure every candidate schedule with the full
         # discipline and keep the winner — the same "measure, then pick"
@@ -378,6 +393,9 @@ def run_onesided(
         # candidate that fails (e.g. a kernel the platform's lowering
         # rejects) is recorded and skipped — one bad schedule must not
         # zero the headline; an explicitly requested kernel still raises.
+        from tpu_patterns.runtime import chip_hbm_gbps
+
+        hbm_spec = chip_hbm_gbps()
         best = None
         errors: list[BaseException] = []
         for name, (put, want_fn) in candidates.items():
@@ -398,13 +416,26 @@ def run_onesided(
                 notes.append(f"kernel {name} failed: {type(e).__name__}")
                 continue
             kgbps = kres.gbps(shard_bytes)
+            kplausible = hbm_plausible(kgbps, hbm_spec)
             extra_metrics[f"bandwidth_GBps_{name}"] = kgbps
-            writer.progress(f"onesided local_put[{name}]: {kgbps:.1f} GB/s")
-            if best is None or kgbps > best[2]:
-                best = (name, kfn, kgbps, kres, want_fn)
+            writer.progress(
+                f"onesided local_put[{name}]: {kgbps:.1f} GB/s"
+                + ("" if kplausible else " (traffic above HBM spec — not HBM)")
+            )
+            if not kplausible:
+                notes.append(
+                    f"kernel {name}: {kgbps:.0f} GB/s copy implies "
+                    f"{2 * kgbps:.0f} GB/s of HBM traffic, above the "
+                    f"{hbm_spec:.0f} GB/s spec — buffer resident in a "
+                    "faster tier"
+                )
+            # A plausible schedule always beats an implausible one: an
+            # auto-select must not crown a number HBM cannot carry.
+            if best is None or (kplausible, kgbps) > (best[0], best[3]):
+                best = (kplausible, name, kfn, kgbps, kres, want_fn)
         if best is None:
             raise errors[0]
-        name, fn, gbps, res, want_fn = best
+        plausible, name, fn, gbps, res, want_fn = best
         if len(candidates) > 1:
             notes.append(f"auto-selected kernel: {name}")
 
@@ -416,7 +447,11 @@ def run_onesided(
         data_ok = bool((out == want_fn(np.asarray(x))).all())
     bw_ok = cfg.min_bandwidth < 0 or gbps >= cfg.min_bandwidth
 
-    verdict = Verdict.SUCCESS if (data_ok and bw_ok) else Verdict.FAILURE
+    verdict = (
+        Verdict.SUCCESS
+        if (data_ok and bw_ok and plausible is not False)
+        else Verdict.FAILURE
+    )
     writer.metric(f"{mode} Bandwidth", gbps, "GB/s")
     rec = Record(
         pattern="onesided",
@@ -427,6 +462,12 @@ def run_onesided(
             "min_time_us": res.us(),
             "bytes_per_put": float(shard_bytes),
             "checksum_ok": float(data_ok),
+            # absent on the ring/ICI path, where the gate does not apply
+            **(
+                {}
+                if plausible is None
+                else {"hbm_plausible": float(plausible)}
+            ),
             **extra_metrics,
         },
         verdict=verdict,
@@ -434,4 +475,10 @@ def run_onesided(
     rec.notes.extend(notes)
     if not data_ok:
         rec.notes.append("one-sided put data mismatch")
+    if not plausible:
+        rec.notes.append(
+            "measured copy rate implies HBM traffic above the chip's spec — "
+            "the shrunken buffer never left a faster memory tier; grow "
+            "count until the working set exceeds VMEM"
+        )
     return [writer.record(rec)]

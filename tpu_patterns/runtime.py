@@ -45,6 +45,44 @@ def match_device_spec(
     return best[1] if best else None
 
 
+# Published per-chip HBM bandwidth (decimal GB/s) and per-link one-way ICI
+# bandwidth — same device_kind-substring keying as the TFLOP/s table.
+# bench.py's headline baselines and the bandwidth plausibility gate
+# (comm/onesided.py) share these.
+HBM_SPEC_GBPS = {
+    "v4": 1228.0,
+    "v5p": 2765.0,
+    "v5 lite": 819.0,
+    "v5e": 819.0,
+    "v6 lite": 1640.0,
+    "v6e": 1640.0,
+}
+ICI_SPEC_PER_LINK_GBPS = {
+    "v4": 50.0,
+    "v5p": 100.0,
+    "v5 lite": 50.0,
+    "v5e": 50.0,
+    "v6 lite": 100.0,
+    "v6e": 100.0,
+}
+
+
+def chip_hbm_gbps() -> float | None:
+    """HBM spec bandwidth of device 0, or None off-TPU / unknown kind.
+
+    A DMA *copy* rate above ~spec/2 is physically impossible through HBM
+    (every copied byte is one read + one write), so measurements above it
+    exercised a faster tier instead — observed live on v5e: a 4.7 MB
+    loop-carried buffer stays VMEM-resident and "copies" at 103 TB/s.
+    """
+    import jax
+
+    dev = jax.devices()[0]
+    if dev.platform != "tpu":
+        return None
+    return match_device_spec(HBM_SPEC_GBPS, getattr(dev, "device_kind", ""))
+
+
 def chip_peak_tflops(dtype=None) -> float | None:
     """Dense peak of device 0 for ``dtype``, or None off-TPU / unknown
     kind.  The table holds bf16 peaks; float32 issues through the MXU at
