@@ -117,6 +117,32 @@ class TestP2P:
         with pytest.raises(ValueError, match="even"):
             run_p2p(mesh, P2PConfig(count=16))
 
+    def test_per_pair_rate_recorded(self, mesh1d):
+        (rec,) = run_p2p(
+            mesh1d, P2PConfig(count=2048, reps=2, warmup=1,
+                              bidirectional=False)
+        )
+        pairs = rec.metrics["num_transfers"]
+        assert rec.metrics["bandwidth_GBps_per_pair"] == pytest.approx(
+            rec.metrics["bandwidth_GBps"] / pairs
+        )
+        # CPU mesh: no ICI spec, so no unchecked plausibility claim
+        assert "ici_plausible" not in rec.metrics
+
+    def test_ici_plausibility_gate(self, mesh1d, monkeypatch):
+        # ≙ the HBM gate of onesided: a per-pair rate no link can carry
+        # (spec forced to ~0) must fail the verdict with a diagnostic
+        from tpu_patterns import runtime
+
+        monkeypatch.setattr(runtime, "chip_ici_gbps", lambda: 1e-9)
+        (rec,) = run_p2p(
+            mesh1d, P2PConfig(count=2048, reps=2, warmup=1,
+                              bidirectional=False)
+        )
+        assert rec.verdict is Verdict.FAILURE
+        assert rec.metrics["ici_plausible"] == 0.0
+        assert any("never crossed chips" in n for n in rec.notes)
+
 
 def _shard_mapped(mesh, fn, *args):
     out = jax.shard_map(fn, mesh=mesh, in_specs=P("x"), out_specs=P("x"))(*args)

@@ -94,6 +94,8 @@ class TestRunOneSided:
         assert "bandwidth_GBps_streamed" in rec.metrics
         assert "bandwidth_GBps_multi" in rec.metrics
         assert any(n.startswith("auto-selected kernel:") for n in rec.notes)
+        # CPU mesh: no HBM spec, so no unchecked plausibility claim
+        assert "hbm_plausible" not in rec.metrics
 
     @pytest.mark.parametrize("kernel", ["streamed", "multi", "mono"])
     def test_single_device_explicit_kernel(self, devices, kernel):

@@ -150,6 +150,24 @@ def run_p2p(
         )
         num_pairs = len(perm)  # transfers in flight (bi counts both directions)
         gbps = res.gbps(shard_bytes * num_pairs)
+        # Physical plausibility (≙ the HBM gate of comm/onesided.py, on
+        # the ICI path): each pair's shard crosses one inter-chip link,
+        # so the per-pair one-way rate is bounded by the link spec.  A
+        # wrapped torus axis doubles the links between neighbors, so the
+        # bound allows 2 links (+ the shared calibration slack) — the
+        # artifact class this catches (a shard that never left the chip
+        # measuring memory bandwidth as "ICI") overshoots by ~10-100x.
+        from tpu_patterns.runtime import (
+            SPEC_PLAUSIBILITY_MARGIN,
+            chip_ici_gbps,
+        )
+
+        ici_spec = chip_ici_gbps()
+        per_pair = gbps / max(1, num_pairs)
+        ici_ok = (
+            ici_spec is None
+            or per_pair <= 2.0 * SPEC_PLAUSIBILITY_MARGIN * ici_spec
+        )
         # Verify: receiver shard d must hold source shard s for each (s, d);
         # non-receivers hold zeros (ppermute semantics).
         out_sums = np.asarray(csum_fn(fn(x)))
@@ -158,7 +176,11 @@ def run_p2p(
             expect[d] += src_sums[s]
         data_ok = bool((out_sums == expect).all())
         bw_ok = cfg.min_bandwidth < 0 or gbps >= cfg.min_bandwidth
-        verdict = Verdict.SUCCESS if (data_ok and bw_ok) else Verdict.FAILURE
+        verdict = (
+            Verdict.SUCCESS
+            if (data_ok and bw_ok and ici_ok)
+            else Verdict.FAILURE
+        )
         writer.metric(f"{name.capitalize()} Bandwidth", gbps, "GB/s")
         rec = Record(
             pattern="p2p",
@@ -166,10 +188,16 @@ def run_p2p(
             commands=f"{n_dev}dev x {shard_bytes // 1_000_000}MB",
             metrics={
                 "bandwidth_GBps": gbps,
+                "bandwidth_GBps_per_pair": per_pair,
                 "min_time_us": res.us(),
                 "bytes_per_pair": float(shard_bytes),
                 "num_transfers": float(num_pairs),
                 "checksum_ok": float(data_ok),
+                **(
+                    {}
+                    if ici_spec is None
+                    else {"ici_plausible": float(ici_ok)}
+                ),
             },
             verdict=verdict,
         )
@@ -178,6 +206,12 @@ def run_p2p(
         if not bw_ok:
             rec.notes.append(
                 f"bandwidth {gbps:.2f} GB/s below floor {cfg.min_bandwidth}"
+            )
+        if not ici_ok:
+            rec.notes.append(
+                f"per-pair rate {per_pair:.1f} GB/s exceeds what "
+                f"{2:.0f} ICI links ({ici_spec:.0f} GB/s each) can carry "
+                "— the exchange never crossed chips"
             )
         records.append(writer.record(rec))
     return records

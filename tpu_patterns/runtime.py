@@ -49,6 +49,12 @@ def match_device_spec(
 # bandwidth — same device_kind-substring keying as the TFLOP/s table.
 # bench.py's headline baselines and the bandwidth plausibility gate
 # (comm/onesided.py) share these.
+# Shared calibration slack for the physical-plausibility gates (HBM gate
+# in comm/onesided.py, ICI gate in comm/p2p.py): rates a hair over spec
+# are measurement slack; the artifact class the gates exist to catch
+# (a buffer that never left a faster tier) overshoots by 10-100x.
+SPEC_PLAUSIBILITY_MARGIN = 1.15
+
 HBM_SPEC_GBPS = {
     "v4": 1228.0,
     "v5p": 2765.0,
@@ -65,6 +71,19 @@ ICI_SPEC_PER_LINK_GBPS = {
     "v6 lite": 100.0,
     "v6e": 100.0,
 }
+
+
+def chip_ici_gbps() -> float | None:
+    """Per-link one-way ICI spec of device 0, or None off-TPU / unknown
+    kind — the bound behind comm/p2p.py's plausibility gate."""
+    import jax
+
+    dev = jax.devices()[0]
+    if dev.platform != "tpu":
+        return None
+    return match_device_spec(
+        ICI_SPEC_PER_LINK_GBPS, getattr(dev, "device_kind", "")
+    )
 
 
 def chip_hbm_gbps() -> float | None:
