@@ -16,35 +16,54 @@ set -u
 cd "$(dirname "$0")/.."
 OUT=docs/measured/r4live
 mkdir -p "$OUT"
+
+# -k: a tunnel hang sits in native code holding the GIL and shrugs off
+# SIGTERM; escalate to SIGKILL so the probes themselves can never wedge
+probe() {
+  timeout -k 10 90 python -c "import jax; jax.block_until_ready(jax.numpy.ones((256,256))@jax.numpy.ones((256,256))); print('up', jax.devices())" >/dev/null 2>&1
+}
+
+# Observed live (r4, 04:17): the tunnel died BETWEEN ladder stages and
+# every remaining cell burned its full timeout producing nothing — hours
+# of dead grinding. Re-probe between stages; on a dead tunnel fall back
+# to the poll loop (every stage is resumable, so nothing is lost).
+lost() {
+  echo "[$(date +%H:%M:%S)] tunnel lost mid-ladder — back to polling"
+}
+
 while true; do
-  # -k: a tunnel hang sits in native code holding the GIL and shrugs off
-  # SIGTERM; escalate to SIGKILL so the watcher itself can never wedge
-  if timeout -k 10 90 python -c "import jax; jax.block_until_ready(jax.numpy.ones((256,256))@jax.numpy.ones((256,256))); print('up', jax.devices())" >/dev/null 2>&1; then
+  if probe; then
     echo "[$(date +%H:%M:%S)] tunnel up — capturing r4 ladder"
     # 1. baseline bench (pre-tune number, salvage ladder inside)
     TPU_PATTERNS_BENCH_TIMEOUT=700 timeout -k 30 900 \
       python bench.py > "$OUT/bench_pre_$(date +%Y%m%d_%H%M%S).json" 2>> "$OUT/bench.log"
     echo "[$(date +%H:%M:%S)] bench(pre) done: $(ls -t "$OUT"/bench_pre_*.json 2>/dev/null | head -1 | xargs tail -1 2>/dev/null | tail -c 300)"
+    probe || { lost; continue; }
     # 2. DMA-knob search + promote winners into OneSidedConfig defaults
     timeout -k 30 2400 python -m tpu_patterns sweep tune --out "$OUT/tune" --resume --cell-timeout 420 >> "$OUT/tune.log" 2>&1
     echo "[$(date +%H:%M:%S)] tune done rc=$?"
     timeout -k 30 120 python -m tpu_patterns sweep promote --out "$OUT/tune" >> "$OUT/tune.log" 2>&1
     echo "[$(date +%H:%M:%S)] promote done rc=$?"
-    # 3. the full 25-cell measured matrix (zero skipped-for-hardware)
+    probe || { lost; continue; }
+    # 3. the full measured matrix (zero skipped-for-hardware)
     timeout -k 30 7200 python -m tpu_patterns sweep measured --out "$OUT/measured" --resume --cell-timeout 600 >> "$OUT/measured.log" 2>&1
     echo "[$(date +%H:%M:%S)] measured done rc=$?"
+    probe || { lost; continue; }
     # 4. grad-gate re-derivation: 10 consecutive clean runs per config,
     #    refit written to gates_fit.json (VERDICT r3 next #3)
     timeout -k 30 3600 python -m tpu_patterns sweep gates --out "$OUT/gates" --resume --cell-timeout 420 >> "$OUT/gates.log" 2>&1
     echo "[$(date +%H:%M:%S)] gates done rc=$? fit=$(tail -c 200 "$OUT/gates/gates_fit.json" 2>/dev/null)"
+    probe || { lost; continue; }
     # 5. runtime-knob sweep; the built-in bite guard flags an all-inert
     #    sweep (silently-ignored flag strings, VERDICT r3 next #7)
     timeout -k 30 5400 python -m tpu_patterns sweep runtime --out "$OUT/runtime" --resume --cell-timeout 420 >> "$OUT/runtime.log" 2>&1
     echo "[$(date +%H:%M:%S)] runtime done rc=$?"
+    probe || { lost; continue; }
     # 6. compiled-program assertions ON SILICON: Mosaic vmem boundary,
     #    remat buffer shrink (ring cells need >1 chip and self-skip)
     timeout -k 30 900 python -m tpu_patterns --jsonl "$OUT/hlocheck.jsonl" hlocheck >> "$OUT/hlocheck.log" 2>&1
     echo "[$(date +%H:%M:%S)] hlocheck done rc=$?"
+    probe || { lost; continue; }
     # 7. profiled runs: flagship step + longctx GRAD (grad so the stream
     #    carries tflops_hw for the crosscheck), then profilecheck each —
     #    real-op-name fixture + unclassified-time gate + the
@@ -57,6 +76,7 @@ while true; do
       --profile_dir "$OUT/profile/longctx_grad" --jsonl "$OUT/longctx_grad_profiled.jsonl" \
       longctx --devices 1 --strategy flash --grad true --dtype bfloat16 --seq 4096 --reps 3 >> "$OUT/profile.log" 2>&1
     echo "[$(date +%H:%M:%S)] longctx grad profile done rc=$?"
+    probe || { lost; continue; }
     timeout -k 30 300 python -m tpu_patterns --jsonl "$OUT/profilecheck.jsonl" \
       profilecheck "$OUT/profile/flagship" \
       --snapshot-out "$OUT/op_names_flagship.json" >> "$OUT/profile.log" 2>&1

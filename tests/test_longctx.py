@@ -678,19 +678,128 @@ def test_longctx_cli_threads_kernel_flags():
         )
 
 
-def test_compact_grid_rejected_on_grad_path():
-    """causal_grid='compact' is forward-only; a grad run must refuse it
-    rather than emit a compact-labeled record timing the dense grid."""
-    from jax.sharding import Mesh
+class TestCompactCausalGridBackward:
+    """grid_mode="compact" on the grad path: the live-tile tables reach
+    the stats-emitting forward AND the dq/dk/dv kernels, with the dense
+    nest's accumulation order — gradients must be bit-identical to the
+    dense grid's."""
 
-    from tpu_patterns.longctx.pattern import LongCtxConfig, run_longctx_grad
+    def test_kmajor_pair_table_shape_and_flags(self):
+        from tpu_patterns.longctx.flash import _causal_pair_table_kmajor
 
-    mesh = Mesh(np.array(jax.devices()[:1]), ("x",))
-    cfg = LongCtxConfig(
-        seq=64, heads=8, head_dim=16, reps=2, warmup=1,
-        strategies=("flash",), causal_grid="compact",
-    )
-    with pytest.raises(ValueError, match="forward-only"):
-        run_longctx_grad(mesh, cfg, __import__(
-            "tpu_patterns.core.results", fromlist=["ResultWriter"]
-        ).ResultWriter())
+        tab = _causal_pair_table_kmajor(4, 4, 16, 16)
+        # k row jk is live for iq >= jk: 4+3+2+1 tiles
+        assert tab.shape == (4, 10)
+        jk, iq, first, last = tab
+        assert all(q >= k for k, q in zip(jk, iq))
+        assert list(jk) == sorted(jk)  # jk-major
+        assert sum(first) == 4 and sum(last) == 4
+
+    def test_kmajor_pair_table_mixed_blocks(self):
+        from tpu_patterns.longctx.flash import _causal_pair_table_kmajor
+
+        # bq=32, bk=16, 64x64: k blocks 0..1 live for both q rows,
+        # k blocks 2..3 only for q row 1
+        tab = _causal_pair_table_kmajor(2, 4, 32, 16)
+        assert tab.shape == (4, 6)
+        assert list(tab[0]) == [0, 0, 1, 1, 2, 3]
+        assert list(tab[1]) == [0, 1, 0, 1, 1, 1]
+
+    def test_compact_grads_bit_identical_to_dense(self):
+        from tpu_patterns.longctx.flash import flash_attention_diff
+
+        q, k, v = _qkv(21)
+
+        def loss(mode):
+            def f(q, k, v):
+                out = flash_attention_diff(
+                    q, k, v, True, None, 16, 16, True, mode
+                )
+                return jnp.sum(out * jnp.cos(out))
+
+            return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+        dense = loss("dense")
+        compact = loss("compact")
+        for d, c in zip(dense, compact):
+            np.testing.assert_array_equal(np.asarray(d), np.asarray(c))
+
+    def test_compact_block_stats_match_dense(self):
+        from tpu_patterns.longctx.flash import flash_block
+
+        q, k, v = _qkv(22)
+        args = dict(causal=True, block_q=16, block_k=16, interpret=True)
+        od, md, ld = flash_block(q, k, v, 0, 0, **args)
+        oc, mc, lc = flash_block(q, k, v, 0, 0, grid_mode="compact", **args)
+        np.testing.assert_array_equal(np.asarray(od), np.asarray(oc))
+        np.testing.assert_array_equal(np.asarray(md), np.asarray(mc))
+        np.testing.assert_array_equal(np.asarray(ld), np.asarray(lc))
+
+    def test_compact_bwd_rejects_traced_offsets(self):
+        from tpu_patterns.longctx.flash import flash_block_bwd
+
+        q, k, v = _qkv(23)
+        lse = jnp.zeros((H, L), jnp.float32)
+        delta = jnp.zeros((H, L), jnp.float32)
+        with pytest.raises(ValueError, match="static zero shard offsets"):
+            flash_block_bwd(
+                q, k, v, q, lse, delta, q_off=jnp.int32(0), causal=True,
+                grid_mode="compact", interpret=True,
+            )
+
+    def test_runner_refuses_noncausal_compact(self):
+        # the kernels fall back to dense when non-causal; a compact-
+        # labeled Record must never time that fallback
+        from jax.sharding import Mesh
+
+        from tpu_patterns.core.results import ResultWriter
+        from tpu_patterns.longctx.pattern import (
+            LongCtxConfig,
+            run_longctx_grad,
+        )
+
+        mesh = Mesh(np.array(jax.devices()[:1]), ("x",))
+        cfg = LongCtxConfig(
+            seq=64, heads=8, head_dim=16, reps=2, warmup=1, causal=False,
+            strategies=("flash",), causal_grid="compact",
+        )
+        with pytest.raises(ValueError, match="requires --causal true"):
+            run_longctx_grad(mesh, cfg, ResultWriter())
+
+    def test_flagship_refuses_noncausal_compact(self):
+        from jax.sharding import Mesh
+
+        from tpu_patterns.core.results import ResultWriter
+        from tpu_patterns.models.transformer import (
+            FlagshipConfig,
+            run_flagship,
+        )
+
+        mesh = Mesh(
+            np.array(jax.devices()[:1]).reshape(1, 1, 1), ("dp", "sp", "tp")
+        )
+        cfg = FlagshipConfig(
+            embed=64, heads=4, head_dim=16, seq=128, batch=2, depth=1,
+            causal=False, attn="pallas", attn_grid="compact", reps=1,
+            warmup=0,
+        )
+        with pytest.raises(ValueError, match="requires --causal true"):
+            run_flagship(mesh, cfg, ResultWriter())
+
+    def test_pattern_grad_runner_compact(self):
+        from jax.sharding import Mesh
+
+        from tpu_patterns.core.results import ResultWriter, Verdict
+        from tpu_patterns.longctx.pattern import (
+            LongCtxConfig,
+            run_longctx_grad,
+        )
+
+        mesh = Mesh(np.array(jax.devices()[:1]), ("x",))
+        cfg = LongCtxConfig(
+            seq=64, heads=8, head_dim=16, reps=2, warmup=1,
+            strategies=("flash",), block_q=16, block_k=16,
+            causal_grid="compact",
+        )
+        recs = run_longctx_grad(mesh, cfg, ResultWriter())
+        assert recs[0].verdict is Verdict.SUCCESS, recs[0].notes

@@ -77,6 +77,31 @@ _DIM_SEMANTICS = pltpu.CompilerParams(
     dimension_semantics=("parallel", "parallel", "arbitrary")
 )
 
+# Compact pair grids are (head, pair): the pair dim revisits the VMEM
+# scratch accumulators row by row and must execute in order.
+_COMPACT_DIM_SEMANTICS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "arbitrary")
+)
+
+
+def _compact_specs(roles, bq, bk, d, qcol, kcol):
+    """BlockSpecs for a compact-grid pallas_call: each role is
+    ("q"|"k", minor) — a q-row- or k-row-indexed block of (1, rows,
+    minor) — and ``qcol``/``kcol`` say which pair-table row carries that
+    index (0/1 for the iq-major table, 1/0 for the jk-major one).  The
+    four compact call sites differ ONLY in this mapping; sharing the
+    builder keeps their index plumbing from diverging."""
+
+    def spec(role):
+        axis, minor = role
+        rows = bq if axis == "q" else bk
+        col = qcol if axis == "q" else kcol
+        return pl.BlockSpec(
+            (1, rows, minor), lambda h, p, t, col=col: (h, t[col, p], 0)
+        )
+
+    return [spec(r) for r in roles]
+
 
 def _sds(shape, dtype, vma):
     """ShapeDtypeStruct carrying the caller's varying-manual-axes when set
@@ -191,27 +216,67 @@ def _kernel(
 # ---------------------------------------------------------------------------
 
 
-def _score_tile(causal, scale, block_q, block_k, iq, ik, offs_ref,
+def _score_tile(causal, scale, block_q, block_k, iq, ik, offs,
                 q_ref, k_ref, lse_ref):
-    """Recompute the P tile [Bq, Bk] from saved row statistics."""
+    """Recompute the P tile [Bq, Bk] from saved row statistics.  ``offs``
+    is the (q_off, k_off, q_stride, k_stride) quadruple — SMEM scalars on
+    the ring path, python ints (0, 0, 1, 1) on the compact grid."""
     s = jax.lax.dot_general(
         q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * scale
     if causal:
-        q_pos = offs_ref[0] + (
+        q_pos = offs[0] + (
             iq * block_q
             + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-        ) * offs_ref[2]
-        k_pos = offs_ref[1] + (
+        ) * offs[2]
+        k_pos = offs[1] + (
             ik * block_k
             + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        ) * offs_ref[3]
+        ) * offs[3]
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
     # lse is the GLOBAL logsumexp of the row (finite: every causal row has
     # at least its own position unmasked), so exp is <= 1 and masked
     # entries collapse to exactly 0.
     return jnp.exp(s - lse_ref[0])
+
+
+def _dq_tile(causal, scale, block_q, block_k, iq, ik, offs,
+             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_scr):
+    """One (q-block, k-block) dq accumulation — shared by the dense and
+    compact grids (same math, same ik-ascending add order, so the two
+    grids produce bit-identical gradients)."""
+    p = _score_tile(causal, scale, block_q, block_k, iq, ik, offs,
+                    q_ref, k_ref, lse_ref)
+    dp = jax.lax.dot_general(
+        do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta_ref[0])  # [Bq, Bk] f32
+    dq_scr[:] = dq_scr[:] + scale * jax.lax.dot(
+        ds.astype(k_ref.dtype), k_ref[0], preferred_element_type=jnp.float32
+    )
+
+
+def _dkv_tile(causal, scale, block_q, block_k, iq, jk, offs,
+              q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+              dk_scr, dv_scr):
+    """One (q-block, k-block) dk/dv accumulation — shared like
+    :func:`_dq_tile` (iq-ascending add order on both grids)."""
+    p = _score_tile(causal, scale, block_q, block_k, iq, jk, offs,
+                    q_ref, k_ref, lse_ref)
+    pt = p.astype(do_ref.dtype).T  # [Bk, Bq]
+    dv_scr[:] = dv_scr[:] + jax.lax.dot(
+        pt, do_ref[0], preferred_element_type=jnp.float32
+    )
+    dp = jax.lax.dot_general(
+        do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta_ref[0])
+    dk_scr[:] = dk_scr[:] + scale * jax.lax.dot(
+        ds.astype(q_ref.dtype).T, q_ref[0], preferred_element_type=jnp.float32
+    )
 
 
 def _bwd_dq_kernel(causal, scale, block_q, block_k, offs_ref,
@@ -222,16 +287,8 @@ def _bwd_dq_kernel(causal, scale, block_q, block_k, offs_ref,
     pl.when(ik == 0)(lambda: dq_scr.__setitem__(slice(None), jnp.zeros_like(dq_scr)))
 
     def _body():
-        p = _score_tile(causal, scale, block_q, block_k, iq, ik, offs_ref,
-                        q_ref, k_ref, lse_ref)
-        dp = jax.lax.dot_general(
-            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta_ref[0])  # [Bq, Bk] f32
-        dq_scr[:] = dq_scr[:] + scale * jax.lax.dot(
-            ds.astype(k_ref.dtype), k_ref[0], preferred_element_type=jnp.float32
-        )
+        _dq_tile(causal, scale, block_q, block_k, iq, ik, offs_ref,
+                 q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_scr)
 
     if causal:
         pl.when(
@@ -259,20 +316,9 @@ def _bwd_dkv_kernel(causal, scale, block_q, block_k, offs_ref,
     pl.when(iq == 0)(_zero)
 
     def _body():
-        p = _score_tile(causal, scale, block_q, block_k, iq, jk, offs_ref,
-                        q_ref, k_ref, lse_ref)
-        pt = p.astype(do_ref.dtype).T  # [Bk, Bq]
-        dv_scr[:] = dv_scr[:] + jax.lax.dot(
-            pt, do_ref[0], preferred_element_type=jnp.float32
-        )
-        dp = jax.lax.dot_general(
-            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta_ref[0])
-        dk_scr[:] = dk_scr[:] + scale * jax.lax.dot(
-            ds.astype(q_ref.dtype).T, q_ref[0], preferred_element_type=jnp.float32
-        )
+        _dkv_tile(causal, scale, block_q, block_k, iq, jk, offs_ref,
+                  q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                  dk_scr, dv_scr)
 
     if causal:
         pl.when(
@@ -283,6 +329,52 @@ def _bwd_dkv_kernel(causal, scale, block_q, block_k, offs_ref,
         _body()
 
     @pl.when(iq == nq - 1)
+    def _emit():
+        dk_ref[0] = dk_scr[:]
+        dv_ref[0] = dv_scr[:]
+
+
+# Static (single-shard) offsets for the compact-grid kernels: the pair
+# tables are built at trace time, which requires global positions known
+# then — exactly the flash_attention_diff path (offsets 0, stride 1).
+_STATIC_OFFS = (0, 0, 1, 1)
+
+
+def _bwd_dq_kernel_compact(scale, block_q, block_k, tab_ref,
+                           q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                           dq_ref, dq_scr):
+    """dq over the compacted causal pair grid (iq-major table): masked
+    tiles' k/v DMAs never issue — the backward twin of _kernel_compact."""
+    p = pl.program_id(1)
+    iq, ik = tab_ref[0, p], tab_ref[1, p]
+    pl.when(tab_ref[2, p] == 1)(
+        lambda: dq_scr.__setitem__(slice(None), jnp.zeros_like(dq_scr))
+    )
+    _dq_tile(True, scale, block_q, block_k, iq, ik, _STATIC_OFFS,
+             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_scr)
+
+    @pl.when(tab_ref[3, p] == 1)
+    def _emit():
+        dq_ref[0] = dq_scr[:]
+
+
+def _bwd_dkv_kernel_compact(scale, block_q, block_k, tab_ref,
+                            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                            dk_ref, dv_ref, dk_scr, dv_scr):
+    """dk/dv over the compacted causal pair grid (jk-major table)."""
+    p = pl.program_id(1)
+    jk, iq = tab_ref[0, p], tab_ref[1, p]
+
+    def _zero():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    pl.when(tab_ref[2, p] == 1)(_zero)
+    _dkv_tile(True, scale, block_q, block_k, iq, jk, _STATIC_OFFS,
+              q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+              dk_scr, dv_scr)
+
+    @pl.when(tab_ref[3, p] == 1)
     def _emit():
         dk_ref[0] = dk_scr[:]
         dv_ref[0] = dv_scr[:]
@@ -303,6 +395,7 @@ def flash_block_bwd(
     block_k: int = 512,
     interpret: bool = False,
     pos_stride: jax.Array | int = 1,
+    grid_mode: str = "dense",
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Gradient contributions of one (q-shard, kv-shard) pair.
 
@@ -311,10 +404,34 @@ def flash_block_bwd(
     f32 (dq, dk, dv) — the caller sums contributions across kv shards (dq)
     / q shards (dk, dv) and casts.  Offsets/strides address global
     positions exactly as :func:`flash_block`.
+
+    ``grid_mode="compact"`` iterates scalar-prefetch tables of only the
+    causally live tiles (iq-major for dq, jk-major for dk/dv), so masked
+    tiles' block DMAs never issue — the backward twin of the forward's
+    compact grid, with identical accumulation order (bit-identical
+    grads).  Tables are built at trace time, so it requires ``causal``
+    with static zero offsets and unit stride (the
+    ``flash_attention_diff`` path); the ring's traced shard offsets keep
+    the dense grid.
     """
     lq, h, d = q.shape
     lk = k.shape[0]
     scale = float(scale) if scale is not None else d**-0.5
+    if grid_mode not in ("dense", "compact"):
+        raise ValueError(f"unknown grid_mode {grid_mode!r}")
+    compact = grid_mode == "compact" and causal
+    if compact and not (
+        isinstance(q_off, int) and q_off == 0
+        and isinstance(k_off, int) and k_off == 0
+        and isinstance(pos_stride, int) and pos_stride == 1
+        and lq == lk
+    ):
+        raise ValueError(
+            "grid_mode='compact' needs static zero shard offsets, unit "
+            "stride, and Lq == Lk (pair tables are built at trace time "
+            "and every k-row must own a live tile); the ring path must "
+            "use the dense grid"
+        )
     bq, bk = _auto_block(lq, lk, d, q.dtype.itemsize, 4, block_q, block_k)
     if lq % bq or lk % bk:
         raise ValueError(
@@ -323,6 +440,54 @@ def flash_block_bwd(
     qt, kt, vt, dot = (a.swapaxes(0, 1) for a in (q, k, v, do))
     lse3 = lse[..., None].astype(jnp.float32)  # [H, Lq, 1]
     delta3 = delta[..., None].astype(jnp.float32)
+    vma = getattr(jax.typeof(q), "vma", None)
+
+    # the backward's operand roles: q, k, v, do, lse, delta
+    bwd_roles = (
+        ("q", d), ("k", d), ("k", d), ("q", d), ("q", 1), ("q", 1),
+    )
+    if compact:
+        tab_q = jnp.asarray(_causal_pair_table(lq // bq, lk // bk, bq, bk))
+        dq = pl.pallas_call(
+            functools.partial(_bwd_dq_kernel_compact, scale, bq, bk),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(h, tab_q.shape[1]),
+                in_specs=_compact_specs(bwd_roles, bq, bk, d, 0, 1),
+                out_specs=_compact_specs([("q", d)], bq, bk, d, 0, 1)[0],
+                scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+            ),
+            out_shape=_sds((h, lq, d), jnp.float32, vma),
+            interpret=interpret,
+            compiler_params=_COMPACT_DIM_SEMANTICS,
+        )(tab_q, qt, kt, vt, dot, lse3, delta3)
+
+        tab_k = jnp.asarray(
+            _causal_pair_table_kmajor(lq // bq, lk // bk, bq, bk)
+        )
+        dk, dv = pl.pallas_call(
+            functools.partial(_bwd_dkv_kernel_compact, scale, bq, bk),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(h, tab_k.shape[1]),
+                in_specs=_compact_specs(bwd_roles, bq, bk, d, 1, 0),
+                out_specs=_compact_specs(
+                    [("k", d), ("k", d)], bq, bk, d, 1, 0
+                ),
+                scratch_shapes=[
+                    pltpu.VMEM((bk, d), jnp.float32),
+                    pltpu.VMEM((bk, d), jnp.float32),
+                ],
+            ),
+            out_shape=[
+                _sds((h, lk, d), jnp.float32, vma),
+                _sds((h, lk, d), jnp.float32, vma),
+            ],
+            interpret=interpret,
+            compiler_params=_COMPACT_DIM_SEMANTICS,
+        )(tab_k, qt, kt, vt, dot, lse3, delta3)
+        return dq.swapaxes(0, 1), dk.swapaxes(0, 1), dv.swapaxes(0, 1)
+
     offs = jnp.stack(
         [
             jnp.asarray(q_off),
@@ -331,7 +496,6 @@ def flash_block_bwd(
             jnp.asarray(pos_stride),
         ]
     ).astype(jnp.int32)
-    vma = getattr(jax.typeof(q), "vma", None)
 
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     qspec = pl.BlockSpec((1, bq, d), lambda h, iq, ik: (h, iq, 0))
@@ -411,10 +575,11 @@ def flash_attention_diff(
     forward kernel plus the Pallas dq/dk/dv backward (flash_block_bwd) —
     O(L) memory end to end, never materializing the [H, L, L] score
     tensor.  The forward saves (q, k, v, out, lse); the backward
-    recomputes score tiles from lse per block.  ``grid_mode`` reaches the
-    undifferentiated forward only (the grad path's stats-emitting/
-    backward kernels keep the dense grid; their own causal skip is the
-    ``pl.when`` predicate).
+    recomputes score tiles from lse per block.  ``grid_mode="compact"``
+    (causal) applies to BOTH directions: the stats-emitting forward and
+    the dq/dk/dv backward each iterate scalar-prefetch tables of only
+    the causally live tiles, so masked tiles' block DMAs never issue —
+    with dense-identical accumulation order (bit-identical results).
     """
     return flash_attention(
         q, k, v, causal=causal, scale=scale,
@@ -428,6 +593,7 @@ def _flash_diff_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
     o_un, m, l = flash_block(
         q, k, v, 0, 0, causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, interpret=interpret,
+        grid_mode=grid_mode,
     )
     out, lse = _row_stats(o_un, m, l)
     out = out.astype(q.dtype)
@@ -441,6 +607,7 @@ def _flash_diff_bwd(causal, scale, block_q, block_k, interpret, grid_mode,
         q, k, v, g, lse, _delta(g, out),
         causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, interpret=interpret,
+        grid_mode=grid_mode,
     )
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
@@ -496,6 +663,41 @@ def _block_kernel(
         l_ref[0] = l_scr[:, 0:1]
 
 
+def _block_kernel_compact(
+    scale: float,
+    block_q: int,
+    block_k: int,
+    tab_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    m_ref,
+    l_ref,
+    m_scr,
+    l_scr,
+    acc_scr,
+):
+    """Stats-emitting causal forward over the compacted pair grid — the
+    diff path's twin of :func:`_kernel_compact` (emits the (o, m, l)
+    partial triple instead of finalizing)."""
+    p = pl.program_id(1)
+    iq, ik = tab_ref[0, p], tab_ref[1, p]
+    pl.when(tab_ref[2, p] == 1)(
+        lambda: _init_scratch(m_scr, l_scr, acc_scr)
+    )
+    _online_step(
+        True, scale, block_q, block_k, 0, 0,
+        iq, ik, q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
+    )
+
+    @pl.when(tab_ref[3, p] == 1)
+    def _emit():
+        o_ref[0] = acc_scr[:]
+        m_ref[0] = m_scr[:, 0:1]
+        l_ref[0] = l_scr[:, 0:1]
+
+
 def flash_block(
     q: jax.Array,
     k: jax.Array,
@@ -509,6 +711,7 @@ def flash_block(
     interpret: bool = False,
     pos_stride: jax.Array | int = 1,
     clamp: bool = True,
+    grid_mode: str = "dense",
 ):
     """Fused ``attention.block_attention``: returns the (o, m, l) partial
     triple (o unnormalized f32 [Lq, H, D]; m, l f32 [H, Lq]) for
@@ -518,11 +721,26 @@ def flash_block(
     (sp for the striped layout).  ``clamp=False`` honors
     ``block_q``/``block_k`` exactly, skipping the ``_auto_block`` VMEM
     clamp — only the boundary probe uses it, to test the estimator
-    against Mosaic's actual verdict.
+    against Mosaic's actual verdict.  ``grid_mode="compact"`` (causal,
+    static zero offsets, unit stride — the diff path) iterates only the
+    causally live tiles, as in :func:`flash_attention`.
     """
     lq, h, d = q.shape
     lk = k.shape[0]
     scale = float(scale) if scale is not None else d**-0.5
+    if grid_mode not in ("dense", "compact"):
+        raise ValueError(f"unknown grid_mode {grid_mode!r}")
+    compact = grid_mode == "compact" and causal
+    if compact and not (
+        isinstance(q_off, int) and q_off == 0
+        and isinstance(k_off, int) and k_off == 0
+        and isinstance(pos_stride, int) and pos_stride == 1
+    ):
+        raise ValueError(
+            "grid_mode='compact' needs static zero shard offsets and "
+            "unit stride (pair tables are built at trace time); ring "
+            "shards must use the dense grid"
+        )
     if clamp:
         bq, bk = _auto_block(lq, lk, d, q.dtype.itemsize, 2, block_q, block_k)
     else:
@@ -532,6 +750,37 @@ def flash_block(
             f"block sizes ({bq}, {bk}) must divide the shard lengths ({lq}, {lk})"
         )
     qt, kt, vt = (a.swapaxes(0, 1) for a in (q, k, v))
+    vma = getattr(jax.typeof(q), "vma", None)
+
+    if compact:
+        tab = jnp.asarray(_causal_pair_table(lq // bq, lk // bk, bq, bk))
+        o, m, l = pl.pallas_call(
+            functools.partial(_block_kernel_compact, scale, bq, bk),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(h, tab.shape[1]),
+                in_specs=_compact_specs(
+                    [("q", d), ("k", d), ("k", d)], bq, bk, d, 0, 1
+                ),
+                out_specs=_compact_specs(
+                    [("q", d), ("q", 1), ("q", 1)], bq, bk, d, 0, 1
+                ),
+                scratch_shapes=[
+                    pltpu.VMEM((bq, LANES), jnp.float32),
+                    pltpu.VMEM((bq, LANES), jnp.float32),
+                    pltpu.VMEM((bq, d), jnp.float32),
+                ],
+            ),
+            out_shape=[
+                _sds((h, lq, d), jnp.float32, vma),
+                _sds((h, lq, 1), jnp.float32, vma),
+                _sds((h, lq, 1), jnp.float32, vma),
+            ],
+            interpret=interpret,
+            compiler_params=_COMPACT_DIM_SEMANTICS,
+        )(tab, qt, kt, vt)
+        return o.swapaxes(0, 1), m[..., 0], l[..., 0]
+
     offs = jnp.stack(
         [
             jnp.asarray(q_off),
@@ -540,7 +789,6 @@ def flash_block(
             jnp.asarray(pos_stride),
         ]
     ).astype(jnp.int32)
-    vma = getattr(jax.typeof(q), "vma", None)
 
     o, m, l = pl.pallas_call(
         functools.partial(_block_kernel, causal, scale, bq, bk),
@@ -591,6 +839,27 @@ def _causal_pair_table(nq: int, nk: int, bq: int, bk: int):
         for ik in range(k_hi + 1):
             rows.append(
                 (iq, ik, 1 if ik == 0 else 0, 1 if ik == k_hi else 0)
+            )
+    return np.asarray(rows, dtype=np.int32).T.copy()
+
+
+def _causal_pair_table_kmajor(nq: int, nk: int, bq: int, bk: int):
+    """jk-major twin of :func:`_causal_pair_table` for the dk/dv compact
+    grid: rows are (jk, iq, is_first_of_row, is_last_of_row) with iq
+    ascending per k-block — the same live-tile predicate and the same
+    accumulation order as the dense nest, so gradients stay
+    bit-identical."""
+    import numpy as np
+
+    rows = []
+    for jk in range(nk):
+        live = [
+            iq for iq in range(nq) if (iq + 1) * bq - 1 >= jk * bk
+        ]
+        for pos, iq in enumerate(live):
+            rows.append(
+                (jk, iq, 1 if pos == 0 else 0,
+                 1 if pos == len(live) - 1 else 0)
             )
     return np.asarray(rows, dtype=np.int32).T.copy()
 
@@ -677,14 +946,10 @@ def flash_attention(
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(h, tab.shape[1]),
-            in_specs=[
-                pl.BlockSpec((1, bq, d), lambda h, p, t: (h, t[0, p], 0)),
-                pl.BlockSpec((1, bk, d), lambda h, p, t: (h, t[1, p], 0)),
-                pl.BlockSpec((1, bk, d), lambda h, p, t: (h, t[1, p], 0)),
-            ],
-            out_specs=pl.BlockSpec(
-                (1, bq, d), lambda h, p, t: (h, t[0, p], 0)
+            in_specs=_compact_specs(
+                [("q", d), ("k", d), ("k", d)], bq, bk, d, 0, 1
             ),
+            out_specs=_compact_specs([("q", d)], bq, bk, d, 0, 1)[0],
             scratch_shapes=scratch,
         )
         out = pl.pallas_call(
@@ -693,9 +958,7 @@ def flash_attention(
             out_shape=out_sds,
             interpret=interpret,
             # pair dim revisits the scratch accumulators: sequential
-            compiler_params=pltpu.CompilerParams(
-                dimension_semantics=("parallel", "arbitrary")
-            ),
+            compiler_params=_COMPACT_DIM_SEMANTICS,
         )(tab, qt, kt, vt)
         return out.swapaxes(0, 1)
     out = pl.pallas_call(

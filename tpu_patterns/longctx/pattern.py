@@ -115,7 +115,7 @@ class LongCtxConfig:
     block_k: int = 1024
     # flash causal grid: "dense" (rectangular, pl.when skip) or
     # "compact" (scalar-prefetch table of live tiles — masked tiles'
-    # k/v DMAs never issue; forward-only)
+    # k/v DMAs never issue; applies to the fwd AND the fused bwd)
     causal_grid: str = "dense"
 
 
@@ -123,16 +123,20 @@ class LongCtxConfig:
 def _resolve_strategy(name: str, cfg: "LongCtxConfig", grad: bool = False):
     """Strategy callable with cfg's kernel knobs applied — ONE place for
     the flash tile-lever wiring so the grad and non-grad runners cannot
-    silently diverge.  Rejects forward-only knobs on the grad path: the
-    fused backward runs the dense grid, and a compact-labeled grad
-    Record would measure something other than its name."""
+    silently diverge.  ``causal_grid="compact"`` reaches both directions:
+    the stats-emitting forward and the dq/dk/dv backward all iterate the
+    live-tile tables (flash.py::flash_block_bwd)."""
     strat = STRATEGIES[name]
     if name == "flash":
-        if grad and cfg.causal_grid != "dense":
+        if cfg.causal_grid != "dense" and not cfg.causal:
+            # the kernels silently fall back to the dense grid when
+            # non-causal (there is nothing to compact) — a benchmark
+            # Record labeled compact must never time that fallback
             raise ValueError(
-                "causal_grid='compact' is forward-only; grad runs must "
-                "use the dense grid (the record would otherwise be "
-                "labeled compact while timing dense DMAs)"
+                "causal_grid='compact' requires --causal true: the "
+                "non-causal grid has no masked tiles to skip, and the "
+                "record would be labeled compact while timing the "
+                "dense grid"
             )
         strat = functools.partial(
             strat, block_q=cfg.block_q, block_k=cfg.block_k,

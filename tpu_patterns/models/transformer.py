@@ -93,6 +93,10 @@ class ModelConfig:
     # per-shard lengths are already block-scale.
     block_q: int = 1024
     block_k: int = 1024
+    # Causal-grid mode of the same path: "compact" iterates only the
+    # causally live tiles in the fwd AND fused bwd kernels (masked
+    # tiles' k/v DMAs never issue — longctx.flash pair tables).
+    attn_grid: str = "dense"
 
     @property
     def mlp_hidden(self) -> int:
@@ -317,7 +321,7 @@ def forward_shard(
         attn = unfold(
             flash_attention_diff(
                 fold(q), fold(k), fold(v), cfg.causal, None,
-                cfg.block_q, cfg.block_k, False,
+                cfg.block_q, cfg.block_k, False, cfg.attn_grid,
             )
         )
     else:
@@ -793,6 +797,8 @@ class FlagshipConfig:
     # single-chip fused-attention tile shape (see ModelConfig.block_q)
     block_q: int = 1024
     block_k: int = 1024
+    # causal-grid mode of the fused path (see ModelConfig.attn_grid)
+    attn_grid: str = "dense"
     moe: bool = False
     # sgd | zero-sgd | zero-adam (sharded optimizer) | zero-adam-offload
     # (sharded + moments pinned to host memory between steps)
@@ -862,12 +868,21 @@ def run_flagship(mesh: Mesh, cfg: FlagshipConfig, writer) -> list:
         rope=cfg.rope,
         block_q=cfg.block_q,
         block_k=cfg.block_k,
+        attn_grid=cfg.attn_grid,
     )
     dp, sp = int(mesh.shape["dp"]), int(mesh.shape["sp"])
     if cfg.batch % dp or cfg.seq % sp:
         raise ValueError(
             f"batch {cfg.batch} must be divisible by dp={dp} and "
             f"seq {cfg.seq} by sp={sp}"
+        )
+    if cfg.attn_grid != "dense" and not cfg.causal:
+        # same labeling discipline as longctx: the kernels fall back to
+        # the dense grid when non-causal, and a compact-labeled Record
+        # must never time that fallback
+        raise ValueError(
+            "attn_grid='compact' requires --causal true (non-causal has "
+            "no masked tiles to skip)"
         )
     params = init_params(jax.random.key(cfg.seed), mcfg, _n_experts(mesh, mcfg))
     dtype = jnp.dtype(cfg.dtype)

@@ -616,6 +616,31 @@ def measured_specs(quick: bool = False) -> list[SweepSpec]:
                 env=env,
             )
         )
+    # ...the same compaction through the BACKWARD (live-tile tables in
+    # the stats-emitting fwd + dq/dk/dv kernels) — pairs against
+    # measured.flash_bf16_grad, and at the flagship level against
+    # measured.flagship_pallas (the whole-train-step before/after)
+    specs.append(
+        SweepSpec(
+            name="measured.flash_compact_grad",
+            argv=(
+                "longctx", "--devices", "1", "--strategy", "flash",
+                "--dtype", "bfloat16", "--causal", "true", "--grad",
+                "true", "--causal_grid", "compact", *flash,
+            ),
+            env=env,
+        )
+    )
+    specs.append(
+        SweepSpec(
+            name="measured.flagship_pallas_compact",
+            argv=(
+                "flagship", "--attn", "pallas",
+                "--attn_grid", "compact", *flagship,
+            ),
+            env=env,
+        )
+    )
     for variant, extra, sizes in (
         ("xla", (), flagship),
         ("pallas", (), flagship),
