@@ -786,6 +786,37 @@ class TestCompactCausalGridBackward:
         with pytest.raises(ValueError, match="requires --causal true"):
             run_flagship(mesh, cfg, ResultWriter())
 
+    def test_flagship_refuses_compact_off_fused_path(self):
+        # attn='xla' and sp>1 (the ring) would silently ignore the flag
+        # — a compact-labeled Record must never time those paths
+        import dataclasses
+
+        from jax.sharding import Mesh
+
+        from tpu_patterns.core.results import ResultWriter
+        from tpu_patterns.models.transformer import (
+            FlagshipConfig,
+            run_flagship,
+        )
+
+        cfg = FlagshipConfig(
+            embed=64, heads=4, head_dim=16, seq=128, batch=2, depth=1,
+            causal=True, attn="pallas", attn_grid="compact", reps=1,
+            warmup=0,
+        )
+        mesh1 = Mesh(
+            np.array(jax.devices()[:1]).reshape(1, 1, 1), ("dp", "sp", "tp")
+        )
+        with pytest.raises(ValueError, match="fused pallas"):
+            run_flagship(
+                mesh1, dataclasses.replace(cfg, attn="xla"), ResultWriter()
+            )
+        mesh_sp2 = Mesh(
+            np.array(jax.devices()[:2]).reshape(1, 2, 1), ("dp", "sp", "tp")
+        )
+        with pytest.raises(ValueError, match="single-chip"):
+            run_flagship(mesh_sp2, cfg, ResultWriter())
+
     def test_pattern_grad_runner_compact(self):
         from jax.sharding import Mesh
 

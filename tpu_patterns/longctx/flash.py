@@ -84,7 +84,7 @@ _COMPACT_DIM_SEMANTICS = pltpu.CompilerParams(
 )
 
 
-def _compact_specs(roles, bq, bk, d, qcol, kcol):
+def _compact_specs(roles, bq, bk, qcol, kcol):
     """BlockSpecs for a compact-grid pallas_call: each role is
     ("q"|"k", minor) — a q-row- or k-row-indexed block of (1, rows,
     minor) — and ``qcol``/``kcol`` say which pair-table row carries that
@@ -453,8 +453,8 @@ def flash_block_bwd(
             grid_spec=pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=1,
                 grid=(h, tab_q.shape[1]),
-                in_specs=_compact_specs(bwd_roles, bq, bk, d, 0, 1),
-                out_specs=_compact_specs([("q", d)], bq, bk, d, 0, 1)[0],
+                in_specs=_compact_specs(bwd_roles, bq, bk, 0, 1),
+                out_specs=_compact_specs([("q", d)], bq, bk, 0, 1)[0],
                 scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
             ),
             out_shape=_sds((h, lq, d), jnp.float32, vma),
@@ -470,9 +470,9 @@ def flash_block_bwd(
             grid_spec=pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=1,
                 grid=(h, tab_k.shape[1]),
-                in_specs=_compact_specs(bwd_roles, bq, bk, d, 1, 0),
+                in_specs=_compact_specs(bwd_roles, bq, bk, 1, 0),
                 out_specs=_compact_specs(
-                    [("k", d), ("k", d)], bq, bk, d, 1, 0
+                    [("k", d), ("k", d)], bq, bk, 1, 0
                 ),
                 scratch_shapes=[
                     pltpu.VMEM((bk, d), jnp.float32),
@@ -760,10 +760,10 @@ def flash_block(
                 num_scalar_prefetch=1,
                 grid=(h, tab.shape[1]),
                 in_specs=_compact_specs(
-                    [("q", d), ("k", d), ("k", d)], bq, bk, d, 0, 1
+                    [("q", d), ("k", d), ("k", d)], bq, bk, 0, 1
                 ),
                 out_specs=_compact_specs(
-                    [("q", d), ("q", 1), ("q", 1)], bq, bk, d, 0, 1
+                    [("q", d), ("q", 1), ("q", 1)], bq, bk, 0, 1
                 ),
                 scratch_shapes=[
                     pltpu.VMEM((bq, LANES), jnp.float32),
@@ -947,9 +947,9 @@ def flash_attention(
             num_scalar_prefetch=1,
             grid=(h, tab.shape[1]),
             in_specs=_compact_specs(
-                [("q", d), ("k", d), ("k", d)], bq, bk, d, 0, 1
+                [("q", d), ("k", d), ("k", d)], bq, bk, 0, 1
             ),
-            out_specs=_compact_specs([("q", d)], bq, bk, d, 0, 1)[0],
+            out_specs=_compact_specs([("q", d)], bq, bk, 0, 1)[0],
             scratch_shapes=scratch,
         )
         out = pl.pallas_call(

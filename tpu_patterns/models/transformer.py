@@ -876,14 +876,28 @@ def run_flagship(mesh: Mesh, cfg: FlagshipConfig, writer) -> list:
             f"batch {cfg.batch} must be divisible by dp={dp} and "
             f"seq {cfg.seq} by sp={sp}"
         )
-    if cfg.attn_grid != "dense" and not cfg.causal:
-        # same labeling discipline as longctx: the kernels fall back to
-        # the dense grid when non-causal, and a compact-labeled Record
-        # must never time that fallback
-        raise ValueError(
-            "attn_grid='compact' requires --causal true (non-causal has "
-            "no masked tiles to skip)"
-        )
+    if cfg.attn_grid != "dense":
+        # Labeling discipline (≙ longctx): a compact-labeled Record must
+        # never time a path that silently ignored the flag.  The compact
+        # grid lives in the single-chip fused pallas branch only — xla
+        # attention and the sp>1 ring (which keeps the dense grid for
+        # its traced shard offsets) would no-op it.
+        if not cfg.causal:
+            raise ValueError(
+                "attn_grid='compact' requires --causal true (non-causal "
+                "has no masked tiles to skip)"
+            )
+        if cfg.attn != "pallas":
+            raise ValueError(
+                "attn_grid='compact' applies to the fused pallas "
+                "attention path only (--attn pallas)"
+            )
+        if sp > 1:
+            raise ValueError(
+                "attn_grid='compact' is the single-chip fused path; "
+                "sp>1 routes to ring attention, whose traced shard "
+                "offsets require the dense grid"
+            )
     params = init_params(jax.random.key(cfg.seed), mcfg, _n_experts(mesh, mcfg))
     dtype = jnp.dtype(cfg.dtype)
     x = jax.random.normal(

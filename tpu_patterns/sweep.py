@@ -634,8 +634,11 @@ def measured_specs(quick: bool = False) -> list[SweepSpec]:
     specs.append(
         SweepSpec(
             name="measured.flagship_pallas_compact",
+            # pinned to one device: the compact grid is the single-chip
+            # fused path, and run_flagship REFUSES it at sp>1 rather
+            # than silently timing dense-grid ring attention
             argv=(
-                "flagship", "--attn", "pallas",
+                "flagship", "--attn", "pallas", "--devices", "1",
                 "--attn_grid", "compact", *flagship,
             ),
             env=env,
@@ -714,6 +717,30 @@ def measured_specs(quick: bool = False) -> list[SweepSpec]:
             env=env,
         )
     )
+    # Bank the highest-value cells first: live tunnel windows observed in
+    # r4 are ~30 minutes, and --resume keeps whatever landed before the
+    # drop.  The flagship headline pair leads, then its MFU-lever pairs,
+    # then the flash kernel matrix; onesided/interop trail — bench(pre)
+    # re-measures the onesided number at the top of every window anyway.
+    # (The sort is stable, so in-group order — e.g. dense before its
+    # compact twin — is preserved from construction order.)
+    headline = {"measured.flagship_pallas", "measured.flagship_xla"}
+    order = (
+        ("measured.flagship", 1),  # lever/feature cells after their base
+        ("measured.flash", 2),
+        ("measured.decode", 3),
+        ("measured.lm", 3),
+        ("measured.concurrency", 4),
+    )
+
+    def _prio(s: SweepSpec) -> int:
+        if s.name in headline:
+            return 0
+        return next(
+            (p for prefix, p in order if s.name.startswith(prefix)), 5
+        )
+
+    specs.sort(key=_prio)
     return specs
 
 
