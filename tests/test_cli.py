@@ -614,8 +614,9 @@ class TestGatesSuite:
         names = {s.name.rsplit(".", 1)[0] for s in specs}
         assert names == {"gates.flash_bf16_causal", "gates.flash_f32_causal"}
         full = sweep.specs_for("gates")
-        # full: 3 configs x 10 consecutive runs (VERDICT r3 next #3)
-        assert len(full) == 30
+        # full: 4 configs (incl. the compact-grid backward) x 10
+        # consecutive runs (VERDICT r3 next #3)
+        assert len(full) == 40
 
     def test_fit_gates_refits_width_from_spread(self, tmp_path):
         import json

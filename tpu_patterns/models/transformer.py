@@ -1010,6 +1010,16 @@ def run_flagship(mesh: Mesh, cfg: FlagshipConfig, writer) -> list:
         rec.notes.append(f"loss not finite/reproducible: {loss} vs {loss2}")
     if not perf_ok:
         rec.notes.append(f"{tflops:.3f} TFLOP/s below floor {cfg.min_tflops}")
+    if cfg.attn == "pallas" and sp == 1 and _interpret():
+        # the single-chip fused path is TPU-only; off-TPU the step timed
+        # XLA reference attention — say so in the record rather than let
+        # a CPU quick twin read as a fused-kernel (or compact-grid)
+        # measurement
+        rec.notes.append(
+            "interpret fallback: fused pallas attention inactive on this "
+            "backend (timed XLA reference attention"
+            + (", attn_grid ignored)" if cfg.attn_grid != "dense" else ")")
+        )
     return [writer.record(rec)]
 
 

@@ -810,6 +810,15 @@ def gates_specs(quick: bool = False) -> list[SweepSpec]:
                  "--causal", "false"),
             )
         )
+        # the compact-grid backward (candidate default once measured):
+        # its gate spread must be characterized alongside the dense one
+        configs.append(
+            (
+                "flash_bf16_compact",
+                ("--strategy", "flash", "--dtype", "bfloat16",
+                 "--causal_grid", "compact"),
+            )
+        )
     specs = []
     for cname, flags in configs:
         for r in range(runs):
