@@ -648,8 +648,47 @@ class TestGatesSuite:
         assert tight["gate_loose_10x"]
         assert tight["recommended_width_eps"] == 2  # floor
         assert fit["recommended_width_eps"] == 8
+        # the fit persists to disk — the promote step and the committed
+        # capture both depend on gates_fit.json existing
         on_disk = json.loads((tmp_path / "gates_fit.json").read_text())
         assert on_disk["current_width_eps"] == 8
+        assert on_disk["recommended_width_eps"] == 8
+
+    def test_promote_gates_writes_fit_tier(self, tmp_path, monkeypatch):
+        import json
+
+        fit = {
+            "current_width_eps": 8,
+            "recommended_width_eps": 4,
+            "configs": {"gates.clean": {"defect": False}},
+        }
+        (tmp_path / "gates_fit.json").write_text(json.dumps(fit))
+        dest = tmp_path / "promoted.json"
+        out = sweep.promote_gates(str(tmp_path), dest=str(dest))
+        assert out["recommended_width_eps"] == 4
+        assert out["source"] == str(tmp_path)
+        # the gate reads the promoted tier lazily via the env override
+        from tpu_patterns.longctx import pattern
+
+        monkeypatch.setenv("TPU_PATTERNS_GATES_FIT", str(dest))
+        assert pattern._gate_width_eps() == 4.0
+        monkeypatch.setenv("TPU_PATTERNS_GATES_FIT", "/dev/null")
+        assert pattern._gate_width_eps() == 8.0  # fallback width
+
+    def test_promote_gates_refuses_defect(self, tmp_path):
+        import json
+
+        fit = {
+            "current_width_eps": 8,
+            "recommended_width_eps": 40,
+            "configs": {"gates.bad": {"defect": True}},
+        }
+        (tmp_path / "gates_fit.json").write_text(json.dumps(fit))
+        with pytest.raises(ValueError, match="defect"):
+            sweep.promote_gates(str(tmp_path), dest=str(tmp_path / "x"))
+        assert not (tmp_path / "x").exists()  # refusal writes nothing
+        with pytest.raises(FileNotFoundError):
+            sweep.promote_gates(str(tmp_path / "nope"))
 
     def test_fit_gates_flags_defect(self, tmp_path):
         from tpu_patterns.core.results import Record

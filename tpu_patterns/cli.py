@@ -575,11 +575,26 @@ def _cmd_interop(args, writer: ResultWriter) -> None:
 def _cmd_sweep(args, writer: ResultWriter) -> int:
     from tpu_patterns import sweep
 
+    if args.gates_dir and args.suite != "promote":
+        # the repo's own bite-guard discipline: a flag must never be
+        # silently ignored
+        raise SystemExit("--gates-dir applies to 'sweep promote' only")
     if args.suite == "promote":
         # fold a completed `sweep tune --out <dir>` into the committed
-        # OneSidedConfig defaults (comm/tuned.json)
-        tuned = sweep.promote_tuned(args.out)
-        print(f"# promoted {tuned}")
+        # OneSidedConfig defaults (comm/tuned.json), or — with
+        # --gates-dir — a clean `sweep gates` refit into the committed
+        # grad-gate width (longctx/gates_fit.json)
+        if args.gates_dir:
+            if args.out != "results":  # non-default --out would be dropped
+                raise SystemExit(
+                    "pass EITHER --out (tune promotion) OR --gates-dir "
+                    "(gate-width promotion), not both"
+                )
+            fit = sweep.promote_gates(args.gates_dir)
+            print(f"# promoted gates fit: {fit}")
+        else:
+            tuned = sweep.promote_tuned(args.out)
+            print(f"# promoted {tuned}")
         return 0
     rc = sweep.run_sweep(
         args.suite, out_dir=args.out, quick=args.quick, resume=args.resume,
@@ -982,6 +997,13 @@ def build_parser() -> argparse.ArgumentParser:
         "points at its directory) into the OneSidedConfig defaults",
     )
     s.add_argument("--out", default="results", help="log/JSONL directory")
+    s.add_argument(
+        "--gates-dir",
+        default=None,
+        help="with 'promote': fold this finished `sweep gates` run into "
+        "the committed grad-gate width (longctx/gates_fit.json) instead "
+        "of promoting tune knobs",
+    )
     s.add_argument("--quick", action="store_true", help="tiny workloads")
     s.add_argument(
         "--resume",

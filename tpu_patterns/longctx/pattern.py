@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -277,6 +278,43 @@ GRAD_HW_FLOP_MULT = {"flash": 4.5, "ring_pallas": 4.5}
 GRAD_HW_FLOP_MULT_DEFAULT = 3.0
 
 
+# Hardware-refit grad-gate width, written by ``sweep promote --gates``
+# from a clean ``sweep gates`` run (10 consecutive post-accounting-fix
+# runs per config; sweep.py::fit_gates) and committed with the capture.
+# Absent file -> the provisional 8-eps width below, which was justified
+# against PRE-fix records (VERDICT r3 weak #2) and stands only until the
+# first clean refit lands.  TPU_PATTERNS_GATES_FIT overrides the path
+# (=/dev/null disables the tier).  Read lazily per call — a promote in
+# this process takes effect immediately (≙ the tuned.json discipline).
+GATES_FIT_PATH = os.path.join(os.path.dirname(__file__), "gates_fit.json")
+
+
+def _gate_width_eps() -> float:
+    import json
+
+    path = os.environ.get("TPU_PATTERNS_GATES_FIT", GATES_FIT_PATH)
+    try:
+        with open(path) as f:
+            text = f.read()
+    except FileNotFoundError:
+        return 8.0  # no fit promoted yet
+    if not text.strip():
+        return 8.0  # =/dev/null disable reads as empty
+    try:
+        return float(json.loads(text)["recommended_width_eps"])
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        # A PRESENT but unreadable fit must not silently loosen a
+        # promoted tighter gate back to the 8-eps fallback.
+        import warnings
+
+        warnings.warn(
+            f"gates fit at {path} unreadable ({type(e).__name__}: {e}); "
+            "falling back to the provisional 8-eps width",
+            stacklevel=2,
+        )
+        return 8.0
+
+
 def _grad_gates(cfg: LongCtxConfig, ref: np.ndarray) -> _Gates:
     """Gates for gradient validation: the forward gates at depth=4 (the
     backward chains two more matmul stages), with the atol term rescaled
@@ -292,17 +330,20 @@ def _grad_gates(cfg: LongCtxConfig, ref: np.ndarray) -> _Gates:
     base = _gates(cfg, ref, depth=4)
     eps = _eps_effective(cfg) * 4
     ref_scale = float(np.max(np.abs(ref)))
-    # 8 eps (not 2): at analytic-cancellation points dS = P*(dP - delta)
-    # subtracts an in-kernel MXU reduction from an XLA einsum, and the
-    # residue's size moves with reduction order across compilations —
-    # committed captures span 0.08x..2.42x of a 2-eps allowance for the
-    # SAME config (docs/measured/flash_tpu_v5e.jsonl:8,9,12,13), i.e. the
-    # 2-eps gate sat ON the rounding boundary and its verdict flipped run
-    # to run.  8 eps clears the observed spread 1.65x while staying ~3
-    # orders below any structural error; it also matches the forward
-    # gates' 8-eps rtol headroom.
+    # Width (default 8 eps, not 2): at analytic-cancellation points
+    # dS = P*(dP - delta) subtracts an in-kernel MXU reduction from an
+    # XLA einsum, and the residue's size moves with reduction order
+    # across compilations — committed captures span 0.08x..2.42x of a
+    # 2-eps allowance for the SAME config
+    # (docs/measured/flash_tpu_v5e.jsonl:8,9,12,13), i.e. the 2-eps gate
+    # sat ON the rounding boundary and its verdict flipped run to run.
+    # 8 eps clears the observed spread 1.65x while staying ~3 orders
+    # below any structural error.  That spread came from PRE-fix
+    # records, so the width is a FIT TIER: a clean hardware refit
+    # (sweep gates -> promote --gates) overrides it via gates_fit.json.
+    width = _gate_width_eps()
     return dataclasses.replace(
-        base, atol=max(cfg.tol, min(8 * eps, 0.25) * ref_scale)
+        base, atol=max(cfg.tol, min(width * eps, 0.25) * ref_scale)
     )
 
 
