@@ -618,21 +618,27 @@ class TestGatesSuite:
         # consecutive runs (VERDICT r3 next #3)
         assert len(full) == 40
 
-    def test_fit_gates_refits_width_from_spread(self, tmp_path):
+    def test_fit_gates_refits_width_from_spread(self, tmp_path, monkeypatch):
         import json
 
         from tpu_patterns.core.results import Record
 
-        def write(cfg, violations):
+        # a promoted fit on this machine must not leak into the math
+        monkeypatch.setenv("TPU_PATTERNS_GATES_FIT", "/dev/null")
+
+        def write(cfg, violations, width=None):
             path = tmp_path / f"gates.{cfg}.r0.jsonl"
             with open(path, "w") as f:
                 for i, v in enumerate(violations):
+                    metrics = {"gate_violation": v}
+                    if width is not None:
+                        metrics["gate_width_eps"] = width
                     f.write(
                         Record(
                             pattern="longctx",
                             mode="flash_grad",
                             commands=f"run {i}",
-                            metrics={"gate_violation": v},
+                            metrics=metrics,
                         ).to_json()
                         + "\n"
                     )
@@ -653,6 +659,37 @@ class TestGatesSuite:
         on_disk = json.loads((tmp_path / "gates_fit.json").read_text())
         assert on_disk["current_width_eps"] == 8
         assert on_disk["recommended_width_eps"] == 8
+
+    def test_fit_gates_uses_record_width_provenance(
+        self, tmp_path, monkeypatch
+    ):
+        # records taken under DIFFERENT promoted widths carry their own
+        # gate_width_eps; the refit works in violation*width, so mixing
+        # them is correct and re-fitting after a promotion is idempotent
+        # (no ratchet toward the floor)
+        from tpu_patterns.core.results import Record
+
+        monkeypatch.setenv("TPU_PATTERNS_GATES_FIT", "/dev/null")
+        with open(tmp_path / "gates.mixed.r0.jsonl", "w") as f:
+            for v, w in ((0.5, 8.0), (1.0, 4.0)):  # both = 4 eps residue
+                f.write(
+                    Record(
+                        pattern="longctx",
+                        mode="flash_grad",
+                        commands="x",
+                        metrics={
+                            "gate_violation": v,
+                            "gate_width_eps": w,
+                        },
+                    ).to_json()
+                    + "\n"
+                )
+        fit = sweep.fit_gates(str(tmp_path))
+        mixed = fit["configs"]["gates.mixed"]
+        # worst residue 4 eps -> ceil(4 * 1.5) = 6, regardless of which
+        # width happened to be live at fit time
+        assert mixed["recommended_width_eps"] == 6
+        assert not mixed["defect"]  # 1.0 is ON the gate, not over it
 
     def test_promote_gates_writes_fit_tier(self, tmp_path, monkeypatch):
         import json
@@ -690,8 +727,10 @@ class TestGatesSuite:
         with pytest.raises(FileNotFoundError):
             sweep.promote_gates(str(tmp_path / "nope"))
 
-    def test_fit_gates_flags_defect(self, tmp_path):
+    def test_fit_gates_flags_defect(self, tmp_path, monkeypatch):
         from tpu_patterns.core.results import Record
+
+        monkeypatch.setenv("TPU_PATTERNS_GATES_FIT", "/dev/null")
 
         with open(tmp_path / "gates.bad.r0.jsonl", "w") as f:
             f.write(

@@ -344,3 +344,54 @@ class TestCrosscheckZeroCompute:
         bd = {"compute_ms": 0.0, "busy_ms": 8.0, "wall_ms": 10.0}
         cc = prof.crosscheck_rate(0.0, bd, 197.0)
         assert "coherent" not in cc
+
+
+class TestCommittedOpNameFixtures:
+    """The classifier against SILICON vocabulary (VERDICT r3 next #6):
+    every op-name fixture captured by the hardware ladder and committed
+    under tests/fixtures/ is re-classified by the CURRENT rules — a rule
+    change that unbuckets a real hot op, or books >20% of real busy time
+    as 'other', fails here with no TPU needed."""
+
+    FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures")
+
+    def _fixtures(self):
+        import glob
+
+        return sorted(
+            glob.glob(os.path.join(self.FIXDIR, "op_names_*.json"))
+        )
+
+    def test_real_vocabulary_classifies(self):
+        import json
+
+        fixtures = self._fixtures()
+        if not fixtures:
+            pytest.skip(
+                "no captured op-name fixtures committed yet (the r4 "
+                "hardware ladder's profilecheck stage writes them)"
+            )
+        for path in fixtures:
+            with open(path) as f:
+                names = json.load(f)
+            assert names, path
+            total = sum(d["duration_ps"] for d in names.values()) or 1
+            other = sum(
+                d["duration_ps"]
+                for n, d in names.items()
+                if prof.classify(n) == "other"
+            )
+            # same bar as profilecheck's live gate: an unclassified hot
+            # op skews every breakdown fraction
+            assert other / total <= 0.20, (
+                f"{path}: {other / total:.1%} of real busy time "
+                "unclassified under current rules"
+            )
+            # drift net: the category recorded at capture time must
+            # match what the current rules produce, or the fixture (and
+            # every committed breakdown) is stale
+            for n, d in names.items():
+                assert prof.classify(n) == d["category"], (
+                    f"{path}: rule drift on {n!r}: "
+                    f"{d['category']} -> {prof.classify(n)}"
+                )

@@ -293,26 +293,31 @@ def _gate_width_eps() -> float:
     import json
 
     path = os.environ.get("TPU_PATTERNS_GATES_FIT", GATES_FIT_PATH)
-    try:
-        with open(path) as f:
-            text = f.read()
-    except FileNotFoundError:
-        return 8.0  # no fit promoted yet
-    if not text.strip():
-        return 8.0  # =/dev/null disable reads as empty
-    try:
-        return float(json.loads(text)["recommended_width_eps"])
-    except (OSError, ValueError, KeyError, TypeError) as e:
-        # A PRESENT but unreadable fit must not silently loosen a
+    def _warn_fallback(e: Exception) -> float:
+        # A PRESENT but unreadable fit must not SILENTLY loosen a
         # promoted tighter gate back to the 8-eps fallback.
         import warnings
 
         warnings.warn(
             f"gates fit at {path} unreadable ({type(e).__name__}: {e}); "
             "falling back to the provisional 8-eps width",
-            stacklevel=2,
+            stacklevel=3,
         )
         return 8.0
+
+    try:
+        with open(path) as f:
+            text = f.read()
+    except FileNotFoundError:
+        return 8.0  # no fit promoted yet
+    except OSError as e:  # present but unreadable (permissions, isadir…)
+        return _warn_fallback(e)
+    if not text.strip():
+        return 8.0  # =/dev/null disable reads as empty
+    try:
+        return float(json.loads(text)["recommended_width_eps"])
+    except (ValueError, KeyError, TypeError) as e:
+        return _warn_fallback(e)
 
 
 def _grad_gates(cfg: LongCtxConfig, ref: np.ndarray) -> _Gates:
@@ -495,6 +500,10 @@ def run_longctx_grad(
                 "min_time_us": res.us(),
                 "flops": flops,
                 "gate_violation": violation,
+                # width provenance: violation is scaled by the gate
+                # active at RUN time, so any later refit (fit_gates)
+                # must read the width off the record, not assume one
+                "gate_width_eps": _gate_width_eps(),
                 "rms_err": err_rms,
                 "checksum_ok": float(data_ok),
             },

@@ -856,39 +856,47 @@ def fit_gates(out_dir: str) -> dict:
     from tpu_patterns.core.results import parse_log
     from tpu_patterns.longctx.pattern import _gate_width_eps
 
-    # eps units of _grad_gates' atol term — the LIVE width (fit tier or
-    # the 8-eps fallback), since gate_violation in the records is scaled
-    # by whatever gate was active when they ran; hardcoding 8 here would
-    # mis-scale every refit after the first promotion
-    current_width = _gate_width_eps()
-    by_cfg: dict[str, list[float]] = {}
+    # Each record carries the width its violation was scaled by
+    # (gate_width_eps, written at run time) — the refit works in the
+    # width-independent quantity violation*width, so records taken under
+    # different promoted widths mix correctly and re-fitting the same
+    # records after a promotion is IDEMPOTENT (no ratchet).  Records
+    # predating the provenance metric all ran under the 8-eps fallback.
+    by_cfg: dict[str, list[tuple[float, float]]] = {}
     for path in sorted(glob.glob(os.path.join(out_dir, "gates.*.jsonl"))):
         cfg_name = os.path.basename(path)[: -len(".jsonl")].rsplit(".", 1)[0]
         with open(path) as f:
             for rec in parse_log(f.readlines()):
                 if rec.mode.endswith("_grad") and "gate_violation" in rec.metrics:
                     by_cfg.setdefault(cfg_name, []).append(
-                        rec.metrics["gate_violation"]
+                        (
+                            rec.metrics["gate_violation"],
+                            rec.metrics.get("gate_width_eps", 8.0),
+                        )
                     )
     if not by_cfg:
         raise FileNotFoundError(
             f"fit_gates: no completed grad records under {out_dir}"
         )
     fit: dict[str, dict] = {}
-    for cfg_name, violations in sorted(by_cfg.items()):
+    for cfg_name, runs in sorted(by_cfg.items()):
+        violations = [v for v, _ in runs]
         vmax, vmin = max(violations), min(violations)
+        # worst residue in eps units, independent of the gate it was
+        # measured against; 50% headroom, 2-eps floor
+        eps_max = max(v * w for v, w in runs)
         fit[cfg_name] = {
-            "runs": len(violations),
+            "runs": len(runs),
             "violation_min": vmin,
             "violation_max": vmax,
-            "recommended_width_eps": max(
-                2, math.ceil(current_width * vmax * 1.5)
-            ),
+            "recommended_width_eps": max(2, math.ceil(eps_max * 1.5)),
             "defect": vmax > 1.0,  # clean code over the gate = kernel bug
             "gate_loose_10x": vmax < 0.1,
         }
     out = {
-        "current_width_eps": current_width,
+        # informational: the width live at fit time (fit math above does
+        # not depend on it)
+        "current_width_eps": _gate_width_eps(),
         "configs": fit,
         "recommended_width_eps": max(
             c["recommended_width_eps"] for c in fit.values()
