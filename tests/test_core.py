@@ -256,6 +256,39 @@ class TestMeasureChain:
         assert m.lengths[1] <= 32
         assert m.per_op_ns >= 0
 
+    def test_convergence_flag(self):
+        # The r4 live artifact: 32768 near-free VMEM copies never
+        # separated from the fetch round trip, yet the rate was recorded
+        # as a clean measurement.  A chain that hits max length with the
+        # differential still under the jitter floor must say so.
+        from tpu_patterns.core import TimingMode, measure_chain
+
+        def free(k):
+            return lambda: 0  # per-op cost ~0: diff can never clear 10 ms
+
+        m = measure_chain(
+            free, reps=2, warmup=0, lengths=None,
+            mode=TimingMode.AMORTIZED, max_chain=16, barrier=None,
+        )
+        assert m.converged is False
+
+        import time
+
+        def slow(k):
+            return lambda: time.sleep(0.004 * k)  # 4 ms/iter: clears fast
+
+        m2 = measure_chain(
+            slow, reps=2, warmup=0, lengths=None,
+            mode=TimingMode.AMORTIZED, max_chain=64, barrier=None,
+        )
+        assert m2.converged is True
+        # DIRECT mode has no differential to converge: flag stays True
+        m3 = measure_chain(
+            self._builder(), reps=2, warmup=0, mode=TimingMode.DIRECT,
+            direct_fn=self._builder()(1),
+        )
+        assert m3.converged is True
+
 
 class TestChipPeak:
     def test_dtype_scales_peak(self, monkeypatch):

@@ -817,6 +817,29 @@ class TestCompactCausalGridBackward:
         with pytest.raises(ValueError, match="single-chip"):
             run_flagship(mesh_sp2, cfg, ResultWriter())
 
+    def test_width_needed_is_width_independent(self):
+        # the refit quantity must not move with the promoted width, even
+        # where cfg.tol floors the atol (there the violation RATIO is
+        # width-independent and violation*width would ratchet)
+        import dataclasses as dc
+
+        from tpu_patterns.longctx.pattern import _Gates
+
+        ref = np.zeros((4,), np.float32)
+        ref[0] = 1.0
+        diff = np.array([0.0, 3e-4, 0.0, 0.0], np.float32)
+        g8 = _Gates(rtol=1e-6, atol=1e-4, rms=1.0, unit_atol=5e-5)
+        g4 = dc.replace(g8, atol=2e-4)  # a different promoted width
+        assert g8.width_needed(diff, ref) == pytest.approx(6.0)
+        assert g4.width_needed(diff, ref) == pytest.approx(6.0)
+        # violation ratios DO differ across the widths — the old
+        # violation*width refit would have disagreed with itself
+        assert g8.check_elem(diff, ref) != g4.check_elem(diff, ref)
+        # forward gates carry no unit: quantity not claimed
+        assert _Gates(rtol=1e-6, atol=1e-4, rms=1.0).width_needed(
+            diff, ref
+        ) is None
+
     def test_pattern_grad_runner_compact(self):
         from jax.sharding import Mesh
 

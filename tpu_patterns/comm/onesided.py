@@ -424,6 +424,7 @@ def run_onesided(
                 None if hbm_spec is None else hbm_plausible(kgbps, hbm_spec)
             )
             extra_metrics[f"bandwidth_GBps_{name}"] = kgbps
+            extra_metrics[f"timing_converged_{name}"] = float(kres.converged)
             writer.progress(
                 f"onesided local_put[{name}]: {kgbps:.1f} GB/s"
                 + (
@@ -431,6 +432,7 @@ def run_onesided(
                     if kplausible is False
                     else ""
                 )
+                + ("" if kres.converged else " (noise-bound)")
             )
             if kplausible is False:
                 notes.append(
@@ -439,12 +441,17 @@ def run_onesided(
                     f"{hbm_spec:.0f} GB/s spec — buffer resident in a "
                     "faster tier"
                 )
-            # A plausible (or unchecked) schedule always beats an
-            # implausible one: an auto-select must not crown a number HBM
-            # cannot carry.
-            if best is None or (kplausible is not False, kgbps) > (
-                best[0] is not False,
-                best[3],
+            # Ranking: a plausible (or unchecked) schedule beats an
+            # implausible one, and a CONVERGED measurement beats a
+            # noise-bound one — a chain that never separated from the
+            # jitter floor can fabricate an arbitrarily high rate from a
+            # noise-sized positive differential, and must not out-rank a
+            # real measurement on that fiction.
+            def rank(plaus, res_, gbps_):
+                return (plaus is not False, res_.converged, gbps_)
+
+            if best is None or rank(kplausible, kres, kgbps) > rank(
+                best[0], best[4], best[3]
             ):
                 best = (kplausible, name, kfn, kgbps, kres, want_fn)
         if best is None:
@@ -476,6 +483,7 @@ def run_onesided(
             "min_time_us": res.us(),
             "bytes_per_put": float(shard_bytes),
             "checksum_ok": float(data_ok),
+            "timing_converged": float(res.converged),
             # absent on the ring/ICI path, where the gate does not apply
             **(
                 {}
@@ -487,6 +495,11 @@ def run_onesided(
         verdict=verdict,
     )
     rec.notes.extend(notes)
+    if not res.converged:
+        rec.notes.append(
+            "amortized differential never cleared the jitter floor "
+            "(chain hit max length) — rate is noise-bound, not measured"
+        )
     if not data_ok:
         rec.notes.append("one-sided put data mismatch")
     if plausible is False:

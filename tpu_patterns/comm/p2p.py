@@ -193,6 +193,7 @@ def run_p2p(
                 "bytes_per_pair": float(shard_bytes),
                 "num_transfers": float(num_pairs),
                 "checksum_ok": float(data_ok),
+                "timing_converged": float(res.converged),
                 **(
                     {}
                     if ici_spec is None
@@ -212,6 +213,12 @@ def run_p2p(
                 f"per-pair rate {per_pair:.1f} GB/s exceeds what "
                 f"{2:.0f} ICI links ({ici_spec:.0f} GB/s each) can carry "
                 "— the exchange never crossed chips"
+            )
+        if not res.converged:
+            rec.notes.append(
+                "amortized differential never cleared the jitter floor "
+                "(chain hit max length) — rate is noise-bound, not "
+                "measured"
             )
         records.append(writer.record(rec))
     return records

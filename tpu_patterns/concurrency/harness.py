@@ -61,9 +61,9 @@ def _measure_solo(
     backend,
     cmd: Command,
     cfg: ConcurrencyConfig,
-    cache: dict[tuple, tuple[float, int]] | None = None,
-) -> tuple[float, int]:
-    """Per-command (time alone [ns], bytes moved per measured iteration)
+    cache: dict[tuple, tuple[float, int, bool]] | None = None,
+) -> tuple[float, int, bool]:
+    """Per-command (time alone [ns], bytes per iteration, converged)
     (serial probe, main.cpp:236-238).  Cached by workload so the tuning
     probe and the serial reference don't re-measure (and re-compile) the
     unchanged slowest command."""
@@ -79,7 +79,7 @@ def _measure_solo(
         direct_fn=built.direct_fn,
         label=f"solo:{cmd.text}",
     )
-    out = (m.per_op_ns, built.cmd_bytes[0])
+    out = (m.per_op_ns, built.cmd_bytes[0], m.converged)
     if cache is not None:
         cache[key] = out
     return out
@@ -90,7 +90,7 @@ def auto_tune(
     cmds: list[Command],
     cfg: ConcurrencyConfig,
     writer: ResultWriter,
-    solo_cache: dict[tuple, tuple[float, int]] | None = None,
+    solo_cache: dict[tuple, tuple[float, int, bool]] | None = None,
 ) -> list[Command]:
     """Linear workload rescale so all commands take ~equal time
     (≙ commands_to_parameters_tunned, main.cpp:248-257: time ∝ knob)."""
@@ -134,7 +134,7 @@ def run_group(
     cmds = _apply_defaults(parse_group(group), cfg)
     backend.validate(cfg.mode, cmds)
 
-    solo_cache: dict[tuple, tuple[float, int]] = {}
+    solo_cache: dict[tuple, tuple[float, int, bool]] = {}
     if cfg.auto_tune:
         cmds = auto_tune(backend, cmds, cfg, writer, solo_cache)
 
@@ -145,6 +145,7 @@ def run_group(
         _measure_solo(backend, c, cfg, solo_cache)
     solo_ns = [solo_cache[_solo_key(c)][0] for c in cmds]
     solo_bytes = [solo_cache[_solo_key(c)][1] for c in cmds]
+    solo_converged = all(solo_cache[_solo_key(c)][2] for c in cmds)
     serial_total_ns = sum(solo_ns)
     # Max theoretical speedup: perfect overlap leaves the slowest command
     # (main.cpp:290-293).
@@ -199,10 +200,17 @@ def run_group(
             "serial_total_us": serial_total_ns / 1e3,
             "mode_us": m.per_op_ns / 1e3,
             "bytes_per_iter": float(built.n_bytes_per_iter),
+            "timing_converged": float(solo_converged and m.converged),
         },
         verdict=verdict,
         notes=notes,
     )
+    if not (solo_converged and m.converged):
+        rec.notes.append(
+            "amortized differential never cleared the jitter floor "
+            "(chain hit max length) — speedup is noise-bound, not "
+            "measured"
+        )
     return writer.record(rec)
 
 

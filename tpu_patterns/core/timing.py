@@ -240,13 +240,22 @@ def unrolled_chain(op: Callable[[Any], Any], a: Any, k: Any):
 
 @dataclasses.dataclass
 class ChainMeasurement:
-    """Per-op time from chained differential measurement."""
+    """Per-op time from chained differential measurement.
+
+    ``converged``: whether the long-chain differential actually cleared
+    the jitter threshold.  False means the chain hit ``max_chain`` (or a
+    caller-pinned length) while the signal was still inside the noise —
+    the per-op time is then an upper-bound-ish estimate, not a
+    measurement, and callers should say so in their records (the live
+    r4 VMEM-residency artifact rode exactly this path: 32768 near-free
+    copies never separated from the fetch round trip)."""
 
     per_op_ns: float
     mode: TimingMode
     short: TimingResult
     long: TimingResult | None = None
     lengths: tuple[int, int] = (1, 1)
+    converged: bool = True
 
     def gbps(self, n_bytes: int) -> float:
         return n_bytes / self.per_op_ns
@@ -329,6 +338,7 @@ def measure_chain(
         assert k1 > k0 >= 1
         r0 = timed(k0, warmup)
         r1 = timed(k1, warmup)
+        threshold = max(4 * r0.spread_ns, 10_000_000)
     else:
         k0 = 1
         r0 = timed(k0, warmup)
@@ -350,4 +360,7 @@ def measure_chain(
     return ChainMeasurement(
         per_op_ns=float(per_iter) / ops_per_iter, mode=mode, short=r0, long=r1,
         lengths=(k0, k1),
+        # the chain ran out of length before the differential emerged
+        # from the jitter floor: the number is noise-bound, not measured
+        converged=diff >= threshold,
     )

@@ -230,11 +230,29 @@ class _Gates:
     rtol: float
     atol: float
     rms: float
+    # one width-unit of the grad gate's atol (eps4 * max|ref|), set by
+    # _grad_gates only: lets width_needed express the residue in eps
+    # units regardless of cfg.tol floors or the width live at run time
+    unit_atol: float | None = None
 
     def check_elem(self, diff: np.ndarray, ref: np.ndarray) -> float:
         """Max violation ratio: <=1 passes (1 == exactly at the gate)."""
         allow = self.atol + self.rtol * np.abs(np.asarray(ref, np.float64))
         return float(np.max(np.abs(np.asarray(diff, np.float64)) / allow))
+
+    def width_needed(self, diff: np.ndarray, ref: np.ndarray) -> float | None:
+        """Smallest gate width (in eps units) whose atol term would have
+        admitted this residue — THE width-independent refit quantity:
+        promotions change atol but not this number, so fit_gates stays
+        idempotent even where cfg.tol floors the atol (there the
+        violation ratio itself is width-independent and violation*width
+        would ratchet with every promotion)."""
+        if not self.unit_atol:
+            return None
+        slack = np.abs(np.asarray(diff, np.float64)) - self.rtol * np.abs(
+            np.asarray(ref, np.float64)
+        )
+        return float(max(0.0, float(np.max(slack)) / self.unit_atol))
 
     def describe(self) -> str:
         return (
@@ -320,7 +338,9 @@ def _gate_width_eps() -> float:
         return _warn_fallback(e)
 
 
-def _grad_gates(cfg: LongCtxConfig, ref: np.ndarray) -> _Gates:
+def _grad_gates(
+    cfg: LongCtxConfig, ref: np.ndarray, width: float | None = None
+) -> _Gates:
     """Gates for gradient validation: the forward gates at depth=4 (the
     backward chains two more matmul stages), with the atol term rescaled
     to max|ref| rather than rms(ref) — gradient rows that are exactly zero
@@ -346,9 +366,15 @@ def _grad_gates(cfg: LongCtxConfig, ref: np.ndarray) -> _Gates:
     # below any structural error.  That spread came from PRE-fix
     # records, so the width is a FIT TIER: a clean hardware refit
     # (sweep gates -> promote --gates) overrides it via gates_fit.json.
-    width = _gate_width_eps()
+    # Callers that RECORD the width (run_longctx_grad) read it once and
+    # pass it in, so a mid-run promote cannot desynchronize the gate
+    # from its recorded provenance.
+    if width is None:
+        width = _gate_width_eps()
     return dataclasses.replace(
-        base, atol=max(cfg.tol, min(width * eps, 0.25) * ref_scale)
+        base,
+        atol=max(cfg.tol, min(width * eps, 0.25) * ref_scale),
+        unit_atol=eps * ref_scale,
     )
 
 
@@ -401,7 +427,11 @@ def run_longctx_grad(
         )
     )(q, k, v)
     ref_np = tuple(np.asarray(g, np.float32) for g in ref_grads)
-    gates = tuple(_grad_gates(cfg, g) for g in ref_np)
+    # the width is read ONCE and threads into every gate and record: a
+    # promote landing mid-run cannot stamp records with a width their
+    # violations were not scaled by
+    width_used = _gate_width_eps()
+    gates = tuple(_grad_gates(cfg, g, width=width_used) for g in ref_np)
 
     interp = use_interpret()
     records = []
@@ -467,6 +497,10 @@ def run_longctx_grad(
             gt.check_elem(g - r, r)
             for gt, g, r in zip(gates, got_np, ref_np)
         )
+        width_needed = max(
+            gt.width_needed(g - r, r)
+            for gt, g, r in zip(gates, got_np, ref_np)
+        )
         # per-gradient rms check: each of dq/dk/dv against ITS OWN gate
         # (their reference magnitudes differ; the largest gate must not
         # absolve the smallest gradient)
@@ -500,10 +534,11 @@ def run_longctx_grad(
                 "min_time_us": res.us(),
                 "flops": flops,
                 "gate_violation": violation,
-                # width provenance: violation is scaled by the gate
-                # active at RUN time, so any later refit (fit_gates)
-                # must read the width off the record, not assume one
-                "gate_width_eps": _gate_width_eps(),
+                # refit provenance: the width the gate ran at (captured
+                # once, at gate construction) and the width-independent
+                # residue-in-eps the refit actually fits on
+                "gate_width_eps": width_used,
+                "gate_width_needed_eps": width_needed,
                 "rms_err": err_rms,
                 "checksum_ok": float(data_ok),
             },

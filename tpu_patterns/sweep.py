@@ -856,24 +856,27 @@ def fit_gates(out_dir: str) -> dict:
     from tpu_patterns.core.results import parse_log
     from tpu_patterns.longctx.pattern import _gate_width_eps
 
-    # Each record carries the width its violation was scaled by
-    # (gate_width_eps, written at run time) — the refit works in the
-    # width-independent quantity violation*width, so records taken under
-    # different promoted widths mix correctly and re-fitting the same
-    # records after a promotion is IDEMPOTENT (no ratchet).  Records
-    # predating the provenance metric all ran under the 8-eps fallback.
+    # Each record carries the refit quantity directly:
+    # gate_width_needed_eps is the smallest width whose atol admits the
+    # run's residue, computed width-independently at gate time — records
+    # taken under different promoted widths mix correctly and re-fitting
+    # the same records after a promotion is IDEMPOTENT (no ratchet),
+    # including where cfg.tol floors the atol (there violation*width
+    # would scale with the live width and ratchet).  Legacy records
+    # without it fall back to violation * gate_width_eps (provisional
+    # 8 when that is absent too — every pre-tier record ran at 8).
     by_cfg: dict[str, list[tuple[float, float]]] = {}
     for path in sorted(glob.glob(os.path.join(out_dir, "gates.*.jsonl"))):
         cfg_name = os.path.basename(path)[: -len(".jsonl")].rsplit(".", 1)[0]
         with open(path) as f:
             for rec in parse_log(f.readlines()):
                 if rec.mode.endswith("_grad") and "gate_violation" in rec.metrics:
-                    by_cfg.setdefault(cfg_name, []).append(
-                        (
-                            rec.metrics["gate_violation"],
-                            rec.metrics.get("gate_width_eps", 8.0),
-                        )
+                    v = rec.metrics["gate_violation"]
+                    needed = rec.metrics.get(
+                        "gate_width_needed_eps",
+                        v * rec.metrics.get("gate_width_eps", 8.0),
                     )
+                    by_cfg.setdefault(cfg_name, []).append((v, needed))
     if not by_cfg:
         raise FileNotFoundError(
             f"fit_gates: no completed grad records under {out_dir}"
@@ -884,7 +887,7 @@ def fit_gates(out_dir: str) -> dict:
         vmax, vmin = max(violations), min(violations)
         # worst residue in eps units, independent of the gate it was
         # measured against; 50% headroom, 2-eps floor
-        eps_max = max(v * w for v, w in runs)
+        eps_max = max(needed for _, needed in runs)
         fit[cfg_name] = {
             "runs": len(runs),
             "violation_min": vmin,
