@@ -289,6 +289,7 @@ def run_hierarchical(
             commands=f"{dcn}x{ici}dev x {n_bytes // 1_000_000}MB",
             metrics={
                 "time_us": res.us(),
+                "timing_converged": float(res.converged),
                 "wire_GBps_per_device": gbps,
                 "checksum_ok": float(data_ok),
                 **{k: float(v) for k, v in model.items()},

@@ -497,8 +497,8 @@ def run_onesided(
     rec.notes.extend(notes)
     if not res.converged:
         rec.notes.append(
-            "amortized differential never cleared the jitter floor "
-            "(chain hit max length) — rate is noise-bound, not measured"
+            "amortized differential never cleared the jitter floor — "
+            "rate is noise-bound, not measured"
         )
     if not data_ok:
         rec.notes.append("one-sided put data mismatch")

@@ -216,9 +216,8 @@ def run_p2p(
             )
         if not res.converged:
             rec.notes.append(
-                "amortized differential never cleared the jitter floor "
-                "(chain hit max length) — rate is noise-bound, not "
-                "measured"
+                "amortized differential never cleared the jitter floor — "
+                "rate is noise-bound, not measured"
             )
         records.append(writer.record(rec))
     return records

@@ -207,9 +207,8 @@ def run_group(
     )
     if not (solo_converged and m.converged):
         rec.notes.append(
-            "amortized differential never cleared the jitter floor "
-            "(chain hit max length) — speedup is noise-bound, not "
-            "measured"
+            "amortized differential never cleared the jitter floor — "
+            "speedup is noise-bound, not measured"
         )
     return writer.record(rec)
 

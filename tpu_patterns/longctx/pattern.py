@@ -247,8 +247,13 @@ class _Gates:
         idempotent even where cfg.tol floors the atol (there the
         violation ratio itself is width-independent and violation*width
         would ratchet with every promotion)."""
-        if not self.unit_atol:
-            return None
+        if self.unit_atol is None:
+            return None  # not a grad gate: the quantity is not claimed
+        if self.unit_atol == 0:
+            # identically-zero reference (ref_scale 0): any residue is
+            # gated by the cfg.tol floor alone; no width can help or
+            # hurt, so the needed width is 0
+            return 0.0
         slack = np.abs(np.asarray(diff, np.float64)) - self.rtol * np.abs(
             np.asarray(ref, np.float64)
         )

@@ -198,6 +198,7 @@ def run_allreduce(
             "wire_GBps": wire / (wall_s * 1e9),
             "bytes_per_rank": float(n_bytes),
             "validated": float(ok_all),
+            "timing_converged": float(res.converged),
         },
         verdict=Verdict.SUCCESS if ok_all else Verdict.FAILURE,
         config={

@@ -729,6 +729,7 @@ def run_decode(mesh: Mesh, cfg: DecodeConfig, writer) -> list:
             "prefill_ms": round(prefill_ms, 2),
             "cache_MB": round(cache_mb, 3),
             "prefill_context": float(cfg.prefill),
+            "timing_converged": float(res.converged),
         },
         verdict=Verdict.SUCCESS if ok else Verdict.FAILURE,
     )

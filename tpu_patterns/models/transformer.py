@@ -999,6 +999,7 @@ def run_flagship(mesh: Mesh, cfg: FlagshipConfig, writer) -> list:
         metrics={
             "tflops": tflops,
             "step_ms": res.per_op_ns / 1e6,
+            "timing_converged": float(res.converged),
             "flops": flops,
             "loss": loss,
             "checksum_ok": float(data_ok),

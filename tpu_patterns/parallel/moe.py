@@ -284,6 +284,7 @@ def run_moe(mesh, cfg: MoEConfig | None = None, writer=None):
             commands=f"ep{ep} T{cfg.tokens} D{cfg.dim} C{cap}",
             metrics={
                 "time_us": res.us(),
+                "timing_converged": float(res.converged),
                 "capacity": float(cap),
                 "capacity_factor": float(cf),
                 "dropped_tokens": float(dropped),

@@ -434,6 +434,7 @@ def run_pipeline(mesh, cfg: PipelineConfig | None = None, writer=None):
             + (" sharded" if cfg.micro_sharded else " replicated"),
             metrics={
                 "step_us": res.us(),
+                "timing_converged": float(res.converged),
                 "loss": float(loss),
                 "bubble_fraction": bubble_fraction(schedule, pp, cfg.n_micro),
                 "peak_stash_microbatches": float(stash),

@@ -841,14 +841,16 @@ def fit_gates(out_dir: str) -> dict:
 
     Reads every ``gates.*.jsonl``, groups the ``*_grad`` records by
     config, and reports per config: run count, violation spread (in
-    units of the CURRENT gate), and the recommended width in eps units
-    — ``ceil(current_width * max_violation * 1.5)`` (50% headroom over
-    the worst clean run), floored at 2 eps.  A max violation > 1 on
-    clean code is a real kernel defect, not gate noise; a spread
-    entirely below 0.1 means the current gate is ~10x looser than the
-    data needs.  Writes ``gates_fit.json`` into ``out_dir`` and returns
-    the dict; raises when the dir holds no grad records (the fit must
-    never silently no-op)."""
+    units of the gate each record ran against), and the recommended
+    width in eps units — ``ceil(max(gate_width_needed_eps) * 1.5)``
+    (50% headroom over the worst clean run's width-independent
+    residue; legacy records without the metric contribute
+    ``violation * gate_width_eps`` instead), floored at 2 eps.  A max
+    violation > 1 on clean code is a real kernel defect, not gate
+    noise; a spread entirely below 0.1 means the current gate is ~10x
+    looser than the data needs.  Writes ``gates_fit.json`` into
+    ``out_dir`` and returns the dict; raises when the dir holds no
+    grad records (the fit must never silently no-op)."""
     import glob
     import json
     import math
