@@ -233,4 +233,14 @@ class TestHloCheckPattern:
         assert verdicts["remat_temp"] is Verdict.SUCCESS
         assert verdicts["async_overlap"] is Verdict.SKIPPED
         assert verdicts["vmem_boundary"] is Verdict.SKIPPED
+        # grad-chain FLOP crosscheck: the honest chain matches the
+        # single grad AND the dq-only DCE twin counts measurably fewer
+        assert verdicts["grad_flops"] is Verdict.SUCCESS
+        by_mode = {r.mode: r for r in records}
+        gf = by_mode["grad_flops"].metrics
+        assert gf["discriminates"] == 1.0
+        assert gf["twin_over_chain"] <= 0.8
+        assert 0.5 <= gf["chain_per_op_ratio"] <= 1.6
+        # Mosaic-call counting needs a TPU
+        assert verdicts["flash_chain_calls"] is Verdict.SKIPPED
         assert writer.exit_code == 0

@@ -298,5 +298,7 @@ def run_hierarchical(
         )
         if not data_ok:
             rec.notes.append("hierarchical allreduce result mismatch")
+        if note := res.noise_note("GB/s"):
+            rec.notes.append(note)
         records.append(writer.record(rec))
     return records

@@ -495,11 +495,8 @@ def run_onesided(
         verdict=verdict,
     )
     rec.notes.extend(notes)
-    if not res.converged:
-        rec.notes.append(
-            "amortized differential never cleared the jitter floor — "
-            "rate is noise-bound, not measured"
-        )
+    if note := res.noise_note():
+        rec.notes.append(note)
     if not data_ok:
         rec.notes.append("one-sided put data mismatch")
     if plausible is False:

@@ -214,10 +214,7 @@ def run_p2p(
                 f"{2:.0f} ICI links ({ici_spec:.0f} GB/s each) can carry "
                 "— the exchange never crossed chips"
             )
-        if not res.converged:
-            rec.notes.append(
-                "amortized differential never cleared the jitter floor — "
-                "rate is noise-bound, not measured"
-            )
+        if note := res.noise_note():
+            rec.notes.append(note)
         records.append(writer.record(rec))
     return records

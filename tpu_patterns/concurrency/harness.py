@@ -206,10 +206,7 @@ def run_group(
         notes=notes,
     )
     if not (solo_converged and m.converged):
-        rec.notes.append(
-            "amortized differential never cleared the jitter floor — "
-            "speedup is noise-bound, not measured"
-        )
+        rec.notes.append(timing.noise_bound_note("speedup"))
     return writer.record(rec)
 
 

@@ -198,6 +198,15 @@ def measure_sequence(
 # ---------------------------------------------------------------------------
 
 
+def noise_bound_note(what: str = "rate") -> str:
+    """The shared not-a-measurement wording (see
+    ChainMeasurement.noise_note)."""
+    return (
+        "amortized differential never cleared the jitter floor — "
+        f"{what} is noise-bound, not measured"
+    )
+
+
 class TimingMode(enum.Enum):
     DIRECT = "direct"  # host wall clock around each rep (reference discipline)
     AMORTIZED = "amortized"  # differential chained in-program timing
@@ -262,6 +271,11 @@ class ChainMeasurement:
 
     def us(self) -> float:
         return self.per_op_ns * 1e-3
+
+    def noise_note(self, what: str = "rate") -> str | None:
+        """The record note every runner attaches when the measurement is
+        noise-bound — ONE wording, so runners cannot drift apart."""
+        return None if self.converged else noise_bound_note(what)
 
 
 def measure_chain(

@@ -546,11 +546,14 @@ def run_longctx_grad(
                 "gate_width_needed_eps": width_needed,
                 "rms_err": err_rms,
                 "checksum_ok": float(data_ok),
+                "timing_converged": float(res.converged),
             },
             verdict=Verdict.SUCCESS
             if (data_ok and perf_ok and sane)
             else Verdict.FAILURE,
         )
+        if note := res.noise_note("TFLOP/s"):
+            rec.notes.append(note)
         if not data_ok:
             rec.notes.append(
                 f"grad elem violation {violation:.2f}x / rms {err_rms:.2e}"
@@ -689,9 +692,12 @@ def run_longctx(
                 "rms_err": err_rms,
                 "gate_violation": violation,
                 "checksum_ok": float(data_ok),
+                "timing_converged": float(res.converged),
             },
             verdict=verdict,
         )
+        if note := res.noise_note("TFLOP/s"):
+            rec.notes.append(note)
         if not data_ok:
             rec.notes.append(
                 f"elem violation {violation:.2f}x / rms {err_rms:.2e} "

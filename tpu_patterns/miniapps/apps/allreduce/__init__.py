@@ -211,4 +211,6 @@ def run_allreduce(
     )
     if not ok_all:
         rec.notes.append(f"elementwise check != {expect} (tol {cfg.tol})")
+    if note := res.noise_note("GB/s"):
+        rec.notes.append(note)
     return writer.record(rec)

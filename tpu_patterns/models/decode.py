@@ -733,6 +733,8 @@ def run_decode(mesh: Mesh, cfg: DecodeConfig, writer) -> list:
         },
         verdict=Verdict.SUCCESS if ok else Verdict.FAILURE,
     )
+    if note := res.noise_note("tokens/s"):
+        rec.notes.append(note)
     if not gate:
         rec.notes.append("teacher-forcing gate FAILED: cache path diverges")
     writer.record(rec)

@@ -1011,6 +1011,8 @@ def run_flagship(mesh: Mesh, cfg: FlagshipConfig, writer) -> list:
         rec.notes.append(f"loss not finite/reproducible: {loss} vs {loss2}")
     if not perf_ok:
         rec.notes.append(f"{tflops:.3f} TFLOP/s below floor {cfg.min_tflops}")
+    if note := res.noise_note("TFLOP/s"):
+        rec.notes.append(note)
     if cfg.attn == "pallas" and sp == 1 and _interpret():
         # the single-chip fused path is TPU-only; off-TPU the step timed
         # XLA reference attention — say so in the record rather than let

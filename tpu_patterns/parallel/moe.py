@@ -299,5 +299,7 @@ def run_moe(mesh, cfg: MoEConfig | None = None, writer=None):
         )
         if not ok:
             rec.notes.append(f"token-exact invariant broken: {err:.2e} > {tol:.0e}")
+        if note := res.noise_note("time"):
+            rec.notes.append(note)
         records.append(writer.record(rec))
     return records

@@ -265,10 +265,7 @@ def _run_one(mesh: Mesh, cfg: OverlapConfig, kind: str, writer) -> "Record":
         verdict=Verdict.SUCCESS if (exact_ok and perf_ok) else Verdict.FAILURE,
     )
     if not converged:
-        rec.notes.append(
-            "amortized differential never cleared the jitter floor — "
-            "speedup is noise-bound, not measured"
-        )
+        rec.notes.append(timing.noise_bound_note("speedup"))
     if not exact_ok:
         rec.notes.append("decomposed result diverges from XLA collective")
     writer.record(rec)

@@ -448,5 +448,7 @@ def run_pipeline(mesh, cfg: PipelineConfig | None = None, writer=None):
             rec.notes.append(
                 f"gradients diverge from sequential reference: {err:.2e}"
             )
+        if note := res.noise_note("step time"):
+            rec.notes.append(note)
         records.append(writer.record(rec))
     return records
