@@ -95,6 +95,18 @@ while true; do
       --snapshot-out "$OUT/op_names_longctx.json" \
       --rates-jsonl "$OUT/longctx_grad_profiled.jsonl" >> "$OUT/profile.log" 2>&1
     echo "[$(date +%H:%M:%S)] profilecheck(longctx grad) rc=$?"
+    # committed-fixture tier: the snapshots feed
+    # tests/test_profile.py::TestCommittedOpNameFixtures, so the
+    # classifier is CI-tested against silicon vocabulary from the
+    # moment the capture lands (the driver commits the tree at round
+    # end even if no one is watching)
+    mkdir -p tests/fixtures
+    for fx in "$OUT"/op_names_*.json; do
+      # a SIGKILLed profilecheck can leave a truncated file; committing
+      # corrupt JSON would break CI until manually removed
+      [ -f "$fx" ] && python -m json.tool "$fx" >/dev/null 2>&1 && cp "$fx" tests/fixtures/
+    done
+    echo "[$(date +%H:%M:%S)] fixtures: $(ls tests/fixtures 2>/dev/null | tr '\n' ' ')"
     # 8. post-tune bench: the number the driver should reproduce
     TPU_PATTERNS_BENCH_TIMEOUT=700 timeout -k 30 900 \
       python bench.py > "$OUT/bench_post_$(date +%Y%m%d_%H%M%S).json" 2>> "$OUT/bench.log"

@@ -150,6 +150,33 @@ class TestResults:
         table = tabulate_records(recs)
         assert "serial" in table and "async" in table and "C C" in table
 
+    def test_tabulate_surfaces_integrity_flags(self):
+        recs = [
+            Record(
+                pattern="onesided", mode="local_put", commands="1dev",
+                verdict=Verdict.FAILURE,
+                metrics={
+                    "bandwidth_GBps": 103523.6,
+                    "timing_converged": 0.0,
+                    "hbm_plausible": 0.0,
+                },
+            ),
+            Record(
+                pattern="onesided", mode="clean", commands="1dev",
+                verdict=Verdict.SUCCESS,
+                metrics={
+                    "bandwidth_GBps": 335.6,
+                    "timing_converged": 1.0,
+                    "hbm_plausible": 1.0,
+                },
+            ),
+        ]
+        table = tabulate_records(recs)
+        # the 103 TB/s artifact reads as flagged, the clean row does not
+        assert "NOISE-BOUND" in table and "NOT-HBM" in table
+        assert table.count("NOISE-BOUND") == 1
+        assert "335.6" in table and "[" not in table.split("335.6")[1].split("|")[0]
+
 
 class TestTiming:
     def test_clock_monotonic(self):

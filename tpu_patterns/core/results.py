@@ -220,6 +220,20 @@ def tabulate_records(records: list[Record]) -> str:
         if rec.metrics:
             main_metric = next(iter(rec.metrics.items()))
             cell = f"{rec.verdict.value} ({main_metric[0]}={main_metric[1]:.4g})"
+        # measurement-integrity flags ride with the number: a reader of
+        # the table must see a noise-bound or implausible rate AS such,
+        # not discover it three columns deep in the raw JSONL
+        flags = [
+            tag
+            for key, tag in (
+                ("timing_converged", "NOISE-BOUND"),
+                ("hbm_plausible", "NOT-HBM"),
+                ("ici_plausible", "NOT-ICI"),
+            )
+            if rec.metrics.get(key, 1.0) == 0.0
+        ]
+        if flags:
+            cell = f"{cell} [{','.join(flags)}]"
         if rec.superseded:
             # provenance, not a result: the number stays visible but can
             # never be quoted as a current measurement
