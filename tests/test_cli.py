@@ -246,12 +246,12 @@ class TestSweep:
         assert len(hier) == 2  # 2 dcn splits x 1 dtype
         meas = sweep.specs_for("measured", quick=True)
         assert {s.name.split(".")[0] for s in meas} == {"measured"}
-        # onesided + interop + 6 concurrency + 4 flash + 8 MFU-
+        # onesided + interop + 6 concurrency + 4 flash + 9 MFU-
         # push cells (3 flash block shapes + 1 flagship block shape +
-        # 2 compact-causal-grid fwd + compact grad + compact flagship)
-        # + 9 flagship (incl. the r3 remat/depth4/gqa/rope cells)
-        # + decode (mha + gqa + int8) + lm
-        assert len(meas) == 33
+        # 2 compact-causal-grid fwd + compact grad + compact flagship +
+        # compact x blocks composed) + 9 flagship (incl. the r3
+        # remat/depth4/gqa/rope cells) + decode (mha + gqa + int8) + lm
+        assert len(meas) == 34
         # every flash cell pins --devices to exactly 1 (any other world
         # would silently SKIP the cell and checkpoint it as passed)
         for s in meas:

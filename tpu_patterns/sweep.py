@@ -644,6 +644,21 @@ def measured_specs(quick: bool = False) -> list[SweepSpec]:
             env=env,
         )
     )
+    # ...and both levers composed: the compact grid cuts masked-tile
+    # DMAs, the (512, 1024) block shape deepens the p@v contraction —
+    # independent mechanisms, so the best single-chip flagship config
+    # is plausibly their product
+    specs.append(
+        SweepSpec(
+            name="measured.flagship.pallas_compact_bq512_bk1024",
+            argv=(
+                "flagship", "--attn", "pallas", "--devices", "1",
+                "--attn_grid", "compact",
+                "--block_q", "512", "--block_k", "1024", *flagship,
+            ),
+            env=env,
+        )
+    )
     for variant, extra, sizes in (
         ("xla", (), flagship),
         ("pallas", (), flagship),
