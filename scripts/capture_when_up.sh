@@ -45,8 +45,11 @@ while true; do
     timeout -k 30 120 python -m tpu_patterns sweep promote --out "$OUT/tune" >> "$OUT/tune.log" 2>&1
     echo "[$(date +%H:%M:%S)] promote done rc=$?"
     probe || { lost; continue; }
-    # 3. the full measured matrix (zero skipped-for-hardware)
-    timeout -k 30 7200 python -m tpu_patterns sweep measured --out "$OUT/measured" --resume --cell-timeout 600 >> "$OUT/measured.log" 2>&1
+    # 3. the full measured matrix (zero skipped-for-hardware).  12600 s:
+    # 34 cells x up to 600 s each don't fit the old 7200 cap even once —
+    # a long tunnel window must not be spent on an artificial stage
+    # restart (each cell is individually deadline-bounded regardless)
+    timeout -k 30 12600 python -m tpu_patterns sweep measured --out "$OUT/measured" --resume --cell-timeout 600 >> "$OUT/measured.log" 2>&1
     echo "[$(date +%H:%M:%S)] measured done rc=$?"
     probe || { lost; continue; }
     # 4. grad-gate re-derivation: 10 consecutive clean runs per config,
