@@ -705,6 +705,51 @@ class TestCompactCausalGridBackward:
         assert list(tab[0]) == [0, 0, 1, 1, 2, 3]
         assert list(tab[1]) == [0, 1, 0, 1, 1, 1]
 
+    @pytest.mark.parametrize("nq,nk,bq,bk", [
+        (1, 1, 16, 16), (1, 4, 64, 16), (4, 1, 16, 64), (8, 8, 16, 16),
+        (3, 5, 40, 24), (5, 3, 24, 40), (2, 8, 128, 32), (8, 2, 32, 128),
+        (7, 7, 16, 16), (1, 8, 256, 32),
+    ])
+    def test_pair_tables_exactly_cover_live_tiles(self, nq, nk, bq, bk):
+        # Both tables must enumerate EXACTLY the dense grids' live tiles
+        # (the pl.when predicate), each once, with one first and one
+        # last flag per row — for any block aspect, including ragged
+        # ones.  The compact grid's correctness is this property.
+        from tpu_patterns.longctx.flash import (
+            _causal_pair_table,
+            _causal_pair_table_kmajor,
+        )
+
+        live = {
+            (iq, ik)
+            for iq in range(nq)
+            for ik in range(nk)
+            if (iq + 1) * bq - 1 >= ik * bk  # the dense kernels' predicate
+        }
+        tq = _causal_pair_table(nq, nk, bq, bk)
+        tk = _causal_pair_table_kmajor(nq, nk, bq, bk)
+        assert {(q, k) for q, k in zip(tq[0], tq[1])} == live
+        assert tq.shape[1] == len(live)  # each exactly once
+        assert {(q, k) for k, q in zip(tk[0], tk[1])} == live
+        assert tk.shape[1] == len(live)
+        # per-row flags: exactly one first and one last per live q row
+        # (iq-major) / per live k row (jk-major), and the flagged pairs
+        # bound each row's ascending run
+        for tab, major in ((tq, 0), (tk, 0)):
+            rows = {}
+            for j in range(tab.shape[1]):
+                rows.setdefault(int(tab[major, j]), []).append(j)
+            for _, idxs in rows.items():
+                assert idxs == list(range(idxs[0], idxs[-1] + 1))  # contiguous
+                assert [int(tab[2, j]) for j in idxs].count(1) == 1
+                assert int(tab[2, idxs[0]]) == 1
+                assert [int(tab[3, j]) for j in idxs].count(1) == 1
+                assert int(tab[3, idxs[-1]]) == 1
+                # minor index ascends within the row (dense accumulation
+                # order — the bit-identity precondition)
+                minors = [int(tab[1, j]) for j in idxs]
+                assert minors == sorted(minors)
+
     def test_compact_grads_bit_identical_to_dense(self):
         from tpu_patterns.longctx.flash import flash_attention_diff
 
