@@ -283,11 +283,13 @@ def _cmd_longctx(args, writer: ResultWriter) -> None:
             f"seq {args.seq} not divisible by sp={n}",
         )
         return
-    if "ulysses" in strategies and args.heads % n:
+    if any(s.startswith("ulysses") for s in strategies) and args.heads % n:
         if args.strategy == "both":
-            # Only ulysses carries the heads % sp constraint; the other
-            # strategies still run and get measured.
-            strategies = tuple(s for s in strategies if s != "ulysses")
+            # Only the ulysses family carries the heads % sp constraint;
+            # the other strategies still run and get measured.
+            strategies = tuple(
+                s for s in strategies if not s.startswith("ulysses")
+            )
             writer.progress(
                 f"dropping ulysses: heads {args.heads} not divisible by sp={n}"
             )
@@ -876,7 +878,8 @@ def build_parser() -> argparse.ArgumentParser:
     lc.add_argument(
         "--strategy",
         choices=(
-            "ring", "ring_pallas", "ring_striped", "ulysses", "flash", "both"
+            "ring", "ring_pallas", "ring_striped", "ulysses",
+            "ulysses_pallas", "flash", "both"
         ),
         default="both",
         help="manual-ring vs library-collective lineage (≙ ring vs -a); "

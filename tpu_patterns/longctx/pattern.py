@@ -73,20 +73,36 @@ def ring_striped(q, k, v, axis_name=None, axis_size=1, causal=False, scale=None)
     )
 
 
+def ulysses_pallas(q, k, v, axis_name=None, axis_size=1, causal=False,
+                   scale=None, block_q=1024, block_k=1024,
+                   grid_mode="dense"):
+    """Ulysses with the fused flash kernel as the per-rank hot op — the
+    all-to-all flips sequence->heads, then each rank's full-sequence
+    attention runs the Mosaic fwd+bwd kernels (compact grids reach it
+    too: the post-collective view is the single-shard case)."""
+    return ulysses_attention(
+        q, k, v, axis_name, axis_size, causal=causal, scale=scale,
+        block_impl="pallas", block_q=block_q, block_k=block_k,
+        grid_mode=grid_mode,
+    )
+
+
 STRATEGIES = {
     "ring": ring_attention,
     "ring_pallas": ring_pallas,
     "ring_striped": ring_striped,
     "ulysses": ulysses_attention,
+    "ulysses_pallas": ulysses_pallas,
     "flash": flash_local,
 }
 # Strategies needing check_vma=False on the shard_map — applied ONLY in
 # interpret mode (the `vma = name not in VMA_OFF or not interp` gate), so
-# hardware runs always keep the varying-axes check.  flash: the Pallas HLO
-# interpreter's grid loop cannot track varying manual axes through its
-# dynamic_slice at multi-block shapes (>=2 grid steps, e.g. seq 512
-# non-causal on CPU); Mosaic on TPU has no such limitation.
-VMA_OFF: set[str] = {"flash"}
+# hardware runs always keep the varying-axes check.  flash (and ulysses'
+# pallas local op): the Pallas HLO interpreter's grid loop cannot track
+# varying manual axes through its dynamic_slice at multi-block shapes
+# (>=2 grid steps, e.g. seq 512 non-causal on CPU); Mosaic on TPU has no
+# such limitation.
+VMA_OFF: set[str] = {"flash", "ulysses_pallas"}
 # these expect shards in the striped token layout (r::sp)
 STRIPED = {"ring_striped"}
 
@@ -128,7 +144,7 @@ def _resolve_strategy(name: str, cfg: "LongCtxConfig", grad: bool = False):
     the stats-emitting forward and the dq/dk/dv backward all iterate the
     live-tile tables (flash.py::flash_block_bwd)."""
     strat = STRATEGIES[name]
-    if name == "flash":
+    if name in ("flash", "ulysses_pallas"):
         if cfg.causal_grid != "dense" and not cfg.causal:
             # the kernels silently fall back to the dense grid when
             # non-causal (there is nothing to compact) — a benchmark
@@ -292,12 +308,14 @@ GRAD_FLOP_MULT = 3.5
 # 4.5x — this covers "flash" AND "ring_pallas", whose custom-VJP second
 # ring calls flash_block_bwd per step (ring_attention.py:197).  The
 # XLA-autodiff strategies ("ring"/"ring_striped" with block_impl="xla",
-# "ulysses") save the per-chunk probabilities as residuals instead of
+# "ulysses" with the XLA local op) save the per-chunk probabilities
+# as residuals instead of
 # recomputing -> bwd 4 (dV, dP, dQ, dK) = 3.0x.  Records carry BOTH
 # rates: `tflops` is model FLOPs (cross-implementation comparable),
 # `tflops_hw` is silicon throughput (must never exceed chip peak — the
 # sanity check a model-FLOPs rate cannot provide).
-GRAD_HW_FLOP_MULT = {"flash": 4.5, "ring_pallas": 4.5}
+GRAD_HW_FLOP_MULT = {"flash": 4.5, "ring_pallas": 4.5,
+                     "ulysses_pallas": 4.5}
 GRAD_HW_FLOP_MULT_DEFAULT = 3.0
 
 
@@ -586,7 +604,9 @@ def run_longctx(
         raise ValueError("longctx expects a 1-D mesh (one sp axis)")
     if cfg.seq % sp != 0:
         raise ValueError(f"seq {cfg.seq} not divisible by sp={sp}")
-    if cfg.heads % sp != 0 and "ulysses" in cfg.strategies:
+    if cfg.heads % sp != 0 and any(
+        s.startswith("ulysses") for s in cfg.strategies
+    ):
         raise ValueError(f"heads {cfg.heads} not divisible by sp={sp} (ulysses)")
     if "flash" in cfg.strategies and sp != 1:
         raise ValueError("flash strategy is single-device (needs sp=1)")

@@ -33,14 +33,41 @@ def ulysses_attention(
     axis_size: int,
     causal: bool = False,
     scale: float | None = None,
+    block_impl: str = "xla",
+    block_q: int = 1024,
+    block_k: int = 1024,
+    grid_mode: str = "dense",
 ) -> jax.Array:
     """Exact attention via head re-sharding; call inside ``shard_map``.
 
     q, k, v: [L_local, H, D] sequence shards with H % axis_size == 0.
     Returns the [L_local, H, D] output shard.
+
+    ``block_impl="pallas"``: after the all-to-all each rank holds the
+    FULL sequence for its H/sp heads — exactly the fused kernel's
+    single-shard case (static zero offsets, Lq == Lk), so the hot op
+    becomes :func:`~..flash.flash_attention_diff` (fwd + fused backward,
+    O(L) memory, ``grid_mode="compact"`` live-tile grids for causal)
+    instead of the [H, L, L]-materializing XLA reference — the same
+    kernel-vs-XLA pairing ring attention gets from ``ring_pallas``.
     """
+    if block_impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown block_impl {block_impl!r}; want xla|pallas")
+
+    def local_attn(qf, kf, vf):
+        if block_impl == "pallas":
+            from tpu_patterns.longctx.flash import flash_attention_diff
+            from tpu_patterns.runtime import use_interpret
+
+            return flash_attention_diff(
+                qf, kf, vf, causal,
+                float(scale) if scale is not None else None,
+                block_q, block_k, use_interpret(), grid_mode,
+            )
+        return att.attention_reference(qf, kf, vf, causal=causal, scale=scale)
+
     if axis_size == 1:
-        return att.attention_reference(q, k, v, causal=causal, scale=scale)
+        return local_attn(q, k, v)
     h = q.shape[1]
     if h % axis_size != 0:
         raise ValueError(f"heads {h} not divisible by sp axis {axis_size}")
@@ -51,13 +78,7 @@ def ulysses_attention(
     def heads_to_seq(x):  # [L, H/sp, D] -> [L/sp, H, D]
         return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=1, tiled=True)
 
-    o = att.attention_reference(
-        seq_to_heads(q),
-        seq_to_heads(k),
-        seq_to_heads(v),
-        causal=causal,
-        scale=scale,
-    )
+    o = local_attn(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v))
     return heads_to_seq(o)
 
 

@@ -289,8 +289,9 @@ def longctx_specs(quick: bool = False) -> list[SweepSpec]:
         )
     )
     # backward cells: fwd+bwd measured with gradient gates (ulysses'
-    # backward is the all_to_all transpose — free from autodiff)
-    for strategy in ("ring", "ring_pallas", "ulysses"):
+    # backward is the all_to_all transpose — free from autodiff;
+    # ulysses_pallas runs the fused Mosaic fwd+bwd as its per-rank op)
+    for strategy in ("ring", "ring_pallas", "ulysses", "ulysses_pallas"):
         specs.append(
             SweepSpec(
                 name=f"longctx.grad.{strategy}",
