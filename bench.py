@@ -153,6 +153,101 @@ def last_metric_line(text: str) -> str | None:
     return None
 
 
+def banked_fallback(error_msg: str, search_dir: str | None = None) -> str | None:
+    """Driver-schema line from the newest banked in-window bench result.
+
+    A dead tunnel at snapshot time must not erase a same-round live
+    capture: BENCH_r04 said ``bench_error`` while 335.556 GB/s from that
+    round's 31-minute window sat in ``docs/measured/r4live/``.  The
+    capture ladder banks every bench pass as ``bench_{pre,post}_*.json``;
+    when the live measurement fails, the newest banked NUMBER is emitted
+    instead — with explicit staleness provenance (``stale``,
+    ``captured_at``, ``capture_commit``) plus the live failure detail, so
+    a stale number can never read as a clean live run (the reference's
+    contract is number-plus-verdict, never verdict-alone:
+    /root/reference/concurency/main.cpp:270,321).
+
+    Returns ``None`` when no banked record exists (the caller falls back
+    to the plain error line).  ``TPU_PATTERNS_BENCH_BANKED`` overrides the
+    search root (set it to an empty/missing dir to disable).
+    """
+    import datetime
+    import glob
+    import subprocess
+
+    root = search_dir if search_dir is not None else os.environ.get(
+        "TPU_PATTERNS_BENCH_BANKED",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "docs", "measured"),
+    )
+    if not root:  # TPU_PATTERNS_BENCH_BANKED="" means disabled, not cwd
+        return None
+
+    def capture_ts(path: str) -> float:
+        # The ladder stamps filenames bench_{pre,post}_YYYYmmdd_HHMMSS —
+        # the authoritative capture time (git checkouts reset mtimes, so
+        # a clone would otherwise date every banked record "today" and
+        # order same-tier records arbitrarily).  mtime is the fallback
+        # for hand-placed files.
+        stem = os.path.splitext(os.path.basename(path))[0]
+        try:
+            stamp = datetime.datetime.strptime(
+                "_".join(stem.split("_")[-2:]), "%Y%m%d_%H%M%S"
+            )
+            return stamp.replace(tzinfo=datetime.timezone.utc).timestamp()
+        except ValueError:
+            return os.path.getmtime(path)
+
+    candidates = []  # (clean, capture_ts, rec, path)
+    for path in glob.glob(os.path.join(root, "**", "bench_*.json"),
+                          recursive=True):
+        try:
+            with open(path) as f:
+                line = last_metric_line(f.read())
+            ts = capture_ts(path)
+        except OSError:  # deleted mid-scan (ladder rotating files)
+            continue
+        except UnicodeDecodeError:  # truncated by a SIGKILLed ladder stage
+            continue
+        if line is None:
+            continue
+        rec = json.loads(line)
+        value = rec.get("value")
+        if (
+            rec.get("metric") == "bench_error"
+            or rec.get("stale")  # never chain stale-on-stale provenance
+            or not isinstance(value, (int, float))
+            or not value > 0
+        ):
+            continue
+        # a clean record beats a salvaged one (quick-pass / teardown-hang
+        # lines carry an "error" annotation); within a tier, newest wins
+        candidates.append(("error" not in rec, ts, rec, path))
+    if not candidates:
+        return None
+    clean, ts, rec, path = max(candidates, key=lambda c: (c[0], c[1]))
+    if "error" in rec:
+        rec["banked_error"] = rec.pop("error")
+    rec["stale"] = True
+    rec["captured_at"] = datetime.datetime.fromtimestamp(
+        ts, datetime.timezone.utc
+    ).isoformat(timespec="seconds")
+    rec["capture_file"] = os.path.relpath(
+        path, os.path.dirname(os.path.abspath(__file__))
+    )
+    try:
+        commit = subprocess.run(
+            ["git", "log", "-1", "--format=%H", "--", path],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip()
+    except (OSError, subprocess.SubprocessError):
+        commit = ""
+    rec["capture_commit"] = commit or "uncommitted"
+    rec["error"] = error_msg
+    return json.dumps(rec)
+
+
 def _child_main() -> int:
     # Provisional quick pass first (seconds): its line is salvaged by the
     # parent if the full-size pass below hangs.  The parent forwards only
@@ -290,13 +385,11 @@ def main() -> int:
                 flush=True,
             )
         if not ok:
-            print(
-                error_line(
-                    f"preflight failed twice within {preflight_s}s each: "
-                    "device backend unreachable (hung tunnel?)"
-                ),
-                flush=True,
+            msg = (
+                f"preflight failed twice within {preflight_s}s each: "
+                "device backend unreachable (hung tunnel?)"
             )
+            print(banked_fallback(msg) or error_line(msg), flush=True)
             return 0
 
     def annotate_salvaged(line: str, quick_msg: str, full_msg: str) -> str:
@@ -352,6 +445,12 @@ def main() -> int:
                 f"child exited {proc.returncode} after this line; "
                 "crash after measurement; result salvaged",
             )
+    # Any error-only outcome (hang with nothing salvaged, child crash
+    # without a line, or a child-reported measurement error) defers to a
+    # banked in-window number before shipping an empty record.
+    rec = json.loads(out)
+    if rec.get("metric") == "bench_error":
+        out = banked_fallback(rec.get("error", "bench_error")) or out
     print(out, flush=True)
     return 0
 
