@@ -288,6 +288,65 @@ class TestSweep:
             sweep.specs_for("gates", quick=True)
         )
 
+    def test_measured_two_phase_ordering(self):
+        # VERDICT r4 next #3: phase 1 = every cell full-size at reps=2
+        # (the .fp twins), phase 2 = the refined matrix; a ~30-min window
+        # banks breadth before depth.
+        full = sweep.specs_for("measured")
+        fp = [s for s in full if s.name.endswith(".fp")]
+        refined = [s for s in full if not s.name.endswith(".fp")]
+        assert len(refined) == 34
+        # every cell with a repetition knob (--reps/--steps) gets a twin;
+        # interop + 3 decode cells have none and appear refined-only
+        assert len(fp) == 30
+        last_fp = max(
+            i for i, s in enumerate(full) if s.name.endswith(".fp")
+        )
+        first_ref = min(
+            i for i, s in enumerate(full) if not s.name.endswith(".fp")
+        )
+        assert last_fp < first_ref, "first-pass phase must fully precede"
+        by_name = {s.name: s for s in refined}
+        for s in fp:
+            base = by_name[s.name[: -len(".fp")]]
+            assert ("TPU_PATTERNS_SWEEP_TIER", "first_pass") in s.env
+            # full workload size: argv differs ONLY at the value slot
+            # after --reps/--steps (never a shape-bearing flag)
+            assert len(s.argv) == len(base.argv)
+            diffs = [
+                (i, a, b)
+                for i, (a, b) in enumerate(zip(base.argv, s.argv))
+                if a != b
+            ]
+            assert diffs, s.name
+            for i, a, b in diffs:
+                assert base.argv[i - 1] in ("--reps", "--steps"), s.name
+                assert b in ("2", "5")
+        # the headline pair leads phase 1, same priority order as refined
+        assert full[0].name in (
+            "measured.flagship_pallas.fp", "measured.flagship_xla.fp"
+        )
+        # the CI quick tier is already tiny: no twins there
+        assert not any(
+            s.name.endswith(".fp")
+            for s in sweep.specs_for("measured", quick=True)
+        )
+
+    def test_report_prefers_refined_over_first_pass(self):
+        from tpu_patterns.core.results import Record, prefer_refined
+
+        fp_env = {"TPU_PATTERNS_SWEEP_TIER": "first_pass"}
+        a_fp = Record(pattern="longctx", mode="flash", commands="L4096",
+                      metrics={"tflops": 100.0}, env=dict(fp_env))
+        a_ref = Record(pattern="longctx", mode="flash", commands="L4096",
+                       metrics={"tflops": 110.0})
+        b_fp = Record(pattern="longctx", mode="flash", commands="L8192",
+                      metrics={"tflops": 90.0}, env=dict(fp_env))
+        out = prefer_refined([a_fp, a_ref, b_fp])
+        # the refined record shadows its quick twin; an unshadowed quick
+        # record (breadth from a short window) still tabulates
+        assert a_ref in out and b_fp in out and a_fp not in out
+
     def test_promote_tuned_picks_best_cell_per_family(self, tmp_path):
         """`sweep promote` folds the winning chunks/block_rows of a tune
         run into a tuned.json that OneSidedConfig reads as defaults."""

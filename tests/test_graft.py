@@ -126,6 +126,9 @@ class TestBench:
         env["JAX_PLATFORMS"] = "cpu"
         env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
         env["TPU_PATTERNS_COUNT"] = "65536"  # small workload for CI
+        # fallback OFF: a broken measurement must FAIL here, not be
+        # masked by the repo's committed banked records
+        env["TPU_PATTERNS_BENCH_BANKED"] = "/nonexistent"
         if not watchdog:
             env["TPU_PATTERNS_BENCH_TIMEOUT"] = "0"
         proc = subprocess.run(
@@ -143,6 +146,7 @@ class TestBench:
         assert set(rec) >= {"metric", "value", "unit", "vs_baseline"}
         assert rec["metric"] != "bench_error", rec
         assert rec["value"] > 0
+        assert "stale" not in rec, "live run must not emit banked data"
 
     def test_last_metric_line_selection(self):
         # The parent's salvage helper must pick the LAST driver-schema

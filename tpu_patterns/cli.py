@@ -731,6 +731,7 @@ def _cmd_profilecheck(args, writer: ResultWriter) -> int:
 def _cmd_report(args, writer: ResultWriter) -> None:
     from tpu_patterns.core.results import (
         parse_log,
+        prefer_refined,
         stale_grad_records,
         tabulate_records,
     )
@@ -753,7 +754,8 @@ def _cmd_report(args, writer: ResultWriter) -> None:
                 file=sys.stderr,
             )
         raise SystemExit(2)
-    print(tabulate_records(records))
+    # a refined measurement supersedes its first-pass quick twin
+    print(tabulate_records(prefer_refined(records)))
 
 
 def build_parser() -> argparse.ArgumentParser:

@@ -71,6 +71,7 @@ _CONTEXT_ENV_VARS = (
     "JAX_DEFAULT_MATMUL_PRECISION",
     "JAX_ENABLE_COMPILATION_CACHE",
     "TPU_PATTERNS_SWEEP_CONFIG",
+    "TPU_PATTERNS_SWEEP_TIER",
 )
 
 
@@ -148,6 +149,31 @@ def stale_grad_records(records: Iterable[Record]) -> list[Record]:
         and r.timestamp < GRAD_ACCOUNTING_FIX_TS
         and not r.superseded
     ]
+
+
+def prefer_refined(records: Iterable[Record]) -> list[Record]:
+    """Drop first-pass-tier records shadowed by a refined record.
+
+    The measured sweep's two-phase ordering banks every cell at the
+    minimum repetition count first (records tagged
+    ``TPU_PATTERNS_SWEEP_TIER=first_pass`` in their env context), then
+    refines at full reps.  A refined record with the same
+    (pattern, mode, commands) key supersedes its quick twin in every
+    table.  An UNshadowed quick record still tabulates — breadth banked
+    in a short tunnel window is a result, just a provisional one, and
+    its tier rides visibly in the table's env key.
+    """
+
+    records = list(records)  # may be a generator; it is walked twice
+
+    def key(r: Record) -> tuple[str, str, str]:
+        return (r.pattern, r.mode, r.commands)
+
+    def is_fp(r: Record) -> bool:
+        return r.env.get("TPU_PATTERNS_SWEEP_TIER") == "first_pass"
+
+    refined = {key(r) for r in records if not is_fp(r)}
+    return [r for r in records if not is_fp(r) or key(r) not in refined]
 
 
 _VERDICT_RE = re.compile(
