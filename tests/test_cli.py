@@ -275,10 +275,15 @@ class TestSweep:
         # over its bench AND its command mixes)
         assert any(s.argv[0] == "concurrency" for s in rt)
         assert any(s.argv[0] == "flagship" for s in rt)
+        asym = sweep.specs_for("asymptote")
+        # 5 sizes + 3 chunk interpolants + 2 aliased-inplace cells
+        assert len(asym) == 10
+        assert any("inplace" in s.name for s in asym)
+        assert any("755MB" in s.name for s in asym)
         # 'all' must be exactly these suites, independently summed
         assert set(sweep.SUITES) == {
-            "p2p", "hier", "measured", "tune", "gates", "concurrency",
-            "runtime", "allreduce", "longctx", "parallel",
+            "p2p", "hier", "measured", "tune", "asymptote", "gates",
+            "concurrency", "runtime", "allreduce", "longctx", "parallel",
         }
         assert len(sweep.specs_for("all", quick=True)) == len(p2p) + len(
             con
@@ -286,7 +291,7 @@ class TestSweep:
             par
         ) + len(hier) + len(meas) + len(tune) + len(rt) + len(
             sweep.specs_for("gates", quick=True)
-        )
+        ) + len(sweep.specs_for("asymptote", quick=True))
 
     def test_measured_two_phase_ordering(self):
         # VERDICT r4 next #3: phase 1 = every cell full-size at reps=2
@@ -306,10 +311,16 @@ class TestSweep:
             i for i, s in enumerate(full) if not s.name.endswith(".fp")
         )
         assert last_fp < first_ref, "first-pass phase must fully precede"
+        # every cell carries its own config tag (collision-avoidance:
+        # sibling cells can emit identical record surfaces), and a .fp
+        # twin shares its refined cell's tag so supersede is cell-exact
+        for s in refined:
+            assert ("TPU_PATTERNS_SWEEP_CONFIG", s.name) in s.env
         by_name = {s.name: s for s in refined}
         for s in fp:
             base = by_name[s.name[: -len(".fp")]]
             assert ("TPU_PATTERNS_SWEEP_TIER", "first_pass") in s.env
+            assert ("TPU_PATTERNS_SWEEP_CONFIG", base.name) in s.env
             # full workload size: argv differs ONLY at the value slot
             # after --reps/--steps (never a shape-bearing flag)
             assert len(s.argv) == len(base.argv)
@@ -346,6 +357,27 @@ class TestSweep:
         # the refined record shadows its quick twin; an unshadowed quick
         # record (breadth from a short window) still tabulates
         assert a_ref in out and b_fp in out and a_fp not in out
+
+    def test_supersede_unit_is_the_cell(self):
+        from tpu_patterns.core.results import Record, prefer_refined
+
+        def rec(cell, commands, tier=None, v=1.0):
+            env = {"TPU_PATTERNS_SWEEP_CONFIG": cell}
+            if tier:
+                env["TPU_PATTERNS_SWEEP_TIER"] = tier
+            return Record(pattern="lm", mode="train", commands=commands,
+                          metrics={"steps_per_s": v}, env=env)
+
+        # lm-style: the tiers' record surfaces differ (steps count in
+        # commands) but share the cell tag -> still superseded
+        lm_fp = rec("measured.lm", "B8 steps5", tier="first_pass")
+        lm_ref = rec("measured.lm", "B8 steps20")
+        # sibling-cell style: IDENTICAL record surface, different cells
+        # -> the sibling's refined record must NOT retire this breadth
+        sib_fp = rec("measured.lm_lever", "B8 steps20",
+                     tier="first_pass", v=2.0)
+        out = prefer_refined([lm_fp, lm_ref, sib_fp])
+        assert lm_ref in out and sib_fp in out and lm_fp not in out
 
     def test_promote_tuned_picks_best_cell_per_family(self, tmp_path):
         """`sweep promote` folds the winning chunks/block_rows of a tune

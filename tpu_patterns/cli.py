@@ -788,10 +788,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--put-kernel",
-        choices=("auto", "streamed", "multi", "mono", "xla"),
+        choices=("auto", "streamed", "multi", "mono", "xla", "inplace"),
         default="auto",
         help="one_sided single-chip copy schedule (auto = measure "
-        "streamed, multi, and the XLA-scheduled rotation, then pick)",
+        "streamed, multi, the XLA-scheduled rotation, and the aliased "
+        "in-place put, then pick)",
     )
     # default=None so the promoted tuned.json defaults (resolved inside
     # OneSidedConfig) apply unless the flag is given explicitly

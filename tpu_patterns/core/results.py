@@ -157,17 +157,28 @@ def prefer_refined(records: Iterable[Record]) -> list[Record]:
     The measured sweep's two-phase ordering banks every cell at the
     minimum repetition count first (records tagged
     ``TPU_PATTERNS_SWEEP_TIER=first_pass`` in their env context), then
-    refines at full reps.  A refined record with the same
-    (pattern, mode, commands) key supersedes its quick twin in every
-    table.  An UNshadowed quick record still tabulates — breadth banked
-    in a short tunnel window is a result, just a provisional one, and
-    its tier rides visibly in the table's env key.
+    refines at full reps.  The supersede unit is the sweep CELL: both
+    tiers of a cell carry the same ``TPU_PATTERNS_SWEEP_CONFIG`` value
+    (the cell name), so one refined record retires every quick record
+    of ITS cell — and only its cell.  Keying on the record surface
+    instead would both under-shadow (the lm cell prints its steps count
+    inside ``commands``, so the tiers' records would never match) and
+    over-shadow (flash L4096 dense and its block-shape lever cells emit
+    identical record keys, so one refined sibling would silently retire
+    another cell's banked breadth).  Records without a cell tag fall
+    back to the (pattern, mode, commands) surface.  An UNshadowed quick
+    record still tabulates — breadth banked in a short tunnel window is
+    a result, just a provisional one, and its tier rides visibly in the
+    table's env key.
     """
 
     records = list(records)  # may be a generator; it is walked twice
 
-    def key(r: Record) -> tuple[str, str, str]:
-        return (r.pattern, r.mode, r.commands)
+    def key(r: Record) -> tuple:
+        cell = r.env.get("TPU_PATTERNS_SWEEP_CONFIG")
+        if cell:
+            return ("cell", cell)
+        return ("record", r.pattern, r.mode, r.commands)
 
     def is_fp(r: Record) -> bool:
         return r.env.get("TPU_PATTERNS_SWEEP_TIER") == "first_pass"

@@ -740,6 +740,15 @@ def measured_specs(quick: bool = False) -> list[SweepSpec]:
     # re-measures the onesided number at the top of every window anyway.
     # (The sort is stable, so in-group order — e.g. dense before its
     # compact twin — is preserved from construction order.)
+    # Per-cell config tags (the same collision-avoidance as tune/
+    # asymptote): distinct cells can emit records with identical
+    # (pattern, mode, commands) keys — flash L4096 dense vs its
+    # block-shape levers, say — so the report tables and the first-pass
+    # supersede logic key by the CELL, not the record surface.
+    specs = [
+        dataclasses.replace(s, env=(("TPU_PATTERNS_SWEEP_CONFIG", s.name),))
+        for s in specs
+    ]
     headline = {"measured.flagship_pallas", "measured.flagship_xla"}
     order = (
         ("measured.flagship", 1),  # lever/feature cells after their base
@@ -830,6 +839,68 @@ def tune_specs(quick: bool = False) -> list[SweepSpec]:
                     *base, "--put-kernel", "streamed",
                     "--block-rows", str(rows), *size,
                 ),
+                env=(("TPU_PATTERNS_SWEEP_CONFIG", name),),
+            )
+        )
+    return specs
+
+
+def asymptote_specs(quick: bool = False) -> list[SweepSpec]:
+    """Prove or break the ~335 GB/s HBM-copy ceiling (VERDICT r4 #6).
+
+    The r4 tune left streamed/multi/XLA plateauing within noise at
+    ~671 GB/s of HBM traffic, 82% of the v5e's 819 GB/s spec — which
+    *suggests* a platform ceiling but proves nothing.  Three probes:
+    (a) buffer-size asymptote: the winning multi schedule over
+    47..755 MB — a kernel-limited rate moves with buffer size, a
+    chip-limited one is flat once past the VMEM-residency scale;
+    (b) chunk counts 6/10/12 interpolating tune's 4/8/16 around the
+    chunks=8 peak; (c) the aliased in-place schedule — a genuinely
+    different discipline (half the live footprint, no second
+    allocation) rather than another parameterization of the same one.
+    """
+    base = ("p2p", "--transport", "one_sided", "--devices", "1")
+    reps = ("--reps", "2") if quick else ("--reps", "5")
+    specs = []
+    # 47/94/189/377/755 MB of f32 at the (count//512)-row layout; the
+    # default full-size cell (no --count) is 40 units = 188.7 MB
+    unit = 65536 if quick else 1179648 * 10
+
+    def size_label(mult: int) -> str:
+        # label from the ACTUAL buffer bytes, so the multi and inplace
+        # cells at the same --count carry the same size tag
+        return f"size{round(unit * mult * 4 / 1e6)}MB"
+
+    for mult in (1, 2) if quick else (1, 2, 4, 8, 16):
+        name = f"asymptote.multi.{size_label(mult)}"
+        specs.append(
+            SweepSpec(
+                name=name,
+                argv=(*base, "--put-kernel", "multi",
+                      "--count", str(unit * mult), *reps),
+                env=(("TPU_PATTERNS_SWEEP_CONFIG", name),),
+            )
+        )
+    for chunks in (6,) if quick else (6, 10, 12):
+        name = f"asymptote.multi.chunks{chunks}"
+        specs.append(
+            SweepSpec(
+                name=name,
+                argv=(*base, "--put-kernel", "multi",
+                      "--chunks", str(chunks),
+                      *(("--count", str(unit)) if quick else ()), *reps),
+                env=(("TPU_PATTERNS_SWEEP_CONFIG", name),),
+            )
+        )
+    inplace_cells = [("chunks8", ("--count", str(unit)) if quick else ())]
+    if not quick:  # the aliased schedule at the asymptote's far end too
+        inplace_cells.append((size_label(16), ("--count", str(unit * 16))))
+    for tag, extra in inplace_cells:
+        name = f"asymptote.inplace.{tag}"
+        specs.append(
+            SweepSpec(
+                name=name,
+                argv=(*base, "--put-kernel", "inplace", *extra, *reps),
                 env=(("TPU_PATTERNS_SWEEP_CONFIG", name),),
             )
         )
@@ -1140,6 +1211,7 @@ SUITES = {
     "hier": hier_specs,
     "measured": measured_specs,
     "tune": tune_specs,
+    "asymptote": asymptote_specs,
     "gates": gates_specs,
     "concurrency": concurrency_specs,
     "runtime": runtime_specs,
