@@ -379,6 +379,25 @@ class TestSweep:
         out = prefer_refined([lm_fp, lm_ref, sib_fp])
         assert lm_ref in out and sib_fp in out and lm_fp not in out
 
+    def test_partial_refined_cell_keeps_unmatched_fp_records(self):
+        # a multi-record cell whose refined run was slice-killed after
+        # flushing only its train record must NOT retire the first-pass
+        # generate record (no refined twin of it ever landed)
+        from tpu_patterns.core.results import Record, prefer_refined
+
+        def rec(mode, tier=None):
+            env = {"TPU_PATTERNS_SWEEP_CONFIG": "measured.lm"}
+            if tier:
+                env["TPU_PATTERNS_SWEEP_TIER"] = tier
+            return Record(pattern="lm", mode=mode, commands="B8",
+                          metrics={"v": 1.0}, env=env)
+
+        fp_train = rec("train", tier="first_pass")
+        fp_gen = rec("generate", tier="first_pass")
+        ref_train = rec("train")  # the only record the partial flush kept
+        out = prefer_refined([fp_train, fp_gen, ref_train])
+        assert ref_train in out and fp_gen in out and fp_train not in out
+
     def test_promote_tuned_picks_best_cell_per_family(self, tmp_path):
         """`sweep promote` folds the winning chunks/block_rows of a tune
         run into a tuned.json that OneSidedConfig reads as defaults."""

@@ -113,6 +113,27 @@ class TestLocalPutInplace:
             np.asarray(local_put_inplace(x, interpret=True)), np.asarray(x)
         )
 
+    def test_explicit_inplace_refuses_degenerate_rows(self, devices):
+        # rows < 2 makes the schedule an identity no-op (half == 0): an
+        # explicit request must raise, never record a 0-byte SUCCESS
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(devices[:1]), ("x",))
+        with pytest.raises(ValueError, match="inplace"):
+            run_onesided(
+                mesh, OneSidedConfig(count=512, reps=1, kernel="inplace")
+            )
+
+    def test_auto_skips_inplace_on_degenerate_rows(self, devices):
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(devices[:1]), ("x",))
+        (rec,) = run_onesided(
+            mesh, OneSidedConfig(count=512, reps=2, warmup=1)
+        )
+        assert rec.verdict is Verdict.SUCCESS, rec.notes
+        assert "bandwidth_GBps_inplace" not in rec.metrics
+
     def test_bytes_accounting_in_record(self, devices):
         # the record must credit the bytes the schedule MOVED (count/2-ish)
         from jax.sharding import Mesh

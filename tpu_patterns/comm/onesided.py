@@ -438,10 +438,20 @@ def run_onesided(
                 (ip_chunks * ip_half) / rows,
             ),
         }
+        # rows < 2 degenerates the inplace schedule to an identity no-op
+        # (half == 0): an explicitly requested kernel that cannot run
+        # must raise — recording a 0-byte "put" as SUCCESS would be a
+        # fabricated measurement — and auto must not even try it
+        if cfg.kernel == "inplace" and rows < 2:
+            raise ValueError(
+                f"kernel 'inplace' needs >= 2 rows (count >= 1024); "
+                f"count={cfg.count} gives rows={rows}"
+            )
         if cfg.kernel == "auto":
-            candidates = {
-                k: puts[k] for k in ("streamed", "multi", "xla", "inplace")
-            }
+            auto = ["streamed", "multi", "xla"]
+            if rows >= 2:
+                auto.append("inplace")
+            candidates = {k: puts[k] for k in auto}
         else:
             candidates = {cfg.kernel: puts[cfg.kernel]}
 

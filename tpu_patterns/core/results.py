@@ -157,19 +157,22 @@ def prefer_refined(records: Iterable[Record]) -> list[Record]:
     The measured sweep's two-phase ordering banks every cell at the
     minimum repetition count first (records tagged
     ``TPU_PATTERNS_SWEEP_TIER=first_pass`` in their env context), then
-    refines at full reps.  The supersede unit is the sweep CELL: both
-    tiers of a cell carry the same ``TPU_PATTERNS_SWEEP_CONFIG`` value
-    (the cell name), so one refined record retires every quick record
-    of ITS cell — and only its cell.  Keying on the record surface
-    instead would both under-shadow (the lm cell prints its steps count
-    inside ``commands``, so the tiers' records would never match) and
-    over-shadow (flash L4096 dense and its block-shape lever cells emit
-    identical record keys, so one refined sibling would silently retire
-    another cell's banked breadth).  Records without a cell tag fall
-    back to the (pattern, mode, commands) surface.  An UNshadowed quick
-    record still tabulates — breadth banked in a short tunnel window is
-    a result, just a provisional one, and its tier rides visibly in the
-    table's env key.
+    refines at full reps.  The supersede key is the sweep CELL (both
+    tiers of a cell carry the same ``TPU_PATTERNS_SWEEP_CONFIG`` value,
+    the cell name) PLUS the record's (pattern, mode) — but NOT its
+    ``commands``.  Each piece earns its place: commands is excluded
+    because the lm cell prints its steps count inside it, so the tiers'
+    records would never match; the cell tag is included because sibling
+    lever cells emit byte-identical record surfaces, so a surface key
+    would let one cell's refined record retire another cell's banked
+    breadth; and (pattern, mode) is included because a cell can emit
+    SEVERAL records and a slice-killed refined run may have flushed
+    only some of them — a cell-only key would let that partial flush
+    retire first-pass records whose refined twin never landed.  Records
+    without a cell tag fall back to the full (pattern, mode, commands)
+    surface.  An UNshadowed quick record still tabulates — breadth
+    banked in a short tunnel window is a result, just a provisional
+    one, and its tier rides visibly in the table's env key.
     """
 
     records = list(records)  # may be a generator; it is walked twice
@@ -177,7 +180,7 @@ def prefer_refined(records: Iterable[Record]) -> list[Record]:
     def key(r: Record) -> tuple:
         cell = r.env.get("TPU_PATTERNS_SWEEP_CONFIG")
         if cell:
-            return ("cell", cell)
+            return ("cell", cell, r.pattern, r.mode)
         return ("record", r.pattern, r.mode, r.commands)
 
     def is_fp(r: Record) -> bool:

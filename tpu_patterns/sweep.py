@@ -868,8 +868,13 @@ def asymptote_specs(quick: bool = False) -> list[SweepSpec]:
 
     def size_label(mult: int) -> str:
         # label from the ACTUAL buffer bytes, so the multi and inplace
-        # cells at the same --count carry the same size tag
-        return f"size{round(unit * mult * 4 / 1e6)}MB"
+        # cells at the same --count carry the same size tag; kB
+        # resolution for the sub-MB quick tier (a 0.26 MB buffer must
+        # not be tagged "size0MB")
+        nbytes = unit * mult * 4
+        if nbytes < 10_000_000:
+            return f"size{nbytes // 1000}KB"
+        return f"size{round(nbytes / 1e6)}MB"
 
     for mult in (1, 2) if quick else (1, 2, 4, 8, 16):
         name = f"asymptote.multi.{size_label(mult)}"
