@@ -12,6 +12,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpu_patterns.models.decode import (
     DecodeConfig,
     _CacheLayout,
+    _ragged_gate,
     _stacked_params,
     _stacked_specs,
     _teacher_forcing_gate,
@@ -454,77 +455,15 @@ class TestRagged:
         # lens[b] + n.  rope=True makes positions load-bearing; the
         # striped case additionally proves ragged masks/gathers against
         # the striped slot placement (rows' valid tokens scatter across
-        # ranks instead of filling them in order).
-        from tpu_patterns.models.transformer import forward_shard
-
+        # ranks instead of filling them in order).  One implementation
+        # of the invariant: the same _ragged_gate the multichip dryrun
+        # runs at its primary factorization.
         mesh = Mesh(
             np.array(devices[:8]).reshape(2, 2, 2), ("dp", "sp", "tp")
         )
-        cfg = ModelConfig(
-            **CFG, dtype="float32", causal=True, rope=rope,
-            attn_layout=layout,
+        assert _ragged_gate(
+            mesh, ModelConfig(depth=1, rope=rope, attn_layout=layout)
         )
-        b, lp, gen = 4, 16, 4
-        lens_np = np.array([16, 11, 8, 3], np.int32)
-        params = _stacked_params(jax.random.key(0), cfg)
-        flat = {k: v[0] for k, v in params.items()}
-        x = jax.random.normal(
-            jax.random.key(1), (b, lp + gen, cfg.embed), jnp.float32
-        )
-        # per-row reference: forward of the row's own contiguous stream
-        # (prompt tokens then the teacher-forced continuation tokens)
-        want = np.zeros((b, lp + gen, cfg.embed), np.float32)
-        for row in range(b):
-            ln = int(lens_np[row])
-            seq = jnp.concatenate(
-                [x[row, :ln], x[row, lp:lp + gen]], axis=0
-            )[None]
-            want[row, :ln + gen] = np.asarray(
-                forward_shard(flat, seq, cfg)
-            )[0]
-
-        prefill, generate = make_decoder(mesh, cfg, b, lp, gen)
-        sp_params = jax.device_put(
-            params,
-            {k: NamedSharding(mesh, s)
-             for k, s in _stacked_specs(cfg).items()},
-        )
-        xp_np = np.asarray(x[:, :lp])
-        if layout == "striped":
-            # the caller stripes (shard r holds tokens r::sp); padding
-            # stripes with everything else
-            sp = int(mesh.shape["sp"])
-            xp_np = np.concatenate(
-                [xp_np[:, r::sp] for r in range(sp)], axis=1
-            )
-        xp = jax.device_put(
-            xp_np, NamedSharding(mesh, P("dp", "sp", None))
-        )
-        lens = jax.device_put(
-            jnp.asarray(lens_np), NamedSharding(mesh, P("dp"))
-        )
-        caches, y0 = prefill(sp_params, xp, lens)
-        # y0 = each row's output at its own last valid position
-        for row in range(b):
-            np.testing.assert_allclose(
-                np.asarray(y0)[row, 0],
-                want[row, lens_np[row] - 1],
-                rtol=0, atol=1e-5,
-            )
-        c = caches
-        for n in range(gen):
-            tok = jax.device_put(
-                x[:, lp + n:lp + n + 1],
-                NamedSharding(mesh, P("dp", None, None)),
-            )
-            c, ys = generate(sp_params, c, tok, (lens, n), 1)
-            for row in range(b):
-                np.testing.assert_allclose(
-                    np.asarray(ys)[row, 0],
-                    want[row, lens_np[row] + n],
-                    rtol=0, atol=1e-5,
-                    err_msg=f"row {row} gen step {n}",
-                )
 
     def test_ragged_selffeeding_rollout_finite(self, devices):
         mesh = Mesh(

@@ -741,6 +741,94 @@ def run_decode(mesh: Mesh, cfg: DecodeConfig, writer) -> list:
     return [rec]
 
 
+def _ragged_gate(mesh: Mesh, big: ModelConfig) -> bool:
+    """Ragged (per-row prompt length) decode-vs-forward equivalence.
+
+    Rows with DIFFERENT true prompt lengths (right-padded to the cache's
+    prefill size): teacher-forced decode of row ``b`` at gen index ``n``
+    must equal the plain causal forward of that row's OWN unpadded
+    stream at position ``lens[b] + n``.  Run it with ``big.rope=True``
+    (the dryrun does) so absolute positions are load-bearing — an
+    off-by-one in ragged slot addressing shifts a rotary phase and
+    fails loudly rather than averaging out.  ``big.attn_layout`` is
+    honoured (striped raggedness scatters rows' valid tokens across
+    ranks); moe/GQA are forced OFF — the feature matrix belongs to
+    :func:`_teacher_forcing_gate`, this gate owns per-row lengths.
+    Probe shape scales with the mesh, and ``gen = 2*sp`` so every rank
+    writes at least TWO generation slots (slot index >= 1 exercises the
+    ``r*lg_loc + n//sp`` addressing a one-slot probe would never
+    touch).  The multichip dryrun runs this at its primary
+    factorization so the ragged path is driver-visible, not pytest-only
+    (VERDICT r4 next #7); the TestRagged pytests drive the same gate
+    across rope/layout combinations.
+    """
+    from tpu_patterns.models.transformer import forward_shard
+
+    dp = int(mesh.shape["dp"])
+    sp = int(mesh.shape["sp"])
+    tp = int(mesh.shape["tp"])
+    heads = 8 if 8 % tp == 0 else tp
+    b = 2 * dp
+    lp = 16 if 16 % sp == 0 else 4 * sp  # prefill must divide over sp
+    gen = 2 * sp  # divides over sp AND gives every rank >= 2 gen slots
+    cfg = dataclasses.replace(
+        big, embed=64, heads=heads, head_dim=8, depth=1, dtype="float32",
+        causal=True, moe=False, kv_heads=0,
+    )
+    params = _stacked_params(jax.random.key(21), cfg)
+    flat = {k: v[0] for k, v in params.items()}
+    x = jax.random.normal(
+        jax.random.key(22), (b, lp + gen, cfg.embed), jnp.float32
+    )
+    # distinct true lengths per row (raggedness is the thing under test)
+    lens_np = np.array([max(1, lp - 3 * i) for i in range(b)], np.int32)
+
+    # per-row reference: forward of the row's own contiguous stream
+    # (true prompt tokens, then the teacher-forced continuations)
+    want = np.zeros((b, lp + gen, cfg.embed), np.float32)
+    for row in range(b):
+        ln = int(lens_np[row])
+        seq = jnp.concatenate(
+            [x[row, :ln], x[row, lp:lp + gen]], axis=0
+        )[None]
+        want[row, :ln + gen] = np.asarray(forward_shard(flat, seq, cfg))[0]
+
+    prefill, generate = make_decoder(mesh, cfg, b, lp, gen)
+    sharded_params = jax.device_put(
+        params,
+        {k: NamedSharding(mesh, s) for k, s in _stacked_specs(cfg).items()},
+    )
+    xp = np.asarray(x[:, :lp])
+    if cfg.attn_layout == "striped":
+        from tpu_patterns.longctx.attention import stripe
+
+        xp = stripe(xp, sp, axis=1)
+    xs = jax.device_put(xp, NamedSharding(mesh, P("dp", "sp", None)))
+    lens = jax.device_put(jnp.asarray(lens_np), NamedSharding(mesh, P("dp")))
+    caches, y0 = prefill(sharded_params, xs, lens)
+    eps = 64 * np.finfo(np.float32).eps
+
+    def row_ok(got_row: np.ndarray, ref_row: np.ndarray) -> bool:
+        scale = max(1.0, float(np.abs(ref_row).max()))
+        return bool(np.abs(got_row - ref_row).max() <= eps * scale)
+
+    ok = all(
+        row_ok(np.asarray(y0)[row, 0], want[row, lens_np[row] - 1])
+        for row in range(b)
+    )
+    c = caches
+    for n in range(gen):
+        tok = jax.device_put(
+            x[:, lp + n:lp + n + 1], NamedSharding(mesh, P("dp", None, None))
+        )
+        c, ys = generate(sharded_params, c, tok, (lens, n), 1)
+        ok = ok and all(
+            row_ok(np.asarray(ys)[row, 0], want[row, lens_np[row] + n])
+            for row in range(b)
+        )
+    return ok
+
+
 def _teacher_forcing_gate(
     mesh: Mesh, big: ModelConfig, cache_int8: bool = False
 ) -> bool:
