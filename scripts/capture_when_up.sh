@@ -98,7 +98,7 @@ while true; do
       python bench.py > "$OUT/bench_pre_$(date -u +%Y%m%d_%H%M%S).json" 2>> "$OUT/bench.log"
     echo "[$(date -u +%H:%M:%S)] bench(pre) done: $(ls -t "$OUT"/bench_pre_*.json 2>/dev/null | head -1 | xargs tail -1 2>/dev/null | tail -c 300)"
     bank "bench(pre)"
-    # 2. the measured matrix: first-pass breadth tier (30 full-size
+    # 2. the measured matrix: first-pass breadth tier (31 full-size
     #    reps=2 cells, headline pair first) then the refined matrix —
     #    up to 16 slices ~ 8 h of ladder on a long window.  Slice
     #    exhaustion with the tunnel up (rc=2) proceeds down the ladder:

@@ -249,9 +249,10 @@ class TestSweep:
         # onesided + interop + 6 concurrency + 4 flash + 9 MFU-
         # push cells (3 flash block shapes + 1 flagship block shape +
         # 2 compact-causal-grid fwd + compact grad + compact flagship +
-        # compact x blocks composed) + 9 flagship (incl. the r3
-        # remat/depth4/gqa/rope cells) + decode (mha + gqa + int8) + lm
-        assert len(meas) == 34
+        # compact x blocks composed) + 10 flagship (incl. the r3
+        # remat/depth4/gqa/rope cells + the r5 remat_dots selective-
+        # checkpoint contrast) + decode (mha + gqa + int8) + lm
+        assert len(meas) == 35
         # every flash cell pins --devices to exactly 1 (any other world
         # would silently SKIP the cell and checkpoint it as passed)
         for s in meas:
@@ -300,10 +301,10 @@ class TestSweep:
         full = sweep.specs_for("measured")
         fp = [s for s in full if s.name.endswith(".fp")]
         refined = [s for s in full if not s.name.endswith(".fp")]
-        assert len(refined) == 34
+        assert len(refined) == 35
         # every cell with a repetition knob (--reps/--steps) gets a twin;
         # interop + 3 decode cells have none and appear refined-only
-        assert len(fp) == 30
+        assert len(fp) == 31
         last_fp = max(
             i for i, s in enumerate(full) if s.name.endswith(".fp")
         )

@@ -112,6 +112,70 @@ def test_deep_remat_same_math_less_memory(mesh3d, batch):
         assert temps[True] < temps[False], temps
 
 
+def test_remat_dots_policy_same_math_memory_between(mesh3d, batch):
+    """Selective (dots) checkpoint: identical loss to both neighbors,
+    compiled peak temp between full remat (saves nothing) and no remat
+    (saves everything) — the Megatron-style middle point."""
+    import dataclasses
+
+    from jax.sharding import NamedSharding
+
+    from tpu_patterns.models.transformer import _memory_metrics
+
+    sx = jax.device_put(batch, NamedSharding(mesh3d, P("dp", "sp", None)))
+    dcfg = dataclasses.replace(CFG, depth=4)
+    stacked = init_params(jax.random.key(8), dcfg)
+    temps, losses = {}, {}
+    for name, kw in (
+        ("none", dict(remat=False)),
+        ("dots", dict(remat=True, remat_policy="dots")),
+        ("full", dict(remat=True)),
+    ):
+        cfg = dataclasses.replace(dcfg, **kw)
+        step, _ = make_train_step(mesh3d, cfg, lr=1e-3)
+        p = shard_params(stacked, mesh3d, cfg)
+        _, losses[name] = step(p, sx)
+        temps[name] = _memory_metrics(step, p, sx).get("peak_temp_MB")
+    np.testing.assert_allclose(
+        float(losses["none"]), float(losses["dots"]), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        float(losses["none"]), float(losses["full"]), rtol=1e-6
+    )
+    if all(t is not None for t in temps.values()):
+        # saving the dot outputs can only cost memory vs saving nothing,
+        # and must still beat saving everything
+        assert temps["full"] <= temps["dots"] * 1.01, temps
+        assert temps["dots"] < temps["none"], temps
+
+
+def test_remat_policy_validated(mesh3d):
+    import dataclasses
+
+    with pytest.raises(ValueError, match="remat_policy"):
+        make_train_step(
+            mesh3d,
+            dataclasses.replace(CFG, remat=True, remat_policy="bogus"),
+            lr=1e-3,
+        )
+
+
+def test_flagship_flops_remat_accounting():
+    # dots recompute = attention only: strictly between 3x and 4x fwd
+    import dataclasses
+
+    from tpu_patterns.models.transformer import FlagshipConfig, flagship_flops
+
+    base = FlagshipConfig(seq=256, batch=2)
+    none = flagship_flops(base)
+    dots = flagship_flops(
+        dataclasses.replace(base, remat=True, remat_policy="dots")
+    )
+    full = flagship_flops(dataclasses.replace(base, remat=True))
+    assert none < dots < full
+    assert full == pytest.approx(none * 4 / 3)
+
+
 def test_pipeline_rejects_depth(mesh3d):
     import dataclasses
 

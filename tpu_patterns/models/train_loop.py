@@ -56,6 +56,7 @@ class TrainLoopConfig:
     attn: str = "xla"
     moe: bool = False
     remat: bool = False
+    remat_policy: str = "full"  # full | dots (ModelConfig.remat_policy)
     depth: int = 1
     kv_heads: int = 0  # GQA K/V heads (0 = MHA)
     rope: bool = False  # rotary position embeddings on q/k
@@ -92,6 +93,7 @@ def _model_cfg(cfg: TrainLoopConfig) -> ModelConfig:
         moe=cfg.moe,
         attn=cfg.attn,
         remat=cfg.remat,
+        remat_policy=cfg.remat_policy,
         depth=cfg.depth,
         kv_heads=cfg.kv_heads,
         rope=cfg.rope,

@@ -69,6 +69,16 @@ class ModelConfig:
     # The win scales with depth: the backward holds ONE live block's
     # activations instead of all ``depth`` of them.
     remat: bool = False
+    # Remat granularity (applies wherever remat=True applies): "full"
+    # saves nothing per block — max memory win, one whole extra forward
+    # of FLOPs; "dots" saves the batch-dim-free matmul outputs (qkv/out
+    # projections, MLP) and recomputes only the attention inner part +
+    # elementwise work (jax.checkpoint_policies.
+    # dots_with_no_batch_dims_saveable, the Megatron-style selective
+    # checkpoint) — most of the memory win at a fraction of the FLOPs
+    # tax, because the projections/MLP dots dominate recompute cost
+    # while the softmax stash dominates memory.
+    remat_policy: str = "full"
     # Number of stacked transformer blocks applied by lax.scan (params get
     # a leading [depth] axis).  depth=1 keeps the single-block layout.
     depth: int = 1
@@ -97,6 +107,15 @@ class ModelConfig:
     # causally live tiles in the fwd AND fused bwd kernels (masked
     # tiles' k/v DMAs never issue — longctx.flash pair tables).
     attn_grid: str = "dense"
+
+    def __post_init__(self):
+        # eager validation: a typo'd policy must fail at config build,
+        # not at first trace deep inside a jitted step
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"unknown remat_policy {self.remat_policy!r}; "
+                "want full|dots"
+            )
 
     @property
     def mlp_hidden(self) -> int:
@@ -400,6 +419,18 @@ def _moe_ffn(
     return (out * weight[:, None]).reshape(b, l, e)
 
 
+def _remat_wrap(cfg: ModelConfig):
+    """The jax.checkpoint wrapper for ``cfg.remat_policy`` (values are
+    validated in ModelConfig.__post_init__)."""
+    return {
+        "full": jax.checkpoint,
+        "dots": functools.partial(
+            jax.checkpoint,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        ),
+    }[cfg.remat_policy]
+
+
 def loss_shard(
     params: dict,
     x: jax.Array,
@@ -415,6 +446,7 @@ def loss_shard(
     def fwd(p, xb):
         return forward_shard(p, xb, cfg, **fwd_kw)
 
+    ck = _remat_wrap(cfg)
     if cfg.depth > 1:
         # Stacked blocks via scan over the leading [depth] param axis.
         # With remat, each scan step is checkpointed: the backward keeps
@@ -423,7 +455,7 @@ def loss_shard(
         def block(carry, layer):
             return fwd(layer, carry), None
 
-        body = jax.checkpoint(block) if cfg.remat else block
+        body = ck(block) if cfg.remat else block
 
         def fwd_full(p, xb):
             y, _ = lax.scan(body, xb, p)
@@ -431,7 +463,7 @@ def loss_shard(
 
     else:
         # single block: checkpoint drops its attn/hidden stash
-        fwd_full = jax.checkpoint(fwd) if cfg.remat else fwd
+        fwd_full = ck(fwd) if cfg.remat else fwd
     z = fwd_full(params, x)
     local = jnp.sum(z.astype(jnp.float32) ** 2)
     if axes:
@@ -804,6 +836,7 @@ class FlagshipConfig:
     # (sharded + moments pinned to host memory between steps)
     optimizer: str = "sgd"
     remat: bool = False  # jax.checkpoint each block (FLOPs for HBM)
+    remat_policy: str = "full"  # full | dots (see ModelConfig.remat_policy)
     depth: int = 1  # stacked blocks applied by lax.scan
     kv_heads: int = 0  # GQA K/V heads (0 = MHA)
     rope: bool = False  # rotary position embeddings on q/k
@@ -824,9 +857,27 @@ def flagship_flops(cfg: FlagshipConfig) -> float:
     attn = 4.0 * l * l * cfg.heads * cfg.head_dim * b / (2 if cfg.causal else 1)
     mlp = 4 * b * l * e * (e * cfg.mlp_mult)
     per_block = proj + attn + mlp
-    # fwd + bwd = 3x fwd; remat re-runs the forward once more per block
-    factor = 4.0 if cfg.remat else 3.0
-    return factor * per_block * cfg.depth
+    # fwd + bwd = 3x fwd.  Full remat re-runs the whole forward once
+    # more per block; the dots policy re-runs only the attention part
+    # (projection/MLP dot outputs are saved; the attention dots carry
+    # batch dims — or live inside the fused Pallas kernel — and are
+    # recomputed either way).  Explicit by-name accounting: an unknown
+    # policy must error here too, not silently bill as "full" (this
+    # function also takes duck-typed configs that skip ModelConfig's
+    # __post_init__ validation).
+    if not cfg.remat:
+        step_flops = 3.0 * per_block
+    else:
+        policy = getattr(cfg, "remat_policy", "full")
+        if policy == "dots":
+            step_flops = 3.0 * per_block + attn
+        elif policy == "full":
+            step_flops = 4.0 * per_block
+        else:
+            raise ValueError(
+                f"unknown remat_policy {policy!r}; want full|dots"
+            )
+    return step_flops * cfg.depth
 
 
 def _memory_metrics(jitted, *args) -> dict[str, float]:
@@ -863,6 +914,7 @@ def run_flagship(mesh: Mesh, cfg: FlagshipConfig, writer) -> list:
         attn=cfg.attn,
         attn_layout=cfg.attn_layout,
         remat=cfg.remat,
+        remat_policy=cfg.remat_policy,
         depth=cfg.depth,
         kv_heads=cfg.kv_heads,
         rope=cfg.rope,
@@ -990,7 +1042,11 @@ def run_flagship(mesh: Mesh, cfg: FlagshipConfig, writer) -> list:
         mode=cfg.attn
         + ("_moe" if cfg.moe else "")
         + (f"_{cfg.optimizer}" if cfg.optimizer != "sgd" else "")
-        + ("_remat" if cfg.remat else "")
+        + (
+            ("_remat" + ("" if cfg.remat_policy == "full" else
+                         f"_{cfg.remat_policy}"))
+            if cfg.remat else ""
+        )
         + (f"_d{cfg.depth}" if cfg.depth > 1 else ""),
         commands=f"dp{dp} sp{sp} tp{int(mesh.shape['tp'])} B{cfg.batch} "
         f"L{cfg.seq} E{cfg.embed} {cfg.dtype}"

@@ -671,6 +671,12 @@ def measured_specs(quick: bool = False) -> list[SweepSpec]:
         # not just CPU memory analysis), depth>1 (the scanned stack),
         # GQA, and rope
         ("pallas_remat", ("--remat", "true"), flagship),
+        # selective checkpoint (save dots, recompute attention): pairs
+        # against pallas_remat — most of full remat's memory win at a
+        # fraction of its FLOPs tax, so the measured contrast shows
+        # whether the recompute tax or the HBM relief dominates on chip
+        ("pallas_remat_dots",
+         ("--remat", "true", "--remat_policy", "dots"), flagship),
         ("pallas_depth4", ("--depth", "4"), flagship),
         ("pallas_gqa2", ("--kv_heads", "2"), flagship),
         ("pallas_rope", ("--rope", "true"), flagship),
