@@ -76,6 +76,99 @@ class TestTopology:
         assert "devices:" in topo.describe()
 
 
+class TestNativeTopologyCore:
+    """The C++ core (csrc/topo.cc) must agree byte-for-byte with the
+    Python implementation across topology shapes — same twin discipline
+    as the checksum/clock FFI modules (SURVEY.md §2.2 item 2)."""
+
+    def _topologies(self):
+        def grid(dims, cores=1):
+            devs, i = [], 0
+            def rec(prefix, rest):
+                nonlocal i
+                if not rest:
+                    for c in range(cores):
+                        devs.append(
+                            FakeDevice(id=i, coords=tuple(prefix),
+                                       core_on_chip=c)
+                        )
+                        i += 1
+                    return
+                for v in range(rest[0]):
+                    rec(prefix + [v], rest[1:])
+            rec([], list(dims))
+            return devs
+
+        return {
+            "2x2x2cores": fake_slice(),
+            "chain8": grid([8]),
+            "2x4": grid([2, 4]),
+            "2x2x2": grid([2, 2, 2]),
+            "4x1": grid([4, 1]),  # degenerate second axis
+            "single": grid([1]),
+            "3d_cores": grid([2, 2, 2], cores=2),
+        }
+
+    @pytest.fixture(autouse=True)
+    def _require_native(self):
+        from tpu_patterns.topo import native as topo_native
+
+        if topo_native.load() is None:
+            pytest.skip(
+                f"native topo core unavailable: {topo_native.load_error()}"
+            )
+
+    def test_planes_native_matches_python(self):
+        for name, devs in self._topologies().items():
+            topo = discover(devs)
+            py = topo.planes(impl="python")
+            cc = topo.planes(impl="native")
+            assert cc == py, f"{name}: native {cc} != python {py}"
+
+    def test_neighbors_native_matches_python(self):
+        for name, devs in self._topologies().items():
+            topo = discover(devs)
+            for d in topo.devices:
+                py = topo.neighbors(d.index, impl="python")
+                cc = topo.neighbors(d.index, impl="native")
+                assert cc == py, f"{name}[{d.index}]: {cc} != {py}"
+
+    def test_auto_prefers_native_and_agrees(self, monkeypatch):
+        topo = discover(fake_slice())
+        assert topo.planes() == topo.planes(impl="python")
+        # ...and auto really ROUTES to the native core (a silent
+        # fallback would make the assertion above vacuous)
+        from tpu_patterns.topo import native as topo_native
+
+        sentinel = [[99]]
+        monkeypatch.setattr(
+            topo_native, "planes_native", lambda devs: sentinel
+        )
+        assert topo.planes() is sentinel
+
+    def test_native_maps_positions_to_device_index(self):
+        # a hand-built Topology whose .index differs from list position:
+        # both impls must speak DeviceInfo.index, not positions
+        from tpu_patterns.topo.topology import DeviceInfo, Topology
+
+        devs = [
+            DeviceInfo(index=10 + p, id=p, process_index=0,
+                       platform="fake", coords=(c,), core_on_chip=0,
+                       synthetic_coords=False)
+            for p, c in enumerate(range(4))
+        ]
+        topo = Topology(devices=devs)
+        assert topo.planes(impl="native") == topo.planes(impl="python")
+        assert topo.planes(impl="native") == [[10, 11, 12, 13]]
+
+    def test_bad_impl_rejected(self):
+        topo = discover(fake_slice())
+        with pytest.raises(ValueError, match="impl"):
+            topo.planes(impl="cuda")
+        with pytest.raises(ValueError, match="impl"):
+            topo.neighbors(0, impl="cuda")
+
+
 class TestPlacement:
     def test_compact_fills_chip_first(self):
         topo = discover(fake_slice())

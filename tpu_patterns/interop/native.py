@@ -62,6 +62,42 @@ def build_shared_object(src_name: str, so_path: str) -> str | None:
     return None
 
 
+class LazyLib:
+    """Shared lazy build+dlopen scaffold for csrc/ native modules: make
+    on first use, cache the CDLL (or the failure), run ``configure``
+    once to set argtypes.  Third module in, the pattern graduated from
+    copy-paste to this helper — new bindings (topo/native.py) use it;
+    the two older modules keep their hand-rolled twins until a
+    behavioral change forces the migration."""
+
+    def __init__(self, src_name: str, so_path: str, configure):
+        self._src, self._so, self._configure = src_name, so_path, configure
+        self._lock = threading.Lock()
+        self._lib: ctypes.CDLL | None = None
+        self._error: str | None = None
+
+    def load(self) -> ctypes.CDLL | None:
+        with self._lock:
+            if self._lib is not None or self._error is not None:
+                return self._lib
+            err = build_shared_object(self._src, self._so)
+            if err is not None:
+                self._error = err
+                return None
+            try:
+                lib = ctypes.CDLL(self._so)
+            except OSError as e:
+                self._error = str(e)
+                return None
+            self._configure(lib)
+            self._lib = lib
+            return self._lib
+
+    @property
+    def error(self) -> str | None:
+        return self._error
+
+
 def _build() -> bool:
     global _build_error
     err = build_shared_object("tpu_patterns_ffi.cc", _SO)

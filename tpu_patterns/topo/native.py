@@ -1,0 +1,101 @@
+"""ctypes binding for the native topology core (csrc/topo.cc).
+
+SURVEY.md §2.2 item 2: the reference's fabric prober is native C++
+(p2p/topology.cpp); this is its TPU twin — union-find over implied ICI
+links — loaded lazily like the other native modules and verified
+byte-identical to the Python implementation by tests/test_topo.py.
+Absent toolchain -> the loaders return None and Topology falls back to
+Python (same contract as interop/native.py / io/loader.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+from tpu_patterns.interop.native import _BUILD, LazyLib
+
+_SO = os.path.join(_BUILD, "libtpu_patterns_topo.so")
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.tp_topo_planes.restype = ctypes.c_int32
+    lib.tp_topo_planes.argtypes = [
+        i32p, i32p, ctypes.c_int32, ctypes.c_int32,
+        i32p, i32p, ctypes.c_int32, ctypes.c_int32,
+    ]
+    lib.tp_topo_neighbors.restype = ctypes.c_int32
+    lib.tp_topo_neighbors.argtypes = [
+        i32p, i32p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        i32p, ctypes.c_int32,
+    ]
+
+
+_LIB = LazyLib("topo.cc", _SO, _configure)
+
+
+def load() -> ctypes.CDLL | None:
+    """Build (lazily) and load the topology core; None when unavailable."""
+    return _LIB.load()
+
+
+def load_error() -> str | None:
+    return _LIB.error
+
+
+def _pack(devices) -> tuple:
+    n = len(devices)
+    ndim = len(devices[0].coords)
+    coords = (ctypes.c_int32 * (n * ndim))()
+    cores = (ctypes.c_int32 * n)()
+    for i, d in enumerate(devices):
+        cores[i] = d.core_on_chip
+        for ax, c in enumerate(d.coords):
+            coords[i * ndim + ax] = c
+    return coords, cores, n, ndim
+
+
+def planes_native(devices) -> list[list[int]] | None:
+    """Rings via the C++ core; None when the module is unavailable.
+    Raises on a core-reported error (bad args/overflow) — a silent
+    None there would hide a real defect behind the Python fallback."""
+    lib = load()
+    if lib is None:
+        return None
+    coords, cores, n, ndim = _pack(devices)
+    cap_members = n * (ndim + 1)
+    cap_rings = n * ndim + 1
+    members = (ctypes.c_int32 * cap_members)()
+    offsets = (ctypes.c_int32 * (cap_rings + 1))()
+    rc = lib.tp_topo_planes(
+        coords, cores, n, ndim, members, offsets, cap_members, cap_rings
+    )
+    if rc < 0:
+        raise RuntimeError(
+            f"tp_topo_planes failed (rc={rc}) for n={n}, ndim={ndim}"
+        )
+    # the core speaks list positions; the Python twin returns
+    # DeviceInfo.index — map so parity holds even for a hand-built
+    # Topology whose index differs from position
+    return [
+        [devices[members[i]].index for i in range(offsets[r], offsets[r + 1])]
+        for r in range(rc)
+    ]
+
+
+def neighbors_native(devices, index: int) -> list[int] | None:
+    """One-hop ICI adjacency via the C++ core; None when unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    coords, cores, n, ndim = _pack(devices)
+    # ``index`` is a list position, same as the Python twin's
+    # ``self.devices[index]``; outputs map back to DeviceInfo.index
+    out = (ctypes.c_int32 * n)()
+    rc = lib.tp_topo_neighbors(coords, cores, n, ndim, index, out, n)
+    if rc < 0:
+        raise RuntimeError(
+            f"tp_topo_neighbors failed (rc={rc}) for n={n}, index={index}"
+        )
+    return sorted(devices[out[i]].index for i in range(rc))

@@ -88,13 +88,41 @@ class Topology:
             by_chip[d.chip_key] = by_chip.get(d.chip_key, 0) + 1
         return max(by_chip.values())
 
-    def planes(self) -> list[list[int]]:
+    @staticmethod
+    def _native_result(impl: str, fn_name: str, *args):
+        """One dispatch for every impl= method: validate, try the C++
+        core unless impl="python", raise when impl="native" demanded a
+        core that is unavailable, else None (caller runs Python)."""
+        if impl not in ("auto", "native", "python"):
+            raise ValueError(f"unknown impl {impl!r}; want auto|native|python")
+        if impl == "python":
+            return None
+        from tpu_patterns.topo import native as topo_native
+
+        out = getattr(topo_native, fn_name)(*args)
+        if out is None and impl == "native":
+            raise RuntimeError(
+                f"native topology core unavailable: "
+                f"{topo_native.load_error()}"
+            )
+        return out
+
+    def planes(self, impl: str = "auto") -> list[list[int]]:
         """ICI rings: for each torus axis with extent > 1, group devices that
         agree on every *other* coordinate.  Each group is a set of directly
         connected neighbors — the TPU analogue of a fully-port-connected
         Xe-Link plane (topology.cpp:76-89).  Returns device ``index`` lists,
         each sorted along the ring axis.
+
+        ``impl``: "auto" uses the native C++ core (csrc/topo.cc, the
+        union-find twin of the reference's plane merge) when it loads,
+        falling back to Python; "native"/"python" force one side — the
+        tests drive both on the same topologies and require identical
+        output.
         """
+        native = self._native_result(impl, "planes_native", self.devices)
+        if native is not None:
+            return native
         ndim = len(self.devices[0].coords)
         extents = self.torus_shape
         rings: list[list[int]] = []
@@ -127,8 +155,16 @@ class Topology:
         flat = self.flat()
         return flat[n % len(flat)]
 
-    def neighbors(self, index: int) -> list[int]:
-        """Device indices one ICI hop away (±1 along each axis, torus wrap)."""
+    def neighbors(self, index: int, impl: str = "auto") -> list[int]:
+        """Device indices one ICI hop away (±1 along each axis, torus wrap).
+
+        ``impl`` as in :meth:`planes`: auto prefers the C++ core.
+        """
+        native = self._native_result(
+            impl, "neighbors_native", self.devices, index
+        )
+        if native is not None:
+            return native
         me = self.devices[index]
         extents = self.torus_shape
         out = []
