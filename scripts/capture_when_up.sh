@@ -80,6 +80,18 @@ run_suite() {
     timeout -k 30 1800 python -m tpu_patterns sweep "$suite" \
       --out "$dir" --resume --cell-timeout "$ct" >> "$OUT/$suite.log" 2>&1
     echo "[$(date -u +%H:%M:%S)] $suite slice $i rc=$?"
+    # judge-facing markdown of everything banked so far (incl. the HBM
+    # ceiling analysis once asymptote size cells exist) — committed
+    # with the slice, so raw JSONL never lands without a readable
+    # table.  Write-then-move: a summarize timeout/crash must not
+    # truncate the previously banked good table.
+    if timeout -k 10 120 python -m tpu_patterns sweep summarize \
+        --out "$dir" > "$dir/summary.md.tmp" 2>> "$OUT/$suite.log"; then
+      mv "$dir/summary.md.tmp" "$dir/summary.md"
+    else
+      echo "[$(date -u +%H:%M:%S)] $suite summarize failed (kept old table)"
+      rm -f "$dir/summary.md.tmp"
+    fi
     bank "$suite slice $i"
     if suite_done "$dir" "$suite"; then
       echo "[$(date -u +%H:%M:%S)] $suite complete"

@@ -399,6 +399,78 @@ class TestSweep:
         out = prefer_refined([fp_train, fp_gen, ref_train])
         assert ref_train in out and fp_gen in out and fp_train not in out
 
+    def test_summarize_sweep(self, tmp_path):
+        # the watcher banks this markdown per slice: refined rows
+        # shadow their fp twins, and the asymptote size curve gets a
+        # ceiling verdict
+        from tpu_patterns.core.results import Record
+
+        def cell(name, pattern, mode, metrics, tier=None):
+            env = {"TPU_PATTERNS_SWEEP_CONFIG": name.removesuffix(".fp")}
+            if tier:
+                env["TPU_PATTERNS_SWEEP_TIER"] = tier
+            rec = Record(pattern=pattern, mode=mode, commands="x",
+                         metrics=metrics, env=env)
+            (tmp_path / f"{name}.jsonl").write_text(rec.to_json() + "\n")
+
+        cell("measured.flagship_pallas.fp", "flagship", "pallas",
+             {"tflops": 100.0}, tier="first_pass")
+        cell("measured.flagship_pallas", "flagship", "pallas",
+             {"tflops": 121.8})
+        # an UNshadowed first-pass cell: banked breadth must appear
+        cell("measured.flagship_xla.fp", "flagship", "xla",
+             {"tflops": 76.0}, tier="first_pass")
+        for mb, g in ((47, 334.0), (189, 335.2), (755, 333.5)):
+            cell(f"asymptote.multi.size{mb}MB", "onesided", "local_put",
+                 {"bandwidth_GBps": g})
+        # a --quick run's differently-named cells must still appear
+        cell("asymptote.multi.size262KB", "onesided", "local_put",
+             {"bandwidth_GBps": 3.0})
+        # a pre-accounting-fix grad record must be REFUSED (same rule
+        # as `report`), not quoted as a result
+        from tpu_patterns.core.results import GRAD_ACCOUNTING_FIX_TS
+
+        stale = Record(
+            pattern="longctx", mode="flash_grad", commands="x",
+            metrics={"tflops": 189.7},
+            env={"TPU_PATTERNS_SWEEP_CONFIG": "measured.flash_bf16_grad"},
+            timestamp=GRAD_ACCOUNTING_FIX_TS - 10,
+        )
+        (tmp_path / "measured.flash_bf16_grad.jsonl").write_text(
+            stale.to_json() + "\n"
+        )
+        md = sweep.summarize_sweep(str(tmp_path))
+        assert "| measured.flagship_pallas | pallas | tflops | 121.8 |" in md
+        assert "100" not in md.split("asymptote")[0]  # fp twin shadowed
+        assert "SUCCESS (first_pass)" in md  # unshadowed fp, tier visible
+        assert "platform-ceiling evidence" in md  # 0.5% spread over 16x
+        assert "r4 plateau" in md  # 335.2 does not beat 335.6
+        assert "size262KB" in md  # quick-tier cell names visible
+        assert "189.7" not in md and "refused 1 pre-accounting-fix" in md
+        # empty dir: honest emptiness, not a crash
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert "no cell records" in sweep.summarize_sweep(str(empty))
+
+    def test_summarize_flags_kernel_limited_and_beaten_plateau(
+        self, tmp_path
+    ):
+        from tpu_patterns.core.results import Record
+
+        for mb, g in ((47, 250.0), (189, 335.0), (755, 360.0)):
+            rec = Record(
+                pattern="onesided", mode="local_put", commands="x",
+                metrics={"bandwidth_GBps": g},
+                env={"TPU_PATTERNS_SWEEP_CONFIG":
+                     f"asymptote.multi.size{mb}MB"},
+            )
+            (tmp_path / f"asymptote.multi.size{mb}MB.jsonl").write_text(
+                rec.to_json() + "\n"
+            )
+        md = sweep.summarize_sweep(str(tmp_path))
+        assert "KERNEL-limited" in md
+        assert "BEATS the r4" in md  # 360 > 335.6
+
     def test_promote_tuned_picks_best_cell_per_family(self, tmp_path):
         """`sweep promote` folds the winning chunks/block_rows of a tune
         run into a tuned.json that OneSidedConfig reads as defaults."""

@@ -581,6 +581,13 @@ def _cmd_sweep(args, writer: ResultWriter) -> int:
         # the repo's own bite-guard discipline: a flag must never be
         # silently ignored
         raise SystemExit("--gates-dir applies to 'sweep promote' only")
+    if args.suite == "summarize":
+        if args.quick:
+            # summarize reads BOTH tiers' cell names already; accepting
+            # a flag that changes nothing would be a silent no-op
+            raise SystemExit("--quick does not apply to 'sweep summarize'")
+        print(sweep.summarize_sweep(args.out))
+        return 0
     if args.suite == "promote":
         # fold a completed `sweep tune --out <dir>` into the committed
         # OneSidedConfig defaults (comm/tuned.json), or — with
@@ -998,9 +1005,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     s.add_argument(
         "suite",
-        choices=(*SUITES, "all", "promote"),
+        choices=(*SUITES, "all", "promote", "summarize"),
         help="a sweep suite; 'promote' folds a finished tune run (--out "
-        "points at its directory) into the OneSidedConfig defaults",
+        "points at its directory) into the OneSidedConfig defaults; "
+        "'summarize' prints a markdown table of whatever cells have "
+        "records under --out (the capture watcher banks it per slice)",
     )
     s.add_argument("--out", default="results", help="log/JSONL directory")
     s.add_argument(

@@ -246,6 +246,26 @@ def parse_log(lines: Iterable[str]) -> list[Record]:
     return records
 
 
+# zero-valued gate metric -> human tag; ONE list shared by every table
+# renderer (report's tabulate + the capture watcher's summarize), so a
+# future fourth plausibility gate cannot flag in one and pass in the
+# other.
+_INTEGRITY_FLAG_TAGS = (
+    ("timing_converged", "NOISE-BOUND"),
+    ("hbm_plausible", "NOT-HBM"),
+    ("ici_plausible", "NOT-ICI"),
+)
+
+
+def integrity_flags(rec: Record) -> list[str]:
+    """Human-readable tags for every failed integrity gate on a record."""
+    return [
+        tag
+        for key, tag in _INTEGRITY_FLAG_TAGS
+        if rec.metrics.get(key, 1.0) == 0.0
+    ]
+
+
 def tabulate_records(records: list[Record]) -> str:
     """Render records as per-env tables: rows=commands, cols=modes.
 
@@ -263,15 +283,7 @@ def tabulate_records(records: list[Record]) -> str:
         # measurement-integrity flags ride with the number: a reader of
         # the table must see a noise-bound or implausible rate AS such,
         # not discover it three columns deep in the raw JSONL
-        flags = [
-            tag
-            for key, tag in (
-                ("timing_converged", "NOISE-BOUND"),
-                ("hbm_plausible", "NOT-HBM"),
-                ("ici_plausible", "NOT-ICI"),
-            )
-            if rec.metrics.get(key, 1.0) == 0.0
-        ]
+        flags = integrity_flags(rec)
         if flags:
             cell = f"{cell} [{','.join(flags)}]"
         if rec.superseded:
