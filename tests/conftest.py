@@ -19,6 +19,22 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", int(os.environ["TPU_PATTERNS_TEST_DEVICES"]))
 
 
+def load_root_module(name):
+    """Import a repo-root module (bench, __graft_entry__) by path —
+    they live outside the package, so the tests that exercise driver
+    contracts share this one loader instead of hand-rolling importlib
+    boilerplate per file."""
+    import importlib.util
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(root, f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",

@@ -324,11 +324,7 @@ def test_four_process_dryrun():
     reference's every-test-is-mpirun discipline applied to the driver's
     own correctness artifact).  The spawner raises with full worker logs
     on any failure."""
-    import importlib.util
+    from conftest import load_root_module
 
-    spec = importlib.util.spec_from_file_location(
-        "graft_entry", ROOT / "__graft_entry__.py"
-    )
-    graft = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(graft)
+    graft = load_root_module("__graft_entry__")
     graft.dryrun_multiprocess(n_processes=4, n_local=2, timeout=480.0)

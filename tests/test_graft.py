@@ -1,6 +1,5 @@
 """Driver contracts: __graft_entry__.entry / dryrun_multichip + bench.py."""
 
-import importlib.util
 import json
 import subprocess
 import sys
@@ -10,14 +9,9 @@ import jax
 import numpy as np
 import pytest
 
+from conftest import load_root_module as _load
+
 ROOT = Path(__file__).resolve().parent.parent
-
-
-def _load(name):
-    spec = importlib.util.spec_from_file_location(name, ROOT / f"{name}.py")
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
 
 
 class TestGraftEntry:

@@ -1,0 +1,136 @@
+"""Archive integrity audit: committed measurements obey TODAY's gates.
+
+The measurement-integrity tier (physics bounds, stale-grad refusal)
+landed AFTER the round-2 capture, so the committed archive predates the
+gates that would have vetted it (VERDICT r4 weak #3).  This audit
+applies the current gates retroactively to every record under
+``docs/measured/`` — and keeps applying them to whatever the capture
+watcher banks next, so a record stream that violates physics can never
+sit committed without CI saying so.
+
+Constants are the v5e tables from ``runtime.py`` (every committed
+capture ran on one TPU v5 lite chip); rows explicitly flagged
+implausible by their own capture are honest FAILURE evidence and are
+exempt from the bound they already report violating.
+"""
+
+import functools
+import glob
+import json
+import os
+
+import pytest
+
+from tpu_patterns.core.results import Record, stale_grad_records
+from tpu_patterns.runtime import (
+    HBM_SPEC_GBPS,
+    SPEC_PLAUSIBILITY_MARGIN,
+    _CHIP_PEAK_TFLOPS,
+)
+
+ROOT = os.path.join(os.path.dirname(__file__), "..", "docs", "measured")
+V5E_HBM = HBM_SPEC_GBPS["v5 lite"]
+V5E_PEAK_BF16 = _CHIP_PEAK_TFLOPS["v5 lite"]
+
+
+def _record_files():
+    return sorted(
+        p
+        for p in glob.glob(os.path.join(ROOT, "**", "*.jsonl"), recursive=True)
+        # sweep checkpoint state is {"cell": ...} bookkeeping, not
+        # Records — exact name only, so a future record stream with
+        # "state" in its name cannot silently escape the audit
+        if os.path.basename(p) != "sweep-state.jsonl"
+    )
+
+
+@functools.cache
+def _records():
+    out = []
+    for path in _record_files():
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                rec = Record.from_json(line)  # a torn line fails the audit
+                out.append((f"{os.path.relpath(path, ROOT)}:{lineno}", rec))
+    return out
+
+
+class TestMeasuredArchive:
+    def test_archive_exists_and_parses(self):
+        recs = _records()
+        assert len(recs) > 20, "archive unexpectedly empty"
+
+    def test_no_unmarked_pre_fix_grad_records(self):
+        # every grad rate captured before the FLOP-accounting fix must
+        # carry superseded=true — the same refusal report/summarize apply
+        stale = stale_grad_records(r for _, r in _records())
+        assert stale == [], [r.mode for r in stale]
+
+    def test_hbm_copy_rates_physically_plausible(self):
+        # a copy moves 2x its rate in HBM traffic; committed local_put
+        # rows must fit under the chip spec (+ calibration slack) unless
+        # the row itself flags the violation as its finding
+        bound = SPEC_PLAUSIBILITY_MARGIN * V5E_HBM
+        for where, r in _records():
+            if r.mode != "local_put":
+                continue
+            if r.metrics.get("hbm_plausible") == 0.0:
+                continue  # honest flagged evidence of the artifact class
+            for key, bw in r.metrics.items():
+                if key.startswith("bandwidth_GBps"):
+                    # a non-numeric metric is itself a schema violation
+                    # the audit must surface, not skip around
+                    assert isinstance(bw, (int, float)), f"{where}: {key}"
+                    assert 2.0 * bw <= bound, (
+                        f"{where}: {key}={bw:.1f} GB/s implies "
+                        f"{2 * bw:.0f} GB/s of HBM traffic > {bound:.0f}"
+                    )
+
+    def test_tflops_bounded_by_chip_peak(self):
+        # no committed rate may exceed what the MXU can issue; bf16 peak
+        # is the loosest honest bound (archive rows don't all carry
+        # their dtype, and an f32 row above the BF16 peak is just as
+        # impossible)
+        bound = SPEC_PLAUSIBILITY_MARGIN * V5E_PEAK_BF16
+        for where, r in _records():
+            for key in ("tflops", "tflops_hw"):
+                rate = r.metrics.get(key)
+                if rate is not None:
+                    assert rate <= bound, (
+                        f"{where}: {key}={rate:.1f} exceeds the v5e "
+                        f"{V5E_PEAK_BF16:g} TFLOP/s peak (+slack)"
+                    )
+
+    def test_speedups_bounded_by_theoretical(self):
+        # the concurrency harness's own contract: measured speedup can
+        # approach but not meaningfully exceed the theoretical maximum
+        for where, r in _records():
+            s = r.metrics.get("speedup")
+            t = r.metrics.get("theoretical_speedup")
+            if s is not None and t is not None:
+                assert s <= SPEC_PLAUSIBILITY_MARGIN * t, (
+                    f"{where}: speedup {s:.2f} > theoretical {t:.2f}"
+                )
+
+    def test_bench_files_parse_and_carry_schema(self):
+        # the banked bench_*.json files feed bench.py's stale fallback;
+        # a corrupt or schema-less one silently narrows that safety net
+        from conftest import load_root_module
+
+        bench = load_root_module("bench")
+        files = glob.glob(os.path.join(ROOT, "**", "bench_*.json"),
+                          recursive=True)
+        assert files, "no banked bench files"
+        good = 0
+        for path in files:
+            with open(path) as f:
+                line = bench.last_metric_line(f.read())
+            assert line is not None, f"{path}: no driver-schema line"
+            rec = json.loads(line)
+            assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
+            if rec["metric"] != "bench_error":
+                good += 1
+        assert good >= 1, "no numeric banked bench record in the archive"
