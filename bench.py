@@ -175,10 +175,9 @@ def banked_fallback(error_msg: str, search_dir: str | None = None) -> str | None
     import glob
     import subprocess
 
+    repo = os.path.dirname(os.path.abspath(__file__))
     root = search_dir if search_dir is not None else os.environ.get(
-        "TPU_PATTERNS_BENCH_BANKED",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     "docs", "measured"),
+        "TPU_PATTERNS_BENCH_BANKED", os.path.join(repo, "docs", "measured")
     )
     if not root:  # TPU_PATTERNS_BENCH_BANKED="" means disabled, not cwd
         return None
@@ -232,14 +231,11 @@ def banked_fallback(error_msg: str, search_dir: str | None = None) -> str | None
     rec["captured_at"] = datetime.datetime.fromtimestamp(
         ts, datetime.timezone.utc
     ).isoformat(timespec="seconds")
-    rec["capture_file"] = os.path.relpath(
-        path, os.path.dirname(os.path.abspath(__file__))
-    )
+    rec["capture_file"] = os.path.relpath(path, repo)
     try:
         commit = subprocess.run(
             ["git", "log", "-1", "--format=%H", "--", path],
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-            capture_output=True, text=True, timeout=10,
+            cwd=repo, capture_output=True, text=True, timeout=10,
         ).stdout.strip()
     except (OSError, subprocess.SubprocessError):
         commit = ""

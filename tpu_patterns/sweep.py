@@ -19,6 +19,11 @@ import sys
 from typing import Mapping, Sequence
 
 
+# Name suffix of a first-pass (reps-cut breadth tier) twin cell; the
+# base cell name is `name.removesuffix(FIRST_PASS_SUFFIX)`.
+FIRST_PASS_SUFFIX = ".fp"
+
+
 @dataclasses.dataclass(frozen=True)
 class SweepSpec:
     """One cell of a sweep matrix: a CLI invocation + env context."""
@@ -765,7 +770,7 @@ def measured_specs(quick: bool = False) -> list[SweepSpec]:
     )
 
     def _prio(s: SweepSpec) -> int:
-        base = s.name[:-3] if s.name.endswith(".fp") else s.name
+        base = s.name.removesuffix(FIRST_PASS_SUFFIX)
         if base in headline:
             return 0
         return next(
@@ -800,7 +805,7 @@ def measured_specs(quick: bool = False) -> list[SweepSpec]:
         first_pass.append(
             dataclasses.replace(
                 s,
-                name=s.name + ".fp",
+                name=s.name + FIRST_PASS_SUFFIX,
                 argv=tuple(argv),
                 env=s.env + (("TPU_PATTERNS_SWEEP_TIER", "first_pass"),),
             )
@@ -1285,6 +1290,7 @@ def summarize_sweep(out_dir: str) -> str:
     r4 plateau is called out (VERDICT r4 next #6's "Done" artifact).
     """
     from tpu_patterns.core.results import (
+        Verdict,
         integrity_flags,
         parse_log,
         prefer_refined,
@@ -1361,7 +1367,7 @@ def summarize_sweep(out_dir: str) -> str:
             if (
                 suite == "asymptote"
                 and gbps
-                and r.verdict.value == "SUCCESS"
+                and r.verdict is Verdict.SUCCESS
                 and "KB" not in name
                 # sub-MB quick-tier cells validate plumbing only: a
                 # buffer that can sit in VMEM must never feed the HBM
