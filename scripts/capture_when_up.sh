@@ -40,7 +40,8 @@ lost() {
 bank() {
   local paths="" p
   for p in docs/measured tests/fixtures tpu_patterns/comm/tuned.json \
-           tpu_patterns/longctx/gates_fit.json; do
+           tpu_patterns/longctx/gates_fit.json \
+           tpu_patterns/longctx/flash_tuned.json; do
     [ -e "$p" ] && paths="$paths $p"
   done
   [ -n "$paths" ] || return 0
@@ -117,7 +118,17 @@ while true; do
     #    breadth on gates/asymptote beats more depth here, and the
     #    completion check will route a healthy tunnel back anyway.
     run_suite measured "$OUT/measured" 600 16
-    [ $? -eq 1 ] && { lost; continue; }
+    m_rc=$?
+    [ "$m_rc" -eq 1 ] && { lost; continue; }
+    if [ "$m_rc" -eq 0 ]; then
+      # the MFU lever promotes itself: a measured block-shape win
+      # (lever cell beating the base beyond noise, converged both
+      # sides) becomes the shipped flash default without a builder
+      timeout -k 30 120 python -m tpu_patterns sweep promote \
+        --flash-dir "$OUT/measured" >> "$OUT/measured.log" 2>&1
+      echo "[$(date -u +%H:%M:%S)] flash promote rc=$?"
+      bank "flash block-shape promotion"
+    fi
     # 3. grad-gate re-derivation; promote ONLY a complete clean refit
     #    (promote_gates itself refuses a defect-flagged fit)
     run_suite gates "$OUT/gates" 420 6

@@ -471,6 +471,86 @@ class TestSweep:
         assert "KERNEL-limited" in md
         assert "BEATS the r4" in md  # 360 > 335.6
 
+    def _flagship_cell(self, tmp_path, name, tflops, converged=1.0,
+                       verdict="SUCCESS"):
+        import json
+
+        rec = {"pattern": "flagship", "mode": "pallas", "commands": "x",
+               "metrics": {"tflops": tflops,
+                           "timing_converged": converged},
+               "verdict": verdict}
+        (tmp_path / f"{name}.jsonl").write_text(json.dumps(rec) + "\n")
+
+    def test_promote_flash_win_becomes_default(self, tmp_path, monkeypatch):
+        import json
+
+        from tpu_patterns.models.transformer import ModelConfig
+
+        dest = tmp_path / "flash_tuned.json"
+        self._flagship_cell(tmp_path, "measured.flagship_pallas", 121.8)
+        self._flagship_cell(
+            tmp_path, "measured.flagship.pallas_bq512_bk1024", 130.0
+        )
+        tuned = sweep.promote_flash(str(tmp_path), dest=str(dest))
+        assert tuned["promoted"]
+        assert (tuned["block_q"], tuned["block_k"]) == (512, 1024)
+        assert json.loads(dest.read_text())["block_q"] == 512
+        # ...and ModelConfig resolves the promoted tier lazily
+        monkeypatch.setenv("TPU_PATTERNS_FLASH_TUNED", str(dest))
+        cfg = ModelConfig()
+        assert (cfg.block_q, cfg.block_k) == (512, 1024)
+        assert ModelConfig(block_q=2048).block_q == 2048  # explicit wins
+        monkeypatch.setenv("TPU_PATTERNS_FLASH_TUNED", "/dev/null")
+        assert ModelConfig().block_q == 1024  # absent tier -> hand-picked
+
+    def test_promote_flash_refusals(self, tmp_path):
+        # within the noise margin -> no promotion, nothing written
+        self._flagship_cell(tmp_path, "measured.flagship_pallas", 121.8)
+        self._flagship_cell(
+            tmp_path, "measured.flagship.pallas_bq512_bk1024", 122.5
+        )
+        dest = tmp_path / "flash_tuned.json"
+        out = sweep.promote_flash(str(tmp_path), dest=str(dest))
+        assert out["promoted"] is False and not dest.exists()
+        assert out["reason"] == "within noise margin"
+        # a noise-bound lever never qualifies, however fast: the only
+        # lever record is now unconverged -> no usable pair -> raise
+        self._flagship_cell(
+            tmp_path, "measured.flagship.pallas_bq512_bk1024", 150.0,
+            converged=0.0,
+        )
+        with pytest.raises(FileNotFoundError):
+            sweep.promote_flash(str(tmp_path), dest=str(dest))
+        assert not dest.exists()
+
+    def test_promote_flash_never_compares_across_tiers(self, tmp_path):
+        # refined lever vs first-pass-only base: the reps-tier bias can
+        # fabricate a >2% "win" — promotion must refuse the comparison
+        self._flagship_cell(
+            tmp_path, "measured.flagship_pallas.fp", 118.0
+        )
+        self._flagship_cell(
+            tmp_path, "measured.flagship.pallas_bq512_bk1024", 125.0
+        )
+        dest = tmp_path / "flash_tuned.json"
+        out = sweep.promote_flash(str(tmp_path), dest=str(dest))
+        assert out["promoted"] is False
+        assert out["reason"] == "tier mismatch"
+        assert not dest.exists()
+
+    def test_promote_flash_first_pass_fallback(self, tmp_path):
+        # refinement never landed: the fp twins carry the comparison,
+        # and the provenance records which tier each side came from
+        self._flagship_cell(tmp_path, "measured.flagship_pallas.fp", 100.0)
+        self._flagship_cell(
+            tmp_path, "measured.flagship.pallas_bq512_bk1024.fp", 110.0
+        )
+        dest = tmp_path / "flash_tuned.json"
+        tuned = sweep.promote_flash(str(tmp_path), dest=str(dest))
+        assert tuned["promoted"]
+        assert tuned["base_tier"] == "first_pass"
+        assert tuned["lever_tier"] == "first_pass"
+
     def test_promote_tuned_picks_best_cell_per_family(self, tmp_path):
         """`sweep promote` folds the winning chunks/block_rows of a tune
         run into a tuned.json that OneSidedConfig reads as defaults."""

@@ -581,6 +581,8 @@ def _cmd_sweep(args, writer: ResultWriter) -> int:
         # the repo's own bite-guard discipline: a flag must never be
         # silently ignored
         raise SystemExit("--gates-dir applies to 'sweep promote' only")
+    if args.flash_dir and args.suite != "promote":
+        raise SystemExit("--flash-dir applies to 'sweep promote' only")
     if args.suite == "summarize":
         if args.quick:
             # summarize reads BOTH tiers' cell names already; accepting
@@ -590,17 +592,27 @@ def _cmd_sweep(args, writer: ResultWriter) -> int:
         return 0
     if args.suite == "promote":
         # fold a completed `sweep tune --out <dir>` into the committed
-        # OneSidedConfig defaults (comm/tuned.json), or — with
-        # --gates-dir — a clean `sweep gates` refit into the committed
-        # grad-gate width (longctx/gates_fit.json)
+        # OneSidedConfig defaults (comm/tuned.json); with --gates-dir, a
+        # clean `sweep gates` refit into the committed grad-gate width
+        # (longctx/gates_fit.json); with --flash-dir, a measured
+        # flagship block-shape win into the flash defaults
+        # (longctx/flash_tuned.json)
+        picked = [d for d in (args.gates_dir, args.flash_dir) if d]
+        if picked and args.out != "results":
+            raise SystemExit(
+                "pass EXACTLY ONE of --out (tune), --gates-dir (gate "
+                "width), or --flash-dir (flash blocks)"
+            )
+        if len(picked) > 1:
+            raise SystemExit(
+                "pass EXACTLY ONE of --gates-dir or --flash-dir"
+            )
         if args.gates_dir:
-            if args.out != "results":  # non-default --out would be dropped
-                raise SystemExit(
-                    "pass EITHER --out (tune promotion) OR --gates-dir "
-                    "(gate-width promotion), not both"
-                )
             fit = sweep.promote_gates(args.gates_dir)
             print(f"# promoted gates fit: {fit}")
+        elif args.flash_dir:
+            tuned = sweep.promote_flash(args.flash_dir)
+            print(f"# flash promotion: {tuned}")
         else:
             tuned = sweep.promote_tuned(args.out)
             print(f"# promoted {tuned}")
@@ -1018,6 +1030,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="with 'promote': fold this finished `sweep gates` run into "
         "the committed grad-gate width (longctx/gates_fit.json) instead "
         "of promoting tune knobs",
+    )
+    s.add_argument(
+        "--flash-dir",
+        default=None,
+        help="with 'promote': fold this measured run's flagship "
+        "block-shape WIN (lever cell beating the base beyond noise, "
+        "converged timings both sides) into the shipped flash defaults "
+        "(longctx/flash_tuned.json)",
     )
     s.add_argument("--quick", action="store_true", help="tiny workloads")
     s.add_argument(

@@ -18,6 +18,7 @@ compute for those tiles is predicated off, the grid itself stays static.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -34,6 +35,33 @@ NEG_INF = -1e30
 # (estimate 13.1 MB) measurably fits and is the documented v5e sweet spot,
 # while 2048x2048 (estimate ~40 MB) measurably OOMs scoped VMEM.
 VMEM_BUDGET = 14 * 1024 * 1024
+
+
+# Hardware-promoted default block shape, written by
+# ``sweep promote --flash-dir`` from a completed measured run whose
+# flagship block-shape lever cell beat the base beyond noise
+# (sweep.py::promote_flash) — the flash twin of comm/tuned.json.
+# Absent file -> the hand-picked (1024, 1024); TPU_PATTERNS_FLASH_TUNED
+# overrides the path (=/dev/null disables).
+FLASH_TUNED_PATH = os.path.join(os.path.dirname(__file__),
+                                "flash_tuned.json")
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
+
+
+def load_tuned_blocks() -> tuple[int, int]:
+    """(block_q, block_k) defaults: the promoted winners when a
+    measured run committed them, the hand-picked squares otherwise."""
+    import json
+
+    path = os.environ.get("TPU_PATTERNS_FLASH_TUNED", FLASH_TUNED_PATH)
+    try:
+        with open(path) as f:
+            tuned = json.load(f)
+        return (int(tuned.get("block_q", DEFAULT_BLOCK_Q)),
+                int(tuned.get("block_k", DEFAULT_BLOCK_K)))
+    except (OSError, ValueError):
+        return (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
 
 
 def _vmem_estimate(bq: int, bk: int, d: int, in_bytes: int,

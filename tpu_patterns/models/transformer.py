@@ -100,9 +100,14 @@ class ModelConfig:
     # Flash-kernel VMEM tile shape on the single-chip fused path (the
     # MFU block-aspect lever; longctx.flash._auto_block still clamps to
     # the VMEM budget).  The multi-chip ring keeps kernel defaults — its
-    # per-shard lengths are already block-scale.
-    block_q: int = 1024
-    block_k: int = 1024
+    # per-shard lengths are already block-scale.  None resolves lazily
+    # in __post_init__ from the hardware-promoted tier
+    # (longctx/flash_tuned.json, written by `sweep promote --flash-dir`
+    # when a measured lever cell beat the base beyond noise) and falls
+    # back to the hand-picked squares — the same promoted-defaults
+    # discipline as OneSidedConfig's comm/tuned.json.
+    block_q: int | None = None
+    block_k: int | None = None
     # Causal-grid mode of the same path: "compact" iterates only the
     # causally live tiles in the fwd AND fused bwd kernels (masked
     # tiles' k/v DMAs never issue — longctx.flash pair tables).
@@ -116,6 +121,14 @@ class ModelConfig:
                 f"unknown remat_policy {self.remat_policy!r}; "
                 "want full|dots"
             )
+        if self.block_q is None or self.block_k is None:
+            from tpu_patterns.longctx.flash import load_tuned_blocks
+
+            bq, bk = load_tuned_blocks()
+            if self.block_q is None:
+                object.__setattr__(self, "block_q", bq)
+            if self.block_k is None:
+                object.__setattr__(self, "block_k", bk)
 
     @property
     def mlp_hidden(self) -> int:
@@ -826,9 +839,10 @@ class FlagshipConfig:
     causal: bool = True
     attn: str = "pallas"  # "xla" | "pallas"
     attn_layout: str = "contiguous"
-    # single-chip fused-attention tile shape (see ModelConfig.block_q)
-    block_q: int = 1024
-    block_k: int = 1024
+    # single-chip fused-attention tile shape; None defers to the
+    # promoted tier via ModelConfig (see ModelConfig.block_q)
+    block_q: int | None = None
+    block_k: int | None = None
     # causal-grid mode of the fused path (see ModelConfig.attn_grid)
     attn_grid: str = "dense"
     moe: bool = False

@@ -1187,6 +1187,124 @@ def promote_tuned(tune_dir: str, dest: str | None = None) -> dict:
     return tuned
 
 
+# flagship block-shape lever cells -> the (block_q, block_k) they pin;
+# promote_flash compares each against the base cell's defaults
+_FLASH_LEVER_CELLS = {
+    "measured.flagship.pallas_bq512_bk1024": (512, 1024),
+}
+_FLASH_BASE_CELL = "measured.flagship_pallas"
+# a lever must beat the base by more than the run-to-run noise floor
+# before its shape becomes the shipped default
+_FLASH_PROMOTE_MARGIN = 1.02
+
+
+def _flagship_cell_tflops(
+    measured_dir: str, cell: str
+) -> tuple[float, str] | None:
+    """(tflops, tier) of a measured flagship cell — refined record
+    preferred, first-pass twin accepted when refinement never landed;
+    None when no converged SUCCESS record exists.  Noise-bound records
+    never qualify: a default must not be institutionalized on a number
+    that never separated from the jitter floor."""
+    import json
+
+    for name, tier in ((cell, "refined"),
+                       (cell + FIRST_PASS_SUFFIX, "first_pass")):
+        try:
+            with open(os.path.join(measured_dir, name + ".jsonl")) as f:
+                lines = f.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            if not line.strip():
+                continue
+            rec = json.loads(line)
+            m = rec.get("metrics", {})
+            if (
+                rec.get("verdict") == "SUCCESS"
+                and m.get("tflops")
+                and m.get("timing_converged", 1.0) != 0.0
+            ):
+                return float(m["tflops"]), tier
+    return None
+
+
+def promote_flash(measured_dir: str, dest: str | None = None) -> dict:
+    """Fold a measured flagship block-shape WIN into the shipped
+    defaults (``longctx/flash_tuned.json``, read lazily by
+    ``ModelConfig.__post_init__``) — the flash twin of
+    :func:`promote_tuned`, run by the capture watcher after the
+    measured suite completes so the MFU lever promotes itself without
+    a builder in the loop.
+
+    Promotes only when a lever cell beat the base cell by more than
+    ``_FLASH_PROMOTE_MARGIN`` with CONVERGED timings on both sides;
+    returns ``{"promoted": False, ...}`` (without writing) when the
+    base stands.  Raises FileNotFoundError when the cell pair has no
+    usable records — promotion must never silently no-op.  The compact
+    causal grid is deliberately NOT promotable to a default: it is the
+    single-chip fused path only, and run_flagship refuses it at sp>1
+    rather than silently timing the dense ring (a default that crashes
+    multi-chip runs is not a default).
+
+    Note on resume sigs: promotion changes ModelConfig defaults but not
+    any cell's argv/env fingerprint, so already-completed base cells in
+    THIS capture dir keep their records; the next round's fresh dir
+    re-measures the base under the promoted defaults.
+    """
+    import json
+
+    base = _flagship_cell_tflops(measured_dir, _FLASH_BASE_CELL)
+    levers = {
+        cell: (_flagship_cell_tflops(measured_dir, cell), shape)
+        for cell, shape in _FLASH_LEVER_CELLS.items()
+    }
+    present = {c: (r, s) for c, (r, s) in levers.items() if r is not None}
+    if base is None or not present:
+        raise FileNotFoundError(
+            f"no converged flagship base+lever cell pair under "
+            f"{measured_dir} (base: {base}, levers: "
+            f"{sorted(_FLASH_LEVER_CELLS)})"
+        )
+    (base_tflops, base_tier) = base
+    best_cell, ((lever_tflops, lever_tier), shape) = max(
+        present.items(), key=lambda kv: kv[1][0][0]
+    )
+    out = {
+        "source": os.path.abspath(measured_dir),
+        "base_cell": _FLASH_BASE_CELL,
+        "base_tflops": base_tflops,
+        "base_tier": base_tier,
+        "lever_cell": best_cell,
+        "lever_tflops": lever_tflops,
+        "lever_tier": lever_tier,
+    }
+    if base_tier != lever_tier:
+        # a reps=2 first-pass number vs a reps=10 refined number: the
+        # min-over-reps tier bias alone can clear the margin — never
+        # promote across tiers
+        return {**out, "promoted": False, "reason": "tier mismatch"}
+    if lever_tflops <= _FLASH_PROMOTE_MARGIN * base_tflops:
+        return {**out, "promoted": False, "reason": "within noise margin"}
+    if dest is None:
+        from tpu_patterns.longctx.flash import FLASH_TUNED_PATH
+
+        dest = FLASH_TUNED_PATH
+    tuned = {**out, "promoted": True,
+             "block_q": shape[0], "block_k": shape[1]}
+    # tmp+rename: a SIGKILLed promotion must not leave a truncated file
+    # for the watcher to commit (load_tuned_blocks would silently fall
+    # back and the committed artifact would lie about what shipped)
+    tmp = dest + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(tuned, f, indent=1, sort_keys=True)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, dest)
+    return tuned
+
+
 def promote_gates(gates_dir: str, dest: str | None = None) -> dict:
     """Fold a clean ``sweep gates`` refit into the committed grad-gate
     width (``longctx/gates_fit.json``, read lazily by
