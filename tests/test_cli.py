@@ -405,21 +405,31 @@ class TestSweep:
         # ceiling verdict
         from tpu_patterns.core.results import Record
 
-        def cell(name, pattern, mode, metrics, tier=None):
+        FLAGSHIP_CMDS = "dp1 sp1 tp1 B2 L4096 E1024 bfloat16"
+
+        def cell(name, pattern, mode, metrics, tier=None, commands="x",
+                 config=None):
             env = {"TPU_PATTERNS_SWEEP_CONFIG": name.removesuffix(".fp")}
             if tier:
                 env["TPU_PATTERNS_SWEEP_TIER"] = tier
-            rec = Record(pattern=pattern, mode=mode, commands="x",
-                         metrics=metrics, env=env)
+            rec = Record(pattern=pattern, mode=mode, commands=commands,
+                         metrics=metrics, env=env, config=config or {})
             (tmp_path / f"{name}.jsonl").write_text(rec.to_json() + "\n")
 
         cell("measured.flagship_pallas.fp", "flagship", "pallas",
-             {"tflops": 100.0}, tier="first_pass")
+             {"tflops": 100.0}, tier="first_pass",
+             commands=FLAGSHIP_CMDS)
         cell("measured.flagship_pallas", "flagship", "pallas",
-             {"tflops": 121.8})
+             {"tflops": 121.8}, commands=FLAGSHIP_CMDS,
+             config={"device_kind": "TPU v5 lite"})
         # an UNshadowed first-pass cell: banked breadth must appear
         cell("measured.flagship_xla.fp", "flagship", "xla",
-             {"tflops": 76.0}, tier="first_pass")
+             {"tflops": 76.0}, tier="first_pass", commands=FLAGSHIP_CMDS)
+        # a block-shape lever beating the base: the MFU table must show
+        # the pair delta and the distance to the 70% bar
+        cell("measured.flagship.pallas_bq512_bk1024", "flagship",
+             "pallas", {"tflops": 130.0}, commands=FLAGSHIP_CMDS,
+             config={"device_kind": "TPU v5 lite"})
         for mb, g in ((47, 334.0), (189, 335.2), (755, 333.5)):
             cell(f"asymptote.multi.size{mb}MB", "onesided", "local_put",
                  {"bandwidth_GBps": g})
@@ -447,6 +457,14 @@ class TestSweep:
         assert "r4 plateau" in md  # 335.2 does not beat 335.6
         assert "size262KB" in md  # quick-tier cell names visible
         assert "189.7" not in md and "refused 1 pre-accounting-fix" in md
+        # the MFU analysis: lever delta vs base within the same tier,
+        # peak fraction, and the honest distance to the 70% bar —
+        # scored against the chip the records NAME (device_kind stamp)
+        assert "## Flagship MFU analysis (vs the TPU v5 lite 197" in md
+        assert "| measured.flagship.pallas_bq512_bk1024 | 130.0 | 66.0% | +6.7% | refined |" in md
+        assert "short of the 70% bar" in md  # 130 < 137.9
+        # the fp-tier xla cell shows but gets no cross-tier delta
+        assert "| measured.flagship_xla.fp | 76.0 | 38.6% | — | first_pass |" in md
         # empty dir: honest emptiness, not a crash
         empty = tmp_path / "empty"
         empty.mkdir()

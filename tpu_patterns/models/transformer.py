@@ -1075,6 +1075,14 @@ def run_flagship(mesh: Mesh, cfg: FlagshipConfig, writer) -> list:
             "checksum_ok": float(data_ok),
             **mem,
         },
+        # which silicon produced the rate: MFU claims downstream
+        # (sweep summarize) divide by THIS chip's peak, not an assumed
+        # one — a v5e table must not score v6e captures
+        config={
+            "device_kind": getattr(
+                jax.devices()[0], "device_kind", jax.devices()[0].platform
+            )
+        },
         verdict=Verdict.SUCCESS if (data_ok and perf_ok) else Verdict.FAILURE,
     )
     if not data_ok:
