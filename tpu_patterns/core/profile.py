@@ -192,6 +192,15 @@ _RULES: tuple[tuple[str, tuple[str, ...]], ...] = (
     # (copy, transpose, dynamic-update-slice) survives in the event name
     ("compute", ("fusion", "dynamic-update-slice", "transpose")),
     ("dma", ("copy", "dma", "memset")),
+    # Pallas/Mosaic kernels surface as custom calls in the trace — and
+    # they ARE this framework's hot compute ops (fused flash fwd/bwd,
+    # FMA busy-wait).  Without this rule a profiled flagship-pallas run
+    # books its own main kernel as "other" and fails the unclassified-
+    # time gate on first silicon contact (caught by a pre-capture
+    # dry-fire of the fixture tier).  Ordered AFTER the dma rule so a
+    # DMA-flavored kernel name (dma_overlap, async copy) keeps its
+    # engine bucket.
+    ("compute", ("custom-call", "custom_call", "mosaic", "pallas")),
     ("compute", (
         "dot", "conv", "matmul", "fma", "loop", "scan", "while",
         "reduce", "select", "add", "multiply", "exp", "iota", "broadcast",
