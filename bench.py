@@ -186,8 +186,11 @@ def banked_fallback(error_msg: str, search_dir: str | None = None) -> str | None
         # The ladder stamps filenames bench_{pre,post}_YYYYmmdd_HHMMSS —
         # the authoritative capture time (git checkouts reset mtimes, so
         # a clone would otherwise date every banked record "today" and
-        # order same-tier records arbitrarily).  mtime is the fallback
-        # for hand-placed files.
+        # order same-tier records arbitrarily).  Stamps are UTC by
+        # contract: the r5+ ladder uses `date -u`, and the r4 files were
+        # stamped on a UTC host; a hand-placed file stamped in another
+        # timezone would carry that offset into captured_at.  mtime is
+        # the fallback for stamp-less files.
         stem = os.path.splitext(os.path.basename(path))[0]
         try:
             stamp = datetime.datetime.strptime(

@@ -5,7 +5,7 @@
 #   nohup bash scripts/capture_when_up.sh > /tmp/capture_r5.log 2>&1 &
 #
 # r5 ladder (VERDICT r4 next #1/#3/#4/#5/#6):
-#   bench(pre) -> measured(64: first-pass breadth tier THEN the refined
+#   bench(pre) -> measured(66: 31 first-pass breadth twins THEN the 35
 #   matrix, in 30-min slices with probes between) -> gates(+promote) ->
 #   asymptote (HBM ceiling: size sweep + chunk interpolants + aliased
 #   inplace) -> runtime(+inertness guard) -> hlocheck -> profiled runs

@@ -432,10 +432,12 @@ class TestSweep:
              config={"device_kind": "TPU v5 lite"})
         for mb, g in ((47, 334.0), (189, 335.2), (755, 333.5)):
             cell(f"asymptote.multi.size{mb}MB", "onesided", "local_put",
-                 {"bandwidth_GBps": g})
-        # a --quick run's differently-named cells must still appear
+                 {"bandwidth_GBps": g, "bytes_per_put": mb * 1e6})
+        # a --quick run's differently-named cells must still appear —
+        # but their sub-MB bytes_per_put keeps them OUT of the ceiling
+        # verdict even at an absurd VMEM-resident rate
         cell("asymptote.multi.size262KB", "onesided", "local_put",
-             {"bandwidth_GBps": 3.0})
+             {"bandwidth_GBps": 99999.0, "bytes_per_put": 262144.0})
         # a pre-accounting-fix grad record must be REFUSED (same rule
         # as `report`), not quoted as a result
         from tpu_patterns.core.results import GRAD_ACCOUNTING_FIX_TS
@@ -478,7 +480,7 @@ class TestSweep:
         for mb, g in ((47, 250.0), (189, 335.0), (755, 360.0)):
             rec = Record(
                 pattern="onesided", mode="local_put", commands="x",
-                metrics={"bandwidth_GBps": g},
+                metrics={"bandwidth_GBps": g, "bytes_per_put": mb * 1e6},
                 env={"TPU_PATTERNS_SWEEP_CONFIG":
                      f"asymptote.multi.size{mb}MB"},
             )

@@ -584,10 +584,12 @@ def _cmd_sweep(args, writer: ResultWriter) -> int:
     if args.flash_dir and args.suite != "promote":
         raise SystemExit("--flash-dir applies to 'sweep promote' only")
     if args.suite == "summarize":
-        if args.quick:
-            # summarize reads BOTH tiers' cell names already; accepting
-            # a flag that changes nothing would be a silent no-op
-            raise SystemExit("--quick does not apply to 'sweep summarize'")
+        if args.quick or args.resume:
+            # summarize reads BOTH tiers' cell names and runs nothing;
+            # accepting flags that change nothing would be silent no-ops
+            raise SystemExit(
+                "--quick/--resume do not apply to 'sweep summarize'"
+            )
         print(sweep.summarize_sweep(args.out))
         return 0
     if args.suite == "promote":

@@ -76,6 +76,7 @@ def ring_put(x: jax.Array, axis: str, axis_size: int, interpret: bool = False):
     to a 1-D ring view first (see __graft_entry__.dryrun_multichip)."""
     return pl.pallas_call(
         functools.partial(_ring_put_kernel, axis, axis_size),
+        name="ring_put_remote_dma",
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
@@ -95,6 +96,7 @@ def local_put(x: jax.Array, interpret: bool = False):
     One monolithic HBM->HBM engine DMA — the minimal put-semantics demo."""
     return pl.pallas_call(
         _local_put_kernel,
+        name="local_put_dma",
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
@@ -152,6 +154,7 @@ def local_put_streamed(
     )
     return pl.pallas_call(
         _copy_block_kernel,
+        name="local_put_dma_streamed",
         grid=(rows // block_rows,),
         in_specs=[pl.BlockSpec((block_rows,) + x.shape[1:], lambda i: (i,) + (0,) * (x.ndim - 1))],
         out_specs=pl.BlockSpec((block_rows,) + x.shape[1:], lambda i: (i,) + (0,) * (x.ndim - 1)),
@@ -213,6 +216,7 @@ def local_put_inplace(x: jax.Array, chunks: int = 8, interpret: bool = False):
     n_chunks, chunk_rows, half = _inplace_plan(rows, chunks)
     return pl.pallas_call(
         functools.partial(_inplace_put_kernel, n_chunks, chunk_rows, half),
+        name="local_put_dma_inplace",
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
@@ -255,6 +259,7 @@ def local_put_multi(x: jax.Array, chunks: int = 8, interpret: bool = False):
     chunks = _largest_divisor_at_most(rows, chunks)
     return pl.pallas_call(
         functools.partial(_multi_put_kernel, chunks, rows // chunks),
+        name="local_put_dma_multi",
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),

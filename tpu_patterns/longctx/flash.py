@@ -49,6 +49,14 @@ DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 
 
+# (path, mtime) -> blocks: ModelConfig construction happens dozens of
+# times per process (every dataclasses.replace re-runs __post_init__),
+# so the tuned read is one stat + cache hit, not a JSON parse each time;
+# the mtime key keeps a same-process promotion (tests; the watcher
+# promotes cross-process) visible.
+_TUNED_CACHE: dict[tuple[str, float], tuple[int, int]] = {}
+
+
 def load_tuned_blocks() -> tuple[int, int]:
     """(block_q, block_k) defaults: the promoted winners when a
     measured run committed them, the hand-picked squares otherwise."""
@@ -56,12 +64,21 @@ def load_tuned_blocks() -> tuple[int, int]:
 
     path = os.environ.get("TPU_PATTERNS_FLASH_TUNED", FLASH_TUNED_PATH)
     try:
+        key = (path, os.path.getmtime(path))
+    except OSError:
+        return (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+    cached = _TUNED_CACHE.get(key)
+    if cached is not None:
+        return cached
+    try:
         with open(path) as f:
             tuned = json.load(f)
-        return (int(tuned.get("block_q", DEFAULT_BLOCK_Q)),
-                int(tuned.get("block_k", DEFAULT_BLOCK_K)))
+        blocks = (int(tuned.get("block_q", DEFAULT_BLOCK_Q)),
+                  int(tuned.get("block_k", DEFAULT_BLOCK_K)))
     except (OSError, ValueError):
-        return (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+        blocks = (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+    _TUNED_CACHE[key] = blocks
+    return blocks
 
 
 def _vmem_estimate(bq: int, bk: int, d: int, in_bytes: int,

@@ -1425,14 +1425,19 @@ def summarize_sweep(out_dir: str) -> str:
     for suite in SUITES:
         # both tiers' cell names: a --quick run banks under different
         # names (e.g. asymptote size262KB vs size47MB) and "whatever
-        # cells have records" means exactly that
-        specs = specs_for(suite)
-        names = {s.name for s in specs}
-        specs = specs + [
-            s for s in specs_for(suite, quick=True) if s.name not in names
+        # cells have records" means exactly that.  The completion ratio
+        # counts against the FULL tier only — quick-only extras must
+        # not inflate the denominator and make a complete capture read
+        # incomplete in its own completion artifact.
+        full_specs = specs_for(suite)
+        full_names = {s.name for s in full_specs}
+        specs = full_specs + [
+            s for s in specs_for(suite, quick=True)
+            if s.name not in full_names
         ]
         cell_records = []
         done = 0
+        quick_extras = 0
         for spec in specs:
             rec_lines: list[str] = []
             for ext in (".log", ".jsonl"):
@@ -1444,7 +1449,10 @@ def summarize_sweep(out_dir: str) -> str:
                     continue
             recs = [r for r in parse_log(rec_lines) if not r.superseded]
             if recs:
-                done += 1
+                if spec.name in full_names:
+                    done += 1
+                else:
+                    quick_extras += 1
                 cell_records.extend((spec.name, r) for r in recs)
         if not cell_records:
             continue
@@ -1459,7 +1467,11 @@ def summarize_sweep(out_dir: str) -> str:
             r for _, r in cell_records if id(r) not in refused
         )
         kept_ids = {id(r) for r in kept}
-        lines.append(f"## {suite} ({done}/{len(specs)} cells with records)")
+        lines.append(
+            f"## {suite} ({done}/{len(full_specs)} cells with records"
+            + (f", +{quick_extras} quick-tier" if quick_extras else "")
+            + ")"
+        )
         if refused:
             lines.append(
                 f"(refused {len(refused)} pre-accounting-fix grad "
@@ -1503,11 +1515,12 @@ def summarize_sweep(out_dir: str) -> str:
                 suite == "asymptote"
                 and gbps
                 and r.verdict is Verdict.SUCCESS
-                and "KB" not in name
-                # sub-MB quick-tier cells validate plumbing only: a
-                # buffer that can sit in VMEM must never feed the HBM
-                # ceiling verdict (the 103.5 TB/s lesson) — they still
-                # show in the table above, just not in the analysis
+                # small-buffer cells validate plumbing only: a buffer
+                # that can sit in VMEM must never feed the HBM ceiling
+                # verdict (the 103.5 TB/s lesson).  Gate on the bytes
+                # the record says it MOVED, not on a name tag — quick
+                # chunk/inplace cells carry no size in their names
+                and r.metrics.get("bytes_per_put", 0.0) >= 10_000_000
             ):
                 if best_hbm is None or gbps > best_hbm[0]:
                     best_hbm = (gbps, name)
