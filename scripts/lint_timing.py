@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Lint: all timing in ``tpu_patterns/`` goes through ``core/timing.py``.
+
+The suite's whole metrology rests on one clock discipline — monotonic
+``clock_ns()`` (native FFI when built, ``perf_counter_ns`` otherwise)
+for durations, ``wall_time_s()`` for provenance timestamps.  A stray
+``time.time()`` in a runner silently reintroduces wall-clock jumps into
+a duration (NTP steps, suspend/resume) and bypasses the native clock;
+a stray ``time.perf_counter()`` forks the epoch from every span and
+TimingResult around it.  This lint forbids both outside core/timing.py.
+
+Zero dependencies; exit 0 = clean, 1 = violations (printed as
+``path:line: text``).  Run directly or via CI (.github/workflows/ci.yml).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(ROOT, "tpu_patterns")
+
+# attribute access, with or without the call parens: catches
+# ``t = time.time()`` and ``default_factory=time.time`` alike
+_FORBIDDEN = re.compile(r"\btime\s*\.\s*(time|perf_counter(_ns)?)\b")
+
+# the clock discipline's own home — the ONLY file allowed to touch the
+# raw clocks
+_ALLOWED = {os.path.join("tpu_patterns", "core", "timing.py")}
+
+
+def lint() -> int:
+    violations: list[str] = []
+    for dirpath, dirnames, filenames in os.walk(PACKAGE):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, ROOT)
+            if rel in _ALLOWED:
+                continue
+            with open(path) as f:
+                for lineno, line in enumerate(f, start=1):
+                    if _FORBIDDEN.search(line):
+                        violations.append(
+                            f"{rel}:{lineno}: {line.strip()}"
+                        )
+    if violations:
+        print(
+            "bare time.time()/time.perf_counter() outside core/timing.py "
+            "— route durations through timing.clock_ns() and timestamps "
+            "through timing.wall_time_s():",
+            file=sys.stderr,
+        )
+        for v in violations:
+            print(f"  {v}", file=sys.stderr)
+        return 1
+    print("timing lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(lint())

@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Build the committed synthetic ``*.xplane.pb`` classifier fixture.
+
+VERDICT weak #6: the profile-fixture classifier tier skipped two rounds
+running because only the hardware ladder could produce op-name fixtures.
+This script hand-builds one from the wire format — the exact mirror of
+``core/profile.py``'s reader (XSpace: planes -> lines -> events, with
+per-plane event-metadata maps) — covering every classifier family the
+rules distinguish: compute fusions/dots, collectives, DMA copies,
+Pallas/Mosaic custom calls, infeed/outfeed, and a deliberately
+unclassifiable op held under the 20% ``other`` gate.
+
+Outputs (committed under tests/fixtures/):
+  synthetic.xplane.pb        the binary trace
+  op_names_synthetic.json    its {name -> count/duration/category}
+                             snapshot, derived THROUGH the reader +
+                             classifier so the drift-net test
+                             (tests/test_profile.py
+                             TestCommittedOpNameFixtures) starts green
+
+Regenerate after changing the encoder or the rule that books an op here:
+    python scripts/make_xplane_fixture.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+FIXDIR = os.path.join(ROOT, "tests", "fixtures")
+
+
+# -- protobuf wire-format writer (mirror of core/profile.py's reader) ------
+
+
+def varint(v: int) -> bytes:
+    out = b""
+    while True:
+        b7 = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b7 | 0x80])
+        else:
+            return out + bytes([b7])
+
+
+def field(num: int, wire: int, payload: bytes) -> bytes:
+    head = varint((num << 3) | wire)
+    if wire == 2:
+        return head + varint(len(payload)) + payload
+    return head + payload
+
+
+def msg(num: int, payload: bytes) -> bytes:
+    return field(num, 2, payload)
+
+
+def s(num: int, text: str) -> bytes:
+    return field(num, 2, text.encode())
+
+
+def i(num: int, v: int) -> bytes:
+    return field(num, 0, varint(v))
+
+
+def event(mid: int, off_ps: int, dur_ps: int) -> bytes:
+    # XEvent: metadata_id=1, offset_ps=2, duration_ps=3
+    return i(1, mid) + i(2, off_ps) + i(3, dur_ps)
+
+
+def event_meta(mid: int, name: str) -> bytes:
+    # XEventMetadata: id=1, name=2
+    return i(1, mid) + s(2, name)
+
+
+def plane(name: str, lines: list[bytes], metas: dict[int, str]) -> bytes:
+    # XPlane: id=1, name=2, lines=3, event_metadata map=4 (key=1, value=2)
+    meta_entries = b"".join(
+        msg(4, i(1, mid) + msg(2, event_meta(mid, mname)))
+        for mid, mname in metas.items()
+    )
+    return i(1, 7) + s(2, name) + b"".join(msg(3, ln) for ln in lines) + meta_entries
+
+
+def line(lid: int, name: str, ts_ns: int, events: list[bytes]) -> bytes:
+    # XLine: id=1, name=2, timestamp_ns=3, events=4
+    return i(1, lid) + s(2, name) + i(3, ts_ns) + b"".join(
+        msg(4, e) for e in events
+    )
+
+
+def space(planes: list[bytes]) -> bytes:
+    # XSpace: planes=1
+    return b"".join(msg(1, p) for p in planes)
+
+
+# -- the fixture's vocabulary: one op per classifier family, durations
+#    chosen so 'other' stays safely under the 20% busy-time gate ----------
+
+MS = 10**9  # ps per ms
+
+# (name, duration_ps) in timeline order; offsets are cumulative.
+OPS: list[tuple[str, int]] = [
+    # compute: fusions, dots, the fused-copy loop the r3 rules pin
+    ("fusion.42", 3 * MS),
+    ("dot.1", 2 * MS),
+    ("loop_copy_fusion.2", MS),
+    ("dynamic-update-slice-fusion.5", MS),
+    # collective: the ICI ops
+    ("all-reduce.3", 2 * MS),
+    ("reduce-scatter.7", MS),
+    ("all-gather.1", MS),
+    ("collective-permute-start.2", MS // 2),
+    # dma: copies and memsets on the copy engines
+    ("copy.5", MS),
+    ("copy-start.11", MS // 2),
+    ("memset.2", MS // 4),
+    # custom calls: Pallas/Mosaic kernels are this framework's hot
+    # compute ops; a DMA-flavored kernel keeps its engine bucket
+    ("tpu_custom_call.flash_fwd", 2 * MS),
+    ("mosaic_kernel.1", MS),
+    ("tpu_custom_call.dma_overlap", MS // 2),
+    # host transfer
+    ("outfeed", MS // 4),
+    # deliberately unclassifiable: must stay under the 20% other gate
+    ("zzz-unknown-op.9", MS // 2),
+]
+
+
+def build() -> bytes:
+    metas = {mid: name for mid, (name, _) in enumerate(OPS, start=1)}
+    events, off = [], 0
+    for mid, (_, dur) in enumerate(OPS, start=1):
+        events.append(event(mid, off, dur))
+        off += dur + MS // 10  # a small gap: idle time is real too
+    op_line = line(1, "XLA Ops", 1000, events)
+    # a Steps line that re-aggregates the whole window: the reader must
+    # skip it (summing it would double-count busy time)
+    steps_line = line(2, "Steps", 1000, [event(1, 0, off)])
+    tpu = plane("/device:TPU:0", [op_line, steps_line], metas)
+    host = plane(
+        "/host:CPU", [line(1, "python", 0, [event(1, 0, 123)])], {1: "python"}
+    )
+    return space([tpu, host])
+
+
+def main() -> int:
+    os.makedirs(FIXDIR, exist_ok=True)
+    pb_path = os.path.join(FIXDIR, "synthetic.xplane.pb")
+    with open(pb_path, "wb") as f:
+        f.write(build())
+
+    # Derive the op-name snapshot THROUGH the real reader + classifier:
+    # the committed categories cannot drift from the code that wrote them.
+    from tpu_patterns.core import profile as prof
+
+    names = prof.op_name_snapshot(FIXDIR)
+    assert names is not None, "reader found no device plane in the fixture"
+    missing = {n for n, _ in OPS} - set(names)
+    assert not missing, f"ops lost in the round trip: {missing}"
+    cats = {d["category"] for d in names.values()}
+    assert cats >= {"compute", "collective", "dma", "infeed_outfeed",
+                    "other"}, cats
+    total = sum(d["duration_ps"] for d in names.values())
+    other = sum(
+        d["duration_ps"] for d in names.values() if d["category"] == "other"
+    )
+    assert other / total <= 0.20, "fixture violates its own other-gate"
+
+    json_path = os.path.join(FIXDIR, "op_names_synthetic.json")
+    with open(json_path, "w") as f:
+        json.dump(names, f, indent=1, sort_keys=True)
+    print(f"wrote {pb_path} ({os.path.getsize(pb_path)} bytes)")
+    print(f"wrote {json_path} ({len(names)} ops, "
+          f"other={other / total:.1%} of busy)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

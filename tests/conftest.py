@@ -8,15 +8,28 @@ use so it also overrides the environment's TPU platform plugin.
 
 import os
 
+os.environ.setdefault("TPU_PATTERNS_TEST_DEVICES", "8")
+_N_DEVICES = os.environ["TPU_PATTERNS_TEST_DEVICES"]
+
 import numpy as np
 import pytest
-
-os.environ.setdefault("TPU_PATTERNS_TEST_DEVICES", "8")
 
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", int(os.environ["TPU_PATTERNS_TEST_DEVICES"]))
+# EXACTLY ONE device-count mechanism: newer JAX rejects the XLA flag and
+# jax_num_cpu_devices set together, older JAX only has the flag.  Both
+# work here because the flag is read at first backend init, which has
+# not happened yet (jax_platforms above would have raised otherwise).
+if hasattr(jax.config, "jax_num_cpu_devices"):
+    jax.config.update("jax_num_cpu_devices", int(_N_DEVICES))
+elif "--xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={_N_DEVICES}"
+    ).strip()
 
 
 def load_root_module(name):

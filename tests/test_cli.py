@@ -32,6 +32,9 @@ class TestParser:
             ["sweep", "p2p", "--quick"],
             ["report", "x.log"],
             ["hlocheck", "--seq", "1024", "--depth", "2"],
+            ["obs", "summarize"],
+            ["obs", "export", "--chrome-trace", "t.json", "--prom"],
+            ["doctor", "--watch_jsonl", "w.jsonl"],
         ):
             args = p.parse_args(argv)
             assert args.cmd == argv[0]

@@ -31,7 +31,13 @@ WORKER = textwrap.dedent(
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    if hasattr(jax.config, "jax_num_cpu_devices"):
+        jax.config.update("jax_num_cpu_devices", 2)
+    else:  # old JAX: the XLA flag, set before first backend init
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=2"
+        ).strip()
     jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
     import numpy as np
@@ -195,7 +201,13 @@ CKPT_WORKER = textwrap.dedent(
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    if hasattr(jax.config, "jax_num_cpu_devices"):
+        jax.config.update("jax_num_cpu_devices", 2)
+    else:  # old JAX: the XLA flag, set before first backend init
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=2"
+        ).strip()
     jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
     import numpy as np

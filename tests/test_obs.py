@@ -1,0 +1,417 @@
+"""Observability layer (tpu_patterns/obs): span nesting/threading, ring
+wraparound, watchdog hang diagnosis, metrics round trips, Chrome trace
+schema, and the CLI surface."""
+
+import json
+import math
+import os
+import threading
+import time
+
+import pytest
+
+from tpu_patterns import obs
+from tpu_patterns.obs import export as obs_export
+from tpu_patterns.obs import metrics as obs_metrics
+from tpu_patterns.obs import recorder as obs_recorder
+from tpu_patterns.obs import spans as obs_spans
+
+
+@pytest.fixture(autouse=True)
+def _isolated_obs(tmp_path):
+    """Each test gets a clean ring, registry, and run dir — obs state is
+    process-global by design (that is what makes it a flight recorder),
+    so tests must isolate explicitly."""
+    obs.flight_recorder().clear()
+    obs.metrics_registry().clear()
+    obs.configure(str(tmp_path))
+    obs.set_enabled(True)
+    yield
+    obs.flight_recorder().clear()
+    obs.metrics_registry().clear()
+    obs.configure(None)
+    obs.set_enabled(True)
+
+
+class TestSpans:
+    def test_nesting_records_depth_and_parent(self):
+        with obs.span("outer", a=1) as so:
+            with obs.span("middle") as sm:
+                with obs.span("inner"):
+                    pass
+        entries = {e["name"]: e for e in obs.flight_recorder().snapshot()}
+        assert entries["outer"]["depth"] == 0
+        assert entries["outer"]["parent_id"] == 0
+        assert entries["middle"]["depth"] == 1
+        assert entries["middle"]["parent_id"] == so.span_id
+        assert entries["inner"]["depth"] == 2
+        assert entries["inner"]["parent_id"] == sm.span_id
+        assert entries["outer"]["attrs"] == {"a": 1}
+        # innermost closes first: ring order is inner, middle, outer
+        assert [e["name"] for e in obs.flight_recorder().snapshot()] == [
+            "inner", "middle", "outer",
+        ]
+
+    def test_duration_on_the_monotonic_clock(self):
+        with obs.span("timed"):
+            time.sleep(0.02)
+        (entry,) = obs.flight_recorder().snapshot()
+        assert entry["dur_ns"] >= 15e6  # >= 15ms of the 20ms sleep
+
+    def test_exception_marks_the_span(self):
+        with pytest.raises(RuntimeError):
+            with obs.span("failing"):
+                raise RuntimeError("boom")
+        (entry,) = obs.flight_recorder().snapshot()
+        assert entry["error"] == "RuntimeError"
+
+    def test_threads_nest_independently(self):
+        """Two threads racing nested spans: each thread's stack is its
+        own — depths/parents never cross threads."""
+        barrier = threading.Barrier(2)
+
+        def work(tag):
+            barrier.wait()
+            for _ in range(20):
+                with obs.span(f"{tag}.outer") as so:
+                    with obs.span(f"{tag}.inner") as si:
+                        assert si.parent_id == so.span_id
+                        assert si.depth == 1
+
+        threads = [
+            threading.Thread(target=work, args=(t,), name=t)
+            for t in ("t1", "t2")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        entries = obs.flight_recorder().snapshot()
+        assert len(entries) == 80
+        by_id = {e["span_id"]: e for e in entries}
+        for e in entries:
+            if e["parent_id"]:
+                parent = by_id[e["parent_id"]]
+                assert parent["tid"] == e["tid"]  # parents never cross
+                assert parent["name"].split(".")[0] == e["name"].split(".")[0]
+
+    def test_event_records_instant(self):
+        with obs.span("ctx"):
+            obs.event("marker", step=3)
+        ev = [
+            e for e in obs.flight_recorder().snapshot()
+            if e["kind"] == "event"
+        ]
+        assert len(ev) == 1 and ev[0]["attrs"] == {"step": 3}
+        assert ev[0]["depth"] == 1  # nested under the open span
+
+    def test_disabled_is_a_shared_noop(self):
+        obs.set_enabled(False)
+        s1 = obs.span("a")
+        s2 = obs.span("b", deadline_s=1)
+        assert s1 is s2  # ONE shared object: no per-call allocation
+        with s1:
+            pass
+        obs.event("e")
+        assert len(obs.flight_recorder()) == 0
+        assert obs.metrics_registry().metrics() == []
+
+    def test_min_over_reps_unchanged_when_disabled(self):
+        """The acceptance bar: obs disabled -> the timing path records
+        nothing and the measurement result is structurally identical."""
+        from tpu_patterns.core import timing
+
+        obs.set_enabled(False)
+        res = timing.min_over_reps(
+            lambda: sum(range(100)), reps=3, warmup=1, barrier=None
+        )
+        assert len(res.times_ns) == 3
+        assert len(obs.flight_recorder()) == 0
+        obs.set_enabled(True)
+        res = timing.min_over_reps(
+            lambda: sum(range(100)), reps=3, warmup=1, barrier=None
+        )
+        assert len(res.times_ns) == 3
+        names = [e["name"] for e in obs.flight_recorder().snapshot()]
+        assert names == ["timing.min_over_reps"]
+
+
+class TestFlightRecorder:
+    def test_wraparound_keeps_newest(self):
+        r = obs_recorder.FlightRecorder(capacity=8)
+        for k in range(20):
+            r.append({"kind": "event", "name": f"e{k}"})
+        assert len(r) == 8
+        assert [e["name"] for e in r.snapshot()] == [
+            f"e{k}" for k in range(12, 20)
+        ]
+        assert r.dropped == 12
+
+    def test_dump_parses_back_with_meta_and_open_spans(self, tmp_path):
+        with obs.span("closed"):
+            pass
+        sp = obs.span("still-open", deadline_s=99)
+        sp.__enter__()
+        try:
+            path = obs.dump(str(tmp_path / "d.jsonl"), reason="unit test")
+        finally:
+            sp.__exit__(None, None, None)
+        lines = [json.loads(ln) for ln in open(path)]
+        assert lines[0]["kind"] == "meta"
+        assert lines[0]["reason"] == "unit test"
+        opens = [ln for ln in lines if ln.get("open")]
+        assert [o["name"] for o in opens] == ["still-open"]
+        assert opens[0]["deadline_ns"] == 99e9
+        # the loader skips meta and keeps both spans
+        entries = obs_export.load_entries(path)
+        assert {e["name"] for e in entries} == {"closed", "still-open"}
+
+
+class TestWatchdog:
+    def test_stalled_fake_collective_is_diagnosed_live(self, tmp_path):
+        """The ISSUE's acceptance criterion: a deliberately hung span (a
+        stalled fake collective) produces a flight-recorder dump + an
+        all-thread stack file in the run directory and a WARNING Record,
+        within the watchdog deadline (+ poll latency)."""
+        obs.configure(str(tmp_path))
+        before = set(obs.fired_dumps())
+        release = threading.Event()
+
+        def fake_collective():
+            with obs.span(
+                "comm.fake_collective", deadline_s=0.2, bytes=1 << 20
+            ):
+                release.wait(10)
+
+        t = threading.Thread(
+            target=fake_collective, name="fake-collective"
+        )
+        t.start()
+        try:
+            deadline = time.monotonic() + 6  # 0.2s deadline + poll slack
+            while (
+                set(obs.fired_dumps()) == before
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.05)
+            new = [p for p in obs.fired_dumps() if p not in before]
+            assert new, "watchdog never fired on the stalled span"
+        finally:
+            release.set()
+            t.join()
+        (ring_path,) = new
+        assert os.path.dirname(ring_path) == str(tmp_path)
+        # the dump parses back, and the hung span rides in it, open
+        lines = [json.loads(ln) for ln in open(ring_path)]
+        assert lines[0]["kind"] == "meta"
+        hung = [
+            ln
+            for ln in lines
+            if ln.get("open") and ln["name"] == "comm.fake_collective"
+        ]
+        assert hung and hung[0]["attrs"] == {"bytes": 1 << 20}
+        # the all-thread stack file names the stalled thread
+        stacks_path = ring_path.replace(".jsonl", "_stacks.txt")
+        assert os.path.exists(stacks_path)
+        stacks = open(stacks_path).read()
+        assert "fake-collective" in stacks and "fake_collective" in stacks
+        # the WARNING Record landed in the run dir's watchdog stream
+        from tpu_patterns.core.results import parse_log
+
+        with open(tmp_path / "watchdog.jsonl") as f:
+            (rec,) = parse_log(f.readlines())
+        assert rec.verdict.value == "WARNING"
+        assert rec.commands == "comm.fake_collective"
+        assert rec.metrics["deadline_s"] == pytest.approx(0.2)
+
+    def test_span_closing_in_time_never_fires(self):
+        before = len(obs.fired_dumps())
+        with obs.span("quick", deadline_s=30):
+            pass
+        time.sleep(1.2)  # two poll periods
+        assert len(obs.fired_dumps()) == before
+
+
+class TestMetrics:
+    def test_counter_gauge_histogram(self):
+        reg = obs_metrics.Registry()
+        reg.counter("c", help="a counter").inc()
+        reg.counter("c").inc(2)
+        reg.gauge("g", shard="0").set(1.5)
+        h = reg.histogram("h", buckets=(10, 100))
+        for v in (5, 50, 500):
+            h.observe(v)
+        assert reg.counter("c").value == 3
+        assert reg.gauge("g", shard="0").value == 1.5
+        assert h.cumulative() == [(10, 1), (100, 2), (math.inf, 3)]
+        assert h.sum == 555 and h.count == 3
+        with pytest.raises(ValueError):
+            reg.counter("c").inc(-1)
+
+    def test_labels_distinguish_series(self):
+        reg = obs_metrics.Registry()
+        reg.counter("c", k="a").inc()
+        reg.counter("c", k="b").inc(5)
+        assert reg.counter("c", k="a").value == 1
+        assert reg.counter("c", k="b").value == 5
+
+    def test_prom_text_round_trips(self):
+        reg = obs_metrics.Registry()
+        reg.counter("steps_total", help="steps run").inc(7)
+        reg.gauge("loss", optimizer="sgd").set(0.25)
+        h = reg.histogram("lat_ns", buckets=(1000, 1000000), span="x")
+        h.observe(500)
+        h.observe(2000)
+        text = reg.to_prom_text()
+        assert "# TYPE steps_total counter" in text
+        assert "# HELP steps_total steps run" in text
+        samples = obs.parse_prom_text(text)
+        assert samples[("steps_total", ())] == 7
+        assert samples[("loss", (("optimizer", "sgd"),))] == 0.25
+        assert samples[
+            ("lat_ns_bucket", (("span", "x"), ("le", "1000")))
+        ] == 1
+        assert samples[
+            ("lat_ns_bucket", (("span", "x"), ("le", "+Inf")))
+        ] == 2
+        assert samples[("lat_ns_sum", (("span", "x"),))] == 2500
+        assert samples[("lat_ns_count", (("span", "x"),))] == 2
+
+    def test_jsonl_round_trips_through_registry(self):
+        reg = obs_metrics.Registry()
+        reg.counter("c").inc(3)
+        reg.gauge("g").set(-1.25)
+        h = reg.histogram("h", buckets=(10, 100))
+        h.observe(5)
+        h.observe(50)
+        back = obs_metrics.registry_from_jsonl(
+            reg.to_jsonl().splitlines()
+        )
+        assert back.to_prom_text() == reg.to_prom_text()
+
+    def test_span_layer_feeds_the_registry(self):
+        with obs.span("fed"):
+            pass
+        h = obs.metrics_registry().histogram(
+            "tpu_patterns_span_duration_ns", span="fed"
+        )
+        assert h.count == 1
+
+
+class TestChromeTrace:
+    def test_schema_and_ordering(self, tmp_path):
+        with obs.span("outer", bytes=42):
+            with obs.span("inner"):
+                pass
+            obs.event("mark")
+        path = obs.dump(str(tmp_path / "s.jsonl"))
+        trace = obs_export.chrome_trace(obs_export.load_entries(path))
+        assert set(trace) == {"traceEvents", "displayTimeUnit"}
+        evs = trace["traceEvents"]
+        assert len(evs) == 3
+        for ev in evs:
+            # required trace_event fields
+            assert {"name", "ph", "ts", "pid", "tid"} <= set(ev)
+            assert ev["ph"] in ("X", "i")
+            assert isinstance(ev["ts"], float)
+            if ev["ph"] == "X":
+                assert "dur" in ev
+            else:
+                assert ev["s"] == "t"
+        assert [e["ts"] for e in evs] == sorted(e["ts"] for e in evs)
+        outer = next(e for e in evs if e["name"] == "outer")
+        assert outer["args"] == {"bytes": 42}
+        json.dumps(trace)  # must be valid JSON end to end
+
+    def test_write_chrome_trace(self, tmp_path):
+        with obs.span("s"):
+            pass
+        src = obs.dump(str(tmp_path / "s.jsonl"))
+        out = obs_export.write_chrome_trace(
+            obs_export.load_entries(src), str(tmp_path / "t.json")
+        )
+        assert json.load(open(out))["traceEvents"]
+
+
+class TestSummaries:
+    def test_span_stats_aggregates(self):
+        entries = [
+            {"kind": "span", "name": "a", "dur_ns": 2e6},
+            {"kind": "span", "name": "a", "dur_ns": 4e6},
+            {"kind": "span", "name": "b", "dur_ns": 1e6, "open": True},
+            {"kind": "event", "name": "e"},
+        ]
+        stats = obs_export.span_stats(entries)
+        assert stats["a"]["count"] == 2
+        assert stats["a"]["total_ms"] == pytest.approx(6.0)
+        assert stats["a"]["mean_ms"] == pytest.approx(3.0)
+        assert stats["a"]["max_ms"] == pytest.approx(4.0)
+        assert stats["b"]["open"] == 1
+
+    def test_summarize_renders(self):
+        with obs.span("render.me"):
+            pass
+        out = obs_export.summarize(obs.flight_recorder().snapshot())
+        assert "render.me" in out
+
+
+class TestObsCLI:
+    def _dump_some_spans(self, d):
+        with obs.span("cli.span", n=1):
+            pass
+        obs.dump(os.path.join(d, "spans.jsonl"))
+        obs.dump_metrics(os.path.join(d, "metrics.jsonl"))
+
+    def test_summarize(self, tmp_path, capsys):
+        from tpu_patterns.cli import main
+
+        self._dump_some_spans(str(tmp_path))
+        rc = main(["--obs-dir", str(tmp_path), "obs", "summarize"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "cli.span" in out
+
+    def test_export_both(self, tmp_path, capsys):
+        from tpu_patterns.cli import main
+
+        self._dump_some_spans(str(tmp_path))
+        trace_out = tmp_path / "trace.json"
+        rc = main([
+            "--obs-dir", str(tmp_path), "obs", "export",
+            "--chrome-trace", str(trace_out), "--prom",
+        ])
+        assert rc == 0
+        assert json.load(open(trace_out))["traceEvents"]
+        samples = obs.parse_prom_text(capsys.readouterr().out)
+        assert any(
+            name == "tpu_patterns_span_duration_ns_count"
+            for name, _ in samples
+        )
+
+    def test_export_without_target_is_an_error(self, tmp_path):
+        from tpu_patterns.cli import main
+
+        with pytest.raises(SystemExit):
+            main(["--obs-dir", str(tmp_path), "obs", "export"])
+
+    def test_summarize_empty_dir_is_an_error(self, tmp_path):
+        from tpu_patterns.cli import main
+
+        with pytest.raises(SystemExit):
+            main(["--obs-dir", str(tmp_path), "obs", "summarize"])
+
+    def test_host_device_join_reads_profile(self, tmp_path, capsys):
+        from tpu_patterns.cli import main
+
+        self._dump_some_spans(str(tmp_path))
+        fixdir = os.path.join(os.path.dirname(__file__), "fixtures")
+        rc = main([
+            "--obs-dir", str(tmp_path), "obs", "summarize",
+            "--profile-dir", fixdir,
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        # host spans and every device engine bucket in ONE report
+        assert "cli.span" in out
+        for token in ("MXU", "ICI", "HBM"):
+            assert token in out

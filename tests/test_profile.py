@@ -357,11 +357,13 @@ class TestCrosscheckZeroCompute:
 
 
 class TestCommittedOpNameFixtures:
-    """The classifier against SILICON vocabulary (VERDICT r3 next #6):
-    every op-name fixture captured by the hardware ladder and committed
-    under tests/fixtures/ is re-classified by the CURRENT rules — a rule
-    change that unbuckets a real hot op, or books >20% of real busy time
-    as 'other', fails here with no TPU needed."""
+    """The classifier against COMMITTED vocabulary (VERDICT r3 next #6):
+    every op-name fixture under tests/fixtures/ is re-classified by the
+    CURRENT rules — a rule change that unbuckets a hot op, or books >20%
+    of busy time as 'other', fails here with no TPU needed.  The
+    synthetic fixture (scripts/make_xplane_fixture.py) guarantees this
+    tier always runs; hardware-ladder snapshots add silicon vocabulary
+    alongside it as they land."""
 
     FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures")
 
@@ -376,11 +378,12 @@ class TestCommittedOpNameFixtures:
         import json
 
         fixtures = self._fixtures()
-        if not fixtures:
-            pytest.skip(
-                "no captured op-name fixtures committed yet (the r4 "
-                "hardware ladder's profilecheck stage writes them)"
-            )
+        # the synthetic fixture is committed: this tier may never skip
+        # again (it sat skipped for two rounds — VERDICT weak #6)
+        assert fixtures, (
+            "tests/fixtures/op_names_*.json missing — regenerate with "
+            "scripts/make_xplane_fixture.py"
+        )
         for path in fixtures:
             with open(path) as f:
                 names = json.load(f)
@@ -405,3 +408,49 @@ class TestCommittedOpNameFixtures:
                     f"{path}: rule drift on {n!r}: "
                     f"{d['category']} -> {prof.classify(n)}"
                 )
+
+    def test_synthetic_pb_parses_and_classifies(self):
+        """The committed BINARY fixture through the real reader: the
+        wire-format writer (scripts/make_xplane_fixture.py) and the
+        reader must agree on the bytes, and the snapshot derived from
+        them must cover every classifier family."""
+        pb = os.path.join(self.FIXDIR, "synthetic.xplane.pb")
+        assert os.path.exists(pb), (
+            "tests/fixtures/synthetic.xplane.pb missing — regenerate "
+            "with scripts/make_xplane_fixture.py"
+        )
+        planes = prof.parse_xspace(pb)
+        assert [p.name for p in planes] == ["/device:TPU:0", "/host:CPU"]
+        names = prof.op_name_snapshot(self.FIXDIR)
+        assert names is not None
+        # one representative per family, spelled as silicon spells them
+        assert names["fusion.42"]["category"] == "compute"
+        assert names["all-reduce.3"]["category"] == "collective"
+        assert names["copy-start.11"]["category"] == "dma"
+        assert names["tpu_custom_call.flash_fwd"]["category"] == "compute"
+        assert names["tpu_custom_call.dma_overlap"]["category"] == "dma"
+        assert names["outfeed"]["category"] == "infeed_outfeed"
+        assert names["zzz-unknown-op.9"]["category"] == "other"
+        # the breakdown runs off the same bytes: busy must exclude the
+        # re-aggregating Steps line and the host plane
+        bd = prof.breakdown(self.FIXDIR)
+        assert bd is not None
+        assert bd["busy_ms"] == pytest.approx(
+            sum(d["duration_ps"] for d in names.values()) / 1e9
+        )
+        assert bd["idle_ms"] > 0  # the writer leaves inter-op gaps
+
+    def test_synthetic_json_matches_pb(self):
+        """The two committed artifacts describe the same trace — a
+        regenerated .pb with a stale .json (or vice versa) fails."""
+        import json
+
+        with open(
+            os.path.join(self.FIXDIR, "op_names_synthetic.json")
+        ) as f:
+            committed = json.load(f)
+        derived = prof.op_name_snapshot(self.FIXDIR)
+        assert derived == committed, (
+            "tests/fixtures out of sync — rerun "
+            "scripts/make_xplane_fixture.py"
+        )

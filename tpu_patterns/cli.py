@@ -749,6 +749,76 @@ def _cmd_profilecheck(args, writer: ResultWriter) -> int:
     return writer.exit_code
 
 
+def _cmd_obs(args, writer: ResultWriter) -> None:
+    """Read the obs layer's dumps: span summaries, Chrome-trace and
+    Prometheus export, host+device join against a captured profile."""
+    import glob
+    import os
+
+    from tpu_patterns import obs
+    from tpu_patterns.obs import export as obs_export
+    from tpu_patterns.obs import metrics as obs_metrics
+
+    obs_dir = args.obs_dir or obs.run_dir()
+    if args.input:
+        span_files = [args.input]
+    else:
+        span_files = [
+            p
+            for p in (
+                os.path.join(obs_dir, "spans.jsonl"),
+                os.path.join(obs_dir, "crash.jsonl"),
+            )
+            if os.path.exists(p)
+        ] + sorted(glob.glob(os.path.join(obs_dir, "hang_*.jsonl")))
+    entries: list[dict] = []
+    for p in span_files:
+        entries.extend(obs_export.load_entries(p))
+    # hang/crash dumps and an end-of-run spans.jsonl overlap (same ring,
+    # dumped at different moments): summaries must not double-count
+    entries = obs_export.dedupe_entries(entries)
+
+    if args.action == "summarize":
+        if not entries:
+            raise SystemExit(
+                f"no obs dumps under {obs_dir} — run a pattern with "
+                "--obs-dump (or wait for a watchdog/crash dump) first"
+            )
+        writer.progress(
+            f"{len(entries)} entries from {len(span_files)} dump(s) "
+            f"under {obs_dir}"
+        )
+        if args.profile_dir:
+            print(obs_export.host_device_join(entries, args.profile_dir))
+        else:
+            print(obs_export.summarize(entries))
+        return
+
+    # action == "export"
+    if not args.chrome_trace and not args.prom:
+        # a flag must never be silently ignored — and an export that
+        # exports nothing is a silent no-op
+        raise SystemExit(
+            "obs export: pass --chrome-trace OUT.json and/or --prom"
+        )
+    if args.chrome_trace:
+        if not entries:
+            raise SystemExit(f"no obs dumps under {obs_dir} to export")
+        out = obs_export.write_chrome_trace(entries, args.chrome_trace)
+        writer.progress(
+            f"chrome trace ({len(entries)} events) -> {out} "
+            "(open in Perfetto / chrome://tracing)"
+        )
+    if args.prom:
+        mpath = os.path.join(obs_dir, "metrics.jsonl")
+        if not os.path.exists(mpath):
+            raise SystemExit(
+                f"no {mpath} — run a pattern with --obs-dump first"
+            )
+        with open(mpath) as f:
+            print(obs_metrics.registry_from_jsonl(f).to_prom_text(), end="")
+
+
 def _cmd_report(args, writer: ResultWriter) -> None:
     from tpu_patterns.core.results import (
         parse_log,
@@ -794,6 +864,19 @@ def build_parser() -> argparse.ArgumentParser:
         "--profile_dir",
         default="results/profile",
         help="trace output directory for --enable_profiling",
+    )
+    parser.add_argument(
+        "--obs-dir",
+        default=None,
+        help="directory for obs dumps (watchdog/crash/spans/metrics); "
+        "default $TPU_PATTERNS_OBS_DIR, else results/obs",
+    )
+    parser.add_argument(
+        "--obs-dump",
+        action="store_true",
+        help="dump the flight recorder (spans.jsonl) and metrics "
+        "(metrics.jsonl) under the obs dir when the run finishes — the "
+        "ring records always; this flag exports it",
     )
     sub = parser.add_subparsers(dest="cmd", required=True)
 
@@ -1060,6 +1143,42 @@ def build_parser() -> argparse.ArgumentParser:
     r = sub.add_parser("report", help="tabulate logs (≙ parse.py)")
     r.add_argument("paths", nargs="+")
 
+    ob = sub.add_parser(
+        "obs",
+        help="observability layer: summarize recorded spans, export "
+        "Chrome traces (Perfetto-openable) and Prometheus metrics, join "
+        "host spans against a device-plane profile breakdown",
+    )
+    ob.add_argument(
+        "action",
+        choices=("summarize", "export"),
+        help="summarize = per-span table (+device join with "
+        "--profile-dir); export = --chrome-trace / --prom",
+    )
+    ob.add_argument(
+        "--input",
+        default=None,
+        help="one specific dump file (default: spans.jsonl + crash.jsonl "
+        "+ hang_*.jsonl under the obs dir)",
+    )
+    ob.add_argument(
+        "--chrome-trace",
+        default=None,
+        metavar="OUT.json",
+        help="write Chrome trace_event JSON here",
+    )
+    ob.add_argument(
+        "--prom",
+        action="store_true",
+        help="print the dumped metrics in Prometheus text format",
+    )
+    ob.add_argument(
+        "--profile-dir",
+        default=None,
+        help="jax.profiler trace dir: join host spans with the device "
+        "busy-time breakdown (host vs MXU vs ICI vs HBM)",
+    )
+
     pc = sub.add_parser(
         "profilecheck",
         help="validate a captured trace: real-op-name fixture snapshot, "
@@ -1086,6 +1205,12 @@ def main(argv: list[str] | None = None) -> int:
 
     args = build_parser().parse_args(argv)
     setup_jax()  # platform override + compile cache BEFORE any backend touch
+    from tpu_patterns import obs
+
+    if args.obs_dir:
+        obs.configure(args.obs_dir)
+    if args.cmd != "obs":  # the reader must not dump over what it reads
+        obs.install_crash_handlers()
     writer = ResultWriter(jsonl_path=args.jsonl)
     handlers = {
         "p2p": _cmd_p2p,
@@ -1107,6 +1232,7 @@ def main(argv: list[str] | None = None) -> int:
         "topo": _cmd_topo,
         "interop": _cmd_interop,
         "report": _cmd_report,
+        "obs": _cmd_obs,
         "profilecheck": _cmd_profilecheck,
     }
     if args.cmd == "sweep":
@@ -1119,6 +1245,12 @@ def main(argv: list[str] | None = None) -> int:
             raise SystemExit(
                 "error: --enable_profiling does not apply to sweep (cells are "
                 "subprocesses; profile an individual pattern run instead)"
+            )
+        if args.obs_dump:
+            raise SystemExit(
+                "error: --obs-dump does not apply to sweep (cells are "
+                "subprocesses with their own recorders; pass it to an "
+                "individual pattern run)"
             )
         return _cmd_sweep(args, writer)
     if args.enable_profiling:
@@ -1159,6 +1291,9 @@ def main(argv: list[str] | None = None) -> int:
             ))
     else:
         handlers[args.cmd](args, writer)
+    if args.obs_dump and args.cmd != "obs":
+        writer.progress(f"obs spans -> {obs.dump(reason='end_of_run')}")
+        writer.progress(f"obs metrics -> {obs.dump_metrics()}")
     return writer.exit_code
 
 

@@ -483,11 +483,19 @@ def run_onesided(
     )
     extra_metrics: dict[str, float] = {}
     notes: list[str] = []
+    from tpu_patterns import obs
+
     if mode == "ring_put":
-        res = timing.measure_chain(
-            build_chain, reps=cfg.reps, warmup=cfg.warmup,
-            direct_fn=lambda: fn(x), ops_per_iter=timing.CHAIN_UNROLL,
-        )
+        with obs.span(
+            "onesided.ring_put",
+            deadline_s=obs.collective_deadline_s(),
+            bytes=shard_bytes * num_transfers,
+            devices=n_dev,
+        ):
+            res = timing.measure_chain(
+                build_chain, reps=cfg.reps, warmup=cfg.warmup,
+                direct_fn=lambda: fn(x), ops_per_iter=timing.CHAIN_UNROLL,
+            )
         gbps = res.gbps(shard_bytes * num_transfers)
         plausible = None  # ICI-path rate; the HBM gate applies to local_put
         bytes_factor = 1.0
@@ -507,10 +515,16 @@ def run_onesided(
         for name, (put, want_fn, factor) in candidates.items():
             try:
                 kfn, kbuild = one_kernel(put)
-                kres = timing.measure_chain(
-                    kbuild, reps=cfg.reps, warmup=cfg.warmup,
-                    direct_fn=lambda: kfn(x), ops_per_iter=timing.CHAIN_UNROLL,
-                )
+                with obs.span(
+                    "onesided.local_put",
+                    kernel=name,
+                    bytes=int(shard_bytes * factor),
+                ):
+                    kres = timing.measure_chain(
+                        kbuild, reps=cfg.reps, warmup=cfg.warmup,
+                        direct_fn=lambda: kfn(x),
+                        ops_per_iter=timing.CHAIN_UNROLL,
+                    )
             except Exception as e:
                 if len(candidates) == 1:
                     raise

@@ -25,6 +25,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_patterns import obs
 from tpu_patterns.comm import verify
 from tpu_patterns.comm.dtypes import get_dtype
 from tpu_patterns.core import timing
@@ -144,10 +145,17 @@ def run_p2p(
         def build_chain(k: int, _chained=chained):
             return lambda: _chained(x, jnp.int32(k))
 
-        res = timing.measure_chain(
-            build_chain, reps=cfg.reps, warmup=cfg.warmup, label=name,
-            direct_fn=lambda: fn(x), ops_per_iter=timing.CHAIN_UNROLL,
-        )
+        with obs.span(
+            "p2p.pair_exchange",
+            deadline_s=obs.collective_deadline_s(),
+            direction=name,
+            bytes=shard_bytes * len(perm),
+            devices=n_dev,
+        ):
+            res = timing.measure_chain(
+                build_chain, reps=cfg.reps, warmup=cfg.warmup, label=name,
+                direct_fn=lambda: fn(x), ops_per_iter=timing.CHAIN_UNROLL,
+            )
         num_pairs = len(perm)  # transfers in flight (bi counts both directions)
         gbps = res.gbps(shard_bytes * num_pairs)
         # Physical plausibility (≙ the HBM gate of comm/onesided.py, on

@@ -119,6 +119,18 @@ def allreduce(x: jax.Array, axis_name: str, axis_size: int, variant: str, op=Non
     """Dispatch table for the miniapp's algorithm matrix.  ``op`` customizes
     the per-step accumulate of the manual rings; the library path ignores it
     (XLA owns the schedule, ≙ MPI_Allreduce owning the reduction op)."""
+    from tpu_patterns import obs
+
+    # Host code under tracing: one flight-recorder event per traced
+    # program, recording WHICH schedule was compiled for which ring size
+    # (the body below runs inside shard_map — no host spans in there).
+    obs.event(
+        "ring.allreduce.trace",
+        variant=variant,
+        axis=axis_name,
+        axis_size=axis_size,
+        elements=int(x.size),
+    )
     if variant == "psum":
         return library_allreduce(x, axis_name)
     if variant == "ring":

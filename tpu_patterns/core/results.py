@@ -18,8 +18,9 @@ import json
 import os
 import re
 import sys
-import time
 from typing import Any, Iterable, TextIO
+
+from tpu_patterns.core.timing import wall_time_s
 
 
 class Verdict(enum.Enum):
@@ -43,7 +44,7 @@ class Record:
     verdict: Verdict = Verdict.SUCCESS
     config: dict[str, Any] = dataclasses.field(default_factory=dict)
     env: dict[str, str] = dataclasses.field(default_factory=dict)
-    timestamp: float = dataclasses.field(default_factory=time.time)
+    timestamp: float = dataclasses.field(default_factory=wall_time_s)
     notes: list[str] = dataclasses.field(default_factory=list)
     # True marks a committed record whose number was invalidated by a
     # later accounting/measurement fix: it stays in the archive as

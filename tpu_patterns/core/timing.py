@@ -31,6 +31,14 @@ def clock_ns() -> int:
     return native() if native is not None else time.perf_counter_ns()
 
 
+def wall_time_s() -> float:
+    """Wall-clock epoch seconds — for PROVENANCE (record timestamps,
+    episode open/close times), never for durations.  The one sanctioned
+    wall-clock read: everything else in ``tpu_patterns/`` must time via
+    :func:`clock_ns` (enforced by scripts/lint_timing.py)."""
+    return time.time()
+
+
 _NATIVE_CLOCK: Any = False  # False = unprobed, None = unavailable
 
 
@@ -52,18 +60,30 @@ def device_barrier() -> None:
 
     Single process: drain all local devices.  Multi-process: global device
     sync via multihost utils (collective over all processes).
+
+    The span's deadline arms the hang watchdog (obs/watchdog.py): a dead
+    device tunnel wedges exactly here, inside native code with the GIL
+    held — post-mortem invisible, live-diagnosable.
     """
     import jax
 
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+    from tpu_patterns import obs
 
-        multihost_utils.sync_global_devices("tpu_patterns_barrier")
-    else:
-        for d in jax.local_devices():
-            # A trivial transfer per device, then fence: leaves every device
-            # queue empty so the next timestamp isn't charged prior work.
-            jax.device_put(0, d).block_until_ready()
+    with obs.span(
+        "timing.device_barrier",
+        deadline_s=obs.collective_deadline_s(),
+        processes=jax.process_count(),
+    ):
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("tpu_patterns_barrier")
+        else:
+            for d in jax.local_devices():
+                # A trivial transfer per device, then fence: leaves every
+                # device queue empty so the next timestamp isn't charged
+                # prior work.
+                jax.device_put(0, d).block_until_ready()
 
 
 @dataclasses.dataclass
@@ -111,19 +131,29 @@ def min_over_reps(
     ``fn`` must block until its device work completes (return value with
     ``block_until_ready`` applied, or pure host work).  Warmup runs absorb
     compilation — the XLA analogue of the reference's first-touch effects.
+
+    The obs span wraps the whole measurement (warmup + reps), never the
+    timed region itself: between ``t0`` and ``t1`` nothing but ``fn`` and
+    its fence runs, obs enabled or not — the min-over-reps numbers are
+    identical either way.
     """
-    for _ in range(warmup):
-        r = fn()
-        _block(r)
-    times = []
-    for _ in range(reps):
-        if barrier is not None:
-            barrier()
-        t0 = clock_ns()
-        r = fn()
-        _block(r)
-        t1 = clock_ns()
-        times.append(t1 - t0)
+    from tpu_patterns import obs
+
+    with obs.span(
+        "timing.min_over_reps", label=label, reps=reps, warmup=warmup
+    ):
+        for _ in range(warmup):
+            r = fn()
+            _block(r)
+        times = []
+        for _ in range(reps):
+            if barrier is not None:
+                barrier()
+            t0 = clock_ns()
+            r = fn()
+            _block(r)
+            t1 = clock_ns()
+            times.append(t1 - t0)
     return TimingResult(times_ns=times, label=label)
 
 
@@ -371,6 +401,12 @@ def measure_chain(
             r1 = timed(k1, 0)
     diff = r1.min_ns - r0.min_ns
     per_iter = diff / (k1 - k0) if diff > 0 else r1.min_ns / k1
+    from tpu_patterns import obs
+
+    obs.event(
+        "timing.measure_chain", label=label, mode=mode.value,
+        k0=k0, k1=k1, converged=bool(diff >= threshold),
+    )
     return ChainMeasurement(
         per_op_ns=float(per_iter) / ops_per_iter, mode=mode, short=r0, long=r1,
         lengths=(k0, k1),

@@ -104,10 +104,22 @@ def run_all(
 
     The aggregated pass/fail is ``writer.exit_code`` (≙ CTest's summary).
     """
+    from tpu_patterns import obs
+
     writer = writer or ResultWriter()
     mesh = mesh if mesh is not None else default_mesh(n_devices)
     records = []
     for spec, dtype in typed_runs():
         writer.progress(f"miniapp {spec.name}.{dtype}")
-        records.append(spec.run(mesh=mesh, dtype=dtype, writer=writer, **overrides))
+        with obs.span(
+            "miniapp.run",
+            deadline_s=obs.collective_deadline_s(),
+            app=spec.app,
+            variant=spec.variant,
+            dtype=dtype,
+        ):
+            records.append(
+                spec.run(mesh=mesh, dtype=dtype, writer=writer, **overrides)
+            )
+        obs.counter("tpu_patterns_miniapp_runs_total", app=spec.app).inc()
     return records

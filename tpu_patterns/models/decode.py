@@ -669,11 +669,13 @@ def run_decode(mesh: Mesh, cfg: DecodeConfig, writer) -> list:
     jax.block_until_ready(y0)
     # time-to-first-token: a warmed prefill over the full context (the
     # other canonical inference latency, alongside per-token decode)
-    import time as _time
+    from tpu_patterns import obs
+    from tpu_patterns.core.timing import clock_ns
 
-    t_pf = _time.perf_counter()
-    jax.block_until_ready(prefill(params, x)[1])
-    prefill_ms = 1e3 * (_time.perf_counter() - t_pf)
+    with obs.span("decode.prefill", tokens=cfg.batch * cfg.prefill):
+        t_pf = clock_ns()
+        jax.block_until_ready(prefill(params, x)[1])
+        prefill_ms = (clock_ns() - t_pf) / 1e6
 
     gate = _teacher_forcing_gate(mesh, mcfg, cache_int8=cfg.cache_int8)
 

@@ -388,14 +388,19 @@ def run_lm(mesh: Mesh, cfg: LMConfig, writer) -> list:
     st = jax.device_put(toks, NamedSharding(mesh, P("dp", "sp")))
     _, first = step(p, st)
     first = float(first)
-    import time
+    from tpu_patterns import obs
+    from tpu_patterns.core.timing import clock_ns
 
-    t0 = time.perf_counter()
     loss = first  # steps=0: report the initial loss, nothing trained
-    for _ in range(cfg.steps):
-        p, loss = step(p, st)
-    loss = float(loss)
-    train_s = time.perf_counter() - t0
+    # the span wraps the clock reads, never the reverse: span enter/exit
+    # overhead must not ride inside the reported duration (the same
+    # discipline as timing.min_over_reps)
+    with obs.span("lm.train", steps=cfg.steps, vocab=cfg.vocab):
+        t0 = clock_ns()
+        for _ in range(cfg.steps):
+            p, loss = step(p, st)
+        loss = float(loss)
+        train_s = (clock_ns() - t0) / 1e9
 
     prefill_len = cfg.seq  # generate from the training context
     # capacity padded up to a multiple of sp (the cache layout divides
@@ -415,10 +420,11 @@ def run_lm(mesh: Mesh, cfg: LMConfig, writer) -> list:
     jax.block_until_ready(
         gen(p, caches, tok0, jnp.asarray(prefill_len), cfg.gen, **gen_kw)[1]
     )
-    t1 = time.perf_counter()
-    _, out = gen(p, caches, tok0, jnp.asarray(prefill_len), cfg.gen, **gen_kw)
-    out = np.asarray(out)
-    gen_s = time.perf_counter() - t1
+    with obs.span("lm.generate", tokens=cfg.batch * cfg.gen):
+        t1 = clock_ns()
+        _, out = gen(p, caches, tok0, jnp.asarray(prefill_len), cfg.gen, **gen_kw)
+        out = np.asarray(out)
+        gen_s = (clock_ns() - t1) / 1e9
     tps = cfg.batch * cfg.gen / gen_s if gen_s > 0 else 0.0
 
     learned = np.isfinite(loss) and loss < first

@@ -22,14 +22,14 @@ N-k steps) must produce the SAME final parameters — on CPU this is exact
 from __future__ import annotations
 
 import dataclasses
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpu_patterns import ckpt
+from tpu_patterns import ckpt, obs
+from tpu_patterns.core.timing import clock_ns
 from tpu_patterns.models.transformer import (
     ModelConfig,
     _n_experts,
@@ -149,6 +149,12 @@ def _emit_step_record(
 ) -> None:
     from tpu_patterns.core.results import Record, Verdict
 
+    # live metrics ride alongside the Record stream: a scrape/dump sees
+    # the training vitals without parsing JSONL
+    obs.gauge("tpu_patterns_train_loss", optimizer=cfg.optimizer).set(loss)
+    obs.gauge(
+        "tpu_patterns_train_steps_per_s", optimizer=cfg.optimizer
+    ).set(steps_per_s)
     writer.record(
         Record(
             pattern="train_step",
@@ -271,26 +277,35 @@ def train(mesh: Mesh, cfg: TrainLoopConfig, writer=None) -> dict:
     loss = None
     get_batch, close_source = _make_batch_source(cfg, mesh, start)
     saver = ckpt.AsyncSaver() if cfg.ckpt_async else None
-    t0 = time.perf_counter()
+    t0 = clock_ns()
     rate_start = start
     t_window, window_start = t0, start
+    steps_total = obs.counter(
+        "tpu_patterns_train_steps_total", optimizer=cfg.optimizer
+    )
     try:
         for t in range(start, cfg.steps):
-            x = get_batch(t)
-            new_state, loss = one(
-                {k: v for k, v in tree.items() if k != "step"}, x
-            )
-            tree = dict(new_state, step=jnp.asarray(t + 1, jnp.int32))
+            with obs.span("train.step", step=t, optimizer=cfg.optimizer):
+                x = get_batch(t)
+                new_state, loss = one(
+                    {k: v for k, v in tree.items() if k != "step"}, x
+                )
+                tree = dict(new_state, step=jnp.asarray(t + 1, jnp.int32))
+            steps_total.inc()
             if (
                 cfg.ckpt_dir
                 and cfg.ckpt_every > 0
                 and (t + 1) % cfg.ckpt_every == 0
             ):
-                jax.block_until_ready(tree)
-                if saver is not None:
-                    saver.save(cfg.ckpt_dir, t + 1, tree, keep=cfg.keep)
-                else:
-                    ckpt.save(cfg.ckpt_dir, t + 1, tree, keep=cfg.keep)
+                with obs.span(
+                    "train.checkpoint", step=t + 1,
+                    mode="async" if saver is not None else "sync",
+                ):
+                    jax.block_until_ready(tree)
+                    if saver is not None:
+                        saver.save(cfg.ckpt_dir, t + 1, tree, keep=cfg.keep)
+                    else:
+                        ckpt.save(cfg.ckpt_dir, t + 1, tree, keep=cfg.keep)
             if t == start:
                 # restart the clocks AFTER the first step: it carries the
                 # jit compile, which would otherwise dominate both the
@@ -298,7 +313,7 @@ def train(mesh: Mesh, cfg: TrainLoopConfig, writer=None) -> dict:
                 # is excluded from clock and count alike, so the summary
                 # rate is comparable with the bench's warmed numbers)
                 jax.block_until_ready(loss)
-                t0, rate_start = time.perf_counter(), t + 1
+                t0, rate_start = clock_ns(), t + 1
                 t_window, window_start = t0, t + 1
             if (
                 writer is not None
@@ -312,10 +327,10 @@ def train(mesh: Mesh, cfg: TrainLoopConfig, writer=None) -> dict:
                 steps_in_window = t + 1 - window_start
                 if steps_in_window > 0:
                     step_loss = float(np.asarray(loss))
-                    now = time.perf_counter()
+                    now = clock_ns()
                     _emit_step_record(
                         writer, cfg, t + 1, step_loss,
-                        steps_in_window / max(now - t_window, 1e-9),
+                        steps_in_window / max((now - t_window) / 1e9, 1e-9),
                     )
                     t_window, window_start = now, t + 1
         jax.block_until_ready(tree)
@@ -328,7 +343,7 @@ def train(mesh: Mesh, cfg: TrainLoopConfig, writer=None) -> dict:
                 saver.wait()
         finally:
             close_source()
-    elapsed = time.perf_counter() - t0
+    elapsed = (clock_ns() - t0) / 1e9
     # post-compile steps (0 on 1-step runs); clamped: a resumed
     # checkpoint whose step already exceeds cfg.steps runs nothing, and
     # a negative count must not become a negative throughput

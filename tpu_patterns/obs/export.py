@@ -1,0 +1,188 @@
+"""Exporters: Chrome trace_event JSON, span summaries, host/device join.
+
+The flight recorder's entries are plain dicts; this module turns a dump
+(or the live ring) into
+
+* a Chrome ``trace_event`` JSON file — open any run in Perfetto /
+  chrome://tracing: spans become complete ("ph": "X") events with
+  microsecond timestamps, nested per thread exactly as they ran;
+* a per-span-name summary table (count / total / mean / max), the
+  ``tpu-patterns obs summarize`` product;
+* a host+device join against ``core/profile.py``'s device-plane busy
+  categories, so ONE report answers "where did the step go: host, MXU
+  (compute), ICI (collective), or HBM (dma)".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable
+
+
+def load_entries(path: str) -> list[dict]:
+    """Read one dump (spans.jsonl / hang_*.jsonl) back into entry dicts;
+    meta header lines are skipped, torn trailing lines tolerated (dumps
+    are written by dying processes)."""
+    entries: list[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(e, dict) and e.get("kind") in ("span", "event"):
+                entries.append(e)
+    return entries
+
+
+def dedupe_entries(entries: Iterable[dict]) -> list[dict]:
+    """Drop repeats across overlapping dumps of the same ring.
+
+    A run where the watchdog fired AND --obs-dump exported at end of run
+    wrote the same entries twice (hang_*.jsonl then spans.jsonl) — and
+    the hung span twice more, once open and once closed.  Identity is
+    (span_id, t0_ns, tid, name); the closed form of a span wins over its
+    still-open snapshot.  First-seen order is preserved.
+    """
+    best: dict[tuple, dict] = {}
+    order: list[tuple] = []
+    for e in entries:
+        key = (e.get("span_id"), e.get("t0_ns"), e.get("tid"),
+               e.get("name"))
+        prev = best.get(key)
+        if prev is None:
+            best[key] = e
+            order.append(key)
+        elif prev.get("open") and not e.get("open"):
+            best[key] = e
+    return [best[k] for k in order]
+
+
+def chrome_trace(entries: Iterable[dict]) -> dict:
+    """trace_event JSON object format: spans -> "X" (complete) events,
+    events -> "i" (instant); ts/dur in microseconds per the schema."""
+    trace_events = []
+    pid = os.getpid()
+    for e in entries:
+        ev = {
+            "name": e.get("name", "?"),
+            "cat": "tpu_patterns" + (",open" if e.get("open") else ""),
+            "ph": "X" if e.get("kind") == "span" else "i",
+            "ts": e.get("t0_ns", 0) / 1e3,
+            "pid": pid,
+            "tid": e.get("tid", 0),
+            "args": dict(e.get("attrs") or {}),
+        }
+        if e.get("kind") == "span":
+            ev["dur"] = e.get("dur_ns", 0) / 1e3
+        else:
+            ev["s"] = "t"  # instant scope: thread
+        trace_events.append(ev)
+    trace_events.sort(key=lambda ev: ev["ts"])
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(entries: Iterable[dict], out_path: str) -> str:
+    d = os.path.dirname(out_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(chrome_trace(entries), f)
+    return out_path
+
+
+def span_stats(entries: Iterable[dict]) -> dict[str, dict]:
+    """Per span name: count, total/mean/max duration (ms), still-open
+    count — the summarize table's rows."""
+    stats: dict[str, dict] = {}
+    for e in entries:
+        if e.get("kind") != "span":
+            continue
+        s = stats.setdefault(
+            e.get("name", "?"),
+            {"count": 0, "total_ms": 0.0, "max_ms": 0.0, "open": 0,
+             "errors": 0},
+        )
+        dur_ms = e.get("dur_ns", 0) / 1e6
+        s["count"] += 1
+        s["total_ms"] += dur_ms
+        s["max_ms"] = max(s["max_ms"], dur_ms)
+        if e.get("open"):
+            s["open"] += 1
+        if e.get("error"):
+            s["errors"] += 1
+    for s in stats.values():
+        s["mean_ms"] = s["total_ms"] / s["count"] if s["count"] else 0.0
+    return stats
+
+
+def summarize(entries: list[dict]) -> str:
+    """Markdown table of span stats, longest total first."""
+    from tabulate import tabulate  # deferred; baked into the image
+
+    stats = span_stats(entries)
+    n_events = sum(1 for e in entries if e.get("kind") == "event")
+    rows = [
+        [
+            name,
+            s["count"],
+            f"{s['total_ms']:.3f}",
+            f"{s['mean_ms']:.3f}",
+            f"{s['max_ms']:.3f}",
+            s["open"] or "",
+            s["errors"] or "",
+        ]
+        for name, s in sorted(
+            stats.items(), key=lambda kv: -kv[1]["total_ms"]
+        )
+    ]
+    table = tabulate(
+        rows,
+        headers=["span", "count", "total ms", "mean ms", "max ms",
+                 "open", "errors"],
+        tablefmt="github",
+    )
+    return f"{table}\n\n{len(entries)} entries ({n_events} events)"
+
+
+def host_device_join(entries: list[dict], profile_dir: str) -> str:
+    """Join host spans with the device-plane breakdown of a captured
+    trace: one report answering "where did the step go"."""
+    from tpu_patterns.core import profile as profile_mod
+
+    lines = [summarize(entries), ""]
+    bd = profile_mod.breakdown(profile_dir)
+    if bd is None:
+        lines.append(
+            f"(no device plane under {profile_dir} — host spans only)"
+        )
+        return "\n".join(lines)
+    host_ms = sum(
+        e.get("dur_ns", 0) / 1e6
+        for e in entries
+        if e.get("kind") == "span" and e.get("depth", 0) == 0
+    )
+    lines.append("device plane (core/profile.py breakdown):")
+    lines.append(
+        f"  host (top-level spans): {host_ms:.3f} ms wall"
+    )
+    for cat, engine in (
+        ("compute", "MXU"), ("collective", "ICI"), ("dma", "HBM"),
+        ("infeed_outfeed", "host xfer"), ("other", "?"),
+    ):
+        ms = bd.get(f"{cat}_ms", 0.0)
+        frac = bd.get(f"{cat}_frac")
+        lines.append(
+            f"  {engine + ' (' + cat + ')':24s} {ms:10.3f} ms"
+            + (f"  ({frac:.1%} of busy)" if frac is not None else "")
+        )
+    lines.append(
+        f"  device busy {bd.get('busy_ms', 0.0):.3f} ms / wall "
+        f"{bd.get('wall_ms', 0.0):.3f} ms / idle "
+        f"{bd.get('idle_ms', 0.0):.3f} ms"
+    )
+    return "\n".join(lines)

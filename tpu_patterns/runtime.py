@@ -157,7 +157,19 @@ def setup_jax(cache_dir: str | None = None) -> None:
         jax.config.update("jax_platforms", plat)
         n = os.environ.get("TPU_PATTERNS_CPU_DEVICES")
         if plat == "cpu" and n:
-            jax.config.update("jax_num_cpu_devices", int(n))
+            if hasattr(jax.config, "jax_num_cpu_devices"):
+                jax.config.update("jax_num_cpu_devices", int(n))
+            elif "--xla_force_host_platform_device_count" not in (
+                os.environ.get("XLA_FLAGS", "")
+            ):
+                # Older JAX has no jax_num_cpu_devices option; the XLA
+                # flag is read at first backend init, which the guard
+                # above says has not happened yet (same fallback as
+                # tests/conftest.py).
+                os.environ["XLA_FLAGS"] = (
+                    os.environ.get("XLA_FLAGS", "")
+                    + f" --xla_force_host_platform_device_count={n}"
+                ).strip()
 
     if jax.config.jax_compilation_cache_dir:
         return

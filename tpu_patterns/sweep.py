@@ -1822,11 +1822,12 @@ def _record_cell(
     out_dir: str, suite: str, cell: str, rc: int, sig: str, completed: bool
 ) -> None:
     import json
-    import time
+
+    from tpu_patterns.core.timing import wall_time_s
 
     rec = {
         "cell": cell, "rc": rc, "sig": sig, "completed": completed,
-        "ts": time.time(),
+        "ts": wall_time_s(),
     }
     with open(_state_path(out_dir, suite), "a") as f:
         f.write(json.dumps(rec) + "\n")
@@ -1923,9 +1924,26 @@ def run_sweep(
                 rc = 1
             continue
         print(f"# sweep cell: {spec.name}", flush=True)
-        cell_rc, completed = run_spec(
-            spec, out_dir, base_env=base_env, timeout=cell_timeout
-        )
+        from tpu_patterns import obs
+
+        # the subprocess has its own deadline; the span deadline is a
+        # backstop 60s past it, so a cell whose *timeout machinery* wedges
+        # (a SIGKILL the child shrugs off in native code) is still
+        # diagnosed live by the watchdog
+        with obs.span(
+            "sweep.cell",
+            deadline_s=(cell_timeout + 60) if cell_timeout > 0 else None,
+            suite=suite,
+            cell=spec.name,
+        ):
+            cell_rc, completed = run_spec(
+                spec, out_dir, base_env=base_env, timeout=cell_timeout
+            )
+        obs.counter(
+            "tpu_patterns_sweep_cells_total",
+            suite=suite,
+            status="completed" if completed else "aborted",
+        ).inc()
         _record_cell(out_dir, suite, spec.name, cell_rc, sig, completed)
         print(f"# -> exit {cell_rc}", flush=True)
         if cell_rc != 0:  # incl. negative (signal-killed) returncodes
