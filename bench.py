@@ -300,8 +300,37 @@ def _preflight_main() -> int:
     devs = jax.devices()
     x = jnp.ones((128, 128), jnp.float32)
     jax.block_until_ready(jnp.dot(x, x))
-    print(f"preflight_ok {getattr(devs[0], 'device_kind', devs[0].platform)}")
+    print(
+        f"preflight_ok {getattr(devs[0], 'device_kind', devs[0].platform)}",
+        flush=True,
+    )
     return 0
+
+
+def _server_main() -> int:
+    """Warm bench server: preflight, then measure IN THE SAME PROCESS.
+
+    The old ladder paid cold JAX init three times — once per preflight
+    attempt, once for the measurement child — and the round-5 outage
+    JSON shows both preflight attempts timing out at exactly the 60 s
+    boundary: the init tax alone ate the deadline.  Here one child does
+    the preflight and then waits on stdin; the parent's ``run`` line
+    starts the measurement on the already-initialized backend, so a
+    clean preflight's init is never re-paid (the sweep engine's warm-
+    worker idea, applied to the bench).
+    """
+    try:
+        rc = _preflight_main()
+    except Exception as e:
+        print(f"# preflight error: {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
+        return 1
+    if rc != 0:
+        return rc
+    line = sys.stdin.readline()
+    if line.strip() != "run":
+        return 0  # parent went away / declined: exit quietly
+    return _child_main()
 
 
 def main() -> int:
@@ -311,12 +340,23 @@ def main() -> int:
     no Python exception, and SIGALRM handlers never run — so the only
     reliable timeout is a parent that can SIGKILL.  Without it the driver
     would wait on this process forever instead of reading its line.
+
+    The child is ONE warm server (``_server_main``): preflight and
+    measurement share a process, so the init a clean preflight paid is
+    reused by the measurement instead of being paid again — the round-5
+    outage shape (both preflight attempts timing out at exactly the 60 s
+    boundary) was the cold-init tax, not the device.
     """
     import subprocess
 
+    if os.environ.get("_TPU_PATTERNS_BENCH_SERVER"):
+        return _server_main()
     if os.environ.get("_TPU_PATTERNS_BENCH_CHILD"):
         return _child_main()
     if os.environ.get("_TPU_PATTERNS_BENCH_PREFLIGHT"):
+        # standalone device-probe mode: the warm-server flow above made
+        # this parent-internal path obsolete, but capture ladders can
+        # still invoke it directly as a cheap is-the-tunnel-up check
         return _preflight_main()
     try:
         timeout_s = int(os.environ.get("TPU_PATTERNS_BENCH_TIMEOUT", "900"))
@@ -364,33 +404,6 @@ def main() -> int:
                 partial = partial.decode(errors="replace")
             return None, partial
 
-    # Preflight with one retry: each attempt costs at most preflight_s, so
-    # a hung tunnel is reported in ~2*preflight_s with a distinguishable
-    # error instead of a 900 s generic timeout; a transient hang (tunnel
-    # reconnecting) is absorbed by the retry.
-    if preflight_s > 0:
-        ok = False
-        for attempt in (1, 2):
-            proc, _ = run_child("_TPU_PATTERNS_BENCH_PREFLIGHT", preflight_s)
-            if proc is not None and proc.returncode == 0 and "preflight_ok" in (
-                proc.stdout or ""
-            ):
-                ok = True
-                break
-            print(
-                f"# preflight attempt {attempt} failed "
-                f"({'timeout' if proc is None else f'rc={proc.returncode}'})",
-                file=sys.stderr,
-                flush=True,
-            )
-        if not ok:
-            msg = (
-                f"preflight failed twice within {preflight_s}s each: "
-                "device backend unreachable (hung tunnel?)"
-            )
-            print(banked_fallback(msg) or error_line(msg), flush=True)
-            return 0
-
     def annotate_salvaged(line: str, quick_msg: str, full_msg: str) -> str:
         """Mark a salvaged line so it never reads as a clean run; a line
         already carrying structured error detail (a child bench_error
@@ -403,9 +416,105 @@ def main() -> int:
             return json.dumps(rec)
         return line
 
-    proc, stdout = run_child("_TPU_PATTERNS_BENCH_CHILD", timeout_s)
+    if preflight_s > 0:
+        # Warm-server flow: spawn ONE child that preflights then waits
+        # for "run".  Each preflight attempt costs at most preflight_s
+        # (a hung tunnel is reported in ~2*preflight_s with a
+        # distinguishable error, a transient hang is absorbed by the
+        # retry) — and a PASSING preflight's backend init is reused by
+        # the measurement instead of re-paid by a second cold child.
+        import signal
+        import threading
+        import time
+
+        # deliberately NOT exec/proc.kill_process_group: the parent half
+        # of bench.py must run standalone from any cwd with tpu_patterns
+        # unimportable (the fake-repo harness test exercises exactly
+        # that) — only the measurement children import the package
+        def kill_server(proc) -> None:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                proc.kill()
+
+        def spawn_server():
+            proc = subprocess.Popen(
+                # -u: the preflight_ok / provisional lines must cross
+                # the pipe live, not sit in a block buffer past deadlines
+                [sys.executable, "-u", os.path.abspath(__file__)],
+                env=dict(
+                    os.environ,
+                    _TPU_PATTERNS_BENCH_SERVER="1",
+                    _TPU_PATTERNS_BENCH_CHILD="1",
+                ),
+                stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE,
+                text=True,
+                start_new_session=True,
+            )
+            lines: list[str] = []
+            seen = threading.Event()
+            eof = threading.Event()
+
+            def read():
+                for ln in proc.stdout:
+                    lines.append(ln)
+                    if "preflight_ok" in ln:
+                        seen.set()
+                eof.set()
+
+            threading.Thread(target=read, daemon=True).start()
+            return proc, lines, seen, eof
+
+        server = None
+        for attempt in (1, 2):
+            proc, lines, seen, eof = spawn_server()
+            deadline = time.monotonic() + preflight_s
+            status = "timeout"
+            while time.monotonic() < deadline:
+                if seen.wait(timeout=0.2):
+                    status = "ok"
+                    break
+                if eof.is_set() or proc.poll() is not None:
+                    status = f"rc={proc.poll()}"
+                    break
+            if status == "ok":
+                server = (proc, lines, eof)
+                break
+            kill_server(proc)
+            print(
+                f"# preflight attempt {attempt} failed ({status})",
+                file=sys.stderr,
+                flush=True,
+            )
+        if server is None:
+            msg = (
+                f"preflight failed twice within {preflight_s}s each: "
+                "device backend unreachable (hung tunnel?)"
+            )
+            print(banked_fallback(msg) or error_line(msg), flush=True)
+            return 0
+        proc, lines, eof = server
+        try:
+            proc.stdin.write("run\n")
+            proc.stdin.flush()
+        except OSError:
+            pass  # died after preflight: surfaces as child-exit below
+        try:
+            proc.wait(timeout=timeout_s)
+            rc = proc.returncode
+        except subprocess.TimeoutExpired:
+            kill_server(proc)
+            rc = None
+        eof.wait(timeout=30)  # reader drains the pipe after exit/kill
+        stdout = "".join(lines)
+    else:
+        # preflight disabled: the legacy single measurement child
+        proc, stdout = run_child("_TPU_PATTERNS_BENCH_CHILD", timeout_s)
+        rc = None if proc is None else proc.returncode
+
     salvaged = last_metric_line(stdout)
-    if proc is None:
+    if rc is None:
         if salvaged is not None:
             # a measurement landed before the hang — a real number beats
             # an error line.  Distinguish a salvaged small-workload quick
@@ -429,19 +538,19 @@ def main() -> int:
         # crashes only; truncating it would lose the structured detail.
         out = salvaged
         if out is None:
-            lines = stdout.strip().splitlines()
+            tail = stdout.strip().splitlines()
             out = error_line(
-                f"child exited {proc.returncode}; last output "
-                f"{lines[-1][:120] if lines else '<none>'!r}"
+                f"child exited {rc}; last output "
+                f"{tail[-1][:120] if tail else '<none>'!r}"
             )
-        elif proc.returncode != 0:
+        elif rc != 0:
             # native crash after the last good line: never present a
             # salvaged (possibly quick-pass) number as a clean run
             out = annotate_salvaged(
                 out,
-                f"child exited {proc.returncode} after this line; "
+                f"child exited {rc} after this line; "
                 "provisional quick-pass measurement salvaged",
-                f"child exited {proc.returncode} after this line; "
+                f"child exited {rc} after this line; "
                 "crash after measurement; result salvaged",
             )
     # Any error-only outcome (hang with nothing salvaged, child crash

@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""CI gate for the concurrent sweep engine (docs/sweep-engine.md).
+
+Runs a tiny host-parallel suite through the real CLI on the CPU
+backend — once serial (``--jobs 1``), once concurrent (``--jobs 4``)
+— then asserts the properties the engine exists for:
+
+  (a) every selected cell COMPLETED in the concurrent run (the engine
+      must not lose or wedge cells the serial engine finishes);
+  (b) the engine's own serial-vs-concurrent Record reports
+      ``speedup > 1`` on host-parallel cells — the concurrency suite's
+      pass bar applied to the harness;
+  (c) the REAL wall-clock contrast: the concurrent run beats the
+      serial run by >= 1.5x (two measured wall clocks, no estimate —
+      the engine Record's speedup numerator is measured under
+      concurrency, so contention could inflate it; this assert cannot
+      be fooled that way).
+
+Zero dependencies beyond the package; exit 0 = pass.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Small, fast, host-parallel on the CPU backend, spanning several
+# suites; every cell must pass standalone on the oldest supported jax
+# (the allreduce D cells need memory kinds old CPU JAX can't express —
+# a known tier-1 baseline failure — so they stay out of this gate).
+CELLS = [
+    "p2p.compact.mesh.two_sided.n2",
+    "moe.capacity",
+    "longctx.agreement.1dev",
+    "hier.dcn2.float32",
+]
+# width matched to the runner: each cell is a multi-threaded XLA
+# process, so exceeding the cores trades overlap for thrash (measured:
+# 1.65x at jobs=2 on a 2-core box vs 1.27x at jobs=4 on the same box)
+JOBS = max(2, min(4, os.cpu_count() or 2))
+
+
+# the REAL wall-clock bar for (c), scaled to the parallelism the box
+# can physically offer: each cell is a multi-threaded XLA process, so
+# a 2-core host tops out well under 2x (measured 1.4-1.65x) while a
+# 4-core runner clears 1.5x.  Deliberately under the engine's quiet-box
+# numbers: a flaky gate teaches people to ignore it; a real regression
+# (no overlap) reads ~1.0x and fails either bar.
+MIN_WALL_RATIO = 1.5 if (os.cpu_count() or 2) >= 4 else 1.2
+
+
+def _run_suite(jobs: int, env: dict) -> tuple[int, float, str]:
+    out_dir = tempfile.mkdtemp(prefix=f"sweep_smoke_j{jobs}_")
+    cmd = [
+        sys.executable, "-m", "tpu_patterns", "sweep", "all", "--quick",
+        "--jobs", str(jobs), "--out", out_dir,
+    ]
+    for name in CELLS:
+        cmd += ["--name", name]
+    print("+", " ".join(cmd), flush=True)
+    t0 = time.monotonic()
+    proc = subprocess.run(cmd, env=env, cwd=ROOT)
+    return proc.returncode, time.monotonic() - t0, out_dir
+
+
+def main() -> int:
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    serial_rc, serial_wall, _ = _run_suite(1, env)
+    if serial_rc != 0:
+        print(f"sweep smoke: serial suite exited {serial_rc}",
+              file=sys.stderr)
+        return 1
+    rc, conc_wall, out_dir = _run_suite(JOBS, env)
+    if rc != 0:
+        print(f"sweep smoke: concurrent suite exited {rc}",
+              file=sys.stderr)
+        return 1
+
+    # (a) every cell completed
+    try:
+        from tpu_patterns.sweep import load_sweep_state
+    except ModuleNotFoundError:  # run from a checkout without install
+        sys.path.insert(0, ROOT)
+        from tpu_patterns.sweep import load_sweep_state
+
+    state = load_sweep_state(out_dir)
+    missing = [
+        c for c in CELLS
+        if c not in state or not state[c]["completed"]
+    ]
+    if missing:
+        print(f"sweep smoke: cells not completed: {missing}",
+              file=sys.stderr)
+        return 1
+
+    # (b) the engine Record says concurrency won
+    engine_path = os.path.join(out_dir, "sweep-engine.jsonl")
+    with open(engine_path) as f:
+        recs = [json.loads(ln) for ln in f if ln.strip()]
+    if not recs:
+        print("sweep smoke: no engine Record banked", file=sys.stderr)
+        return 1
+    rec = recs[-1]
+    m = rec.get("metrics", {})
+    print(
+        f"sweep smoke: engine verdict={rec.get('verdict')} "
+        f"speedup={m.get('speedup')} wall={m.get('wall_s')}s "
+        f"serial_estimate={m.get('serial_estimate_s')}s "
+        f"worker_hit_rate={m.get('worker_hit_rate')}",
+        flush=True,
+    )
+    if m.get("host_parallel_cells", 0) < len(CELLS):
+        print(
+            f"sweep smoke: expected {len(CELLS)} host-parallel cells, "
+            f"got {m.get('host_parallel_cells')}",
+            file=sys.stderr,
+        )
+        return 1
+    if not m.get("speedup", 0) > 1.0:
+        print(
+            f"sweep smoke: speedup {m.get('speedup')} <= 1 — concurrent "
+            "submission did not beat serial",
+            file=sys.stderr,
+        )
+        return 1
+
+    # (c) the measured wall-clock contrast — two real runs, no estimate
+    ratio = serial_wall / conc_wall if conc_wall > 0 else 0.0
+    print(
+        f"sweep smoke: serial wall {serial_wall:.1f}s vs concurrent "
+        f"{conc_wall:.1f}s -> {ratio:.2f}x (bar {MIN_WALL_RATIO}x)",
+        flush=True,
+    )
+    if ratio < MIN_WALL_RATIO:
+        print(
+            f"sweep smoke: real wall-clock ratio {ratio:.2f} < "
+            f"{MIN_WALL_RATIO} — the engine did not actually beat the "
+            "serial engine",
+            file=sys.stderr,
+        )
+        return 1
+    print("sweep smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

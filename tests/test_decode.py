@@ -386,6 +386,38 @@ class TestRollout:
             got, np.asarray(ys_once), rtol=0, atol=1e-6
         )
 
+    def test_donated_cache_updates_in_place(self, mesh3d):
+        """donate=True: generate consumes the KV cache (no whole-cache
+        copy per call), the buffers really alias (the consumed input is
+        deleted, not copied), and the tokens match the copying decoder's
+        bit for bit."""
+        cfg = ModelConfig(**CFG, dtype="float32", causal=True, depth=1)
+        b, lp, gen = 2, 8, 4
+        prefill, generate = make_decoder(mesh3d, cfg, b, lp, gen)
+        dprefill, dgenerate = make_decoder(
+            mesh3d, cfg, b, lp, gen, donate=True
+        )
+        params = jax.device_put(
+            _stacked_params(jax.random.key(2), cfg),
+            {k: NamedSharding(mesh3d, s)
+             for k, s in _stacked_specs(cfg).items()},
+        )
+        x = jax.device_put(
+            jax.random.normal(jax.random.key(3), (b, lp, cfg.embed)),
+            NamedSharding(mesh3d, P("dp", "sp", None)),
+        )
+        t0 = jnp.asarray(lp, jnp.int32)
+        caches, y0 = prefill(params, x)
+        _, ys_ref = generate(params, caches, y0, t0, gen)
+        dcaches, dy0 = dprefill(params, x)
+        c2, ys_don = dgenerate(params, dcaches, dy0, t0, gen)
+        np.testing.assert_array_equal(np.asarray(ys_ref), np.asarray(ys_don))
+        # the input cache is consumed — the scatter went in place
+        assert all(v.is_deleted() for v in dcaches.values())
+        # the returned cache is the live continuation
+        _, ys_more = dgenerate(params, c2, ys_don[:, -1:, :], t0 + gen, gen)
+        assert np.isfinite(np.asarray(ys_more)).all()
+
 
 class TestInt8Cache:
     def test_quantize_roundtrip_error_bounded(self):

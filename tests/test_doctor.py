@@ -70,6 +70,41 @@ class TestRunDoctor:
         assert rec.metrics["watchdog_ok"] == 1.0
         assert rec.metrics["tiny_op_compile_s"] >= 0
 
+    def test_warm_worker_probe_opt_in(self, monkeypatch, tmp_path):
+        # --workers true certifies the sweep engine's fast path: worker
+        # spawns, backend-warms, answers a ping — and its timings become
+        # doctor metrics
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        monkeypatch.delenv("TPU_PATTERNS_PLATFORM", raising=False)
+        from tpu_patterns import obs
+
+        obs.configure(str(tmp_path))
+        try:
+            (rec,) = run_doctor(
+                DoctorConfig(probe_timeout=240, deep=False, workers=True),
+                ResultWriter(),
+            )
+        finally:
+            obs.configure(None)
+        assert rec.metrics["warm_worker_ok"] == 1.0, rec.notes
+        assert rec.metrics["warm_worker_spawn_s"] > 0
+        assert rec.metrics["warm_worker_ping_ms"] >= 0
+
+    def test_worker_probe_absent_by_default(self, monkeypatch, tmp_path):
+        # the default doctor stays fast: no worker spawn, no metric row
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        monkeypatch.delenv("TPU_PATTERNS_PLATFORM", raising=False)
+        from tpu_patterns import obs
+
+        obs.configure(str(tmp_path))
+        try:
+            (rec,) = run_doctor(
+                DoctorConfig(probe_timeout=120, deep=False), ResultWriter()
+            )
+        finally:
+            obs.configure(None)
+        assert "warm_worker_ok" not in rec.metrics
+
     def test_broken_backend_names_the_layer_and_skips_the_rest(self):
         # a bogus platform kills the first probe child fast; the doctor
         # must name backend_init and not waste deadlines on later layers

@@ -1,0 +1,507 @@
+"""Concurrent sweep engine (tpu_patterns/exec/, docs/sweep-engine.md)."""
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import pytest
+
+from tpu_patterns import sweep
+from tpu_patterns.exec import (
+    CellClass,
+    classify,
+    detect_platform,
+    run_cells,
+    run_command,
+)
+from tpu_patterns.sweep import SweepSpec
+
+
+def _cpu_env():
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    return env
+
+
+class TestClassify:
+    def test_backend_env_forces_isolation(self):
+        # every runtime-suite cell toggles backend-init-time state: a warm
+        # worker would render the knob silently inert
+        for spec in sweep.runtime_specs(quick=True):
+            if any(
+                k.startswith(("LIBTPU_", "JAX_")) for k, _ in spec.env
+            ):
+                assert classify(spec, "cpu") is CellClass.ENV_ISOLATED
+                assert classify(spec, "tpu") is CellClass.ENV_ISOLATED
+
+    def test_sweep_config_tag_is_not_isolation(self):
+        # the report-keying tag is framework-tier env, re-read per run —
+        # it must NOT push a cell off the warm path
+        spec = SweepSpec(
+            "x", ("p2p",), env=(("TPU_PATTERNS_SWEEP_CONFIG", "x"),)
+        )
+        assert classify(spec, "cpu") is CellClass.HOST_PARALLEL
+
+    def test_device_commands_exclusive_on_tpu_only(self):
+        spec = SweepSpec("x", ("p2p", "--devices", "2"))
+        assert classify(spec, "tpu") is CellClass.DEVICE_EXCLUSIVE
+        assert classify(spec, "cpu") is CellClass.HOST_PARALLEL
+        # libtpu is single-process: even "analysis" commands init the
+        # default backend, so on hardware they serialize too
+        assert (
+            classify(SweepSpec("t", ("topo",)), "tpu")
+            is CellClass.DEVICE_EXCLUSIVE
+        )
+        # only backend-free log/manifest readers stay parallel on TPU
+        assert (
+            classify(SweepSpec("r", ("report", "x.log")), "tpu")
+            is CellClass.HOST_PARALLEL
+        )
+        # an unknown future subcommand defaults to device-owning (safe)
+        assert (
+            classify(SweepSpec("n", ("newthing",)), "tpu")
+            is CellClass.DEVICE_EXCLUSIVE
+        )
+
+    def test_every_suite_cell_classifies(self):
+        for spec in sweep.specs_for("all", quick=True):
+            assert classify(spec, "tpu") in CellClass
+            assert classify(spec, "cpu") in CellClass
+
+    def test_detect_platform_reads_pins_without_backend_touch(self):
+        assert detect_platform({"JAX_PLATFORMS": "cpu"}) == "cpu"
+        assert detect_platform({"TPU_PATTERNS_PLATFORM": "tpu"}) == "tpu"
+        # the package pin outranks the jax one (same precedence as
+        # runtime.setup_jax)
+        assert (
+            detect_platform(
+                {"TPU_PATTERNS_PLATFORM": "cpu", "JAX_PLATFORMS": "tpu"}
+            )
+            == "cpu"
+        )
+
+
+class TestProcessGroupKill:
+    def test_timeout_kills_grandchild(self, tmp_path):
+        # REGRESSION (round-5 "device backend unreachable"): the old
+        # subprocess.run(timeout=...) killed only the direct child; a
+        # double-forked grandchild survived holding the TPU and broke
+        # the NEXT cell's backend init.  run_command kills the GROUP.
+        script = (
+            "import subprocess, sys, time\n"
+            "p = subprocess.Popen([sys.executable, '-c',"
+            " 'import time; time.sleep(600)'])\n"
+            "print('GRANDCHILD', p.pid, flush=True)\n"
+            "time.sleep(600)\n"
+        )
+        stdout, rc, timed_out = run_command(
+            [sys.executable, "-c", script], timeout=3
+        )
+        assert timed_out and rc == 1
+        assert "GRANDCHILD" in stdout  # partial output survives the kill
+        pid = int(stdout.split("GRANDCHILD", 1)[1].split()[0])
+        # the grandchild must be DEAD (reaped by init), not orphaned
+        for _ in range(50):
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                break
+            time.sleep(0.1)
+        else:
+            os.kill(pid, signal.SIGKILL)  # cleanup before failing
+            pytest.fail(f"grandchild {pid} survived the group kill")
+
+    def test_clean_exit_passes_through(self):
+        stdout, rc, timed_out = run_command(
+            [sys.executable, "-c", "print('ok')"], timeout=30
+        )
+        assert (stdout.strip(), rc, timed_out) == ("ok", 0, False)
+
+
+class TestStateContention:
+    def test_concurrent_record_cell_is_lossless(self, tmp_path):
+        # the engine checkpoints cells from several pool threads at
+        # once: N threads x M cells, every record must replay intact
+        n_threads, m_cells = 8, 25
+        out = str(tmp_path)
+
+        def writer(t):
+            for m in range(m_cells):
+                sweep._record_cell(
+                    out, "s", f"cell.t{t}.m{m}", rc=t % 2,
+                    sig=f"sig{t}", completed=True,
+                )
+
+        threads = [
+            threading.Thread(target=writer, args=(t,))
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # raw file: every line is a complete JSON record (no torn writes)
+        with open(os.path.join(out, "sweep-state.jsonl")) as f:
+            lines = f.readlines()
+        assert len(lines) == n_threads * m_cells
+        for ln in lines:
+            json.loads(ln)
+        # replay: every cell present with its own thread's values
+        state = sweep.load_sweep_state(out)
+        assert len(state) == n_threads * m_cells
+        for t in range(n_threads):
+            for m in range(m_cells):
+                assert state[f"cell.t{t}.m{m}"] == {
+                    "rc": t % 2, "sig": f"sig{t}", "completed": True,
+                }
+
+
+class TestScheduler:
+    def _stub_specs(self, n_host=6, n_dev=2):
+        host = [SweepSpec(f"h{i}", ("topo",)) for i in range(n_host)]
+        dev = [SweepSpec(f"d{i}", ("p2p",)) for i in range(n_dev)]
+        return host + dev
+
+    def test_results_in_spec_order_and_engine_record(self, tmp_path):
+        specs = self._stub_specs()
+        seen = []
+        lock = threading.Lock()
+
+        def runner(spec):
+            with lock:
+                seen.append(spec.name)
+            time.sleep(0.05)
+            return 0, True
+
+        results, rec = run_cells(
+            specs, str(tmp_path), jobs=4, warm_workers=False,
+            cell_timeout=30, platform="cpu", subprocess_runner=runner,
+            progress=lambda s: None,
+        )
+        assert [r.spec.name for r in results] == [s.name for s in specs]
+        assert sorted(seen) == sorted(s.name for s in specs)
+        assert all(r.completed and r.rc == 0 for r in results)
+        assert rec.pattern == "sweep" and rec.mode == "engine"
+        assert rec.metrics["cells"] == len(specs)
+        assert rec.metrics["speedup"] > 1.0
+        assert rec.verdict.value == "SUCCESS"
+
+    def test_device_exclusive_cells_never_overlap(self, tmp_path):
+        # on TPU, device cells must drain strictly serially even while
+        # the host pool fans out (only backend-free readers stay
+        # host-parallel on hardware)
+        specs = [
+            SweepSpec(f"h{i}", ("report", "x.log")) for i in range(4)
+        ] + [SweepSpec(f"d{i}", ("p2p",)) for i in range(4)]
+        active_dev = []
+        max_dev = [0]
+        lock = threading.Lock()
+
+        def runner(spec):
+            is_dev = spec.name.startswith("d")
+            with lock:
+                if is_dev:
+                    active_dev.append(spec.name)
+                    max_dev[0] = max(max_dev[0], len(active_dev))
+            time.sleep(0.05)
+            with lock:
+                if is_dev:
+                    active_dev.remove(spec.name)
+            return 0, True
+
+        _, rec = run_cells(
+            specs, str(tmp_path), jobs=4, warm_workers=False,
+            cell_timeout=30, platform="tpu", subprocess_runner=runner,
+            progress=lambda s: None,
+        )
+        assert max_dev[0] == 1
+        assert rec.metrics["device_exclusive_cells"] == 4
+        assert rec.metrics["host_parallel_cells"] == 4
+
+    def test_failures_propagate_and_record(self, tmp_path):
+        specs = self._stub_specs(n_host=3, n_dev=0)
+        results, _ = run_cells(
+            specs, str(tmp_path), jobs=2, warm_workers=False,
+            cell_timeout=30, platform="cpu",
+            subprocess_runner=lambda s: (1, True),
+            progress=lambda s: None,
+        )
+        assert all(r.rc == 1 and r.completed for r in results)
+
+    def test_env_isolated_fans_out_off_tpu(self, tmp_path):
+        # env-isolated means "no warm process", not "serial": off-TPU a
+        # private subprocess IS the isolation, so the runtime.* cells
+        # must overlap instead of flooring the wall clock
+        specs = [
+            SweepSpec(
+                f"e{i}", ("concurrency",),
+                env=(("LIBTPU_INIT_ARGS", f"--flag{i}"),),
+            )
+            for i in range(4)
+        ]
+        active, peak = [], [0]
+        lock = threading.Lock()
+
+        def runner(spec):
+            with lock:
+                active.append(spec.name)
+                peak[0] = max(peak[0], len(active))
+            time.sleep(0.05)
+            with lock:
+                active.remove(spec.name)
+            return 0, True
+
+        results, rec = run_cells(
+            specs, str(tmp_path), jobs=4, warm_workers=False,
+            cell_timeout=30, platform="cpu", subprocess_runner=runner,
+            progress=lambda s: None,
+        )
+        assert peak[0] > 1  # overlapped
+        assert all(r.runner == "subprocess" for r in results)  # no worker
+        assert rec.metrics["env_isolated_cells"] == 4
+        # ...but on TPU the same cells serialize (they own the chip)
+        peak[0] = 0
+        _, _ = run_cells(
+            specs, str(tmp_path), jobs=4, warm_workers=False,
+            cell_timeout=30, platform="tpu", subprocess_runner=runner,
+            progress=lambda s: None,
+        )
+        assert peak[0] == 1
+
+    def test_single_host_cell_is_skipped_verdict(self, tmp_path):
+        # one cell at jobs=4: nothing to overlap — the Record must say
+        # SKIPPED, never claim a concurrency win
+        _, rec = run_cells(
+            [SweepSpec("h0", ("topo",))], str(tmp_path), jobs=4,
+            warm_workers=False, cell_timeout=30, platform="cpu",
+            subprocess_runner=lambda s: (0, True),
+            progress=lambda s: None,
+        )
+        assert rec.verdict.value == "SKIPPED"
+
+
+class TestRunSweepJobs:
+    def test_run_sweep_engine_checkpoints_and_banks_record(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        names = [
+            "p2p.compact.mesh.two_sided.n2",
+            "p2p.compact.visible.two_sided.n2",
+            "p2p.spread.mesh.two_sided.n2",
+        ]
+        monkeypatch.setattr(
+            sweep, "run_spec",
+            lambda spec, out, base_env=None, timeout=None: (0, True),
+        )
+        rc = sweep.run_sweep(
+            "p2p", out_dir=str(tmp_path), quick=True, names=names,
+            base_env={"JAX_PLATFORMS": "cpu"}, jobs=3, warm_workers=False,
+        )
+        assert rc == 0
+        state = sweep.load_sweep_state(str(tmp_path))
+        assert all(state[n]["completed"] for n in names)
+        with open(tmp_path / "sweep-engine.jsonl") as f:
+            recs = [json.loads(ln) for ln in f if ln.strip()]
+        assert recs and recs[-1]["mode"] == "engine"
+        assert recs[-1]["metrics"]["host_parallel_cells"] == 3
+        out = capsys.readouterr().out
+        assert "sweep cell" in out and "## engine |" in out
+
+    def test_engine_resume_skips_completed(self, tmp_path, monkeypatch):
+        name = "p2p.compact.mesh.two_sided.n2"
+        calls = []
+        monkeypatch.setattr(
+            sweep, "run_spec",
+            lambda spec, out, base_env=None, timeout=None: calls.append(
+                spec.name
+            ) or (0, True),
+        )
+        sweep.run_sweep(
+            "p2p", out_dir=str(tmp_path), quick=True, names=[name],
+            base_env={}, jobs=2, warm_workers=False,
+        )
+        sweep.run_sweep(
+            "p2p", out_dir=str(tmp_path), quick=True, names=[name],
+            base_env={}, jobs=2, warm_workers=False, resume=True,
+        )
+        assert calls == [name]  # engine + resume share one checkpoint
+
+    def test_engine_failure_rc_aggregates(self, tmp_path, monkeypatch):
+        name = "p2p.compact.mesh.two_sided.n2"
+        monkeypatch.setattr(
+            sweep, "run_spec",
+            lambda spec, out, base_env=None, timeout=None: (1, True),
+        )
+        rc = sweep.run_sweep(
+            "p2p", out_dir=str(tmp_path), quick=True, names=[name],
+            base_env={}, jobs=2, warm_workers=False,
+        )
+        assert rc == 1
+
+
+class TestWarmWorkers:
+    @pytest.fixture(scope="class")
+    def pool(self, tmp_path_factory):
+        from tpu_patterns.exec.workers import WorkerPool
+
+        d = tmp_path_factory.mktemp("workers")
+        pool = WorkerPool(1, _cpu_env(), log_dir=str(d))
+        yield pool
+        pool.shutdown()
+
+    def test_worker_serves_and_reuses(self, pool, tmp_path):
+        w = pool.lease()
+        assert w is not None and w.ready
+        for i in range(2):  # second cell reuses the warm runtime
+            log = tmp_path / f"cell{i}.log"
+            jsonl = tmp_path / f"cell{i}.jsonl"
+            resp = w.request(
+                {
+                    "op": "cell",
+                    "cell": f"cell{i}",
+                    "argv": ["topo"],
+                    "env": {"TPU_PATTERNS_SWEEP_CONFIG": "t"},
+                    "log": str(log),
+                    "jsonl": str(jsonl),
+                },
+                timeout=120,
+            )
+            assert resp["rc"] == 0 and resp["served"] == i + 1
+            assert "devices: 8 (cpu)" in log.read_text()
+        pool.release(w, reusable=True)
+        w2 = pool.lease()
+        assert w2 is w  # reuse hit
+        assert pool.hits == 1
+        pool.release(w2, reusable=True)
+
+    def test_worker_crash_in_cell_reports_rc_and_traceback(
+        self, pool, tmp_path
+    ):
+        w = pool.lease()
+        log = tmp_path / "bad.log"
+        resp = w.request(
+            {
+                "op": "cell",
+                "cell": "bad",
+                "argv": ["allreduce", "--algorithm", "ringg"],
+                "env": {},
+                "log": str(log),
+                "jsonl": str(tmp_path / "bad.jsonl"),
+            },
+            timeout=120,
+        )
+        assert resp["rc"] != 0
+        assert w.alive()  # a cell failure must not kill the server
+        # nonzero rc -> recycled, preserving the fresh-runtime guarantee
+        pool.release(w, reusable=False)
+        assert pool.recycled >= 1
+
+    def test_scheduler_worker_path_end_to_end(self, tmp_path):
+        # two REAL host-parallel cells through the warm-worker path: the
+        # log artifact must carry the export-context prologue and the
+        # same completion semantics as the subprocess path
+        specs = [
+            SweepSpec(
+                "t0", ("topo",), env=(("TPU_PATTERNS_SWEEP_CONFIG", "a"),)
+            ),
+            SweepSpec(
+                "t1", ("topo",), env=(("TPU_PATTERNS_SWEEP_CONFIG", "b"),)
+            ),
+        ]
+        results, rec = run_cells(
+            specs, str(tmp_path), jobs=2, warm_workers=True,
+            cell_timeout=240, base_env=_cpu_env(), platform="cpu",
+            progress=lambda s: None,
+        )
+        assert all(r.rc == 0 and r.completed for r in results)
+        assert {r.runner for r in results} == {"worker"}
+        text = (tmp_path / "t0.log").read_text()
+        assert text.startswith("export TPU_PATTERNS_SWEEP_CONFIG=a\n")
+        assert "devices: 8 (cpu)" in text
+        assert rec.metrics["worker_cells"] == 2
+
+
+class TestWorkerCircuitBreaker:
+    def test_broken_worker_init_kills_the_warm_path_fast(self, tmp_path):
+        # a wedged/broken worker init must not cost a spawn-wait PER
+        # CELL: after two consecutive failures the pool declares the
+        # warm path dead and lease() returns None instantly
+        from tpu_patterns.exec.workers import WorkerPool
+
+        env = _cpu_env()
+        env["TPU_PATTERNS_PLATFORM"] = "bogus_platform"  # init dies
+        pool = WorkerPool(2, env, log_dir=str(tmp_path))
+        try:
+            assert pool.lease() is None
+            assert pool.lease() is None
+            assert pool._dead
+            t0 = time.monotonic()
+            assert pool.lease() is None  # no spawn attempt at all
+            assert time.monotonic() - t0 < 1.0
+            assert pool.stats()["worker_hit_rate"] == 0.0
+        finally:
+            pool.shutdown()
+
+
+class TestWatchdogQueue:
+    def test_queued_deadline_fires_and_disarm_prevents(self, tmp_path):
+        from tpu_patterns import obs
+        from tpu_patterns.obs import watchdog
+
+        obs.configure(str(tmp_path))
+        try:
+            fired_before = len(watchdog.fired_dumps())
+            w = obs.watch_queued(
+                "test.queue.cell", deadline_s=0.2, cell="c1"
+            )
+            deadline = time.monotonic() + 10
+            while (
+                len(watchdog.fired_dumps()) == fired_before
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.1)
+            dumps = watchdog.fired_dumps()
+            assert len(dumps) > fired_before
+            assert "queued" in os.path.basename(dumps[-1])
+            w.done()
+            # a disarmed watch must NOT fire
+            w2 = obs.watch_queued("test.queue.fast", deadline_s=0.2)
+            w2.done()
+            n = len(watchdog.fired_dumps())
+            time.sleep(1.5)
+            assert len(watchdog.fired_dumps()) == n
+        finally:
+            obs.configure(None)
+
+
+class TestCliFlags:
+    def test_engine_flags_parse(self):
+        from tpu_patterns.cli import build_parser
+
+        args = build_parser().parse_args(
+            ["sweep", "p2p", "--jobs", "4", "--no-warm-workers",
+             "--name", "a", "--name", "b"]
+        )
+        assert args.jobs == 4 and args.no_warm_workers
+        assert args.name == ["a", "b"]
+
+    def test_engine_flags_rejected_for_promote_and_summarize(self):
+        from tpu_patterns.cli import main
+
+        for suite in ("promote", "summarize"):
+            with pytest.raises(SystemExit, match="do not apply"):
+                main(["sweep", suite, "--jobs", "4"])
+            with pytest.raises(SystemExit, match="do not apply"):
+                main(["sweep", suite, "--name", "x"])
+
+    def test_unknown_name_fails_loudly_via_cli(self, tmp_path):
+        # a one-line usage error at the CLI boundary, not a traceback
+        from tpu_patterns.cli import main
+
+        with pytest.raises(SystemExit, match="unknown cell name"):
+            main(["sweep", "p2p", "--quick", "--out", str(tmp_path),
+                  "--name", "nope"])

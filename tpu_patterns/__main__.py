@@ -1,6 +1,15 @@
+import os
 import sys
 
-from tpu_patterns.cli import main
-
 if __name__ == "__main__":
+    # Warm-worker server mode: the sweep engine pre-forks `python -m
+    # tpu_patterns` processes that serve cells over a pipe protocol
+    # instead of parsing argv (exec/worker.py) — dispatched BEFORE the
+    # CLI import so a worker pays only what it will reuse.
+    if os.environ.get("_TPU_PATTERNS_EXEC_WORKER"):
+        from tpu_patterns.exec.worker import main as worker_main
+
+        sys.exit(worker_main())
+    from tpu_patterns.cli import main
+
     sys.exit(main())

@@ -583,6 +583,17 @@ def _cmd_sweep(args, writer: ResultWriter) -> int:
         raise SystemExit("--gates-dir applies to 'sweep promote' only")
     if args.flash_dir and args.suite != "promote":
         raise SystemExit("--flash-dir applies to 'sweep promote' only")
+    if args.suite in ("promote", "summarize") and (
+        args.jobs != 1 or args.no_warm_workers or args.name
+    ):
+        # promote/summarize run no cells: engine flags would be no-ops
+        raise SystemExit(
+            "--jobs/--no-warm-workers/--name do not apply to "
+            f"'sweep {args.suite}'"
+        )
+    if args.jobs < 0:
+        # a typo'd width must not silently become an auto-width fan-out
+        raise SystemExit("--jobs must be >= 0 (0 = auto, 1 = serial)")
     if args.suite == "summarize":
         if args.quick or args.resume:
             # summarize reads BOTH tiers' cell names and runs nothing;
@@ -619,10 +630,17 @@ def _cmd_sweep(args, writer: ResultWriter) -> int:
             tuned = sweep.promote_tuned(args.out)
             print(f"# promoted {tuned}")
         return 0
-    rc = sweep.run_sweep(
-        args.suite, out_dir=args.out, quick=args.quick, resume=args.resume,
-        cell_timeout=args.cell_timeout,
-    )
+    try:
+        rc = sweep.run_sweep(
+            args.suite, out_dir=args.out, quick=args.quick,
+            resume=args.resume, cell_timeout=args.cell_timeout,
+            names=args.name, jobs=args.jobs,
+            warm_workers=not args.no_warm_workers,
+        )
+    except ValueError as e:
+        # usage errors (unknown --name cells, empty matches) read as a
+        # one-line message at the CLI boundary, not a harness traceback
+        raise SystemExit(f"error: {e}") from e
     if args.suite == "gates":
         # refit the grad-gate width from the clean-run spread
         fit = sweep.fit_gates(args.out)
@@ -1138,6 +1156,30 @@ def build_parser() -> argparse.ArgumentParser:
         default=DEFAULT_CELL_TIMEOUT,
         help="per-cell subprocess deadline in seconds; <= 0 disables it "
         "(a timed-out cell is not completed: --resume retries it)",
+    )
+    s.add_argument(
+        "--jobs",
+        type=int,
+        default=1,
+        help="concurrent engine width for host-parallel cells: 1 = the "
+        "serial engine (default, bit-identical to previous releases), "
+        "0 = auto (one per core, capped), N = N-wide; device-exclusive "
+        "and env-isolated cells always drain serially (docs/"
+        "sweep-engine.md)",
+    )
+    s.add_argument(
+        "--no-warm-workers",
+        action="store_true",
+        help="run every cell as a fresh subprocess even under --jobs "
+        "(warm workers skip the per-cell interpreter + JAX import + "
+        "backend-init tax for same-env host-parallel cells)",
+    )
+    s.add_argument(
+        "--name",
+        action="append",
+        metavar="CELL",
+        help="run only the named cell(s); repeatable (unknown names "
+        "fail loudly, never silently drop coverage)",
     )
 
     r = sub.add_parser("report", help="tabulate logs (≙ parse.py)")

@@ -36,6 +36,10 @@ class DoctorConfig:
     # the passes-preflight-then-hangs failure mode)
     deep: bool = True
     deep_timeout: int = 120
+    # probe the sweep engine's warm-worker path: spawn one worker, wait
+    # for backend-warm readiness, round-trip a ping (opt-in — it costs a
+    # full JAX init, ~seconds, so the default doctor stays fast)
+    workers: bool = False
     # watch mode: coalesce consecutive failing polls into ONE open/close
     # episode entry in this JSONL file instead of a line per poll (the
     # round-5 outage log was ~20 commits of per-poll noise)
@@ -209,6 +213,55 @@ def run_doctor(cfg: DoctorConfig, writer) -> list:
         **({} if loader_ok else {"error": str(io_loader.build_error())}),
     }
 
+    # warm-worker probe (opt-in): the sweep engine's fast path is a
+    # pre-initialized `python -m tpu_patterns` server — if IT cannot
+    # come up, `sweep --jobs N` silently degrades to cold subprocesses
+    # and every cell pays the init tax again.  Spawn one, time
+    # ready+ping, kill it.  Gated on the earlier layers like every
+    # device probe: a worker's warm_backend() would just wedge on the
+    # same broken backend for another probe_timeout of redundant noise.
+    if cfg.workers and broken is not None:
+        checks["warm_worker"] = {"ok": False, "error": f"skipped: {broken}"}
+    elif cfg.workers:
+        from tpu_patterns.exec.workers import WarmWorker, WorkerError
+
+        t0 = clock_ns()
+        w = None
+        try:
+            w = WarmWorker(dict(os.environ))
+            if w.wait_ready(timeout=cfg.probe_timeout):
+                spawn_s = (clock_ns() - t0) / 1e9
+                t1 = clock_ns()
+                resp = w.request({"op": "ping"}, timeout=cfg.probe_timeout)
+                checks["warm_worker"] = {
+                    "ok": resp.get("rc") == 0,
+                    "spawn_s": round(spawn_s, 2),
+                    "ping_ms": round((clock_ns() - t1) / 1e6, 1),
+                    **(
+                        {}
+                        if resp.get("rc") == 0
+                        else {"error": f"ping rc={resp.get('rc')}"}
+                    ),
+                }
+            else:
+                checks["warm_worker"] = {
+                    "ok": False,
+                    "error": (
+                        f"worker not ready within {cfg.probe_timeout}s "
+                        "(backend init wedged?)"
+                    ),
+                }
+        except (WorkerError, OSError) as e:
+            checks["warm_worker"] = {
+                "ok": False,
+                "error": f"{type(e).__name__}: {e}",
+            }
+        finally:
+            # ALWAYS reap: a protocol error mid-request must not leak a
+            # live backend-initialized worker (on TPU it holds the chip)
+            if w is not None:
+                w.kill()
+
     # watchdog probe: the obs layer's live hang evidence folded into the
     # health report.  A runtime can pass every probe NOW yet have wedged
     # ten minutes ago — the watchdog's flight-recorder dumps say so, and
@@ -234,7 +287,8 @@ def run_doctor(cfg: DoctorConfig, writer) -> list:
         detail = " ".join(
             f"{k}={c[k]}"
             for k in ("platform", "device_kind", "device_count", "init_s",
-                      "compile_s", "warm_3x_ms", "deep_s", "recent_dumps")
+                      "compile_s", "warm_3x_ms", "deep_s", "spawn_s",
+                      "ping_ms", "recent_dumps")
             if k in c
         )
         print(
@@ -248,7 +302,7 @@ def run_doctor(cfg: DoctorConfig, writer) -> list:
     for name, c in checks.items():
         metrics[f"{name}_ok"] = 1.0 if c.get("ok") else 0.0
         for k in ("init_s", "compile_s", "warm_3x_ms", "deep_s", "elapsed_s",
-                  "recent_dumps"):
+                  "spawn_s", "ping_ms", "recent_dumps"):
             if k in c:
                 metrics[f"{name}_{k}"] = float(c[k])
     # broken layer -> FAILURE; healthy but recent hang evidence ->

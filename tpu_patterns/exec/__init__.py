@@ -1,0 +1,51 @@
+"""exec/ — the concurrent sweep engine: resource-aware cell scheduling.
+
+The reference's concurrency suite exists to answer "does submitting
+independent work concurrently beat serial submission?" (SURVEY.md
+§Concurrency) — and until this subsystem the harness never applied the
+answer to itself: every sweep cell ran as a serial fresh subprocess,
+each paying the full interpreter + JAX import + backend-init tax, so a
+full ``sweep all`` was dominated by harness overhead rather than
+measurement time.  This package is that answer, applied:
+
+  classify.py   one cell -> one resource class: DEVICE_EXCLUSIVE (owns
+                the accelerator; drains serially, bit-identical to the
+                serial engine), HOST_PARALLEL (fans out N-wide), or
+                ENV_ISOLATED (spec.env mutates backend-init-time state;
+                keeps the fresh-subprocess path)
+  proc.py       process-GROUP subprocess runner: a timeout SIGKILLs the
+                whole group, so a grandchild holding the TPU dies with
+                its parent instead of wedging the next cell's backend
+                init (the round-5 "device backend unreachable" symptom)
+  worker.py     the warm-worker server side: a ``python -m tpu_patterns``
+                process that pre-pays JAX import + backend init once,
+                then accepts cell argv over a stdin/stdout pipe protocol
+  workers.py    the parent side: a bounded pool of warm workers, leased
+                per cell, recycled after K cells or on any nonzero rc to
+                preserve the fresh-runtime isolation guarantee
+  scheduler.py  the engine: per-class queues with per-class concurrency
+                limits, deterministic result ordering, obs spans/metrics
+                per cell (queue-wait vs run-time, worker reuse), queued-
+                cell watchdog deadlines, and ONE serial-vs-concurrent
+                speedup Record in the concurrency suite's own pass/fail
+                shape — the harness measured by its own discipline.
+
+``sweep.run_sweep(jobs=N)`` / ``tpu-patterns sweep <suite> --jobs N``
+is the entry point; ``--no-warm-workers`` keeps the subprocess path for
+every cell.  See docs/sweep-engine.md.
+"""
+
+from __future__ import annotations
+
+from tpu_patterns.exec.classify import (  # noqa: F401
+    CellClass,
+    classify,
+    detect_platform,
+)
+from tpu_patterns.exec.proc import kill_process_group, run_command  # noqa: F401
+from tpu_patterns.exec.scheduler import (  # noqa: F401
+    CellResult,
+    default_jobs,
+    run_cells,
+)
+from tpu_patterns.exec.workers import WorkerPool  # noqa: F401

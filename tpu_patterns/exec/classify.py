@@ -1,0 +1,135 @@
+"""Cell classification: which resource does a sweep cell actually own?
+
+Three classes, in decreasing order of constraint:
+
+* ENV_ISOLATED — the cell's ``spec.env`` mutates state that is read at
+  interpreter start or first backend init (``JAX_*``, ``XLA_*``,
+  ``LIBTPU_*``, the platform pins).  A warm worker has already paid
+  backend init, so these knobs would be silently inert in one — exactly
+  the silent-no-op failure mode ``check_runtime_bite`` polices.  These
+  cells keep the fresh-subprocess path unconditionally; the scheduler
+  still fans them out off-TPU (a private subprocess IS the isolation),
+  and serializes them on hardware, where they also own the chip.
+
+* DEVICE_EXCLUSIVE — the cell initializes a backend on a host with a
+  real TPU.  libtpu is single-process: a backend client owns the chip,
+  so these drain strictly serially — one cell's DMA must never share
+  the device with another's (nor with a warm worker's init), and their
+  results stay bit-identical to the serial engine's.  This includes
+  nominally "analysis" commands (topo, hlocheck, interop): their jax
+  import grabs the default backend too.
+
+* HOST_PARALLEL — everything else: every cell on a TPU-less host (the
+  CPU-simulated mesh — where the whole wall-clock win lives), plus the
+  few backend-free log/manifest readers on any host.  These fan out
+  across a bounded worker pool.
+
+Framework-tier env vars (``TPU_PATTERNS_SWEEP_CONFIG``, ``..._TIER``,
+``..._TIMING``, the workload knobs) are re-read from ``os.environ`` by
+each run's config stack, so a warm worker can apply them per cell —
+they do NOT force isolation.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import sys
+from typing import Mapping
+
+from tpu_patterns.sweep import SweepSpec
+
+
+class CellClass(enum.Enum):
+    DEVICE_EXCLUSIVE = "device_exclusive"
+    HOST_PARALLEL = "host_parallel"
+    ENV_ISOLATED = "env_isolated"
+
+
+# spec.env keys that are read at interpreter/backend-init time — too
+# late to apply inside a warm worker or a shared host process.
+_BACKEND_ENV_PREFIXES = ("JAX_", "XLA_", "LIBTPU_")
+_BACKEND_ENV_KEYS = frozenset(
+    {
+        "TPU_PATTERNS_PLATFORM",
+        "TPU_PATTERNS_CPU_DEVICES",
+        "TPU_PATTERNS_CACHE_DIR",
+        "PYTHONPATH",
+        "LD_PRELOAD",
+    }
+)
+
+# CLI subcommands that NEVER initialize a JAX backend (log/manifest
+# readers only).  On a real TPU, libtpu is single-process: ANY cell that
+# inits a backend — including "analysis" passes like topo/interop/
+# hlocheck, whose jax import grabs the default (TPU) client — owns the
+# chip, so only these stay host-parallel there.  An unknown future
+# subcommand defaults to device-owning (serial): misclassifying toward
+# safety costs wall-clock, never correctness.
+BACKEND_FREE_COMMANDS = frozenset({"report", "ckpt", "obs"})
+
+
+def _mutates_backend_env(spec: SweepSpec) -> bool:
+    return any(
+        k.startswith(_BACKEND_ENV_PREFIXES) or k in _BACKEND_ENV_KEYS
+        for k, _ in spec.env
+    )
+
+
+def classify(spec: SweepSpec, platform: str) -> CellClass:
+    """Resource class of one cell under the given backend platform.
+
+    ``platform`` is the backend the CELLS will run on (``"tpu"``,
+    ``"cpu"``, ...) — detected without initializing a backend in the
+    scheduling parent (:func:`detect_platform`), because on real
+    hardware the parent grabbing the chip would starve every child.
+    """
+    if _mutates_backend_env(spec):
+        return CellClass.ENV_ISOLATED
+    cmd = spec.argv[0] if spec.argv else ""
+    if platform == "tpu" and cmd not in BACKEND_FREE_COMMANDS:
+        # unknown commands fall here too: device-owning until proven not
+        return CellClass.DEVICE_EXCLUSIVE
+    return CellClass.HOST_PARALLEL
+
+
+def detect_platform(env: Mapping[str, str] | None = None) -> str:
+    """Best-effort backend platform WITHOUT initiating a backend.
+
+    The scheduler must never initialize JAX in the sweep parent — on
+    hardware that would take the very device lock every cell needs.
+    Order: the env pins ``runtime.setup_jax`` honors; an ALREADY
+    initialized in-process backend (free to ask — the init this
+    function avoids has happened); chip-presence heuristics (TPU device
+    nodes, an importable libtpu).  When every signal is negative the
+    host has no TPU this process could see ⇒ ``"cpu"`` and the fan-out
+    proceeds; a TPU reachable only through an exotic runtime plugin
+    that leaves no such trace must be pinned explicitly
+    (``TPU_PATTERNS_PLATFORM``/``JAX_PLATFORMS``) — every capture
+    ladder here already pins, so the failure mode requires both an
+    invisible plugin AND an unpinned env.
+    """
+    env = os.environ if env is None else env
+    for key in ("TPU_PATTERNS_PLATFORM", "JAX_PLATFORMS"):
+        v = env.get(key, "")
+        if v.strip():
+            return v.split(",")[0].strip().lower()
+    if env is os.environ and "jax" in sys.modules:
+        from tpu_patterns.runtime import _backends_initialized
+
+        if _backends_initialized():
+            import jax
+
+            return jax.default_backend()
+    import glob
+
+    if glob.glob("/dev/accel*") or glob.glob("/dev/vfio/*"):
+        return "tpu"
+    import importlib.util
+
+    try:
+        if importlib.util.find_spec("libtpu") is not None:
+            return "tpu"
+    except (ImportError, ValueError):
+        pass
+    return "cpu"

@@ -1,0 +1,100 @@
+"""Process-group subprocess runner: timeouts kill the WHOLE group.
+
+``subprocess.run(timeout=...)`` kills only the direct child; a cell
+whose child forked a grandchild (a wedged compile server, a runaway
+loader thread's helper, anything double-forked) leaves that grandchild
+alive and holding the TPU — which then fails the NEXT cell's backend
+init, exactly the round-5 "device backend unreachable" symptom.  Every
+cell subprocess here starts in its own session (= its own process
+group), and a deadline SIGKILLs the group, so nothing the cell spawned
+survives it.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+from typing import Mapping, Sequence
+
+
+def kill_process_group(proc: subprocess.Popen) -> None:
+    """SIGKILL ``proc``'s whole process group (it was started with
+    ``start_new_session=True``, so pgid == pid); falls back to killing
+    the lone child when the group is already gone."""
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            proc.kill()
+        except OSError:
+            pass
+
+
+def run_command(
+    cmd: Sequence[str],
+    env: Mapping[str, str] | None = None,
+    timeout: float | None = None,
+    cwd: str | None = None,
+) -> tuple[str, int, bool]:
+    """Run ``cmd`` in its own process group; returns
+    ``(stdout_text, rc, timed_out)`` with stderr folded into stdout.
+
+    On timeout the group is SIGKILLed and the partial output captured so
+    far (the lines before the hang — the diagnostic that says WHERE it
+    hung) is still returned; ``rc`` is 1 and ``timed_out`` True.
+    ``timeout`` <= 0 or None means no deadline.
+    """
+    proc = subprocess.Popen(
+        list(cmd),
+        env=dict(env) if env is not None else None,
+        cwd=cwd,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        start_new_session=True,
+    )
+    try:
+        stdout, _ = proc.communicate(
+            timeout=timeout if timeout and timeout > 0 else None
+        )
+        return stdout or "", proc.returncode, False
+    except subprocess.TimeoutExpired:
+        kill_process_group(proc)
+        # reap + drain: communicate() after the kill returns everything
+        # the child flushed before dying
+        try:
+            stdout, _ = proc.communicate(timeout=30)
+        except subprocess.TimeoutExpired:  # pipe wedged by a survivor
+            proc.kill()
+            stdout = ""
+        if isinstance(stdout, bytes):  # defensive: text=True normally
+            stdout = stdout.decode(errors="replace")
+        return stdout or "", 1, True
+    except BaseException:
+        # the caller is dying (KeyboardInterrupt, a scheduler bug):
+        # never leave the cell's group running behind us
+        kill_process_group(proc)
+        raise
+
+
+def popen_in_group(
+    cmd: Sequence[str],
+    env: Mapping[str, str] | None = None,
+    **kwargs,
+) -> subprocess.Popen:
+    """``Popen`` in a fresh session/group — the warm-worker spawn path,
+    sharing the same group-kill discipline as :func:`run_command`."""
+    return subprocess.Popen(
+        list(cmd),
+        env=dict(env) if env is not None else None,
+        start_new_session=True,
+        **kwargs,
+    )
+
+
+def python_argv() -> list[str]:
+    """Unbuffered interpreter argv for protocol children: a pipe-buffered
+    stdout would hold protocol/progress lines hostage past deadlines."""
+    return [sys.executable, "-u"]

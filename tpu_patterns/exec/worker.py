@@ -1,0 +1,152 @@
+"""Warm-worker server: one pre-initialized runtime, many cells.
+
+Spawned as ``python -m tpu_patterns`` with ``_TPU_PATTERNS_EXEC_WORKER=1``
+(``__main__.py`` dispatches here before touching the CLI).  The worker
+pays the interpreter + JAX import + backend-init + compilation-cache
+warmup tax ONCE (``runtime.warm_backend``), announces readiness, then
+serves cells over a line-oriented JSON pipe protocol:
+
+  parent -> worker (stdin):  {"op": "cell", "cell": name,
+                              "argv": [...], "env": {...},
+                              "log": path, "jsonl": path}
+                             {"op": "ping"} | {"op": "shutdown"}
+  worker -> parent (stdout): {"ready": true, "pid": ..., "platform": ...}
+                             {"op": "cell", "cell": ..., "rc": ...,
+                              "served": k}
+
+Each cell runs IN-PROCESS via ``cli.main(["--jsonl", jsonl, *argv])``
+with fds 1/2 rerouted to the cell's log file for the duration (native
+XLA chatter included — the log looks exactly like the subprocess
+path's), and the cell's framework-tier env applied around the call.
+The protocol channel is a private dup of the original stdout taken
+before any cell can scribble on fd 1.
+
+Isolation: the worker serves ONE cell at a time, and the parent
+recycles it after K cells or on any nonzero rc (workers.py) — the
+"fresh runtime" guarantee sweep.py's subprocess design exists for is
+weakened only between consecutive PASSING same-env cells, which share
+nothing but a hot backend and a warm compile cache.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import traceback
+from typing import IO
+
+ENV_FLAG = "_TPU_PATTERNS_EXEC_WORKER"
+
+
+def _send(out: IO[str], obj: dict) -> None:
+    out.write(json.dumps(obj) + "\n")
+    out.flush()
+
+
+def _run_cell(req: dict) -> int:
+    """One in-process CLI run with fd-level log capture + env overlay."""
+    argv = [str(a) for a in req.get("argv", [])]
+    log_path = req.get("log")
+    jsonl_path = req.get("jsonl")
+    env_overlay = {str(k): str(v) for k, v in (req.get("env") or {}).items()}
+
+    saved_env = {k: os.environ.get(k) for k in env_overlay}
+    os.environ.update(env_overlay)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    saved1, saved2 = os.dup(1), os.dup(2)
+    logf = open(log_path, "a") if log_path else None
+    try:
+        if logf is not None:
+            os.dup2(logf.fileno(), 1)
+            os.dup2(logf.fileno(), 2)
+        from tpu_patterns.cli import main as cli_main
+
+        try:
+            cli_args = (["--jsonl", jsonl_path] if jsonl_path else []) + argv
+            rc = cli_main(cli_args)
+        except SystemExit as e:  # argparse errors / explicit exits —
+            # keep subprocess semantics: bare sys.exit() is SUCCESS, a
+            # message exit prints the message (fd 2 is the cell log)
+            if e.code is None or isinstance(e.code, int):
+                rc = e.code or 0
+            else:
+                print(e.code, file=sys.stderr)
+                rc = 1
+        except Exception:
+            # same artifact a crashing subprocess leaves: the traceback
+            # in the cell log (run_spec's completed test keys on it)
+            traceback.print_exc()
+            rc = 1
+        return int(rc or 0)
+    finally:
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os.dup2(saved1, 1)
+        os.dup2(saved2, 2)
+        os.close(saved1)
+        os.close(saved2)
+        if logf is not None:
+            logf.close()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def serve(proto_in: IO[str], proto_out: IO[str]) -> int:
+    """The worker main loop: warm the backend, then serve requests until
+    EOF or a shutdown op.  Protocol errors terminate the worker (the
+    parent treats a dead worker as a miss and falls back to the
+    subprocess path)."""
+    try:
+        from tpu_patterns.runtime import warm_backend
+
+        platform = warm_backend()
+    except Exception as e:
+        _send(
+            proto_out,
+            {"ready": False, "error": f"{type(e).__name__}: {e}"},
+        )
+        return 1
+    _send(proto_out, {"ready": True, "pid": os.getpid(), "platform": platform})
+    served = 0
+    for line in proto_in:
+        if not line.strip():
+            continue
+        try:
+            req = json.loads(line)
+        except ValueError:
+            return 2  # garbled request: the pipe is not trustworthy
+        op = req.get("op")
+        if op == "shutdown":
+            return 0
+        if op == "ping":
+            _send(proto_out, {"op": "ping", "rc": 0, "served": served})
+            continue
+        if op != "cell":
+            _send(proto_out, {"op": op, "rc": 1, "error": "unknown op"})
+            continue
+        rc = _run_cell(req)
+        served += 1
+        _send(
+            proto_out,
+            {"op": "cell", "cell": req.get("cell", ""), "rc": rc,
+             "served": served},
+        )
+    return 0
+
+
+def main() -> int:
+    # Claim the protocol channel FIRST, then point fd 1 at stderr so a
+    # stray library print between cells can never corrupt the protocol.
+    proto_fd = os.dup(1)
+    os.dup2(2, 1)  # sys.stdout now lands on stderr between cells
+    proto_out = os.fdopen(proto_fd, "w")
+    return serve(sys.stdin, proto_out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,264 @@
+"""Warm-worker pool (parent side): lease, run, recycle.
+
+A :class:`WorkerPool` holds up to ``size`` live workers.  ``lease()``
+hands a ready worker to exactly one scheduler thread; ``release()``
+returns it for reuse — or kills it when the cell failed, timed out, or
+the worker hit its recycle budget.  A worker that dies mid-protocol is
+a MISS: the caller falls back to the fresh-subprocess path, so warm
+workers are purely an optimization, never a correctness dependency.
+
+Recycle policy (the fresh-runtime guarantee, bounded): a worker serves
+at most ``TPU_PATTERNS_WORKER_RECYCLE`` cells (default 25) and is
+killed on the first nonzero rc — a failing cell may have poisoned
+process state (leaked device buffers, a wedged compile client), and
+the cell after it must not inherit that.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import threading
+from typing import Mapping
+
+from tpu_patterns.exec import proc as _proc
+from tpu_patterns.exec.worker import ENV_FLAG
+
+DEFAULT_RECYCLE_AFTER = int(
+    os.environ.get("TPU_PATTERNS_WORKER_RECYCLE", "25")
+)
+# backend init on a remote-compiled runtime can take tens of seconds;
+# double the sweep preflight budget, not the cell budget
+READY_TIMEOUT_S = float(os.environ.get("TPU_PATTERNS_WORKER_READY_S", "180"))
+
+
+class WorkerError(RuntimeError):
+    """The worker died or broke protocol — fall back to a subprocess."""
+
+
+class WarmWorker:
+    """One live server process (see exec/worker.py for the protocol)."""
+
+    def __init__(
+        self,
+        base_env: Mapping[str, str],
+        stderr_path: str | None = None,
+        recycle_after: int = DEFAULT_RECYCLE_AFTER,
+    ):
+        self.recycle_after = recycle_after
+        self.served = 0
+        self.ready = False
+        self._stderr_f = open(stderr_path, "ab") if stderr_path else None
+        self.proc = _proc.popen_in_group(
+            [*_proc.python_argv(), "-m", "tpu_patterns"],
+            env={**base_env, ENV_FLAG: "1"},
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=self._stderr_f
+            if self._stderr_f is not None
+            else subprocess.DEVNULL,
+            text=True,
+        )
+
+    def _read_line(self, timeout: float | None) -> str | None:
+        """One protocol line with a deadline; None = deadline passed.
+
+        A helper thread does the blocking readline: killing the worker
+        EOFs the pipe, which unblocks and reaps the helper — no fd
+        select games against Python's buffered reader.
+        """
+        box: dict = {}
+
+        def read():
+            try:
+                box["line"] = self.proc.stdout.readline()
+            except (ValueError, OSError):
+                box["line"] = ""
+
+        t = threading.Thread(target=read, daemon=True)
+        t.start()
+        t.join(timeout if timeout and timeout > 0 else None)
+        if t.is_alive():
+            return None
+        return box.get("line", "")
+
+    def wait_ready(self, timeout: float = READY_TIMEOUT_S) -> bool:
+        if self.ready:
+            return True
+        line = self._read_line(timeout)
+        if not line:
+            return False
+        try:
+            msg = json.loads(line)
+        except ValueError:
+            return False
+        self.ready = bool(msg.get("ready"))
+        return self.ready
+
+    def request(self, req: dict, timeout: float | None) -> dict:
+        """One request/response round trip.  Raises :class:`WorkerError`
+        on a dead/garbled worker; returns ``{"timed_out": True}`` after
+        killing the group on deadline."""
+        try:
+            self.proc.stdin.write(json.dumps(req) + "\n")
+            self.proc.stdin.flush()
+        except (BrokenPipeError, OSError) as e:
+            raise WorkerError(f"worker pipe closed: {e}") from e
+        line = self._read_line(timeout)
+        if line is None:
+            self.kill()
+            return {"timed_out": True}
+        if not line:
+            raise WorkerError("worker EOF mid-request")
+        try:
+            resp = json.loads(line)
+        except ValueError as e:
+            raise WorkerError(f"garbled worker response: {line!r}") from e
+        if req.get("op") == "cell":
+            self.served += 1
+        return resp
+
+    @property
+    def expired(self) -> bool:
+        return self.served >= self.recycle_after
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def kill(self) -> None:
+        _proc.kill_process_group(self.proc)
+        try:
+            self.proc.wait(timeout=10)
+        except Exception:
+            pass
+        for f in (self.proc.stdin, self.proc.stdout, self._stderr_f):
+            try:
+                if f is not None:
+                    f.close()
+            except OSError:
+                pass
+
+    def shutdown(self) -> None:
+        """Polite exit first (lets the worker flush), then the hammer."""
+        try:
+            self.proc.stdin.write(json.dumps({"op": "shutdown"}) + "\n")
+            self.proc.stdin.flush()
+            self.proc.wait(timeout=5)
+        except Exception:
+            pass
+        self.kill()
+
+
+class WorkerPool:
+    """Bounded pool with reuse accounting.
+
+    ``stats()`` feeds the engine Record: a cell served by a worker that
+    had already served at least one cell is a reuse HIT (it paid zero
+    init tax); a fresh spawn's first cell is a MISS (it paid the init,
+    though concurrently with other work).
+    """
+
+    def __init__(
+        self,
+        size: int,
+        base_env: Mapping[str, str],
+        log_dir: str | None = None,
+        recycle_after: int = DEFAULT_RECYCLE_AFTER,
+    ):
+        self.size = max(1, int(size))
+        self.base_env = dict(base_env)
+        self.log_dir = log_dir
+        self.recycle_after = recycle_after
+        self._lock = threading.Lock()
+        self._free: list[WarmWorker] = []
+        self._spawned = 0
+        self.hits = 0
+        self.misses = 0
+        self.recycled = 0
+        # circuit breaker: after this many consecutive spawn/ready
+        # failures the warm path is declared dead and every later
+        # lease() returns None instantly — without it, a wedged worker
+        # init costs READY_TIMEOUT_S per CELL, making --jobs strictly
+        # slower than --no-warm-workers on exactly the broken-backend
+        # hosts the engine's history is about
+        self._spawn_failures = 0
+        self._dead = False
+
+    def _spawn(self) -> WarmWorker | None:
+        with self._lock:
+            n = self._spawned
+            self._spawned += 1
+        stderr_path = None
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+            stderr_path = os.path.join(self.log_dir, f"worker-{n}.log")
+        try:
+            w = WarmWorker(
+                self.base_env, stderr_path, recycle_after=self.recycle_after
+            )
+        except OSError:
+            return None
+        if not w.wait_ready():
+            w.kill()
+            return None
+        return w
+
+    def lease(self) -> WarmWorker | None:
+        """A ready worker, or None when warm execution is unavailable
+        (spawn/init failed, or the warm path was declared dead) — the
+        caller then runs the subprocess path."""
+        with self._lock:
+            while self._free:
+                w = self._free.pop()
+                if w.alive():
+                    self.hits += 1
+                    return w
+                w.kill()
+            if self._dead:
+                self.misses += 1
+                return None
+        w = self._spawn()
+        if w is None:
+            with self._lock:
+                self.misses += 1
+                self._spawn_failures += 1
+                if self._spawn_failures >= 2:  # one retry absorbs a blip
+                    self._dead = True
+            return None
+        with self._lock:
+            self._spawn_failures = 0
+            # a fresh worker's first cell still skipped nothing: count
+            # the cold init it paid (concurrently, but paid)
+            self.misses += 1
+        return w
+
+    def release(self, worker: WarmWorker, reusable: bool) -> None:
+        if not reusable or worker.expired or not worker.alive():
+            self.recycled += 1
+            worker.kill()
+            return
+        with self._lock:  # decide under the lock, act outside it: a
+            # shutdown's bounded waits must not stall every other
+            # lease/release on the pool
+            keep = len(self._free) < self.size
+            if keep:
+                self._free.append(worker)
+        if not keep:
+            worker.shutdown()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            workers, self._free = self._free, []
+        for w in workers:
+            w.shutdown()
+
+    def stats(self) -> dict:
+        total = self.hits + self.misses
+        return {
+            "worker_cells": float(total),
+            "worker_reuse_hits": float(self.hits),
+            "worker_spawns": float(self._spawned),
+            "worker_recycled": float(self.recycled),
+            "worker_hit_rate": (self.hits / total) if total else 0.0,
+        }

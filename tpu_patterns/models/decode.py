@@ -463,8 +463,17 @@ def make_decoder(
     prefill_len: int,
     gen_cap: int,
     cache_int8: bool = False,
+    donate: bool = False,
 ):
     """Build the jitted (prefill, generate) pair over a dp x sp x tp mesh.
+
+    ``donate=True`` donates the KV caches into ``generate`` (in/out
+    cache specs match, so XLA scatters new K/V slots into the SAME HBM
+    buffers instead of copying the whole cache per call — at long
+    context the cache dwarfs everything else the decode step touches).
+    OPT-IN: donation consumes the caller's cache, so branching decode
+    (the same prefix generated twice, the split-vs-whole agreement
+    tests) must keep the copying path.
 
     * ``prefill(params, x, lens=None) -> (caches, y_last)``: run the
       (right-padded) prompt [batch, prefill_len, E] through every layer,
@@ -579,6 +588,9 @@ def make_decoder(
                 out_specs=(cache_specs, tok_spec),
                 check_vma=False,
             ),
+            # argnum 1 is the cache dict: in/out specs match, so the
+            # donated buffers are updated in place
+            donate_argnums=(1,) if donate else (),
         )
 
     def _gen(params, caches, y0, t0, n_steps):
@@ -645,9 +657,12 @@ def run_decode(mesh: Mesh, cfg: DecodeConfig, writer) -> list:
     sp = int(mesh.shape["sp"])
     n_exp = _n_experts(mesh, mcfg)
     gen_cap = cfg.gen + (-cfg.gen % sp)
+    # the measured pattern owns its cache lifecycle: donate, so the timed
+    # scan updates K/V slots in place instead of copying the whole
+    # long-context cache every generate call
     prefill, generate = make_decoder(
         mesh, mcfg, cfg.batch, cfg.prefill, gen_cap,
-        cache_int8=cfg.cache_int8,
+        cache_int8=cfg.cache_int8, donate=True,
     )
     max_len = cfg.prefill + gen_cap
     params = jax.device_put(
@@ -685,8 +700,13 @@ def run_decode(mesh: Mesh, cfg: DecodeConfig, writer) -> list:
         def run():
             # every iteration regenerates the SAME positions (t0 fixed, so
             # work per iter is identical and capacity is never exceeded);
-            # data dependence flows through caches and the fed-back token
-            c, y, out = caches, y0, None
+            # data dependence flows through caches and the fed-back token.
+            # Donation consumes each iteration's cache, so the chain
+            # starts from a fresh copy of the prefill cache — one copy
+            # per chain, constant across chain lengths, cancelling in
+            # the amortized differential (timing.measure_chain).
+            c = jax.tree.map(jnp.copy, caches)
+            y, out = y0, None
             for _ in range(k):
                 c, out = generate(params, c, y, t0, cfg.gen)
                 y = out[:, -1:, :]

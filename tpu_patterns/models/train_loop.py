@@ -220,8 +220,18 @@ def train(mesh: Mesh, cfg: TrainLoopConfig, writer=None) -> dict:
     # mean objective (normalize by output element count): lr scales stay
     # independent of batch/seq, unlike the bench's unnormalized sum
     n_global = float(cfg.batch * cfg.seq * cfg.embed)
+    # The loop owns the state lifecycle end to end, so both step builders
+    # run with donate=True: each step consumes the previous state and
+    # updates it in place — no step holds old+new params (or, under
+    # ZeRO, old+new moments) live in HBM at once.  Everything that reads
+    # state does so BEFORE the next step donates it: ckpt.save reads
+    # synchronously, AsyncSaver snapshots to host inside save() (its
+    # documented contract — "the device arrays are free to be mutated
+    # immediately"), and loss is a fresh output.
     if cfg.optimizer == "sgd":
-        step_fn, _ = make_train_step(mesh, mcfg, lr=cfg.lr, n_global=n_global)
+        step_fn, _ = make_train_step(
+            mesh, mcfg, lr=cfg.lr, n_global=n_global, donate=True
+        )
         # resuming: an abstract template suffices — restore supplies the
         # values, so the init compute + transient second copy are skipped
         state = {
@@ -237,7 +247,7 @@ def train(mesh: Mesh, cfg: TrainLoopConfig, writer=None) -> dict:
         zstep, zinit, shard_specs = make_zero_train_step(
             mesh, mcfg, lr=cfg.lr,
             optimizer=cfg.optimizer.split("-", 1)[1],
-            n_global=n_global,
+            n_global=n_global, donate=True,
         )
         if resume_step is not None:
             sh_abs, opt_abs = jax.eval_shape(zinit, abs_params)

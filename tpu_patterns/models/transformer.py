@@ -502,6 +502,7 @@ def make_train_step(
     lr: float = 1e-3,
     x_spec: P | None = None,
     n_global: float = 1.0,
+    donate: bool = False,
 ):
     """jit-compiled full training step (fwd + bwd + SGD) over the mesh.
 
@@ -511,6 +512,15 @@ def make_train_step(
     reductions.  ``n_global`` normalizes the summed objective (1.0 for
     the bench, where the lr underflows anyway; the element count for real
     training so lr scales don't depend on batch/seq).
+
+    ``donate=True`` donates the params argument to the update
+    (``donate_argnums``): in and out shardings match, so XLA updates the
+    train state in place instead of holding old+new params live across
+    the step — the steady-state HBM copy the train loop exists to avoid.
+    OPT-IN because donation consumes the caller's buffers: comparative
+    callers (the bench's before/after contrasts, the agreement gates,
+    tests re-deriving a reference from the same params) legitimately
+    reuse params after a step and must keep the copying path.
     """
     x_spec = x_spec or P("dp", "sp", None)
     axes = ("dp", "sp")  # tp is already reduced inside the forward
@@ -539,7 +549,7 @@ def make_train_step(
         in_specs=(pspecs, x_spec),
         out_specs=(pspecs, P()),
     )
-    return jax.jit(sharded), pspecs
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ()), pspecs
 
 
 def _local_shape(shape: tuple, spec: P, mesh: Mesh) -> tuple:
@@ -562,6 +572,7 @@ def make_zero_train_step(
     optimizer: str = "adam",
     offload_state: bool = False,
     n_global: float = 1.0,
+    donate: bool = False,
 ):
     """ZeRO-1 twin of :func:`make_train_step` (parallel/zero.py).
 
@@ -587,6 +598,11 @@ def make_zero_train_step(
     leave HBM entirely between steps, XLA inserting the host<->device DMA
     around the shard update — ZeRO-1 composed with host offload, the
     second standard optimizer-memory lever.
+
+    ``donate=True`` donates the param shards and optimizer moments to
+    their updated selves (same in/out specs ⇒ in-place update, no
+    old+new double residency); opt-in with the same reuse caveat as
+    :func:`make_train_step`.
     """
     import optax
 
@@ -751,7 +767,11 @@ def make_zero_train_step(
         in_specs=(shard_specs, state_specs, x_spec),
         out_specs=(shard_specs, state_specs, P()),
     )
-    raw_step = jax.jit(sharded)
+    # donate=True: the param shards AND the optimizer moments alias their
+    # outputs (same specs in and out) — under ZeRO the moments are the
+    # dominant optimizer memory, so this is the bigger half of the win.
+    # Same opt-in contract as make_train_step.
+    raw_step = jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
     if offload_state:
 
         def step_fn(pshards, opt_state, x):
@@ -892,6 +912,20 @@ def flagship_flops(cfg: FlagshipConfig) -> float:
                 f"unknown remat_policy {policy!r}; want full|dots"
             )
     return step_flops * cfg.depth
+
+
+def donation_took(jitted, *args) -> bool | None:
+    """Whether the compiled program ACTUALLY aliases donated inputs onto
+    outputs (``memory_analysis().alias_size_in_bytes`` > 0) — donation
+    is a request, and a backend may silently decline it, so the donating
+    callers' tests assert on this instead of trusting ``donate_argnums``.
+    None when the backend exposes no memory-analysis API (assert nothing
+    rather than something false)."""
+    try:
+        ma = jitted.lower(*args).compile().memory_analysis()
+        return float(ma.alias_size_in_bytes) > 0
+    except Exception:
+        return None
 
 
 def _memory_metrics(jitted, *args) -> dict[str, float]:

@@ -50,7 +50,11 @@ from tpu_patterns.obs.spans import (  # noqa: F401
     set_enabled,
     span,
 )
-from tpu_patterns.obs.watchdog import find_dumps, fired_dumps  # noqa: F401
+from tpu_patterns.obs.watchdog import (  # noqa: F401
+    find_dumps,
+    fired_dumps,
+    watch_queued,
+)
 
 
 def flight_recorder() -> "_recorder.FlightRecorder":

@@ -123,6 +123,22 @@ def chip_peak_tflops(dtype=None) -> float | None:
     return peak
 
 
+def warm_backend() -> str:
+    """Pay the slow process-start costs NOW: platform setup, persistent
+    compile cache, first backend init.  Returns the live platform name.
+
+    This is the whole point of a warm worker (exec/worker.py) and of
+    bench.py's server child: the interpreter + JAX import + backend
+    init costs seconds per process (tens on remote-compiled runtimes),
+    and a sweep pays it per CELL unless a warm process absorbs it once.
+    """
+    setup_jax()
+    import jax
+
+    jax.devices()  # first backend touch — the init this exists to prepay
+    return jax.default_backend()
+
+
 def _backends_initialized() -> bool:
     """Whether any JAX backend client already exists in this process."""
     try:

@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import subprocess
 import sys
 from typing import Mapping, Sequence
 
@@ -1634,6 +1633,33 @@ def summarize_sweep(out_dir: str) -> str:
 DEFAULT_CELL_TIMEOUT = 1800.0
 
 
+def cell_completed(
+    rc: int, timed_out: bool, output: str, jsonl_path: str
+) -> bool:
+    """Whether a cell's run COMPLETED: the measurement reached a verdict,
+    even a FAILURE one (an honest perf verdict is a RESULT, ≙ the
+    reference's FAILURE table rows) — as opposed to a timeout/crash,
+    which left no verdict and must be re-run on ``--resume``.  Shared by
+    the subprocess path (:func:`run_spec`) and the warm-worker path
+    (exec/scheduler.py) so the two engines cannot drift on resume
+    semantics.  rc < 0 is a signal kill (OOM/segfault) — never
+    completed, even if some records were flushed before the kill."""
+    has_records = False
+    try:
+        with open(jsonl_path) as f:
+            has_records = any(line.strip() for line in f)
+    except OSError:
+        pass
+    return not timed_out and (
+        rc == 0
+        or (
+            rc > 0
+            and has_records
+            and "Traceback (most recent call last)" not in output
+        )
+    )
+
+
 def run_spec(
     spec: SweepSpec,
     out_dir: str,
@@ -1642,7 +1668,15 @@ def run_spec(
 ) -> tuple[int, bool]:
     """Run one cell: subprocess CLI, log tee'd to ``<name>.log``, JSONL to
     ``<name>.jsonl`` (≙ ``|& tee -a $log``, run_omp.sh:26).  Returns
-    ``(rc, completed)`` — see the completion comment below."""
+    ``(rc, completed)`` — see :func:`cell_completed`.
+
+    The child runs in its own process GROUP and a timeout SIGKILLs the
+    whole group (exec/proc.py): ``subprocess.run(timeout=...)`` killed
+    only the direct child, so a grandchild could survive holding the
+    TPU and fail the NEXT cell's backend init — the round-5 "device
+    backend unreachable" symptom."""
+    from tpu_patterns.exec.proc import run_command
+
     os.makedirs(out_dir, exist_ok=True)
     log_path = os.path.join(out_dir, f"{spec.name}.log")
     jsonl_path = os.path.join(out_dir, f"{spec.name}.jsonl")
@@ -1650,54 +1684,20 @@ def run_spec(
         os.unlink(jsonl_path)  # ResultWriter appends; stale cells must not leak
     env = dict(base_env if base_env is not None else os.environ)
     env.update(dict(spec.env))
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-m", "tpu_patterns", "--jsonl", jsonl_path, *spec.argv],
-            env=env,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-            timeout=timeout if timeout > 0 else None,  # <= 0: no deadline
-        )
-        stdout, rc = proc.stdout, proc.returncode
-        timed_out = False
-    except subprocess.TimeoutExpired as e:
-        # TimeoutExpired carries the child's partial output as BYTES even
-        # in text mode — decode it so the lines before the hang (the
-        # diagnostic that says where it hung) reach the cell log.
-        partial = e.stdout or b""
-        stdout = (
-            partial if isinstance(partial, str)
-            else partial.decode(errors="replace")
-        )
+    stdout, rc, timed_out = run_command(
+        [sys.executable, "-m", "tpu_patterns", "--jsonl", jsonl_path,
+         *spec.argv],
+        env=env,
+        timeout=timeout,  # <= 0: no deadline
+    )
+    if timed_out:
         stdout += f"\n## {spec.name} | timeout | FAILURE\n"
-        rc, timed_out = 1, True
     with open(log_path, "w") as f:
         # export-context lines first: parse_log keys the table rows by them
         for k, v in spec.env:
             f.write(f"export {k}={v}\n")
         f.write(stdout)
-    # "completed" = the measurement ran to its verdict, even a FAILURE one
-    # (an honest perf verdict is a RESULT, ≙ the reference's FAILURE table
-    # rows) — as opposed to a timeout/crash, which left no verdict and must
-    # be re-run on --resume.
-    has_records = False
-    try:
-        with open(jsonl_path) as f:
-            has_records = any(line.strip() for line in f)
-    except OSError:
-        pass
-    # rc < 0 is a signal kill (OOM/segfault) — never completed, even if
-    # some records were flushed before the kill
-    completed = not timed_out and (
-        rc == 0
-        or (
-            rc > 0
-            and has_records
-            and "Traceback (most recent call last)" not in stdout
-        )
-    )
-    return rc, completed
+    return rc, cell_completed(rc, timed_out, stdout, jsonl_path)
 
 
 def _state_path(out_dir: str, suite: str) -> str:
@@ -1829,10 +1829,22 @@ def _record_cell(
         "cell": cell, "rc": rc, "sig": sig, "completed": completed,
         "ts": wall_time_s(),
     }
-    with open(_state_path(out_dir, suite), "a") as f:
-        f.write(json.dumps(rec) + "\n")
-        f.flush()
-        os.fsync(f.fileno())  # survive the very crash resume exists for
+    # ONE unbuffered O_APPEND write per record: the concurrent engine
+    # checkpoints cells from several pool threads at once, and a
+    # buffered writer may split a line across flushes, letting two
+    # writers interleave a torn record into the state history.  A single
+    # os.write to an O_APPEND fd is atomic on local filesystems.
+    line = (json.dumps(rec) + "\n").encode()
+    fd = os.open(
+        _state_path(out_dir, suite),
+        os.O_WRONLY | os.O_APPEND | os.O_CREAT,
+        0o644,
+    )
+    try:
+        os.write(fd, line)
+        os.fsync(fd)  # survive the very crash resume exists for
+    finally:
+        os.close(fd)
 
 
 def _forget_cells(out_dir: str, suite: str, cells: set[str]) -> None:
@@ -1873,6 +1885,8 @@ def run_sweep(
     base_env: Mapping[str, str] | None = None,
     resume: bool = False,
     cell_timeout: float = DEFAULT_CELL_TIMEOUT,
+    jobs: int = 1,
+    warm_workers: bool = True,
 ) -> int:
     """Run a suite's matrix; print the tabulated report; return the
     aggregated exit code (any FAILURE -> 1).
@@ -1884,6 +1898,14 @@ def run_sweep(
     single-shot").  Skipped cells keep contributing their recorded rc to
     the aggregate exit code, and their logs/JSONL are still on disk, so
     the final report covers the whole matrix either way.
+
+    ``jobs`` selects the engine: 1 (default) is the serial path, bit-
+    identical to every previous release; 0 = auto width, N > 1 = the
+    concurrent engine (tpu_patterns/exec/) running host-parallel cells
+    N-wide behind warm workers while device-exclusive cells drain
+    serially.  ``warm_workers=False`` keeps the fresh-subprocess path
+    for every cell.  Either engine checkpoints per cell as it finishes,
+    so resume semantics are identical.
     """
     from tpu_patterns.core.results import (
         parse_log,
@@ -1908,9 +1930,11 @@ def run_sweep(
     if not resume:  # fresh run: forget history for the selected cells only
         _forget_cells(out_dir, suite, {s.name for s in specs})
     rc = 0
+    pending: list[SweepSpec] = []
+    sigs: dict[str, str] = {}
     for spec in specs:
         prev = done.get(spec.name)
-        sig = _spec_sig(spec, base_env)
+        sigs[spec.name] = sig = _spec_sig(spec, base_env)
         # Skip cells that COMPLETED — reached a verdict, even FAILURE (an
         # honest perf verdict is a result; re-measuring it on every resume
         # would defeat the checkpoint) — but carry their recorded rc into
@@ -1923,31 +1947,74 @@ def run_sweep(
             if prev["rc"] != 0:
                 rc = 1
             continue
-        print(f"# sweep cell: {spec.name}", flush=True)
-        from tpu_patterns import obs
+        pending.append(spec)
+    if pending and jobs != 1:
+        from tpu_patterns import exec as exec_mod
+        from tpu_patterns.core.results import ResultWriter
 
-        # the subprocess has its own deadline; the span deadline is a
-        # backstop 60s past it, so a cell whose *timeout machinery* wedges
-        # (a SIGKILL the child shrugs off in native code) is still
-        # diagnosed live by the watchdog
-        with obs.span(
-            "sweep.cell",
-            deadline_s=(cell_timeout + 60) if cell_timeout > 0 else None,
-            suite=suite,
-            cell=spec.name,
-        ):
-            cell_rc, completed = run_spec(
-                spec, out_dir, base_env=base_env, timeout=cell_timeout
+        agg = {"rc": rc}
+
+        def on_result(res) -> None:
+            # checkpoint per cell AS IT FINISHES (pool threads included):
+            # a killed schedule resumes from whatever landed
+            _record_cell(
+                out_dir, suite, res.spec.name, res.rc,
+                sigs[res.spec.name], res.completed,
             )
-        obs.counter(
-            "tpu_patterns_sweep_cells_total",
+            if res.rc != 0:  # incl. negative (signal-killed) returncodes
+                agg["rc"] = 1
+
+        _, engine_rec = exec_mod.run_cells(
+            pending,
+            out_dir,
+            jobs=jobs,
             suite=suite,
-            status="completed" if completed else "aborted",
-        ).inc()
-        _record_cell(out_dir, suite, spec.name, cell_rc, sig, completed)
-        print(f"# -> exit {cell_rc}", flush=True)
-        if cell_rc != 0:  # incl. negative (signal-killed) returncodes
-            rc = 1
+            warm_workers=warm_workers,
+            cell_timeout=cell_timeout,
+            base_env=base_env,
+            # run_cells' default subprocess_runner is exactly run_spec
+            # with these arguments (resolved through this module, so
+            # test monkeypatching still intercepts)
+            on_result=on_result,
+        )
+        rc = agg["rc"]
+        # the engine's serial-vs-concurrent verdict — the concurrency
+        # suite's own pass/fail shape applied to the harness — banked
+        # beside the cells it scheduled.  Its verdict never poisons the
+        # suite's exit code: measurement failures do, engine
+        # inefficiency is a WARNING row.
+        ResultWriter(
+            jsonl_path=os.path.join(out_dir, "sweep-engine.jsonl")
+        ).record(engine_rec)
+    else:
+        for spec in pending:
+            print(f"# sweep cell: {spec.name}", flush=True)
+            from tpu_patterns import obs
+
+            # the subprocess has its own deadline; the span deadline is a
+            # backstop 60s past it, so a cell whose *timeout machinery*
+            # wedges (a SIGKILL the child shrugs off in native code) is
+            # still diagnosed live by the watchdog
+            with obs.span(
+                "sweep.cell",
+                deadline_s=(cell_timeout + 60) if cell_timeout > 0 else None,
+                suite=suite,
+                cell=spec.name,
+            ):
+                cell_rc, completed = run_spec(
+                    spec, out_dir, base_env=base_env, timeout=cell_timeout
+                )
+            obs.counter(
+                "tpu_patterns_sweep_cells_total",
+                suite=suite,
+                status="completed" if completed else "aborted",
+            ).inc()
+            _record_cell(
+                out_dir, suite, spec.name, cell_rc, sigs[spec.name], completed
+            )
+            print(f"# -> exit {cell_rc}", flush=True)
+            if cell_rc != 0:  # incl. negative (signal-killed) returncodes
+                rc = 1
     # Parse per cell: a cell's export-context lines must not leak into the
     # next cell's marker-only records.
     records = []
