@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""CI gate for the continuous-batching serve engine (docs/serving.md).
+
+Runs a small request trace through the real CLI (``tpu-patterns serve``)
+on the simulated 8-device CPU mesh.  ``run_serve`` serves the SAME trace
+twice — continuous batching at ``--slots`` wide, then sequentially (one
+request at a time through the same executables) — and banks ONE Record
+carrying every verdict this job gates on:
+
+  (a) speedup: continuous-batching tokens/s beats sequential tokens/s on
+      the same trace (the concurrency suite's pass bar, applied to
+      serving — iteration-level scheduling must actually overlap work);
+  (b) exactness: every request's greedy ids are bit-identical to its
+      PER-REQUEST dense decode — batching and paging must never change
+      what a request would have said alone;
+  (c) memory: the paged pool's cache bytes sit under the dense
+      ``slots x max_len`` rectangle, and compiled ``memory_analysis``
+      shows the donated pool aliased in place across steps.
+
+Zero dependencies beyond the package; exit 0 = pass.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Small enough for a stock runner's cold XLA, big enough that the active
+# set actually turns over (requests > slots forces admission mid-flight,
+# and the ragged prompt spread exercises per-row positions).
+SERVE_ARGS = [
+    "--vocab", "64", "--embed", "64", "--head_dim", "8", "--depth", "1",
+    "--requests", "8", "--min_prompt", "4", "--max_prompt", "16",
+    "--gen", "6", "--slots", "4", "--block_len", "8",
+]
+
+
+def main() -> int:
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    jsonl = os.path.join(
+        tempfile.mkdtemp(prefix="serve_smoke_"), "serve.jsonl"
+    )
+    cmd = [
+        sys.executable, "-m", "tpu_patterns", "--jsonl", jsonl,
+        "serve", "--dp", "1", "--tp", "2", *SERVE_ARGS,
+    ]
+    print("+", " ".join(cmd), flush=True)
+    t0 = time.monotonic()
+    proc = subprocess.run(cmd, env=env, cwd=ROOT)
+    wall = time.monotonic() - t0
+    if proc.returncode != 0:
+        print(f"serve smoke: CLI exited {proc.returncode}", file=sys.stderr)
+        return 1
+
+    with open(jsonl) as f:
+        recs = [json.loads(ln) for ln in f if ln.strip()]
+    if not recs:
+        print("serve smoke: no Record banked", file=sys.stderr)
+        return 1
+    rec = recs[-1]
+    m = rec.get("metrics", {})
+    print(
+        f"serve smoke: verdict={rec.get('verdict')} "
+        f"tokens/s={m.get('tokens_per_s')} "
+        f"sequential={m.get('sequential_tokens_per_s')} "
+        f"speedup={m.get('speedup')} exact={m.get('exact')} "
+        f"cache={m.get('cache_MB')}MB dense={m.get('dense_cache_MB')}MB "
+        f"alias={m.get('alias_MB')}MB wall={wall:.1f}s",
+        flush=True,
+    )
+    if rec.get("verdict") != "SUCCESS":
+        print(
+            f"serve smoke: verdict {rec.get('verdict')} — "
+            f"notes: {rec.get('notes')}",
+            file=sys.stderr,
+        )
+        return 1
+    if m.get("exact") != 1.0:
+        print(
+            "serve smoke: exactness gate failed — continuous batching "
+            "changed a request's greedy ids vs per-request dense decode",
+            file=sys.stderr,
+        )
+        return 1
+    if not m.get("speedup", 0) > 1.0:
+        print(
+            f"serve smoke: speedup {m.get('speedup')} <= 1 — continuous "
+            "batching did not beat sequential serving",
+            file=sys.stderr,
+        )
+        return 1
+    if not m.get("cache_MB", 0) < m.get("dense_cache_MB", 0):
+        print(
+            f"serve smoke: pool {m.get('cache_MB')}MB not under the "
+            f"dense rectangle {m.get('dense_cache_MB')}MB",
+            file=sys.stderr,
+        )
+        return 1
+    print("serve smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -284,10 +284,17 @@ class TestSweep:
         assert len(asym) == 10
         assert any("inplace" in s.name for s in asym)
         assert any("755MB" in s.name for s in asym)
+        srv = sweep.specs_for("serve", quick=True)
+        # base engine + int8 pool + gqa pool, each a full-verdict cell
+        assert {s.name for s in srv} == {
+            "serve.continuous", "serve.int8_pool", "serve.gqa_pool",
+        }
+        assert all(s.argv[0] == "serve" for s in srv)
         # 'all' must be exactly these suites, independently summed
         assert set(sweep.SUITES) == {
             "p2p", "hier", "measured", "tune", "asymptote", "gates",
             "concurrency", "runtime", "allreduce", "longctx", "parallel",
+            "serve",
         }
         assert len(sweep.specs_for("all", quick=True)) == len(p2p) + len(
             con
@@ -295,7 +302,7 @@ class TestSweep:
             par
         ) + len(hier) + len(meas) + len(tune) + len(rt) + len(
             sweep.specs_for("gates", quick=True)
-        ) + len(sweep.specs_for("asymptote", quick=True))
+        ) + len(sweep.specs_for("asymptote", quick=True)) + len(srv)
 
     def test_measured_two_phase_ordering(self):
         # VERDICT r4 next #3: phase 1 = every cell full-size at reps=2

@@ -497,6 +497,55 @@ class TestRagged:
             mesh, ModelConfig(depth=1, rope=rope, attn_layout=layout)
         )
 
+    @pytest.mark.parametrize("layout", ["contiguous", "striped"])
+    def test_ragged_edges_full_and_min_length_rows(self, devices, layout):
+        # the boundary lengths a spread of "interior" lens never hits:
+        # lens == prefill_len (the last valid slot is the FINAL prompt
+        # slot, owned only by the last rank under contiguous and by rank
+        # (lp-1) % sp under striped) and lens == 1 (the first slot, rank
+        # 0's alone) — _gather_last_valid and the ragged decode masks
+        # must be exact at both extremes, under both layouts
+        mesh = Mesh(
+            np.array(devices[:8]).reshape(2, 2, 2), ("dp", "sp", "tp")
+        )
+        assert _ragged_gate(
+            mesh,
+            ModelConfig(depth=1, rope=True, attn_layout=layout),
+            lens_fn=lambda b, lp: np.array(
+                [lp if i % 2 == 0 else 1 for i in range(b)], np.int32
+            ),
+        )
+
+    def test_ragged_gate_rejects_out_of_range_lens(self, devices):
+        mesh = Mesh(
+            np.array(devices[:8]).reshape(2, 2, 2), ("dp", "sp", "tp")
+        )
+        with pytest.raises(ValueError, match="lens_fn"):
+            _ragged_gate(
+                mesh,
+                ModelConfig(depth=1),
+                lens_fn=lambda b, lp: np.full((b,), lp + 1, np.int32),
+            )
+
+    @pytest.mark.parametrize("layout", ["contiguous", "striped"])
+    def test_gather_last_valid_edge_lens_single_rank(self, layout):
+        # the unsharded inverse map directly: full-length and length-1
+        # rows pick exactly their own last valid position
+        from tpu_patterns.models.decode import (
+            _CacheLayout,
+            _gather_last_valid,
+        )
+
+        lp = 8
+        lay = _CacheLayout(prefill=lp, gen_cap=4, sp=1, layout=layout)
+        y = jax.random.normal(jax.random.key(0), (3, lp, 16))
+        lens = jnp.asarray([lp, 1, 5], jnp.int32)
+        got = np.asarray(_gather_last_valid(y, lens, lay, None))
+        for b, ln in enumerate([lp, 1, 5]):
+            np.testing.assert_array_equal(
+                got[b, 0], np.asarray(y)[b, ln - 1]
+            )
+
     def test_ragged_selffeeding_rollout_finite(self, devices):
         mesh = Mesh(
             np.array(devices[:4]).reshape(2, 2, 1), ("dp", "sp", "tp")

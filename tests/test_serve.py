@@ -1,0 +1,455 @@
+"""Continuous-batching serve engine over the paged KV cache (serve/):
+layout math, token exactness vs per-request dense decode, int8 parity,
+admission/deferral scheduling, pool donation, and memory scaling."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from tpu_patterns.models.lm import init_lm_params, make_lm_decoder
+from tpu_patterns.models.transformer import ModelConfig, _n_experts
+from tpu_patterns.serve import (
+    Request,
+    ServeConfig,
+    ServeEngine,
+    TRASH_BLOCK,
+    make_paged_lm_decoder,
+    run_serve,
+)
+from tpu_patterns.serve.paged import PagedLayout, _pool_write
+
+CFG = dict(embed=64, heads=8, head_dim=8, causal=True, dtype="float32")
+VOCAB = 64
+
+
+def _mesh(devices, shape):
+    n = int(np.prod(shape))
+    return Mesh(np.array(devices[:n]).reshape(shape), ("dp", "sp", "tp"))
+
+
+def _decoder_and_params(
+    mesh, mcfg, *, n_blocks=13, block_len=8, max_len=40, cache_int8=False,
+    seed=0,
+):
+    dec = make_paged_lm_decoder(
+        mesh, mcfg, VOCAB, n_blocks=n_blocks, block_len=block_len,
+        max_len=max_len, cache_int8=cache_int8,
+    )
+    flat = init_lm_params(
+        jax.random.key(seed), mcfg, VOCAB, _n_experts(mesh, mcfg)
+    )
+    return dec, dec.stack_params(flat), flat
+
+
+def _trace(n, vocab=VOCAB, min_p=3, max_p=20, n_gen=6, seed=1):
+    rng = np.random.RandomState(seed)
+    return [
+        Request(
+            rid=i,
+            tokens=rng.randint(
+                0, vocab, size=rng.randint(min_p, max_p + 1)
+            ).tolist(),
+            n_gen=n_gen,
+        )
+        for i in range(n)
+    ]
+
+
+def _dense_ids(mesh, mcfg, flat_params, req, lpd, gen_cap, cache_int8=False):
+    """Per-request dense greedy decode — the exactness oracle."""
+    sp = int(mesh.shape["sp"])
+    lpd = lpd + (-lpd % sp)
+    gen_cap = gen_cap + (-gen_cap % sp)
+    pre, gen = make_lm_decoder(
+        mesh, mcfg, VOCAB, 1, lpd, gen_cap, cache_int8=cache_int8
+    )
+    toks = np.zeros((1, lpd), np.int32)
+    toks[0, : len(req.tokens)] = req.tokens
+    lens = jnp.asarray([len(req.tokens)], jnp.int32)
+    caches, t0 = pre(flat_params, toks, lens)
+    out = [int(np.asarray(t0)[0])]
+    if req.n_gen > 1:
+        _, ids = gen(flat_params, caches, t0, (lens, 0), req.n_gen - 1)
+        out += np.asarray(ids)[0].tolist()
+    return out
+
+
+class TestPagedLayout:
+    def test_each_offset_owned_by_one_rank(self):
+        lay = PagedLayout(n_blocks=5, block_len=8, sp=4)
+        for o in range(8):
+            owners = [r for r in range(4) if o // lay.bl_loc == r]
+            assert len(owners) == 1, o
+
+    def test_page_positions_cover_block_once_across_ranks(self):
+        # union over ranks of page_positions == every position the
+        # window covers, each exactly once
+        lay = PagedLayout(n_blocks=5, block_len=8, sp=4)
+        n_pages = 3
+        seen = []
+        for r in range(4):
+            j = np.arange(n_pages)[:, None]
+            ol = np.arange(lay.bl_loc)[None, :]
+            seen += (j * lay.block_len + r * lay.bl_loc + ol).reshape(-1).tolist()
+        assert sorted(seen) == list(range(n_pages * 8))
+
+    def test_invalid_layouts_rejected(self):
+        with pytest.raises(ValueError, match="divide over sp"):
+            PagedLayout(n_blocks=4, block_len=6, sp=4)
+        with pytest.raises(ValueError, match="trash"):
+            PagedLayout(n_blocks=1, block_len=8, sp=1)
+
+    def test_blocks_for(self):
+        lay = PagedLayout(n_blocks=4, block_len=8, sp=1)
+        assert [lay.blocks_for(n) for n in (1, 8, 9, 16, 17)] == [
+            1, 1, 2, 2, 3,
+        ]
+
+
+class TestFactoryContracts:
+    def test_dp_rejected(self, devices):
+        mesh = _mesh(devices, (2, 2, 2))
+        with pytest.raises(ValueError, match="fold dp into sp"):
+            make_paged_lm_decoder(
+                mesh, ModelConfig(**CFG), VOCAB,
+                n_blocks=4, block_len=8, max_len=16,
+            )
+
+    def test_block_len_must_divide_sp(self, devices):
+        mesh = _mesh(devices, (1, 4, 1))
+        with pytest.raises(ValueError, match="divide over sp"):
+            make_paged_lm_decoder(
+                mesh, ModelConfig(**CFG), VOCAB,
+                n_blocks=4, block_len=6, max_len=16,
+            )
+
+    def test_submit_validation(self, devices):
+        mesh = _mesh(devices, (1, 1, 1))
+        dec, params, _ = _decoder_and_params(
+            mesh, ModelConfig(**CFG), n_blocks=3, block_len=8, max_len=16
+        )
+        eng = ServeEngine(dec, params, slots=2)
+        with pytest.raises(ValueError, match="needs"):
+            # 3 blocks needed, pool has 2 allocatable
+            eng.submit(Request(rid=0, tokens=list(range(16)), n_gen=2))
+        with pytest.raises(ValueError, match="empty prompt"):
+            eng.submit(Request(rid=1, tokens=[], n_gen=2))
+
+
+class TestExactness:
+    """The serving invariant: batching/paging must never change what a
+    request would have said alone — greedy ids bit-identical to the
+    per-request dense decoder, on the 8-device CPU mesh."""
+
+    @pytest.mark.parametrize(
+        "shape,kv,rope,int8",
+        [
+            ((1, 4, 2), 0, True, False),  # sp x tp, rope positions live
+            ((1, 8, 1), 0, False, False),  # sp-only
+            ((1, 2, 4), 4, True, False),  # GQA pool over tp=4
+            ((1, 4, 2), 0, True, True),  # int8 pool (satellite parity)
+            ((1, 1, 1), 2, True, False),  # single device
+        ],
+    )
+    def test_engine_matches_per_request_dense_decode(
+        self, devices, shape, kv, rope, int8
+    ):
+        mesh = _mesh(devices, shape)
+        mcfg = ModelConfig(**CFG, depth=2, kv_heads=kv, rope=rope)
+        dec, params, flat = _decoder_and_params(
+            mesh, mcfg, cache_int8=int8
+        )
+        reqs = _trace(5)
+        eng = ServeEngine(dec, params, slots=3)
+        got = eng.run([dataclasses.replace(r) for r in reqs])
+        for r in reqs:
+            want = _dense_ids(
+                mesh, mcfg, flat, r, lpd=20, gen_cap=r.n_gen,
+                cache_int8=int8,
+            )
+            assert got[r.rid] == want, f"rid {r.rid}"
+
+    def test_admission_edges_full_and_min_prompts(self, devices):
+        # rows at the window edges: a full-length prompt (every table
+        # block used by prefill alone) beside minimum-length rows
+        mesh = _mesh(devices, (1, 4, 2))
+        mcfg = ModelConfig(**CFG, depth=1, rope=True)
+        dec, params, flat = _decoder_and_params(
+            mesh, mcfg, n_blocks=17, block_len=8, max_len=40
+        )
+        rng = np.random.RandomState(3)
+        reqs = [
+            Request(rid=0, tokens=rng.randint(0, VOCAB, 34).tolist(),
+                    n_gen=6),  # 34 + 6 == max_len: full window
+            Request(rid=1, tokens=[5], n_gen=6),  # minimum prompt
+            Request(rid=2, tokens=[7], n_gen=1),  # retires at prefill
+            Request(rid=3, tokens=rng.randint(0, VOCAB, 35).tolist(),
+                    n_gen=6),  # span 35+6-1 == the 40-slot window
+                               # exactly (the last token's K/V is never
+                               # stored, so this FITS)
+        ]
+        eng = ServeEngine(dec, params, slots=3)
+        got = eng.run([dataclasses.replace(r) for r in reqs])
+        assert len(got[2]) == 1
+        for r in reqs:
+            want = _dense_ids(mesh, mcfg, flat, r, lpd=36, gen_cap=8)
+            assert got[r.rid] == want[: r.n_gen], f"rid {r.rid}"
+
+
+class TestScheduler:
+    def test_pool_exhaustion_defers_and_completes(self, devices):
+        # a pool too small for the whole trace at once: admission must
+        # DEFER (count it), never overcommit, and still finish everything
+        mesh = _mesh(devices, (1, 1, 1))
+        mcfg = ModelConfig(**CFG, depth=1)
+        dec, params, _ = _decoder_and_params(
+            mesh, mcfg, n_blocks=5, block_len=8, max_len=24
+        )
+        reqs = _trace(6, min_p=8, max_p=14, n_gen=4)
+        eng = ServeEngine(dec, params, slots=4)
+        got = eng.run([dataclasses.replace(r) for r in reqs])
+        assert sorted(got) == [r.rid for r in reqs]
+        assert all(len(v) == 4 for v in got.values())
+        assert eng.stats["deferrals"] > 0
+        # every block came home: the free list is whole again
+        assert sorted(eng.free) == list(range(1, 5))
+
+    def test_blocks_recycle_across_requests(self, devices):
+        mesh = _mesh(devices, (1, 1, 1))
+        mcfg = ModelConfig(**CFG, depth=1)
+        dec, params, _ = _decoder_and_params(
+            mesh, mcfg, n_blocks=4, block_len=8, max_len=16
+        )
+        # each request needs 2 blocks; the pool has 3 allocatable — the
+        # second wave can only run on the first wave's freed blocks
+        reqs = _trace(4, min_p=8, max_p=10, n_gen=3)
+        eng = ServeEngine(dec, params, slots=2)
+        got = eng.run([dataclasses.replace(r) for r in reqs])
+        assert len(got) == 4
+
+    def test_bucketed_executables_stay_bounded(self, devices):
+        # steady-state serving must reuse a small compiled set: row
+        # buckets are powers of two capped at slots
+        mesh = _mesh(devices, (1, 1, 1))
+        mcfg = ModelConfig(**CFG, depth=1)
+        dec, params, _ = _decoder_and_params(
+            mesh, mcfg, n_blocks=17, block_len=8, max_len=32
+        )
+        eng = ServeEngine(dec, params, slots=4)
+        eng.run([dataclasses.replace(r) for r in _trace(7, n_gen=3)])
+        n_prefill, n_step = dec.compiled_buckets()
+        assert n_step <= 3  # {1, 2, 4}
+        assert n_prefill <= 4
+
+
+class TestInt8PoolParity:
+    """Satellite: _quantize_kv must round-trip through the paged pool
+    with the dense path's error bound, ragged lens included."""
+
+    def test_pool_roundtrip_error_bounded_ragged(self):
+        lay = PagedLayout(n_blocks=6, block_len=8, sp=1)
+        hkv, d = 4, 16
+        rng = np.random.RandomState(0)
+        pool = {
+            "k": jnp.zeros((6, 8, hkv, d), jnp.int8),
+            "v": jnp.zeros((6, 8, hkv, d), jnp.int8),
+            "ks": jnp.zeros((6, 8, hkv), jnp.float32),
+            "vs": jnp.zeros((6, 8, hkv), jnp.float32),
+        }
+        # two ragged rows: 11 and 3 positions, tables [1,2] and [3]
+        lens = [11, 3]
+        tables = [[1, 2], [3]]
+        x = rng.randn(2, 16, hkv, d).astype(np.float32)
+        for b, ln in enumerate(lens):
+            for t in range(ln):
+                pb = tables[b][t // lay.block_len]
+                ob = t % lay.block_len
+                pool = _pool_write(
+                    pool,
+                    jnp.asarray(x[b, t][None]),
+                    jnp.asarray(x[b, t][None]),
+                    jnp.asarray([pb]),
+                    jnp.asarray([ob]),
+                )
+        # gather back through the tables and check the dense bound:
+        # per-slot error <= scale/2 (same gate as TestInt8Cache)
+        for b, ln in enumerate(lens):
+            for t in range(ln):
+                pb = tables[b][t // lay.block_len]
+                ob = t % lay.block_len
+                q = np.asarray(pool["k"][pb, ob], np.float32)
+                s = np.asarray(pool["ks"][pb, ob])
+                deq = q * s[:, None]
+                err = np.abs(deq - x[b, t])
+                assert (err <= s[:, None] * 0.5 + 1e-7).all(), (b, t)
+
+    def test_trash_block_contents_never_leak(self, devices):
+        """Poison the trash block with huge values: results must not
+        move — routed-away writes land there, masked reads never
+        surface it."""
+        mesh = _mesh(devices, (1, 1, 1))
+        mcfg = ModelConfig(**CFG, depth=1)
+        dec, params, flat = _decoder_and_params(
+            mesh, mcfg, n_blocks=9, block_len=8, max_len=24
+        )
+        reqs = _trace(3, n_gen=3)
+        eng = ServeEngine(dec, params, slots=2)
+        poison = np.array(eng.pool["k"])  # writable copy
+        poison[:, TRASH_BLOCK] = 1e4  # huge but finite
+        eng.pool["k"] = jnp.asarray(poison)
+        got = eng.run([dataclasses.replace(r) for r in reqs])
+        for r in reqs:
+            want = _dense_ids(mesh, mcfg, flat, r, lpd=20, gen_cap=4)
+            assert got[r.rid] == want[: r.n_gen], f"rid {r.rid}"
+        assert TRASH_BLOCK not in eng.free  # trash never enters the pool
+
+
+class TestDonation:
+    """The serve path's answer to run_decode's copy-per-chain: ONE pool
+    threads through every step, donated and updated in place (extends
+    the PR-3 donation tests to the paged cache)."""
+
+    def test_step_consumes_pool_and_aliases(self, devices):
+        from tpu_patterns.models.transformer import donation_took
+
+        mesh = _mesh(devices, (1, 4, 2))
+        mcfg = ModelConfig(**CFG, depth=1)
+        dec, params, _ = _decoder_and_params(mesh, mcfg)
+        pool = dec.init_pool()
+        rows = 2
+        args = (
+            params, pool,
+            jnp.zeros((rows,), jnp.int32),
+            jnp.asarray([4, 3], jnp.int32),
+            jnp.zeros((rows,), jnp.int32),
+            jnp.asarray([[1, 2, 0, 0, 0], [3, 0, 0, 0, 0]], jnp.int32),
+            jnp.ones((rows,), bool),
+        )
+        took = donation_took(dec.step_jit(rows), *args)
+        if took is None:
+            pytest.skip("backend exposes no memory-analysis API")
+        assert took, "pool donation was silently declined"
+        new_pool, _ = dec.step_jit(rows)(*args)
+        assert all(
+            v.is_deleted() for v in pool.values()
+        ), "donated pool still alive: the step copied instead of aliasing"
+        # the returned pool is the live continuation
+        assert np.isfinite(np.asarray(new_pool["k"], np.float32)).all()
+
+    def test_alias_analysis_survives_persistent_cache(
+        self, devices, tmp_path
+    ):
+        """The warm-CLI regression: with the persistent compilation
+        cache enabled and the step executable already persisted, a
+        cache-HIT deserialization reports alias bytes == 0 — the gate
+        must compile for real (analysis_compile) and still see the
+        donated pool aliased."""
+        if not hasattr(jax.config, "jax_enable_compilation_cache"):
+            pytest.skip("no compilation-cache config on this JAX")
+        from jax.experimental.compilation_cache import (
+            compilation_cache as cc,
+        )
+
+        mesh = _mesh(devices, (1, 1, 1))
+        mcfg = ModelConfig(**CFG, depth=1)
+        dec, params, _ = _decoder_and_params(mesh, mcfg)
+        prev_dir = jax.config.jax_compilation_cache_dir
+        prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+        jax.config.update("jax_compilation_cache_dir", str(tmp_path))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        cc.reset_cache()  # re-latch onto the tmp cache dir
+        try:
+            rows = 2
+            pool = dec.init_pool()
+            args = (
+                params, pool,
+                jnp.zeros((rows,), jnp.int32),
+                jnp.zeros((rows,), jnp.int32),
+                jnp.zeros((rows,), jnp.int32),
+                jnp.zeros((rows, dec.n_pages), jnp.int32),
+                jnp.zeros((rows,), bool),
+            )
+            dec.step_jit(rows)(*args)  # normal compile -> persisted entry
+            assert any(tmp_path.iterdir()), "no cache entry written"
+            mm = dec.memory_metrics(params, rows)
+            if mm is None:
+                pytest.skip("backend exposes no memory-analysis API")
+            assert mm["alias_bytes"] >= mm["pool_bytes"]
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", prev_min
+            )
+            cc.reset_cache()
+
+    def test_alias_covers_whole_pool(self, devices):
+        mesh = _mesh(devices, (1, 4, 2))
+        mcfg = ModelConfig(**CFG, depth=1)
+        dec, params, _ = _decoder_and_params(mesh, mcfg)
+        mm = dec.memory_metrics(params, 2)
+        if mm is None:
+            pytest.skip("backend exposes no memory-analysis API")
+        assert mm["alias_bytes"] >= mm["pool_bytes"]
+        assert mm["pool_bytes_global"] == dec.pool_nbytes()
+        # pool_nbytes is the formula; a REAL pool must weigh the same
+        pool = dec.init_pool()
+        assert sum(int(v.nbytes) for v in pool.values()) == dec.pool_nbytes()
+
+
+class TestMemoryScaling:
+    def test_cache_bytes_scale_with_pool_not_batch_max_len(self, devices):
+        """The PagedAttention claim at the compiled level: doubling the
+        POOL moves the step's argument bytes by exactly the pool delta,
+        while batch x max_len (slots, table window) stays fixed."""
+        mesh = _mesh(devices, (1, 4, 2))
+        mcfg = ModelConfig(**CFG, depth=1)
+        sizes = {}
+        for n_blocks in (9, 17):
+            dec, params, _ = _decoder_and_params(
+                mesh, mcfg, n_blocks=n_blocks, block_len=8, max_len=40
+            )
+            mm = dec.memory_metrics(params, 4)
+            if mm is None:
+                pytest.skip("backend exposes no memory-analysis API")
+            sizes[n_blocks] = mm
+        d_arg = sizes[17]["argument_bytes"] - sizes[9]["argument_bytes"]
+        d_pool = sizes[17]["pool_bytes"] - sizes[9]["pool_bytes"]
+        assert d_pool > 0
+        assert d_arg == pytest.approx(d_pool)
+
+
+class TestRunServe:
+    def test_measured_pattern_succeeds(self, devices):
+        from tpu_patterns.core.results import ResultWriter
+
+        mesh = _mesh(devices, (1, 8, 1))
+        cfg = ServeConfig(
+            vocab=VOCAB, embed=64, head_dim=8, depth=1, requests=6,
+            min_prompt=4, max_prompt=16, gen=6, slots=4, block_len=8,
+        )
+        writer = ResultWriter()
+        (rec,) = run_serve(mesh, cfg, writer)
+        assert rec.verdict.value == "SUCCESS", rec.notes
+        assert rec.metrics["exact"] == 1.0
+        assert rec.metrics["speedup"] > 1.0
+        assert rec.metrics["cache_MB"] < rec.metrics["dense_cache_MB"]
+
+    def test_metrics_reach_the_obs_registry(self, devices):
+        from tpu_patterns import obs
+
+        mesh = _mesh(devices, (1, 1, 1))
+        mcfg = ModelConfig(**CFG, depth=1)
+        dec, params, _ = _decoder_and_params(mesh, mcfg)
+        before = obs.counter("tpu_patterns_serve_tokens_total").value
+        eng = ServeEngine(dec, params, slots=2)
+        eng.run([dataclasses.replace(r) for r in _trace(2, n_gen=3)])
+        assert (
+            obs.counter("tpu_patterns_serve_tokens_total").value
+            == before + 6
+        )
+        assert obs.histogram("tpu_patterns_serve_step_ms").count > 0
+        assert obs.histogram("tpu_patterns_serve_queue_wait_ms").count > 0

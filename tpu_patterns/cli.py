@@ -372,6 +372,16 @@ def _cmd_lm(args, writer: ResultWriter) -> None:
     run_lm(_mesh3d_from_args(args), _cfg_from_args(LMConfig, args), writer)
 
 
+def _cmd_serve(args, writer: ResultWriter) -> None:
+    from tpu_patterns.serve import ServeConfig, run_serve
+
+    if args.dp != 1:
+        # the paged pool is shared state over sp/tp; batch rows are
+        # scheduler slots, not a data axis — fail fast with the reason
+        raise SystemExit("error: serve requires --dp 1 (fold devices into sp)")
+    run_serve(_mesh3d_from_args(args), _cfg_from_args(ServeConfig, args), writer)
+
+
 def _cmd_doctor(args, writer: ResultWriter) -> None:
     from tpu_patterns.core.doctor import DoctorConfig, run_doctor
 
@@ -1058,6 +1068,17 @@ def build_parser() -> argparse.ArgumentParser:
     add_config_args(lmp, LMConfig)
     _add_mesh3d_args(lmp)
 
+    sv = sub.add_parser(
+        "serve",
+        help="continuous-batching serve engine over a paged KV cache: "
+        "iteration-level scheduling vs sequential serving, with "
+        "token-exactness and in-place pool memory gates",
+    )
+    from tpu_patterns.serve import ServeConfig
+
+    add_config_args(sv, ServeConfig)
+    _add_mesh3d_args(sv)
+
     dr = sub.add_parser(
         "doctor",
         help="deadline-bounded runtime health probes (backend init / tiny "
@@ -1266,6 +1287,7 @@ def main(argv: list[str] | None = None) -> int:
         "train": _cmd_train,
         "decode": _cmd_decode,
         "lm": _cmd_lm,
+        "serve": _cmd_serve,
         "doctor": _cmd_doctor,
         "ckpt": _cmd_ckpt,
         "pipeline": _cmd_pipeline,

@@ -608,6 +608,20 @@ def make_decoder(
     return prefill, _gen
 
 
+def kv_slot_bytes(
+    head_dim: int, kv_heads: int, dtype, cache_int8: bool
+) -> int:
+    """Bytes of ONE K+V cache slot (a single token's keys and values for
+    one layer): int8 stores 1 byte per element plus a 4-byte f32 scale
+    per D-lane slot; float stores the dtype's itemsize per element.  The
+    one encoding of this arithmetic — the dense cache Record, the paged
+    ``pool_nbytes``, and the serve memory gate's dense rectangle all
+    price their slots here."""
+    if cache_int8:
+        return 2 * (kv_heads * head_dim + kv_heads * 4)
+    return 2 * kv_heads * head_dim * int(jnp.dtype(dtype).itemsize)
+
+
 @dataclasses.dataclass
 class DecodeConfig:
     """CLI ``decode`` subcommand."""
@@ -720,15 +734,21 @@ def run_decode(mesh: Mesh, cfg: DecodeConfig, writer) -> list:
     tokens = cfg.batch * cfg.gen
     sec = res.per_op_ns * 1e-9
     tps = tokens / sec if sec > 0 else 0.0
-    # int8: 1 byte per element + a 4-byte f32 scale per D-lane slot
-    kv_bytes = (
-        (1.0 + 4.0 / cfg.head_dim)
-        if cfg.cache_int8
-        else float(jnp.dtype(cfg.dtype).itemsize)
-    )
+    # feed the obs metrics registry (spans alone never reach the
+    # metrics/Prometheus export): throughput, per-step latency, prefill
+    obs.gauge("tpu_patterns_decode_tokens_per_s").set(tps)
+    obs.gauge("tpu_patterns_decode_prefill_ms").set(prefill_ms)
+    if cfg.gen > 0 and sec > 0:
+        obs.histogram("tpu_patterns_decode_step_ms").observe(
+            1e3 * sec / cfg.gen
+        )
+    obs.counter("tpu_patterns_decode_tokens_total").inc(tokens)
     cache_mb = (
-        2 * cfg.depth * cfg.batch * (cfg.kv_heads or cfg.heads) * max_len
-        * cfg.head_dim * kv_bytes / 1e6
+        cfg.depth * cfg.batch * max_len
+        * kv_slot_bytes(
+            cfg.head_dim, cfg.kv_heads or cfg.heads, cfg.dtype,
+            cfg.cache_int8,
+        ) / 1e6
     )
     ok = gate and np.isfinite(tps) and tps > 0
     if cfg.min_tokens_per_s > 0:
@@ -763,7 +783,7 @@ def run_decode(mesh: Mesh, cfg: DecodeConfig, writer) -> list:
     return [rec]
 
 
-def _ragged_gate(mesh: Mesh, big: ModelConfig) -> bool:
+def _ragged_gate(mesh: Mesh, big: ModelConfig, lens_fn=None) -> bool:
     """Ragged (per-row prompt length) decode-vs-forward equivalence.
 
     Rows with DIFFERENT true prompt lengths (right-padded to the cache's
@@ -783,6 +803,12 @@ def _ragged_gate(mesh: Mesh, big: ModelConfig) -> bool:
     factorization so the ragged path is driver-visible, not pytest-only
     (VERDICT r4 next #7); the TestRagged pytests drive the same gate
     across rope/layout combinations.
+
+    ``lens_fn(b, lp) -> [b] int array`` overrides the default
+    length spread — the ragged-EDGE tests pin lens == lp (full prompt:
+    ``_gather_last_valid`` must hit the final slot, the one only the
+    last rank owns) and lens == 1 (minimum: the first slot, rank 0
+    only) through it, under both cache layouts.
     """
     from tpu_patterns.models.transformer import forward_shard
 
@@ -803,7 +829,15 @@ def _ragged_gate(mesh: Mesh, big: ModelConfig) -> bool:
         jax.random.key(22), (b, lp + gen, cfg.embed), jnp.float32
     )
     # distinct true lengths per row (raggedness is the thing under test)
-    lens_np = np.array([max(1, lp - 3 * i) for i in range(b)], np.int32)
+    if lens_fn is None:
+        lens_np = np.array([max(1, lp - 3 * i) for i in range(b)], np.int32)
+    else:
+        lens_np = np.asarray(lens_fn(b, lp), np.int32)
+        if lens_np.shape != (b,) or lens_np.min() < 1 or lens_np.max() > lp:
+            raise ValueError(
+                f"lens_fn must return [b={b}] lengths in [1, {lp}], "
+                f"got {lens_np!r}"
+            )
 
     # per-row reference: forward of the row's own contiguous stream
     # (true prompt tokens, then the teacher-forced continuations)

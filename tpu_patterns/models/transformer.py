@@ -914,6 +914,44 @@ def flagship_flops(cfg: FlagshipConfig) -> float:
     return step_flops * cfg.depth
 
 
+def analysis_compile(jitted, *args):
+    """lower+compile OUTSIDE the persistent compilation cache.
+
+    A cache-HIT executable is deserialized, and its ``memory_analysis()``
+    comes back with ``alias_size_in_bytes == 0`` (argument/output/temp
+    sizes survive; the alias figure does not) — which reads as "donation
+    declined" when it really means "analysis not persisted".  Any caller
+    about to assert on alias bytes must compile for real, EVERY time:
+    compiling normally first and bypassing only on an ambiguous 0 does
+    not work, because a same-process cache-hit compile memoizes the
+    deserialized executable in memory and the "recompile" hands it
+    straight back.  Flipping ``jax_enable_compilation_cache`` alone is
+    also not enough: the cache-used decision is LATCHED at the first
+    compile of the process (``compilation_cache.is_cache_used``), so the
+    latch is reset with the flag off, then reset again so later compiles
+    re-latch with the cache (enabled by ``runtime.setup_jax`` on every
+    CLI path) back on.
+    """
+    lowered = jitted.lower(*args)
+    try:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as cc,
+        )
+
+        enabled = bool(jax.config.jax_enable_compilation_cache)
+    except Exception:  # no cache machinery on this JAX: nothing to dodge
+        return lowered.compile()
+    if not enabled:
+        return lowered.compile()
+    jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        cc.reset_cache()  # drop the latched cache-used decision
+        return lowered.compile()
+    finally:
+        jax.config.update("jax_enable_compilation_cache", True)
+        cc.reset_cache()  # re-latch with the cache on at the next compile
+
+
 def donation_took(jitted, *args) -> bool | None:
     """Whether the compiled program ACTUALLY aliases donated inputs onto
     outputs (``memory_analysis().alias_size_in_bytes`` > 0) — donation
@@ -922,7 +960,7 @@ def donation_took(jitted, *args) -> bool | None:
     None when the backend exposes no memory-analysis API (assert nothing
     rather than something false)."""
     try:
-        ma = jitted.lower(*args).compile().memory_analysis()
+        ma = analysis_compile(jitted, *args).memory_analysis()
         return float(ma.alias_size_in_bytes) > 0
     except Exception:
         return None
@@ -931,7 +969,9 @@ def donation_took(jitted, *args) -> bool | None:
 def _memory_metrics(jitted, *args) -> dict[str, float]:
     """Compiled-program memory analysis (bytes -> MB): peak temp (the
     activation stash the remat lever targets), argument and output sizes.
-    Best-effort — absent on backends without the analysis API."""
+    Best-effort — absent on backends without the analysis API.  A plain
+    compile suffices: these three figures survive a persistent-cache
+    deserialization (unlike alias bytes — see ``analysis_compile``)."""
     try:
         ma = jitted.lower(*args).compile().memory_analysis()
         return {

@@ -1,0 +1,23 @@
+"""serve/ — continuous-batching decode over a paged KV cache.
+
+  paged.py   block pool + per-sequence block tables; compiled
+             (prefill, step) cores with the pool donated in place
+  engine.py  iteration-level scheduler (admit / prefill / step /
+             retire / defer) + the ``serve`` measured pattern
+
+See docs/serving.md for the layout diagram, scheduler states, and how
+to read the verdict Records.
+"""
+
+from tpu_patterns.serve.engine import (  # noqa: F401
+    Request,
+    ServeConfig,
+    ServeEngine,
+    run_serve,
+)
+from tpu_patterns.serve.paged import (  # noqa: F401
+    PagedDecoder,
+    PagedLayout,
+    TRASH_BLOCK,
+    make_paged_lm_decoder,
+)

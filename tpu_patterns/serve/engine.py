@@ -1,0 +1,499 @@
+"""Continuous-batching serve engine: iteration-level scheduling over the
+paged pool.
+
+The host loop owns what the compiled cores cannot: the request queue,
+the slot table, and the block free-list.  Each iteration it
+
+  1. RETIRES finished rows (their blocks go back to the pool),
+  2. ADMITS queued requests into freed slots — deferring, never OOMing,
+     when the pool cannot cover a request's whole lifetime
+     (``ceil((prompt + gen - 1) / block_len)`` blocks, reserved at
+     admission so a mid-flight row can never strand),
+  3. PREFILLS the newcomers as one bucketed call (ragged lens), and
+  4. runs ONE decode step for the whole active set — per-row positions,
+     so a row admitted at iteration 40 decodes beside one admitted at
+     iteration 0 (the Orca iteration-level property).
+
+Compiled shapes are bucketed (active rows to the next power of two,
+prompt lengths likewise), so steady-state serving re-dispatches a small
+fixed set of executables; the pool is donated through every call and
+updates in place.  Every step runs under a PR-2 watchdog span, and the
+loop feeds the obs metrics registry (tokens/s, queue wait, pool
+occupancy, per-step latency).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from tpu_patterns.core.timing import clock_ns
+from tpu_patterns.serve.paged import TRASH_BLOCK, make_paged_lm_decoder
+
+
+def _bucket(n: int, cap: int) -> int:
+    """Next power of two >= n, clipped to cap."""
+    return min(1 << max(0, n - 1).bit_length(), cap)
+
+
+@dataclasses.dataclass
+class Request:
+    rid: int
+    tokens: list[int]  # prompt ids
+    n_gen: int  # total tokens to generate (first comes from prefill)
+
+
+@dataclasses.dataclass
+class _Slot:
+    rid: int
+    lens: int
+    steps: int  # generated tokens already WRITTEN through the cache
+    n_gen: int
+    table: list[int]
+    last_tok: int
+    out: list[int]
+    t_submit_ns: int
+
+
+class ServeEngine:
+    """Continuous-batching scheduler over a :class:`PagedDecoder`.
+
+    ``slots`` bounds the active set (the decode bucket ceiling);
+    ``decoder`` supplies the compiled cores and pool layout and may be
+    SHARED between engines (each engine owns its own pool), which is how
+    the sequential baseline reuses the continuous run's executables.
+    """
+
+    def __init__(self, decoder, params, *, slots: int,
+                 watchdog_s: float = 0.0):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        self.decoder = decoder
+        self.params = params
+        self.slots = slots
+        self.watchdog_s = watchdog_s
+        self.layout = decoder.layout
+        self.n_pages = decoder.n_pages
+        self.pool = decoder.init_pool()
+        # block 0 is the trash block: never handed out
+        self.free = list(range(self.layout.n_blocks - 1, TRASH_BLOCK, -1))
+        self.queue: list[tuple[Request, int]] = []  # (request, t_submit)
+        self.active: list[_Slot] = []
+        self.done: dict[int, list[int]] = {}
+        self.stats = {
+            "steps": 0, "prefills": 0, "deferrals": 0, "tokens": 0,
+            "max_occupancy": 0.0, "queue_wait_ns": [],
+        }
+
+    # -- bookkeeping -----------------------------------------------------
+
+    def _blocks_needed(self, req: Request) -> int:
+        # highest written position is prompt + n_gen - 2 (the final token
+        # is returned but its K/V is never needed); keep one extra slot
+        # of headroom so n_gen == 1 still reserves the prompt's blocks
+        return self.layout.blocks_for(len(req.tokens) + max(req.n_gen - 1, 0))
+
+    def submit(self, req: Request) -> None:
+        if not req.tokens or req.n_gen < 1:
+            raise ValueError(f"request {req.rid}: empty prompt or n_gen < 1")
+        need = self._blocks_needed(req)
+        # highest position ever written/attended is prompt + n_gen - 2
+        # (the final token is returned, its K/V never stored) — the same
+        # lifetime model _blocks_needed reserves for
+        span = len(req.tokens) + req.n_gen - 1
+        if need > self.layout.n_blocks - 1:
+            raise ValueError(
+                f"request {req.rid} needs {need} blocks; the pool only has "
+                f"{self.layout.n_blocks - 1} allocatable"
+            )
+        if span > self.n_pages * self.layout.block_len:
+            raise ValueError(
+                f"request {req.rid}: {span} positions exceed the "
+                f"{self.n_pages}-block table window"
+            )
+        self.queue.append((req, clock_ns()))
+
+    def _occupancy(self) -> float:
+        alloc = self.layout.n_blocks - 1 - len(self.free)
+        return alloc / (self.layout.n_blocks - 1)
+
+    def _retire(self) -> None:
+        from tpu_patterns import obs
+
+        still = []
+        for s in self.active:
+            if len(s.out) >= s.n_gen:
+                self.free.extend(
+                    b for b in s.table if b != TRASH_BLOCK
+                )
+                self.done[s.rid] = s.out
+                obs.counter("tpu_patterns_serve_requests_total").inc()
+            else:
+                still.append(s)
+        self.active = still
+
+    def _admit(self) -> list[tuple[Request, _Slot]]:
+        """Pull queued requests into free slots while blocks last; a
+        request the pool cannot cover right now DEFERS (stays queued, a
+        deferral counted) instead of overcommitting — pool exhaustion is
+        a scheduling state, not an OOM."""
+        from tpu_patterns import obs
+
+        admitted: list[tuple[Request, _Slot]] = []
+        while self.queue and len(self.active) + len(admitted) < self.slots:
+            req, t_submit = self.queue[0]
+            need = self._blocks_needed(req)
+            if need > len(self.free):
+                self.stats["deferrals"] += 1
+                obs.counter("tpu_patterns_serve_deferrals_total").inc()
+                break  # FIFO: later (smaller) requests must not starve it
+            self.queue.pop(0)
+            table = [self.free.pop() for _ in range(need)]
+            slot = _Slot(
+                rid=req.rid, lens=len(req.tokens), steps=0,
+                n_gen=req.n_gen, table=table, last_tok=-1, out=[],
+                t_submit_ns=t_submit,
+            )
+            wait_ns = clock_ns() - t_submit
+            self.stats["queue_wait_ns"].append(wait_ns)
+            obs.histogram("tpu_patterns_serve_queue_wait_ms").observe(
+                wait_ns / 1e6
+            )
+            admitted.append((req, slot))
+        return admitted
+
+    def _tables_array(self, slots: list[_Slot], rows: int) -> np.ndarray:
+        t = np.full((rows, self.n_pages), TRASH_BLOCK, np.int32)
+        for i, s in enumerate(slots):
+            t[i, : len(s.table)] = s.table
+        return t
+
+    # -- compiled-call assembly ------------------------------------------
+
+    def _prefill(self, admitted: list[tuple[Request, _Slot]]) -> None:
+        from tpu_patterns import obs
+
+        reqs = [r for r, _ in admitted]
+        slots = [s for _, s in admitted]
+        lmax = max(len(r.tokens) for r in reqs)
+        lpad = _bucket(lmax, self.n_pages * self.layout.block_len)
+        rows = _bucket(len(reqs), self.slots)
+        tokens = np.zeros((rows, lpad), np.int32)
+        lens = np.zeros((rows,), np.int32)
+        active = np.zeros((rows,), bool)
+        for i, r in enumerate(reqs):
+            tokens[i, : len(r.tokens)] = r.tokens
+            lens[i] = len(r.tokens)
+            active[i] = True
+        tables = self._tables_array(slots, rows)
+        fn = self.decoder.prefill_jit(rows, lpad)
+        t0 = clock_ns()
+        with obs.span(
+            "serve.prefill",
+            deadline_s=self.watchdog_s or None,
+            rows=len(reqs), lpad=lpad,
+        ):
+            self.pool, tok0 = fn(
+                self.params, self.pool, tokens, lens, tables, active
+            )
+            tok0 = np.asarray(tok0)
+        obs.histogram("tpu_patterns_serve_prefill_ms").observe(
+            (clock_ns() - t0) / 1e6
+        )
+        for i, s in enumerate(slots):
+            s.last_tok = int(tok0[i])
+            s.out.append(s.last_tok)
+            self.stats["tokens"] += 1
+        obs.counter("tpu_patterns_serve_tokens_total").inc(len(slots))
+        self.stats["prefills"] += 1
+        self.active.extend(slots)
+
+    def _step(self) -> None:
+        from tpu_patterns import obs
+
+        rows = _bucket(len(self.active), self.slots)
+        tok = np.zeros((rows,), np.int32)
+        lens = np.zeros((rows,), np.int32)
+        steps = np.zeros((rows,), np.int32)
+        active = np.zeros((rows,), bool)
+        for i, s in enumerate(self.active):
+            tok[i], lens[i], steps[i], active[i] = (
+                s.last_tok, s.lens, s.steps, True
+            )
+        tables = self._tables_array(self.active, rows)
+        fn = self.decoder.step_jit(rows)
+        t0 = clock_ns()
+        with obs.span(
+            "serve.step",
+            deadline_s=self.watchdog_s or None,
+            rows=len(self.active),
+        ):
+            self.pool, nxt = fn(
+                self.params, self.pool, tok, lens, steps, tables, active
+            )
+            nxt = np.asarray(nxt)
+        obs.histogram("tpu_patterns_serve_step_ms").observe(
+            (clock_ns() - t0) / 1e6
+        )
+        for i, s in enumerate(self.active):
+            s.steps += 1  # the fed token's K/V is now in the pool
+            s.last_tok = int(nxt[i])
+            s.out.append(s.last_tok)
+            self.stats["tokens"] += 1
+        obs.counter("tpu_patterns_serve_tokens_total").inc(len(self.active))
+        self.stats["steps"] += 1
+
+    # -- the loop --------------------------------------------------------
+
+    def run(self, requests: list[Request]) -> dict[int, list[int]]:
+        """Serve ``requests`` to completion; returns {rid: generated ids}."""
+        from tpu_patterns import obs
+
+        for r in requests:
+            self.submit(r)
+        with obs.span("serve.run", requests=len(requests)):
+            while self.queue or self.active:
+                self._retire()
+                admitted = self._admit()
+                if admitted:
+                    self._prefill(admitted)
+                    self._retire()  # n_gen == 1 rows finish at prefill
+                if self.active:
+                    self._step()
+                occ = self._occupancy()
+                self.stats["max_occupancy"] = max(
+                    self.stats["max_occupancy"], occ
+                )
+                obs.gauge("tpu_patterns_serve_pool_occupancy").set(occ)
+                obs.gauge("tpu_patterns_serve_active_rows").set(
+                    len(self.active)
+                )
+        return dict(self.done)
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """CLI ``serve`` subcommand: the continuous-batching measured pattern."""
+
+    vocab: int = 512
+    embed: int = 128
+    heads: int = 8
+    head_dim: int = 16
+    mlp_mult: int = 4
+    depth: int = 2
+    dtype: str = "float32"
+    rope: bool = True
+    kv_heads: int = 0
+    cache_int8: bool = False
+    slots: int = 8  # active-set ceiling (decode bucket cap)
+    block_len: int = 16  # pool block size in token slots
+    n_blocks: int = 0  # pool blocks incl. trash; 0 = auto (~3/4 of dense)
+    requests: int = 16
+    min_prompt: int = 8
+    max_prompt: int = 48
+    gen: int = 16  # tokens generated per request
+    min_speedup: float = 1.0  # continuous-vs-sequential gate
+    watchdog_s: float = 0.0  # per-step watchdog deadline (0 = spans only)
+    seed: int = 0
+
+
+def _auto_blocks(cfg: ServeConfig) -> int:
+    """Default pool: ~3/4 of the dense ``slots x max_len`` rectangle (so
+    the memory contrast is real and deferral is reachable), floored at
+    one request's worst case + trash."""
+    max_len = cfg.max_prompt + cfg.gen
+    dense_blocks = cfg.slots * (-(-max_len // cfg.block_len))
+    need_one = -(-max_len // cfg.block_len)
+    return max(3 * dense_blocks // 4, need_one + 1) + 1  # +1: trash block
+
+
+def run_serve(mesh, cfg: ServeConfig, writer) -> list:
+    """Measured pattern: serve one request trace twice — continuous
+    batching (``slots`` wide) vs sequential (one request at a time
+    through the SAME engine and executables) — and gate:
+
+    * speedup: continuous tokens/s > sequential tokens/s,
+    * exactness: every request's greedy ids equal its PER-REQUEST dense
+      decode (``make_lm_decoder`` at batch 1 — the engine must never
+      change what a request would have said alone; caveat: int8 on an
+      sp > 1 mesh compares against a dense prefill that attends FLOAT
+      k/v via ring attention while the paged prefill reads the
+      quantized pool, so a top-2 margin inside the quantization error
+      could flip this gate — see docs/serving.md),
+    * memory: compiled ``memory_analysis`` shows the donated pool
+      aliased in place and cache bytes proportional to the pool, under
+      the dense ``slots x max_len`` rectangle.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_patterns import obs
+    from tpu_patterns.core.results import Record, Verdict
+    from tpu_patterns.models.lm import init_lm_params, make_lm_decoder
+    from tpu_patterns.models.transformer import ModelConfig, _n_experts
+
+    mcfg = ModelConfig(
+        embed=cfg.embed,
+        heads=cfg.heads,
+        head_dim=cfg.head_dim,
+        mlp_mult=cfg.mlp_mult,
+        causal=True,
+        dtype=cfg.dtype,
+        depth=cfg.depth,
+        kv_heads=cfg.kv_heads,
+        rope=cfg.rope,
+    )
+    sp = int(mesh.shape["sp"])
+    max_len = cfg.max_prompt + cfg.gen
+    n_blocks = cfg.n_blocks or _auto_blocks(cfg)
+    decoder = make_paged_lm_decoder(
+        mesh, mcfg, cfg.vocab,
+        n_blocks=n_blocks, block_len=cfg.block_len, max_len=max_len,
+        cache_int8=cfg.cache_int8,
+    )
+    flat_params = init_lm_params(
+        jax.random.key(cfg.seed), mcfg, cfg.vocab, _n_experts(mesh, mcfg)
+    )
+    params = decoder.stack_params(flat_params)
+
+    rng = np.random.RandomState(cfg.seed + 1)
+    trace = [
+        Request(
+            rid=i,
+            tokens=rng.randint(
+                0, cfg.vocab,
+                size=rng.randint(cfg.min_prompt, cfg.max_prompt + 1),
+            ).tolist(),
+            n_gen=cfg.gen,
+        )
+        for i in range(cfg.requests)
+    ]
+    total_tokens = sum(r.n_gen for r in trace)
+
+    def timed_run(slots: int):
+        eng = ServeEngine(
+            decoder, params, slots=slots, watchdog_s=cfg.watchdog_s
+        )
+        eng.run([dataclasses.replace(r) for r in trace])  # warm compile
+        eng2 = ServeEngine(
+            decoder, params, slots=slots, watchdog_s=cfg.watchdog_s
+        )
+        t0 = clock_ns()
+        out = eng2.run([dataclasses.replace(r) for r in trace])
+        sec = (clock_ns() - t0) / 1e9
+        return out, sec, eng2
+
+    with obs.span("serve.continuous", slots=cfg.slots):
+        out_cont, cont_s, eng_cont = timed_run(cfg.slots)
+    with obs.span("serve.sequential"):
+        out_seq, seq_s, _ = timed_run(1)
+    cont_tps = total_tokens / cont_s if cont_s > 0 else 0.0
+    seq_tps = total_tokens / seq_s if seq_s > 0 else 0.0
+    speedup = cont_tps / seq_tps if seq_tps > 0 else 0.0
+    obs.gauge("tpu_patterns_serve_tokens_per_s", mode="continuous").set(
+        cont_tps
+    )
+    obs.gauge("tpu_patterns_serve_tokens_per_s", mode="sequential").set(
+        seq_tps
+    )
+
+    # exactness: per-request dense decode, greedy, same mesh
+    lpd = cfg.max_prompt + (-cfg.max_prompt % sp)
+    gen_cap = cfg.gen + (-cfg.gen % sp)
+    dpre, dgen = make_lm_decoder(
+        mesh, mcfg, cfg.vocab, 1, lpd, gen_cap, cache_int8=cfg.cache_int8
+    )
+    exact = out_cont == out_seq  # batching must not change a row's ids
+    for r in trace:
+        toks = np.zeros((1, lpd), np.int32)
+        toks[0, : len(r.tokens)] = r.tokens
+        lens = jnp.asarray([len(r.tokens)], jnp.int32)
+        caches, t0_tok = dpre(flat_params, toks, lens)
+        want = [int(np.asarray(t0_tok)[0])]
+        if r.n_gen > 1:
+            _, ids = dgen(
+                flat_params, caches, t0_tok, (lens, 0), r.n_gen - 1
+            )
+            want += np.asarray(ids)[0].tolist()
+        if out_cont.get(r.rid) != want:
+            exact = False
+            writer.progress(
+                f"serve exactness: request {r.rid} diverged from dense "
+                f"decode (got {out_cont.get(r.rid)}, want {want})"
+            )
+            break
+
+    # memory gates: donated pool aliased in place; cache bytes scale
+    # with the pool, not the dense slots x max_len rectangle
+    from tpu_patterns.models.decode import kv_slot_bytes
+
+    mm = decoder.memory_metrics(params, cfg.slots)
+    pool_mb = decoder.pool_nbytes() / 1e6
+    dense_mb = (
+        cfg.depth * cfg.slots * max_len
+        * kv_slot_bytes(
+            cfg.head_dim, cfg.kv_heads or cfg.heads, cfg.dtype,
+            cfg.cache_int8,
+        ) / 1e6
+    )
+    mem_ok = pool_mb < dense_mb
+    alias_mb = -1.0
+    if mm is not None:
+        alias_mb = mm["alias_bytes"] / 1e6
+        mem_ok = mem_ok and mm["alias_bytes"] >= mm["pool_bytes"]
+        mem_ok = mem_ok and mm["argument_bytes"] >= mm["pool_bytes"]
+
+    waits = eng_cont.stats["queue_wait_ns"]
+    ok = (
+        exact
+        and np.isfinite(speedup)
+        and speedup > cfg.min_speedup
+        and mem_ok
+    )
+    rec = Record(
+        pattern="serve",
+        mode=f"slots{cfg.slots}_bl{cfg.block_len}_sp{sp}"
+        + (f"_gqa{cfg.kv_heads}" if cfg.kv_heads else "")
+        + ("_int8" if cfg.cache_int8 else ""),
+        commands=(
+            f"req{cfg.requests} prompt{cfg.min_prompt}-{cfg.max_prompt} "
+            f"gen{cfg.gen} V{cfg.vocab} depth{cfg.depth} {cfg.dtype}"
+        ),
+        metrics={
+            "tokens_per_s": round(cont_tps, 1),
+            "sequential_tokens_per_s": round(seq_tps, 1),
+            "speedup": round(speedup, 3),
+            "exact": float(exact),
+            "pool_blocks": float(n_blocks),
+            "cache_MB": round(pool_mb, 4),
+            "dense_cache_MB": round(dense_mb, 4),
+            "alias_MB": round(alias_mb, 4),
+            "max_pool_occupancy": round(
+                eng_cont.stats["max_occupancy"], 3
+            ),
+            "deferrals": float(eng_cont.stats["deferrals"]),
+            "decode_steps": float(eng_cont.stats["steps"]),
+            "mean_queue_wait_ms": round(
+                float(np.mean(waits)) / 1e6 if waits else 0.0, 3
+            ),
+        },
+        verdict=Verdict.SUCCESS if ok else Verdict.FAILURE,
+    )
+    if not exact:
+        rec.notes.append(
+            "exactness gate FAILED: continuous batching changed a "
+            "request's greedy ids vs per-request dense decode"
+        )
+    if not speedup > cfg.min_speedup:
+        rec.notes.append(
+            f"speedup {speedup:.2f} <= {cfg.min_speedup}: continuous "
+            "batching did not beat sequential serving on this trace"
+        )
+    if not mem_ok:
+        rec.notes.append(
+            "memory gate FAILED: pool not aliased in place or cache "
+            "bytes not under the dense slots x max_len rectangle"
+        )
+    writer.record(rec)
+    return [rec]

@@ -1,0 +1,556 @@
+"""Paged KV cache: a block pool + per-sequence block tables.
+
+The dense decoder (models/decode.py) allocates ``batch x max_len`` cache
+slots up front and can admit nothing until the whole batch drains.  Here
+the cache is a POOL of fixed-size blocks —
+
+    k/v: [depth, n_blocks, block_len, Hkv, D]   sharded P(-, -, sp, tp, -)
+
+— and each sequence owns a TABLE of physical block ids covering its
+positions ``[0, lens+steps)``.  Prefill and decode write through the
+table (a scatter at the row's ``(block, offset)``), attention reads
+through it (a gather over the row's block ids), and a finished sequence
+returns its blocks to the pool, so cache HBM scales with the configured
+pool — concurrent sequences share it — instead of with the worst-case
+``batch x max_len`` rectangle (the PagedAttention memory argument).
+
+Sharding keeps the dense path's axes: ``tp`` shards KV heads exactly as
+before, and ``sp`` shards WITHIN each block (rank r owns in-block
+offsets ``[r*bl_loc, (r+1)*bl_loc)``), so every rank holds a slice of
+every block, gathers are rank-local, and the attention combine is the
+same pmax/psum online softmax as ``_cache_attend``
+(:func:`~tpu_patterns.models.decode._distributed_attention`, reused
+verbatim — int8 blocks carry per-slot scales through the same einsum
+folding).  ``dp`` is rejected: the pool is shared state across the
+active set, and batch rows are scheduler slots, not a data axis.
+
+Physical block 0 is the TRASH block: never allocated, it absorbs the
+writes of non-owning sp ranks, padding positions, and inactive rows —
+the select-not-branch SPMD discipline of ``_CacheLayout`` applied to a
+scatter.  Slots the table does not cover are masked by closed-form
+positions, so a stale pool block can never leak into attention.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_patterns.models.decode import (
+    _distributed_attention,
+    _mlp,
+    _quantize_kv,
+    _stacked_specs,
+    kv_slot_bytes,
+)
+from tpu_patterns.models.lm import embed_tokens, sharded_argmax
+from tpu_patterns.models.transformer import (
+    ModelConfig,
+    _check_kv_heads_shardable,
+    _n_experts,
+    analysis_compile,
+    apply_rope,
+    qkv_native,
+    rope_tables,
+)
+
+# physical block 0 absorbs routed-away writes and is never allocated
+TRASH_BLOCK = 0
+
+
+class PagedLayout:
+    """Closed-form slot math for the block pool.
+
+    Global position ``t`` lives in logical block ``t // block_len`` at
+    in-block offset ``t % block_len``; sp rank ``o // bl_loc`` owns that
+    offset's slice.  The physical block is whatever the sequence's table
+    maps the logical block to — the ONE indirection the dense layout
+    lacks.
+    """
+
+    def __init__(self, n_blocks: int, block_len: int, sp: int):
+        if n_blocks < 2:
+            raise ValueError(
+                f"need >= 2 blocks (one is the trash block), got {n_blocks}"
+            )
+        if block_len % sp:
+            raise ValueError(
+                f"block_len {block_len} must divide over sp={sp}"
+            )
+        self.n_blocks, self.block_len, self.sp = n_blocks, block_len, sp
+        self.bl_loc = block_len // sp
+
+    def blocks_for(self, n_positions: int) -> int:
+        """Blocks covering positions [0, n_positions)."""
+        return -(-n_positions // self.block_len)
+
+    def _rank(self, sp_axis):
+        return lax.axis_index(sp_axis) if sp_axis is not None else 0
+
+    def write_slot(self, pos, tables, sp_axis):
+        """Per-row ``(physical block, local offset, owned)`` for writing
+        global position ``pos`` [B] through ``tables`` [B, n_pages]."""
+        n_pages = tables.shape[1]
+        j = jnp.clip(pos // self.block_len, 0, n_pages - 1)
+        o = pos % self.block_len
+        phys = jnp.take_along_axis(tables, j[:, None], axis=1)[:, 0]
+        own = (o // self.bl_loc) == self._rank(sp_axis)
+        return phys, o % self.bl_loc, own
+
+    def page_positions(self, n_pages: int, sp_axis) -> jax.Array:
+        """[n_pages * bl_loc] GLOBAL position held by each local slot of
+        a gathered page window (logical block j, local offset ol on this
+        rank ↦ ``j*block_len + r*bl_loc + ol``)."""
+        r = self._rank(sp_axis)
+        j = jnp.arange(n_pages, dtype=jnp.int32)
+        ol = jnp.arange(self.bl_loc, dtype=jnp.int32)
+        return (
+            j[:, None] * self.block_len + r * self.bl_loc + ol[None, :]
+        ).reshape(-1)
+
+
+def _pool_write(pool_l: dict, kt, vt, pb, ob) -> dict:
+    """Scatter per-row k/v [B, Hkv, D] into local pool leaves at
+    ``(pb, ob)`` [B] each; quantizing on the way in when int8 (same
+    per-slot granularity as the dense ``_cache_write``).  Rows routed to
+    the trash block may collide — by design, their values are garbage."""
+    if "ks" in pool_l:
+        kq, ks = _quantize_kv(kt[:, :, None, :])
+        vq, vs = _quantize_kv(vt[:, :, None, :])
+        return {
+            "k": pool_l["k"].at[pb, ob].set(kq[:, :, 0, :]),
+            "v": pool_l["v"].at[pb, ob].set(vq[:, :, 0, :]),
+            "ks": pool_l["ks"].at[pb, ob].set(ks[:, :, 0]),
+            "vs": pool_l["vs"].at[pb, ob].set(vs[:, :, 0]),
+        }
+    return {
+        "k": pool_l["k"].at[pb, ob].set(kt.astype(pool_l["k"].dtype)),
+        "v": pool_l["v"].at[pb, ob].set(vt.astype(pool_l["v"].dtype)),
+    }
+
+
+def _pool_attend(pool_l: dict, q, tables, mask, layout, sp_axis):
+    """Attention of q [B, Lq, H, D] against the rows' gathered pages.
+
+    Gathers each row's table window [B, n_pages, bl_loc, Hkv, ...] from
+    the local pool slice, flattens pages into the cache axis, and runs
+    the SAME masked online-softmax combine as the dense path — the
+    gather-over-block-indices is the only paged-specific step."""
+    b = q.shape[0]
+    tb = jnp.clip(tables, 0, layout.n_blocks - 1)
+
+    def pages(leaf):  # [n_blocks, bl_loc, Hkv, ...] -> [B, Hkv, L_loc, ...]
+        g = leaf[tb]  # [B, n_pages, bl_loc, Hkv, ...]
+        if g.ndim == 5:
+            g = g.transpose(0, 3, 1, 2, 4)
+        else:
+            g = g.transpose(0, 3, 1, 2)
+        return g.reshape(b, g.shape[1], -1, *g.shape[4:])
+
+    return _distributed_attention(
+        q, pages(pool_l["k"]), pages(pool_l["v"]), mask, sp_axis,
+        k_scale=pages(pool_l["ks"]) if "ks" in pool_l else None,
+        v_scale=pages(pool_l["vs"]) if "vs" in pool_l else None,
+    )
+
+
+def _paged_prefill_layer(
+    p_l, x, pool_l, lens, tables, layout, cfg, sp_axis, tp_axis
+):
+    """One layer over a batch of (right-padded) PROMPTS: compute k/v for
+    every prompt position, scatter them through the tables, then attend
+    causally by reading the written pages back — so prefill sees exactly
+    what decode will see (quantized values included), on every sp
+    layout.  Queries are sp-replicated (the pool, not the activations,
+    carries the sp sharding), so the replicated-query psum combine
+    applies at prefill too — no ring pass needed."""
+    b, lp, _ = x.shape
+    n_pages = tables.shape[1]
+    q, k, v = qkv_native(p_l, x)
+    if cfg.rope:
+        pos = jnp.arange(lp, dtype=jnp.int32)
+        cos, sin = rope_tables(pos, cfg.head_dim, cfg.rope_theta, q.dtype)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+    t = jnp.arange(lp, dtype=jnp.int32)
+    j = jnp.clip(t // layout.block_len, 0, n_pages - 1)
+    o = t % layout.block_len
+    phys = jnp.take(tables, j, axis=1)  # [B, Lp]
+    own = ((o // layout.bl_loc) == layout._rank(sp_axis))[None, :] & (
+        t[None, :] < lens[:, None]
+    )
+    pb = jnp.where(own, phys, TRASH_BLOCK).reshape(-1)
+    ob = jnp.where(own, (o % layout.bl_loc)[None, :], 0).reshape(-1)
+    hkv, d = k.shape[2], k.shape[3]
+    pool_l = _pool_write(
+        pool_l,
+        k.reshape(b * lp, hkv, d),
+        v.reshape(b * lp, hkv, d),
+        pb,
+        ob,
+    )
+
+    # causal by GLOBAL positions over the gathered window; slots beyond
+    # the table or the row's written prefix sit at invisible positions
+    posn = layout.page_positions(n_pages, sp_axis)  # [L_loc]
+    tvalid = jnp.repeat(tables > TRASH_BLOCK, layout.bl_loc, axis=1)
+    mask = (
+        (posn[None, None, :] <= t[None, :, None])
+        & (posn[None, None, :] < lens[:, None, None])
+        & tvalid[:, None, :]
+    )  # [B, Lp, L_loc]
+    attn = _pool_attend(pool_l, q, tables, mask, layout, sp_axis)
+    o_ = jnp.einsum("blhd,hde->ble", attn, p_l["wo"])
+    if tp_axis is not None:
+        o_ = lax.psum(o_, tp_axis)
+    y = x + o_
+    return _mlp(p_l, y, tp_axis, cfg), pool_l
+
+
+def _paged_decode_layer(
+    p_l, x, pool_l, pos, active, tables, layout, cfg, sp_axis, tp_axis
+):
+    """One layer for each active row's NEXT token.  x [B, 1, E]
+    sp-replicated; ``pos`` [B] the incoming token's global position
+    (``lens + steps`` — per-row step counts, nothing is lockstep);
+    writes go to the row's tail block, reads gather its page window."""
+    q, k, v = qkv_native(p_l, x)
+    if cfg.rope:
+        cos, sin = rope_tables(
+            pos[:, None], cfg.head_dim, cfg.rope_theta, q.dtype
+        )
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+    kt = k.transpose(0, 2, 1, 3)[:, :, 0]  # [B, Hkv, D]
+    vt = v.transpose(0, 2, 1, 3)[:, :, 0]
+    phys, o_loc, own = layout.write_slot(pos, tables, sp_axis)
+    keep = own & active
+    pool_l = _pool_write(
+        pool_l,
+        kt,
+        vt,
+        jnp.where(keep, phys, TRASH_BLOCK),
+        jnp.where(keep, o_loc, 0),
+    )
+
+    n_pages = tables.shape[1]
+    posn = layout.page_positions(n_pages, sp_axis)
+    tvalid = jnp.repeat(tables > TRASH_BLOCK, layout.bl_loc, axis=1)
+    mask = (
+        (posn[None, :] <= pos[:, None]) & tvalid & active[:, None]
+    )  # [B, L_loc]
+    attn = _pool_attend(pool_l, q, tables, mask[:, None, :], layout, sp_axis)
+    o_ = jnp.einsum("blhd,hde->ble", attn, p_l["wo"])
+    if tp_axis is not None:
+        o_ = lax.psum(o_, tp_axis)
+    y = x + o_
+    return _mlp(p_l, y, tp_axis, cfg), pool_l
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedDecoder:
+    """Compiled (prefill, step) pair over the paged pool.
+
+    * ``prefill(params, pool, tokens, lens, tables, active) ->
+      (pool, tok0)``: run a bucket of newcomer prompts [B, Lpad]
+      (right-padded, per-row true ``lens``), write their K/V through
+      their tables, and return each row's greedy first token.
+    * ``step(params, pool, tok, lens, steps, tables, active) ->
+      (pool, next_tok)``: one iteration for a bucket of ACTIVE rows —
+      embed each row's last token (its generation index ``steps[b]``,
+      global position ``lens[b] + steps[b]``), write its K/V to the
+      row's tail block, attend through the tables, and return the next
+      greedy ids.  Rows are independent: per-row lens/steps, no
+      lockstep.
+
+    The pool is DONATED into both: in/out specs match, so XLA scatters
+    the new slots into the SAME HBM buffers step after step — the serve
+    loop threads one pool through its whole lifetime with no per-call
+    cache copy (the dense ``run_decode`` chain had to copy to cancel
+    donation; here reuse IS the design).  Compiled executables are
+    cached per (rows, prompt-length) bucket, so steady-state serving
+    re-dispatches a small fixed set of programs.
+    """
+
+    mesh: Mesh
+    cfg: ModelConfig
+    vocab: int
+    layout: PagedLayout
+    n_pages: int  # table width: blocks covering the longest sequence
+    cache_int8: bool = False
+
+    def __post_init__(self):
+        if int(self.mesh.shape.get("dp", 1)) != 1:
+            raise ValueError(
+                "serve shards the pool over sp/tp only — fold dp into sp "
+                "(batch rows are scheduler slots, not a data axis)"
+            )
+        if int(self.layout.sp) != int(self.mesh.shape["sp"]):
+            raise ValueError("layout.sp must match the mesh sp axis")
+        tp = int(self.mesh.shape["tp"])
+        if self.vocab % tp:
+            raise ValueError(f"vocab {self.vocab} must divide over tp={tp}")
+        _check_kv_heads_shardable(self.cfg, self.mesh)
+        # lru caches must live per instance, not on the frozen class
+        object.__setattr__(self, "_prefill_cache", {})
+        object.__setattr__(self, "_step_cache", {})
+
+    # -- pool ------------------------------------------------------------
+
+    def _kv_heads(self) -> int:
+        return self.cfg.kv_heads or self.cfg.heads
+
+    def pool_specs(self) -> dict[str, P]:
+        kv = P(None, None, "sp", "tp", None)
+        specs = {"k": kv, "v": kv}
+        if self.cache_int8:
+            specs.update(
+                {"ks": P(None, None, "sp", "tp"),
+                 "vs": P(None, None, "sp", "tp")}
+            )
+        return specs
+
+    def pool_nbytes(self) -> int:
+        lay, cfg = self.layout, self.cfg
+        slots = lay.n_blocks * lay.block_len
+        return cfg.depth * slots * kv_slot_bytes(
+            cfg.head_dim, self._kv_heads(), cfg.dtype, self.cache_int8
+        )
+
+    def _pool_leaves(self) -> dict[str, tuple[tuple, jnp.dtype]]:
+        """(shape, dtype) per pool leaf — one encoding shared by the
+        real allocation (init_pool) and the analysis avals."""
+        lay, cfg = self.layout, self.cfg
+        kv_shape = (
+            cfg.depth, lay.n_blocks, lay.block_len,
+            self._kv_heads(), cfg.head_dim,
+        )
+        if self.cache_int8:
+            return {
+                "k": (kv_shape, jnp.dtype(jnp.int8)),
+                "v": (kv_shape, jnp.dtype(jnp.int8)),
+                "ks": (kv_shape[:-1], jnp.dtype(jnp.float32)),
+                "vs": (kv_shape[:-1], jnp.dtype(jnp.float32)),
+            }
+        dt = jnp.dtype(cfg.dtype)
+        return {"k": (kv_shape, dt), "v": (kv_shape, dt)}
+
+    def init_pool(self) -> dict:
+        """Fresh zeroed pool, sharded over (sp, tp)."""
+        specs = self.pool_specs()
+        return {
+            n: jax.device_put(
+                jnp.zeros(shape, dt), NamedSharding(self.mesh, specs[n])
+            )
+            for n, (shape, dt) in self._pool_leaves().items()
+        }
+
+    # -- compiled cores --------------------------------------------------
+
+    def _axes(self):
+        sp = int(self.mesh.shape["sp"])
+        tp = int(self.mesh.shape["tp"])
+        return ("sp" if sp > 1 else None), ("tp" if tp > 1 else None)
+
+    def _param_specs(self) -> dict[str, P]:
+        n_exp = _n_experts(self.mesh, self.cfg)
+        return dict(
+            _stacked_specs(self.cfg, n_exp), wemb=P(None, "tp", None)
+        )
+
+    @staticmethod
+    def _split(params):
+        blocks = {k: v for k, v in params.items() if k != "wemb"}
+        return blocks, params["wemb"][0]  # wemb carries a dummy depth axis
+
+    def prefill_jit(self, rows: int, prompt_len: int):
+        key = (rows, prompt_len)
+        fn = self._prefill_cache.get(key)
+        if fn is None:
+            fn = self._prefill_cache[key] = self._build_prefill(prompt_len)
+        return fn
+
+    def step_jit(self, rows: int):
+        fn = self._step_cache.get(rows)
+        if fn is None:
+            fn = self._step_cache[rows] = self._build_step()
+        return fn
+
+    def compiled_buckets(self) -> tuple[int, int]:
+        return len(self._prefill_cache), len(self._step_cache)
+
+    def _build_prefill(self, prompt_len: int):
+        cfg, layout = self.cfg, self.layout
+        lcfg = dataclasses.replace(cfg, depth=1)
+        sp_axis, tp_axis = self._axes()
+        if prompt_len > self.n_pages * layout.block_len:
+            raise ValueError(
+                f"prompt_len {prompt_len} exceeds the table window "
+                f"({self.n_pages} blocks x {layout.block_len})"
+            )
+
+        def body(params, pool, tokens, lens, tables, active):
+            blocks, wemb = self._split(params)
+            x = embed_tokens(wemb, tokens, tp_axis).astype(
+                jnp.dtype(cfg.dtype)
+            )
+
+            def layer(carry, xs):
+                y = carry
+                p_l, pl_l = xs
+                y, pl_l = _paged_prefill_layer(
+                    p_l, y, pl_l, lens, tables, layout, lcfg,
+                    sp_axis, tp_axis,
+                )
+                return y, pl_l
+
+            y, pool = lax.scan(layer, x, (blocks, pool))
+            idx = jnp.clip(lens - 1, 0, prompt_len - 1)
+            y_last = jnp.take_along_axis(y, idx[:, None, None], axis=1)
+            logits = jnp.einsum("be,ve->bv", y_last[:, 0, :], wemb)
+            tok0 = sharded_argmax(logits, tp_axis)
+            return pool, jnp.where(active, tok0, 0)
+
+        pool_specs = self.pool_specs()
+        return jax.jit(
+            jax.shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=(
+                    self._param_specs(), pool_specs, P(), P(), P(), P(),
+                ),
+                out_specs=(pool_specs, P()),
+                check_vma=False,
+            ),
+            donate_argnums=(1,),
+        )
+
+    def _build_step(self):
+        cfg, layout = self.cfg, self.layout
+        lcfg = dataclasses.replace(cfg, depth=1)
+        sp_axis, tp_axis = self._axes()
+
+        def body(params, pool, tok, lens, steps, tables, active):
+            blocks, wemb = self._split(params)
+            x = embed_tokens(wemb, tok[:, None], tp_axis).astype(
+                jnp.dtype(cfg.dtype)
+            )
+            pos = (lens + steps).astype(jnp.int32)
+
+            def layer(carry, xs):
+                y = carry
+                p_l, pl_l = xs
+                y, pl_l = _paged_decode_layer(
+                    p_l, y, pl_l, pos, active, tables, layout, lcfg,
+                    sp_axis, tp_axis,
+                )
+                return y, pl_l
+
+            y, pool = lax.scan(layer, x, (blocks, pool))
+            logits = jnp.einsum("be,ve->bv", y[:, 0, :], wemb)
+            nxt = sharded_argmax(logits, tp_axis)
+            return pool, jnp.where(active, nxt, 0)
+
+        pool_specs = self.pool_specs()
+        return jax.jit(
+            jax.shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=(
+                    self._param_specs(), pool_specs, P(), P(), P(), P(),
+                    P(),
+                ),
+                out_specs=(pool_specs, P()),
+                check_vma=False,
+            ),
+            donate_argnums=(1,),
+        )
+
+    # -- params ----------------------------------------------------------
+
+    def stack_params(self, params: dict) -> dict:
+        """Accept flat LM params (init_lm_params) and return the stacked,
+        sharded dict the compiled cores expect (leading depth axis on
+        every leaf; wemb carries a dummy one)."""
+        out = {}
+        for k, v in params.items():
+            if k == "wemb":
+                out[k] = v[None] if v.ndim == 2 else v
+            else:
+                out[k] = v if self.cfg.depth > 1 else v[None]
+        specs = self._param_specs()
+        return {
+            k: jax.device_put(v, NamedSharding(self.mesh, specs[k]))
+            for k, v in out.items()
+        }
+
+    # -- gates -----------------------------------------------------------
+
+    def memory_metrics(self, params: dict, rows: int) -> dict | None:
+        """Compiled memory analysis of the ``rows``-bucket decode step:
+        argument/alias/pool bytes.  The serve verdict gates on
+        ``alias >= pool`` (the donated pool really updates in place) and
+        the caller contrasts ``pool`` against the dense
+        ``slots x max_len`` rectangle.  None when the backend exposes no
+        analysis API — assert nothing rather than something false."""
+        specs = self.pool_specs()
+        pool_avals = {
+            n: jax.ShapeDtypeStruct(
+                shape, dt, sharding=NamedSharding(self.mesh, specs[n])
+            )
+            for n, (shape, dt) in self._pool_leaves().items()
+        }  # avals, not a second live pool: analysis must not double HBM
+        args = (
+            params, pool_avals,
+            jnp.zeros((rows,), jnp.int32),
+            jnp.zeros((rows,), jnp.int32),
+            jnp.zeros((rows,), jnp.int32),
+            jnp.zeros((rows, self.n_pages), jnp.int32),
+            jnp.zeros((rows,), bool),
+        )
+        try:
+            # analysis_compile, not a bare .compile(): a persistent-cache
+            # hit deserializes the executable with alias bytes == 0, and
+            # the in-place gate would false-fail on every warm CLI run
+            ma = analysis_compile(self.step_jit(rows), *args).memory_analysis()
+            # memory_analysis reports PER-DEVICE bytes; the pool leaves
+            # all shard fully over sp x tp (dp is rejected), so the
+            # per-device share divides by the mesh size
+            pool_global = float(self.pool_nbytes())
+            return {
+                "argument_bytes": float(ma.argument_size_in_bytes),
+                "alias_bytes": float(ma.alias_size_in_bytes),
+                "pool_bytes": pool_global / self.mesh.size,
+                "pool_bytes_global": pool_global,
+            }
+        except Exception:
+            return None
+
+
+def make_paged_lm_decoder(
+    mesh: Mesh,
+    cfg: ModelConfig,
+    vocab: int,
+    *,
+    n_blocks: int,
+    block_len: int,
+    max_len: int,
+    cache_int8: bool = False,
+) -> PagedDecoder:
+    """Build the paged token decoder: ``n_blocks`` physical blocks of
+    ``block_len`` slots (block 0 reserved as trash), tables sized to
+    cover ``max_len`` positions per sequence."""
+    layout = PagedLayout(n_blocks, block_len, int(mesh.shape["sp"]))
+    return PagedDecoder(
+        mesh=mesh,
+        cfg=cfg,
+        vocab=vocab,
+        layout=layout,
+        n_pages=layout.blocks_for(max_len),
+        cache_int8=cache_int8,
+    )

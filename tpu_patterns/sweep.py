@@ -263,6 +263,11 @@ QUICK_DECODE = (
     "--head_dim", "8", "--depth", "1", "--dtype", "float32",
     "--reps", "2", "--warmup", "1",
 )
+QUICK_SERVE = (
+    "--vocab", "64", "--embed", "64", "--head_dim", "8", "--depth", "1",
+    "--requests", "6", "--min_prompt", "4", "--max_prompt", "16",
+    "--gen", "6", "--slots", "4", "--block_len", "8",
+)
 
 
 def longctx_specs(quick: bool = False) -> list[SweepSpec]:
@@ -454,6 +459,32 @@ def parallel_specs(quick: bool = False) -> list[SweepSpec]:
             )
         )
     return specs
+
+
+def serve_specs(quick: bool = False) -> list[SweepSpec]:
+    """Continuous-batching serve matrix: the base engine cell, the int8
+    pool, and a GQA pool — each cell re-runs the full verdict set
+    (speedup over sequential, per-request token exactness, in-place
+    paged-pool memory analysis) at its own cache layout."""
+    small = QUICK_SERVE if quick else (
+        "--requests", "24", "--max_prompt", "96", "--gen", "32",
+        "--slots", "8", "--block_len", "16", "--embed", "256",
+        "--vocab", "1024",
+    )
+    env = (("TPU_PATTERNS_SWEEP_CONFIG", "serve"),)
+    return [
+        SweepSpec(name="serve.continuous", argv=("serve", *small), env=env),
+        SweepSpec(
+            name="serve.int8_pool",
+            argv=("serve", "--cache_int8", "true", *small),
+            env=env,
+        ),
+        SweepSpec(
+            name="serve.gqa_pool",
+            argv=("serve", "--kv_heads", "2", *small),
+            env=env,
+        ),
+    ]
 
 
 def hier_specs(quick: bool = False) -> list[SweepSpec]:
@@ -1351,6 +1382,7 @@ SUITES = {
     "allreduce": allreduce_specs,
     "longctx": longctx_specs,
     "parallel": parallel_specs,
+    "serve": serve_specs,
 }
 
 
