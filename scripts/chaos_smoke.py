@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""CI gate for the self-healing runtime (docs/robustness.md).
+
+Runs the REAL CLI on the simulated 8-device CPU mesh with faults
+injected through ``TPU_PATTERNS_FAULTS`` and gates that the runtime
+heals itself, visibly:
+
+  (a) cell crash-then-succeed: a sweep cell's CLI process crashes on
+      attempt 1 (shared fault-state dir makes the retry's fresh process
+      see ordinal 1); the schedule must still exit 0 with
+      ``tpu_patterns_faults_retries_total`` > 0 banked in the
+      schedule's sweep-metrics.jsonl — self-healing leaves a trail;
+  (b) worker kill: a warm worker is SIGKILLed before its ready
+      handshake; the schedule must still exit 0 (subprocess fallback)
+      with ``tpu_patterns_exec_spawn_failures_total`` > 0;
+  (c) preemption: a serve run takes SIGTERM mid-decode (injected
+      ``preempt``), snapshots through the ckpt atomic commit, and
+      ``serve --resume`` finishes the trace with greedy ids
+      BIT-IDENTICAL to an uninterrupted run of the same trace.
+
+Zero dependencies beyond the package; exit 0 = pass.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SERVE_ARGS = [
+    "--vocab", "64", "--embed", "64", "--head_dim", "8", "--depth", "1",
+    "--requests", "8", "--min_prompt", "4", "--max_prompt", "16",
+    "--gen", "6", "--slots", "4", "--block_len", "8",
+]
+
+
+def _env(faults: str = "", state: str = "") -> dict:
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.pop("TPU_PATTERNS_FAULTS", None)
+    env.pop("TPU_PATTERNS_FAULTS_STATE", None)
+    if faults:
+        env["TPU_PATTERNS_FAULTS"] = faults
+    if state:
+        env["TPU_PATTERNS_FAULTS_STATE"] = state
+    return env
+
+
+def _run(tag: str, cmd: list[str], env: dict) -> int:
+    print(f"+ [{tag}]", " ".join(cmd), flush=True)
+    t0 = time.monotonic()
+    proc = subprocess.run(cmd, env=env, cwd=ROOT)
+    print(f"  [{tag}] rc={proc.returncode} "
+          f"wall={time.monotonic() - t0:.1f}s", flush=True)
+    return proc.returncode
+
+
+def _metric_total(metrics_path: str, name: str) -> float:
+    """Sum a counter over all label sets in a sweep-metrics.jsonl dump."""
+    total = 0.0
+    with open(metrics_path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            m = json.loads(line)
+            if m.get("metric") == name:
+                total += float(m.get("value", 0.0))
+    return total
+
+
+def fail(msg: str) -> int:
+    print(f"chaos smoke: {msg}", file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    work = tempfile.mkdtemp(prefix="chaos_smoke_")
+    py = [sys.executable, "-m", "tpu_patterns"]
+
+    # (a) cell crash on attempt 1 -> retried to SUCCESS, counted.
+    # cmd=serve scopes the crash to serve CELL processes (the parent
+    # sweep CLI is cmd=sweep and must not crash); the shared state dir
+    # spends the single firing in the first attempt's process.
+    out_a = os.path.join(work, "sweep-retry")
+    rc = _run(
+        "cell-crash",
+        [*py, "sweep", "serve", "--quick", "--out", out_a,
+         "--name", "serve.continuous"],
+        _env("cell.run:crash:count=1:cmd=serve",
+             os.path.join(work, "state-a")),
+    )
+    if rc != 0:
+        return fail("sweep did not survive a cell crash-then-succeed")
+    metrics_a = os.path.join(out_a, "sweep-metrics.jsonl")
+    if not os.path.exists(metrics_a):
+        return fail(f"{metrics_a} missing — schedule vitals not banked")
+    retries = _metric_total(metrics_a, "tpu_patterns_faults_retries_total")
+    injected = _metric_total(
+        metrics_a, "tpu_patterns_faults_injected_total"
+    )
+    print(f"  [cell-crash] retries={retries} injected={injected}",
+          flush=True)
+    if not retries > 0:
+        return fail(
+            "schedule succeeded but banked no retries — the fault "
+            "either never fired or the recovery trail is invisible"
+        )
+
+    # (b) warm worker SIGKILLed before ready -> subprocess fallback,
+    # schedule still green, spawn failure counted.
+    out_b = os.path.join(work, "sweep-worker")
+    rc = _run(
+        "worker-kill",
+        [*py, "sweep", "serve", "--quick", "--out", out_b,
+         "--name", "serve.continuous", "--jobs", "2"],
+        _env("worker.ready:kill:count=1", os.path.join(work, "state-b")),
+    )
+    if rc != 0:
+        return fail("sweep did not survive a worker kill")
+    spawn_failures = _metric_total(
+        os.path.join(out_b, "sweep-metrics.jsonl"),
+        "tpu_patterns_exec_spawn_failures_total",
+    )
+    print(f"  [worker-kill] spawn_failures={spawn_failures}", flush=True)
+    if not spawn_failures > 0:
+        return fail("worker kill left no spawn-failure metric trail")
+
+    # (c) preemption: uninterrupted vs preempt+resume, bit-identical ids.
+    def serve(tag, snap, ids, faults="", resume=False):
+        cmd = [*py, "--jsonl", os.path.join(work, f"{tag}.jsonl"),
+               "serve", "--dp", "1", "--tp", "2", *SERVE_ARGS,
+               "--snapshot_dir", snap]
+        if ids:
+            cmd += ["--ids_out", ids]
+        if resume:
+            cmd += ["--resume", "true"]
+        return _run(tag, cmd, _env(faults))
+
+    want_ids = os.path.join(work, "want.json")
+    if serve("uninterrupted", os.path.join(work, "snap-u"), want_ids):
+        return fail("uninterrupted serve run failed")
+
+    snap_p = os.path.join(work, "snap-p")
+    if serve("preempted", snap_p, "",
+             faults="serve.step:preempt:after=4:count=1"):
+        return fail("preempted serve run did not exit cleanly")
+    with open(os.path.join(work, "preempted.jsonl")) as f:
+        pre = [json.loads(ln) for ln in f if ln.strip()][-1]
+    if pre.get("metrics", {}).get("preempted") != 1.0:
+        return fail(f"no preemption Record banked: {pre}")
+    if not os.path.isdir(snap_p):
+        return fail("preempted run left no snapshot dir")
+
+    got_ids = os.path.join(work, "got.json")
+    if serve("resumed", snap_p, got_ids, resume=True):
+        return fail("serve --resume failed")
+    with open(os.path.join(work, "resumed.jsonl")) as f:
+        res = [json.loads(ln) for ln in f if ln.strip()][-1]
+    m = res.get("metrics", {})
+    print(f"  [resumed] verdict={res.get('verdict')} exact={m.get('exact')} "
+          f"resumed_from={m.get('resumed_from')} "
+          f"quarantined={m.get('quarantined')}", flush=True)
+    if res.get("verdict") != "SUCCESS" or m.get("exact") != 1.0:
+        return fail(
+            f"resume verdict {res.get('verdict')} exact {m.get('exact')} "
+            f"— notes: {res.get('notes')}"
+        )
+    if not m.get("resumed_from", -1.0) >= 0:
+        return fail("resume Record does not point at a snapshot step")
+    with open(want_ids) as f:
+        want = json.load(f)
+    with open(got_ids) as f:
+        got = json.load(f)
+    if want != got:
+        return fail(
+            "resumed ids diverged from the uninterrupted run "
+            f"(want {want}, got {got})"
+        )
+    print("chaos smoke: all gates passed "
+          "(cell retry, worker fallback, preempt/resume exactness)",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

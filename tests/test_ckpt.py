@@ -235,7 +235,11 @@ class TestAsyncSaver:
         self, mesh2d, tmp_path, monkeypatch
     ):
         # an IO failure inside the worker thread must surface on wait(),
-        # not vanish (chmod-denial doesn't work under root, so inject)
+        # not vanish (chmod-denial doesn't work under root, so inject).
+        # The same signature on every attempt classifies as deterministic
+        # under the ckpt RetryPolicy, so the surfaced error is Quarantined
+        # (chained from the OSError, message preserved).
+        from tpu_patterns import faults
         from tpu_patterns.ckpt import checkpoint as ckpt_mod
 
         def boom(*a, **k):
@@ -245,7 +249,7 @@ class TestAsyncSaver:
         tree = _tree(mesh2d)
         saver = ckpt.AsyncSaver()
         saver.save(str(tmp_path), 1, tree)
-        with pytest.raises(OSError, match="injected"):
+        with pytest.raises((OSError, faults.Quarantined), match="injected"):
             saver.wait()
         # the saver is reusable after a failed save
         monkeypatch.undo()

@@ -19,11 +19,12 @@ from tpu_patterns.ckpt.checkpoint import (
     available_steps,
     describe,
     latest_step,
+    read_extra,
     restore,
     save,
 )
 
 __all__ = [
     "AsyncSaver", "available_steps", "describe", "latest_step",
-    "restore", "save",
+    "read_extra", "restore", "save",
 ]

@@ -43,6 +43,8 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from tpu_patterns import faults
+
 FORMAT_VERSION = 1
 
 
@@ -199,34 +201,83 @@ def _snapshot(tree, proc: int, copy: bool = False):
     return shard_table, arrays, manifest_leaves
 
 
+_RESERVED_PREFIXES = ("manifest", "proc", "shards_proc")
+
+
+def _check_extras(extras) -> dict[str, bytes]:
+    out: dict[str, bytes] = {}
+    for name, data in (extras or {}).items():
+        if (
+            os.path.basename(name) != name
+            or name.startswith(_RESERVED_PREFIXES)
+        ):
+            raise ValueError(
+                f"extra {name!r}: must be a bare filename not starting "
+                f"with {_RESERVED_PREFIXES}"
+            )
+        out[name] = data.encode() if isinstance(data, str) else bytes(data)
+    return out
+
+
 def save(
     root: str,
     step: int,
     tree,
     *,
     keep: int | None = None,
+    extras=None,
 ) -> str:
     """Write one atomic checkpoint of ``tree`` at ``step``.
 
     Every leaf must be a ``jax.Array`` (committed data only — host
     scalars belong in the caller's own metadata, passed through
     ``manifest.json`` is deliberately NOT extensible to keep the format
-    auditable).  Returns the committed directory.  ``keep=k`` prunes all
-    but the newest k committed steps after a successful commit.
+    auditable).  ``extras`` maps bare filenames to str/bytes payloads
+    written as SIDECAR files inside the step dir before the manifest
+    commit marker — host state (e.g. the serve engine's scheduler
+    tables) rides the same atomic rename as the array shards; read them
+    back with :func:`read_extra`.  Returns the committed directory.
+    ``keep=k`` prunes all but the newest k committed steps after a
+    successful commit.
+
+    Single-process saves retry transient I/O errors under the shared
+    ckpt :class:`~tpu_patterns.faults.RetryPolicy` (each attempt starts
+    from a fresh tmp dir; the host snapshot is reused).  Multi-process
+    saves attempt once — re-entering the barrier protocol on a partial
+    failure would deadlock the processes that passed it.
     """
     proc = jax.process_index()
-    if proc == 0:
-        _prepare_tmp(root, step)
-    _barrier(f"ckpt_mkdir_{step}")
+    nprocs = jax.process_count()
+    extras = _check_extras(extras)
+    if nprocs > 1:
+        if proc == 0:
+            _prepare_tmp(root, step)
+        _barrier(f"ckpt_mkdir_{step}")
+        snapshot = _snapshot(tree, proc)
+        return _write_and_commit(
+            root, step, proc, nprocs, snapshot, keep, _barrier,
+            extras=extras,
+        )
 
-    snapshot = _snapshot(tree, proc)
-    return _write_and_commit(
-        root, step, proc, jax.process_count(), snapshot, keep, _barrier
+    snapshot = _snapshot(tree, 0)
+
+    def attempt() -> str:
+        _prepare_tmp(root, step)
+        return _write_and_commit(
+            root, step, 0, 1, snapshot, keep, lambda tag: None,
+            extras=extras,
+        )
+
+    return faults.call_with_retry(
+        attempt,
+        policy=faults.ckpt_retry_policy(),
+        site="ckpt.save",
+        retry_on=(OSError,),
     )
 
 
 def _write_and_commit(
-    root, step, proc, process_count, snapshot, keep, barrier
+    root, step, proc, process_count, snapshot, keep, barrier, extras=None
 ) -> str:
     """The file-writing + atomic-commit half of :func:`save`, operating
     purely on a host snapshot — callable from a background thread (the
@@ -243,8 +294,22 @@ def _write_and_commit(
         f.flush()
         os.fsync(f.fileno())
 
+    # fault site: MID-save — shards on disk, manifest (the commit
+    # marker) not yet written.  A crash/kill here leaves exactly the
+    # torn ``.tmp.step_N`` the restore-ignores / next-save-sweeps
+    # contract exists for; an ``error`` here is a transient I/O failure
+    # the save retry policy absorbs.
+    faults.inject("ckpt.save", step=step, proc=proc)
     barrier(f"ckpt_written_{step}")
     if proc == 0:
+        # extras land BEFORE the manifest: a crash between them leaves a
+        # tmp dir with sidecars but no commit marker — still torn, still
+        # ignored by restore, still swept by the next save
+        for name, data in (extras or {}).items():
+            with open(os.path.join(tmp, name), "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
         manifest = {
             "format": FORMAT_VERSION,
             "step": step,
@@ -320,14 +385,22 @@ class AsyncSaver:
         if jax.process_count() > 1:
             save(root, step, tree, keep=keep)
             return
-        _prepare_tmp(root, step)
         snapshot = _snapshot(tree, 0, copy=True)
         result: dict = {}
 
         def work():
-            try:
+            def attempt():
+                _prepare_tmp(root, step)  # each attempt starts clean
                 _write_and_commit(
                     root, step, 0, 1, snapshot, keep, lambda tag: None
+                )
+
+            try:
+                faults.call_with_retry(
+                    attempt,
+                    policy=faults.ckpt_retry_policy(),
+                    site="ckpt.save",
+                    retry_on=(OSError,),
                 )
             except BaseException as e:  # surfaced by the next wait()
                 result["error"] = e
@@ -404,50 +477,86 @@ def restore(root: str, like, *, step: int | None = None):
     saved entries by tree keypath, and every template leaf must be
     present in the checkpoint (a schema mismatch is an error, not a
     silent partial restore).
+
+    Reads are idempotent, so transient I/O errors retry under the shared
+    ckpt :class:`~tpu_patterns.faults.RetryPolicy`.  A missing
+    checkpoint (no committed step at all, or an explicit ``step`` that
+    was never committed) raises FileNotFoundError immediately — absence
+    is a state, not a transient fault, and must not burn the retry
+    budget or surface as Quarantined.
     """
     if step is None:
         step = latest_step(root)
         if step is None:
             raise FileNotFoundError(f"no committed checkpoint under {root}")
     step_path = _step_dir(root, step)
-    with open(os.path.join(step_path, "manifest.json")) as f:
-        manifest = json.load(f)
-    by_key = {info["key"]: info for info in manifest["leaves"]}
+    if not os.path.isfile(os.path.join(step_path, "manifest.json")):
+        raise FileNotFoundError(
+            f"no committed checkpoint at step {step} under {root}"
+        )
 
-    paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(like)
-    reader = _ShardReader(step_path, manifest["process_count"])
-    try:
-        out_leaves = []
-        for path, leaf in paths_and_leaves:
-            key = _keystr(path)
-            info = by_key.get(key)
-            if info is None:
-                raise KeyError(
-                    f"template leaf {key} not in checkpoint step {step} "
-                    f"(has: {sorted(by_key)[:8]}...)"
+    def attempt():
+        faults.inject("ckpt.restore", step=step)
+        with open(os.path.join(step_path, "manifest.json")) as f:
+            manifest = json.load(f)
+        by_key = {info["key"]: info for info in manifest["leaves"]}
+
+        paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(
+            like
+        )
+        reader = _ShardReader(step_path, manifest["process_count"])
+        try:
+            out_leaves = []
+            for path, leaf in paths_and_leaves:
+                key = _keystr(path)
+                info = by_key.get(key)
+                if info is None:
+                    raise KeyError(
+                        f"template leaf {key} not in checkpoint step {step} "
+                        f"(has: {sorted(by_key)[:8]}...)"
+                    )
+                if tuple(info["shape"]) != tuple(leaf.shape):
+                    raise ValueError(
+                        f"{key}: checkpoint shape {tuple(info['shape'])} != "
+                        f"template shape {tuple(leaf.shape)}"
+                    )
+                hostval = reader.load_global(manifest, info["leaf"]).astype(
+                    _np_dtype(str(leaf.dtype)), copy=False
                 )
-            if tuple(info["shape"]) != tuple(leaf.shape):
-                raise ValueError(
-                    f"{key}: checkpoint shape {tuple(info['shape'])} != "
-                    f"template shape {tuple(leaf.shape)}"
+                sharding = getattr(leaf, "sharding", None)
+                if sharding is None:
+                    sharding = NamedSharding(  # pragma: no cover
+                        jax.sharding.Mesh(
+                            np.array(jax.devices()[:1]), ("_",)
+                        ),
+                        P(),
+                    )
+                out_leaves.append(
+                    jax.make_array_from_callback(
+                        hostval.shape, sharding, lambda idx, h=hostval: h[idx]
+                    )
                 )
-            hostval = reader.load_global(manifest, info["leaf"]).astype(
-                _np_dtype(str(leaf.dtype)), copy=False
-            )
-            sharding = getattr(leaf, "sharding", None)
-            if sharding is None:
-                sharding = NamedSharding(  # pragma: no cover - convenience
-                    jax.sharding.Mesh(np.array(jax.devices()[:1]), ("_",)),
-                    P(),
-                )
-            out_leaves.append(
-                jax.make_array_from_callback(
-                    hostval.shape, sharding, lambda idx, h=hostval: h[idx]
-                )
-            )
-    finally:
-        reader.close()
-    return jax.tree_util.tree_unflatten(treedef, out_leaves)
+        finally:
+            reader.close()
+        return jax.tree_util.tree_unflatten(treedef, out_leaves)
+
+    return faults.call_with_retry(
+        attempt,
+        policy=faults.ckpt_retry_policy(),
+        site="ckpt.restore",
+        retry_on=(OSError,),
+    )
+
+
+def read_extra(root: str, name: str, *, step: int | None = None) -> bytes:
+    """Read a sidecar file written via ``save(..., extras=...)`` from the
+    committed step (default: latest)."""
+    if step is None:
+        step = latest_step(root)
+        if step is None:
+            raise FileNotFoundError(f"no committed checkpoint under {root}")
+    with open(os.path.join(_step_dir(root, step), name), "rb") as f:
+        return f.read()
 
 
 def describe(root: str) -> dict:

@@ -1268,12 +1268,23 @@ def main(argv: list[str] | None = None) -> int:
 
     args = build_parser().parse_args(argv)
     setup_jax()  # platform override + compile cache BEFORE any backend touch
-    from tpu_patterns import obs
+    import os
+
+    from tpu_patterns import faults, obs
 
     if args.obs_dir:
         obs.configure(args.obs_dir)
     if args.cmd != "obs":  # the reader must not dump over what it reads
         obs.install_crash_handlers()
+    # fault site: a whole CLI run (= one sweep cell) crashing/hanging
+    # before dispatch — the sweep retry/quarantine policy is the
+    # recovery under test.  Cells are matchable by name: the sweep
+    # runner exports TPU_PATTERNS_CELL into each cell's env.
+    faults.inject(
+        "cell.run",
+        cmd=args.cmd,
+        cell=os.environ.get("TPU_PATTERNS_CELL", ""),
+    )
     writer = ResultWriter(jsonl_path=args.jsonl)
     handlers = {
         "p2p": _cmd_p2p,

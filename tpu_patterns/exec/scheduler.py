@@ -34,6 +34,7 @@ from typing import Callable, Mapping, Sequence
 
 from tpu_patterns.exec.classify import CellClass, classify, detect_platform
 from tpu_patterns.exec.workers import WorkerError, WorkerPool
+from tpu_patterns.faults import cell_retry_policy, run_cell_attempts
 from tpu_patterns.sweep import SweepSpec
 
 
@@ -56,6 +57,8 @@ class CellResult:
     queue_wait_s: float
     run_s: float
     runner: str  # "worker" | "subprocess"
+    attempts: int = 1  # total tries under the cell RetryPolicy
+    quarantined: bool = False  # same crash signature twice: gave up early
 
 
 def _run_on_worker(
@@ -84,7 +87,9 @@ def _run_on_worker(
         "op": "cell",
         "cell": spec.name,
         "argv": list(spec.argv),
-        "env": dict(spec.env),
+        # TPU_PATTERNS_CELL: same name tag the subprocess path exports,
+        # so the `cell.run` fault site can target cells on either path
+        "env": {**dict(spec.env), "TPU_PATTERNS_CELL": spec.name},
         "log": log_path,
         "jsonl": jsonl_path,
     }
@@ -187,13 +192,19 @@ def run_cells(
             log_dir=os.path.join(out_dir, ".workers"),
         )
 
+    # transient crash/timeout recovery: each cell gets up to
+    # policy.max_attempts tries before its failure is final (completed
+    # FAILUREs are verdicts and never retried — see run_cell_attempts)
+    retry_policy = cell_retry_policy()
+
     # Queued-cell deadlines: cell q of a width-w queue should have
     # STARTED within ceil((q+1)/w) cell budgets; past that the queue
     # itself is wedged (a hung pool thread, a dead worker spawn) and the
-    # watchdog dumps the evidence live.
+    # watchdog dumps the evidence live.  A cell budget covers every
+    # retry attempt it may take.
     watches: dict[int, object] = {}
     if cell_timeout > 0:
-        per = cell_timeout + 60
+        per = (cell_timeout + 60) * retry_policy.max_attempts
         for qpos, i in enumerate(serial_idx):
             watches[i] = watchdog.watch_queued(
                 f"sweep.queue:{specs[i].name}",
@@ -224,30 +235,44 @@ def run_cells(
         if w is not None:
             w.done()
         say(f"# sweep cell: {spec.name} [{cls.value}]")
-        runner = "subprocess"
-        with obs.span(
-            "sweep.cell",
-            deadline_s=(cell_timeout + 60) if cell_timeout > 0 else None,
-            suite=suite,
-            cell=spec.name,
-            cell_class=cls.value,
-        ):
+        runner_box = ["subprocess"]
+
+        def one_attempt(attempt: int) -> tuple[int, bool]:
             out = None
             if pool is not None and cls is CellClass.HOST_PARALLEL:
                 out = _run_on_worker(pool, spec, out_dir, cell_timeout)
                 if out is not None:
-                    runner = "worker"
+                    runner_box[0] = "worker"
             if out is None:
+                runner_box[0] = "subprocess"
                 if aborted.is_set():
                     # the schedule is being torn down (Ctrl-C, a
                     # scheduler bug): the teardown killed this cell's
                     # worker — do NOT respawn it as a cold subprocess
                     # that would outlive the abort by up to a full
                     # cell_timeout.  Not completed: --resume re-runs it.
-                    out = (1, False)
-                else:
-                    out = subprocess_runner(spec)
-            rc, completed = out
+                    return 1, False
+                out = subprocess_runner(spec)
+            return out
+
+        with obs.span(
+            "sweep.cell",
+            deadline_s=(
+                (cell_timeout + 60) * retry_policy.max_attempts
+                if cell_timeout > 0
+                else None
+            ),
+            suite=suite,
+            cell=spec.name,
+            cell_class=cls.value,
+        ):
+            rc, completed, attempts, quarantined = run_cell_attempts(
+                one_attempt,
+                policy=retry_policy,
+                cell=spec.name,
+                should_stop=aborted.is_set,
+                progress=lambda msg: say(f"# {msg}"),
+            )
         run_s = (clock_ns() - t_start) / 1e9
         obs.histogram(
             "tpu_patterns_sweep_queue_wait_s", cell_class=cls.value
@@ -267,10 +292,16 @@ def run_cells(
             completed=completed,
             queue_wait_s=queue_wait_s,
             run_s=run_s,
-            runner=runner,
+            runner=runner_box[0],
+            attempts=attempts,
+            quarantined=quarantined,
         )
         results[i] = res
-        say(f"# -> {spec.name} exit {rc}")
+        say(
+            f"# -> {spec.name} exit {rc}"
+            + (f" (attempts={attempts})" if attempts > 1 else "")
+            + (" QUARANTINED" if quarantined else "")
+        )
         if on_result is not None:
             on_result(res)
 
@@ -335,6 +366,10 @@ def run_cells(
             sum(waits) / len(waits) if waits else 0.0, 3
         ),
         "queue_wait_max_s": round(max(waits, default=0.0), 3),
+        # the self-healing trail: how many extra attempts the schedule
+        # absorbed, and how many cells were quarantined as deterministic
+        "cell_retries": float(sum(r.attempts - 1 for r in done)),
+        "cells_quarantined": float(sum(r.quarantined for r in done)),
     }
     if pool is not None:
         metrics.update(

@@ -101,6 +101,12 @@ def serve(proto_in: IO[str], proto_out: IO[str]) -> int:
     EOF or a shutdown op.  Protocol errors terminate the worker (the
     parent treats a dead worker as a miss and falls back to the
     subprocess path)."""
+    # fault site: a worker that dies (SIGKILL) or wedges (hang) BEFORE
+    # the ready handshake — the parent's wait_ready deadline + circuit
+    # breaker are the recovery under test
+    from tpu_patterns import faults
+
+    faults.inject("worker.ready", pid=os.getpid())
     try:
         from tpu_patterns.runtime import warm_backend
 

@@ -12,6 +12,17 @@ at most ``TPU_PATTERNS_WORKER_RECYCLE`` cells (default 25) and is
 killed on the first nonzero rc — a failing cell may have poisoned
 process state (leaked device buffers, a wedged compile client), and
 the cell after it must not inherit that.
+
+Circuit breaker (closed -> open -> half-open): two consecutive
+spawn/ready failures OPEN the breaker — later ``lease()`` calls return
+None instantly instead of paying READY_TIMEOUT_S per cell.  After
+``TPU_PATTERNS_BREAKER_COOLDOWN_S`` (default 30) the breaker goes
+HALF-OPEN: exactly one lease is allowed to probe a fresh spawn; success
+closes the breaker (warm workers resume for the rest of the schedule),
+failure re-opens it for another cool-down.  One bad minute no longer
+disables warm workers for the whole night.  Every spawn failure and
+every warm-path fallback is counted in the obs metrics registry
+(``tpu_patterns_exec_spawn_failures_total`` / ``..._fallbacks_total``).
 """
 
 from __future__ import annotations
@@ -31,6 +42,10 @@ DEFAULT_RECYCLE_AFTER = int(
 # backend init on a remote-compiled runtime can take tens of seconds;
 # double the sweep preflight budget, not the cell budget
 READY_TIMEOUT_S = float(os.environ.get("TPU_PATTERNS_WORKER_READY_S", "180"))
+# open-breaker cool-down before a half-open probe spawn is allowed
+BREAKER_COOLDOWN_S = float(
+    os.environ.get("TPU_PATTERNS_BREAKER_COOLDOWN_S", "30")
+)
 
 
 class WorkerError(RuntimeError):
@@ -107,6 +122,13 @@ class WarmWorker:
             raise WorkerError(f"worker pipe closed: {e}") from e
         line = self._read_line(timeout)
         if line is None:
+            # deadline: SIGKILL the worker's whole process GROUP (the
+            # in-process cell and anything it spawned share it), so a
+            # hung cell cannot outlive the timeout or wedge pool
+            # teardown behind a half-dead worker
+            from tpu_patterns import obs
+
+            obs.counter("tpu_patterns_exec_worker_timeouts_total").inc()
             self.kill()
             return {"timed_out": True}
         if not line:
@@ -165,25 +187,32 @@ class WorkerPool:
         base_env: Mapping[str, str],
         log_dir: str | None = None,
         recycle_after: int = DEFAULT_RECYCLE_AFTER,
+        breaker_cooldown_s: float = BREAKER_COOLDOWN_S,
     ):
         self.size = max(1, int(size))
         self.base_env = dict(base_env)
         self.log_dir = log_dir
         self.recycle_after = recycle_after
+        self.breaker_cooldown_s = breaker_cooldown_s
         self._lock = threading.Lock()
         self._free: list[WarmWorker] = []
+        self._leased: set[WarmWorker] = set()
         self._spawned = 0
         self.hits = 0
         self.misses = 0
         self.recycled = 0
-        # circuit breaker: after this many consecutive spawn/ready
-        # failures the warm path is declared dead and every later
-        # lease() returns None instantly — without it, a wedged worker
-        # init costs READY_TIMEOUT_S per CELL, making --jobs strictly
-        # slower than --no-warm-workers on exactly the broken-backend
-        # hosts the engine's history is about
+        # circuit breaker: after two consecutive spawn/ready failures
+        # the warm path is declared dead and every later lease()
+        # returns None instantly — without it, a wedged worker init
+        # costs READY_TIMEOUT_S per CELL, making --jobs strictly slower
+        # than --no-warm-workers on exactly the broken-backend hosts
+        # the engine's history is about.  After breaker_cooldown_s one
+        # lease probes a fresh spawn (half-open): success re-arms the
+        # warm path, failure re-opens the breaker.
         self._spawn_failures = 0
         self._dead = False
+        self._opened_ns = 0
+        self._probing = False
 
     def _spawn(self) -> WarmWorker | None:
         with self._lock:
@@ -206,34 +235,78 @@ class WorkerPool:
 
     def lease(self) -> WarmWorker | None:
         """A ready worker, or None when warm execution is unavailable
-        (spawn/init failed, or the warm path was declared dead) — the
-        caller then runs the subprocess path."""
+        (spawn/init failed, or the breaker is open) — the caller then
+        runs the subprocess path."""
+        from tpu_patterns import obs
+        from tpu_patterns.core.timing import clock_ns
+
+        probe = False
         with self._lock:
             while self._free:
                 w = self._free.pop()
                 if w.alive():
                     self.hits += 1
+                    self._leased.add(w)
                     return w
                 w.kill()
             if self._dead:
-                self.misses += 1
-                return None
-        w = self._spawn()
+                cooled = (
+                    clock_ns() - self._opened_ns
+                ) / 1e9 >= self.breaker_cooldown_s
+                if not cooled or self._probing:
+                    self.misses += 1
+                    obs.counter(
+                        "tpu_patterns_exec_fallbacks_total",
+                        reason="breaker_open",
+                    ).inc()
+                    return None
+                # half-open: exactly ONE lease probes a fresh spawn;
+                # the rest keep falling back until the probe verdict
+                self._probing = probe = True
+        try:
+            w = self._spawn()
+        except BaseException:
+            # an exception escaping _spawn (ENOSPC on the log dir, a
+            # kill/wait error) must not leave _probing latched True —
+            # that would disable half-open recovery for good
+            if probe:
+                with self._lock:
+                    self._probing = False
+                    self._opened_ns = clock_ns()
+            raise
         if w is None:
             with self._lock:
                 self.misses += 1
                 self._spawn_failures += 1
-                if self._spawn_failures >= 2:  # one retry absorbs a blip
+                if probe:
+                    # failed probe: re-open for another cool-down
+                    self._probing = False
+                    self._opened_ns = clock_ns()
+                elif self._spawn_failures >= 2:  # one retry absorbs a blip
                     self._dead = True
+                    self._opened_ns = clock_ns()
+            obs.counter("tpu_patterns_exec_spawn_failures_total").inc()
+            obs.counter(
+                "tpu_patterns_exec_fallbacks_total", reason="spawn_failed"
+            ).inc()
+            obs.gauge("tpu_patterns_exec_breaker_open").set(
+                1.0 if self._dead else 0.0
+            )
             return None
         with self._lock:
             self._spawn_failures = 0
+            self._dead = False
+            self._probing = False
             # a fresh worker's first cell still skipped nothing: count
             # the cold init it paid (concurrently, but paid)
             self.misses += 1
+            self._leased.add(w)
+        obs.gauge("tpu_patterns_exec_breaker_open").set(0.0)
         return w
 
     def release(self, worker: WarmWorker, reusable: bool) -> None:
+        with self._lock:
+            self._leased.discard(worker)
         if not reusable or worker.expired or not worker.alive():
             self.recycled += 1
             worker.kill()
@@ -250,6 +323,12 @@ class WorkerPool:
     def shutdown(self) -> None:
         with self._lock:
             workers, self._free = self._free, []
+            leased, self._leased = set(self._leased), set()
+        # leased workers still out at teardown are wedged or mid-abort:
+        # group-SIGKILL (no polite drain) so their cells — and anything
+        # those cells spawned — cannot hang pool teardown behind them
+        for w in leased:
+            w.kill()
         for w in workers:
             w.shutdown()
 

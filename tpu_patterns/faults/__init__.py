@@ -1,0 +1,52 @@
+"""faults/ — deterministic fault injection + shared recovery policies.
+
+The runtime treats preemption, transient faults, and numerical blowups
+as EXPECTED inputs, the way PR 3 made concurrency one:
+
+  injector.py  named fault sites (``inject("worker.ready")``) fired by
+               a seeded ``TPU_PATTERNS_FAULTS`` spec — every recovery
+               path is reachable in CI on a CPU mesh, and every firing
+               is logged as an obs WARNING Record + counter
+  retry.py     the shared RetryPolicy (bounded attempts, exponential
+               backoff + jitter, same-signature-twice -> quarantine)
+               applied to sweep cells, worker spawn, and ckpt I/O
+
+Fault sites (each has a test that fires it — see tests/test_faults.py
+and docs/robustness.md):
+
+  worker.ready   exec/worker.py, before the ready handshake
+  cell.run       cli.py main(), before dispatch (ctx: cell, cmd)
+  ckpt.save      ckpt/checkpoint.py, mid-save (after shards, before
+                 the manifest commit marker)
+  ckpt.restore   ckpt/checkpoint.py, before shard reads
+  train.step     models/train_loop.py, per step (``nan`` poisons loss)
+  serve.step     serve/engine.py, before each decode step's compiled
+                 call (``preempt`` raises SIGTERM; the engine finishes
+                 the step, snapshots, and exits clean; ``error`` retries
+                 under the serve policy, quarantining rows on
+                 exhaustion)
+  serve.prefill  serve/engine.py, before each prefill's compiled call
+                 (``error`` retries; exhaustion quarantines exactly the
+                 admitted rows with a per-request verdict)
+"""
+
+from tpu_patterns.faults.injector import (  # noqa: F401
+    ENV_SPEC,
+    ENV_STATE,
+    KNOWN_SITES,
+    FaultSpec,
+    InjectedFault,
+    active,
+    configure,
+    inject,
+    parse_spec,
+)
+from tpu_patterns.faults.retry import (  # noqa: F401
+    Quarantined,
+    RetryPolicy,
+    call_with_retry,
+    cell_retry_policy,
+    ckpt_retry_policy,
+    run_cell_attempts,
+    serve_retry_policy,
+)

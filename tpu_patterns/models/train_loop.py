@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpu_patterns import ckpt, obs
+from tpu_patterns import ckpt, faults, obs
 from tpu_patterns.core.timing import clock_ns
 from tpu_patterns.models.transformer import (
     ModelConfig,
@@ -80,6 +80,20 @@ class TrainLoopConfig:
     # final summary Record) — loss curve + throughput in the same JSONL
     # stream every pattern writes (core/results.py)
     log_every: int = 0
+    # non-finite guard: on-device isfinite reduction over the loss and
+    # the updated state; on NaN/Inf, "halt" stops the loop with a
+    # WARNING Record (final verdict FAILURE), "skip-step" reverts the
+    # poisoned update and continues (the batch is consumed — the stream
+    # stays a pure function of the step index), "off" disables the
+    # check.  skip-step keeps the pre-step state live, so it builds the
+    # step WITHOUT donation (documented HBM cost of skippability).
+    nonfinite: str = "halt"  # halt | skip-step | off
+    # reading the check's verdict is a host sync point (it breaks async
+    # dispatch overlap), so halt thins it: 0 = auto (every step under
+    # skip-step — reverting needs the PREVIOUS state provably clean —
+    # every 10th under halt; a checkpoint step always forces a check,
+    # so a poisoned tree still can never be committed)
+    nonfinite_every: int = 0
 
 
 def _model_cfg(cfg: TrainLoopConfig) -> ModelConfig:
@@ -142,6 +156,56 @@ def _make_batch_source(cfg: TrainLoopConfig, mesh: Mesh, start: int):
         return jax.device_put(x, NamedSharding(mesh, P("dp", "sp", None)))
 
     return get_batch, loader.close
+
+
+@jax.jit
+def _finite_flag(loss, leaves):
+    return jnp.all(
+        jnp.stack(
+            [jnp.isfinite(loss)]
+            + [jnp.all(jnp.isfinite(leaf)) for leaf in leaves]
+        )
+    )
+
+
+def _all_finite(loss, state) -> bool:
+    """ONE fused finiteness check — a single jitted reduction over the
+    loss and every inexact state leaf (a non-finite grad poisons the
+    updated params, so checking the update catches grad blowups the
+    loss alone would miss); only the final bool crosses to host.  The
+    host read is a sync point — the documented cost of acting on the
+    verdict before the next step runs (thin it with nonfinite_every)."""
+    leaves = [
+        leaf
+        for leaf in jax.tree.leaves(state)
+        if jnp.issubdtype(leaf.dtype, jnp.inexact)
+    ]
+    return bool(np.asarray(_finite_flag(loss, leaves)))
+
+
+def _emit_nonfinite_warning(
+    writer, cfg: TrainLoopConfig, step: int, policy: str
+) -> None:
+    from tpu_patterns.core.results import Record, Verdict
+
+    obs.counter(
+        "tpu_patterns_train_nonfinite_total", optimizer=cfg.optimizer
+    ).inc()
+    obs.event("train.nonfinite", step=str(step), policy=policy)
+    if writer is not None:
+        writer.record(
+            Record(
+                pattern="train",
+                mode="nonfinite",
+                commands=f"step={step}",
+                metrics={"step": float(step)},
+                verdict=Verdict.WARNING,
+                notes=[
+                    f"non-finite loss/state at step {step}; "
+                    f"policy={policy}"
+                ],
+            )
+        )
 
 
 def _emit_step_record(
@@ -217,6 +281,19 @@ def train(mesh: Mesh, cfg: TrainLoopConfig, writer=None) -> dict:
             init_params(jax.random.key(cfg.seed), mcfg, n_exp), mesh, mcfg
         )
 
+    if cfg.nonfinite not in ("halt", "skip-step", "off"):
+        raise ValueError(
+            f"unknown nonfinite policy {cfg.nonfinite!r}; "
+            "want halt|skip-step|off"
+        )
+    if cfg.nonfinite == "skip-step" and cfg.nonfinite_every not in (0, 1):
+        # a thinned check can only see poison k-1 steps late, when the
+        # pre-step state it would revert to is itself already poisoned —
+        # the revert would loop forever while reporting SUCCESS
+        raise ValueError(
+            "nonfinite=skip-step requires nonfinite_every=1 (reverting "
+            "needs the previous step's state to be provably clean)"
+        )
     # mean objective (normalize by output element count): lr scales stay
     # independent of batch/seq, unlike the bench's unnormalized sum
     n_global = float(cfg.batch * cfg.seq * cfg.embed)
@@ -227,10 +304,14 @@ def train(mesh: Mesh, cfg: TrainLoopConfig, writer=None) -> dict:
     # state does so BEFORE the next step donates it: ckpt.save reads
     # synchronously, AsyncSaver snapshots to host inside save() (its
     # documented contract — "the device arrays are free to be mutated
-    # immediately"), and loss is a fresh output.
+    # immediately"), and loss is a fresh output.  EXCEPT under
+    # nonfinite="skip-step": reverting a poisoned update needs the
+    # pre-step state still live, so skippability is bought by building
+    # the step WITHOUT donation (old+new state coexist in HBM).
+    donate = cfg.nonfinite != "skip-step"
     if cfg.optimizer == "sgd":
         step_fn, _ = make_train_step(
-            mesh, mcfg, lr=cfg.lr, n_global=n_global, donate=True
+            mesh, mcfg, lr=cfg.lr, n_global=n_global, donate=donate
         )
         # resuming: an abstract template suffices — restore supplies the
         # values, so the init compute + transient second copy are skipped
@@ -247,7 +328,7 @@ def train(mesh: Mesh, cfg: TrainLoopConfig, writer=None) -> dict:
         zstep, zinit, shard_specs = make_zero_train_step(
             mesh, mcfg, lr=cfg.lr,
             optimizer=cfg.optimizer.split("-", 1)[1],
-            n_global=n_global, donate=True,
+            n_global=n_global, donate=donate,
         )
         if resume_step is not None:
             sh_abs, opt_abs = jax.eval_shape(zinit, abs_params)
@@ -293,20 +374,66 @@ def train(mesh: Mesh, cfg: TrainLoopConfig, writer=None) -> dict:
     steps_total = obs.counter(
         "tpu_patterns_train_steps_total", optimizer=cfg.optimizer
     )
+    if cfg.nonfinite == "off":
+        check_every = 0
+    elif cfg.nonfinite_every > 0:
+        check_every = cfg.nonfinite_every
+    else:  # auto: skip-step must see every step; halt amortizes the sync
+        check_every = 1 if cfg.nonfinite == "skip-step" else 10
+    halted_at = None
     try:
         for t in range(start, cfg.steps):
             with obs.span("train.step", step=t, optimizer=cfg.optimizer):
                 x = get_batch(t)
-                new_state, loss = one(
-                    {k: v for k, v in tree.items() if k != "step"}, x
-                )
+                prev_state = {
+                    k: v for k, v in tree.items() if k != "step"
+                }
+                new_state, step_loss = one(prev_state, x)
+                # fault site: ``nan`` poisons this step's loss, the
+                # same shape as a real numerical blowup — the guard
+                # below is the recovery under test
+                fault = faults.inject("train.step", step=t)
+                if fault is not None and fault.action == "nan":
+                    step_loss = step_loss * jnp.nan
                 tree = dict(new_state, step=jnp.asarray(t + 1, jnp.int32))
-            steps_total.inc()
-            if (
+            will_ckpt = (
                 cfg.ckpt_dir
                 and cfg.ckpt_every > 0
                 and (t + 1) % cfg.ckpt_every == 0
+            )
+            # a thinned check (nonfinite_every > 1) is still forced at
+            # every checkpoint step: NaN propagates through subsequent
+            # updates, so checking the tree that is ABOUT to be saved
+            # keeps the "never checkpoint a poisoned tree" promise
+            if (
+                check_every
+                and ((t + 1) % check_every == 0 or will_ckpt)
+                and not _all_finite(step_loss, new_state)
             ):
+                _emit_nonfinite_warning(writer, cfg, t, cfg.nonfinite)
+                if cfg.nonfinite == "halt":
+                    # stop BEFORE the poisoned tree can be checkpointed;
+                    # the final Record carries the non-finite loss and a
+                    # FAILURE verdict
+                    loss = step_loss
+                    halted_at = t
+                    break
+                # skip-step: revert the poisoned update (pre-step state
+                # is live — the step was built without donation).  The
+                # batch is consumed and the step leaf still advances, so
+                # the data stream stays a pure function of t; `loss`
+                # keeps its last finite value.
+                obs.counter(
+                    "tpu_patterns_train_steps_skipped_total",
+                    optimizer=cfg.optimizer,
+                ).inc()
+                tree = dict(
+                    prev_state, step=jnp.asarray(t + 1, jnp.int32)
+                )
+            else:
+                loss = step_loss
+            steps_total.inc()
+            if will_ckpt:
                 with obs.span(
                     "train.checkpoint", step=t + 1,
                     mode="async" if saver is not None else "sync",
@@ -335,7 +462,7 @@ def train(mesh: Mesh, cfg: TrainLoopConfig, writer=None) -> dict:
                 # zero post-compile steps (log_every=1 at the first step)
                 # emits no rate record rather than a bogus one.
                 steps_in_window = t + 1 - window_start
-                if steps_in_window > 0:
+                if steps_in_window > 0 and loss is not None:
                     step_loss = float(np.asarray(loss))
                     now = clock_ns()
                     _emit_step_record(
@@ -389,6 +516,12 @@ def train(mesh: Mesh, cfg: TrainLoopConfig, writer=None) -> dict:
         else:
             metrics["final_loss"] = out["loss"]
             finite = bool(np.isfinite(out["loss"]))
+        if halted_at is not None:
+            notes.append(
+                f"halted at step {halted_at}: non-finite loss/state "
+                "(nonfinite=halt; pass --nonfinite skip-step to revert "
+                "and continue)"
+            )
         writer.record(
             Record(
                 pattern="train",

@@ -25,11 +25,17 @@ occupancy, per-step latency).
 from __future__ import annotations
 
 import dataclasses
+import json
+import signal
+import threading
 
 import numpy as np
 
+from tpu_patterns import ckpt, faults
 from tpu_patterns.core.timing import clock_ns
 from tpu_patterns.serve.paged import TRASH_BLOCK, make_paged_lm_decoder
+
+SNAPSHOT_FORMAT = 1
 
 
 def _bucket(n: int, cap: int) -> int:
@@ -66,7 +72,8 @@ class ServeEngine:
     """
 
     def __init__(self, decoder, params, *, slots: int,
-                 watchdog_s: float = 0.0):
+                 watchdog_s: float = 0.0, snapshot_dir: str | None = None,
+                 retry_policy=None, fingerprint=None):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         self.decoder = decoder
@@ -81,10 +88,23 @@ class ServeEngine:
         self.queue: list[tuple[Request, int]] = []  # (request, t_submit)
         self.active: list[_Slot] = []
         self.done: dict[int, list[int]] = {}
+        # per-request verdicts for rows the recovery policy gave up on:
+        # {rid: reason} — quarantined, never silently dropped
+        self.failed: dict[int, str] = {}
         self.stats = {
             "steps": 0, "prefills": 0, "deferrals": 0, "tokens": 0,
             "max_occupancy": 0.0, "queue_wait_ns": [],
         }
+        # preemption safety: SIGTERM/SIGINT (or an injected ``preempt``)
+        # sets the event; the loop finishes the current decode step,
+        # snapshots everything the scheduler owns into snapshot_dir
+        # through the ckpt atomic-commit machinery, and returns
+        self.snapshot_dir = snapshot_dir
+        self.retry_policy = retry_policy or faults.serve_retry_policy()
+        self.fingerprint = dict(fingerprint or {})
+        self.preempted_at: int | None = None
+        self._preempt = threading.Event()
+        self._preempt_signum: int | None = None
 
     # -- bookkeeping -----------------------------------------------------
 
@@ -188,6 +208,9 @@ class ServeEngine:
             active[i] = True
         tables = self._tables_array(slots, rows)
         fn = self.decoder.prefill_jit(rows, lpad)
+        # fault site: before the compiled call — no engine state has
+        # been mutated yet, so an ``error`` here is safely retryable
+        faults.inject("serve.prefill", rows=len(reqs))
         t0 = clock_ns()
         with obs.span(
             "serve.prefill",
@@ -223,6 +246,11 @@ class ServeEngine:
             )
         tables = self._tables_array(self.active, rows)
         fn = self.decoder.step_jit(rows)
+        # fault site: before the compiled call (state untouched, so
+        # ``error`` retries cleanly); ``preempt`` raises SIGTERM — the
+        # handler sets the flag, THIS step still completes, and the loop
+        # snapshots at the iteration boundary
+        faults.inject("serve.step", step=self.stats["steps"])
         t0 = clock_ns()
         with obs.span(
             "serve.step",
@@ -244,31 +272,228 @@ class ServeEngine:
         obs.counter("tpu_patterns_serve_tokens_total").inc(len(self.active))
         self.stats["steps"] += 1
 
+    # -- recovery + preemption -------------------------------------------
+
+    def _quarantine(self, slots: list[_Slot], reason: str) -> None:
+        """Give up on ``slots``: free their blocks, record a per-request
+        verdict, keep serving everyone else — one poisoned row (or one
+        deterministic compiled-call failure) must not sink the batch."""
+        from tpu_patterns import obs
+
+        for s in slots:
+            self.free.extend(b for b in s.table if b != TRASH_BLOCK)
+            self.failed[s.rid] = reason
+            obs.counter("tpu_patterns_serve_quarantined_total").inc()
+            obs.event("serve.quarantine", rid=str(s.rid), reason=reason)
+
+    def _on_preempt_signal(self, signum, frame) -> None:
+        # async-signal-safe ONLY: the handler interrupts the main thread,
+        # which may be holding the (non-reentrant) obs registry lock —
+        # any counter/event/log here could deadlock the very loop that
+        # must now snapshot.  Event.set is safe; the loop does the
+        # counting at its iteration boundary.
+        self._preempt_signum = signum
+        self._preempt.set()
+
+    def _install_preempt_handlers(self):
+        """Arm SIGTERM/SIGINT -> graceful-snapshot while the loop runs;
+        returns a restore callback.  Off the main thread (or with no
+        snapshot_dir) this is a no-op — signals then keep their process
+        defaults."""
+        if not self.snapshot_dir:
+            return lambda: None
+        try:
+            prev = {
+                s: signal.signal(s, self._on_preempt_signal)
+                for s in (signal.SIGTERM, signal.SIGINT)
+            }
+        except ValueError:  # not the main thread
+            return lambda: None
+
+        def restore():
+            for s, h in prev.items():
+                signal.signal(s, h)
+
+        return restore
+
+    def snapshot(self) -> str:
+        """Commit pool + scheduler state atomically under snapshot_dir.
+
+        The pool (device arrays) goes through ``ckpt.save``; everything
+        host-side the loop owns — queue, active slots with their block
+        tables and emitted ids, free list, done/failed maps — rides as a
+        JSON sidecar in the SAME commit, so a crash mid-snapshot leaves
+        either a complete resumable state or a torn tmp dir restore
+        ignores."""
+        from tpu_patterns import obs
+
+        step = self.stats["steps"]
+        state = {
+            "format": SNAPSHOT_FORMAT,
+            "fingerprint": self.fingerprint,
+            "queue": [
+                {"rid": r.rid, "tokens": r.tokens, "n_gen": r.n_gen}
+                for r, _ in self.queue
+            ],
+            "active": [
+                {
+                    "rid": s.rid, "lens": s.lens, "steps": s.steps,
+                    "n_gen": s.n_gen, "table": s.table,
+                    "last_tok": s.last_tok, "out": s.out,
+                }
+                for s in self.active
+            ],
+            "free": list(self.free),
+            "done": {str(k): v for k, v in self.done.items()},
+            "failed": {str(k): v for k, v in self.failed.items()},
+            "stats": {
+                k: v for k, v in self.stats.items() if k != "queue_wait_ns"
+            },
+        }
+        path = ckpt.save(
+            self.snapshot_dir, step, {"pool": self.pool},
+            extras={"engine.json": json.dumps(state)},
+        )
+        obs.event("serve.snapshot", step=str(step))
+        return path
+
+    def restore_snapshot(self) -> int:
+        """Load the latest committed snapshot into this (fresh) engine;
+        returns the snapshot's decode-step counter.  The engine must
+        have been built with the same decoder/pool layout — a stored
+        config fingerprint mismatch fails loudly."""
+        from tpu_patterns import obs
+
+        if not self.snapshot_dir:
+            raise ValueError("engine has no snapshot_dir to restore from")
+        step = ckpt.latest_step(self.snapshot_dir)
+        if step is None:
+            raise FileNotFoundError(
+                f"no committed serve snapshot under {self.snapshot_dir}"
+            )
+        state = json.loads(
+            ckpt.read_extra(self.snapshot_dir, "engine.json", step=step)
+        )
+        if state.get("format") != SNAPSHOT_FORMAT:
+            raise ValueError(
+                f"serve snapshot format {state.get('format')} != "
+                f"{SNAPSHOT_FORMAT}"
+            )
+        if (
+            self.fingerprint
+            and state.get("fingerprint")
+            and state["fingerprint"] != self.fingerprint
+        ):
+            diff = {
+                k
+                for k in set(self.fingerprint) | set(state["fingerprint"])
+                if self.fingerprint.get(k) != state["fingerprint"].get(k)
+            }
+            raise ValueError(
+                "serve snapshot was taken under a different config "
+                f"(mismatched: {sorted(diff)}) — resume with the flags "
+                "of the preempted run"
+            )
+        self.pool = ckpt.restore(
+            self.snapshot_dir, {"pool": self.pool}, step=step
+        )["pool"]
+        now = clock_ns()
+        self.queue = [
+            (Request(rid=q["rid"], tokens=list(q["tokens"]),
+                     n_gen=q["n_gen"]), now)
+            for q in state["queue"]
+        ]
+        self.active = [
+            _Slot(
+                rid=a["rid"], lens=a["lens"], steps=a["steps"],
+                n_gen=a["n_gen"], table=list(a["table"]),
+                last_tok=a["last_tok"], out=list(a["out"]),
+                t_submit_ns=now,
+            )
+            for a in state["active"]
+        ]
+        self.free = list(state["free"])
+        self.done = {int(k): v for k, v in state["done"].items()}
+        self.failed = {int(k): v for k, v in state["failed"].items()}
+        for k, v in state["stats"].items():
+            if k in self.stats and k != "queue_wait_ns":
+                self.stats[k] = v
+        obs.counter("tpu_patterns_serve_resumes_total").inc()
+        obs.event("serve.resume", step=str(step))
+        return step
+
     # -- the loop --------------------------------------------------------
 
     def run(self, requests: list[Request]) -> dict[int, list[int]]:
-        """Serve ``requests`` to completion; returns {rid: generated ids}."""
+        """Serve ``requests`` to completion; returns {rid: generated ids}.
+
+        An empty ``requests`` list continues whatever the queue/active
+        set already holds (the resume path after
+        :meth:`restore_snapshot`).  If a preemption signal arrives the
+        loop finishes the in-flight iteration, snapshots, sets
+        ``preempted_at``, and returns the partial results."""
         from tpu_patterns import obs
 
         for r in requests:
             self.submit(r)
-        with obs.span("serve.run", requests=len(requests)):
-            while self.queue or self.active:
-                self._retire()
-                admitted = self._admit()
-                if admitted:
-                    self._prefill(admitted)
-                    self._retire()  # n_gen == 1 rows finish at prefill
-                if self.active:
-                    self._step()
-                occ = self._occupancy()
-                self.stats["max_occupancy"] = max(
-                    self.stats["max_occupancy"], occ
-                )
-                obs.gauge("tpu_patterns_serve_pool_occupancy").set(occ)
-                obs.gauge("tpu_patterns_serve_active_rows").set(
-                    len(self.active)
-                )
+        restore_handlers = self._install_preempt_handlers()
+        try:
+            with obs.span("serve.run", requests=len(requests)):
+                while self.queue or self.active:
+                    self._retire()
+                    admitted = self._admit()
+                    if admitted:
+                        slots = [s for _, s in admitted]
+                        try:
+                            faults.call_with_retry(
+                                lambda: self._prefill(admitted),
+                                policy=self.retry_policy,
+                                site="serve.prefill",
+                            )
+                        except (OSError, faults.Quarantined) as e:
+                            self._quarantine(
+                                slots, f"prefill failed after retries: {e}"
+                            )
+                        else:
+                            self._retire()  # n_gen == 1 finish at prefill
+                    if self.active:
+                        try:
+                            faults.call_with_retry(
+                                self._step,
+                                policy=self.retry_policy,
+                                site="serve.step",
+                            )
+                        except (OSError, faults.Quarantined) as e:
+                            casualties, self.active = self.active, []
+                            self._quarantine(
+                                casualties,
+                                f"decode step failed after retries: {e}",
+                            )
+                    occ = self._occupancy()
+                    self.stats["max_occupancy"] = max(
+                        self.stats["max_occupancy"], occ
+                    )
+                    obs.gauge("tpu_patterns_serve_pool_occupancy").set(occ)
+                    obs.gauge("tpu_patterns_serve_active_rows").set(
+                        len(self.active)
+                    )
+                    if self._preempt.is_set():
+                        # deferred from the signal handler (which must
+                        # stay async-signal-safe): count + log here, on
+                        # the loop's own thread with no lock held
+                        obs.counter(
+                            "tpu_patterns_serve_preemptions_total"
+                        ).inc()
+                        obs.event(
+                            "serve.preempt",
+                            signum=str(self._preempt_signum),
+                        )
+                        self.preempted_at = self.stats["steps"]
+                        if self.snapshot_dir:
+                            self.snapshot()
+                        break
+        finally:
+            restore_handlers()
         return dict(self.done)
 
 
@@ -296,6 +521,15 @@ class ServeConfig:
     min_speedup: float = 1.0  # continuous-vs-sequential gate
     watchdog_s: float = 0.0  # per-step watchdog deadline (0 = spans only)
     seed: int = 0
+    # preemption safety: with snapshot_dir set, SIGTERM/SIGINT mid-serve
+    # finishes the current decode step, commits engine state there, and
+    # exits with a WARNING Record; --resume restores the latest snapshot
+    # and continues — completed ids gated bit-identical to an
+    # uninterrupted run (this path serves the trace ONCE, no
+    # speedup race; docs/robustness.md)
+    snapshot_dir: str = ""
+    resume: bool = False
+    ids_out: str = ""  # write {rid: generated ids} JSON on completion
 
 
 def _auto_blocks(cfg: ServeConfig) -> int:
@@ -306,6 +540,171 @@ def _auto_blocks(cfg: ServeConfig) -> int:
     dense_blocks = cfg.slots * (-(-max_len // cfg.block_len))
     need_one = -(-max_len // cfg.block_len)
     return max(3 * dense_blocks // 4, need_one + 1) + 1  # +1: trash block
+
+
+def _dense_expected(mesh, sp, mcfg, cfg, flat_params, requests):
+    """Per-request greedy ids from the dense batch-1 decoder — the
+    engine-independent ground truth both the measured path and the
+    preemption/resume path gate against."""
+    import jax.numpy as jnp
+
+    from tpu_patterns.models.lm import make_lm_decoder
+
+    lpd = cfg.max_prompt + (-cfg.max_prompt % sp)
+    gen_cap = cfg.gen + (-cfg.gen % sp)
+    dpre, dgen = make_lm_decoder(
+        mesh, mcfg, cfg.vocab, 1, lpd, gen_cap, cache_int8=cfg.cache_int8
+    )
+    want: dict[int, list[int]] = {}
+    for r in requests:
+        toks = np.zeros((1, lpd), np.int32)
+        toks[0, : len(r.tokens)] = r.tokens
+        lens = jnp.asarray([len(r.tokens)], jnp.int32)
+        caches, t0_tok = dpre(flat_params, toks, lens)
+        ids = [int(np.asarray(t0_tok)[0])]
+        if r.n_gen > 1:
+            _, gen_ids = dgen(
+                flat_params, caches, t0_tok, (lens, 0), r.n_gen - 1
+            )
+            ids += np.asarray(gen_ids)[0].tolist()
+        want[r.rid] = ids
+    return want
+
+
+def _serve_fingerprint(cfg: ServeConfig, n_blocks: int) -> dict:
+    """The config surface a snapshot must agree on to be resumable —
+    everything that shapes the pool, the trace, or the token stream."""
+    fp = dataclasses.asdict(cfg)
+    for k in ("snapshot_dir", "resume", "ids_out", "watchdog_s",
+              "min_speedup"):
+        fp.pop(k, None)
+    fp["n_blocks"] = n_blocks  # resolved, not the 0=auto sentinel
+    return fp
+
+
+def _run_preemptible(
+    mesh, sp, cfg, writer, decoder, params, flat_params, mcfg, trace,
+    n_blocks,
+) -> list:
+    """The preemption-safe serve path (``--snapshot_dir``): serve the
+    trace ONCE under armed SIGTERM/SIGINT handlers.  Preempted -> commit
+    a snapshot + WARNING Record; completed (fresh or ``--resume``) ->
+    gate every finished request's ids bit-identical to the dense
+    per-request decode, with quarantined rows reported per-request."""
+    from tpu_patterns import obs
+    from tpu_patterns.core.results import Record, Verdict
+
+    eng = ServeEngine(
+        decoder, params, slots=cfg.slots, watchdog_s=cfg.watchdog_s,
+        snapshot_dir=cfg.snapshot_dir,
+        fingerprint=_serve_fingerprint(cfg, n_blocks),
+    )
+    resumed_from = None
+    if cfg.resume:
+        resumed_from = eng.restore_snapshot()
+        writer.progress(
+            f"serve resume: snapshot at decode step {resumed_from} "
+            f"({len(eng.done)} done, {len(eng.active)} active, "
+            f"{len(eng.queue)} queued)"
+        )
+        out = eng.run([])
+    else:
+        out = eng.run(trace)
+
+    mode = (
+        ("resume" if cfg.resume else "preemptible")
+        + f"_slots{cfg.slots}_sp{sp}"
+    )
+    commands = (
+        f"req{cfg.requests} prompt{cfg.min_prompt}-{cfg.max_prompt} "
+        f"gen{cfg.gen} V{cfg.vocab} depth{cfg.depth} {cfg.dtype}"
+    )
+    if eng.preempted_at is not None:
+        rec = Record(
+            pattern="serve",
+            mode=mode,
+            commands=commands,
+            metrics={
+                "preempted": 1.0,
+                "snapshot_step": float(eng.preempted_at),
+                "done_requests": float(len(eng.done)),
+                "pending_requests": float(
+                    len(eng.queue) + len(eng.active)
+                ),
+            },
+            verdict=Verdict.WARNING,
+            notes=[
+                f"preempted at decode step {eng.preempted_at}; engine "
+                f"state committed under {cfg.snapshot_dir} — rerun with "
+                "--resume true to continue"
+            ],
+        )
+        writer.record(rec)
+        return [rec]
+
+    if cfg.ids_out:
+        with open(cfg.ids_out, "w") as f:
+            json.dump(
+                {
+                    "done": {str(k): out[k] for k in sorted(out)},
+                    "failed": {
+                        str(k): eng.failed[k] for k in sorted(eng.failed)
+                    },
+                },
+                f,
+            )
+    want_ids = _dense_expected(
+        mesh, sp, mcfg, cfg, flat_params,
+        [r for r in trace if r.rid in out],
+    )
+    mismatched = [
+        r.rid for r in trace
+        if r.rid in out and out[r.rid] != want_ids[r.rid]
+    ]
+    exact = not mismatched
+    unaccounted = [
+        r.rid for r in trace
+        if r.rid not in out and r.rid not in eng.failed
+    ]
+    obs.gauge("tpu_patterns_serve_exact").set(float(exact))
+    verdict = Verdict.SUCCESS
+    if mismatched or unaccounted:
+        verdict = Verdict.FAILURE
+    elif eng.failed:
+        verdict = Verdict.WARNING  # recovered, but not unscathed
+    rec = Record(
+        pattern="serve",
+        mode=mode,
+        commands=commands,
+        metrics={
+            "exact": float(exact),
+            "done_requests": float(len(out)),
+            "quarantined": float(len(eng.failed)),
+            "resumed_from": float(
+                resumed_from if resumed_from is not None else -1
+            ),
+            "decode_steps": float(eng.stats["steps"]),
+            "tokens": float(eng.stats["tokens"]),
+            "deferrals": float(eng.stats["deferrals"]),
+        },
+        verdict=verdict,
+    )
+    if mismatched:
+        rec.notes.append(
+            f"exactness gate FAILED for request(s) {mismatched[:8]}: "
+            "ids diverged from the dense per-request decode"
+        )
+    if unaccounted:
+        rec.notes.append(
+            f"request(s) {unaccounted[:8]} neither completed nor "
+            "quarantined — scheduler bug"
+        )
+    for rid in sorted(eng.failed)[:8]:
+        rec.notes.append(f"request {rid} QUARANTINED: {eng.failed[rid]}")
+    if len(eng.failed) > 8:
+        rec.notes.append(f"... and {len(eng.failed) - 8} more quarantined")
+    writer.record(rec)
+    return [rec]
 
 
 def run_serve(mesh, cfg: ServeConfig, writer) -> list:
@@ -371,6 +770,18 @@ def run_serve(mesh, cfg: ServeConfig, writer) -> list:
     ]
     total_tokens = sum(r.n_gen for r in trace)
 
+    if cfg.resume and not cfg.snapshot_dir:
+        raise ValueError("serve --resume requires --snapshot_dir")
+    if cfg.snapshot_dir:
+        # preemption-safe path: one pass, exactness-gated — a run that
+        # can be SIGTERMed anywhere has no meaningful speedup race
+        return _run_preemptible(
+            mesh, sp, cfg, writer, decoder, params, flat_params, mcfg,
+            trace, n_blocks,
+        )
+    if cfg.ids_out:
+        raise ValueError("serve --ids_out requires --snapshot_dir")
+
     def timed_run(slots: int):
         eng = ServeEngine(
             decoder, params, slots=slots, watchdog_s=cfg.watchdog_s
@@ -399,28 +810,14 @@ def run_serve(mesh, cfg: ServeConfig, writer) -> list:
     )
 
     # exactness: per-request dense decode, greedy, same mesh
-    lpd = cfg.max_prompt + (-cfg.max_prompt % sp)
-    gen_cap = cfg.gen + (-cfg.gen % sp)
-    dpre, dgen = make_lm_decoder(
-        mesh, mcfg, cfg.vocab, 1, lpd, gen_cap, cache_int8=cfg.cache_int8
-    )
+    want_ids = _dense_expected(mesh, sp, mcfg, cfg, flat_params, trace)
     exact = out_cont == out_seq  # batching must not change a row's ids
     for r in trace:
-        toks = np.zeros((1, lpd), np.int32)
-        toks[0, : len(r.tokens)] = r.tokens
-        lens = jnp.asarray([len(r.tokens)], jnp.int32)
-        caches, t0_tok = dpre(flat_params, toks, lens)
-        want = [int(np.asarray(t0_tok)[0])]
-        if r.n_gen > 1:
-            _, ids = dgen(
-                flat_params, caches, t0_tok, (lens, 0), r.n_gen - 1
-            )
-            want += np.asarray(ids)[0].tolist()
-        if out_cont.get(r.rid) != want:
+        if out_cont.get(r.rid) != want_ids[r.rid]:
             exact = False
             writer.progress(
                 f"serve exactness: request {r.rid} diverged from dense "
-                f"decode (got {out_cont.get(r.rid)}, want {want})"
+                f"decode (got {out_cont.get(r.rid)}, want {want_ids[r.rid]})"
             )
             break
 

@@ -1716,6 +1716,9 @@ def run_spec(
         os.unlink(jsonl_path)  # ResultWriter appends; stale cells must not leak
     env = dict(base_env if base_env is not None else os.environ)
     env.update(dict(spec.env))
+    # the cell's CLI process can be targeted by name at the `cell.run`
+    # fault site (faults/injector.py match predicates)
+    env["TPU_PATTERNS_CELL"] = spec.name
     stdout, rc, timed_out = run_command(
         [sys.executable, "-m", "tpu_patterns", "--jsonl", jsonl_path,
          *spec.argv],
@@ -2019,22 +2022,37 @@ def run_sweep(
             jsonl_path=os.path.join(out_dir, "sweep-engine.jsonl")
         ).record(engine_rec)
     else:
+        from tpu_patterns.faults import cell_retry_policy, run_cell_attempts
+
+        retry_policy = cell_retry_policy()
         for spec in pending:
             print(f"# sweep cell: {spec.name}", flush=True)
             from tpu_patterns import obs
 
             # the subprocess has its own deadline; the span deadline is a
-            # backstop 60s past it, so a cell whose *timeout machinery*
-            # wedges (a SIGKILL the child shrugs off in native code) is
-            # still diagnosed live by the watchdog
+            # backstop 60s past it (per attempt), so a cell whose
+            # *timeout machinery* wedges (a SIGKILL the child shrugs off
+            # in native code) is still diagnosed live by the watchdog
             with obs.span(
                 "sweep.cell",
-                deadline_s=(cell_timeout + 60) if cell_timeout > 0 else None,
+                deadline_s=(
+                    (cell_timeout + 60) * retry_policy.max_attempts
+                    if cell_timeout > 0
+                    else None
+                ),
                 suite=suite,
                 cell=spec.name,
             ):
-                cell_rc, completed = run_spec(
-                    spec, out_dir, base_env=base_env, timeout=cell_timeout
+                cell_rc, completed, attempts, quarantined = (
+                    run_cell_attempts(
+                        lambda attempt: run_spec(
+                            spec, out_dir, base_env=base_env,
+                            timeout=cell_timeout,
+                        ),
+                        policy=retry_policy,
+                        cell=spec.name,
+                        progress=lambda m: print(f"# {m}", flush=True),
+                    )
                 )
             obs.counter(
                 "tpu_patterns_sweep_cells_total",
@@ -2044,9 +2062,24 @@ def run_sweep(
             _record_cell(
                 out_dir, suite, spec.name, cell_rc, sigs[spec.name], completed
             )
-            print(f"# -> exit {cell_rc}", flush=True)
+            print(
+                f"# -> exit {cell_rc}"
+                + (f" (attempts={attempts})" if attempts > 1 else "")
+                + (" QUARANTINED" if quarantined else ""),
+                flush=True,
+            )
             if cell_rc != 0:  # incl. negative (signal-killed) returncodes
                 rc = 1
+    # Bank the schedule's own vitals beside its cells: the retry /
+    # quarantine / spawn-failure counters live in THIS (parent) process's
+    # registry — cells are subprocesses — so a chaos run's self-healing
+    # trail would otherwise be invisible after exit.
+    from tpu_patterns import obs
+
+    try:
+        obs.dump_metrics(os.path.join(out_dir, "sweep-metrics.jsonl"))
+    except OSError:
+        pass  # a full disk must not turn a finished sweep into a crash
     # Parse per cell: a cell's export-context lines must not leak into the
     # next cell's marker-only records.
     records = []
