@@ -1,53 +1,39 @@
 #!/usr/bin/env python
 """Lint: all timing in ``tpu_patterns/`` goes through ``core/timing.py``.
 
-The suite's whole metrology rests on one clock discipline — monotonic
-``clock_ns()`` (native FFI when built, ``perf_counter_ns`` otherwise)
-for durations, ``wall_time_s()`` for provenance timestamps.  A stray
-``time.time()`` in a runner silently reintroduces wall-clock jumps into
-a duration (NTP steps, suspend/resume) and bypasses the native clock;
-a stray ``time.perf_counter()`` forks the epoch from every span and
-TimingResult around it.  This lint forbids both outside core/timing.py.
+Thin shim over graftlint's ``clock-discipline`` rule
+(tpu_patterns/analysis/) so existing CI invocations keep working: same
+contract as always — exit 0 = clean, 1 = violations printed as
+``path:line: text``.  (Importing the package pulls in jax — the repo's
+baseline dependency everywhere — but the rule itself never inits a
+backend or compiles anything.)  The rule logic,
+file discovery (shared walker: __pycache__, build/, fixtures, generated
+files all excluded in ONE place), and suppression syntax now live in
+the framework; this script is strict mode (no ratchet baseline — a
+clock violation is never acceptable debt).
 
-Zero dependencies; exit 0 = clean, 1 = violations (printed as
-``path:line: text``).  Run directly or via CI (.github/workflows/ci.yml).
+Run directly, via CI (.github/workflows/ci.yml), or as the full
+catalog: ``tpu-patterns lint`` (docs/static-analysis.md).
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGE = os.path.join(ROOT, "tpu_patterns")
-
-# attribute access, with or without the call parens: catches
-# ``t = time.time()`` and ``default_factory=time.time`` alike
-_FORBIDDEN = re.compile(r"\btime\s*\.\s*(time|perf_counter(_ns)?)\b")
-
-# the clock discipline's own home — the ONLY file allowed to touch the
-# raw clocks
-_ALLOWED = {os.path.join("tpu_patterns", "core", "timing.py")}
+# runnable as a loose script from anywhere in the repo
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
 
 def lint() -> int:
-    violations: list[str] = []
-    for dirpath, dirnames, filenames in os.walk(PACKAGE):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in filenames:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, ROOT)
-            if rel in _ALLOWED:
-                continue
-            with open(path) as f:
-                for lineno, line in enumerate(f, start=1):
-                    if _FORBIDDEN.search(line):
-                        violations.append(
-                            f"{rel}:{lineno}: {line.strip()}"
-                        )
+    from tpu_patterns.analysis import run_lint
+
+    report = run_lint(
+        rules=["clock-discipline"], tier="a", use_baseline=False
+    )
+    violations = report.new
     if violations:
         print(
             "bare time.time()/time.perf_counter() outside core/timing.py "
@@ -55,8 +41,8 @@ def lint() -> int:
             "through timing.wall_time_s():",
             file=sys.stderr,
         )
-        for v in violations:
-            print(f"  {v}", file=sys.stderr)
+        for f in violations:
+            print(f"  {f.path}:{f.line}: {f.snippet}", file=sys.stderr)
         return 1
     print("timing lint: clean")
     return 0

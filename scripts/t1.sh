@@ -2,4 +2,7 @@
 # Tier-1 verify — the ROADMAP.md command, VERBATIM.  One encoding of the
 # gate, shared by CI, the driver, and anyone typing `bash scripts/t1.sh`:
 # if the ROADMAP command changes, this file is the only copy to update.
+# Static analysis runs as its own CI job (`tpu-patterns lint`, see
+# docs/static-analysis.md) — the suite below pins the same gates via
+# tests/test_analysis.py, so tier-1 alone still catches new findings.
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

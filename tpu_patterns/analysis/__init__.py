@@ -1,0 +1,36 @@
+"""graftlint: the repo's two-tier static-analysis subsystem.
+
+Tier A walks the package ASTs (no backend init, no compilation)
+enforcing the source invariants five subsystems rest on — clock discipline, hot-path host
+syncs, seeded randomness, the fault-site registry, metric naming,
+exception hygiene, backoff-owned sleeps, lock-guarded registry
+mutation.  Tier B abstract-evals the jitted entry points on CPU and
+interrogates the compiled artifacts — donation really aliases, no host
+callbacks or f64 upcasts in decode steps, scheduler buckets stay on
+the declared power-of-two set.
+
+Findings ratchet against ``baseline.json``: CI fails only on NEW
+findings, inline ``# graftlint: allow[rule] -- why`` suppressions
+require a written justification, and every run emits one Record per
+rule plus ``tpu_patterns_lint_*`` metrics.  Run it::
+
+    tpu-patterns lint [--rules ...] [--tier a|b|both]
+                      [--format text|jsonl|github] [--update-baseline]
+
+docs/static-analysis.md is the catalog and workflow guide.
+"""
+
+from tpu_patterns.analysis.engine import (  # noqa: F401
+    LintReport,
+    emit,
+    lint_sources,
+    rule_docs,
+    rule_names,
+    run_lint,
+    write_records,
+)
+from tpu_patterns.analysis.findings import (  # noqa: F401
+    Finding,
+    default_baseline_path,
+)
+from tpu_patterns.analysis.walker import iter_source_files  # noqa: F401

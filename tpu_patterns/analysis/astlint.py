@@ -1,0 +1,714 @@
+"""Tier A: AST rules over the package sources (no backend, no compile).
+
+Each rule is a class with a ``name``, a one-line ``doc`` (the rule
+catalog in docs/static-analysis.md is generated from these), and a
+``run(files) -> [Finding]`` over the whole corpus — whole-corpus because
+two of the rules (fault-site registry, hot-path reachability) are
+cross-file by nature, and per-file rules just loop.
+
+The rules encode the repo's own invariants (docs/idioms.md and five
+PRs of tribal knowledge), not generic style:
+
+* clock-discipline      — all timing through core/timing.py
+* host-sync-in-hot-path — no device->host sync inside the serve loop
+* unseeded-randomness   — no global-RNG draws (seeded objects only)
+* fault-site-registry   — inject() literals <-> faults.KNOWN_SITES
+* metric-naming         — tpu_patterns_* names, known label keys
+* bare-except-in-runtime— no bare/blind-swallow exception handlers
+* sleep-outside-backoff — time.sleep only in the RetryPolicy home
+* lock-discipline       — guarded-by[] registry mutations under lock
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+
+from tpu_patterns.analysis.findings import Finding
+from tpu_patterns.analysis.walker import rel_to_repo
+
+
+@dataclasses.dataclass
+class SourceFile:
+    """One parsed source: path, text, lines, AST (None on syntax error)."""
+
+    path: str  # absolute
+    rel: str  # repo-relative
+    text: str
+    lines: list[str]
+    tree: ast.AST | None
+
+    @classmethod
+    def load(cls, path: str) -> "SourceFile":
+        with open(path, encoding="utf-8", errors="replace") as f:
+            text = f.read()
+        try:
+            tree = ast.parse(text, filename=path)
+        except SyntaxError:
+            tree = None
+        return cls(
+            path=os.path.abspath(path),
+            rel=rel_to_repo(path),
+            text=text,
+            lines=text.splitlines(),
+            tree=tree,
+        )
+
+    def src_line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+def _finding(rule: str, sf: SourceFile, node, message: str) -> Finding:
+    line = getattr(node, "lineno", 0) if node is not None else 0
+    return Finding(
+        rule=rule,
+        path=sf.rel,
+        line=line,
+        message=message,
+        snippet=sf.src_line(line),
+        tier="A",
+    )
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.device_get' for Attribute chains rooted at a Name; '' else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+class Rule:
+    name = ""
+    doc = ""
+
+    def run(self, files: list[SourceFile]) -> list[Finding]:
+        raise NotImplementedError
+
+
+# -- clock-discipline -----------------------------------------------------
+
+
+class ClockDiscipline(Rule):
+    name = "clock-discipline"
+    doc = (
+        "All timing goes through core/timing.py: bare time.time() / "
+        "time.perf_counter[_ns]() anywhere else reintroduces wall-clock "
+        "jumps into durations and forks the epoch from every span."
+    )
+
+    FORBIDDEN = frozenset({"time", "perf_counter", "perf_counter_ns"})
+    ALLOWED_FILES = frozenset({"tpu_patterns/core/timing.py"})
+
+    def run(self, files: list[SourceFile]) -> list[Finding]:
+        out: list[Finding] = []
+        for sf in files:
+            if sf.rel in self.ALLOWED_FILES or sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if (
+                    isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "time"
+                    and node.attr in self.FORBIDDEN
+                ):
+                    out.append(_finding(
+                        self.name, sf, node,
+                        f"time.{node.attr} outside core/timing.py — use "
+                        "timing.clock_ns() for durations, "
+                        "timing.wall_time_s() for timestamps",
+                    ))
+                elif isinstance(node, ast.ImportFrom) and node.module == "time":
+                    bad = [
+                        a.name for a in node.names
+                        if a.name in self.FORBIDDEN
+                    ]
+                    if bad:
+                        out.append(_finding(
+                            self.name, sf, node,
+                            f"from time import {', '.join(bad)} outside "
+                            "core/timing.py — route through core/timing",
+                        ))
+        return out
+
+
+# -- host-sync-in-hot-path ------------------------------------------------
+
+
+class HostSyncInHotPath(Rule):
+    name = "host-sync-in-hot-path"
+    doc = (
+        "Functions reachable from the serve/decode iteration loops must "
+        "not force a device->host sync (.item(), jax.device_get, "
+        "block_until_ready, np.asarray): one stray sync serializes the "
+        "whole pipelined loop."
+    )
+
+    # file -> root qualnames of the per-iteration hot loops
+    HOT_ROOTS: dict[str, frozenset[str]] = {
+        "tpu_patterns/serve/engine.py": frozenset({
+            "ServeEngine._prefill",
+            "ServeEngine._step",
+            "ServeEngine._retire",
+            "ServeEngine._admit",
+        }),
+    }
+
+    SYNC_ATTRS = frozenset({"item", "block_until_ready"})
+    SYNC_CALLS = frozenset({
+        "jax.device_get",
+        "jax.block_until_ready",
+        "np.asarray",
+        "numpy.asarray",
+    })
+
+    def __init__(self, hot_roots: dict[str, frozenset[str]] | None = None):
+        if hot_roots is not None:
+            self.HOT_ROOTS = hot_roots
+
+    def _functions(self, tree: ast.AST) -> dict[str, ast.AST]:
+        """qualname -> def node for module functions and class methods."""
+        table: dict[str, ast.AST] = {}
+        for node in tree.body:  # type: ignore[attr-defined]
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                table[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        table[f"{node.name}.{sub.name}"] = sub
+        return table
+
+    def _callees(
+        self, qual: str, fn: ast.AST, table: dict[str, ast.AST]
+    ) -> set[str]:
+        cls = qual.split(".")[0] if "." in qual else None
+        out: set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in table:
+                out.add(f.id)
+            elif (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "self"
+                and cls
+                and f"{cls}.{f.attr}" in table
+            ):
+                out.add(f"{cls}.{f.attr}")
+        return out
+
+    def run(self, files: list[SourceFile]) -> list[Finding]:
+        out: list[Finding] = []
+        for sf in files:
+            roots = self.HOT_ROOTS.get(sf.rel)
+            if not roots or sf.tree is None:
+                continue
+            table = self._functions(sf.tree)
+            # BFS the intra-module call graph from the loop roots
+            reach = {r for r in roots if r in table}
+            frontier = list(reach)
+            while frontier:
+                qual = frontier.pop()
+                for callee in self._callees(qual, table[qual], table):
+                    if callee not in reach:
+                        reach.add(callee)
+                        frontier.append(callee)
+            for qual in sorted(reach):
+                for node in ast.walk(table[qual]):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    dotted = _dotted(node.func)
+                    sync = None
+                    if dotted in self.SYNC_CALLS:
+                        sync = dotted
+                    elif (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr in self.SYNC_ATTRS
+                        and not node.args
+                        and not node.keywords
+                    ):
+                        sync = f".{node.func.attr}()"
+                    if sync:
+                        out.append(_finding(
+                            self.name, sf, node,
+                            f"{sync} inside hot-path function {qual} "
+                            "(reachable from the serve iteration loop) "
+                            "forces a device->host sync",
+                        ))
+        return out
+
+
+# -- unseeded-randomness --------------------------------------------------
+
+
+class UnseededRandomness(Rule):
+    name = "unseeded-randomness"
+    doc = (
+        "No draws from the process-global RNGs (random.random(), "
+        "np.random.rand(), random.seed()): randomness comes from seeded "
+        "generator OBJECTS (random.Random(seed), np.random.default_rng) "
+        "so every run replays bit-identically."
+    )
+
+    GLOBAL_RANDOM = frozenset({
+        "random", "randint", "randrange", "choice", "choices", "shuffle",
+        "uniform", "gauss", "normalvariate", "betavariate", "sample",
+        "seed", "getrandbits",
+    })
+    NP_SEEDED_OK = frozenset({
+        "default_rng", "RandomState", "Generator", "SeedSequence",
+        "PCG64", "Philox", "bit_generator",
+    })
+
+    def run(self, files: list[SourceFile]) -> list[Finding]:
+        out: list[Finding] = []
+        for sf in files:
+            if sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = _dotted(node.func)
+                if not dotted:
+                    continue
+                parts = dotted.split(".")
+                if (
+                    len(parts) == 2
+                    and parts[0] == "random"
+                    and parts[1] in self.GLOBAL_RANDOM
+                ):
+                    out.append(_finding(
+                        self.name, sf, node,
+                        f"{dotted}() draws from the process-global RNG — "
+                        "use a seeded random.Random(seed) object",
+                    ))
+                elif (
+                    len(parts) == 3
+                    and parts[0] in ("np", "numpy")
+                    and parts[1] == "random"
+                    and parts[2] not in self.NP_SEEDED_OK
+                ):
+                    out.append(_finding(
+                        self.name, sf, node,
+                        f"{dotted}() draws from numpy's global RNG — "
+                        "use np.random.default_rng(seed)",
+                    ))
+        return out
+
+
+# -- fault-site-registry --------------------------------------------------
+
+
+class FaultSiteRegistry(Rule):
+    name = "fault-site-registry"
+    doc = (
+        "Every faults.inject(\"site\") literal must be registered in "
+        "faults.KNOWN_SITES and every registered site must have a call "
+        "site — an orphan on either side is a chaos spec that silently "
+        "injects nothing."
+    )
+
+    REGISTRY_FILE = "tpu_patterns/faults/injector.py"
+    REGISTRY_NAME = "KNOWN_SITES"
+
+    def _registered(
+        self, sf: SourceFile
+    ) -> tuple[set[str], int]:
+        """(site set, lineno of the KNOWN_SITES assignment)."""
+        if sf.tree is None:
+            return set(), 0
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(
+                isinstance(t, ast.Name) and t.id == self.REGISTRY_NAME
+                for t in node.targets
+            ):
+                continue
+            sites = {
+                c.value
+                for c in ast.walk(node.value)
+                if isinstance(c, ast.Constant) and isinstance(c.value, str)
+            }
+            return sites, node.lineno
+        return set(), 0
+
+    def run(self, files: list[SourceFile]) -> list[Finding]:
+        out: list[Finding] = []
+        reg_sf = next(
+            (sf for sf in files if sf.rel == self.REGISTRY_FILE), None
+        )
+        if reg_sf is None:
+            return out  # partial corpus (tests lint fixture dirs)
+        registered, reg_line = self._registered(reg_sf)
+        called: set[str] = set()
+        for sf in files:
+            if sf.rel == self.REGISTRY_FILE or sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                is_inject = (
+                    isinstance(f, ast.Attribute) and f.attr == "inject"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "faults"
+                ) or (isinstance(f, ast.Name) and f.id == "inject")
+                if not is_inject or not node.args:
+                    continue
+                first = node.args[0]
+                if not (
+                    isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)
+                ):
+                    out.append(_finding(
+                        self.name, sf, node,
+                        "fault site must be a string literal so the "
+                        "registry stays statically checkable",
+                    ))
+                    continue
+                called.add(first.value)
+                if first.value not in registered:
+                    out.append(_finding(
+                        self.name, sf, node,
+                        f"fault site {first.value!r} is not registered "
+                        f"in faults.{self.REGISTRY_NAME} — a spec naming "
+                        "it would be rejected at parse time",
+                    ))
+        for site in sorted(registered - called):
+            out.append(_finding(
+                self.name, reg_sf,
+                type("L", (), {"lineno": reg_line})(),
+                f"registered fault site {site!r} has no inject() call "
+                "site — dead registry entry",
+            ))
+        return out
+
+
+# -- metric-naming --------------------------------------------------------
+
+
+class MetricNaming(Rule):
+    name = "metric-naming"
+    doc = (
+        "Metric literals carry the tpu_patterns_ prefix, counters end "
+        "_total, and label keys come from the known set — one namespace "
+        "a dashboard can glob, no per-PR label drift."
+    )
+
+    METHODS = frozenset({"counter", "gauge", "histogram"})
+    # the registry implementation itself (wraps non-literal names)
+    EXCLUDED_FILES = frozenset({"tpu_patterns/obs/metrics.py"})
+    NON_LABEL_KWARGS = frozenset({"help", "buckets"})
+    KNOWN_LABELS = frozenset({
+        "site", "action", "cell", "cell_class", "suite", "status",
+        "optimizer", "app", "mode", "reason", "rule", "tier", "worker",
+    })
+    PREFIX = "tpu_patterns_"
+
+    def run(self, files: list[SourceFile]) -> list[Finding]:
+        out: list[Finding] = []
+        for sf in files:
+            if sf.rel in self.EXCLUDED_FILES or sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self.METHODS
+                ):
+                    continue
+                if not node.args:
+                    continue
+                first = node.args[0]
+                if not (
+                    isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)
+                ):
+                    continue  # dynamic replay paths re-emit stored names
+                name = first.value
+                kind = node.func.attr
+                if not name.startswith(self.PREFIX):
+                    out.append(_finding(
+                        self.name, sf, node,
+                        f"metric {name!r} lacks the {self.PREFIX!r} "
+                        "prefix — every exported series shares the one "
+                        "namespace",
+                    ))
+                elif kind == "counter" and not name.endswith("_total"):
+                    out.append(_finding(
+                        self.name, sf, node,
+                        f"counter {name!r} must end in '_total' "
+                        "(Prometheus counter convention)",
+                    ))
+                for kw in node.keywords:
+                    if kw.arg is None or kw.arg in self.NON_LABEL_KWARGS:
+                        continue
+                    if kw.arg not in self.KNOWN_LABELS:
+                        out.append(_finding(
+                            self.name, sf, node,
+                            f"label {kw.arg!r} on {name!r} is not in the "
+                            "known label set "
+                            f"({sorted(self.KNOWN_LABELS)}) — add it "
+                            "there deliberately or reuse an existing key",
+                        ))
+        return out
+
+
+# -- bare-except-in-runtime -----------------------------------------------
+
+
+class BareExceptInRuntime(Rule):
+    name = "bare-except-in-runtime"
+    doc = (
+        "No bare `except:` and no blind `except Exception: pass` in "
+        "runtime code — a swallowed error is an invisible outage; catch "
+        "narrowly or leave a trail."
+    )
+
+    def run(self, files: list[SourceFile]) -> list[Finding]:
+        out: list[Finding] = []
+        for sf in files:
+            if sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if node.type is None:
+                    out.append(_finding(
+                        self.name, sf, node,
+                        "bare `except:` catches SystemExit/Keyboard"
+                        "Interrupt too — name the exception",
+                    ))
+                    continue
+                broad = (
+                    isinstance(node.type, ast.Name)
+                    and node.type.id in ("Exception", "BaseException")
+                )
+                swallows = len(node.body) == 1 and isinstance(
+                    node.body[0], (ast.Pass, ast.Continue)
+                )
+                if broad and swallows:
+                    out.append(_finding(
+                        self.name, sf, node,
+                        f"`except {node.type.id}: "
+                        f"{'pass' if isinstance(node.body[0], ast.Pass) else 'continue'}`"
+                        " silently swallows every error — log, narrow, "
+                        "or justify",
+                    ))
+        return out
+
+
+# -- sleep-outside-backoff ------------------------------------------------
+
+
+class SleepOutsideBackoff(Rule):
+    name = "sleep-outside-backoff"
+    doc = (
+        "time.sleep lives in faults/retry.py (the one RetryPolicy "
+        "backoff home) — a stray sleep elsewhere is an unbounded, "
+        "untunable stall no deadline accounts for."
+    )
+
+    ALLOWED_FILES = frozenset({"tpu_patterns/faults/retry.py"})
+
+    def run(self, files: list[SourceFile]) -> list[Finding]:
+        out: list[Finding] = []
+        for sf in files:
+            if sf.rel in self.ALLOWED_FILES or sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if (
+                    isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "time"
+                    and node.attr == "sleep"
+                ):
+                    out.append(_finding(
+                        self.name, sf, node,
+                        "time.sleep outside the RetryPolicy backoff home "
+                        "— waits belong to a policy (bounded, seeded, "
+                        "metered), not inline",
+                    ))
+                elif isinstance(node, ast.ImportFrom) and node.module == "time":
+                    if any(a.name == "sleep" for a in node.names):
+                        out.append(_finding(
+                            self.name, sf, node,
+                            "from time import sleep outside the "
+                            "RetryPolicy backoff home",
+                        ))
+        return out
+
+
+# -- lock-discipline ------------------------------------------------------
+
+
+class LockDiscipline(Rule):
+    name = "lock-discipline"
+    doc = (
+        "Attributes annotated `# graftlint: guarded-by[_lock]` at their "
+        "__init__ assignment may only be mutated inside `with "
+        "self._lock:` — the annotation is the contract, this rule is "
+        "the enforcement."
+    )
+
+    MUTATORS = frozenset({
+        "append", "appendleft", "add", "pop", "popleft", "clear",
+        "remove", "discard", "extend", "update", "insert", "setdefault",
+    })
+    _GUARD_TOKEN = "graftlint: guarded-by["
+
+    def _guard_on_line(self, sf: SourceFile, lineno: int) -> str | None:
+        line = sf.src_line(lineno)
+        i = line.find(self._GUARD_TOKEN)
+        if i < 0:
+            return None
+        rest = line[i + len(self._GUARD_TOKEN):]
+        j = rest.find("]")
+        return rest[:j].strip() if j > 0 else None
+
+    def _self_attr(self, node: ast.AST) -> str | None:
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            return node.attr
+        return None
+
+    def _target_attrs(self, target: ast.AST) -> list[tuple[str, ast.AST]]:
+        """self-attributes written by an assignment target (a subscript
+        store counts once — as the subscript, not also as its base)."""
+        out = []
+        consumed: set[int] = set()
+        for node in ast.walk(target):  # BFS: parents before children
+            if isinstance(node, ast.Subscript):
+                attr = self._self_attr(node.value)
+                if attr is not None:
+                    out.append((attr, node))
+                    consumed.add(id(node.value))
+            elif id(node) not in consumed:
+                attr = self._self_attr(node)
+                if attr is not None:
+                    out.append((attr, node))
+        return out
+
+    def _check_method(
+        self, sf: SourceFile, cls_name: str, method: ast.AST,
+        guarded: dict[str, str], out: list[Finding],
+    ) -> None:
+        def locked_by(stack: list[ast.AST], lock: str) -> bool:
+            for w in stack:
+                if not isinstance(w, ast.With):
+                    continue
+                for item in w.items:
+                    if self._self_attr(item.context_expr) == lock:
+                        return True
+            return False
+
+        def visit(node: ast.AST, stack: list[ast.AST]) -> None:
+            writes: list[tuple[str, ast.AST]] = []
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    writes.extend(self._target_attrs(t))
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    writes.extend(self._target_attrs(t))
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in self.MUTATORS
+                ):
+                    attr = self._self_attr(f.value)
+                    if attr is not None:
+                        writes.append((attr, node))
+            for attr, anchor in writes:
+                lock = guarded.get(attr)
+                if lock is not None and not locked_by(stack, lock):
+                    out.append(_finding(
+                        self.name, sf, anchor,
+                        f"{cls_name}.{attr} is guarded-by[{lock}] but "
+                        f"mutated outside `with self.{lock}` in "
+                        f"{cls_name}.{method.name}",
+                    ))
+            stack.append(node)
+            for child in ast.iter_child_nodes(node):
+                visit(child, stack)
+            stack.pop()
+
+        visit(method, [])
+
+    def run(self, files: list[SourceFile]) -> list[Finding]:
+        out: list[Finding] = []
+        for sf in files:
+            if sf.tree is None or self._GUARD_TOKEN not in sf.text:
+                continue
+            for cls in ast.walk(sf.tree):
+                if not isinstance(cls, ast.ClassDef):
+                    continue
+                guarded: dict[str, str] = {}  # attr -> lock attr
+                decl_methods: dict[str, str] = {}  # attr -> declaring def
+                for method in cls.body:
+                    if not isinstance(
+                        method, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        continue
+                    for node in ast.walk(method):
+                        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                            continue
+                        lock = self._guard_on_line(sf, node.lineno)
+                        if lock is None:
+                            continue
+                        targets = (
+                            node.targets if isinstance(node, ast.Assign)
+                            else [node.target]
+                        )
+                        for t in targets:
+                            attr = self._self_attr(t)
+                            if attr is not None:
+                                guarded[attr] = lock
+                                decl_methods[attr] = method.name
+                if not guarded:
+                    continue
+                for method in cls.body:
+                    if not isinstance(
+                        method, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        continue
+                    # the declaring method (usually __init__) builds the
+                    # object before it is shared: no lock exists yet
+                    local = {
+                        a: l for a, l in guarded.items()
+                        if decl_methods[a] != method.name
+                    }
+                    if local:
+                        self._check_method(sf, cls.name, method, local, out)
+        return out
+
+
+AST_RULES: tuple[type[Rule], ...] = (
+    ClockDiscipline,
+    HostSyncInHotPath,
+    UnseededRandomness,
+    FaultSiteRegistry,
+    MetricNaming,
+    BareExceptInRuntime,
+    SleepOutsideBackoff,
+    LockDiscipline,
+)

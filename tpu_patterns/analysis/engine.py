@@ -1,0 +1,290 @@
+"""graftlint orchestration: rules -> findings -> baseline -> Records.
+
+One run walks the package (Tier A) and/or traces the jitted entry
+points (Tier B), applies inline suppressions, diffs the surviving
+findings against the committed ratchet baseline, and reports:
+
+* one Record per rule in the house SUCCESS/FAILURE shape (pattern
+  ``graftlint``, mode = rule name) — FAILURE iff the rule produced a
+  finding NOT in the baseline, so the process exit code is the verdict
+  exactly like every other runner;
+* ``tpu_patterns_lint_*`` metrics into the obs registry;
+* findings in ``text`` (path:line: [rule] message), ``jsonl`` (one JSON
+  object per finding), or ``github`` (workflow-command annotations on
+  the PR diff) form.
+
+The ratchet: CI fails only on NEW findings.  ``--update-baseline``
+re-pins; stale entries (fixed violations) are reported and dropped on
+the next re-pin, so the baseline only shrinks unless a human pins new
+debt deliberately.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+from typing import TextIO
+
+from tpu_patterns.analysis import walker
+from tpu_patterns.analysis.astlint import AST_RULES, Rule, SourceFile
+from tpu_patterns.analysis.findings import (
+    Finding,
+    apply_suppressions,
+    default_baseline_path,
+    fingerprint_findings,
+    load_baseline,
+    save_baseline,
+    scan_allows,
+)
+
+# the complete rule catalog: Tier A classes + Tier B check names
+def rule_names() -> list[str]:
+    from tpu_patterns.analysis.tracelint import TRACE_CHECKS
+
+    return [r.name for r in AST_RULES] + list(TRACE_CHECKS)
+
+
+def rule_docs() -> dict[str, str]:
+    from tpu_patterns.analysis.tracelint import TRACE_DOCS
+
+    return {**{r.name: r.doc for r in AST_RULES}, **TRACE_DOCS}
+
+
+@dataclasses.dataclass
+class LintReport:
+    findings: list[Finding]  # every finding, suppressed included
+    new: list[Finding]  # unsuppressed, not in baseline -> the gate
+    baselined: list[Finding]  # unsuppressed but pinned
+    suppressed: list[Finding]  # inline-allowed with justification
+    stale: list[dict]  # baseline entries nothing matched (fixed debt)
+    rules_run: list[str]
+    files_scanned: int
+    baseline_path: str | None
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.new else 0
+
+
+def lint_sources(
+    paths: list[str], rules: list[str] | None = None
+) -> tuple[list[Finding], list[SourceFile]]:
+    """Tier A over an explicit file list (the tests' fixture door)."""
+    files = [SourceFile.load(p) for p in paths]
+    findings: list[Finding] = []
+    for cls in AST_RULES:
+        if rules is not None and cls.name not in rules:
+            continue
+        findings.extend(cls().run(files))
+    return findings, files
+
+
+def run_lint(
+    *,
+    rules: list[str] | None = None,
+    tier: str = "both",
+    root: str | None = None,
+    baseline_path: str | None = None,
+    use_baseline: bool = True,
+    update_baseline: bool = False,
+) -> LintReport:
+    """Run graftlint and return the report (no printing; see ``emit``).
+
+    ``use_baseline=False`` is strict mode (the lint_timing shim): every
+    unsuppressed finding is new.  ``rules`` filters both tiers by name;
+    unknown names raise (a typo'd --rules must not silently pass).
+    """
+    known = set(rule_names())
+    if rules is not None:
+        unknown = sorted(set(rules) - known)
+        if unknown:
+            raise ValueError(
+                f"unknown rule(s) {unknown} — known: {sorted(known)}"
+            )
+    if tier not in ("a", "b", "both"):
+        raise ValueError(f"tier must be a|b|both, got {tier!r}")
+
+    findings: list[Finding] = []
+    files: list[SourceFile] = []
+    if tier in ("a", "both"):
+        findings_a, files = lint_sources(
+            walker.iter_source_files(root), rules
+        )
+        findings.extend(findings_a)
+    if tier in ("b", "both"):
+        from tpu_patterns.analysis.tracelint import run_trace_checks
+
+        findings.extend(run_trace_checks(rules))
+
+    allows = {sf.rel: scan_allows(sf.lines) for sf in files}
+    apply_suppressions(findings, allows)
+    fingerprint_findings(findings)
+
+    bl_path = baseline_path or default_baseline_path()
+    baseline = load_baseline(bl_path) if use_baseline else {}
+    live = [f for f in findings if not f.suppressed]
+    new = [f for f in live if f.fingerprint not in baseline]
+    baselined = [f for f in live if f.fingerprint in baseline]
+    seen = {f.fingerprint for f in live}
+    ran = set(rules) if rules is not None else known
+    if tier == "a":
+        ran &= {r.name for r in AST_RULES}
+    elif tier == "b":
+        ran -= {r.name for r in AST_RULES}
+    if not ran:
+        # a --rules/--tier mismatch must not read as a clean lint that
+        # checked nothing (same contract as unknown rule names)
+        raise ValueError(
+            f"no rule left to run: --rules {sorted(rules or [])} all "
+            f"belong to the other tier (--tier {tier})"
+        )
+    # only rules that RAN can declare their baseline entries stale — a
+    # --rules subset must not report the other rules' debt as fixed
+    stale = [
+        e for fp, e in sorted(baseline.items())
+        if fp not in seen and e["rule"] in ran
+    ]
+
+    if update_baseline:
+        if not use_baseline:
+            raise ValueError("cannot update a baseline in strict mode")
+        if rules is not None or tier != "both":
+            raise ValueError(
+                "--update-baseline needs the FULL run (no --rules/--tier "
+                "filter): a partial re-pin would drop other rules' entries"
+            )
+        save_baseline(bl_path, live, baseline)
+        new, baselined, stale = [], live, []
+
+    return LintReport(
+        findings=findings,
+        new=new,
+        baselined=baselined,
+        suppressed=[f for f in findings if f.suppressed],
+        stale=stale,
+        rules_run=sorted(ran),
+        files_scanned=len(files),
+        baseline_path=bl_path if use_baseline else None,
+    )
+
+
+def _count_metrics(report: LintReport) -> None:
+    from tpu_patterns import obs
+
+    by_rule: dict[str, dict[str, int]] = {}
+    for bucket, fs in (
+        ("new", report.new),
+        ("baselined", report.baselined),
+        ("suppressed", report.suppressed),
+    ):
+        for f in fs:
+            by_rule.setdefault(f.rule, {}).setdefault(bucket, 0)
+            by_rule[f.rule][bucket] += 1
+    for rule in report.rules_run:
+        counts = by_rule.get(rule, {})
+        for bucket in ("new", "baselined", "suppressed"):
+            obs.gauge(
+                "tpu_patterns_lint_findings", rule=rule, status=bucket
+            ).set(float(counts.get(bucket, 0)))
+    obs.gauge("tpu_patterns_lint_files_scanned").set(
+        float(report.files_scanned)
+    )
+    obs.counter("tpu_patterns_lint_runs_total").inc()
+
+
+def write_records(report: LintReport, writer) -> None:
+    """One Record per rule run — the house verdict shape.  FAILURE iff
+    the rule has NEW findings; baselined debt and justified
+    suppressions ride as metrics, visible but not fatal."""
+    from tpu_patterns.core.results import Record, Verdict
+
+    _count_metrics(report)
+    by_rule: dict[str, list[Finding]] = {}
+    for f in report.findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    tiers = {r: ("B" if r.startswith("trace-") else "A")
+             for r in report.rules_run}
+    for rule in report.rules_run:
+        fs = by_rule.get(rule, [])
+        new = [f for f in fs if f in report.new]
+        rec = Record(
+            pattern="graftlint",
+            mode=rule,
+            commands=f"tier{tiers[rule]}",
+            metrics={
+                "findings": float(len(fs)),
+                "new": float(len(new)),
+                "baselined": float(
+                    sum(1 for f in fs if f in report.baselined)
+                ),
+                "suppressed": float(sum(1 for f in fs if f.suppressed)),
+            },
+            verdict=Verdict.FAILURE if new else Verdict.SUCCESS,
+            notes=[f"{f.location()}: {f.message}" for f in new[:10]],
+        )
+        writer.record(rec)
+
+
+def emit(
+    report: LintReport, fmt: str = "text", stream: TextIO | None = None
+) -> None:
+    """Print findings in the chosen format (verdict Records are separate
+    — ``write_records`` — so jsonl output stays machine-pure)."""
+    out = stream if stream is not None else sys.stdout
+
+    def _say(s: str) -> None:
+        print(s, file=out)
+
+    ordered = sorted(
+        (f for f in report.findings),
+        key=lambda f: (f.path, f.line, f.rule),
+    )
+    if fmt == "jsonl":
+        for f in ordered:
+            d = f.to_json()
+            d["status"] = (
+                "suppressed" if f.suppressed
+                else "new" if f in report.new else "baselined"
+            )
+            _say(json.dumps(d, sort_keys=True))
+        return
+    if fmt == "github":
+        # workflow commands: new findings annotate as errors (gate),
+        # baselined debt as warnings (visible on the diff, not fatal)
+        for f in ordered:
+            if f.suppressed:
+                continue
+            level = "error" if f in report.new else "warning"
+            msg = f"[{f.rule}] {f.message}".replace("\n", " ")
+            _say(
+                f"::{level} file={f.path},line={max(1, f.line)},"
+                f"title=graftlint {f.rule}::{msg}"
+            )
+        _say(
+            f"::notice title=graftlint::{len(report.new)} new, "
+            f"{len(report.baselined)} baselined, "
+            f"{len(report.suppressed)} suppressed across "
+            f"{report.files_scanned} files"
+        )
+        return
+    # text
+    for f in ordered:
+        tag = (
+            "SUPPRESSED" if f.suppressed
+            else "new" if f in report.new else "baselined"
+        )
+        _say(f"{f.location()}: [{f.rule}] ({tag}) {f.message}")
+        if f.suppressed and f.justification:
+            _say(f"    allow: {f.justification}")
+    for e in report.stale:
+        _say(
+            f"# stale baseline entry (fixed): [{e['rule']}] {e['path']} "
+            f"{e['fingerprint']} — --update-baseline to drop it"
+        )
+    _say(
+        f"# graftlint: {len(report.new)} new, {len(report.baselined)} "
+        f"baselined, {len(report.suppressed)} suppressed, "
+        f"{len(report.stale)} stale; {report.files_scanned} files, "
+        f"rules: {', '.join(report.rules_run)}"
+    )

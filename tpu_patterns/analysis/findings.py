@@ -1,0 +1,185 @@
+"""Findings, inline suppressions, fingerprints, and the ratchet baseline.
+
+A finding is one (rule, file, line, message) violation.  Three layers
+decide what a finding means for the exit code:
+
+* **inline suppression** — ``# graftlint: allow[rule] -- justification``
+  on the flagged line (or a standalone comment line directly above it)
+  acknowledges the violation in the source.  The justification string is
+  REQUIRED: an allow without one is ignored and the finding stays live,
+  so silencing a rule always costs a written sentence.
+* **baseline** — ``tpu_patterns/analysis/baseline.json`` pins the
+  accepted pre-existing findings by content fingerprint.  CI fails only
+  on findings NOT in the baseline (the ratchet): code can only get
+  cleaner.  ``--update-baseline`` re-pins, preserving per-entry
+  justifications across re-pins.
+* **fingerprint** — sha1 over (rule, path, normalized flagged line,
+  occurrence index).  Line-number free, so unrelated edits above a
+  baselined violation do not churn the baseline; the occurrence index
+  keeps two identical violations in one file distinct.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import re
+from typing import Iterable
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation, anchored to a source line."""
+
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int  # 1-based; 0 = whole-file / whole-program finding
+    message: str
+    snippet: str = ""  # the flagged source line, stripped
+    tier: str = "A"
+    suppressed: bool = False
+    justification: str = ""  # from the inline allow, when suppressed
+    fingerprint: str = ""  # filled by fingerprint_findings
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}" if self.line else self.path
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def fingerprint_findings(findings: list[Finding]) -> list[Finding]:
+    """Assign content fingerprints in place (and return the list)."""
+    seen: dict[tuple, int] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        norm = re.sub(r"\s+", " ", f.snippet or f.message).strip()
+        key = (f.rule, f.path, norm)
+        n = seen.get(key, 0)
+        seen[key] = n + 1
+        f.fingerprint = hashlib.sha1(
+            f"{f.rule}|{f.path}|{norm}|{n}".encode()
+        ).hexdigest()[:16]
+    return findings
+
+
+# -- inline suppressions --------------------------------------------------
+
+# ``# graftlint: allow[rule-a,rule-b] -- why this is acceptable``
+_ALLOW_RE = re.compile(
+    r"#\s*graftlint:\s*allow\[(?P<rules>[a-z0-9_,\s-]+)\]"
+    r"(?:\s*--\s*(?P<why>.*\S))?"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Allow:
+    rules: frozenset[str]
+    justification: str  # empty = invalid allow (ignored, and reported)
+    line: int  # where the comment itself lives
+
+
+def scan_allows(lines: list[str]) -> dict[int, Allow]:
+    """Map of source line -> Allow covering it.
+
+    An allow comment covers its own line; a STANDALONE comment line
+    (nothing but the comment) also covers the next line, so long
+    statements can carry their suppression on the line above.
+    """
+    out: dict[int, Allow] = {}
+    for i, raw in enumerate(lines, start=1):
+        m = _ALLOW_RE.search(raw)
+        if not m:
+            continue
+        allow = Allow(
+            rules=frozenset(
+                r.strip() for r in m.group("rules").split(",") if r.strip()
+            ),
+            justification=(m.group("why") or "").strip(),
+            line=i,
+        )
+        out[i] = allow
+        if raw.strip().startswith("#"):  # standalone: covers the next line
+            out.setdefault(i + 1, allow)
+    return out
+
+
+def apply_suppressions(
+    findings: list[Finding], allows_by_path: dict[str, dict[int, Allow]]
+) -> list[Finding]:
+    """Mark findings covered by a justified allow as suppressed.
+
+    An allow WITHOUT a justification never suppresses — the finding
+    stays live and gains a note pointing at the empty allow, so the
+    missing sentence is the thing the run fails on.
+    """
+    for f in findings:
+        allow = allows_by_path.get(f.path, {}).get(f.line)
+        if allow is None or f.rule not in allow.rules:
+            continue
+        if allow.justification:
+            f.suppressed = True
+            f.justification = allow.justification
+        else:
+            f.message += (
+                "  [suppression ignored: allow[] comment has no "
+                "'-- justification' string]"
+            )
+    return findings
+
+
+# -- ratchet baseline -----------------------------------------------------
+
+BASELINE_VERSION = 1
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def load_baseline(path: str) -> dict[str, dict]:
+    """Baseline entries keyed by fingerprint ({} when absent)."""
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"{path}: baseline version {data.get('version')!r} != "
+            f"{BASELINE_VERSION} — regenerate with --update-baseline"
+        )
+    return {e["fingerprint"]: e for e in data.get("entries", [])}
+
+
+def save_baseline(
+    path: str, findings: Iterable[Finding], old: dict[str, dict]
+) -> int:
+    """Re-pin the baseline to the current unsuppressed findings.
+
+    Per-entry ``justification`` strings survive the re-pin (matched by
+    fingerprint) — they are hand-written triage notes, not tool output.
+    Returns the entry count.
+    """
+    entries = []
+    for f in sorted(
+        findings, key=lambda f: (f.rule, f.path, f.line, f.fingerprint)
+    ):
+        entries.append({
+            "rule": f.rule,
+            "path": f.path,
+            "fingerprint": f.fingerprint,
+            "text": f.snippet or f.message,
+            "justification": old.get(f.fingerprint, {}).get(
+                "justification", ""
+            ),
+        })
+    with open(path, "w") as f:
+        json.dump(
+            {"version": BASELINE_VERSION, "entries": entries},
+            f,
+            indent=1,
+            sort_keys=True,
+        )
+        f.write("\n")
+    return len(entries)

@@ -1,0 +1,341 @@
+"""Tier B: trace checks — abstract-eval the jitted entry points on CPU.
+
+Tier A reads source; this tier reads what XLA will actually be handed.
+Tiny configs of the REAL entry points (the train step, the paged
+decoder's prefill/step) are lowered and compiled on the CPU backend —
+no device time beyond compilation, no workload — and the compiled
+artifacts are interrogated:
+
+* trace-donation      — every entry point that declares donate_argnums
+                        must COMPILE to aliased bytes > 0 (via the
+                        cache-dodging ``analysis_compile`` machinery);
+                        donation is a request the backend may silently
+                        decline, and a declined donation is the exact
+                        steady-state HBM regression PR 3/4 exist to
+                        prevent.
+* trace-host-callback — the decode-step jaxpr must contain no host
+                        callback primitive (pure/io/debug callback): one
+                        callback in the per-token program serializes the
+                        whole serve loop through the host.
+* trace-f64-upcast    — no float64 intermediate in the decode-step
+                        jaxpr: an accidental f32->f64 promotion doubles
+                        cache/activation bytes and falls off the TPU
+                        fast path.
+* trace-bucket-shapes — the serve scheduler's bucket function must land
+                        every (rows, prompt) request on the declared
+                        power-of-two bucket set: a stray bucket is a
+                        fresh executable per shape (the recompile-hazard
+                        class).
+
+Checks return Findings (anchored at the entry point's definition file)
+so they ride the same baseline/suppression/Record machinery as Tier A.
+A crashed check is itself a finding — a broken verifier must not read
+as a clean program.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Callable
+
+from tpu_patterns.analysis.findings import Finding
+
+# tiny-but-real model shape shared by every trace check: smallest config
+# the entry points accept (kv head shardability, block math) while
+# keeping Tier B's compile tax to a few seconds on one CPU device
+_CFG = dict(embed=16, heads=2, head_dim=4, depth=1, dtype="float32")
+_VOCAB = 16
+
+
+def _finding(check: str, path: str, message: str, line: int = 0) -> Finding:
+    return Finding(
+        rule=check, path=path, line=line, message=message, tier="B"
+    )
+
+
+def _mesh3d():
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(
+        np.array(jax.devices()[:1]).reshape(1, 1, 1), ("dp", "sp", "tp")
+    )
+
+
+def _paged_decoder():
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_patterns.models.lm import init_lm_params
+    from tpu_patterns.models.transformer import ModelConfig, _n_experts
+    from tpu_patterns.serve.paged import make_paged_lm_decoder
+
+    mesh = _mesh3d()
+    mcfg = ModelConfig(**_CFG)
+    dec = make_paged_lm_decoder(
+        mesh, mcfg, _VOCAB, n_blocks=5, block_len=4, max_len=12
+    )
+    flat = init_lm_params(
+        jax.random.key(0), mcfg, _VOCAB, _n_experts(mesh, mcfg)
+    )
+    params = dec.stack_params(flat)
+    pool = dec.init_pool()
+    rows, lpad = 2, 4
+    prefill_args = (
+        params, pool,
+        jnp.zeros((rows, lpad), jnp.int32),
+        jnp.asarray([3, 2], jnp.int32),
+        jnp.asarray([[1, 0, 0], [2, 0, 0]], jnp.int32),
+        jnp.ones((rows,), bool),
+    )
+    step_args = (
+        params, pool,
+        jnp.zeros((rows,), jnp.int32),
+        jnp.asarray([3, 2], jnp.int32),
+        jnp.zeros((rows,), jnp.int32),
+        jnp.asarray([[1, 0, 0], [2, 0, 0]], jnp.int32),
+        jnp.ones((rows,), bool),
+    )
+    return dec, (rows, lpad), prefill_args, step_args
+
+
+def _train_step():
+    import jax
+    import numpy as np
+
+    from tpu_patterns.models.transformer import (
+        ModelConfig,
+        init_params,
+        make_train_step,
+    )
+
+    mesh = _mesh3d()
+    mcfg = ModelConfig(**_CFG)
+    step, _ = make_train_step(mesh, mcfg, donate=True)
+    params = init_params(jax.random.key(0), mcfg)
+    x = np.zeros((1, 4, _CFG["embed"]), np.float32)
+    return step, (params, x)
+
+
+# -- trace-donation -------------------------------------------------------
+
+
+def check_donation_takes(
+    jitted, args, name: str, path: str, check: str = "trace-donation"
+) -> list[Finding]:
+    """Alias bytes of a donating entry point, via the cache-dodging
+    compile.  Exposed for tests: a jit WITHOUT donate_argnums over the
+    same shapes is the canonical mismatch fixture."""
+    from tpu_patterns.models.transformer import donation_took
+
+    took = donation_took(jitted, *args)
+    if took is None:
+        return []  # backend exposes no memory-analysis API: nothing to say
+    if not took:
+        return [_finding(
+            check, path,
+            f"{name}: donation declared but the compiled program aliases "
+            "0 bytes — the backend declined it, so every call holds "
+            "input AND output buffers live",
+        )]
+    return []
+
+
+def trace_donation() -> list[Finding]:
+    out: list[Finding] = []
+    step, args = _train_step()
+    out += check_donation_takes(
+        step, args, "make_train_step(donate=True)",
+        "tpu_patterns/models/transformer.py",
+    )
+    dec, (rows, lpad), prefill_args, step_args = _paged_decoder()
+    out += check_donation_takes(
+        dec.prefill_jit(rows, lpad), prefill_args,
+        "PagedDecoder.prefill (pool donated)",
+        "tpu_patterns/serve/paged.py",
+    )
+    out += check_donation_takes(
+        dec.step_jit(rows), step_args,
+        "PagedDecoder.step (pool donated)",
+        "tpu_patterns/serve/paged.py",
+    )
+    return out
+
+
+# -- trace-host-callback / trace-f64-upcast -------------------------------
+
+
+def _iter_eqns(jaxpr):
+    """Every eqn in a jaxpr, recursing into sub-jaxprs (scan/cond/pjit
+    bodies) — the decode step is a scan-of-scan, so the interesting
+    primitives all live two levels down."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                yield from _iter_eqns(sub)
+
+
+def _sub_jaxprs(v):
+    import jax
+
+    core = jax.extend.core if hasattr(jax, "extend") else None
+    jaxpr_types = tuple(
+        t for t in (
+            getattr(core, "Jaxpr", None),
+            getattr(core, "ClosedJaxpr", None),
+        ) if t is not None
+    )
+    if not jaxpr_types:  # older JAX spells them jax.core.*
+        import jax.core as jcore
+
+        jaxpr_types = (jcore.Jaxpr, getattr(jcore, "ClosedJaxpr", ()))
+    if isinstance(v, jaxpr_types):
+        return [v if hasattr(v, "eqns") else v.jaxpr]
+    if isinstance(v, (list, tuple)):
+        return [
+            (s if hasattr(s, "eqns") else s.jaxpr)
+            for s in v
+            if isinstance(s, jaxpr_types)
+        ]
+    return []
+
+
+def scan_jaxpr(jitted, args, name: str, path: str) -> list[Finding]:
+    """Host-callback and f64 scan of one jitted program's jaxpr.
+    Exposed for tests (feed it a fn with a pure_callback inside)."""
+    import jax
+    import numpy as np
+
+    closed = jax.make_jaxpr(jitted)(*args)
+    out: list[Finding] = []
+    callbacks: set[str] = set()
+    f64_prims: set[str] = set()
+    for eqn in _iter_eqns(closed.jaxpr):
+        prim = eqn.primitive.name
+        if "callback" in prim:
+            callbacks.add(prim)
+        for var in eqn.outvars:
+            aval = getattr(var, "aval", None)
+            if aval is not None and getattr(aval, "dtype", None) == np.float64:
+                f64_prims.add(prim)
+    if callbacks:
+        out.append(_finding(
+            "trace-host-callback", path,
+            f"{name}: host callback primitive(s) {sorted(callbacks)} in "
+            "the decode-step jaxpr — every token round-trips through "
+            "the host",
+        ))
+    if f64_prims:
+        out.append(_finding(
+            "trace-f64-upcast", path,
+            f"{name}: float64 intermediate(s) produced by "
+            f"{sorted(f64_prims)} — a silent upcast doubles cache bytes "
+            "and leaves the TPU fast path",
+        ))
+    return out
+
+
+def trace_decode_purity() -> list[Finding]:
+    dec, (rows, lpad), prefill_args, step_args = _paged_decoder()
+    out = scan_jaxpr(
+        dec.step_jit(rows), step_args, "PagedDecoder.step",
+        "tpu_patterns/serve/paged.py",
+    )
+    out += scan_jaxpr(
+        dec.prefill_jit(rows, lpad), prefill_args, "PagedDecoder.prefill",
+        "tpu_patterns/serve/paged.py",
+    )
+    return out
+
+
+# -- trace-bucket-shapes --------------------------------------------------
+
+
+def trace_bucket_shapes() -> list[Finding]:
+    """Every reachable scheduler bucket must be in the declared
+    power-of-two set {1, 2, 4, ..., cap} — the executable-set bound the
+    serve design leans on (steady state reuses a small compiled set)."""
+    from tpu_patterns.serve.engine import _bucket
+
+    out: list[Finding] = []
+    path = "tpu_patterns/serve/engine.py"
+    for cap in (1, 2, 4, 8, 16, 64):
+        declared = {1 << e for e in range(cap.bit_length())}
+        declared = {b for b in declared if b <= cap} | {cap}
+        for n in range(1, 4 * cap + 1):
+            b = _bucket(n, cap)
+            if b not in declared:
+                out.append(_finding(
+                    "trace-bucket-shapes", path,
+                    f"_bucket({n}, cap={cap}) = {b} is outside the "
+                    f"declared power-of-two set {sorted(declared)} — a "
+                    "fresh executable per novel shape",
+                ))
+            elif b < min(n, cap):
+                out.append(_finding(
+                    "trace-bucket-shapes", path,
+                    f"_bucket({n}, cap={cap}) = {b} cannot hold "
+                    f"{min(n, cap)} rows — the scheduler would truncate "
+                    "the active set",
+                ))
+    return out
+
+
+# check name -> callable; the engine wraps each in crash-to-finding
+TRACE_CHECKS: dict[str, Callable[[], list[Finding]]] = {
+    "trace-donation": trace_donation,
+    "trace-host-callback": trace_decode_purity,  # emits both purity rules
+    "trace-f64-upcast": trace_decode_purity,
+    "trace-bucket-shapes": trace_bucket_shapes,
+}
+
+TRACE_DOCS: dict[str, str] = {
+    "trace-donation": (
+        "Donating entry points (train step, paged prefill/step) must "
+        "compile to aliased bytes > 0 — a silently declined donation "
+        "doubles steady-state HBM."
+    ),
+    "trace-host-callback": (
+        "No host callback primitive in the decode-step jaxpr — one "
+        "callback per token serializes the serve loop through the host."
+    ),
+    "trace-f64-upcast": (
+        "No float64 intermediate in the decode-step jaxpr — a silent "
+        "upcast doubles cache bytes and leaves the TPU fast path."
+    ),
+    "trace-bucket-shapes": (
+        "The serve scheduler's bucket function lands every shape on the "
+        "declared power-of-two set — stray buckets mean unbounded "
+        "executable churn."
+    ),
+}
+
+
+def run_trace_checks(names: list[str] | None = None) -> list[Finding]:
+    """Run the selected Tier-B checks; a crash inside a check becomes a
+    finding on that check (never a silent pass).  Checks sharing one
+    implementation (the purity pair) run it once."""
+    wanted = [n for n in TRACE_CHECKS if names is None or n in names]
+    out: list[Finding] = []
+    ran: set[int] = set()
+    for name in wanted:
+        fn = TRACE_CHECKS[name]
+        if id(fn) in ran:
+            continue
+        ran.add(id(fn))
+        try:
+            found = fn()
+        except Exception as e:
+            tb = traceback.format_exc(limit=3)
+            found = [_finding(
+                name, "tpu_patterns/analysis/tracelint.py",
+                f"check crashed: {type(e).__name__}: {e} — a broken "
+                f"verifier is not a clean program\n{tb}",
+            )]
+        out.extend(
+            f for f in found
+            if names is None or f.rule in names or f.rule == name
+        )
+    return out

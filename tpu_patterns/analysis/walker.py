@@ -1,0 +1,80 @@
+"""One file walker for every source-level tool.
+
+``scripts/lint_timing.py`` used to hand-roll ``os.walk`` and skip only
+``__pycache__`` — so ``build/`` trees, test fixtures, and generated
+files were linted (or not) depending on which tool walked.  This module
+is the single discovery surface: graftlint (tpu_patterns/analysis/),
+the timing-lint shim, and anything else that needs "the package's real
+sources" share ONE exclusion policy.
+"""
+
+from __future__ import annotations
+
+import os
+
+# directory names pruned anywhere in the tree
+EXCLUDED_DIRS = frozenset({
+    "__pycache__",
+    "build",
+    "fixtures",
+    ".git",
+    ".eggs",
+    "node_modules",
+})
+
+# filename suffixes of machine-written files (never hand-maintained,
+# never lint targets)
+GENERATED_SUFFIXES = ("_pb2.py", "_pb2_grpc.py", "_version.py")
+
+# a file that self-declares as generated in its first lines is skipped
+# no matter what it is called
+_GENERATED_MARKERS = ("@generated", "do not edit", "DO NOT EDIT")
+
+
+def repo_root() -> str:
+    """The repository root (the directory holding ``tpu_patterns/``)."""
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def package_root() -> str:
+    return os.path.join(repo_root(), "tpu_patterns")
+
+
+def is_generated(path: str) -> bool:
+    if path.endswith(GENERATED_SUFFIXES):
+        return True
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            head = [f.readline() for _ in range(3)]
+    except OSError:
+        return False
+    return any(m in line for line in head for m in _GENERATED_MARKERS)
+
+
+def iter_source_files(root: str | None = None) -> list[str]:
+    """All lintable ``.py`` files under ``root`` (default: the installed
+    ``tpu_patterns`` package), sorted, with the shared exclusions
+    applied.  Returns absolute paths."""
+    root = root or package_root()
+    out: list[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames if d not in EXCLUDED_DIRS
+        )
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            if is_generated(path):
+                continue
+            out.append(path)
+    return out
+
+
+def rel_to_repo(path: str) -> str:
+    """Repo-relative display/fingerprint path with forward slashes."""
+    return os.path.relpath(os.path.abspath(path), repo_root()).replace(
+        os.sep, "/"
+    )

@@ -777,6 +777,40 @@ def _cmd_profilecheck(args, writer: ResultWriter) -> int:
     return writer.exit_code
 
 
+def _cmd_lint(args, writer: ResultWriter) -> int:
+    """graftlint: both tiers, ratcheted against the committed baseline
+    (docs/static-analysis.md).  Exit 0 = no NEW findings."""
+    from tpu_patterns import analysis
+
+    rules = None
+    if args.rules:
+        rules = sorted({
+            r.strip() for spec in args.rules for r in spec.split(",")
+            if r.strip()
+        })
+    try:
+        report = analysis.run_lint(
+            rules=rules,
+            tier=args.tier,
+            baseline_path=args.baseline,
+            update_baseline=args.update_baseline,
+        )
+    except ValueError as e:
+        raise SystemExit(f"error: {e}") from e
+    analysis.emit(report, fmt=args.format)
+    # per-rule Records (house verdict shape) go to stderr under jsonl/
+    # github so those streams stay machine-pure on stdout
+    stream = sys.stderr if args.format in ("jsonl", "github") else sys.stdout
+    rec_writer = ResultWriter(jsonl_path=args.jsonl, stream=stream)
+    analysis.write_records(report, rec_writer)
+    if args.update_baseline:
+        writer.progress(
+            f"baseline re-pinned: {len(report.baselined)} entr(ies) at "
+            f"{report.baseline_path}"
+        )
+    return report.exit_code
+
+
 def _cmd_obs(args, writer: ResultWriter) -> None:
     """Read the obs layer's dumps: span summaries, Chrome-trace and
     Prometheus export, host+device join against a captured profile."""
@@ -1206,6 +1240,46 @@ def build_parser() -> argparse.ArgumentParser:
     r = sub.add_parser("report", help="tabulate logs (≙ parse.py)")
     r.add_argument("paths", nargs="+")
 
+    li = sub.add_parser(
+        "lint",
+        help="graftlint: two-tier static analysis (AST rules + compiled-"
+        "artifact trace checks) ratcheted against the committed "
+        "baseline — exit 0 = no NEW findings",
+    )
+    li.add_argument(
+        "--rules",
+        action="append",
+        metavar="RULE[,RULE...]",
+        help="run only the named rule(s); repeatable; unknown names "
+        "fail loudly (see docs/static-analysis.md for the catalog)",
+    )
+    li.add_argument(
+        "--tier",
+        choices=("a", "b", "both"),
+        default="both",
+        help="a = AST rules only (no backend init), b = trace checks "
+        "only, both (default)",
+    )
+    li.add_argument(
+        "--format",
+        choices=("text", "jsonl", "github"),
+        default="text",
+        help="finding output: human text, one JSON object per finding, "
+        "or GitHub workflow-command annotations for the PR diff",
+    )
+    li.add_argument(
+        "--baseline",
+        default=None,
+        help="ratchet baseline path (default: the committed "
+        "tpu_patterns/analysis/baseline.json)",
+    )
+    li.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="re-pin the baseline to the current findings (full run "
+        "only — no --rules/--tier filter); justifications survive",
+    )
+
     ob = sub.add_parser(
         "obs",
         help="observability layer: summarize recorded spans, export "
@@ -1307,9 +1381,33 @@ def main(argv: list[str] | None = None) -> int:
         "topo": _cmd_topo,
         "interop": _cmd_interop,
         "report": _cmd_report,
+        # NB: "lint" is NOT here — main() dispatches it before this dict
+        # (its Records move to stderr under the machine-pure formats, so
+        # the shared record/exit-code path below does not apply)
         "obs": _cmd_obs,
         "profilecheck": _cmd_profilecheck,
     }
+    if args.cmd == "lint":
+        if args.enable_profiling:
+            raise SystemExit(
+                "error: --enable_profiling does not apply to lint (tier "
+                "B compiles for analysis, it never runs a workload)"
+            )
+        # lint records on its own writer (markers move to stderr for the
+        # machine-pure formats), so its exit code is returned directly
+        rc = _cmd_lint(args, writer)
+        if args.obs_dump:
+            # the tpu_patterns_lint_* metrics live in the obs registry
+            # like every runner's — the flag must not be a silent no-op.
+            # The progress line follows the Records to stderr under the
+            # machine-pure formats so jsonl/github stdout stays parseable.
+            dump_writer = ResultWriter(
+                stream=sys.stderr
+                if args.format in ("jsonl", "github")
+                else sys.stdout
+            )
+            dump_writer.progress(f"obs metrics -> {obs.dump_metrics()}")
+        return rc
     if args.cmd == "sweep":
         if args.jsonl:
             raise SystemExit(

@@ -152,8 +152,8 @@ class WarmWorker:
         _proc.kill_process_group(self.proc)
         try:
             self.proc.wait(timeout=10)
-        except Exception:
-            pass
+        except (OSError, subprocess.TimeoutExpired):
+            pass  # already reaped, or wedged in D-state: nothing to add
         for f in (self.proc.stdin, self.proc.stdout, self._stderr_f):
             try:
                 if f is not None:
@@ -167,8 +167,8 @@ class WarmWorker:
             self.proc.stdin.write(json.dumps({"op": "shutdown"}) + "\n")
             self.proc.stdin.flush()
             self.proc.wait(timeout=5)
-        except Exception:
-            pass
+        except (OSError, ValueError, subprocess.TimeoutExpired):
+            pass  # pipe gone or drain too slow: the hammer below settles it
         self.kill()
 
 
@@ -195,12 +195,12 @@ class WorkerPool:
         self.recycle_after = recycle_after
         self.breaker_cooldown_s = breaker_cooldown_s
         self._lock = threading.Lock()
-        self._free: list[WarmWorker] = []
-        self._leased: set[WarmWorker] = set()
-        self._spawned = 0
-        self.hits = 0
-        self.misses = 0
-        self.recycled = 0
+        self._free: list[WarmWorker] = []  # graftlint: guarded-by[_lock]
+        self._leased: set[WarmWorker] = set()  # graftlint: guarded-by[_lock]
+        self._spawned = 0  # graftlint: guarded-by[_lock]
+        self.hits = 0  # graftlint: guarded-by[_lock]
+        self.misses = 0  # graftlint: guarded-by[_lock]
+        self.recycled = 0  # graftlint: guarded-by[_lock]
         # circuit breaker: after two consecutive spawn/ready failures
         # the warm path is declared dead and every later lease()
         # returns None instantly — without it, a wedged worker init
@@ -209,10 +209,10 @@ class WorkerPool:
         # the engine's history is about.  After breaker_cooldown_s one
         # lease probes a fresh spawn (half-open): success re-arms the
         # warm path, failure re-opens the breaker.
-        self._spawn_failures = 0
-        self._dead = False
-        self._opened_ns = 0
-        self._probing = False
+        self._spawn_failures = 0  # graftlint: guarded-by[_lock]
+        self._dead = False  # graftlint: guarded-by[_lock]
+        self._opened_ns = 0  # graftlint: guarded-by[_lock]
+        self._probing = False  # graftlint: guarded-by[_lock]
 
     def _spawn(self) -> WarmWorker | None:
         with self._lock:
@@ -308,7 +308,10 @@ class WorkerPool:
         with self._lock:
             self._leased.discard(worker)
         if not reusable or worker.expired or not worker.alive():
-            self.recycled += 1
+            # release() runs on every scheduler thread: the recycle
+            # counter is pool state like hits/misses and takes the lock
+            with self._lock:
+                self.recycled += 1
             worker.kill()
             return
         with self._lock:  # decide under the lock, act outside it: a

@@ -44,7 +44,7 @@ class Counter(_Metric):
 
     def __init__(self, name, labels, help=""):
         super().__init__(name, labels, help)
-        self.value = 0.0
+        self.value = 0.0  # graftlint: guarded-by[_lock]
 
     def inc(self, v: float = 1.0) -> None:
         if v < 0:
@@ -58,7 +58,7 @@ class Gauge(_Metric):
 
     def __init__(self, name, labels, help=""):
         super().__init__(name, labels, help)
-        self.value = 0.0
+        self.value = 0.0  # graftlint: guarded-by[_lock]
 
     def set(self, v: float) -> None:
         with self._lock:
@@ -78,9 +78,10 @@ class Histogram(_Metric):
         if not bs or bs[-1] != math.inf:
             bs = bs + (math.inf,)
         self.buckets = bs
-        self.counts = [0] * len(bs)  # per-bucket, NON-cumulative
-        self.sum = 0.0
-        self.count = 0
+        # counts is per-bucket, NON-cumulative
+        self.counts = [0] * len(bs)  # graftlint: guarded-by[_lock]
+        self.sum = 0.0  # graftlint: guarded-by[_lock]
+        self.count = 0  # graftlint: guarded-by[_lock]
 
     def observe(self, v: float) -> None:
         with self._lock:
@@ -103,7 +104,7 @@ class Histogram(_Metric):
 
 class Registry:
     def __init__(self):
-        self._metrics: dict[tuple, _Metric] = {}
+        self._metrics: dict[tuple, _Metric] = {}  # graftlint: guarded-by[_lock]
         self._lock = threading.Lock()
 
     def _get(self, cls, name: str, help: str, labels: dict, **kw):

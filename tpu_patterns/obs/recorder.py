@@ -44,8 +44,8 @@ class FlightRecorder:
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
         self.capacity = capacity
-        self._ring: collections.deque = collections.deque(maxlen=capacity)
-        self._dropped = 0  # entries pushed out by wraparound
+        self._ring: collections.deque = collections.deque(maxlen=capacity)  # graftlint: guarded-by[_lock]
+        self._dropped = 0  # graftlint: guarded-by[_lock] -- wraparound count
         self._lock = threading.Lock()  # dumps/clears only, never appends
 
     def append(self, entry: dict) -> None:
@@ -54,7 +54,9 @@ class FlightRecorder:
         # which is advisory — an off-by-a-few dropped count under heavy
         # cross-thread append is acceptable, a hot-path lock is not.
         if len(self._ring) == self.capacity:
+            # graftlint: allow[lock-discipline] -- advisory drop counter; a hot-path lock costs more than an off-by-a-few count
             self._dropped += 1
+        # graftlint: allow[lock-discipline] -- deque.append(maxlen) is GIL-atomic; the lock guards dump/clear only (design constraint above)
         self._ring.append(entry)
 
     def __len__(self) -> int:
