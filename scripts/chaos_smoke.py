@@ -16,7 +16,14 @@ heals itself, visibly:
   (c) preemption: a serve run takes SIGTERM mid-decode (injected
       ``preempt``), snapshots through the ckpt atomic commit, and
       ``serve --resume`` finishes the trace with greedy ids
-      BIT-IDENTICAL to an uninterrupted run of the same trace.
+      BIT-IDENTICAL to an uninterrupted run of the same trace;
+  (d) speculative-verify fault: with prefix sharing AND speculative
+      decoding on, every ``serve.verify`` wide step errors
+      deterministically after the first few succeed — the engine must
+      quarantine the in-flight rows with per-request verdicts (no
+      request silently lost: done + failed covers the trace) and the
+      shared blocks' refcounts must balance (``leaked_blocks == 0``),
+      with the CLI exiting 0 (WARNING, not FAILURE: the runtime healed).
 
 Zero dependencies beyond the package; exit 0 = pass.
 """
@@ -182,8 +189,48 @@ def main() -> int:
             "resumed ids diverged from the uninterrupted run "
             f"(want {want}, got {got})"
         )
+    # (d) deterministic verify fault under sharing + speculation: rows
+    # quarantined, nothing lost, refcounts balance, exit still 0.
+    # after=2 lets early wide steps succeed so shared blocks are truly
+    # in flight (refcounts > 1) when the fault starts firing.
+    vq_jsonl = os.path.join(work, "verify-fault.jsonl")
+    rc = _run(
+        "verify-fault",
+        [*py, "--jsonl", vq_jsonl, "serve", "--dp", "1", "--tp", "2",
+         *SERVE_ARGS, "--prefix_share", "true", "--spec_k", "4",
+         "--max_prompt", "24", "--shared_prefix", "16",
+         "--snapshot_dir", os.path.join(work, "snap-v")],
+        _env("serve.verify:error:after=2:count=99"),
+    )
+    if rc != 0:
+        return fail("verify-fault serve run exited nonzero — a "
+                    "quarantine is a WARNING, not a crash")
+    with open(vq_jsonl) as f:
+        vq = [json.loads(ln) for ln in f if ln.strip()][-1]
+    m = vq.get("metrics", {})
+    print(f"  [verify-fault] verdict={vq.get('verdict')} "
+          f"done={m.get('done_requests')} "
+          f"quarantined={m.get('quarantined')} "
+          f"leaked={m.get('leaked_blocks')}", flush=True)
+    if vq.get("verdict") == "FAILURE":
+        return fail(f"verify-fault run FAILED outright: {vq.get('notes')}")
+    if not m.get("quarantined", 0) > 0:
+        return fail("verify fault never quarantined a row — the fault "
+                    "either never fired or recovery is invisible")
+    if m.get("done_requests", 0) + m.get("quarantined", 0) != 8:
+        return fail(
+            f"requests lost: done {m.get('done_requests')} + "
+            f"quarantined {m.get('quarantined')} != 8 submitted"
+        )
+    if m.get("leaked_blocks") != 0.0:
+        return fail(
+            f"shared-block refcounts leaked {m.get('leaked_blocks')} "
+            "block(s) through quarantine"
+        )
+
     print("chaos smoke: all gates passed "
-          "(cell retry, worker fallback, preempt/resume exactness)",
+          "(cell retry, worker fallback, preempt/resume exactness, "
+          "verify-fault quarantine + refcount balance)",
           flush=True)
     return 0
 

@@ -17,6 +17,16 @@ carrying every verdict this job gates on:
       ``slots x max_len`` rectangle, and compiled ``memory_analysis``
       shows the donated pool aliased in place across steps.
 
+A second invocation runs the PR-7 serving patterns on the same mesh —
+``--prefix_share`` (a 75%-shared 8-request trace, CoW block sharing on
+vs off) and ``--spec_k`` (prompt-lookup speculative decoding on a
+repetitive trace) — and gates their two Records:
+
+  (d) prefix sharing: peak pool bytes with sharing < the non-shared
+      baseline (>= 30% fewer allocated blocks), ids exact;
+  (e) speculation: accepted tokens per row-step > 1.0 (plain decode is
+      exactly 1.0), ids exact.
+
 Zero dependencies beyond the package; exit 0 = pass.
 """
 
@@ -40,30 +50,47 @@ SERVE_ARGS = [
     "--gen", "6", "--slots", "4", "--block_len", "8",
 ]
 
+# the shared/speculative pass: 8 requests whose prompts share two full
+# blocks (16 of <= 24 tokens), all admissible at once (slots 8) so the
+# non-shared baseline's peak really is the full 8-row demand
+PREFIX_SPEC_ARGS = [
+    "--vocab", "64", "--embed", "64", "--head_dim", "8", "--depth", "1",
+    "--requests", "8", "--min_prompt", "4", "--max_prompt", "24",
+    "--gen", "6", "--slots", "8", "--block_len", "8",
+    "--shared_prefix", "16", "--prefix_share", "true", "--spec_k", "4",
+]
+
+
+def _run_cli(tag: str, jsonl: str, args: list[str], env: dict) -> list:
+    cmd = [
+        sys.executable, "-m", "tpu_patterns", "--jsonl", jsonl,
+        "serve", "--dp", "1", "--tp", "2", *args,
+    ]
+    print(f"+ [{tag}]", " ".join(cmd), flush=True)
+    t0 = time.monotonic()
+    proc = subprocess.run(cmd, env=env, cwd=ROOT)
+    wall = time.monotonic() - t0
+    print(f"  [{tag}] rc={proc.returncode} wall={wall:.1f}s", flush=True)
+    if proc.returncode != 0:
+        print(f"serve smoke: CLI exited {proc.returncode}",
+              file=sys.stderr)
+        return []
+    with open(jsonl) as f:
+        recs = [json.loads(ln) for ln in f if ln.strip()]
+    if not recs:
+        print(f"serve smoke: no Record banked by {tag}", file=sys.stderr)
+    return recs
+
 
 def main() -> int:
     env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
-    jsonl = os.path.join(
-        tempfile.mkdtemp(prefix="serve_smoke_"), "serve.jsonl"
+    work = tempfile.mkdtemp(prefix="serve_smoke_")
+    recs = _run_cli(
+        "continuous", os.path.join(work, "serve.jsonl"), SERVE_ARGS, env
     )
-    cmd = [
-        sys.executable, "-m", "tpu_patterns", "--jsonl", jsonl,
-        "serve", "--dp", "1", "--tp", "2", *SERVE_ARGS,
-    ]
-    print("+", " ".join(cmd), flush=True)
-    t0 = time.monotonic()
-    proc = subprocess.run(cmd, env=env, cwd=ROOT)
-    wall = time.monotonic() - t0
-    if proc.returncode != 0:
-        print(f"serve smoke: CLI exited {proc.returncode}", file=sys.stderr)
-        return 1
-
-    with open(jsonl) as f:
-        recs = [json.loads(ln) for ln in f if ln.strip()]
     if not recs:
-        print("serve smoke: no Record banked", file=sys.stderr)
         return 1
     rec = recs[-1]
     m = rec.get("metrics", {})
@@ -73,7 +100,7 @@ def main() -> int:
         f"sequential={m.get('sequential_tokens_per_s')} "
         f"speedup={m.get('speedup')} exact={m.get('exact')} "
         f"cache={m.get('cache_MB')}MB dense={m.get('dense_cache_MB')}MB "
-        f"alias={m.get('alias_MB')}MB wall={wall:.1f}s",
+        f"alias={m.get('alias_MB')}MB",
         flush=True,
     )
     if rec.get("verdict") != "SUCCESS":
@@ -101,6 +128,77 @@ def main() -> int:
         print(
             f"serve smoke: pool {m.get('cache_MB')}MB not under the "
             f"dense rectangle {m.get('dense_cache_MB')}MB",
+            file=sys.stderr,
+        )
+        return 1
+
+    # (d) + (e): one invocation banks both PR-7 Records
+    recs = _run_cli(
+        "prefix+spec", os.path.join(work, "prefix_spec.jsonl"),
+        PREFIX_SPEC_ARGS, env,
+    )
+    by_mode = {
+        r.get("mode", ""): r for r in recs if r.get("pattern") == "serve"
+    }
+    pre = next(
+        (r for mode, r in by_mode.items()
+         if mode.startswith("prefix_share")), None,
+    )
+    spec = next(
+        (r for mode, r in by_mode.items()
+         if mode.startswith("spec_decode")), None,
+    )
+    if pre is None or spec is None:
+        print(
+            f"serve smoke: expected prefix_share + spec_decode Records, "
+            f"got modes {sorted(by_mode)}",
+            file=sys.stderr,
+        )
+        return 1
+    pm, sm = pre.get("metrics", {}), spec.get("metrics", {})
+    print(
+        f"serve smoke: prefix verdict={pre.get('verdict')} "
+        f"peak={pm.get('peak_blocks')} "
+        f"nonshared={pm.get('nonshared_peak_blocks')} "
+        f"savings={pm.get('block_savings')} "
+        f"pool={pm.get('prefix_pool_MB')}MB "
+        f"vs {pm.get('nonshared_pool_MB')}MB exact={pm.get('exact')}",
+        flush=True,
+    )
+    print(
+        f"serve smoke: spec verdict={spec.get('verdict')} "
+        f"accepted/step={sm.get('accepted_tokens_per_step')} "
+        f"exact={sm.get('exact')}",
+        flush=True,
+    )
+    if pre.get("verdict") != "SUCCESS" or spec.get("verdict") != "SUCCESS":
+        print(
+            f"serve smoke: prefix/spec verdicts "
+            f"{pre.get('verdict')}/{spec.get('verdict')} — notes: "
+            f"{pre.get('notes')} {spec.get('notes')}",
+            file=sys.stderr,
+        )
+        return 1
+    if not pm.get("prefix_pool_MB", 1e9) < pm.get("nonshared_pool_MB", 0):
+        print(
+            "serve smoke: prefix sharing did not shrink peak pool bytes "
+            f"({pm.get('prefix_pool_MB')}MB vs "
+            f"{pm.get('nonshared_pool_MB')}MB)",
+            file=sys.stderr,
+        )
+        return 1
+    if not sm.get("accepted_tokens_per_step", 0) > 1.0:
+        print(
+            f"serve smoke: accepted tokens/step "
+            f"{sm.get('accepted_tokens_per_step')} <= 1 — speculation "
+            "never beat plain decode",
+            file=sys.stderr,
+        )
+        return 1
+    if pm.get("exact") != 1.0 or sm.get("exact") != 1.0:
+        print(
+            "serve smoke: prefix/spec exactness gate failed — sharing "
+            "or speculation changed a request's greedy ids",
             file=sys.stderr,
         )
         return 1

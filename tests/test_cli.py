@@ -285,11 +285,17 @@ class TestSweep:
         assert any("inplace" in s.name for s in asym)
         assert any("755MB" in s.name for s in asym)
         srv = sweep.specs_for("serve", quick=True)
-        # base engine + int8 pool + gqa pool, each a full-verdict cell
+        # base engine + int8 pool + gqa pool (full-verdict cells) + the
+        # PR-7 prefix-sharing and speculative-decoding record cells
         assert {s.name for s in srv} == {
             "serve.continuous", "serve.int8_pool", "serve.gqa_pool",
+            "serve.prefix_share", "serve.spec_decode",
         }
         assert all(s.argv[0] == "serve" for s in srv)
+        pre = next(s for s in srv if s.name == "serve.prefix_share")
+        assert "--prefix_share" in pre.argv
+        spc = next(s for s in srv if s.name == "serve.spec_decode")
+        assert "--spec_k" in spc.argv
         # 'all' must be exactly these suites, independently summed
         assert set(sweep.SUITES) == {
             "p2p", "hier", "measured", "tune", "asymptote", "gates",

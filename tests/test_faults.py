@@ -15,6 +15,9 @@ documented recovery behavior — the acceptance bar of the robustness PR:
                  error -> exactly the admitted rows quarantined
   serve.step     deterministic error -> active set quarantined;
                  preempt -> snapshot, then --resume is bit-identical
+  serve.verify   transient error -> wide step retried, ids exact;
+                 deterministic error -> rows quarantined with shared-
+                 block refcounts balanced (nothing leaks, nothing lost)
 """
 
 import dataclasses
@@ -40,7 +43,7 @@ from tpu_patterns.faults import (
     run_cell_attempts,
 )
 
-from test_serve import CFG, _decoder_and_params, _mesh, _trace
+from test_serve import CFG, Request, _decoder_and_params, _mesh, _trace
 
 
 @pytest.fixture(autouse=True)
@@ -684,6 +687,60 @@ class TestServeSites:
             == before + 2
         )
         assert sorted(eng.free) == list(range(1, dec.layout.n_blocks))
+
+    def test_verify_transient_error_retries_ids_exact(self, devices):
+        # the speculative wide step has its own site: a transient error
+        # retries under the serve policy and the committed stream stays
+        # bit-identical to plain decode
+        from tpu_patterns.serve import ServeEngine
+
+        _, _, dec, params, _ = self._engine_bits(devices)
+        reqs = _trace(3, n_gen=4)
+        want = ServeEngine(dec, params, slots=2).run(
+            [dataclasses.replace(r) for r in reqs]
+        )
+        faults.configure("serve.verify:error:count=1")
+        before = _counter_value(
+            "tpu_patterns_faults_retries_total", site="serve.verify"
+        )
+        eng = ServeEngine(dec, params, slots=2, spec_k=3,
+                          retry_policy=_fast_policy())
+        got = eng.run([dataclasses.replace(r) for r in reqs])
+        assert got == want and not eng.failed
+        assert (
+            _counter_value("tpu_patterns_faults_retries_total",
+                           site="serve.verify")
+            == before + 1
+        )
+
+    def test_verify_deterministic_error_quarantines_and_balances_refs(
+        self, devices
+    ):
+        # chaos-smoke's contract, in process: a deterministic verify
+        # failure under sharing + speculation quarantines the rows (no
+        # request lost) and the shared blocks' refcounts still balance
+        from tpu_patterns.serve import ServeEngine
+
+        _, _, dec, params, _ = self._engine_bits(devices, n_blocks=17)
+        rng = np.random.RandomState(5)
+        shared = rng.randint(0, 64, 16).tolist()
+        reqs = [
+            Request(rid=i,
+                    tokens=shared + rng.randint(0, 64, 3).tolist(),
+                    n_gen=4)
+            for i in range(3)
+        ]
+        faults.configure("serve.verify:error:count=99")
+        eng = ServeEngine(dec, params, slots=3, prefix_share=True,
+                          spec_k=3, retry_policy=_fast_policy())
+        got = eng.run([dataclasses.replace(r) for r in reqs])
+        assert got == {}
+        assert sorted(eng.failed) == [0, 1, 2]  # nothing silently lost
+        assert all("after retries" in v for v in eng.failed.values())
+        # refcounts balanced: every shared block came home exactly once
+        assert eng.leaked_blocks() == 0 and not eng.ref
+        assert sorted(eng.free) == list(range(1, dec.layout.n_blocks))
+        assert len(eng.index) == 0
 
     def test_preempt_snapshots_and_resume_is_bit_identical(
         self, devices, tmp_path

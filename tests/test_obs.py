@@ -297,6 +297,40 @@ class TestMetrics:
         )
         assert h.count == 1
 
+    def test_pr7_serve_metric_names_export_cleanly(self):
+        # the prefix-sharing / speculative-decoding series: counters
+        # carry the _total suffix, the histogram exports bucket/sum/
+        # count triplets, and everything shares the tpu_patterns_ glob
+        reg = obs_metrics.Registry()
+        reg.counter("tpu_patterns_serve_prefix_hit_blocks_total").inc(4)
+        reg.counter("tpu_patterns_serve_cow_copies_total").inc()
+        h = reg.histogram("tpu_patterns_serve_spec_accepted_tokens")
+        h.observe(1)
+        h.observe(5)
+        text = reg.to_prom_text()
+        assert (
+            "# TYPE tpu_patterns_serve_prefix_hit_blocks_total counter"
+            in text
+        )
+        assert (
+            "# TYPE tpu_patterns_serve_cow_copies_total counter" in text
+        )
+        assert (
+            "# TYPE tpu_patterns_serve_spec_accepted_tokens histogram"
+            in text
+        )
+        samples = obs.parse_prom_text(text)
+        assert samples[
+            ("tpu_patterns_serve_prefix_hit_blocks_total", ())
+        ] == 4
+        assert samples[("tpu_patterns_serve_cow_copies_total", ())] == 1
+        assert samples[
+            ("tpu_patterns_serve_spec_accepted_tokens_count", ())
+        ] == 2
+        assert samples[
+            ("tpu_patterns_serve_spec_accepted_tokens_sum", ())
+        ] == 6
+
 
 class TestChromeTrace:
     def test_schema_and_ordering(self, tmp_path):

@@ -1,6 +1,8 @@
 """Continuous-batching serve engine over the paged KV cache (serve/):
 layout math, token exactness vs per-request dense decode, int8 parity,
-admission/deferral scheduling, pool donation, and memory scaling."""
+admission/deferral scheduling, pool donation, memory scaling, CoW
+prefix sharing (radix index, refcount invariants, boundary copies), and
+self-drafting speculative decoding (wide-step exactness, acceptance)."""
 
 import dataclasses
 
@@ -13,6 +15,7 @@ from jax.sharding import Mesh
 from tpu_patterns.models.lm import init_lm_params, make_lm_decoder
 from tpu_patterns.models.transformer import ModelConfig, _n_experts
 from tpu_patterns.serve import (
+    PrefixIndex,
     Request,
     ServeConfig,
     ServeEngine,
@@ -420,6 +423,379 @@ class TestMemoryScaling:
         d_pool = sizes[17]["pool_bytes"] - sizes[9]["pool_bytes"]
         assert d_pool > 0
         assert d_arg == pytest.approx(d_pool)
+
+
+def _shared_reqs(n, s_len, max_sfx, n_gen=6, seed=2, vocab=VOCAB):
+    """n requests whose prompts open with the same s_len tokens."""
+    rng = np.random.RandomState(seed)
+    shared = rng.randint(0, vocab, s_len).tolist()
+    return [
+        Request(
+            rid=i,
+            tokens=shared + rng.randint(
+                0, vocab, size=rng.randint(1, max_sfx + 1)
+            ).tolist(),
+            n_gen=n_gen,
+        )
+        for i in range(n)
+    ]
+
+
+def _assert_block_invariants(eng):
+    """The refcount contract: every allocated block is referenced by
+    exactly ref[b] live tables, the trash block is never counted or
+    freed, and the index only describes live blocks."""
+    from collections import Counter
+
+    live = Counter(
+        b for s in eng.active for b in s.table if b != TRASH_BLOCK
+    )
+    assert dict(eng.ref) == dict(live)
+    assert TRASH_BLOCK not in eng.ref and TRASH_BLOCK not in eng.free
+    allocated = set(range(1, eng.layout.n_blocks)) - set(eng.free)
+    assert allocated == set(live)
+    if eng.index is not None:
+        assert eng.index.blocks() <= set(live)
+
+
+class TestPrefixIndex:
+    def test_plan_aliases_full_blocks_and_finds_boundary_donor(self):
+        idx = PrefixIndex(block_len=4)
+        toks = list(range(10))  # blocks (0..3), (4..7); 8,9 partial
+        assert idx.insert(toks, [5, 6, 7]) == [5, 6]  # partial not indexed
+        idx.materialize([5, 6])
+        # full two-block match + 2-token boundary overlap into block 6's
+        # sibling?  no sibling: donor must come from an indexed child
+        plan = idx.plan(list(range(8)) + [99, 98])
+        assert plan.aliased == (5, 6) and plan.donor is None
+        # a second prompt diverging INSIDE block 2 gets block 6 as donor
+        plan = idx.plan(list(range(6)) + [99, 98])
+        assert plan.aliased == (5,)
+        assert plan.donor == 6 and plan.donor_len == 2
+        assert plan.shared_len(4) == 6
+
+    def test_unmaterialized_children_never_donate(self):
+        idx = PrefixIndex(block_len=4)
+        idx.insert(list(range(8)), [3, 4])
+        plan = idx.plan(list(range(6)) + [99, 98])
+        assert plan.aliased == (3,)  # same-wave full alias is fine
+        assert plan.donor is None  # but an unwritten block cannot copy
+        idx.materialize([4])
+        assert idx.plan(list(range(6)) + [99, 98]).donor == 4
+
+    def test_remove_block_prunes_exactly(self):
+        idx = PrefixIndex(block_len=2)
+        idx.insert([1, 2, 3, 4, 5, 6], [7, 8, 9])
+        assert idx.blocks() == {7, 8, 9}
+        idx.remove_block(8)  # parent may go before its child
+        idx.remove_block(9)
+        assert idx.blocks() == {7}
+        assert idx.plan([1, 2, 3, 4]).aliased == (7,)
+        idx.remove_block(7)
+        assert len(idx) == 0 and idx.plan([1, 2]).aliased == ()
+
+    def test_state_round_trip_is_exact(self):
+        idx = PrefixIndex(block_len=2)
+        idx.insert([1, 2, 3, 4], [5, 6])
+        idx.insert([1, 2, 9, 9, 4, 4], [5, 7, 8])
+        idx.materialize([5, 7])
+        back = PrefixIndex.from_state(2, idx.to_state())
+        assert back.to_state() == idx.to_state()
+        assert back.blocks() == idx.blocks()
+        assert back.plan([1, 2, 9, 9]).aliased == (5, 7)
+        # block 6 never materialized: the flag survives the round trip,
+        # so it still cannot donate a boundary copy
+        assert back.plan([1, 2, 3, 3]).donor is None
+
+
+class TestPrefixSharing:
+    """The CoW radix cache: shared-prefix traces must save blocks and
+    change NOTHING about any request's tokens."""
+
+    def test_shared_trace_saves_blocks_ids_exact(self, devices):
+        mesh = _mesh(devices, (1, 4, 2))
+        mcfg = ModelConfig(**CFG, depth=2, rope=True)
+        # pool big enough that the non-shared baseline never defers:
+        # the contrast is allocation, not scheduling
+        dec, params, flat = _decoder_and_params(
+            mesh, mcfg, n_blocks=33, block_len=8, max_len=40
+        )
+        reqs = _shared_reqs(8, s_len=16, max_sfx=5)
+        plain = ServeEngine(dec, params, slots=8)
+        want = plain.run([dataclasses.replace(r) for r in reqs])
+        eng = ServeEngine(dec, params, slots=8, prefix_share=True)
+        got = eng.run([dataclasses.replace(r) for r in reqs])
+        assert got == want
+        for r in reqs:  # and the engine-independent oracle agrees
+            dense = _dense_ids(mesh, mcfg, flat, r, lpd=24, gen_cap=8)
+            assert got[r.rid] == dense[: r.n_gen], f"rid {r.rid}"
+        peak_s, peak_p = (
+            eng.stats["peak_blocks"], plain.stats["peak_blocks"]
+        )
+        assert peak_s < peak_p
+        # 2 shared blocks x 7 aliasing rows over 8 x 3-4 blocks >= 30%
+        assert 1 - peak_s / peak_p >= 0.3
+        assert eng.stats["prefix_hit_blocks"] > 0
+        assert sorted(eng.free) == list(range(1, 33))
+        assert not eng.ref and len(eng.index) == 0
+
+    def test_cow_boundary_copy_ids_exact(self, devices):
+        mesh = _mesh(devices, (1, 4, 2))
+        mcfg = ModelConfig(**CFG, depth=2, rope=True)
+        dec, params, flat = _decoder_and_params(
+            mesh, mcfg, n_blocks=25, block_len=8, max_len=40
+        )
+        rng = np.random.RandomState(7)
+        base = rng.randint(0, VOCAB, 24).tolist()  # 3 full blocks
+        reqs = [
+            # long-lived donor: still active when later waves admit
+            Request(rid=0, tokens=list(base), n_gen=12),
+            Request(rid=1, tokens=base[:8] + [9, 9], n_gen=2),
+            # wave 2: diverges INSIDE block 3 -> boundary CoW copy
+            Request(rid=2, tokens=base[:20] + [1, 2, 3], n_gen=4),
+            # wave 3: exact 2-block prefix; decode extends a private block
+            Request(rid=3, tokens=base[:16], n_gen=4),
+        ]
+        want = ServeEngine(dec, params, slots=2).run(
+            [dataclasses.replace(r) for r in reqs]
+        )
+        eng = ServeEngine(dec, params, slots=2, prefix_share=True)
+        got = eng.run([dataclasses.replace(r) for r in reqs])
+        assert got == want
+        assert eng.stats["cow_copies"] >= 1
+        assert eng.stats["prefix_hit_blocks"] > 0
+        assert sorted(eng.free) == list(range(1, 25))
+
+    def test_sharing_admits_where_rectangles_defer(self, devices):
+        """The shared-aware admission satellite: a second shared-prefix
+        request whose FULL rectangle exceeds the free list must admit
+        immediately by aliasing, where the rectangle count deferred."""
+        mesh = _mesh(devices, (1, 1, 1))
+        mcfg = ModelConfig(**CFG, depth=1)
+        # 7 allocatable blocks; each request's RECTANGLE is 4 blocks
+        # (prompt 22/23 + gen 4 - 1 -> span 25/26 over block_len 8)
+        dec, params, _ = _decoder_and_params(
+            mesh, mcfg, n_blocks=8, block_len=8, max_len=32
+        )
+        rng = np.random.RandomState(2)
+        shared = rng.randint(0, VOCAB, 16).tolist()  # 2 full blocks
+        reqs = [
+            Request(rid=0, tokens=shared + rng.randint(0, VOCAB, 6).tolist(),
+                    n_gen=4),
+            Request(rid=1, tokens=shared + rng.randint(0, VOCAB, 7).tolist(),
+                    n_gen=4),
+        ]
+        # rectangles: 4 + 4 = 8 > 7 free -> the plain engine defers
+        plain = ServeEngine(dec, params, slots=2)
+        plain.run([dataclasses.replace(r) for r in reqs])
+        assert plain.stats["deferrals"] > 0
+        # sharing: request 2 aliases the 2 shared blocks -> 4 + 2 fit
+        eng = ServeEngine(dec, params, slots=2, prefix_share=True)
+        eng.run([dataclasses.replace(r) for r in reqs])
+        assert eng.stats["deferrals"] == 0
+        assert eng.stats["prefix_hit_blocks"] >= 2
+
+
+class TestRefcountInvariants:
+    """Property-style: after every scheduler iteration of a mixed
+    shared trace — and across quarantine and preempt/resume — the
+    refcounts exactly mirror live table references, the trash block is
+    never counted, and snapshots reproduce the index bit-for-bit."""
+
+    def _instrument(self, eng):
+        orig_retire = eng._retire
+
+        def retire_checked():
+            orig_retire()
+            _assert_block_invariants(eng)
+
+        eng._retire = retire_checked
+
+    @pytest.mark.parametrize("spec_k", [0, 3])
+    def test_invariants_hold_through_mixed_traces(self, devices, spec_k):
+        mesh = _mesh(devices, (1, 1, 1))
+        mcfg = ModelConfig(**CFG, depth=1)
+        dec, params, _ = _decoder_and_params(
+            mesh, mcfg, n_blocks=13, block_len=8, max_len=40
+        )
+        reqs = _shared_reqs(6, s_len=16, max_sfx=5, n_gen=5) + _trace(
+            2, n_gen=3, seed=9
+        )
+        for i, r in enumerate(reqs):
+            r.rid = i
+        eng = ServeEngine(
+            dec, params, slots=3, prefix_share=True, spec_k=spec_k
+        )
+        self._instrument(eng)
+        got = eng.run([dataclasses.replace(r) for r in reqs])
+        assert sorted(got) == list(range(len(reqs)))
+        _assert_block_invariants(eng)
+        assert not eng.ref and sorted(eng.free) == list(range(1, 13))
+
+    def test_preempt_resume_reproduces_index_and_ids(
+        self, devices, tmp_path
+    ):
+        from tpu_patterns import faults
+
+        mesh = _mesh(devices, (1, 1, 1))
+        mcfg = ModelConfig(**CFG, depth=1)
+        dec, params, _ = _decoder_and_params(
+            mesh, mcfg, n_blocks=17, block_len=8, max_len=40
+        )
+        reqs = _shared_reqs(5, s_len=16, max_sfx=5, n_gen=6)
+        want = ServeEngine(dec, params, slots=3, prefix_share=True).run(
+            [dataclasses.replace(r) for r in reqs]
+        )
+        snap = str(tmp_path / "snap")
+        try:
+            faults.configure("serve.step:preempt:after=2:count=1")
+            eng = ServeEngine(
+                dec, params, slots=3, prefix_share=True,
+                snapshot_dir=snap, fingerprint={"t": "idx"},
+            )
+            eng.run([dataclasses.replace(r) for r in reqs])
+            assert eng.preempted_at is not None
+            _assert_block_invariants(eng)
+            assert len(eng.index) > 0  # shared blocks were in flight
+        finally:
+            faults.configure("")
+        eng2 = ServeEngine(
+            dec, params, slots=3, prefix_share=True,
+            snapshot_dir=snap, fingerprint={"t": "idx"},
+        )
+        eng2.restore_snapshot()
+        # the exact index: same tree, same blocks, same flags
+        assert eng2.index.to_state() == eng.index.to_state()
+        assert eng2.ref == eng.ref
+        _assert_block_invariants(eng2)
+        got = eng2.run([])
+        assert got == want  # rides the exactness-after-resume gate
+
+
+class TestSpecDecode:
+    """Self-drafting speculative decoding: the wide verify step may
+    only change how many tokens a step commits, never which ones."""
+
+    def test_draft_is_prompt_lookup(self):
+        d = ServeEngine._draft
+        # trailing 2-gram (7, 8) last seen at position 1 -> continue 9, 5
+        assert d([3, 7, 8, 9, 5, 7, 8], 2) == [9, 5]
+        assert d([3, 7, 8, 9, 5, 7, 8], 4) == [9, 5, 7, 8]
+        # a period-1 loop: the most recent 3-gram match sits one token
+        # from the end, so exactly that one continuation is proposed
+        assert d([1, 1, 1, 1], 3) == [1]
+        assert d([2, 1, 2, 1, 2, 1], 3) == [2, 1]  # period-2 tail
+        assert d([1, 2, 3, 4], 3) == []  # nothing repeats
+        assert d([5], 3) == []  # too short to match
+
+    @pytest.mark.parametrize(
+        "shape,kv,int8",
+        [((1, 1, 1), 0, False), ((1, 4, 2), 0, False),
+         ((1, 4, 2), 0, True), ((1, 2, 4), 4, False)],  # GQA over tp=4
+    )
+    def test_spec_ids_bit_identical_to_plain_and_dense(
+        self, devices, shape, kv, int8
+    ):
+        mesh = _mesh(devices, shape)
+        mcfg = ModelConfig(**CFG, depth=2, rope=True, kv_heads=kv)
+        dec, params, flat = _decoder_and_params(
+            mesh, mcfg, cache_int8=int8
+        )
+        # repetitive prompts: drafts fire, acceptance is real
+        rng = np.random.RandomState(4)
+        reqs = [
+            Request(
+                rid=i,
+                tokens=(rng.randint(0, VOCAB, 3).tolist() * 7)[
+                    : int(rng.randint(6, 19))
+                ],
+                n_gen=8,
+            )
+            for i in range(5)
+        ]
+        want = ServeEngine(dec, params, slots=3).run(
+            [dataclasses.replace(r) for r in reqs]
+        )
+        eng = ServeEngine(dec, params, slots=3, spec_k=4)
+        got = eng.run([dataclasses.replace(r) for r in reqs])
+        assert got == want
+        for r in reqs:
+            dense = _dense_ids(
+                mesh, mcfg, flat, r, lpd=20, gen_cap=8, cache_int8=int8
+            )
+            assert got[r.rid] == dense[: r.n_gen], f"rid {r.rid}"
+        assert eng.stats["spec_steps"] > 0
+        # fewer scheduler steps than tokens: speculation really batched
+        assert eng.stats["spec_tokens"] > eng.stats["spec_row_steps"]
+
+    def test_random_trace_degenerates_to_plain_exactly(self, devices):
+        # near-zero acceptance: every step must still commit >= 1 token
+        # and the stream must stay identical to plain decode
+        mesh = _mesh(devices, (1, 1, 1))
+        mcfg = ModelConfig(**CFG, depth=1)
+        dec, params, _ = _decoder_and_params(mesh, mcfg)
+        reqs = _trace(4, n_gen=6)
+        want = ServeEngine(dec, params, slots=2).run(
+            [dataclasses.replace(r) for r in reqs]
+        )
+        eng = ServeEngine(dec, params, slots=2, spec_k=4)
+        got = eng.run([dataclasses.replace(r) for r in reqs])
+        assert got == want
+
+    def test_spec_metrics_reach_the_registry(self, devices):
+        from tpu_patterns import obs
+
+        mesh = _mesh(devices, (1, 1, 1))
+        mcfg = ModelConfig(**CFG, depth=1)
+        dec, params, _ = _decoder_and_params(mesh, mcfg)
+        h = obs.histogram("tpu_patterns_serve_spec_accepted_tokens")
+        before = h.count
+        eng = ServeEngine(dec, params, slots=2, spec_k=3)
+        eng.run([dataclasses.replace(r) for r in _trace(2, n_gen=4)])
+        assert h.count > before
+        assert h.sum >= h.count  # every observation commits >= 1 token
+
+
+class TestRunServePrefixSpec:
+    def test_both_records_succeed_on_the_smoke_shape(self, devices):
+        from tpu_patterns.core.results import ResultWriter
+
+        mesh = _mesh(devices, (1, 8, 1))
+        cfg = ServeConfig(
+            vocab=VOCAB, embed=64, head_dim=8, depth=1, requests=8,
+            min_prompt=4, max_prompt=24, gen=6, slots=8, block_len=8,
+            shared_prefix=16, prefix_share=True, spec_k=4,
+        )
+        writer = ResultWriter()
+        pre, spec = run_serve(mesh, cfg, writer)
+        assert pre.verdict.value == "SUCCESS", pre.notes
+        assert pre.metrics["exact"] == 1.0
+        assert pre.metrics["block_savings"] >= 0.3
+        assert (
+            pre.metrics["prefix_pool_MB"]
+            < pre.metrics["nonshared_pool_MB"]
+        )
+        assert spec.verdict.value == "SUCCESS", spec.notes
+        assert spec.metrics["exact"] == 1.0
+        assert spec.metrics["accepted_tokens_per_step"] > 1.0
+
+    def test_sharing_counters_reach_the_registry(self, devices):
+        from tpu_patterns import obs
+
+        mesh = _mesh(devices, (1, 1, 1))
+        mcfg = ModelConfig(**CFG, depth=1)
+        dec, params, _ = _decoder_and_params(
+            mesh, mcfg, n_blocks=33, block_len=8, max_len=40
+        )
+        hits = obs.counter("tpu_patterns_serve_prefix_hit_blocks_total")
+        before = hits.value
+        eng = ServeEngine(dec, params, slots=4, prefix_share=True)
+        eng.run(
+            [dataclasses.replace(r)
+             for r in _shared_reqs(4, s_len=16, max_sfx=4, n_gen=3)]
+        )
+        assert hits.value > before
+        assert hits.value - before == eng.stats["prefix_hit_blocks"]
 
 
 class TestRunServe:

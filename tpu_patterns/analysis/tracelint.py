@@ -86,6 +86,7 @@ def _paged_decoder():
         params, pool,
         jnp.zeros((rows, lpad), jnp.int32),
         jnp.asarray([3, 2], jnp.int32),
+        jnp.zeros((rows,), jnp.int32),  # prefix-share write fence
         jnp.asarray([[1, 0, 0], [2, 0, 0]], jnp.int32),
         jnp.ones((rows,), bool),
     )

@@ -28,6 +28,10 @@ and docs/robustness.md):
   serve.prefill  serve/engine.py, before each prefill's compiled call
                  (``error`` retries; exhaustion quarantines exactly the
                  admitted rows with a per-request verdict)
+  serve.verify   serve/engine.py, before each SPECULATIVE wide step's
+                 compiled call (``spec_k > 0`` replaces serve.step with
+                 this site; same recovery contract — retries, then
+                 quarantine with shared-block refcounts released)
 """
 
 from tpu_patterns.faults.injector import (  # noqa: F401

@@ -70,7 +70,7 @@ ACTIONS = frozenset(
 # is a typo that would silently inject nothing, so parse_spec rejects it
 KNOWN_SITES = frozenset({
     "worker.ready", "cell.run", "ckpt.save", "ckpt.restore",
-    "train.step", "serve.prefill", "serve.step",
+    "train.step", "serve.prefill", "serve.step", "serve.verify",
 })
 
 # ctx keys the call sites actually pass — the only keys a match

@@ -1,9 +1,15 @@
 """serve/ — continuous-batching decode over a paged KV cache.
 
   paged.py   block pool + per-sequence block tables; compiled
-             (prefill, step) cores with the pool donated in place
+             (prefill, step, verify, copy) cores with the pool donated
+             in place
+  prefix.py  host-side radix index over admitted prompts — the CoW
+             block-sharing planner (alias whole-block matches, copy
+             the partial boundary block)
   engine.py  iteration-level scheduler (admit / prefill / step /
-             retire / defer) + the ``serve`` measured pattern
+             retire / defer) with refcounted CoW prefix sharing and
+             self-drafting speculative decoding + the ``serve``
+             measured patterns
 
 See docs/serving.md for the layout diagram, scheduler states, and how
 to read the verdict Records.
@@ -20,4 +26,8 @@ from tpu_patterns.serve.paged import (  # noqa: F401
     PagedLayout,
     TRASH_BLOCK,
     make_paged_lm_decoder,
+)
+from tpu_patterns.serve.prefix import (  # noqa: F401
+    PrefixIndex,
+    SharePlan,
 )

@@ -4,15 +4,26 @@ paged pool.
 The host loop owns what the compiled cores cannot: the request queue,
 the slot table, and the block free-list.  Each iteration it
 
-  1. RETIRES finished rows (their blocks go back to the pool),
+  1. RETIRES finished rows (their block REFERENCES return to the pool —
+     a block frees when its last referencing row retires),
   2. ADMITS queued requests into freed slots — deferring, never OOMing,
      when the pool cannot cover a request's whole lifetime
      (``ceil((prompt + gen - 1) / block_len)`` blocks, reserved at
-     admission so a mid-flight row can never strand),
-  3. PREFILLS the newcomers as one bucketed call (ragged lens), and
+     admission so a mid-flight row can never strand; with prefix
+     sharing on, fully-indexed prompt blocks ALIAS instead of
+     allocating, and a partial boundary match claims one fresh block
+     for a copy-on-write clone — serve/prefix.py),
+  3. PREFILLS the newcomers as one bucketed call (ragged lens; shared
+     positions sit behind a per-row write fence and are read, never
+     rewritten), and
   4. runs ONE decode step for the whole active set — per-row positions,
      so a row admitted at iteration 40 decodes beside one admitted at
-     iteration 0 (the Orca iteration-level property).
+     iteration 0 (the Orca iteration-level property).  With
+     ``spec_k > 0`` the step is the speculative WIDE step: a
+     prompt-lookup drafter proposes up to k tokens per row, one call
+     verifies all of them, and the longest accepted prefix commits —
+     acceptance is the greedy-ids check itself, so the committed
+     stream is bit-identical to plain decode by construction.
 
 Compiled shapes are bucketed (active rows to the next power of two,
 prompt lengths likewise), so steady-state serving re-dispatches a small
@@ -34,8 +45,12 @@ import numpy as np
 from tpu_patterns import ckpt, faults
 from tpu_patterns.core.timing import clock_ns
 from tpu_patterns.serve.paged import TRASH_BLOCK, make_paged_lm_decoder
+from tpu_patterns.serve.prefix import PrefixIndex
 
-SNAPSHOT_FORMAT = 1
+# format 2: per-block refcounts, the prefix index, and slot prompts
+# joined the host-side state (PR 7) — older snapshots lack them and are
+# rejected loudly rather than resumed with silently-absent sharing state
+SNAPSHOT_FORMAT = 2
 
 
 def _bucket(n: int, cap: int) -> int:
@@ -60,6 +75,9 @@ class _Slot:
     last_tok: int
     out: list[int]
     t_submit_ns: int
+    prompt: list[int]  # kept live: drafter context + index bookkeeping
+    write_from: int = 0  # prefix-share write fence (prefill-transient)
+    own_blocks: tuple[int, ...] = ()  # blocks this row newly indexed
 
 
 class ServeEngine:
@@ -73,9 +91,12 @@ class ServeEngine:
 
     def __init__(self, decoder, params, *, slots: int,
                  watchdog_s: float = 0.0, snapshot_dir: str | None = None,
-                 retry_policy=None, fingerprint=None):
+                 retry_policy=None, fingerprint=None,
+                 prefix_share: bool = False, spec_k: int = 0):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
         self.decoder = decoder
         self.params = params
         self.slots = slots
@@ -85,6 +106,20 @@ class ServeEngine:
         self.pool = decoder.init_pool()
         # block 0 is the trash block: never handed out
         self.free = list(range(self.layout.n_blocks - 1, TRASH_BLOCK, -1))
+        # per-block refcount: #live row tables mapping the block.  Every
+        # allocation (shared or not) is counted, so free is uniformly
+        # "last reference retired" and sum(ref.values()) always equals
+        # the live table references — the invariant the property tests
+        # pin.  TRASH_BLOCK never appears here.
+        self.ref: dict[int, int] = {}
+        # copy-on-write prefix sharing over admitted prompts
+        self.prefix_share = prefix_share
+        self.index = PrefixIndex(self.layout.block_len) if prefix_share \
+            else None
+        self._pending_cow: list[tuple[int, int]] = []  # (src, dst)
+        # self-drafting speculative decoding: propose up to spec_k
+        # tokens per row per step, verify all of them in ONE wide call
+        self.spec_k = spec_k
         self.queue: list[tuple[Request, int]] = []  # (request, t_submit)
         self.active: list[_Slot] = []
         self.done: dict[int, list[int]] = {}
@@ -94,6 +129,8 @@ class ServeEngine:
         self.stats = {
             "steps": 0, "prefills": 0, "deferrals": 0, "tokens": 0,
             "max_occupancy": 0.0, "queue_wait_ns": [],
+            "peak_blocks": 0, "prefix_hit_blocks": 0, "cow_copies": 0,
+            "spec_steps": 0, "spec_row_steps": 0, "spec_tokens": 0,
         }
         # preemption safety: SIGTERM/SIGINT (or an injected ``preempt``)
         # sets the event; the loop finishes the current decode step,
@@ -138,15 +175,40 @@ class ServeEngine:
         alloc = self.layout.n_blocks - 1 - len(self.free)
         return alloc / (self.layout.n_blocks - 1)
 
+    def allocated_blocks(self) -> int:
+        return self.layout.n_blocks - 1 - len(self.free)
+
+    def leaked_blocks(self) -> int:
+        """Allocated blocks no live table references — 0 unless the
+        refcount bookkeeping broke (the chaos smoke gates on this)."""
+        live = sum(
+            1 for s in self.active for b in s.table if b != TRASH_BLOCK
+        )
+        return self.allocated_blocks() - live
+
+    def _release_block(self, b: int) -> None:
+        """Drop one table reference; the LAST reference frees the block
+        and (with sharing on) retires its index node — the index never
+        outlives the live shareable set."""
+        if b == TRASH_BLOCK:
+            return
+        n = self.ref.get(b, 0) - 1
+        if n > 0:
+            self.ref[b] = n
+            return
+        self.ref.pop(b, None)
+        if self.index is not None:
+            self.index.remove_block(b)
+        self.free.append(b)
+
     def _retire(self) -> None:
         from tpu_patterns import obs
 
         still = []
         for s in self.active:
             if len(s.out) >= s.n_gen:
-                self.free.extend(
-                    b for b in s.table if b != TRASH_BLOCK
-                )
+                for b in s.table:
+                    self._release_block(b)
                 self.done[s.rid] = s.out
                 obs.counter("tpu_patterns_serve_requests_total").inc()
             else:
@@ -157,23 +219,68 @@ class ServeEngine:
         """Pull queued requests into free slots while blocks last; a
         request the pool cannot cover right now DEFERS (stays queued, a
         deferral counted) instead of overcommitting — pool exhaustion is
-        a scheduling state, not an OOM."""
+        a scheduling state, not an OOM.
+
+        With prefix sharing on, admission is SHARED-AWARE: the prompt's
+        fully-indexed prefix blocks alias existing physical blocks
+        (refcount + 1, no allocation), a partial boundary match claims
+        one fresh block to CoW-copy the donor into, and only the
+        remainder draws on the free list — so a shareable request
+        admits where its full rectangle would have deferred."""
         from tpu_patterns import obs
 
         admitted: list[tuple[Request, _Slot]] = []
         while self.queue and len(self.active) + len(admitted) < self.slots:
             req, t_submit = self.queue[0]
             need = self._blocks_needed(req)
-            if need > len(self.free):
+            plan = (
+                self.index.plan(req.tokens)
+                if self.index is not None
+                else None
+            )
+            aliased = list(plan.aliased) if plan else []
+            # the plan can never cover more blocks than the lifetime
+            # needs (index depth <= prompt blocks <= need), but clamp
+            # defensively: aliasing MORE than the table would hold ref
+            # counts no table row ever releases
+            aliased = aliased[:need]
+            if need - len(aliased) > len(self.free):
                 self.stats["deferrals"] += 1
                 obs.counter("tpu_patterns_serve_deferrals_total").inc()
                 break  # FIFO: later (smaller) requests must not starve it
             self.queue.pop(0)
-            table = [self.free.pop() for _ in range(need)]
+            fresh = [
+                self.free.pop() for _ in range(need - len(aliased))
+            ]
+            table = aliased + fresh
+            for b in aliased:
+                self.ref[b] = self.ref.get(b, 0) + 1
+            for b in fresh:
+                self.ref[b] = 1
+            write_from = len(aliased) * self.layout.block_len
+            if plan and plan.donor is not None and fresh:
+                # CoW: the boundary block copies the donor, then this
+                # row overwrites its private tail from the split point
+                self._pending_cow.append((plan.donor, fresh[0]))
+                write_from += plan.donor_len
+                self.stats["cow_copies"] += 1
+                obs.counter("tpu_patterns_serve_cow_copies_total").inc()
+            if aliased:
+                self.stats["prefix_hit_blocks"] += len(aliased)
+                obs.counter(
+                    "tpu_patterns_serve_prefix_hit_blocks_total"
+                ).inc(len(aliased))
+            own_blocks: tuple[int, ...] = ()
+            if self.index is not None:
+                own_blocks = tuple(
+                    self.index.insert(req.tokens, table)
+                )
             slot = _Slot(
                 rid=req.rid, lens=len(req.tokens), steps=0,
                 n_gen=req.n_gen, table=table, last_tok=-1, out=[],
-                t_submit_ns=t_submit,
+                t_submit_ns=t_submit, prompt=list(req.tokens),
+                write_from=min(write_from, len(req.tokens)),
+                own_blocks=own_blocks,
             )
             wait_ns = clock_ns() - t_submit
             self.stats["queue_wait_ns"].append(wait_ns)
@@ -191,6 +298,20 @@ class ServeEngine:
 
     # -- compiled-call assembly ------------------------------------------
 
+    def _cow_copy(self) -> None:
+        """Flush pending copy-on-write boundary copies in one compiled
+        call (padded to a power-of-two lane count with TRASH self-
+        copies).  Idempotent: a retried prefill re-copies the same
+        donor blocks before rewriting the same private tails."""
+        if not self._pending_cow:
+            return
+        n = _bucket(len(self._pending_cow), max(self.slots, 1))
+        src = np.full((n,), TRASH_BLOCK, np.int32)
+        dst = np.full((n,), TRASH_BLOCK, np.int32)
+        for i, (s, d) in enumerate(self._pending_cow):
+            src[i], dst[i] = s, d
+        self.pool = self.decoder.copy_jit(n)(self.pool, src, dst)
+
     def _prefill(self, admitted: list[tuple[Request, _Slot]]) -> None:
         from tpu_patterns import obs
 
@@ -201,10 +322,12 @@ class ServeEngine:
         rows = _bucket(len(reqs), self.slots)
         tokens = np.zeros((rows, lpad), np.int32)
         lens = np.zeros((rows,), np.int32)
+        start = np.zeros((rows,), np.int32)
         active = np.zeros((rows,), bool)
         for i, r in enumerate(reqs):
             tokens[i, : len(r.tokens)] = r.tokens
             lens[i] = len(r.tokens)
+            start[i] = slots[i].write_from
             active[i] = True
         tables = self._tables_array(slots, rows)
         fn = self.decoder.prefill_jit(rows, lpad)
@@ -217,18 +340,25 @@ class ServeEngine:
             deadline_s=self.watchdog_s or None,
             rows=len(reqs), lpad=lpad,
         ):
+            self._cow_copy()
             self.pool, tok0 = fn(
-                self.params, self.pool, tokens, lens, tables, active
+                self.params, self.pool, tokens, lens, start, tables,
+                active,
             )
             # graftlint: allow[host-sync-in-hot-path] -- the scheduler's ONE designed sync per iteration: sampled ids must reach the host to retire/admit
             tok0 = np.asarray(tok0)
         obs.histogram("tpu_patterns_serve_prefill_ms").observe(
             (clock_ns() - t0) / 1e6
         )
+        self._pending_cow = []
         for i, s in enumerate(slots):
             s.last_tok = int(tok0[i])
             s.out.append(s.last_tok)
+            s.write_from = 0  # fence spent: the wave is on device
             self.stats["tokens"] += 1
+        if self.index is not None:
+            for s in slots:
+                self.index.materialize(list(s.own_blocks))
         obs.counter("tpu_patterns_serve_tokens_total").inc(len(slots))
         self.stats["prefills"] += 1
         self.active.extend(slots)
@@ -274,6 +404,110 @@ class ServeEngine:
         obs.counter("tpu_patterns_serve_tokens_total").inc(len(self.active))
         self.stats["steps"] += 1
 
+    # -- speculative decoding --------------------------------------------
+
+    @staticmethod
+    def _draft(ctx: list[int], k: int) -> list[int]:
+        """Prompt-lookup self-drafting: find the most recent earlier
+        occurrence of the context's trailing n-gram (n = 3, 2, 1) and
+        propose the tokens that followed it.  No model, no state — the
+        sequence drafts itself, which is exactly the regime (templated
+        prompts, greedy loops, retrieval echoes) where chat decoding
+        repeats.  An unmatched context proposes nothing and the step
+        degenerates to plain decode."""
+        for n in (3, 2, 1):
+            if len(ctx) <= n:
+                continue
+            pat = ctx[-n:]
+            first = pat[0]
+            # backward scan with a first-token fast reject: this runs
+            # per row per wide step on the scheduler hot loop, and the
+            # overwhelming majority of offsets fail on one comparison
+            for s in range(len(ctx) - n - 1, -1, -1):
+                if ctx[s] == first and ctx[s : s + n] == pat:
+                    # s + n <= len(ctx) - 1, so there is always at
+                    # least one continuation token to propose
+                    return ctx[s + n : s + n + k]
+        return []
+
+    def _verify_step(self) -> None:
+        """The speculative wide step: draft up to ``spec_k`` tokens per
+        row, verify all of them (plus the bonus position) in ONE
+        compiled call, and commit the longest accepted prefix.
+
+        Acceptance IS the greedy-ids gate: position i's output is the
+        greedy id the plain step would emit after committing tokens
+        0..i, so a draft survives exactly when it equals what the model
+        was going to say anyway — committed streams stay bit-identical
+        to plain decode, speculation only changes how many tokens each
+        step retires."""
+        from tpu_patterns import obs
+
+        w = self.spec_k + 1
+        rows = _bucket(len(self.active), self.slots)
+        toks = np.zeros((rows, w), np.int32)
+        lens = np.zeros((rows,), np.int32)
+        steps = np.zeros((rows,), np.int32)
+        n_draft = np.zeros((rows,), np.int32)
+        active = np.zeros((rows,), bool)
+        drafts: list[list[int]] = []
+        for i, s in enumerate(self.active):
+            # never draft past the row's reserved lifetime: the last
+            # generated token is returned, never fed, so at most
+            # remaining - 1 drafts can ever be verified
+            room = min(self.spec_k, s.n_gen - len(s.out) - 1)
+            d = self._draft(s.prompt + s.out, room) if room > 0 else []
+            drafts.append(d)
+            toks[i, 0] = s.last_tok
+            toks[i, 1 : 1 + len(d)] = d
+            lens[i], steps[i] = s.lens, s.steps
+            n_draft[i], active[i] = len(d), True
+        tables = self._tables_array(self.active, rows)
+        fn = self.decoder.verify_jit(rows, w)
+        # fault site: before the compiled call (state untouched, so
+        # ``error`` retries cleanly; exhaustion quarantines the active
+        # set with refcounts released, same contract as serve.step)
+        faults.inject("serve.verify", step=self.stats["steps"],
+                      rows=len(self.active))
+        t0 = clock_ns()
+        with obs.span(
+            "serve.verify",
+            deadline_s=self.watchdog_s or None,
+            rows=len(self.active), width=w,
+        ):
+            self.pool, out = fn(
+                self.params, self.pool, toks, lens, steps, n_draft,
+                tables, active,
+            )
+            # graftlint: allow[host-sync-in-hot-path] -- the scheduler's ONE designed sync per iteration: verified ids must reach the host to accept/retire/admit
+            out = np.asarray(out)
+        obs.histogram("tpu_patterns_serve_step_ms").observe(
+            (clock_ns() - t0) / 1e6
+        )
+        committed = 0
+        for i, s in enumerate(self.active):
+            d = drafts[i]
+            a = 0
+            while a < len(d) and d[a] == int(out[i, a]):
+                a += 1  # draft a+1 matched the model's position-a output
+            commit = [int(out[i, t]) for t in range(a + 1)]
+            commit = commit[: s.n_gen - len(s.out)]
+            s.out.extend(commit)
+            s.steps += len(commit)  # their K/V is in the pool
+            s.last_tok = s.out[-1]
+            committed += len(commit)
+            self.stats["tokens"] += len(commit)
+            obs.histogram(
+                "tpu_patterns_serve_spec_accepted_tokens"
+            ).observe(float(len(commit)))
+        obs.counter("tpu_patterns_serve_tokens_total").inc(committed)
+        self.stats["steps"] += 1
+        self.stats["spec_steps"] += 1
+        # per-ROW step count: commits / row_steps is directly comparable
+        # to plain decode's exactly-1 token per row per step
+        self.stats["spec_row_steps"] += len(self.active)
+        self.stats["spec_tokens"] += committed
+
     # -- recovery + preemption -------------------------------------------
 
     def _quarantine(self, slots: list[_Slot], reason: str) -> None:
@@ -282,8 +516,10 @@ class ServeEngine:
         deterministic compiled-call failure) must not sink the batch."""
         from tpu_patterns import obs
 
+        self._pending_cow = []  # never copy into blocks being freed
         for s in slots:
-            self.free.extend(b for b in s.table if b != TRASH_BLOCK)
+            for b in s.table:
+                self._release_block(b)
             self.failed[s.rid] = reason
             obs.counter("tpu_patterns_serve_quarantined_total").inc()
             obs.event("serve.quarantine", rid=str(s.rid), reason=reason)
@@ -342,10 +578,15 @@ class ServeEngine:
                     "rid": s.rid, "lens": s.lens, "steps": s.steps,
                     "n_gen": s.n_gen, "table": s.table,
                     "last_tok": s.last_tok, "out": s.out,
+                    "prompt": s.prompt,
                 }
                 for s in self.active
             ],
             "free": list(self.free),
+            "ref": {str(b): n for b, n in self.ref.items()},
+            "index": (
+                self.index.to_state() if self.index is not None else None
+            ),
             "done": {str(k): v for k, v in self.done.items()},
             "failed": {str(k): v for k, v in self.failed.items()},
             "stats": {
@@ -410,11 +651,16 @@ class ServeEngine:
                 rid=a["rid"], lens=a["lens"], steps=a["steps"],
                 n_gen=a["n_gen"], table=list(a["table"]),
                 last_tok=a["last_tok"], out=list(a["out"]),
-                t_submit_ns=now,
+                t_submit_ns=now, prompt=list(a["prompt"]),
             )
             for a in state["active"]
         ]
         self.free = list(state["free"])
+        self.ref = {int(b): int(n) for b, n in state["ref"].items()}
+        if self.index is not None and state.get("index") is not None:
+            self.index = PrefixIndex.from_state(
+                self.layout.block_len, state["index"]
+            )
         self.done = {int(k): v for k, v in state["done"].items()}
         self.failed = {int(k): v for k, v in state["failed"].items()}
         for k, v in state["stats"].items():
@@ -459,11 +705,19 @@ class ServeEngine:
                         else:
                             self._retire()  # n_gen == 1 finish at prefill
                     if self.active:
+                        # speculative decoding swaps the one-token step
+                        # for the drafted wide step, under its own
+                        # fault site with the same recovery contract
+                        step_fn, site = (
+                            (self._verify_step, "serve.verify")
+                            if self.spec_k
+                            else (self._step, "serve.step")
+                        )
                         try:
                             faults.call_with_retry(
-                                self._step,
+                                step_fn,
                                 policy=self.retry_policy,
-                                site="serve.step",
+                                site=site,
                             )
                         except (OSError, faults.Quarantined) as e:
                             casualties, self.active = self.active, []
@@ -471,6 +725,9 @@ class ServeEngine:
                                 casualties,
                                 f"decode step failed after retries: {e}",
                             )
+                    self.stats["peak_blocks"] = max(
+                        self.stats["peak_blocks"], self.allocated_blocks()
+                    )
                     occ = self._occupancy()
                     self.stats["max_occupancy"] = max(
                         self.stats["max_occupancy"], occ
@@ -523,6 +780,15 @@ class ServeConfig:
     min_speedup: float = 1.0  # continuous-vs-sequential gate
     watchdog_s: float = 0.0  # per-step watchdog deadline (0 = spans only)
     seed: int = 0
+    # prefix sharing (CoW radix cache): serve a shared-prefix trace with
+    # block aliasing on vs off and gate the allocated-block saving
+    prefix_share: bool = False
+    shared_prefix: int = 0  # common prompt-prefix tokens; 0 = auto (3/4)
+    min_block_savings: float = 0.3  # peak-block saving the Record gates
+    # speculative decoding: draft spec_k tokens/row (prompt-lookup) and
+    # verify them in one wide step; 0 = plain one-token decode
+    spec_k: int = 0
+    min_accepted: float = 1.0  # accepted-tokens/step gate (plain = 1.0)
     # preemption safety: with snapshot_dir set, SIGTERM/SIGINT mid-serve
     # finishes the current decode step, commits engine state there, and
     # exits with a WARNING Record; --resume restores the latest snapshot
@@ -578,7 +844,7 @@ def _serve_fingerprint(cfg: ServeConfig, n_blocks: int) -> dict:
     everything that shapes the pool, the trace, or the token stream."""
     fp = dataclasses.asdict(cfg)
     for k in ("snapshot_dir", "resume", "ids_out", "watchdog_s",
-              "min_speedup"):
+              "min_speedup", "min_block_savings", "min_accepted"):
         fp.pop(k, None)
     fp["n_blocks"] = n_blocks  # resolved, not the 0=auto sentinel
     return fp
@@ -600,6 +866,7 @@ def _run_preemptible(
         decoder, params, slots=cfg.slots, watchdog_s=cfg.watchdog_s,
         snapshot_dir=cfg.snapshot_dir,
         fingerprint=_serve_fingerprint(cfg, n_blocks),
+        prefix_share=cfg.prefix_share, spec_k=cfg.spec_k,
     )
     resumed_from = None
     if cfg.resume:
@@ -617,10 +884,7 @@ def _run_preemptible(
         ("resume" if cfg.resume else "preemptible")
         + f"_slots{cfg.slots}_sp{sp}"
     )
-    commands = (
-        f"req{cfg.requests} prompt{cfg.min_prompt}-{cfg.max_prompt} "
-        f"gen{cfg.gen} V{cfg.vocab} depth{cfg.depth} {cfg.dtype}"
-    )
+    commands = _serve_commands(cfg)
     if eng.preempted_at is not None:
         rec = Record(
             pattern="serve",
@@ -670,7 +934,7 @@ def _run_preemptible(
     ]
     obs.gauge("tpu_patterns_serve_exact").set(float(exact))
     verdict = Verdict.SUCCESS
-    if mismatched or unaccounted:
+    if mismatched or unaccounted or eng.leaked_blocks():
         verdict = Verdict.FAILURE
     elif eng.failed:
         verdict = Verdict.WARNING  # recovered, but not unscathed
@@ -688,6 +952,14 @@ def _run_preemptible(
             "decode_steps": float(eng.stats["steps"]),
             "tokens": float(eng.stats["tokens"]),
             "deferrals": float(eng.stats["deferrals"]),
+            # refcount hygiene: allocated blocks nobody references (must
+            # be 0 — quarantine and retire both release through the
+            # refcounts, shared blocks included; chaos smoke gates this)
+            "leaked_blocks": float(eng.leaked_blocks()),
+            "prefix_hit_blocks": float(eng.stats["prefix_hit_blocks"]),
+            "cow_copies": float(eng.stats["cow_copies"]),
+            "spec_steps": float(eng.stats["spec_steps"]),
+            "spec_tokens": float(eng.stats["spec_tokens"]),
         },
         verdict=verdict,
     )
@@ -701,12 +973,237 @@ def _run_preemptible(
             f"request(s) {unaccounted[:8]} neither completed nor "
             "quarantined — scheduler bug"
         )
+    if eng.leaked_blocks():
+        rec.notes.append(
+            f"{eng.leaked_blocks()} allocated block(s) have no live "
+            "table reference — refcount bookkeeping leaked"
+        )
     for rid in sorted(eng.failed)[:8]:
         rec.notes.append(f"request {rid} QUARANTINED: {eng.failed[rid]}")
     if len(eng.failed) > 8:
         rec.notes.append(f"... and {len(eng.failed) - 8} more quarantined")
     writer.record(rec)
     return [rec]
+
+
+def _shared_trace(cfg: ServeConfig, rng) -> tuple[list, int]:
+    """The chat-shaped trace: every prompt opens with the same
+    ``shared_prefix`` tokens (a system prompt) and ends with a short
+    private suffix.  Returns (requests, shared token count)."""
+    s_len = cfg.shared_prefix or max(1, (3 * cfg.max_prompt) // 4)
+    if s_len >= cfg.max_prompt:
+        raise ValueError(
+            f"shared_prefix {s_len} leaves no room for a private "
+            f"suffix under max_prompt {cfg.max_prompt}"
+        )
+    shared = rng.randint(0, cfg.vocab, size=s_len).tolist()
+    reqs = [
+        Request(
+            rid=i,
+            tokens=shared + rng.randint(
+                0, cfg.vocab,
+                size=rng.randint(1, cfg.max_prompt - s_len + 1),
+            ).tolist(),
+            n_gen=cfg.gen,
+        )
+        for i in range(cfg.requests)
+    ]
+    return reqs, s_len
+
+
+def _repetitive_trace(cfg: ServeConfig, rng) -> list:
+    """Motif-tiled prompts: the prompt-lookup drafter's home turf (and
+    a nudge toward the greedy loops tiny models settle into)."""
+    reqs = []
+    for i in range(cfg.requests):
+        motif = rng.randint(0, cfg.vocab, size=3).tolist()
+        lp = int(rng.randint(cfg.min_prompt, cfg.max_prompt + 1))
+        reqs.append(
+            Request(rid=i, tokens=(motif * (lp // 3 + 1))[:lp],
+                    n_gen=cfg.gen)
+        )
+    return reqs
+
+
+def _serve_commands(cfg: ServeConfig) -> str:
+    return (
+        f"req{cfg.requests} prompt{cfg.min_prompt}-{cfg.max_prompt} "
+        f"gen{cfg.gen} V{cfg.vocab} depth{cfg.depth} {cfg.dtype}"
+    )
+
+
+def _prefix_record(mesh, sp, cfg, writer, flat_params, mcfg) -> object:
+    """Measured pattern: the SAME shared-prefix trace served with CoW
+    block sharing on vs off, through one decoder whose pool covers the
+    full non-shared demand — so the contrast is allocation behavior,
+    not deferral pressure.  Gates: >= ``min_block_savings`` fewer peak
+    allocated blocks, every request's greedy ids bit-identical to its
+    per-request dense decode, and shared == non-shared ids."""
+    from tpu_patterns import obs
+    from tpu_patterns.core.results import Record, Verdict
+
+    max_len = cfg.max_prompt + cfg.gen
+    per_row = -(-max_len // cfg.block_len)
+    n_blocks = cfg.slots * per_row + 1  # full rectangle: no deferrals
+    decoder = make_paged_lm_decoder(
+        mesh, mcfg, cfg.vocab, n_blocks=n_blocks,
+        block_len=cfg.block_len, max_len=max_len,
+        cache_int8=cfg.cache_int8,
+    )
+    params = decoder.stack_params(flat_params)
+    rng = np.random.RandomState(cfg.seed + 2)
+    trace, s_len = _shared_trace(cfg, rng)
+
+    def serve_once(share: bool):
+        eng = ServeEngine(
+            decoder, params, slots=cfg.slots, watchdog_s=cfg.watchdog_s,
+            prefix_share=share,
+        )
+        out = eng.run([dataclasses.replace(r) for r in trace])
+        return out, eng
+
+    with obs.span("serve.prefix_share", requests=len(trace)):
+        out_shared, eng_s = serve_once(True)
+    with obs.span("serve.prefix_baseline"):
+        out_plain, eng_p = serve_once(False)
+
+    want_ids = _dense_expected(mesh, sp, mcfg, cfg, flat_params, trace)
+    exact = out_shared == out_plain
+    for r in trace:
+        if out_shared.get(r.rid) != want_ids[r.rid]:
+            exact = False
+            writer.progress(
+                f"prefix-share exactness: request {r.rid} diverged from "
+                f"dense decode (got {out_shared.get(r.rid)}, "
+                f"want {want_ids[r.rid]})"
+            )
+            break
+
+    peak_s = eng_s.stats["peak_blocks"]
+    peak_p = eng_p.stats["peak_blocks"]
+    savings = 1.0 - (peak_s / peak_p) if peak_p else 0.0
+    block_mb = decoder.pool_nbytes() / decoder.layout.n_blocks / 1e6
+    ok = (
+        exact
+        and peak_s < peak_p
+        and savings >= cfg.min_block_savings
+        and eng_s.leaked_blocks() == 0
+        and not eng_s.failed and not eng_p.failed
+    )
+    rec = Record(
+        pattern="serve",
+        mode=f"prefix_share_req{cfg.requests}_bl{cfg.block_len}_sp{sp}",
+        commands=_serve_commands(cfg) + f" shared{s_len}",
+        metrics={
+            "exact": float(exact),
+            "peak_blocks": float(peak_s),
+            "nonshared_peak_blocks": float(peak_p),
+            "block_savings": round(savings, 3),
+            "prefix_pool_MB": round(peak_s * block_mb, 4),
+            "nonshared_pool_MB": round(peak_p * block_mb, 4),
+            "prefix_hit_blocks": float(eng_s.stats["prefix_hit_blocks"]),
+            "cow_copies": float(eng_s.stats["cow_copies"]),
+            "shared_tokens": float(s_len),
+            "deferrals": float(eng_s.stats["deferrals"]),
+            "leaked_blocks": float(eng_s.leaked_blocks()),
+        },
+        verdict=Verdict.SUCCESS if ok else Verdict.FAILURE,
+    )
+    if not exact:
+        rec.notes.append(
+            "exactness gate FAILED: prefix sharing changed a request's "
+            "greedy ids vs per-request dense decode"
+        )
+    if not peak_s < peak_p or savings < cfg.min_block_savings:
+        rec.notes.append(
+            f"memory gate FAILED: peak {peak_s} vs non-shared {peak_p} "
+            f"blocks ({savings:.0%} saved) < {cfg.min_block_savings:.0%} "
+            "target on the shared-prefix trace"
+        )
+    if eng_s.leaked_blocks():
+        rec.notes.append(
+            f"{eng_s.leaked_blocks()} block(s) leaked by the refcounts"
+        )
+    writer.record(rec)
+    return rec
+
+
+def _spec_record(
+    mesh, sp, cfg, writer, decoder, params, flat_params, mcfg
+) -> object:
+    """Measured pattern: a repetitive trace decoded with prompt-lookup
+    speculative decoding vs plain one-token decode, same engine family,
+    same executables for the baseline.  Gates: accepted tokens per
+    verify step > ``min_accepted`` (plain decode is exactly 1.0) and
+    greedy ids bit-identical to both the plain engine and the
+    per-request dense decode — acceptance IS the greedy-ids check, so a
+    passing run proves speculation changed only the schedule."""
+    from tpu_patterns import obs
+    from tpu_patterns.core.results import Record, Verdict
+
+    rng = np.random.RandomState(cfg.seed + 3)
+    trace = _repetitive_trace(cfg, rng)
+
+    with obs.span("serve.spec_decode", k=cfg.spec_k):
+        eng_spec = ServeEngine(
+            decoder, params, slots=cfg.slots, watchdog_s=cfg.watchdog_s,
+            spec_k=cfg.spec_k,
+        )
+        out_spec = eng_spec.run([dataclasses.replace(r) for r in trace])
+    with obs.span("serve.spec_baseline"):
+        eng_plain = ServeEngine(
+            decoder, params, slots=cfg.slots, watchdog_s=cfg.watchdog_s,
+        )
+        out_plain = eng_plain.run([dataclasses.replace(r) for r in trace])
+
+    want_ids = _dense_expected(mesh, sp, mcfg, cfg, flat_params, trace)
+    exact = out_spec == out_plain
+    for r in trace:
+        if out_spec.get(r.rid) != want_ids[r.rid]:
+            exact = False
+            writer.progress(
+                f"spec-decode exactness: request {r.rid} diverged from "
+                f"dense decode (got {out_spec.get(r.rid)}, "
+                f"want {want_ids[r.rid]})"
+            )
+            break
+
+    row_steps = eng_spec.stats["spec_row_steps"]
+    accepted = (
+        eng_spec.stats["spec_tokens"] / row_steps if row_steps else 0.0
+    )
+    obs.gauge("tpu_patterns_serve_accepted_tokens_per_step").set(accepted)
+    ok = (
+        exact
+        and accepted > cfg.min_accepted
+        and not eng_spec.failed and not eng_plain.failed
+    )
+    rec = Record(
+        pattern="serve",
+        mode=f"spec_decode_k{cfg.spec_k}_sp{sp}",
+        commands=_serve_commands(cfg),
+        metrics={
+            "exact": float(exact),
+            "accepted_tokens_per_step": round(accepted, 3),
+            "draft_k": float(cfg.spec_k),
+            "decode_steps": float(eng_spec.stats["steps"]),
+            "plain_decode_steps": float(eng_plain.stats["steps"]),
+            "tokens": float(eng_spec.stats["tokens"]),
+        },
+        verdict=Verdict.SUCCESS if ok else Verdict.FAILURE,
+    )
+    if not exact:
+        rec.notes.append(
+            "exactness gate FAILED: speculative decoding changed a "
+            "request's greedy ids vs plain/dense decode"
+        )
+    if not accepted > cfg.min_accepted:
+        rec.notes.append(
+            f"accepted-tokens/step {accepted:.2f} <= {cfg.min_accepted}:"
+            " drafts were not worth a wide step on this trace"
+        )
+    writer.record(rec)
+    return rec
 
 
 def run_serve(mesh, cfg: ServeConfig, writer) -> list:
@@ -776,13 +1273,41 @@ def run_serve(mesh, cfg: ServeConfig, writer) -> list:
         raise ValueError("serve --resume requires --snapshot_dir")
     if cfg.snapshot_dir:
         # preemption-safe path: one pass, exactness-gated — a run that
-        # can be SIGTERMed anywhere has no meaningful speedup race
+        # can be SIGTERMed anywhere has no meaningful speedup race.
+        # With sharing/speculation requested, serve the SAME trace the
+        # measured pattern would (deterministic from cfg), so preempt/
+        # resume proves exactness with shared blocks / drafts in flight
+        if cfg.prefix_share:
+            trace, _ = _shared_trace(
+                cfg, np.random.RandomState(cfg.seed + 2)
+            )
+        elif cfg.spec_k:
+            trace = _repetitive_trace(
+                cfg, np.random.RandomState(cfg.seed + 3)
+            )
         return _run_preemptible(
             mesh, sp, cfg, writer, decoder, params, flat_params, mcfg,
             trace, n_blocks,
         )
     if cfg.ids_out:
         raise ValueError("serve --ids_out requires --snapshot_dir")
+    if cfg.prefix_share or cfg.spec_k:
+        # the PR-7 measured patterns: each flag banks its own Record
+        # (CoW prefix sharing's peak-block saving; speculative
+        # decoding's accepted-tokens/step), both exactness-gated
+        recs = []
+        if cfg.prefix_share:
+            recs.append(
+                _prefix_record(mesh, sp, cfg, writer, flat_params, mcfg)
+            )
+        if cfg.spec_k:
+            recs.append(
+                _spec_record(
+                    mesh, sp, cfg, writer, decoder, params, flat_params,
+                    mcfg,
+                )
+            )
+        return recs
 
     def timed_run(slots: int):
         eng = ServeEngine(
@@ -855,10 +1380,7 @@ def run_serve(mesh, cfg: ServeConfig, writer) -> list:
         mode=f"slots{cfg.slots}_bl{cfg.block_len}_sp{sp}"
         + (f"_gqa{cfg.kv_heads}" if cfg.kv_heads else "")
         + ("_int8" if cfg.cache_int8 else ""),
-        commands=(
-            f"req{cfg.requests} prompt{cfg.min_prompt}-{cfg.max_prompt} "
-            f"gen{cfg.gen} V{cfg.vocab} depth{cfg.depth} {cfg.dtype}"
-        ),
+        commands=_serve_commands(cfg),
         metrics={
             "tokens_per_s": round(cont_tps, 1),
             "sequential_tokens_per_s": round(seq_tps, 1),

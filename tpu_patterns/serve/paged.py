@@ -29,6 +29,15 @@ writes of non-owning sp ranks, padding positions, and inactive rows —
 the select-not-branch SPMD discipline of ``_CacheLayout`` applied to a
 scatter.  Slots the table does not cover are masked by closed-form
 positions, so a stale pool block can never leak into attention.
+
+Because tables are the only binding between rows and blocks, the same
+physical block may appear in MANY tables: copy-on-write prefix sharing
+(serve/prefix.py, refcounts in the engine) aliases common prompt
+prefixes onto one copy, with prefill's per-row ``start`` fence keeping
+shared blocks read-only and ``copy_blocks`` cloning the one boundary
+block where writes diverge.  The ``verify`` core generalizes ``step``
+to a k+1-token window for speculative decoding — a prefill at a
+per-row offset, returning the greedy id at every fed position.
 """
 
 from __future__ import annotations
@@ -159,7 +168,7 @@ def _pool_attend(pool_l: dict, q, tables, mask, layout, sp_axis):
 
 
 def _paged_prefill_layer(
-    p_l, x, pool_l, lens, tables, layout, cfg, sp_axis, tp_axis
+    p_l, x, pool_l, lens, start, tables, layout, cfg, sp_axis, tp_axis
 ):
     """One layer over a batch of (right-padded) PROMPTS: compute k/v for
     every prompt position, scatter them through the tables, then attend
@@ -167,7 +176,13 @@ def _paged_prefill_layer(
     what decode will see (quantized values included), on every sp
     layout.  Queries are sp-replicated (the pool, not the activations,
     carries the sp sharding), so the replicated-query psum combine
-    applies at prefill too — no ring pass needed."""
+    applies at prefill too — no ring pass needed.
+
+    ``start`` [B] is the prefix-sharing write fence: positions
+    ``t < start`` already sit in the pool (aliased or CoW-copied blocks
+    — see serve/prefix.py), so their writes route to the trash block;
+    shared blocks are READ-only here, which is what keeps aliasing
+    bit-exact.  Attention still covers them through the tables."""
     b, lp, _ = x.shape
     n_pages = tables.shape[1]
     q, k, v = qkv_native(p_l, x)
@@ -183,7 +198,7 @@ def _paged_prefill_layer(
     phys = jnp.take(tables, j, axis=1)  # [B, Lp]
     own = ((o // layout.bl_loc) == layout._rank(sp_axis))[None, :] & (
         t[None, :] < lens[:, None]
-    )
+    ) & (t[None, :] >= start[:, None])
     pb = jnp.where(own, phys, TRASH_BLOCK).reshape(-1)
     ob = jnp.where(own, (o % layout.bl_loc)[None, :], 0).reshape(-1)
     hkv, d = k.shape[2], k.shape[3]
@@ -252,14 +267,90 @@ def _paged_decode_layer(
     return _mlp(p_l, y, tp_axis, cfg), pool_l
 
 
+def _paged_verify_layer(
+    p_l, x, pool_l, pos0, n_draft, active, tables, layout, cfg,
+    sp_axis, tp_axis,
+):
+    """One layer of the speculative WIDE step: x [B, W, E] holds each
+    row's last committed token followed by up to ``n_draft`` drafted
+    tokens, token i at global position ``pos0 + i``.  Structurally a
+    prefill at a per-row offset: write all fed positions through the
+    tables, then attend each query causally over its own prefix — so
+    output i is EXACTLY what the plain one-token step would emit after
+    committing tokens 0..i (per-query masked reductions over the same
+    full table window make the wide step bit-identical, the same
+    argument that makes row/prompt buckets exact).
+
+    Positions ``i > n_draft`` are padding lanes: their writes route to
+    the trash block (they may sit past the row's reserved lifetime) and
+    their outputs are garbage the host never reads.  Slots holding
+    REJECTED drafts from a previous wide step are rewritten here before
+    any trusted query can attend them — the window advances by at most
+    ``accepted + 1 <= W`` positions per step, so the stale range always
+    falls inside the next step's write span."""
+    b, w, _ = x.shape
+    n_pages = tables.shape[1]
+    q, k, v = qkv_native(p_l, x)
+    i = jnp.arange(w, dtype=jnp.int32)
+    pos = pos0[:, None] + i[None, :]  # [B, W] global positions
+    if cfg.rope:
+        cos, sin = rope_tables(pos, cfg.head_dim, cfg.rope_theta, q.dtype)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+    j = jnp.clip(pos // layout.block_len, 0, n_pages - 1)
+    o = pos % layout.block_len
+    phys = jnp.take_along_axis(tables, j, axis=1)  # [B, W]
+    own = (
+        ((o // layout.bl_loc) == layout._rank(sp_axis))
+        & active[:, None]
+        & (i[None, :] <= n_draft[:, None])
+    )
+    pb = jnp.where(own, phys, TRASH_BLOCK).reshape(-1)
+    ob = jnp.where(own, o % layout.bl_loc, 0).reshape(-1)
+    hkv, d = k.shape[2], k.shape[3]
+    pool_l = _pool_write(
+        pool_l,
+        k.reshape(b * w, hkv, d),
+        v.reshape(b * w, hkv, d),
+        pb,
+        ob,
+    )
+
+    posn = layout.page_positions(n_pages, sp_axis)  # [L_loc]
+    tvalid = jnp.repeat(tables > TRASH_BLOCK, layout.bl_loc, axis=1)
+    mask = (
+        (posn[None, None, :] <= pos[:, :, None])
+        & tvalid[:, None, :]
+        & active[:, None, None]
+    )  # [B, W, L_loc]
+    attn = _pool_attend(pool_l, q, tables, mask, layout, sp_axis)
+    o_ = jnp.einsum("blhd,hde->ble", attn, p_l["wo"])
+    if tp_axis is not None:
+        o_ = lax.psum(o_, tp_axis)
+    y = x + o_
+    return _mlp(p_l, y, tp_axis, cfg), pool_l
+
+
 @dataclasses.dataclass(frozen=True)
 class PagedDecoder:
     """Compiled (prefill, step) pair over the paged pool.
 
-    * ``prefill(params, pool, tokens, lens, tables, active) ->
+    * ``prefill(params, pool, tokens, lens, start, tables, active) ->
       (pool, tok0)``: run a bucket of newcomer prompts [B, Lpad]
       (right-padded, per-row true ``lens``), write their K/V through
-      their tables, and return each row's greedy first token.
+      their tables from position ``start`` on (earlier positions sit in
+      shared blocks already — prefix sharing's write fence), and return
+      each row's greedy first token.
+    * ``verify(params, pool, toks, lens, steps, n_draft, tables,
+      active) -> (pool, out)``: the speculative wide step — toks [B, W]
+      holds each row's last committed token plus up to ``n_draft[b]``
+      drafted tokens; one call writes and attends all fed positions and
+      returns the greedy id at EVERY position, so the host can accept
+      the longest draft prefix the model itself would have produced.
+    * ``copy_blocks(pool, src, dst)``: CoW boundary copy — clone whole
+      physical blocks (quantized values and scales included) before a
+      request overwrites its private tail of a partially-shared block.
     * ``step(params, pool, tok, lens, steps, tables, active) ->
       (pool, next_tok)``: one iteration for a bucket of ACTIVE rows —
       embed each row's last token (its generation index ``steps[b]``,
@@ -299,6 +390,8 @@ class PagedDecoder:
         # lru caches must live per instance, not on the frozen class
         object.__setattr__(self, "_prefill_cache", {})
         object.__setattr__(self, "_step_cache", {})
+        object.__setattr__(self, "_verify_cache", {})
+        object.__setattr__(self, "_copy_cache", {})
 
     # -- pool ------------------------------------------------------------
 
@@ -381,6 +474,19 @@ class PagedDecoder:
             fn = self._step_cache[rows] = self._build_step()
         return fn
 
+    def verify_jit(self, rows: int, width: int):
+        key = (rows, width)
+        fn = self._verify_cache.get(key)
+        if fn is None:
+            fn = self._verify_cache[key] = self._build_verify(width)
+        return fn
+
+    def copy_jit(self, n: int):
+        fn = self._copy_cache.get(n)
+        if fn is None:
+            fn = self._copy_cache[n] = self._build_copy()
+        return fn
+
     def compiled_buckets(self) -> tuple[int, int]:
         return len(self._prefill_cache), len(self._step_cache)
 
@@ -394,7 +500,7 @@ class PagedDecoder:
                 f"({self.n_pages} blocks x {layout.block_len})"
             )
 
-        def body(params, pool, tokens, lens, tables, active):
+        def body(params, pool, tokens, lens, start, tables, active):
             blocks, wemb = self._split(params)
             x = embed_tokens(wemb, tokens, tp_axis).astype(
                 jnp.dtype(cfg.dtype)
@@ -404,7 +510,7 @@ class PagedDecoder:
                 y = carry
                 p_l, pl_l = xs
                 y, pl_l = _paged_prefill_layer(
-                    p_l, y, pl_l, lens, tables, layout, lcfg,
+                    p_l, y, pl_l, lens, start, tables, layout, lcfg,
                     sp_axis, tp_axis,
                 )
                 return y, pl_l
@@ -423,6 +529,7 @@ class PagedDecoder:
                 mesh=self.mesh,
                 in_specs=(
                     self._param_specs(), pool_specs, P(), P(), P(), P(),
+                    P(),
                 ),
                 out_specs=(pool_specs, P()),
                 check_vma=False,
@@ -469,6 +576,75 @@ class PagedDecoder:
                 check_vma=False,
             ),
             donate_argnums=(1,),
+        )
+
+    def _build_verify(self, width: int):
+        cfg, layout = self.cfg, self.layout
+        lcfg = dataclasses.replace(cfg, depth=1)
+        sp_axis, tp_axis = self._axes()
+
+        def body(params, pool, toks, lens, steps, n_draft, tables, active):
+            blocks, wemb = self._split(params)
+            x = embed_tokens(wemb, toks, tp_axis).astype(
+                jnp.dtype(cfg.dtype)
+            )
+            pos0 = (lens + steps).astype(jnp.int32)
+
+            def layer(carry, xs):
+                y = carry
+                p_l, pl_l = xs
+                y, pl_l = _paged_verify_layer(
+                    p_l, y, pl_l, pos0, n_draft, active, tables, layout,
+                    lcfg, sp_axis, tp_axis,
+                )
+                return y, pl_l
+
+            y, pool = lax.scan(layer, x, (blocks, pool))
+            b = y.shape[0]
+            logits = jnp.einsum("bwe,ve->bwv", y, wemb)
+            out = sharded_argmax(
+                logits.reshape(b * width, -1), tp_axis
+            ).reshape(b, width)
+            return pool, jnp.where(active[:, None], out, 0)
+
+        pool_specs = self.pool_specs()
+        return jax.jit(
+            jax.shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=(
+                    self._param_specs(), pool_specs, P(), P(), P(), P(),
+                    P(), P(),
+                ),
+                out_specs=(pool_specs, P()),
+                check_vma=False,
+            ),
+            donate_argnums=(1,),
+        )
+
+    def _build_copy(self):
+        """CoW boundary copy: clone pool blocks ``src[i] -> dst[i]``
+        across every layer and leaf (scales included).  Block-axis
+        scatter of a block-axis gather — the per-rank slice copies
+        rank-locally, no collective.  Padding lanes pass
+        ``src == dst == TRASH_BLOCK`` (a self-copy of garbage)."""
+
+        def body(pool, src, dst):
+            return {
+                n: leaf.at[:, dst].set(leaf[:, src])
+                for n, leaf in pool.items()
+            }
+
+        pool_specs = self.pool_specs()
+        return jax.jit(
+            jax.shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=(pool_specs, P(), P()),
+                out_specs=pool_specs,
+                check_vma=False,
+            ),
+            donate_argnums=(0,),
         )
 
     # -- params ----------------------------------------------------------

@@ -1,0 +1,216 @@
+"""Host-side radix index over admitted prompts, at block granularity.
+
+Chat traffic is dominated by shared system prompts: most requests in
+flight agree on their first hundreds of tokens.  The paged pool already
+separates *logical* positions from *physical* blocks, so sharing is
+purely a table-construction question — two rows whose prompts agree on
+positions ``[0, j*BL)`` can map those logical blocks to the SAME
+physical blocks, and the pool holds one copy.
+
+This module is the index that finds those agreements.  It is a radix
+tree whose edges are whole-block token tuples: a node at depth ``j``
+stands for one physical block holding the K/V of positions
+``[(j-1)*BL, j*BL)`` under the exact token context of its path from the
+root.  K/V at position ``t`` is a function of tokens ``[0, t]`` only
+(causal attention), so a block is reusable by any request whose first
+``j*BL`` tokens equal the node's full path — which is precisely what
+tree descent checks.
+
+Sharing comes in two grades (see ``ServeEngine._admit``):
+
+* **alias** — a request matching a node's whole path maps its logical
+  block straight onto the node's physical block (refcount + 1, zero new
+  memory);
+* **CoW boundary copy** — when the common prefix ends MID-block, the
+  block cannot be aliased (the new request must write its differing
+  tail into it), so the engine copies the best-matching child's block
+  into a private one and overwrites from the split point — the classic
+  copy-on-write rule applied at the one block where writes diverge.
+
+Nodes carry a ``materialized`` flag: a block enters the index at
+admission (so requests admitted in the SAME wave can alias each other —
+the batched prefill writes owner rows before any row attends), but its
+contents only exist on device after that wave's prefill commits.  A
+boundary COPY reads the donor block outside a prefill call, so only
+materialized nodes can donate.
+
+Lifetime is refcount-driven and owned by the engine: the index never
+pins a block.  When the last referencing row retires, the engine frees
+the block and calls :meth:`PrefixIndex.remove_block`, so the index
+always describes exactly the live shareable set (no eviction policy to
+tune, and ``sum(refcounts) == live table references`` stays an exact
+invariant — see tests/test_serve.py::TestRefcountInvariants).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator
+
+
+@dataclasses.dataclass
+class _Node:
+    """One full block: ``key`` is its BL-token tuple, ``block`` the
+    physical id, ``parent`` the preceding block's node (or the root)."""
+
+    key: tuple[int, ...]
+    block: int
+    parent: "_Node"
+    materialized: bool = False
+    children: dict[tuple[int, ...], "_Node"] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class SharePlan:
+    """What the index can do for one prompt: ``aliased`` physical blocks
+    covering its first ``len(aliased)`` logical blocks, an optional
+    ``donor`` block for a CoW boundary copy covering ``donor_len`` more
+    tokens, and ``shared_len`` — the total prefix of positions whose K/V
+    need not be recomputed (``len(aliased)*BL + donor_len``)."""
+
+    aliased: tuple[int, ...] = ()
+    donor: int | None = None
+    donor_len: int = 0
+
+    def shared_len(self, block_len: int) -> int:
+        return len(self.aliased) * block_len + self.donor_len
+
+
+class PrefixIndex:
+    """Radix tree over whole-block token tuples -> physical block ids."""
+
+    def __init__(self, block_len: int):
+        if block_len < 1:
+            raise ValueError(f"block_len must be >= 1, got {block_len}")
+        self.block_len = block_len
+        self.root = _Node(key=(), block=-1, parent=None)  # type: ignore
+        self.root.materialized = True
+        self._by_block: dict[int, _Node] = {}
+
+    # -- queries ---------------------------------------------------------
+
+    def _full_blocks(self, tokens: list[int]) -> Iterator[tuple[int, ...]]:
+        bl = self.block_len
+        for j in range(len(tokens) // bl):
+            yield tuple(tokens[j * bl : (j + 1) * bl])
+
+    def plan(self, tokens: list[int]) -> SharePlan:
+        """Best sharing the index offers ``tokens`` right now.
+
+        Descends whole-block matches (aliasable regardless of
+        materialization — same-wave aliases resolve inside the batched
+        prefill), then looks among the deepest node's MATERIALIZED
+        children for the longest partial-boundary donor."""
+        node = self.root
+        aliased: list[int] = []
+        consumed = 0
+        for key in self._full_blocks(tokens):
+            child = node.children.get(key)
+            if child is None:
+                break
+            aliased.append(child.block)
+            consumed += self.block_len
+            node = child
+        # boundary: longest common prefix with a materialized child
+        rest = tuple(tokens[consumed : consumed + self.block_len])
+        donor, donor_len = None, 0
+        if rest:
+            for key, child in node.children.items():
+                if not child.materialized:
+                    continue
+                m = 0
+                for a, b in zip(rest, key):
+                    if a != b:
+                        break
+                    m += 1
+                if m > donor_len:
+                    donor, donor_len = child.block, m
+        return SharePlan(
+            aliased=tuple(aliased), donor=donor, donor_len=donor_len
+        )
+
+    # -- mutation --------------------------------------------------------
+
+    def insert(self, tokens: list[int], blocks: list[int]) -> list[int]:
+        """Register ``tokens``'s fully-covered prompt blocks under the
+        physical ids ``blocks`` (the request's table prefix).  Existing
+        nodes are kept (they ARE the aliased blocks); new nodes start
+        unmaterialized.  Returns the newly indexed physical ids."""
+        node = self.root
+        new: list[int] = []
+        for j, key in enumerate(self._full_blocks(tokens)):
+            child = node.children.get(key)
+            if child is None:
+                child = _Node(key=key, block=blocks[j], parent=node)
+                node.children[key] = child
+                self._by_block[child.block] = child
+                new.append(child.block)
+            node = child
+        return new
+
+    def materialize(self, blocks: list[int]) -> None:
+        """Mark ``blocks`` as written on device (their wave's prefill
+        committed) — they may now donate boundary copies."""
+        for b in blocks:
+            node = self._by_block.get(b)
+            if node is not None:
+                node.materialized = True
+
+    def remove_block(self, block: int) -> None:
+        """Drop ``block``'s node (refcount hit zero — the engine is
+        freeing it).  Rows referencing a descendant also reference every
+        ancestor, so a zero-ref node can only have zero-ref descendants;
+        within one retire they are removed in table order, so a child
+        may outlive its parent's NODE for a moment — the stored parent
+        pointer keeps the unlink well-defined."""
+        node = self._by_block.pop(block, None)
+        if node is None:
+            return
+        if node.parent is not None and node.parent.children.get(
+            node.key
+        ) is node:
+            del node.parent.children[node.key]
+
+    # -- accounting + snapshot -------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._by_block)
+
+    def blocks(self) -> set[int]:
+        return set(self._by_block)
+
+    def to_state(self) -> list:
+        """JSON-friendly nested encoding (preorder, exact round-trip)."""
+
+        def enc(node: _Node) -> list:
+            return [
+                list(node.key),
+                node.block,
+                bool(node.materialized),
+                [enc(c) for _, c in sorted(node.children.items())],
+            ]
+
+        return [enc(c) for _, c in sorted(self.root.children.items())]
+
+    @classmethod
+    def from_state(cls, block_len: int, state: list) -> "PrefixIndex":
+        idx = cls(block_len)
+
+        def dec(parent: _Node, enc: list) -> None:
+            key, block, materialized, children = enc
+            node = _Node(
+                key=tuple(int(t) for t in key),
+                block=int(block),
+                parent=parent,
+                materialized=bool(materialized),
+            )
+            parent.children[node.key] = node
+            idx._by_block[node.block] = node
+            for c in children:
+                dec(node, c)
+
+        for c in state:
+            dec(idx.root, c)
+        return idx

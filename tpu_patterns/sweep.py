@@ -465,11 +465,24 @@ def serve_specs(quick: bool = False) -> list[SweepSpec]:
     """Continuous-batching serve matrix: the base engine cell, the int8
     pool, and a GQA pool — each cell re-runs the full verdict set
     (speedup over sequential, per-request token exactness, in-place
-    paged-pool memory analysis) at its own cache layout."""
+    paged-pool memory analysis) at its own cache layout — plus the PR-7
+    cells: CoW prefix sharing (peak-block saving on a shared-prefix
+    trace) and self-drafting speculative decoding (accepted-tokens/step
+    on a repetitive trace), both exactness-gated."""
     small = QUICK_SERVE if quick else (
         "--requests", "24", "--max_prompt", "96", "--gen", "32",
         "--slots", "8", "--block_len", "16", "--embed", "256",
         "--vocab", "1024",
+    )
+    # the quick twin's 16-token prompts hold only ONE full shared block
+    # (29% < the 30% gate), so the quick prefix cell gets its own
+    # explicit 8-requests x 75%-shared geometry (2 full shared blocks
+    # of 8) rather than flag overrides on QUICK_SERVE
+    prefix_small = small if not quick else (
+        "--vocab", "64", "--embed", "64", "--head_dim", "8", "--depth",
+        "1", "--requests", "8", "--min_prompt", "4", "--max_prompt",
+        "24", "--gen", "6", "--slots", "8", "--block_len", "8",
+        "--shared_prefix", "16",
     )
     env = (("TPU_PATTERNS_SWEEP_CONFIG", "serve"),)
     return [
@@ -482,6 +495,16 @@ def serve_specs(quick: bool = False) -> list[SweepSpec]:
         SweepSpec(
             name="serve.gqa_pool",
             argv=("serve", "--kv_heads", "2", *small),
+            env=env,
+        ),
+        SweepSpec(
+            name="serve.prefix_share",
+            argv=("serve", *prefix_small, "--prefix_share", "true"),
+            env=env,
+        ),
+        SweepSpec(
+            name="serve.spec_decode",
+            argv=("serve", *small, "--spec_k", "4"),
             env=env,
         ),
     ]
