@@ -23,7 +23,13 @@ heals itself, visibly:
       quarantine the in-flight rows with per-request verdicts (no
       request silently lost: done + failed covers the trace) and the
       shared blocks' refcounts must balance (``leaked_blocks == 0``),
-      with the CLI exiting 0 (WARNING, not FAILURE: the runtime healed).
+      with the CLI exiting 0 (WARNING, not FAILURE: the runtime healed);
+  (e) chaos under LOAD: the chat loadgen scenario served clean then
+      again under transient decode faults plus one dropped arrival
+      (``loadgen.arrive``) — the chaos Record must show full coverage
+      (done + failed + dropped == scheduled, nothing silently lost),
+      injected firings > 0, p99 e2e bounded by the scenario multiplier
+      vs the clean run, and the CLI exits 0.
 
 Zero dependencies beyond the package; exit 0 = pass.
 """
@@ -228,9 +234,64 @@ def main() -> int:
             "block(s) through quarantine"
         )
 
+    # (e) chaos under load: the runner composes clean + chaos legs in
+    # one process (faults.configure scopes the spec to the chaos leg),
+    # so the gate reads BOTH Records from one invocation.
+    lg_jsonl = os.path.join(work, "loadgen-chaos.jsonl")
+    rc = _run(
+        "chaos-under-load",
+        [*py, "--jsonl", lg_jsonl, "loadgen", "--dp", "1", "--tp", "2",
+         "--vocab", "64", "--embed", "64", "--head_dim", "8",
+         "--depth", "1", "--slots", "4", "--block_len", "8",
+         "--time_scale", "0.02",
+         "--slo_ttft_ms", "60000", "--slo_tpot_ms", "20000",
+         "--scenarios",
+         "chat:requests=8:min_prompt=4:mean_prompt=8:max_prompt=16"
+         ":min_gen=2:mean_gen=4:max_gen=6",
+         "--chaos",
+         "serve.step:error:count=1,serve.step:error:after=6:count=1,"
+         "loadgen.arrive:error:after=2:count=1",
+         "--chaos_p99_mult", "50"],
+        _env(),
+    )
+    if rc != 0:
+        return fail("chaos-under-load loadgen run exited nonzero")
+    with open(lg_jsonl) as f:
+        lg = [json.loads(ln) for ln in f if ln.strip()]
+    chaos = next(
+        (r for r in lg if "_chaos_" in r.get("mode", "")), None
+    )
+    if chaos is None:
+        return fail(f"no chaos Record banked (modes: "
+                    f"{[r.get('mode') for r in lg]})")
+    m = chaos.get("metrics", {})
+    print(f"  [chaos-under-load] verdict={chaos.get('verdict')} "
+          f"done={m.get('done')} failed={m.get('failed')} "
+          f"dropped={m.get('dropped')} injected={m.get('injected')} "
+          f"p99_ratio={m.get('p99_ratio')}", flush=True)
+    if chaos.get("verdict") == "FAILURE":
+        return fail(f"chaos-under-load FAILED: {chaos.get('notes')}")
+    if m.get("covered") != 1.0:
+        return fail("chaos-under-load lost a request "
+                    f"(covered={m.get('covered')})")
+    if not m.get("injected", 0) > 0:
+        return fail("chaos spec never fired under load")
+    if not m.get("dropped", 0) > 0:
+        return fail("the loadgen.arrive drop never fired")
+    if (
+        m.get("done", 0) + m.get("failed", 0) + m.get("dropped", 0)
+        != m.get("requests")
+    ):
+        return fail(
+            f"chaos accounting broken: done {m.get('done')} + failed "
+            f"{m.get('failed')} + dropped {m.get('dropped')} != "
+            f"{m.get('requests')}"
+        )
+
     print("chaos smoke: all gates passed "
           "(cell retry, worker fallback, preempt/resume exactness, "
-          "verify-fault quarantine + refcount balance)",
+          "verify-fault quarantine + refcount balance, "
+          "chaos-under-load coverage + bounded p99)",
           flush=True)
     return 0
 

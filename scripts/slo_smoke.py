@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""CI gate for the trace-driven load generator (docs/serving.md).
+
+Runs the chat scenario preset through the REAL CLI
+(``tpu-patterns loadgen``) on the simulated 8-device CPU mesh at a
+deliberately generous CPU-mesh SLO and gates:
+
+  (a) the scenario Record's verdict is SUCCESS with goodput == 1.0 —
+      every generated token came from a request that met its deadline
+      (the SLO is generous because CI measures the SCHEDULER, not
+      XLA's CPU latency; a miss here means queueing/starvation, not a
+      slow matmul);
+  (b) coverage: done + failed + dropped == the scheduled trace — the
+      load generator and engine account for every request;
+  (c) the percentile stats are real numbers (TTFT/TPOT/e2e p50 <= p95
+      <= p99, all > 0);
+  (d) the obs dump of the run exports a Chrome trace containing
+      per-request lifecycle lanes (req.queued/req.prefill/req.decode
+      spans + one named "req <rid>" lane per request) — the
+      request-timeline acceptance criterion, end to end through the
+      real CLI.
+
+Zero dependencies beyond the package; exit 0 = pass.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# small enough for a stock runner's cold XLA; requests > slots so the
+# active set turns over and queueing is real
+CHAT = (
+    "chat:requests=8:min_prompt=4:mean_prompt=8:max_prompt=16"
+    ":min_gen=2:mean_gen=4:max_gen=6"
+)
+LOADGEN_ARGS = [
+    "--vocab", "64", "--embed", "64", "--head_dim", "8", "--depth", "1",
+    "--slots", "4", "--block_len", "8", "--time_scale", "0.02",
+    "--slo_ttft_ms", "60000", "--slo_tpot_ms", "20000",
+    "--scenarios", CHAT,
+]
+
+
+def _run(tag: str, cmd: list[str], env: dict) -> int:
+    print(f"+ [{tag}]", " ".join(cmd), flush=True)
+    t0 = time.monotonic()
+    proc = subprocess.run(cmd, env=env, cwd=ROOT)
+    print(f"  [{tag}] rc={proc.returncode} "
+          f"wall={time.monotonic() - t0:.1f}s", flush=True)
+    return proc.returncode
+
+
+def fail(msg: str) -> int:
+    print(f"slo smoke: {msg}", file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.pop("TPU_PATTERNS_FAULTS", None)
+    work = tempfile.mkdtemp(prefix="slo_smoke_")
+    jsonl = os.path.join(work, "loadgen.jsonl")
+    obs_dir = os.path.join(work, "obs")
+    py = [sys.executable, "-m", "tpu_patterns"]
+
+    rc = _run(
+        "chat",
+        [*py, "--jsonl", jsonl, "--obs-dir", obs_dir, "--obs-dump",
+         "loadgen", "--dp", "1", "--tp", "2", *LOADGEN_ARGS],
+        env,
+    )
+    if rc != 0:
+        return fail(f"loadgen CLI exited {rc}")
+    with open(jsonl) as f:
+        recs = [json.loads(ln) for ln in f if ln.strip()]
+    if not recs:
+        return fail("no Record banked")
+    rec = recs[-1]
+    m = rec.get("metrics", {})
+    print(
+        f"slo smoke: verdict={rec.get('verdict')} "
+        f"goodput={m.get('goodput')} ttft p50/p95/p99="
+        f"{m.get('ttft_p50_ms')}/{m.get('ttft_p95_ms')}/"
+        f"{m.get('ttft_p99_ms')}ms tpot p50={m.get('tpot_p50_ms')}ms "
+        f"e2e p99={m.get('e2e_p99_ms')}ms done={m.get('done')}",
+        flush=True,
+    )
+    # (a) SLO verdict + goodput
+    if rec.get("verdict") != "SUCCESS":
+        return fail(
+            f"verdict {rec.get('verdict')} — notes: {rec.get('notes')}"
+        )
+    if m.get("goodput") != 1.0:
+        return fail(
+            f"goodput {m.get('goodput')} != 1.0 at a generous CPU-mesh "
+            "SLO — requests missed deadlines"
+        )
+    # (b) coverage
+    if (
+        m.get("done", 0) + m.get("failed", 0) + m.get("dropped", 0)
+        != m.get("requests")
+    ):
+        return fail(
+            f"requests lost: done {m.get('done')} + failed "
+            f"{m.get('failed')} + dropped {m.get('dropped')} != "
+            f"{m.get('requests')} scheduled"
+        )
+    # (c) percentile sanity
+    for key in ("ttft", "tpot", "e2e"):
+        p50, p95, p99 = (
+            m.get(f"{key}_p50_ms"), m.get(f"{key}_p95_ms"),
+            m.get(f"{key}_p99_ms"),
+        )
+        if not (p50 is not None and 0 < p50 <= p95 <= p99):
+            return fail(f"{key} percentiles implausible: {p50}/{p95}/{p99}")
+
+    # (d) chrome-trace request lanes from the SAME run's obs dump
+    trace_out = os.path.join(work, "trace.json")
+    rc = _run(
+        "trace",
+        [*py, "--obs-dir", obs_dir, "obs", "export",
+         "--chrome-trace", trace_out],
+        env,
+    )
+    if rc != 0:
+        return fail("obs export failed on the run's dump")
+    with open(trace_out) as f:
+        events = json.load(f)["traceEvents"]
+    req_spans = {
+        e["name"] for e in events if e.get("name", "").startswith("req.")
+    }
+    lanes = [
+        e["args"]["name"]
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+        and str(e.get("args", {}).get("name", "")).startswith("req ")
+    ]
+    if not {"req.queued", "req.prefill", "req.decode"} <= req_spans:
+        return fail(
+            f"chrome trace lacks lifecycle spans (got {sorted(req_spans)})"
+        )
+    if len(lanes) != int(m["requests"]):
+        return fail(
+            f"expected {int(m['requests'])} named request lanes, "
+            f"got {len(lanes)}: {lanes}"
+        )
+    print(
+        f"slo smoke: PASS (goodput 1.0, {len(lanes)} request lanes in "
+        "the chrome trace)",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

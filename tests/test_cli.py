@@ -296,11 +296,20 @@ class TestSweep:
         assert "--prefix_share" in pre.argv
         spc = next(s for s in srv if s.name == "serve.spec_decode")
         assert "--spec_k" in spc.argv
+        lg = sweep.specs_for("loadgen", quick=True)
+        # one SLO cell per scenario preset + the chaos-under-load cell
+        assert {s.name for s in lg} == {
+            "loadgen.chat", "loadgen.rag", "loadgen.batch_summarize",
+            "loadgen.agentic", "loadgen.chaos_chat",
+        }
+        assert all(s.argv[0] == "loadgen" for s in lg)
+        chaos = next(s for s in lg if s.name == "loadgen.chaos_chat")
+        assert "--chaos" in chaos.argv
         # 'all' must be exactly these suites, independently summed
         assert set(sweep.SUITES) == {
             "p2p", "hier", "measured", "tune", "asymptote", "gates",
             "concurrency", "runtime", "allreduce", "longctx", "parallel",
-            "serve",
+            "serve", "loadgen",
         }
         assert len(sweep.specs_for("all", quick=True)) == len(p2p) + len(
             con
@@ -308,7 +317,9 @@ class TestSweep:
             par
         ) + len(hier) + len(meas) + len(tune) + len(rt) + len(
             sweep.specs_for("gates", quick=True)
-        ) + len(sweep.specs_for("asymptote", quick=True)) + len(srv)
+        ) + len(sweep.specs_for("asymptote", quick=True)) + len(srv) + len(
+            lg
+        )
 
     def test_measured_two_phase_ordering(self):
         # VERDICT r4 next #3: phase 1 = every cell full-size at reps=2

@@ -332,6 +332,52 @@ class TestMetrics:
         ] == 6
 
 
+    def test_serve_latency_metric_names_export_cleanly(self):
+        # the request-lifecycle series PR 8 wires out of the engine:
+        # queue wait, TTFT, TPOT — histograms under the one namespace,
+        # bucket/sum/count triplets round-tripping through prom text
+        names = (
+            "tpu_patterns_serve_queue_wait_ms",
+            "tpu_patterns_serve_ttft_ms",
+            "tpu_patterns_serve_tpot_ms",
+        )
+        reg = obs_metrics.Registry()
+        for name in names:
+            h = reg.histogram(name)
+            h.observe(3.5)
+            h.observe(10.0)
+        text = reg.to_prom_text()
+        samples = obs.parse_prom_text(text)
+        for name in names:
+            assert f"# TYPE {name} histogram" in text
+            assert samples[(f"{name}_count", ())] == 2
+            assert samples[(f"{name}_sum", ())] == 13.5
+
+    def test_loadgen_slo_series_export_with_scenario_label(self):
+        reg = obs_metrics.Registry()
+        reg.gauge("tpu_patterns_loadgen_goodput", scenario="chat").set(
+            0.875
+        )
+        reg.gauge(
+            "tpu_patterns_loadgen_ttft_p99_ms", scenario="chat"
+        ).set(120.5)
+        reg.counter(
+            "tpu_patterns_loadgen_requests_total",
+            scenario="chat", status="done",
+        ).inc(7)
+        samples = obs.parse_prom_text(reg.to_prom_text())
+        assert samples[
+            ("tpu_patterns_loadgen_goodput", (("scenario", "chat"),))
+        ] == 0.875
+        assert samples[
+            ("tpu_patterns_loadgen_ttft_p99_ms", (("scenario", "chat"),))
+        ] == 120.5
+        assert samples[(
+            "tpu_patterns_loadgen_requests_total",
+            (("scenario", "chat"), ("status", "done")),
+        )] == 7
+
+
 class TestChromeTrace:
     def test_schema_and_ordering(self, tmp_path):
         with obs.span("outer", bytes=42):
@@ -356,6 +402,44 @@ class TestChromeTrace:
         outer = next(e for e in evs if e["name"] == "outer")
         assert outer["args"] == {"bytes": 42}
         json.dumps(trace)  # must be valid JSON end to end
+
+    def test_complete_span_entries_get_named_request_lanes(self):
+        # the serve engine books request lifecycles via complete_span
+        # with an explicit lane; the exporter must name the lane and
+        # keep the spans valid "X" events in the same timeline
+        obs.complete_span(
+            "req.queued", 1_000, 500, tid=1_000_042, rid=42,
+            scenario="chat",
+        )
+        obs.complete_span(
+            "req.decode", 1_500, 900, tid=1_000_042, rid=42,
+            scenario="chat",
+        )
+        with obs.span("serve.step"):
+            # scheduler-thread EVENTS also carry rid attrs; they must
+            # NOT rename the scheduler's own lane to a request lane
+            obs.event("serve.defer", rid="42")
+        trace = obs_export.chrome_trace(obs.flight_recorder().snapshot())
+        evs = trace["traceEvents"]
+        (lane,) = [e for e in evs if e.get("ph") == "M"]
+        assert lane["name"] == "thread_name"
+        assert lane["tid"] == 1_000_042
+        assert lane["args"]["name"] == "req 42 [chat]"
+        decode = next(e for e in evs if e["name"] == "req.decode")
+        assert decode["ph"] == "X"
+        assert decode["ts"] == pytest.approx(1.5)  # ns -> us
+        assert decode["dur"] == pytest.approx(0.9)
+        assert decode["args"]["rid"] == 42
+        # and the span-duration histogram was fed like any span
+        h = obs.metrics_registry().histogram(
+            "tpu_patterns_span_duration_ns", span="req.decode"
+        )
+        assert h.count == 1
+
+    def test_complete_span_disabled_is_a_noop(self):
+        obs.set_enabled(False)
+        obs.complete_span("req.queued", 0, 10, tid=7, rid=1)
+        assert len(obs.flight_recorder()) == 0
 
     def test_write_chrome_trace(self, tmp_path):
         with obs.span("s"):

@@ -414,6 +414,8 @@ class MetricNaming(Rule):
     KNOWN_LABELS = frozenset({
         "site", "action", "cell", "cell_class", "suite", "status",
         "optimizer", "app", "mode", "reason", "rule", "tier", "worker",
+        # loadgen SLO series are keyed by scenario preset (PR 8)
+        "scenario",
     })
     PREFIX = "tpu_patterns_"
 

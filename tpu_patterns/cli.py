@@ -379,7 +379,47 @@ def _cmd_serve(args, writer: ResultWriter) -> None:
         # the paged pool is shared state over sp/tp; batch rows are
         # scheduler slots, not a data axis — fail fast with the reason
         raise SystemExit("error: serve requires --dp 1 (fold devices into sp)")
-    run_serve(_mesh3d_from_args(args), _cfg_from_args(ServeConfig, args), writer)
+    cfg = _cfg_from_args(ServeConfig, args)
+    if cfg.scenario:
+        # parse-time checks up front so spec typos and rejected flag
+        # combos read as one line (same surface as loadgen); runtime
+        # ValueErrors keep their traceback
+        from tpu_patterns.loadgen import parse_scenario
+
+        try:
+            parse_scenario(cfg.scenario)
+            if cfg.snapshot_dir or cfg.resume or cfg.ids_out:
+                raise ValueError(
+                    "serve --scenario is the SLO measured pattern; run "
+                    "preemption (--snapshot_dir/--resume/--ids_out) via "
+                    "the plain serve trace instead"
+                )
+        except ValueError as e:
+            raise SystemExit(f"error: {e}") from e
+    run_serve(_mesh3d_from_args(args), cfg, writer)
+
+
+def _cmd_loadgen(args, writer: ResultWriter) -> None:
+    from tpu_patterns.loadgen import (
+        LoadGenConfig,
+        run_loadgen,
+        validate_config,
+    )
+
+    if args.dp != 1:
+        # same contract as serve: the paged pool is shared state over
+        # sp/tp, batch rows are scheduler slots
+        raise SystemExit("error: loadgen requires --dp 1 (fold devices into sp)")
+    cfg = _cfg_from_args(LoadGenConfig, args)
+    try:
+        # parse-time surface only: scenario/chaos spec typos read as one
+        # line at the CLI boundary (the faults-parser rule), while a
+        # ValueError raised mid-run keeps its traceback — an engine bug
+        # must not print like a user typo
+        validate_config(cfg)
+    except ValueError as e:
+        raise SystemExit(f"error: {e}") from e
+    run_loadgen(_mesh3d_from_args(args), cfg, writer)
 
 
 def _cmd_doctor(args, writer: ResultWriter) -> None:
@@ -1113,6 +1153,18 @@ def build_parser() -> argparse.ArgumentParser:
     add_config_args(sv, ServeConfig)
     _add_mesh3d_args(sv)
 
+    lg = sub.add_parser(
+        "loadgen",
+        help="trace-driven load generator over the serve engine: seeded "
+        "arrival processes + scenario presets (chat, rag, "
+        "batch-summarize, agentic), TTFT/TPOT/e2e percentiles, "
+        "goodput-under-SLO verdicts, optional chaos-under-load twin",
+    )
+    from tpu_patterns.loadgen import LoadGenConfig
+
+    add_config_args(lg, LoadGenConfig)
+    _add_mesh3d_args(lg)
+
     dr = sub.add_parser(
         "doctor",
         help="deadline-bounded runtime health probes (backend init / tiny "
@@ -1373,6 +1425,7 @@ def main(argv: list[str] | None = None) -> int:
         "decode": _cmd_decode,
         "lm": _cmd_lm,
         "serve": _cmd_serve,
+        "loadgen": _cmd_loadgen,
         "doctor": _cmd_doctor,
         "ckpt": _cmd_ckpt,
         "pipeline": _cmd_pipeline,

@@ -32,6 +32,11 @@ and docs/robustness.md):
                  compiled call (``spec_k > 0`` replaces serve.step with
                  this site; same recovery contract — retries, then
                  quarantine with shared-block refcounts released)
+  loadgen.arrive loadgen/runner.py, per scheduled arrival as the load
+                 generator releases it into the engine (ctx: rid,
+                 scenario): ``sleep``/``hang`` DELAYS the arrival,
+                 ``error`` DROPS it — the runner records the drop so
+                 done + failed + dropped still covers the trace
 """
 
 from tpu_patterns.faults.injector import (  # noqa: F401

@@ -71,12 +71,15 @@ ACTIONS = frozenset(
 KNOWN_SITES = frozenset({
     "worker.ready", "cell.run", "ckpt.save", "ckpt.restore",
     "train.step", "serve.prefill", "serve.step", "serve.verify",
+    "loadgen.arrive",
 })
 
 # ctx keys the call sites actually pass — the only keys a match
 # predicate can ever see (a misspelled count= / after= would otherwise
 # fall through to an unmatchable predicate and never fire)
-MATCH_KEYS = frozenset({"pid", "cmd", "cell", "step", "proc", "rows"})
+MATCH_KEYS = frozenset({
+    "pid", "cmd", "cell", "step", "proc", "rows", "rid", "scenario",
+})
 
 
 class InjectedFault(OSError):

@@ -1,0 +1,49 @@
+"""loadgen/ — trace-driven load generation + SLO verdicts for serving.
+
+Every serve gate before this subsystem was throughput-shaped (speedup
+over sequential, pool bytes, accepted-tokens/step).  This layer measures
+what a *user* of the engine feels: requests arrive over time from a
+seeded stochastic process, each carries a deadline, and the verdict is
+latency-shaped — TTFT / TPOT / e2e percentiles and goodput-under-SLO
+(the fraction of tokens from requests that met their deadline).
+
+  arrivals.py     seeded arrival processes: Poisson, bursty (Markov-
+                  modulated on/off), diurnal ramp — offsets in virtual
+                  seconds, bit-identical under the same seed
+  scenarios.py    scenario presets (chat, rag, batch-summarize,
+                  agentic) + the ``name[:key=value]*`` spec grammar
+                  (unknown presets/keys rejected at parse, like the
+                  faults spec parser) and the deterministic schedule
+                  builder
+  percentiles.py  mergeable streaming quantile sketch: exact below its
+                  buffer cap (vs numpy), deterministic compaction above
+  runner.py       drives a schedule through the REAL ServeEngine on
+                  the wall clock (``loadgen.arrive`` fault site per
+                  release), computes the percentile/goodput stats from
+                  the engine's per-request lifecycle, and banks ONE
+                  Record per scenario — plus a chaos twin gating
+                  bounded p99 degradation and zero lost requests
+
+CLI: ``tpu-patterns loadgen --scenarios chat,rag`` (or
+``tpu-patterns serve --scenario chat``).  See docs/serving.md
+"Load generation & SLOs".
+"""
+
+from tpu_patterns.loadgen.arrivals import (  # noqa: F401
+    ARRIVAL_PROCESSES,
+    arrival_offsets,
+)
+from tpu_patterns.loadgen.percentiles import StreamingPercentiles  # noqa: F401
+from tpu_patterns.loadgen.runner import (  # noqa: F401
+    ArrivalSource,
+    LoadGenConfig,
+    run_loadgen,
+    validate_config,
+)
+from tpu_patterns.loadgen.scenarios import (  # noqa: F401
+    PRESETS,
+    ScenarioSpec,
+    TimedRequest,
+    build_schedule,
+    parse_scenario,
+)

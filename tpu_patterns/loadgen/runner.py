@@ -1,0 +1,517 @@
+"""Drive scenario schedules through the real ServeEngine; judge SLOs.
+
+One scenario run = one deterministic schedule (scenarios.build_schedule)
+released onto the wall clock by :class:`ArrivalSource` and served by the
+same ``ServeEngine`` the ``serve`` measured patterns use — iteration-
+level admission, paged pool, deferrals, retries, quarantine, all live.
+The engine's per-request lifecycle (serve/engine.py) supplies TTFT /
+TPOT / e2e per request; the streaming percentile sketch turns those
+into p50/p95/p99; goodput-under-SLO is the fraction of generated tokens
+that came from requests meeting their deadline.  Each scenario banks
+ONE Record with a pass/fail SLO verdict, and — with a chaos spec — a
+second Record gating that p99 degrades bounded (<= the scenario's
+multiplier over the clean run) and that done + failed + dropped exactly
+covers the trace: no request silently lost.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+
+from tpu_patterns import faults
+from tpu_patterns.core.timing import clock_ns
+from tpu_patterns.loadgen.percentiles import StreamingPercentiles
+from tpu_patterns.loadgen.scenarios import (
+    ScenarioSpec,
+    TimedRequest,
+    build_schedule,
+    parse_scenario,
+)
+from tpu_patterns.serve.engine import ServeEngine
+
+
+class ArrivalSource:
+    """Releases a schedule into the engine on the wall clock.
+
+    Plugged into ``ServeEngine.run(source=...)``: polled once per
+    scheduler iteration, it hands over every request whose arrival
+    offset has passed as ``(request, t_submit_ns)`` — submission
+    backdated to the scheduled arrival, so engine lateness reads as
+    queue wait.  When the engine is IDLE (nothing queued or
+    active) it owns the wait — sleeping in bounded slices until the
+    next arrival — so the scheduler loop itself stays sleep-free.
+
+    Every release passes the ``loadgen.arrive`` fault site (ctx: rid,
+    scenario): an injected ``sleep``/``hang`` DELAYS the arrival (the
+    injector blocks inside the release loop, exactly a stalled
+    front-end), an injected ``error`` DROPS it — recorded in
+    ``self.dropped`` so the coverage accounting still closes.
+    """
+
+    def __init__(
+        self,
+        schedule: list[TimedRequest],
+        *,
+        scenario: str,
+        max_sleep_s: float = 0.25,
+    ):
+        self._pending = collections.deque(
+            sorted(schedule, key=lambda tr: (tr.arrival_s, tr.request.rid))
+        )
+        self.scenario = scenario
+        self.dropped: dict[int, str] = {}
+        self.released = 0
+        self._t0_ns: int | None = None
+        self._max_sleep_s = max_sleep_s
+
+    def _elapsed_s(self) -> float:
+        return (clock_ns() - self._t0_ns) / 1e9
+
+    def __call__(self, idle: bool = False):
+        from tpu_patterns import obs
+
+        if not self._pending:
+            return None
+        if self._t0_ns is None:
+            self._t0_ns = clock_ns()
+        if idle:
+            wait_s = self._pending[0].arrival_s - self._elapsed_s()
+            if wait_s > 0:
+                # graftlint: allow[sleep-outside-backoff] -- arrival pacing IS the load model: an idle engine waits for the next scheduled arrival (bounded slice; the engine re-polls)
+                time.sleep(min(wait_s, self._max_sleep_s))
+        batch = []
+        now_s = self._elapsed_s()
+        while self._pending and self._pending[0].arrival_s <= now_s:
+            tr = self._pending.popleft()
+            # submission is backdated to the SCHEDULED arrival: if the
+            # engine was mid-iteration (or an injected delay stalled
+            # the release), that lateness is queue wait the user felt —
+            # counting it is the coordinated-omission fix
+            t_submit_ns = self._t0_ns + int(tr.arrival_s * 1e9)
+            req = dataclasses.replace(
+                tr.request, tokens=list(tr.request.tokens)
+            )
+            try:
+                faults.inject(
+                    "loadgen.arrive", rid=req.rid, scenario=self.scenario
+                )
+            except faults.InjectedFault as e:
+                self.dropped[req.rid] = f"arrival dropped: {e}"
+                obs.counter(
+                    "tpu_patterns_loadgen_requests_total",
+                    scenario=self.scenario, status="dropped",
+                ).inc()
+                obs.event(
+                    "loadgen.drop", rid=str(req.rid),
+                    scenario=self.scenario,
+                )
+                continue
+            batch.append((req, t_submit_ns))
+            self.released += 1
+        return batch
+
+
+@dataclasses.dataclass
+class LoadGenConfig:
+    """CLI ``loadgen`` subcommand: scenario traces with SLO verdicts."""
+
+    # model/pool shape — the same knobs as ServeConfig so `serve
+    # --scenario` maps one-to-one
+    vocab: int = 512
+    embed: int = 128
+    heads: int = 8
+    head_dim: int = 16
+    mlp_mult: int = 4
+    depth: int = 2
+    dtype: str = "float32"
+    rope: bool = True
+    kv_heads: int = 0
+    cache_int8: bool = False
+    slots: int = 8
+    block_len: int = 16
+    n_blocks: int = 0  # 0 = auto: full slots x max_len rectangle + trash
+    spec_k: int = 0  # speculative decoding under load (engine flag)
+    prefix_share: bool = False  # CoW prefix sharing under load
+    watchdog_s: float = 0.0
+    # the workload: comma-separated scenario specs
+    # ("chat,rag:requests=16" — scenarios.parse_scenario grammar)
+    scenarios: tuple[str, ...] = ("chat",)
+    seed: int = 0
+    time_scale: float = 1.0  # compress virtual arrival time onto wall
+    slo_ttft_ms: float = 0.0  # > 0 overrides every scenario's preset
+    slo_tpot_ms: float = 0.0
+    min_goodput: float = 1.0  # the SLO pass bar (fraction of tokens)
+    # chaos-under-load: a TPU_PATTERNS_FAULTS spec; each scenario runs a
+    # SECOND time under it, gating bounded p99 + full trace coverage
+    chaos: str = ""
+    chaos_p99_mult: float = 0.0  # > 0 overrides the scenario preset
+
+
+def _resolved_specs(cfg: LoadGenConfig) -> list[ScenarioSpec]:
+    scenarios = cfg.scenarios
+    if isinstance(scenarios, str):
+        # the auto-generated CLI flag hands sequence fields over as the
+        # raw comma-separated string (cli._cfg_from_args does not run
+        # the env-tier coercion); scenario params use ':' so ',' stays
+        # unambiguous as the list separator
+        scenarios = tuple(s for s in scenarios.split(",") if s.strip())
+    specs = []
+    for text in scenarios:
+        spec = parse_scenario(text)
+        overrides = {}
+        if cfg.slo_ttft_ms > 0:
+            overrides["slo_ttft_ms"] = cfg.slo_ttft_ms
+        if cfg.slo_tpot_ms > 0:
+            overrides["slo_tpot_ms"] = cfg.slo_tpot_ms
+        if cfg.chaos_p99_mult > 0:
+            overrides["chaos_p99_mult"] = cfg.chaos_p99_mult
+        if overrides:
+            spec = dataclasses.replace(spec, **overrides)
+        specs.append(spec)
+    if not specs:
+        raise ValueError("loadgen needs at least one scenario")
+    names = [s.name for s in specs]
+    if len(set(names)) != len(names):
+        raise ValueError(
+            f"duplicate scenario presets in one run ({names}): their "
+            "Records would overwrite each other's mode"
+        )
+    return specs
+
+
+def validate_config(cfg: LoadGenConfig) -> None:
+    """The parse-time surface: scenario specs, the chaos spec, and the
+    schedule-shaping scalars.  Raises ValueError on any typo — the CLI
+    calls this BEFORE running so spec errors read as one line (and
+    before the expensive decoder compile), while a ValueError raised
+    mid-run (a genuine engine bug) still carries its traceback."""
+    _resolved_specs(cfg)
+    if cfg.chaos:
+        faults.parse_spec(cfg.chaos)
+    # the checks build_schedule would hit only after the compile
+    if cfg.time_scale <= 0:
+        raise ValueError(f"time_scale must be > 0, got {cfg.time_scale}")
+    if cfg.vocab < 2:
+        raise ValueError(f"vocab must be >= 2, got {cfg.vocab}")
+    if not 0.0 <= cfg.min_goodput <= 1.0:
+        raise ValueError(
+            f"min_goodput is a token fraction in [0, 1], got "
+            f"{cfg.min_goodput}"
+        )
+
+
+def _drive(
+    decoder, params, cfg: LoadGenConfig, spec: ScenarioSpec,
+    schedule: list[TimedRequest],
+) -> tuple[ServeEngine, ArrivalSource]:
+    from tpu_patterns import obs
+
+    eng = ServeEngine(
+        decoder, params, slots=cfg.slots, watchdog_s=cfg.watchdog_s,
+        prefix_share=cfg.prefix_share, spec_k=cfg.spec_k,
+    )
+    source = ArrivalSource(schedule, scenario=spec.name)
+    with obs.span(
+        "loadgen.scenario", scenario=spec.name, requests=len(schedule)
+    ):
+        eng.run([], source=source)
+    return eng, source
+
+
+def _pending_rids(source: ArrivalSource) -> list[int]:
+    """Arrivals the source never released (engine preempted first)."""
+    return [tr.request.rid for tr in source._pending]
+
+
+def _stats(
+    eng: ServeEngine, source: ArrivalSource, schedule: list[TimedRequest]
+) -> dict:
+    """Percentiles + goodput + coverage from one run's lifecycle."""
+    ttft = StreamingPercentiles()
+    tpot = StreamingPercentiles()
+    e2e = StreamingPercentiles()
+    good_tokens = 0
+    done = failed = 0
+    for lc in eng.lifecycle.values():
+        # FAILED requests stay in the latency sample (e2e = time until
+        # the engine gave up, retries and backoff included): excluding
+        # them would let a fault that quarantines the slowest rows
+        # SHRINK the chaos p99 and pass the bounded-degradation gate on
+        # a survivor-biased sample
+        if lc["ttft_ms"] is not None:
+            ttft.observe(lc["ttft_ms"])
+        if lc["tpot_ms"] is not None:
+            tpot.observe(lc["tpot_ms"])
+        e2e.observe(lc["e2e_ms"])
+        if lc["status"] == "done":
+            done += 1
+            if lc["met"]:
+                good_tokens += lc["n_out"]
+        else:
+            failed += 1
+    total_tokens = sum(tr.request.n_gen for tr in schedule)
+    scheduled = {tr.request.rid for tr in schedule}
+    accounted = (
+        set(eng.lifecycle) | set(source.dropped)
+        # preemption returns mid-trace: still-pending work is accounted,
+        # not lost — the coverage gate distinguishes the two
+        | {r.rid for r, _ in eng.queue} | {s.rid for s in eng.active}
+        | set(_pending_rids(source))
+    )
+    return {
+        "ttft": ttft, "tpot": tpot, "e2e": e2e,
+        "done": done, "failed": failed, "dropped": len(source.dropped),
+        "goodput": good_tokens / total_tokens if total_tokens else 0.0,
+        "tokens": sum(
+            lc["n_out"] for lc in eng.lifecycle.values()
+            if lc["status"] == "done"
+        ),
+        "unaccounted": sorted(scheduled - accounted),
+        "deferrals": eng.stats["deferrals"],
+    }
+
+
+def _pcts(sk: StreamingPercentiles) -> tuple[float, float, float]:
+    """(p50, p95, p99), -1 marking an empty series in Record metrics."""
+    if not sk.count:
+        return (-1.0, -1.0, -1.0)
+    return (sk.quantile(0.5), sk.quantile(0.95), sk.quantile(0.99))
+
+
+def _publish_gauges(spec: ScenarioSpec, st: dict) -> None:
+    from tpu_patterns import obs
+
+    for key in ("ttft", "tpot", "e2e"):
+        p50, p95, p99 = _pcts(st[key])
+        for q, v in (("p50", p50), ("p95", p95), ("p99", p99)):
+            obs.gauge(
+                f"tpu_patterns_loadgen_{key}_{q}_ms", scenario=spec.name
+            ).set(v)
+    obs.gauge(
+        "tpu_patterns_loadgen_goodput", scenario=spec.name
+    ).set(st["goodput"])
+    for status, n in (
+        ("done", st["done"]), ("failed", st["failed"]),
+    ):
+        if n:
+            obs.counter(
+                "tpu_patterns_loadgen_requests_total",
+                scenario=spec.name, status=status,
+            ).inc(n)
+
+
+def _injected_total() -> float:
+    from tpu_patterns import obs
+
+    return sum(
+        m.value
+        for m in obs.metrics_registry().metrics()
+        if m.name == "tpu_patterns_faults_injected_total"
+    )
+
+
+def _scenario_commands(cfg: LoadGenConfig, spec: ScenarioSpec) -> str:
+    return (
+        f"req{spec.requests} {spec.arrival}@{spec.rate_rps:g}rps "
+        f"prompt{spec.min_prompt}-{spec.max_prompt} "
+        f"gen{spec.min_gen}-{spec.max_gen} "
+        f"slo {spec.slo_ttft_ms:g}+{spec.slo_tpot_ms:g}ms "
+        f"x{cfg.time_scale:g}"
+    )
+
+
+def run_loadgen(mesh, cfg: LoadGenConfig, writer) -> list:
+    """Measured pattern: one SLO Record per scenario (plus a chaos twin
+    per scenario when ``cfg.chaos`` is set).
+
+    Clean-run gates: every scheduled request retires or is quarantined
+    (nothing unaccounted), no quarantines on a clean run, and
+    goodput-under-SLO >= ``min_goodput``.  Chaos gates: coverage again
+    (done + failed + dropped == scheduled), at least one injected
+    firing, and p99 e2e <= ``chaos_p99_mult`` x the clean run's p99.
+    """
+    import jax
+
+    from tpu_patterns import obs
+    from tpu_patterns.core.results import Record, Verdict
+    from tpu_patterns.models.lm import init_lm_params
+    from tpu_patterns.models.transformer import ModelConfig, _n_experts
+    from tpu_patterns.serve.paged import make_paged_lm_decoder
+
+    specs = _resolved_specs(cfg)
+    mcfg = ModelConfig(
+        embed=cfg.embed, heads=cfg.heads, head_dim=cfg.head_dim,
+        mlp_mult=cfg.mlp_mult, causal=True, dtype=cfg.dtype,
+        depth=cfg.depth, kv_heads=cfg.kv_heads, rope=cfg.rope,
+    )
+    sp = int(mesh.shape["sp"])
+    max_len = max(s.max_prompt + s.max_gen for s in specs)
+    per_row = -(-max_len // cfg.block_len)
+    # default pool: the full rectangle — SLO runs measure queueing and
+    # latency, so deferral should come from load, not a starved pool
+    n_blocks = cfg.n_blocks or (cfg.slots * per_row + 1)
+    decoder = make_paged_lm_decoder(
+        mesh, mcfg, cfg.vocab, n_blocks=n_blocks,
+        block_len=cfg.block_len, max_len=max_len,
+        cache_int8=cfg.cache_int8,
+    )
+    flat_params = init_lm_params(
+        jax.random.key(cfg.seed), mcfg, cfg.vocab, _n_experts(mesh, mcfg)
+    )
+    params = decoder.stack_params(flat_params)
+    if cfg.chaos:
+        faults.parse_spec(cfg.chaos)  # typos fail before any run
+
+    records = []
+    for spec in specs:
+        schedule = build_schedule(
+            spec, vocab=cfg.vocab, seed=cfg.seed,
+            time_scale=cfg.time_scale,
+        )
+        writer.progress(
+            f"loadgen {spec.name}: {len(schedule)} requests over "
+            f"{schedule[-1].arrival_s:.2f}s "
+            f"({_scenario_commands(cfg, spec)})"
+        )
+        eng, source = _drive(decoder, params, cfg, spec, schedule)
+        st = _stats(eng, source, schedule)
+        _publish_gauges(spec, st)
+        ttft_p = _pcts(st["ttft"])
+        tpot_p = _pcts(st["tpot"])
+        e2e_p = _pcts(st["e2e"])
+        ok = (
+            not st["unaccounted"]
+            and st["failed"] == 0
+            and st["dropped"] == 0
+            and eng.preempted_at is None
+            and st["goodput"] >= cfg.min_goodput
+        )
+        rec = Record(
+            pattern="loadgen",
+            mode=f"{spec.name}_sp{sp}",
+            commands=_scenario_commands(cfg, spec),
+            metrics={
+                "goodput": round(st["goodput"], 4),
+                "ttft_p50_ms": round(ttft_p[0], 3),
+                "ttft_p95_ms": round(ttft_p[1], 3),
+                "ttft_p99_ms": round(ttft_p[2], 3),
+                "tpot_p50_ms": round(tpot_p[0], 3),
+                "tpot_p95_ms": round(tpot_p[1], 3),
+                "tpot_p99_ms": round(tpot_p[2], 3),
+                "e2e_p50_ms": round(e2e_p[0], 3),
+                "e2e_p95_ms": round(e2e_p[1], 3),
+                "e2e_p99_ms": round(e2e_p[2], 3),
+                "requests": float(len(schedule)),
+                "done": float(st["done"]),
+                "failed": float(st["failed"]),
+                "dropped": float(st["dropped"]),
+                "deferrals": float(st["deferrals"]),
+                "tokens": float(st["tokens"]),
+                "slo_ttft_ms": spec.slo_ttft_ms,
+                "slo_tpot_ms": spec.slo_tpot_ms,
+            },
+            verdict=Verdict.SUCCESS if ok else Verdict.FAILURE,
+        )
+        if st["unaccounted"]:
+            rec.notes.append(
+                f"request(s) {st['unaccounted'][:8]} neither completed "
+                "nor quarantined nor dropped — scheduler bug"
+            )
+        if st["failed"]:
+            rec.notes.append(
+                f"{st['failed']} request(s) quarantined on a CLEAN run"
+            )
+        if st["goodput"] < cfg.min_goodput:
+            rec.notes.append(
+                f"goodput {st['goodput']:.3f} < {cfg.min_goodput}: "
+                "deadline misses under the scenario SLO "
+                f"(ttft {spec.slo_ttft_ms:g}ms + "
+                f"tpot {spec.slo_tpot_ms:g}ms/token)"
+            )
+        writer.record(rec)
+        records.append(rec)
+
+        if cfg.chaos:
+            records.append(_chaos_record(
+                decoder, params, cfg, spec, schedule, st, sp, writer
+            ))
+    return records
+
+
+def _chaos_record(
+    decoder, params, cfg, spec, schedule, clean_st, sp, writer
+):
+    """The same schedule served again under ``cfg.chaos`` faults."""
+    from tpu_patterns import obs
+    from tpu_patterns.core.results import Record, Verdict
+
+    injected_before = _injected_total()
+    faults.configure(cfg.chaos)
+    try:
+        with obs.span("loadgen.chaos", scenario=spec.name):
+            eng, source = _drive(decoder, params, cfg, spec, schedule)
+    finally:
+        faults.configure(None)
+    injected = _injected_total() - injected_before
+    st = _stats(eng, source, schedule)
+    clean_p99 = _pcts(clean_st["e2e"])[2]
+    chaos_p99 = _pcts(st["e2e"])[2]
+    ratio = chaos_p99 / clean_p99 if clean_p99 > 0 else -1.0
+    covered = not st["unaccounted"] and eng.preempted_at is None
+    bounded = (
+        chaos_p99 < 0  # nothing finished: coverage gate carries it
+        or clean_p99 <= 0
+        or chaos_p99 <= spec.chaos_p99_mult * clean_p99
+    )
+    verdict = Verdict.SUCCESS
+    if not covered or not bounded:
+        verdict = Verdict.FAILURE
+    elif st["failed"] or st["dropped"] or injected == 0:
+        verdict = Verdict.WARNING  # healed (or inert) — not unscathed
+    rec = Record(
+        pattern="loadgen",
+        mode=f"{spec.name}_chaos_sp{sp}",
+        commands=f"{_scenario_commands(cfg, spec)} | {cfg.chaos}",
+        metrics={
+            "goodput": round(st["goodput"], 4),
+            "e2e_p99_ms": round(chaos_p99, 3),
+            "clean_e2e_p99_ms": round(clean_p99, 3),
+            "p99_ratio": round(ratio, 3),
+            "p99_mult_gate": spec.chaos_p99_mult,
+            "injected": injected,
+            "requests": float(len(schedule)),
+            "done": float(st["done"]),
+            "failed": float(st["failed"]),
+            "dropped": float(st["dropped"]),
+            "covered": float(covered),
+            "leaked_blocks": float(eng.leaked_blocks()),
+        },
+        verdict=verdict,
+    )
+    if st["unaccounted"]:
+        rec.notes.append(
+            f"request(s) {st['unaccounted'][:8]} silently lost under "
+            "chaos — done+failed+dropped must cover the trace"
+        )
+    if eng.preempted_at is not None:
+        rec.notes.append(
+            "engine preempted mid-trace by the injected fault; pending "
+            "requests are accounted but the scenario did not complete"
+        )
+    if not bounded:
+        rec.notes.append(
+            f"p99 e2e {chaos_p99:.1f}ms > {spec.chaos_p99_mult:g}x the "
+            f"clean run's {clean_p99:.1f}ms — chaos degradation "
+            "unbounded"
+        )
+    if injected == 0:
+        rec.notes.append(
+            f"chaos spec {cfg.chaos!r} never fired — the chaos leg "
+            "measured a clean run"
+        )
+    for rid in sorted(eng.failed)[:4]:
+        rec.notes.append(f"request {rid} QUARANTINED: {eng.failed[rid]}")
+    writer.record(rec)
+    return rec

@@ -1,0 +1,229 @@
+"""Scenario presets + the ``name[:key=value]*`` spec grammar.
+
+A scenario is everything the load generator needs to synthesize a
+request trace: an arrival process and rate, prompt/output length
+distributions, and the SLO the trace is judged against.  Presets cover
+the canonical serving shapes:
+
+  chat             Poisson arrivals, mid-length prompts, mid-length
+                   answers — independent users typing at a chatbot
+  rag              Poisson arrivals, LONG prompts (retrieved context),
+                   SHORT answers — the long-prompt-short-answer regime
+                   where prefill dominates
+  batch-summarize  diurnal ramp (the nightly batch window filling up),
+                   long prompts, medium summaries — throughput-shaped
+                   traffic that must still respect a deadline
+  agentic          bursty (Markov-modulated) arrivals of SHORT
+                   many-turn requests — an agent loop firing tool-call
+                   volleys
+
+Specs use the same fail-loudly grammar as ``TPU_PATTERNS_FAULTS``
+(faults/injector.py): ``chat:requests=32:rate_rps=8`` overrides preset
+fields by name; unknown presets, unknown keys, and uncoercible values
+all raise at parse time — a typo'd scenario must never silently bench
+something else.
+
+``build_schedule`` turns a spec into the concrete timed trace.  EVERY
+draw (arrival gaps, prompt/output lengths, token ids) comes from one
+``random.Random(seed)``, so the same (spec, seed, time_scale) replays
+bit-identically: same arrival offsets, same lengths, same tokens.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import typing
+
+from tpu_patterns.loadgen.arrivals import ARRIVAL_PROCESSES, arrival_offsets
+from tpu_patterns.serve.engine import Request
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioSpec:
+    """One fully-resolved scenario (preset defaults + overrides)."""
+
+    name: str
+    arrival: str  # poisson | bursty | diurnal
+    requests: int
+    rate_rps: float  # mean arrival rate, virtual requests/second
+    min_prompt: int
+    max_prompt: int
+    mean_prompt: int
+    min_gen: int
+    max_gen: int
+    mean_gen: int
+    slo_ttft_ms: float  # time-to-first-token budget
+    slo_tpot_ms: float  # per-output-token budget after the first
+    # chaos gate: p99 e2e under faults may degrade at most this factor
+    # over the clean run of the same schedule
+    chaos_p99_mult: float
+
+    def __post_init__(self):
+        if self.arrival not in ARRIVAL_PROCESSES:
+            raise ValueError(
+                f"scenario {self.name!r}: unknown arrival process "
+                f"{self.arrival!r} (want one of {sorted(ARRIVAL_PROCESSES)})"
+            )
+        if self.requests < 1:
+            raise ValueError(
+                f"scenario {self.name!r}: requests must be >= 1"
+            )
+        for what, lo, mid, hi in (
+            ("prompt", self.min_prompt, self.mean_prompt, self.max_prompt),
+            ("gen", self.min_gen, self.mean_gen, self.max_gen),
+        ):
+            if not 1 <= lo <= mid <= hi:
+                raise ValueError(
+                    f"scenario {self.name!r}: want 1 <= min_{what} <= "
+                    f"mean_{what} <= max_{what}, got "
+                    f"({lo}, {mid}, {hi})"
+                )
+        if self.rate_rps <= 0:
+            raise ValueError(f"scenario {self.name!r}: rate_rps must be > 0")
+        if self.slo_ttft_ms <= 0 or self.slo_tpot_ms <= 0:
+            raise ValueError(
+                f"scenario {self.name!r}: SLO budgets must be > 0"
+            )
+        if self.chaos_p99_mult < 1.0:
+            raise ValueError(
+                f"scenario {self.name!r}: chaos_p99_mult must be >= 1"
+            )
+
+    def deadline_ms(self, n_gen: int) -> float:
+        """A request's submit->last-token budget: first token under the
+        TTFT budget, every later token under the TPOT budget."""
+        return self.slo_ttft_ms + self.slo_tpot_ms * max(n_gen - 1, 0)
+
+
+# Preset latency budgets are deliberately generous relative to real
+# hardware: the repo's CI runs the engine on a CPU-simulated mesh, and
+# the SLO exists to catch scheduler pathologies (unbounded queueing,
+# starvation, chaos blowups), not to benchmark XLA's CPU backend.
+PRESETS: dict[str, ScenarioSpec] = {
+    "chat": ScenarioSpec(
+        name="chat", arrival="poisson", requests=32, rate_rps=8.0,
+        min_prompt=8, max_prompt=48, mean_prompt=24,
+        min_gen=4, max_gen=24, mean_gen=12,
+        slo_ttft_ms=2000.0, slo_tpot_ms=500.0, chaos_p99_mult=5.0,
+    ),
+    "rag": ScenarioSpec(
+        name="rag", arrival="poisson", requests=24, rate_rps=4.0,
+        min_prompt=48, max_prompt=96, mean_prompt=80,
+        min_gen=2, max_gen=8, mean_gen=4,
+        slo_ttft_ms=4000.0, slo_tpot_ms=500.0, chaos_p99_mult=5.0,
+    ),
+    "batch-summarize": ScenarioSpec(
+        name="batch-summarize", arrival="diurnal", requests=24,
+        rate_rps=6.0,
+        min_prompt=32, max_prompt=96, mean_prompt=64,
+        min_gen=8, max_gen=24, mean_gen=16,
+        slo_ttft_ms=8000.0, slo_tpot_ms=1000.0, chaos_p99_mult=6.0,
+    ),
+    "agentic": ScenarioSpec(
+        name="agentic", arrival="bursty", requests=40, rate_rps=12.0,
+        min_prompt=4, max_prompt=24, mean_prompt=10,
+        min_gen=2, max_gen=10, mean_gen=4,
+        slo_ttft_ms=1500.0, slo_tpot_ms=400.0, chaos_p99_mult=5.0,
+    ),
+}
+
+# the override surface IS the dataclass (minus the identity field) —
+# a new ScenarioSpec field is automatically spellable in the grammar
+_HINTS = typing.get_type_hints(ScenarioSpec)
+_FIELD_TYPES = {
+    f.name: _HINTS[f.name]
+    for f in dataclasses.fields(ScenarioSpec)
+    if f.name != "name"
+}
+
+
+def parse_scenario(text: str) -> ScenarioSpec:
+    """``preset[:key=value]*`` -> a validated ScenarioSpec; malformed
+    input raises (same discipline as faults.parse_spec)."""
+    parts = [p.strip() for p in text.strip().split(":")]
+    name = parts[0]
+    if name not in PRESETS:
+        raise ValueError(
+            f"scenario {text!r}: unknown preset {name!r} "
+            f"(want one of {sorted(PRESETS)})"
+        )
+    overrides: dict[str, object] = {}
+    for part in parts[1:]:
+        if "=" not in part:
+            raise ValueError(f"scenario {text!r}: {part!r} is not key=value")
+        k, v = part.split("=", 1)
+        k = k.strip()
+        ftype = _FIELD_TYPES.get(k)
+        if ftype is None:
+            raise ValueError(
+                f"scenario {text!r}: unknown key {k!r} "
+                f"(options: {sorted(_FIELD_TYPES)})"
+            )
+        try:
+            overrides[k] = ftype(v.strip()) if ftype is not str else v.strip()
+        except (TypeError, ValueError) as e:
+            raise ValueError(
+                f"scenario {text!r}: {k}={v.strip()!r} is not a "
+                f"{ftype.__name__}"
+            ) from e
+    return dataclasses.replace(PRESETS[name], **overrides)
+
+
+@dataclasses.dataclass(frozen=True)
+class TimedRequest:
+    """One scheduled arrival: the request plus its release offset
+    (seconds after the run starts, time scaling already applied)."""
+
+    request: Request
+    arrival_s: float
+
+
+def _tri(rng: random.Random, lo: int, mid: int, hi: int) -> int:
+    """Integer triangular draw clamped to [lo, hi] — mode at the mean
+    field, so presets read as 'mostly around mid, tails to the caps'."""
+    if lo == hi:
+        return lo
+    return max(lo, min(hi, round(rng.triangular(lo, hi, mid))))
+
+
+def build_schedule(
+    spec: ScenarioSpec,
+    *,
+    vocab: int,
+    seed: int = 0,
+    time_scale: float = 1.0,
+) -> list[TimedRequest]:
+    """The concrete trace: per-request arrival offset, prompt tokens,
+    output budget, and deadline — deterministic from the arguments.
+
+    ``time_scale`` compresses virtual ARRIVAL time onto the wall clock
+    (CI runs a day-shaped ramp in seconds).  Deadlines do NOT scale:
+    service time is real compute, so the SLO budget is wall-clock by
+    definition — a compressed run simply queues harder, which is the
+    point.
+    """
+    if time_scale <= 0:
+        raise ValueError(f"time_scale must be > 0, got {time_scale}")
+    if vocab < 2:
+        raise ValueError(f"vocab must be >= 2, got {vocab}")
+    rng = random.Random(seed)
+    offsets = arrival_offsets(
+        spec.arrival, spec.requests, spec.rate_rps, rng
+    )
+    out: list[TimedRequest] = []
+    for rid, off in enumerate(offsets):
+        lp = _tri(rng, spec.min_prompt, spec.mean_prompt, spec.max_prompt)
+        n_gen = _tri(rng, spec.min_gen, spec.mean_gen, spec.max_gen)
+        tokens = [rng.randrange(vocab) for _ in range(lp)]
+        out.append(
+            TimedRequest(
+                request=Request(
+                    rid=rid, tokens=tokens, n_gen=n_gen,
+                    scenario=spec.name,
+                    deadline_ms=spec.deadline_ms(n_gen),
+                ),
+                arrival_s=off * time_scale,
+            )
+        )
+    return out

@@ -43,6 +43,7 @@ from tpu_patterns.obs.metrics import (  # noqa: F401
 )
 from tpu_patterns.obs.spans import (  # noqa: F401
     collective_deadline_s,
+    complete_span,
     enabled,
     event,
     open_spans,

@@ -64,9 +64,32 @@ def dedupe_entries(entries: Iterable[dict]) -> list[dict]:
 
 def chrome_trace(entries: Iterable[dict]) -> dict:
     """trace_event JSON object format: spans -> "X" (complete) events,
-    events -> "i" (instant); ts/dur in microseconds per the schema."""
+    events -> "i" (instant); ts/dur in microseconds per the schema.
+
+    Entries whose attrs carry a request id (the serve engine's
+    per-request lifecycle spans) get their lane named ``req <rid>`` via
+    thread_name metadata, so Perfetto shows one labeled row per request
+    — queued, prefill, decode, retired — under the scheduler's own
+    thread rows."""
     trace_events = []
     pid = os.getpid()
+    lanes: dict[int, str] = {}
+    entries = list(entries)
+    for e in entries:
+        attrs = e.get("attrs") or {}
+        # only lifecycle SPANS name a lane: scheduler-thread EVENTS
+        # (serve.defer, serve.quarantine, fault.injected, ...) also
+        # carry rid attrs but live on the real thread's lane, which
+        # must keep its thread identity
+        if (
+            e.get("kind") == "span"
+            and "rid" in attrs
+            and e.get("tid") is not None
+        ):
+            label = f"req {attrs['rid']}"
+            if attrs.get("scenario"):
+                label += f" [{attrs['scenario']}]"
+            lanes.setdefault(e["tid"], label)
     for e in entries:
         ev = {
             "name": e.get("name", "?"),
@@ -83,7 +106,14 @@ def chrome_trace(entries: Iterable[dict]) -> dict:
             ev["s"] = "t"  # instant scope: thread
         trace_events.append(ev)
     trace_events.sort(key=lambda ev: ev["ts"])
-    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+    meta = [
+        {
+            "name": "thread_name", "ph": "M", "ts": 0.0, "pid": pid,
+            "tid": tid, "args": {"name": label},
+        }
+        for tid, label in sorted(lanes.items())
+    ]
+    return {"traceEvents": meta + trace_events, "displayTimeUnit": "ms"}
 
 
 def write_chrome_trace(entries: Iterable[dict], out_path: str) -> str:

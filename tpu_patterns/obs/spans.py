@@ -182,6 +182,42 @@ def span(name: str, deadline_s: float | None = None, **attrs):
     return Span(name, deadline_s, attrs)
 
 
+def complete_span(
+    name: str, t0_ns: int, dur_ns: int, *, tid: int | None = None, **attrs
+) -> None:
+    """Record an already-timed region directly into the flight recorder.
+
+    The context-manager :func:`span` can only trace a region that nests
+    inside one Python frame; a REQUEST's lifecycle (queued -> prefill ->
+    decode) spreads across many scheduler iterations, so the engine
+    reconstructs it from host timestamps it already holds and books the
+    phases here at retire time.  ``tid`` picks the Chrome-trace lane —
+    serve/engine.py gives every request its own lane, which is what
+    turns the trace export into a per-request timeline
+    (docs/observability.md)."""
+    if not _ENABLED:
+        return
+    t = threading.current_thread()
+    recorder.get().append({
+        "kind": "span",
+        "name": name,
+        "t0_ns": int(t0_ns),
+        "dur_ns": max(int(dur_ns), 0),
+        "span_id": next(_ids),
+        "parent_id": 0,
+        "depth": 0,
+        "tid": tid if tid is not None else (t.ident or 0),
+        "thread": t.name,
+        "attrs": attrs,
+    })
+    from tpu_patterns.obs import metrics
+
+    # graftlint: allow[metric-naming] -- 'span' predates the known-label set; this feeds the SAME series Span.__exit__ does (baselined there)
+    metrics.default().histogram(
+        "tpu_patterns_span_duration_ns", span=name
+    ).observe(int(dur_ns))
+
+
 def event(name: str, **attrs) -> None:
     """Record an instantaneous event into the flight recorder."""
     if not _ENABLED:

@@ -36,6 +36,7 @@ occupancy, per-step latency).
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import json
 import signal
 import threading
@@ -58,11 +59,27 @@ def _bucket(n: int, cap: int) -> int:
     return min(1 << max(0, n - 1).bit_length(), cap)
 
 
+# Chrome-trace lane ids for per-request lifecycle spans: far above any
+# real thread id's low bits so request lanes never collide with thread
+# lanes in the exported timeline (obs/export.py labels them "req <rid>").
+# Each ENGINE takes its own _REQ_LANE_BASE-sized window (the process-
+# wide sequence below): multi-scenario runs and clean+chaos legs all
+# restart rids at 0 into one shared flight recorder, and keying lanes
+# by rid alone would merge different requests onto one mislabeled row.
+_REQ_LANE_BASE = 1_000_000
+_ENGINE_SEQ = itertools.count()
+
+
 @dataclasses.dataclass
 class Request:
     rid: int
     tokens: list[int]  # prompt ids
     n_gen: int  # total tokens to generate (first comes from prefill)
+    # loadgen lifecycle labels: the scenario rides through spans/metrics,
+    # the deadline is the submit->last-token SLO budget (0 = none; the
+    # engine records, the loadgen runner judges)
+    scenario: str = ""
+    deadline_ms: float = 0.0
 
 
 @dataclasses.dataclass
@@ -78,6 +95,14 @@ class _Slot:
     prompt: list[int]  # kept live: drafter context + index bookkeeping
     write_from: int = 0  # prefix-share write fence (prefill-transient)
     own_blocks: tuple[int, ...] = ()  # blocks this row newly indexed
+    # request-lifecycle timestamps (host clock_ns): admission, first
+    # token out of prefill, most recent token — TTFT/TPOT/e2e come from
+    # these at retire/quarantine time, never from extra device syncs
+    scenario: str = ""
+    deadline_ms: float = 0.0
+    t_admit_ns: int = 0
+    t_first_ns: int = 0
+    t_last_ns: int = 0
 
 
 class ServeEngine:
@@ -123,6 +148,12 @@ class ServeEngine:
         self.queue: list[tuple[Request, int]] = []  # (request, t_submit)
         self.active: list[_Slot] = []
         self.done: dict[int, list[int]] = {}
+        # per-request lifecycle: {rid: {submit/admit/first/last_ns,
+        # n_out, status, scenario, deadline_ms, ttft/tpot/e2e_ms, met}}
+        # — written once at retire/quarantine, read by the loadgen
+        # runner for percentiles and goodput-under-SLO
+        self.lifecycle: dict[int, dict] = {}
+        self._lane_base = _REQ_LANE_BASE * (1 + next(_ENGINE_SEQ))
         # per-request verdicts for rows the recovery policy gave up on:
         # {rid: reason} — quarantined, never silently dropped
         self.failed: dict[int, str] = {}
@@ -151,7 +182,12 @@ class ServeEngine:
         # of headroom so n_gen == 1 still reserves the prompt's blocks
         return self.layout.blocks_for(len(req.tokens) + max(req.n_gen - 1, 0))
 
-    def submit(self, req: Request) -> None:
+    def submit(self, req: Request, t_submit_ns: int | None = None) -> None:
+        """Queue ``req``.  ``t_submit_ns`` backdates the submission to
+        the request's SCHEDULED arrival (loadgen): latency the engine
+        caused by being busy when the arrival was due must count
+        against TTFT/e2e, not be silently absorbed (the coordinated-
+        omission trap classic load generators fall into)."""
         if not req.tokens or req.n_gen < 1:
             raise ValueError(f"request {req.rid}: empty prompt or n_gen < 1")
         need = self._blocks_needed(req)
@@ -169,7 +205,9 @@ class ServeEngine:
                 f"request {req.rid}: {span} positions exceed the "
                 f"{self.n_pages}-block table window"
             )
-        self.queue.append((req, clock_ns()))
+        self.queue.append(
+            (req, clock_ns() if t_submit_ns is None else int(t_submit_ns))
+        )
 
     def _occupancy(self) -> float:
         alloc = self.layout.n_blocks - 1 - len(self.free)
@@ -210,10 +248,74 @@ class ServeEngine:
                 for b in s.table:
                     self._release_block(b)
                 self.done[s.rid] = s.out
+                self._finalize_lifecycle(s, "done")
                 obs.counter("tpu_patterns_serve_requests_total").inc()
             else:
                 still.append(s)
         self.active = still
+
+    def _finalize_lifecycle(self, s: _Slot, status: str) -> None:
+        """Close out a request: TTFT/TPOT histograms into the metrics
+        registry and the queued/prefill/decode lifecycle spans into the
+        flight recorder (one Chrome-trace lane per request), all from
+        host timestamps the loop already took — no device sync."""
+        from tpu_patterns import obs
+
+        now = clock_ns()
+        admit = s.t_admit_ns or now
+        first = s.t_first_ns or now
+        last = s.t_last_ns or first
+        n_out = len(s.out)
+        ttft_ms = (first - s.t_submit_ns) / 1e6 if s.t_first_ns else None
+        tpot_ms = (
+            (last - first) / (n_out - 1) / 1e6
+            if s.t_first_ns and n_out > 1
+            else None
+        )
+        e2e_ms = (last - s.t_submit_ns) / 1e6
+        met = (
+            status == "done"
+            and (s.deadline_ms <= 0 or e2e_ms <= s.deadline_ms)
+        )
+        self.lifecycle[s.rid] = {
+            "status": status, "scenario": s.scenario, "n_out": n_out,
+            "submit_ns": s.t_submit_ns, "admit_ns": s.t_admit_ns,
+            "first_ns": s.t_first_ns, "last_ns": last,
+            "ttft_ms": ttft_ms, "tpot_ms": tpot_ms, "e2e_ms": e2e_ms,
+            "deadline_ms": s.deadline_ms, "met": met,
+        }
+        if ttft_ms is not None:
+            obs.histogram("tpu_patterns_serve_ttft_ms").observe(ttft_ms)
+        if tpot_ms is not None:
+            obs.histogram("tpu_patterns_serve_tpot_ms").observe(tpot_ms)
+        # one lane per request in the Chrome trace: queued -> prefill
+        # (admission to first token) -> decode, with first-token and
+        # retirement instants — obs/export.py names the lane "req <rid>"
+        lane = self._lane_base + s.rid
+        attrs = {"rid": s.rid}
+        if s.scenario:
+            attrs["scenario"] = s.scenario
+        if s.t_admit_ns:
+            obs.complete_span(
+                "req.queued", s.t_submit_ns, s.t_admit_ns - s.t_submit_ns,
+                tid=lane, **attrs,
+            )
+        if s.t_admit_ns and s.t_first_ns:
+            obs.complete_span(
+                "req.prefill", admit, first - admit, tid=lane, **attrs
+            )
+        if s.t_first_ns:
+            obs.complete_span(
+                "req.first_token", first, 0, tid=lane, **attrs
+            )
+            obs.complete_span(
+                "req.decode", first, last - first, tid=lane,
+                tokens=n_out, **attrs,
+            )
+        obs.complete_span(
+            "req.retired" if status == "done" else "req.failed",
+            last, 0, tid=lane, **attrs,
+        )
 
     def _admit(self) -> list[tuple[Request, _Slot]]:
         """Pull queued requests into free slots while blocks last; a
@@ -247,6 +349,10 @@ class ServeEngine:
             if need - len(aliased) > len(self.free):
                 self.stats["deferrals"] += 1
                 obs.counter("tpu_patterns_serve_deferrals_total").inc()
+                obs.event(
+                    "serve.defer", rid=str(req.rid),
+                    need=need - len(aliased), free=len(self.free),
+                )
                 break  # FIFO: later (smaller) requests must not starve it
             self.queue.pop(0)
             fresh = [
@@ -265,6 +371,10 @@ class ServeEngine:
                 write_from += plan.donor_len
                 self.stats["cow_copies"] += 1
                 obs.counter("tpu_patterns_serve_cow_copies_total").inc()
+                obs.event(
+                    "serve.cow_copy", rid=str(req.rid),
+                    donor=plan.donor, dst=fresh[0],
+                )
             if aliased:
                 self.stats["prefix_hit_blocks"] += len(aliased)
                 obs.counter(
@@ -275,14 +385,17 @@ class ServeEngine:
                 own_blocks = tuple(
                     self.index.insert(req.tokens, table)
                 )
+            now = clock_ns()
             slot = _Slot(
                 rid=req.rid, lens=len(req.tokens), steps=0,
                 n_gen=req.n_gen, table=table, last_tok=-1, out=[],
                 t_submit_ns=t_submit, prompt=list(req.tokens),
                 write_from=min(write_from, len(req.tokens)),
                 own_blocks=own_blocks,
+                scenario=req.scenario, deadline_ms=req.deadline_ms,
+                t_admit_ns=now,
             )
-            wait_ns = clock_ns() - t_submit
+            wait_ns = now - t_submit
             self.stats["queue_wait_ns"].append(wait_ns)
             obs.histogram("tpu_patterns_serve_queue_wait_ms").observe(
                 wait_ns / 1e6
@@ -351,10 +464,12 @@ class ServeEngine:
             (clock_ns() - t0) / 1e6
         )
         self._pending_cow = []
+        t_tok = clock_ns()  # the wave's first tokens are on the host now
         for i, s in enumerate(slots):
             s.last_tok = int(tok0[i])
             s.out.append(s.last_tok)
             s.write_from = 0  # fence spent: the wave is on device
+            s.t_first_ns = s.t_last_ns = t_tok
             self.stats["tokens"] += 1
         if self.index is not None:
             for s in slots:
@@ -396,10 +511,12 @@ class ServeEngine:
         obs.histogram("tpu_patterns_serve_step_ms").observe(
             (clock_ns() - t0) / 1e6
         )
+        t_tok = clock_ns()
         for i, s in enumerate(self.active):
             s.steps += 1  # the fed token's K/V is now in the pool
             s.last_tok = int(nxt[i])
             s.out.append(s.last_tok)
+            s.t_last_ns = t_tok
             self.stats["tokens"] += 1
         obs.counter("tpu_patterns_serve_tokens_total").inc(len(self.active))
         self.stats["steps"] += 1
@@ -485,6 +602,7 @@ class ServeEngine:
             (clock_ns() - t0) / 1e6
         )
         committed = 0
+        t_tok = clock_ns()
         for i, s in enumerate(self.active):
             d = drafts[i]
             a = 0
@@ -495,6 +613,7 @@ class ServeEngine:
             s.out.extend(commit)
             s.steps += len(commit)  # their K/V is in the pool
             s.last_tok = s.out[-1]
+            s.t_last_ns = t_tok
             committed += len(commit)
             self.stats["tokens"] += len(commit)
             obs.histogram(
@@ -521,6 +640,7 @@ class ServeEngine:
             for b in s.table:
                 self._release_block(b)
             self.failed[s.rid] = reason
+            self._finalize_lifecycle(s, "failed")
             obs.counter("tpu_patterns_serve_quarantined_total").inc()
             obs.event("serve.quarantine", rid=str(s.rid), reason=reason)
 
@@ -672,14 +792,26 @@ class ServeEngine:
 
     # -- the loop --------------------------------------------------------
 
-    def run(self, requests: list[Request]) -> dict[int, list[int]]:
+    def run(
+        self, requests: list[Request], *, source=None
+    ) -> dict[int, list[int]]:
         """Serve ``requests`` to completion; returns {rid: generated ids}.
 
         An empty ``requests`` list continues whatever the queue/active
         set already holds (the resume path after
         :meth:`restore_snapshot`).  If a preemption signal arrives the
         loop finishes the in-flight iteration, snapshots, sets
-        ``preempted_at``, and returns the partial results."""
+        ``preempted_at``, and returns the partial results.
+
+        ``source`` streams arrivals in: a callable polled once per
+        iteration as ``source(idle=...)`` returning newly-arrived
+        requests ([] = nothing yet, None = exhausted).  Batch items are
+        ``Request`` or ``(Request, t_submit_ns)`` — the timestamped
+        form backdates submission to the scheduled arrival so a busy
+        engine's lateness counts as queue wait.  With ``idle`` True
+        the engine has nothing to run — the source owns the wait until
+        its next arrival (loadgen/runner.py paces the wall clock),
+        keeping the scheduler loop itself sleep-free."""
         from tpu_patterns import obs
 
         for r in requests:
@@ -687,7 +819,30 @@ class ServeEngine:
         restore_handlers = self._install_preempt_handlers()
         try:
             with obs.span("serve.run", requests=len(requests)):
-                while self.queue or self.active:
+                while True:
+                    if source is not None:
+                        batch = source(
+                            idle=not (self.queue or self.active)
+                        )
+                        if batch is None:
+                            source = None
+                        else:
+                            for item in batch:
+                                if isinstance(item, tuple):
+                                    self.submit(
+                                        item[0], t_submit_ns=item[1]
+                                    )
+                                else:
+                                    self.submit(item)
+                    if not (self.queue or self.active):
+                        if self._preempt.is_set():
+                            # idle-waiting on future arrivals: the
+                            # signal must not wait for the next one
+                            self._take_preemption()
+                            break
+                        if source is None:
+                            break
+                        continue
                     self._retire()
                     admitted = self._admit()
                     if admitted:
@@ -737,23 +892,24 @@ class ServeEngine:
                         len(self.active)
                     )
                     if self._preempt.is_set():
-                        # deferred from the signal handler (which must
-                        # stay async-signal-safe): count + log here, on
-                        # the loop's own thread with no lock held
-                        obs.counter(
-                            "tpu_patterns_serve_preemptions_total"
-                        ).inc()
-                        obs.event(
-                            "serve.preempt",
-                            signum=str(self._preempt_signum),
-                        )
-                        self.preempted_at = self.stats["steps"]
-                        if self.snapshot_dir:
-                            self.snapshot()
+                        self._take_preemption()
                         break
         finally:
             restore_handlers()
         return dict(self.done)
+
+    def _take_preemption(self) -> None:
+        """Act on a pending preemption at an iteration boundary:
+        deferred from the signal handler (which must stay async-signal-
+        safe), so the counting/logging/snapshot happen here, on the
+        loop's own thread with no lock held."""
+        from tpu_patterns import obs
+
+        obs.counter("tpu_patterns_serve_preemptions_total").inc()
+        obs.event("serve.preempt", signum=str(self._preempt_signum))
+        self.preempted_at = self.stats["steps"]
+        if self.snapshot_dir:
+            self.snapshot()
 
 
 @dataclasses.dataclass
@@ -798,6 +954,16 @@ class ServeConfig:
     snapshot_dir: str = ""
     resume: bool = False
     ids_out: str = ""  # write {rid: generated ids} JSON on completion
+    # trace-driven load generation: a loadgen scenario spec
+    # ("chat", "rag:requests=16", ... — loadgen/scenarios.py grammar).
+    # Set, the run becomes the SLO measured pattern: the scenario's
+    # seeded arrival process drives this model/pool config through the
+    # engine and the Record gates TTFT/TPOT/e2e percentiles +
+    # goodput-under-SLO instead of the speedup race.  The scenario owns
+    # the TRACE shape: requests/min_prompt/max_prompt/gen above are
+    # superseded (spell overrides inside the spec, "chat:requests=64");
+    # snapshot_dir/resume/ids_out are rejected (docs/serving.md)
+    scenario: str = ""
 
 
 def _auto_blocks(cfg: ServeConfig) -> int:
@@ -1242,6 +1408,36 @@ def run_serve(mesh, cfg: ServeConfig, writer) -> list:
         kv_heads=cfg.kv_heads,
         rope=cfg.rope,
     )
+    if cfg.scenario:
+        # the loadgen bridge: the model/pool knobs map one-to-one, the
+        # SCENARIO owns the trace shape — --requests/--min_prompt/
+        # --max_prompt/--gen are superseded by the preset (override
+        # them inside the spec: "chat:requests=64"); flags whose
+        # machinery the scenario path does not run are rejected.
+        if cfg.snapshot_dir or cfg.resume or cfg.ids_out:
+            raise ValueError(
+                "serve --scenario is the SLO measured pattern; run "
+                "preemption (--snapshot_dir/--resume/--ids_out) via the "
+                "plain serve trace instead"
+            )
+        from tpu_patterns.loadgen import LoadGenConfig, run_loadgen
+
+        return run_loadgen(
+            mesh,
+            LoadGenConfig(
+                vocab=cfg.vocab, embed=cfg.embed, heads=cfg.heads,
+                head_dim=cfg.head_dim, mlp_mult=cfg.mlp_mult,
+                depth=cfg.depth, dtype=cfg.dtype, rope=cfg.rope,
+                kv_heads=cfg.kv_heads, cache_int8=cfg.cache_int8,
+                slots=cfg.slots, block_len=cfg.block_len,
+                n_blocks=cfg.n_blocks, spec_k=cfg.spec_k,
+                prefix_share=cfg.prefix_share,
+                watchdog_s=cfg.watchdog_s, seed=cfg.seed,
+                scenarios=(cfg.scenario,),
+            ),
+            writer,
+        )
+
     sp = int(mesh.shape["sp"])
     max_len = cfg.max_prompt + cfg.gen
     n_blocks = cfg.n_blocks or _auto_blocks(cfg)

@@ -510,6 +510,70 @@ def serve_specs(quick: bool = False) -> list[SweepSpec]:
     ]
 
 
+def loadgen_specs(quick: bool = False) -> list[SweepSpec]:
+    """Trace-driven SLO matrix: one cell per scenario preset (chat /
+    rag / batch-summarize / agentic — each a different arrival process
+    and length mix through the SAME engine) plus one chaos-under-load
+    cell re-serving the chat schedule with transient decode faults
+    injected, gating bounded p99 degradation and full trace coverage.
+    SLOs are CPU-mesh generous: the cells gate scheduler behavior
+    (queueing, starvation, recovery), not XLA's CPU latency."""
+    env = (("TPU_PATTERNS_SWEEP_CONFIG", "loadgen"),)
+    if quick:
+        shape = (
+            "--vocab", "64", "--embed", "64", "--head_dim", "8",
+            "--depth", "1", "--slots", "4", "--block_len", "8",
+            "--time_scale", "0.02",
+            "--slo_ttft_ms", "60000", "--slo_tpot_ms", "20000",
+        )
+        scen = {
+            "chat": "chat:requests=6:min_prompt=4:mean_prompt=8"
+                    ":max_prompt=16:min_gen=2:mean_gen=4:max_gen=6",
+            "rag": "rag:requests=5:min_prompt=12:mean_prompt=20"
+                   ":max_prompt=24:min_gen=2:mean_gen=3:max_gen=4",
+            "batch_summarize": "batch-summarize:requests=5:min_prompt=8"
+                               ":mean_prompt=16:max_prompt=24:min_gen=3"
+                               ":mean_gen=5:max_gen=8",
+            "agentic": "agentic:requests=8:min_prompt=3:mean_prompt=6"
+                       ":max_prompt=12:min_gen=2:mean_gen=3:max_gen=5",
+        }
+    else:
+        shape = (
+            "--time_scale", "0.05",
+            "--slo_ttft_ms", "30000", "--slo_tpot_ms", "5000",
+        )
+        scen = {
+            "chat": "chat",
+            "rag": "rag",
+            "batch_summarize": "batch-summarize",
+            "agentic": "agentic",
+        }
+    specs = [
+        SweepSpec(
+            name=f"loadgen.{cell}",
+            argv=("loadgen", "--scenarios", spec, *shape),
+            env=env,
+        )
+        for cell, spec in scen.items()
+    ]
+    # chaos-under-load: two separated transient decode faults (each one
+    # retry, never two-in-a-row = no quarantine) — latency degrades,
+    # boundedly, and nothing is lost
+    specs.append(
+        SweepSpec(
+            name="loadgen.chaos_chat",
+            argv=(
+                "loadgen", "--scenarios", scen["chat"], *shape,
+                "--chaos",
+                "serve.step:error:count=1,serve.step:error:after=6:count=1",
+                "--chaos_p99_mult", "50",
+            ),
+            env=env,
+        )
+    )
+    return specs
+
+
 def hier_specs(quick: bool = False) -> list[SweepSpec]:
     """Multi-slice hierarchy matrix: outer (DCN) axis size x dtype — the
     flat-vs-hierarchical contrast at each hierarchy split."""
@@ -1406,6 +1470,7 @@ SUITES = {
     "longctx": longctx_specs,
     "parallel": parallel_specs,
     "serve": serve_specs,
+    "loadgen": loadgen_specs,
 }
 
 
