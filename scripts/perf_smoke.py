@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""CI gate for perfwatch (docs/observability.md "Performance trajectory").
+
+Four legs through the real CLI on the simulated 8-device CPU mesh:
+
+  (a) ``perf diff`` against the COMMITTED ``tpu_patterns/perf/
+      baseline.json`` must exit 0: the device-independent analytic
+      entries ratchet everywhere, while measured/compiled entries from
+      a foreign mesh fingerprint are skipped visibly instead of
+      false-failing on a different host.  Measured entries run
+      informational here (``--measured_tol -1``): a committed pin ages
+      across the load regimes of a shared host, so wall-clock gating
+      belongs to the same-regime legs below, where the pin is fresh;
+  (b) a fresh ``perf update-baseline`` to a temp path, then a clean
+      ``perf diff`` against it, must exit 0 — two clean back-to-back
+      runs sit inside the noise bands on the SAME machine, where the
+      measured gates are live;
+  (c) the synthetic-regression leg: the same diff re-run with an
+      injected ``serve.step`` sleep (TPU_PATTERNS_FAULTS) must exit
+      NONZERO and name the step-time regression per-executable in the
+      serve.step Record's notes;
+  (d) provenance: every banked Record carries run_id + git SHA, the
+      two CLI invocations carry DISTINCT run_ids, and the history
+      store under --perf-dir gained one snapshot per capture.
+
+Zero dependencies beyond the package; exit 0 = pass.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the committed baseline's capture shape: PerfConfig defaults on the
+# (1, 4, 2) mesh — these flags and the baseline must move together
+MESH_ARGS = ["--dp", "1", "--tp", "2"]
+
+
+def _run_cli(tag: str, jsonl: str, args: list[str], env: dict) -> tuple:
+    cmd = [
+        sys.executable, "-m", "tpu_patterns", "--jsonl", jsonl,
+        "perf", *args,
+    ]
+    print(f"+ [{tag}]", " ".join(cmd), flush=True)
+    t0 = time.monotonic()
+    proc = subprocess.run(cmd, env=env, cwd=ROOT)
+    wall = time.monotonic() - t0
+    print(f"  [{tag}] rc={proc.returncode} wall={wall:.1f}s", flush=True)
+    recs = []
+    if os.path.exists(jsonl):
+        with open(jsonl) as f:
+            recs = [json.loads(ln) for ln in f if ln.strip()]
+    return proc.returncode, recs
+
+
+def main() -> int:
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    work = tempfile.mkdtemp(prefix="perf_smoke_")
+    perf_dir = os.path.join(work, "perf")
+    tmp_baseline = os.path.join(work, "baseline.json")
+
+    # (a) the committed ratchet: capture -> diff -> exit 0
+    rc, recs = _run_cli(
+        "committed-diff", os.path.join(work, "a.jsonl"),
+        ["diff", *MESH_ARGS, "--perf-dir", perf_dir,
+         "--measured_tol", "-1"], env,
+    )
+    if rc != 0:
+        print(
+            "perf smoke: diff vs the committed baseline failed — "
+            "either a real regression or the baseline needs a "
+            "deliberate `perf update-baseline`",
+            file=sys.stderr,
+        )
+        return 1
+    summary = next(
+        (r for r in recs if r.get("mode") == "diff"), None
+    )
+    if summary is None or summary.get("verdict") != "SUCCESS":
+        print(f"perf smoke: no SUCCESS diff summary in {len(recs)} "
+              "records", file=sys.stderr)
+        return 1
+    per_exec = [r for r in recs if r.get("mode") != "diff"]
+    print(
+        f"perf smoke: committed diff checked="
+        f"{summary['metrics'].get('checked')} skipped="
+        f"{summary['metrics'].get('skipped')} over {len(per_exec)} "
+        "executables",
+        flush=True,
+    )
+    run_ids = {r.get("run", {}).get("run_id") for r in recs}
+    if None in run_ids or "" in run_ids:
+        print("perf smoke: a Record is missing its run stamp",
+              file=sys.stderr)
+        return 1
+    if any(not r.get("run", {}).get("git_sha") for r in recs):
+        print("perf smoke: a Record is missing its git SHA",
+              file=sys.stderr)
+        return 1
+    if len(run_ids) != 1:
+        print(f"perf smoke: one CLI run must stamp one run_id, got "
+              f"{run_ids}", file=sys.stderr)
+        return 1
+
+    # (b) same-machine pin + clean diff: the measured gates are LIVE
+    rc, _ = _run_cli(
+        "pin", os.path.join(work, "b.jsonl"),
+        ["update-baseline", *MESH_ARGS, "--baseline", tmp_baseline,
+         "--perf-dir", perf_dir], env,
+    )
+    if rc != 0:
+        print("perf smoke: update-baseline failed", file=sys.stderr)
+        return 1
+    rc, recs_clean = _run_cli(
+        "clean-diff", os.path.join(work, "c.jsonl"),
+        ["diff", *MESH_ARGS, "--baseline", tmp_baseline,
+         "--include", "serve.step,decoder.step", "--perf-dir", perf_dir],
+        env,
+    )
+    if rc != 0:
+        print(
+            "perf smoke: clean back-to-back diff failed — the noise "
+            "band no longer covers this host's jitter",
+            file=sys.stderr,
+        )
+        return 1
+
+    # (c) the synthetic regression MUST fail, named per-executable
+    fault_env = dict(env)
+    fault_env["TPU_PATTERNS_FAULTS"] = (
+        "serve.step:sleep:delay_s=0.1:count=100000"
+    )
+    rc, recs_fault = _run_cli(
+        "fault-diff", os.path.join(work, "d.jsonl"),
+        ["diff", *MESH_ARGS, "--baseline", tmp_baseline,
+         "--include", "serve.step", "--no-history"], fault_env,
+    )
+    if rc == 0:
+        print(
+            "perf smoke: injected serve.step sleep was NOT flagged — "
+            "the ratchet is blind",
+            file=sys.stderr,
+        )
+        return 1
+    bad = next(
+        (r for r in recs_fault
+         if r.get("mode") == "serve.step"
+         and r.get("verdict") == "FAILURE"),
+        None,
+    )
+    if bad is None or not any(
+        "step_ms" in n for n in bad.get("notes", [])
+    ):
+        print(
+            "perf smoke: regression not named per-executable "
+            f"(records: {[r.get('mode') for r in recs_fault]})",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"perf smoke: injected stall flagged — {bad['notes'][0]}",
+        flush=True,
+    )
+
+    # (d) distinct run_ids across invocations + history grew
+    other = {
+        r.get("run", {}).get("run_id") for r in recs_clean
+    }
+    if run_ids & other:
+        print("perf smoke: two CLI runs shared a run_id",
+              file=sys.stderr)
+        return 1
+    hist = os.path.join(perf_dir, "history.jsonl")
+    with open(hist) as f:
+        snaps = [json.loads(ln) for ln in f if ln.strip()]
+    if len(snaps) != 3:  # legs a + b + c banked one snapshot each
+        print(f"perf smoke: expected 3 history snapshots, got "
+              f"{len(snaps)}", file=sys.stderr)
+        return 1
+    print("perf smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,6 +35,10 @@ class TestParser:
             ["obs", "summarize"],
             ["obs", "export", "--chrome-trace", "t.json", "--prom"],
             ["doctor", "--watch_jsonl", "w.jsonl"],
+            ["perf", "report", "--tp", "2"],
+            ["perf", "diff", "--include", "serve.step",
+             "--measured_tol", "0.5"],
+            ["perf", "update-baseline", "--baseline", "b.json"],
         ):
             args = p.parse_args(argv)
             assert args.cmd == argv[0]

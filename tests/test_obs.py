@@ -377,6 +377,45 @@ class TestMetrics:
             (("scenario", "chat"), ("status", "done")),
         )] == 7
 
+    def test_perf_series_export_with_executable_label(self):
+        # the perfwatch series (perf/registry.py): per-executable
+        # gauges keyed by the registry entry name, the capture counter
+        # under the _total convention, everything in the one namespace
+        reg = obs_metrics.Registry()
+        reg.gauge(
+            "tpu_patterns_perf_step_ms", executable="decoder.step"
+        ).set(5.2)
+        reg.gauge(
+            "tpu_patterns_perf_analytic_flops", executable="decoder.step"
+        ).set(966656.0)
+        reg.gauge(
+            "tpu_patterns_perf_achieved_gflops", executable="serve.step"
+        ).set(0.13)
+        reg.gauge(
+            "tpu_patterns_perf_achieved_gbps", executable="serve.step"
+        ).set(0.07)
+        reg.counter("tpu_patterns_perf_captures_total").inc()
+        text = reg.to_prom_text()
+        assert "# TYPE tpu_patterns_perf_step_ms gauge" in text
+        assert (
+            "# TYPE tpu_patterns_perf_captures_total counter" in text
+        )
+        samples = obs.parse_prom_text(text)
+        assert samples[(
+            "tpu_patterns_perf_step_ms",
+            (("executable", "decoder.step"),),
+        )] == 5.2
+        assert samples[(
+            "tpu_patterns_perf_achieved_gflops",
+            (("executable", "serve.step"),),
+        )] == 0.13
+        assert samples[("tpu_patterns_perf_captures_total", ())] == 1
+        # and the dump replays losslessly (the history/debug path)
+        back = obs_metrics.registry_from_jsonl(
+            reg.to_jsonl().splitlines()
+        )
+        assert back.to_prom_text() == text
+
 
 class TestChromeTrace:
     def test_schema_and_ordering(self, tmp_path):

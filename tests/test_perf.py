@@ -1,0 +1,626 @@
+"""perfwatch (tpu_patterns/perf): provenance stamps, analytic cost
+accounting, the shared ratchet core, noise-banded baseline diffs, the
+history/timeline store, and the capture -> diff loop including a
+faults-driven step-time regression."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tpu_patterns.core import ratchet
+from tpu_patterns.core.results import Record, ResultWriter
+from tpu_patterns.perf import analytic, provenance
+from tpu_patterns.perf import baseline as perf_baseline
+from tpu_patterns.perf import history as perf_history
+from tpu_patterns.perf import report as perf_report
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- provenance ------------------------------------------------------------
+
+
+class TestProvenance:
+    def test_two_runs_in_one_process_get_distinct_run_ids(self):
+        a = provenance.new_run()
+        b = provenance.new_run()
+        assert a.run_id != b.run_id
+        # the code and the environment did NOT change between them
+        assert a.git_sha == b.git_sha
+        assert a.mesh_fp == b.mesh_fp
+
+    def test_stamp_fields_shape(self):
+        d = provenance.stamp_dict()
+        assert set(d) == {"run_id", "git_sha", "mesh_fp"}
+        assert len(d["mesh_fp"]) == 12
+
+    def test_result_writer_stamps_every_record(self, tmp_path):
+        path = tmp_path / "r.jsonl"
+        w = ResultWriter(jsonl_path=path, stream=open(os.devnull, "w"))
+        w.record(Record(pattern="p", mode="m"))
+        w.record(Record(pattern="p", mode="m2"))
+        lines = [json.loads(ln) for ln in open(path)]
+        for d in lines:
+            assert d["run"]["run_id"]
+            assert "git_sha" in d["run"] and "mesh_fp" in d["run"]
+        # one writer session = one run: the two records agree
+        assert lines[0]["run"] == lines[1]["run"]
+
+    def test_cli_main_rotates_the_run_stamp(self, tmp_path, capsys):
+        from tpu_patterns.cli import main
+
+        log = tmp_path / "x.log"
+        log.write_text("## m | c | SUCCESS\n")
+        main(["report", str(log)])
+        first = provenance.current_stamp().run_id
+        main(["report", str(log)])
+        second = provenance.current_stamp().run_id
+        capsys.readouterr()
+        assert first != second
+
+    def test_mesh_fp_is_a_pure_function_of_the_env(self, monkeypatch):
+        # the fingerprint must be identical whether the stamp is taken
+        # before or after backend init (fresh CLI vs warm worker) —
+        # live backend state must never fold in
+        import jax
+
+        a = provenance.mesh_fingerprint()
+        jax.devices()  # force backend init (a no-op if already up)
+        assert provenance.mesh_fingerprint() == a
+        monkeypatch.setenv("TPU_PATTERNS_CPU_DEVICES", "99")
+        assert provenance.mesh_fingerprint() != a  # env DOES identify
+
+    def test_reexported_dump_keeps_the_source_runs_stamp(self):
+        # obs export --prom re-renders a PAST run's dump: the numbers
+        # must stay attributed to the run that produced them
+        from tpu_patterns.obs import metrics as obs_metrics
+
+        reg = obs_metrics.Registry()
+        reg.gauge("tpu_patterns_perf_step_ms", executable="x").set(1.0)
+        lines = reg.to_jsonl().splitlines()
+        head = json.loads(lines[0])
+        head["run_id"], head["git_sha"] = "src-run", "src-sha"
+        lines[0] = json.dumps(head, sort_keys=True)
+        back = obs_metrics.registry_from_jsonl(lines)
+        assert back.run_stamp["run_id"] == "src-run"
+        assert "run_id=src-run" in back.to_prom_text().splitlines()[0]
+        rehead = json.loads(back.to_jsonl().splitlines()[0])
+        assert rehead["run_id"] == "src-run"
+        assert rehead["git_sha"] == "src-sha"
+
+    def test_metrics_dumps_carry_the_stamp(self):
+        from tpu_patterns.obs import metrics as obs_metrics
+
+        reg = obs_metrics.Registry()
+        reg.gauge("tpu_patterns_perf_step_ms", executable="x").set(1.5)
+        head = json.loads(reg.to_jsonl().splitlines()[0])
+        assert head["type"] == "run" and head["run_id"]
+        assert reg.to_prom_text().splitlines()[0].startswith("# RUN ")
+        # replay skips the stamp line instead of choking on it
+        back = obs_metrics.registry_from_jsonl(
+            reg.to_jsonl().splitlines()
+        )
+        assert back.to_prom_text() == reg.to_prom_text()
+
+
+# -- analytic accounting ---------------------------------------------------
+
+
+def _mcfg(**kw):
+    from tpu_patterns.models.transformer import ModelConfig
+
+    base = dict(
+        embed=64, heads=4, head_dim=16, mlp_mult=4, causal=True,
+        dtype="float32", depth=2, rope=True,
+    )
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+class TestAnalytic:
+    def test_prefill_matches_hand_computed_count(self):
+        # independent derivation, term by term at literal dims:
+        # B=4 rows, L=24, E=64, H=4, D=16 (HD=64), hidden=256, depth=2,
+        # V=256 — the acceptance bar is 5%
+        cfg = _mcfg()
+        B, L, E, HD, HID, DEPTH, V = 4, 24, 64, 64, 256, 2, 256
+        qkv = 2 * B * L * E * (3 * HD)  # fused q,k,v projections
+        out = 2 * B * L * HD * E
+        scores = 2 * B * 4 * L * L * 16 / 2  # per-head q.K, causal half
+        attnv = 2 * B * 4 * L * L * 16 / 2  # per-head scores.V
+        mlp = 2 * B * L * E * HID + 2 * B * L * HID * E
+        hand = DEPTH * (qkv + out + scores + attnv + mlp) + 2 * B * E * V
+        got = analytic.prefill_flops(cfg, V, B, L)
+        assert abs(got - hand) / hand < 0.05, (got, hand)
+
+    def test_step_matches_hand_computed_count(self):
+        # one token per row attending over ctx=24 cached positions
+        cfg = _mcfg()
+        B, E, HD, HID, DEPTH, V, CTX = 4, 64, 64, 256, 2, 256, 24
+        qkv = 2 * B * E * (3 * HD)
+        out = 2 * B * HD * E
+        attn = 2 * B * HD * CTX + 2 * B * HD * CTX
+        mlp = 2 * B * E * HID + 2 * B * HID * E
+        hand = DEPTH * (qkv + out + attn + mlp) + 2 * B * E * V
+        got = analytic.step_flops(cfg, V, B, CTX)
+        assert abs(got - hand) / hand < 0.05, (got, hand)
+
+    def test_step_bytes_match_hand_computed_floor(self):
+        # params once + ctx KV read + 1 KV write + f32 logits out
+        cfg = _mcfg()
+        B, V, CTX = 4, 256, 24
+        pbytes = analytic.param_count(cfg, V) * 4  # float32
+        kv_tok = 2 * (2 * 4 * 16 * 4)  # depth * (K+V * Hkv*D * 4B)
+        hand = pbytes + B * CTX * kv_tok + B * kv_tok + B * V * 4
+        got = analytic.step_hbm_bytes(cfg, V, B, CTX)
+        assert abs(got - hand) / hand < 0.05, (got, hand)
+
+    def test_gqa_shrinks_kv_projection_only(self):
+        full = analytic.step_flops(_mcfg(), 256, 4, 24)
+        gqa = analytic.step_flops(_mcfg(kv_heads=2), 256, 4, 24)
+        assert gqa < full
+        # the delta is exactly the kv projection halving, per layer:
+        # 2*B*E*(2*KVD_full - 2*KVD_gqa) = 2*4*64*64, times depth 2
+        assert full - gqa == 2 * (2 * 4 * 64 * 64)
+
+    def test_verify_width_one_approximates_a_step(self):
+        cfg = _mcfg()
+        v1 = analytic.verify_flops(cfg, 256, 4, 1, 24)
+        st = analytic.step_flops(cfg, 256, 4, 24)
+        assert abs(v1 - st) / st < 0.01
+
+    def test_param_count_matches_the_real_tree(self):
+        import jax
+
+        from tpu_patterns.models.lm import init_lm_params
+
+        cfg = _mcfg()
+        flat = init_lm_params(jax.random.key(0), cfg, 256, 0)
+        real = sum(int(np.prod(v.shape)) for v in flat.values())
+        assert analytic.param_count(cfg, 256) == real
+
+    def test_train_flops_agree_with_flagship_accounting(self):
+        from tpu_patterns.models.transformer import flagship_flops
+
+        cfg = _mcfg()
+        got = analytic.train_step_flops(cfg, 8, 32)
+
+        class Duck:
+            batch, seq, embed, heads, head_dim = 8, 32, 64, 4, 16
+            kv_heads, mlp_mult, causal, depth = 0, 4, True, 2
+            remat, remat_policy = False, "full"
+
+        assert got == flagship_flops(Duck())
+
+
+# -- the shared ratchet core -----------------------------------------------
+
+
+class TestRatchetCore:
+    def test_save_load_round_trip_and_version_gate(self, tmp_path):
+        path = str(tmp_path / "b.json")
+        entries = [
+            {"fingerprint": "aa", "justification": "", "v": 1},
+            {"fingerprint": "bb", "justification": "why", "v": 2},
+        ]
+        assert ratchet.save_entries(path, entries, version=3) == 2
+        back = ratchet.load_entries(path, version=3)
+        assert set(back) == {"aa", "bb"}
+        with pytest.raises(ValueError, match="baseline version"):
+            ratchet.load_entries(path, version=4)
+
+    def test_missing_file_is_empty_not_an_error(self, tmp_path):
+        assert ratchet.load_entries(
+            str(tmp_path / "absent.json"), version=1
+        ) == {}
+
+    def test_justifications_survive_a_repin(self):
+        old = {"aa": {"fingerprint": "aa", "justification": "pinned why"}}
+        new = ratchet.preserve_justifications(
+            [{"fingerprint": "aa", "justification": ""},
+             {"fingerprint": "bb", "justification": "fresh"}],
+            old,
+        )
+        assert new[0]["justification"] == "pinned why"
+        assert new[1]["justification"] == "fresh"
+
+    def test_split_entries_with_stale_filter(self):
+        baseline = {
+            "aa": {"fingerprint": "aa", "rule": "r1"},
+            "bb": {"fingerprint": "bb", "rule": "r2"},
+        }
+        new, pinned, stale = ratchet.split_entries(
+            {"aa", "cc"}, baseline,
+            stale_filter=lambda e: e["rule"] == "r1",
+        )
+        assert new == {"cc"} and pinned == {"aa"}
+        assert stale == []  # bb's rule did not run -> not declared fixed
+
+    def test_committed_analysis_baseline_still_loads(self):
+        # the extraction must keep graftlint's committed file readable
+        from tpu_patterns.analysis.findings import (
+            default_baseline_path,
+            load_baseline,
+        )
+
+        entries = load_baseline(default_baseline_path())
+        assert entries, "committed analysis baseline should be non-empty"
+        for e in entries.values():
+            assert {"rule", "path", "fingerprint", "text"} <= set(e)
+
+
+# -- the perf baseline bands -----------------------------------------------
+
+
+def _snapshot(step_ms=5.0, flops=1e8, mesh_fp="m1", **extra):
+    ex = {
+        "analytic_flops": flops,
+        "step_ms": step_ms,
+        "temp_bytes": 1000.0,
+        "compile_s": 2.0,
+    }
+    ex.update(extra)
+    return {
+        "run": {"run_id": "r", "git_sha": "s", "mesh_fp": mesh_fp},
+        "ts": 1.0,
+        "config": {"embed": 64, "k": 3},
+        "mesh": {"shape": {"dp": 1, "sp": 4, "tp": 2}, "devices": 8,
+                 "platform": "cpu"},
+        "executables": {"decoder.step": ex},
+    }
+
+
+class TestPerfBaseline:
+    def _pin(self, tmp_path, snap):
+        path = str(tmp_path / "perf.json")
+        perf_baseline.save_baseline(path, snap, {})
+        return path, perf_baseline.load_baseline(path)
+
+    def test_clean_diff_against_own_pin_passes(self, tmp_path):
+        snap = _snapshot()
+        _, bl = self._pin(tmp_path, snap)
+        d = perf_baseline.diff_snapshot(snap, bl)
+        assert d.exit_code == 0
+        assert not d.regressions and not d.unbaselined and not d.stale
+        assert d.checked > 0
+
+    def test_measured_band_flags_only_a_real_stall(self, tmp_path):
+        _, bl = self._pin(tmp_path, _snapshot(step_ms=5.0))
+        # 2x regime shift on a shared CPU host: inside the band
+        ok = perf_baseline.diff_snapshot(_snapshot(step_ms=10.0), bl)
+        assert ok.exit_code == 0
+        # 4x IS a stall (an injected sleep is 10-20x)
+        bad = perf_baseline.diff_snapshot(_snapshot(step_ms=20.0), bl)
+        assert bad.exit_code == 1
+        assert bad.regressions[0].executable == "decoder.step"
+        assert bad.regressions[0].metric == "step_ms"
+        assert "decoder.step.step_ms" in bad.regressions[0].message()
+
+    def test_measured_improvement_is_not_a_failure(self, tmp_path):
+        _, bl = self._pin(tmp_path, _snapshot(step_ms=50.0))
+        d = perf_baseline.diff_snapshot(_snapshot(step_ms=1.0), bl)
+        assert d.exit_code == 0
+        assert d.improvements and d.improvements[0].metric == "step_ms"
+
+    def test_analytic_drift_gates_both_directions(self, tmp_path):
+        _, bl = self._pin(tmp_path, _snapshot(flops=1e8))
+        # FLOPs silently DROPPING = work dead-code-eliminated out of
+        # the measured program — the grad-gate accounting bug class
+        d = perf_baseline.diff_snapshot(_snapshot(flops=0.9e8), bl)
+        assert d.exit_code == 1
+        d = perf_baseline.diff_snapshot(_snapshot(flops=1.1e8), bl)
+        assert d.exit_code == 1
+        d = perf_baseline.diff_snapshot(_snapshot(flops=1e8 * 1.0005), bl)
+        assert d.exit_code == 0
+
+    def test_foreign_mesh_fp_skips_machine_bound_gates_only(
+        self, tmp_path
+    ):
+        _, bl = self._pin(tmp_path, _snapshot(step_ms=5.0, flops=1e8))
+        # another machine: 100x step time is SKIPPED, visible not fatal
+        d = perf_baseline.diff_snapshot(
+            _snapshot(step_ms=500.0, flops=1e8, mesh_fp="other"), bl
+        )
+        assert d.exit_code == 0
+        assert "decoder.step.step_ms" in d.skipped
+        # ... but the device-independent analytic count still gates
+        d = perf_baseline.diff_snapshot(
+            _snapshot(step_ms=500.0, flops=2e8, mesh_fp="other"), bl
+        )
+        assert d.exit_code == 1
+        assert d.regressions[0].metric == "analytic_flops"
+
+    def test_changed_capture_shape_is_unbaselined_not_regressed(
+        self, tmp_path
+    ):
+        _, bl = self._pin(tmp_path, _snapshot())
+        moved = _snapshot(step_ms=500.0, flops=7e9)
+        moved["config"]["embed"] = 128  # a different capture shape
+        d = perf_baseline.diff_snapshot(moved, bl)
+        assert d.exit_code == 0
+        assert d.unbaselined and d.stale  # re-pin deliberately
+
+    def test_measurement_policy_is_not_identity(self, tmp_path):
+        _, bl = self._pin(tmp_path, _snapshot())
+        quieter = _snapshot()
+        quieter["config"]["k"] = 11  # raising k must not churn the pin
+        d = perf_baseline.diff_snapshot(quieter, bl)
+        assert not d.unbaselined and not d.stale
+
+    def test_justification_survives_update(self, tmp_path):
+        snap = _snapshot()
+        path, bl = self._pin(tmp_path, snap)
+        fp = perf_baseline.fingerprint(
+            "decoder.step", "step_ms",
+            perf_baseline.config_fingerprint(snap),
+        )
+        bl[fp]["justification"] = "accepted: scheduler rework tax"
+        ratchet.save_entries(
+            path, sorted(bl.values(), key=lambda e: e["fingerprint"]),
+            version=perf_baseline.BASELINE_VERSION,
+        )
+        perf_baseline.save_baseline(
+            path, snap, perf_baseline.load_baseline(path)
+        )
+        again = perf_baseline.load_baseline(path)
+        assert again[fp]["justification"] == (
+            "accepted: scheduler rework tax"
+        )
+
+    def test_tolerance_override(self, tmp_path):
+        _, bl = self._pin(tmp_path, _snapshot(step_ms=5.0))
+        d = perf_baseline.diff_snapshot(
+            _snapshot(step_ms=10.0), bl, tolerances={"measured": 0.5}
+        )
+        assert d.exit_code == 1  # the quiet-box band catches a 2x
+
+    def test_tolerance_none_makes_measured_informational(self, tmp_path):
+        # the committed-ledger mode (perf diff --measured_tol -1): an
+        # aged pin's wall-clock entries stop gating entirely while the
+        # analytic ratchet stays live
+        _, bl = self._pin(tmp_path, _snapshot(step_ms=5.0, flops=1e8))
+        d = perf_baseline.diff_snapshot(
+            _snapshot(step_ms=500.0, flops=1e8), bl,
+            tolerances={"measured": None},
+        )
+        assert d.exit_code == 0
+        d = perf_baseline.diff_snapshot(
+            _snapshot(step_ms=500.0, flops=2e8), bl,
+            tolerances={"measured": None},
+        )
+        assert d.exit_code == 1
+        assert d.regressions[0].metric == "analytic_flops"
+
+    def test_subset_capture_never_declares_the_rest_stale(
+        self, tmp_path
+    ):
+        snap = _snapshot()
+        snap["executables"]["train.step"] = {
+            "analytic_flops": 2e8, "step_ms": 9.0,
+        }
+        _, bl = self._pin(tmp_path, snap)
+        only = _snapshot()  # decoder.step alone "ran"
+        d = perf_baseline.diff_snapshot(only, bl)
+        assert d.exit_code == 0
+        assert not d.stale
+
+    def test_informational_classes_never_gate(self, tmp_path):
+        _, bl = self._pin(tmp_path, _snapshot(compile_s=2.0))
+        d = perf_baseline.diff_snapshot(_snapshot(compile_s=200.0), bl)
+        assert d.exit_code == 0
+
+
+# -- history + timeline ----------------------------------------------------
+
+
+class TestHistoryTimeline:
+    def test_append_and_load_round_trip(self, tmp_path):
+        d = str(tmp_path / "perf")
+        s1, s2 = _snapshot(), _snapshot(step_ms=6.0)
+        perf_history.append_snapshot(s1, d)
+        perf_history.append_snapshot(s2, d)
+        back = perf_history.load_history(d)
+        assert len(back) == 2
+        assert back[1]["executables"]["decoder.step"]["step_ms"] == 6.0
+
+    def test_torn_tail_line_is_skipped(self, tmp_path):
+        d = str(tmp_path / "perf")
+        perf_history.append_snapshot(_snapshot(), d)
+        with open(perf_history.history_path(d), "a") as f:
+            f.write('{"run": {"trunc')
+        assert len(perf_history.load_history(d)) == 1
+
+    def test_committed_bench_rounds_land_on_the_timeline(self):
+        rounds = perf_history.load_bench_rounds(ROOT)
+        assert len(rounds) >= 5
+        assert [r["round"] for r in rounds] == sorted(
+            r["round"] for r in rounds
+        )
+        # the hardware outage IS part of the trajectory
+        assert any("unreachable" in r["error"] for r in rounds)
+
+    def test_results_records_are_ingested_with_their_stamps(
+        self, tmp_path
+    ):
+        res = tmp_path / "results"
+        res.mkdir()
+        w = ResultWriter(
+            jsonl_path=res / "serve.jsonl", stream=open(os.devnull, "w")
+        )
+        w.record(Record(pattern="serve", mode="slots8",
+                        metrics={"speedup": 2.7}))
+        (res / "noise.jsonl").write_text(
+            '{"type": "run", "run_id": "x"}\nnot json\n'
+        )
+        tl = perf_history.build_timeline(
+            str(tmp_path / "perf"), str(res), str(tmp_path)
+        )
+        assert len(tl["records"]) == 1
+        assert tl["records"][0]["run"]["run_id"]
+        assert tl["records"][0]["pattern"] == "serve"
+
+    def test_report_renders_all_sections(self, tmp_path):
+        d = str(tmp_path / "perf")
+        perf_history.append_snapshot(_snapshot(), d)
+        tl = perf_history.build_timeline(d, str(tmp_path / "none"), ROOT)
+        text = perf_report.render(_snapshot(), tl)
+        assert "perfwatch snapshot" in text
+        assert "decoder.step" in text
+        assert "driver captures" in text
+        assert "step_ms over runs" in text
+
+
+# -- metric-naming: the new series pass graftlint --------------------------
+
+
+class TestLintIntegration:
+    def test_executable_label_is_known(self):
+        from tpu_patterns.analysis.astlint import MetricNaming
+
+        assert "executable" in MetricNaming.KNOWN_LABELS
+
+    def test_perf_series_pass_metric_naming(self, tmp_path):
+        from tpu_patterns.analysis.engine import lint_sources
+
+        p = tmp_path / "perf_fixture.py"
+        p.write_text(
+            "from tpu_patterns import obs\n"
+            'obs.gauge("tpu_patterns_perf_step_ms",'
+            ' executable="decoder.step").set(1.0)\n'
+            'obs.counter("tpu_patterns_perf_captures_total").inc()\n'
+        )
+        findings, _ = lint_sources([str(p)], rules=["metric-naming"])
+        assert findings == []
+
+
+# -- capture -> diff, end to end on the CPU mesh ---------------------------
+
+
+@pytest.fixture(scope="module")
+def perf_mesh(devices):
+    from jax.sharding import Mesh
+
+    return Mesh(
+        np.array(devices[:8]).reshape(1, 4, 2), ("dp", "sp", "tp")
+    )
+
+
+@pytest.fixture(scope="module")
+def captured(perf_mesh):
+    """One real capture shared by the e2e assertions (compiles are the
+    cost; k/inner stay small — band logic is unit-tested above)."""
+    from tpu_patterns.perf.registry import PerfConfig, capture
+
+    cfg = PerfConfig(
+        k=2, inner=4,
+        include="decoder.prefill,decoder.step,serve.step",
+    )
+    return capture(perf_mesh, cfg), cfg
+
+
+class TestCaptureE2E:
+    def test_snapshot_shape_and_stamp(self, captured):
+        snap, _cfg = captured
+        assert set(snap["executables"]) == {
+            "decoder.prefill", "decoder.step", "serve.step"
+        }
+        assert snap["run"]["run_id"] and len(snap["run"]["mesh_fp"]) == 12
+        for name, m in snap["executables"].items():
+            assert m["analytic_flops"] > 0, name
+            assert m["step_ms"] > 0, name
+            assert m["achieved_gflops"] > 0, name
+
+    def test_xla_counts_within_sanity_band_of_analytic(self, captured):
+        # cost_analysis reports PER-DEVICE flops; the whole-mesh total
+        # must bracket the analytic model count (masked full-window
+        # attention and collective overhead push it above, per-device
+        # sharding pulls it below — an order-of-magnitude disagreement
+        # means the accounting broke)
+        snap, _cfg = captured
+        n = snap["mesh"]["devices"]
+        for name in ("decoder.prefill", "decoder.step"):
+            m = snap["executables"][name]
+            assert "xla_flops" in m, "CPU backend exposes cost_analysis"
+            ratio = m["xla_flops"] * n / m["analytic_flops"]
+            assert 0.3 < ratio < 5.0, (name, ratio)
+
+    def test_pool_donation_shows_in_alias_bytes(self, captured):
+        snap, _cfg = captured
+        assert snap["executables"]["decoder.step"]["alias_bytes"] > 0
+
+    def test_mfu_scored_against_the_capture_dtype_peak(self):
+        # an f32 capture against the bf16 peak halves every MFU — the
+        # derive step must pass the capture dtype through
+        from unittest import mock
+
+        from tpu_patterns.perf import registry as perf_registry
+
+        m = {"step_ms": 1.0, "analytic_flops": 1e9,
+             "analytic_hbm_bytes": 1e6}
+        with mock.patch(
+            "tpu_patterns.runtime.chip_peak_tflops",
+            side_effect=lambda dtype: 100.0
+            if np.dtype(dtype).itemsize < 4 else 50.0,
+        ) as peak:
+            perf_registry._derive(m, 1, "float32")
+        assert peak.call_args == mock.call(dtype="float32")
+        assert m["mfu"] == pytest.approx((1e9 / 1.0e-3 / 1e12) / 50.0)
+
+    def test_span_join_fed_the_histograms(self, captured):
+        from tpu_patterns import obs
+
+        h = obs.histogram(
+            "tpu_patterns_span_duration_ns", span="perf.decoder.step"
+        )
+        assert h.count > 0
+        assert obs.gauge(
+            "tpu_patterns_perf_step_ms", executable="decoder.step"
+        ).value > 0
+
+    def test_clean_diff_against_own_pin_is_green(
+        self, captured, tmp_path
+    ):
+        snap, _cfg = captured
+        path = str(tmp_path / "bl.json")
+        perf_baseline.save_baseline(path, snap, {})
+        d = perf_baseline.diff_snapshot(
+            snap, perf_baseline.load_baseline(path)
+        )
+        assert d.exit_code == 0 and not d.regressions
+
+    def test_sleep_fault_at_serve_step_flags_the_regression(
+        self, captured, perf_mesh, tmp_path
+    ):
+        # the acceptance loop: pin a clean serve.step capture, re-capture
+        # under an injected sleep at the serve.step fault site, and the
+        # diff must name the step-time regression per-executable; a
+        # clean re-capture afterwards passes the noise band again
+        from tpu_patterns import faults
+        from tpu_patterns.perf.registry import PerfConfig, capture
+
+        cfg = PerfConfig(k=2, inner=4, include="serve.step")
+        path = str(tmp_path / "bl.json")
+        clean = capture(perf_mesh, cfg)
+        perf_baseline.save_baseline(path, clean, {})
+        bl = perf_baseline.load_baseline(path)
+        try:
+            faults.configure(
+                "serve.step:sleep:delay_s=0.1:count=10000"
+            )
+            slow = capture(perf_mesh, cfg)
+        finally:
+            faults.configure(None)
+        d = perf_baseline.diff_snapshot(slow, bl)
+        assert d.exit_code == 1
+        assert any(
+            f.executable == "serve.step" and f.metric == "step_ms"
+            for f in d.regressions
+        )
+        # back-to-back clean runs stay inside the band
+        again = capture(perf_mesh, cfg)
+        d2 = perf_baseline.diff_snapshot(again, bl)
+        assert not any(
+            f.metric == "step_ms" for f in d2.regressions
+        ), [f.message() for f in d2.regressions]

@@ -416,6 +416,8 @@ class MetricNaming(Rule):
         "optimizer", "app", "mode", "reason", "rule", "tier", "worker",
         # loadgen SLO series are keyed by scenario preset (PR 8)
         "scenario",
+        # perfwatch series are keyed by registry entry (perf/registry.py)
+        "executable",
     })
     PREFIX = "tpu_patterns_"
 

@@ -27,6 +27,7 @@ import sys
 from typing import TextIO
 
 from tpu_patterns.analysis import walker
+from tpu_patterns.core import ratchet
 from tpu_patterns.analysis.astlint import AST_RULES, Rule, SourceFile
 from tpu_patterns.analysis.findings import (
     Finding,
@@ -124,9 +125,6 @@ def run_lint(
     bl_path = baseline_path or default_baseline_path()
     baseline = load_baseline(bl_path) if use_baseline else {}
     live = [f for f in findings if not f.suppressed]
-    new = [f for f in live if f.fingerprint not in baseline]
-    baselined = [f for f in live if f.fingerprint in baseline]
-    seen = {f.fingerprint for f in live}
     ran = set(rules) if rules is not None else known
     if tier == "a":
         ran &= {r.name for r in AST_RULES}
@@ -139,12 +137,17 @@ def run_lint(
             f"no rule left to run: --rules {sorted(rules or [])} all "
             f"belong to the other tier (--tier {tier})"
         )
-    # only rules that RAN can declare their baseline entries stale — a
-    # --rules subset must not report the other rules' debt as fixed
-    stale = [
-        e for fp, e in sorted(baseline.items())
-        if fp not in seen and e["rule"] in ran
-    ]
+    # the ratchet split is the shared contract (core/ratchet.py);
+    # stale_filter: only rules that RAN can declare their baseline
+    # entries stale — a --rules subset must not report the other rules'
+    # debt as fixed
+    new_fps, pinned_fps, stale = ratchet.split_entries(
+        (f.fingerprint for f in live),
+        baseline,
+        stale_filter=lambda e: e["rule"] in ran,
+    )
+    new = [f for f in live if f.fingerprint in new_fps]
+    baselined = [f for f in live if f.fingerprint in pinned_fps]
 
     if update_baseline:
         if not use_baseline:

@@ -12,7 +12,10 @@ decide what a finding means for the exit code:
   accepted pre-existing findings by content fingerprint.  CI fails only
   on findings NOT in the baseline (the ratchet): code can only get
   cleaner.  ``--update-baseline`` re-pins, preserving per-entry
-  justifications across re-pins.
+  justifications across re-pins.  The file format, version gate, and
+  justification survival live in :mod:`tpu_patterns.core.ratchet` —
+  ONE ratchet contract shared with perfwatch (perf/baseline.py); this
+  module owns only what a lint fingerprint hashes.
 * **fingerprint** — sha1 over (rule, path, normalized flagged line,
   occurrence index).  Line-number free, so unrelated edits above a
   baselined violation do not churn the baseline; the occurrence index
@@ -23,10 +26,11 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
-import json
 import os
 import re
 from typing import Iterable
+
+from tpu_patterns.core import ratchet
 
 
 @dataclasses.dataclass
@@ -140,16 +144,7 @@ def default_baseline_path() -> str:
 
 def load_baseline(path: str) -> dict[str, dict]:
     """Baseline entries keyed by fingerprint ({} when absent)."""
-    if not os.path.exists(path):
-        return {}
-    with open(path) as f:
-        data = json.load(f)
-    if data.get("version") != BASELINE_VERSION:
-        raise ValueError(
-            f"{path}: baseline version {data.get('version')!r} != "
-            f"{BASELINE_VERSION} — regenerate with --update-baseline"
-        )
-    return {e["fingerprint"]: e for e in data.get("entries", [])}
+    return ratchet.load_entries(path, version=BASELINE_VERSION)
 
 
 def save_baseline(
@@ -161,25 +156,20 @@ def save_baseline(
     fingerprint) — they are hand-written triage notes, not tool output.
     Returns the entry count.
     """
-    entries = []
-    for f in sorted(
-        findings, key=lambda f: (f.rule, f.path, f.line, f.fingerprint)
-    ):
-        entries.append({
+    entries = [
+        {
             "rule": f.rule,
             "path": f.path,
             "fingerprint": f.fingerprint,
             "text": f.snippet or f.message,
-            "justification": old.get(f.fingerprint, {}).get(
-                "justification", ""
-            ),
-        })
-    with open(path, "w") as f:
-        json.dump(
-            {"version": BASELINE_VERSION, "entries": entries},
-            f,
-            indent=1,
-            sort_keys=True,
+            "justification": "",
+        }
+        for f in sorted(
+            findings, key=lambda f: (f.rule, f.path, f.line, f.fingerprint)
         )
-        f.write("\n")
-    return len(entries)
+    ]
+    return ratchet.save_entries(
+        path,
+        ratchet.preserve_justifications(entries, old),
+        version=BASELINE_VERSION,
+    )

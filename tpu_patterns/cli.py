@@ -422,6 +422,119 @@ def _cmd_loadgen(args, writer: ResultWriter) -> None:
     run_loadgen(_mesh3d_from_args(args), cfg, writer)
 
 
+def _cmd_perf(args, writer: ResultWriter) -> None:
+    """perfwatch: capture the executable registry, bank the snapshot,
+    then report / diff-against-baseline / re-pin.  The diff's verdict
+    Records are per-executable — a regression is named where it lives —
+    and the process exit code aggregates through the writer like every
+    other runner."""
+    from tpu_patterns.perf import baseline as perf_baseline
+    from tpu_patterns.perf import history as perf_history
+    from tpu_patterns.perf import registry as perf_registry
+    from tpu_patterns.perf import report as perf_report
+
+    if args.dp != 1:
+        # same contract as serve: the paged pool is scheduler-slot
+        # shaped; the capture builds its own dp axis for train/ZeRO
+        raise SystemExit("error: perf requires --dp 1 (fold devices into sp)")
+    cfg = _cfg_from_args(perf_registry.PerfConfig, args)
+    if args.perf_cmd == "update-baseline" and cfg.include:
+        raise SystemExit(
+            "error: --update-baseline needs the FULL registry (no "
+            "--include filter): a partial re-pin would drop the other "
+            "executables' entries"
+        )
+    try:
+        snap = perf_registry.capture(_mesh3d_from_args(args), cfg, writer)
+    except ValueError as e:  # unknown --include names read as one line
+        raise SystemExit(f"error: {e}") from e
+    if not args.no_history:
+        path = perf_history.append_snapshot(snap, args.perf_dir)
+        writer.progress(f"snapshot appended -> {path}")
+
+    if args.perf_cmd == "report":
+        timeline = perf_history.build_timeline(args.perf_dir)
+        print(perf_report.render(snap, timeline))
+        writer.record(Record(
+            pattern="perf",
+            mode="report",
+            commands=f"{len(snap['executables'])} executables",
+            metrics={
+                "executables": float(len(snap["executables"])),
+                "history_snapshots": float(len(timeline["snapshots"])),
+                "bench_rounds": float(len(timeline["bench_rounds"])),
+                "records_ingested": float(len(timeline["records"])),
+            },
+        ))
+        return
+
+    bl_path = args.baseline or perf_baseline.default_baseline_path()
+    old = perf_baseline.load_baseline(bl_path)
+    if args.perf_cmd == "update-baseline":
+        n = perf_baseline.save_baseline(bl_path, snap, old)
+        writer.record(Record(
+            pattern="perf",
+            mode="update-baseline",
+            commands=bl_path,
+            metrics={"entries": float(n)},
+        ))
+        return
+
+    tolerances = None
+    if args.measured_tol < 0:
+        tolerances = {"measured": None}  # informational this run
+        writer.progress(
+            "measured entries informational for this diff "
+            "(--measured_tol < 0)"
+        )
+    elif args.measured_tol:
+        tolerances = {"measured": args.measured_tol}
+    diff = perf_baseline.diff_snapshot(snap, old, tolerances=tolerances)
+    by_exec: dict[str, list] = {}
+    for f in diff.regressions:
+        by_exec.setdefault(f.executable, []).append(f)
+    for name in sorted(snap["executables"]):
+        regs = by_exec.get(name, [])
+        rec = Record(
+            pattern="perf",
+            mode=name,
+            commands="perf diff",
+            metrics={
+                "regressions": float(len(regs)),
+                "step_ms": snap["executables"][name].get("step_ms", -1.0),
+            },
+            verdict=Verdict.FAILURE if regs else Verdict.SUCCESS,
+            notes=[f.message() for f in regs],
+        )
+        writer.record(rec)
+    for f in diff.improvements:
+        writer.progress(f"improvement: {f.message()}")
+    for s in diff.unbaselined:
+        writer.progress(f"unbaselined (run perf update-baseline): {s}")
+    for s in diff.skipped:
+        writer.progress(f"skipped (foreign mesh fingerprint): {s}")
+    for e in diff.stale:
+        writer.progress(
+            f"stale baseline entry: {e['executable']}.{e['metric']} "
+            f"{e['fingerprint']} — update-baseline to drop it"
+        )
+    writer.record(Record(
+        pattern="perf",
+        mode="diff",
+        commands=bl_path,
+        metrics={
+            "checked": float(diff.checked),
+            "regressions": float(len(diff.regressions)),
+            "improvements": float(len(diff.improvements)),
+            "unbaselined": float(len(diff.unbaselined)),
+            "skipped": float(len(diff.skipped)),
+            "stale": float(len(diff.stale)),
+        },
+        verdict=Verdict.FAILURE if diff.regressions else Verdict.SUCCESS,
+        notes=[f.message() for f in diff.regressions[:10]],
+    ))
+
+
 def _cmd_doctor(args, writer: ResultWriter) -> None:
     from tpu_patterns.core.doctor import DoctorConfig, run_doctor
 
@@ -1165,6 +1278,54 @@ def build_parser() -> argparse.ArgumentParser:
     add_config_args(lg, LoadGenConfig)
     _add_mesh3d_args(lg)
 
+    pf = sub.add_parser(
+        "perf",
+        help="performance observatory (perfwatch): capture analytic + "
+        "compiled + measured cost per jitted entry point, bank one "
+        "snapshot per run, and ratchet the trajectory against the "
+        "committed perf/baseline.json",
+    )
+    pf.add_argument(
+        "perf_cmd",
+        choices=("report", "diff", "update-baseline"),
+        help="report: capture + render roofline/trajectory; diff: "
+        "capture + gate vs the baseline (exit 1 on NEW regressions, "
+        "named per-executable); update-baseline: capture + re-pin "
+        "(per-entry justifications survive)",
+    )
+    from tpu_patterns.perf.registry import PerfConfig
+
+    add_config_args(pf, PerfConfig)
+    pf.add_argument(
+        "--baseline",
+        default=None,
+        help="baseline path (default: the committed "
+        "tpu_patterns/perf/baseline.json)",
+    )
+    pf.add_argument(
+        "--perf-dir",
+        default=None,
+        help="history store directory (default results/perf)",
+    )
+    pf.add_argument(
+        "--no-history",
+        action="store_true",
+        help="do not append this capture to the history store",
+    )
+    pf.add_argument(
+        "--measured_tol",
+        type=float,
+        default=0.0,
+        help="override the measured-class tolerance band for this diff "
+        "(relative, e.g. 0.5 on a quiet dedicated box; 0 keeps the "
+        "class default — perf/baseline.py CLASSES; negative makes "
+        "measured entries informational for this diff, the right mode "
+        "when gating the committed analytic ledger on a shared host "
+        "whose load regime moved since the pin — back-to-back runs "
+        "gate measured via a fresh update-baseline instead)",
+    )
+    _add_mesh3d_args(pf)
+
     dr = sub.add_parser(
         "doctor",
         help="deadline-bounded runtime health probes (backend init / tiny "
@@ -1397,6 +1558,13 @@ def main(argv: list[str] | None = None) -> int:
     import os
 
     from tpu_patterns import faults, obs
+    from tpu_patterns.perf import provenance
+
+    # one CLI invocation = one run: rotate the provenance stamp so every
+    # Record/metrics dump this run banks carries a fresh run_id — warm
+    # workers call main() many times per process and each cell must
+    # stamp distinctly (perf/provenance.py)
+    provenance.new_run()
 
     if args.obs_dir:
         obs.configure(args.obs_dir)
@@ -1426,6 +1594,7 @@ def main(argv: list[str] | None = None) -> int:
         "lm": _cmd_lm,
         "serve": _cmd_serve,
         "loadgen": _cmd_loadgen,
+        "perf": _cmd_perf,
         "doctor": _cmd_doctor,
         "ckpt": _cmd_ckpt,
         "pipeline": _cmd_pipeline,

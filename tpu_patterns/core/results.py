@@ -46,6 +46,11 @@ class Record:
     env: dict[str, str] = dataclasses.field(default_factory=dict)
     timestamp: float = dataclasses.field(default_factory=wall_time_s)
     notes: list[str] = dataclasses.field(default_factory=list)
+    # Run provenance (perf/provenance.py): run_id + git_sha + mesh_fp.
+    # Stamped by ResultWriter.record for every banked Record so runs
+    # are joinable across time; {} only on legacy records parsed from
+    # pre-stamp archives.
+    run: dict[str, str] = dataclasses.field(default_factory=dict)
     # True marks a committed record whose number was invalidated by a
     # later accounting/measurement fix: it stays in the archive as
     # provenance but must never be tabulated as a result.
@@ -110,6 +115,12 @@ class ResultWriter:
     def record(self, rec: Record) -> Record:
         if not rec.env:
             rec.env = context_env()
+        if not rec.run:
+            # lazy import: stamping must not pull perf/ into every
+            # results consumer at module load
+            from tpu_patterns.perf.provenance import stamp_dict
+
+            rec.run = stamp_dict()
         if rec.verdict is Verdict.FAILURE:
             self._failures += 1
         if not rec.commands:

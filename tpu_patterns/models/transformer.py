@@ -966,6 +966,62 @@ def donation_took(jitted, *args) -> bool | None:
         return None
 
 
+def cost_metrics(jitted, *args) -> dict[str, float]:
+    """Compiled cost + memory analysis for perfwatch's executable
+    registry (perf/registry.py), through ONE cache-dodging
+    ``analysis_compile`` so alias bytes are real on warm CLI runs.
+
+    Returns (empty dict when the backend exposes no analysis API):
+
+    * ``compile_s`` — wall seconds of the real (cache-bypassed) compile;
+    * ``cached_compile_s`` — wall seconds of a plain ``compile()``
+      immediately after, which the persistent cache may serve — the
+      pair is the cache's hit evidence (perf/registry.py derives
+      ``cache_hit`` from the ratio);
+    * ``xla_flops`` / ``xla_bytes_accessed`` — the compiler's own
+      PER-DEVICE counts (absent on backends whose cost_analysis lacks
+      the key);
+    * ``argument_bytes`` / ``output_bytes`` / ``temp_bytes`` /
+      ``alias_bytes`` — per-device ``memory_analysis`` figures.
+    """
+    from tpu_patterns.core.timing import wall_time_s
+
+    out: dict[str, float] = {}
+    try:
+        t0 = wall_time_s()
+        compiled = analysis_compile(jitted, *args)
+        out["compile_s"] = wall_time_s() - t0
+        t0 = wall_time_s()
+        jitted.lower(*args).compile()
+        out["cached_compile_s"] = wall_time_s() - t0
+    except Exception:
+        return {}
+    try:
+        ca = compiled.cost_analysis()
+        # older JAX returns [dict] per device-assignment, newer a dict
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if "flops" in ca:
+            out["xla_flops"] = float(ca["flops"])
+        if "bytes accessed" in ca:
+            out["xla_bytes_accessed"] = float(ca["bytes accessed"])
+    # graftlint: allow[bare-except-in-runtime] -- cost_analysis is an optional backend API; absence degrades to "no compiler counts", never fails the capture
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        out.update(
+            argument_bytes=float(ma.argument_size_in_bytes),
+            output_bytes=float(ma.output_size_in_bytes),
+            temp_bytes=float(ma.temp_size_in_bytes),
+            alias_bytes=float(ma.alias_size_in_bytes),
+        )
+    # graftlint: allow[bare-except-in-runtime] -- memory_analysis is an optional backend API; same degrade-not-fail contract as cost_analysis above
+    except Exception:
+        pass
+    return out
+
+
 def _memory_metrics(jitted, *args) -> dict[str, float]:
     """Compiled-program memory analysis (bytes -> MB): peak temp (the
     activation stash the remat lever targets), argument and output sizes.

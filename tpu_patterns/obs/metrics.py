@@ -106,6 +106,12 @@ class Registry:
     def __init__(self):
         self._metrics: dict[tuple, _Metric] = {}  # graftlint: guarded-by[_lock]
         self._lock = threading.Lock()
+        # Run provenance of the metrics IN this registry.  None = live
+        # registry (stamp with the current run at export time); set by
+        # registry_from_jsonl so re-exporting a PAST run's dump keeps
+        # that run's stamp instead of misattributing the numbers to
+        # the exporter's run_id/git SHA.
+        self.run_stamp: dict[str, str] | None = None
 
     def _get(self, cls, name: str, help: str, labels: dict, **kw):
         key = (cls.kind, name, _label_key(labels))
@@ -134,14 +140,27 @@ class Registry:
         with self._lock:
             self._metrics.clear()
 
+    def _stamp(self) -> dict[str, str]:
+        if self.run_stamp is not None:
+            return self.run_stamp
+        from tpu_patterns.perf.provenance import stamp_dict
+
+        return stamp_dict()
+
     # -- export ----------------------------------------------------------
 
     def to_prom_text(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+        """Prometheus text exposition format 0.0.4.
+
+        The first line is a run-provenance comment (``# RUN k=v ...``)
+        — comments are ignored by every exposition parser including
+        :func:`parse_prom_text`, so the stamp rides along without
+        breaking round-trips.
+        """
         by_name: dict[str, list[_Metric]] = {}
         for m in self.metrics():
             by_name.setdefault(m.name, []).append(m)
-        lines: list[str] = []
+        lines: list[str] = [_run_stamp_comment(self._stamp())]
         for name in sorted(by_name):
             group = by_name[name]
             if group[0].help:
@@ -168,11 +187,18 @@ class Registry:
         return "\n".join(lines) + "\n"
 
     def to_jsonl(self) -> str:
-        """One JSON object per metric — the suite's JSONL discipline."""
+        """One JSON object per metric — the suite's JSONL discipline.
+
+        The first line is a run-provenance object (``{"type": "run",
+        ...}``): :func:`registry_from_jsonl` skips unknown types, so the
+        stamp makes dumps joinable across runs without breaking replay.
+        """
         from tpu_patterns.core import timing
 
         ts = timing.wall_time_s()
-        lines = []
+        lines = [json.dumps(
+            {"type": "run", "ts": ts, **self._stamp()}, sort_keys=True
+        )]
         for m in self.metrics():
             d: dict = {
                 "metric": m.name, "type": m.kind, "labels": m.labels,
@@ -188,6 +214,13 @@ class Registry:
                 d["value"] = m.value
             lines.append(json.dumps(d, sort_keys=True))
         return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _run_stamp_comment(stamp: dict[str, str]) -> str:
+    """``# RUN run_id=... git_sha=... mesh_fp=...`` — the provenance
+    stamp in comment form (exposition parsers skip ``#`` lines)."""
+    kv = " ".join(f"{k}={v}" for k, v in sorted(stamp.items()))
+    return f"# RUN {kv}"
 
 
 def _num(v: float) -> str:
@@ -264,6 +297,16 @@ def registry_from_jsonl(lines: Iterable[str]) -> Registry:
         d = json.loads(line)
         labels = d.get("labels", {})
         kind = d.get("type")
+        if kind == "run":
+            # keep the DUMPED run's provenance: re-exports of this
+            # registry must attribute the numbers to the run that
+            # produced them, not to the exporting process
+            reg.run_stamp = {
+                k: str(d[k])
+                for k in ("run_id", "git_sha", "mesh_fp")
+                if k in d
+            }
+            continue
         if kind == "counter":
             reg.counter(d["metric"], **labels).inc(d["value"])
         elif kind == "gauge":

@@ -1,0 +1,44 @@
+"""perf/ — the performance-trajectory observatory (perfwatch).
+
+Every Record so far proves a speedup *within one run*; this subsystem
+gives the measurement layer a memory.  Three pillars:
+
+  provenance.py  run_id + git SHA + env/mesh fingerprint stamped into
+                 every Record header and obs metrics dump, so artifacts
+                 from different runs are joinable across time
+  analytic.py    closed-form FLOP/HBM-byte accounting for the jitted
+                 entry points (device-independent: works on the CPU
+                 mesh today, snaps to the v5e verdict tables when
+                 hardware returns)
+  registry.py    the executable registry: capture cost_analysis() +
+                 memory_analysis() (via the cache-dodging
+                 analysis_compile), compile time, and median-of-k
+                 measured times per entry point; join spans -> achieved
+                 FLOP/s, bandwidth, roofline position
+  history.py     one normalized snapshot per run appended under
+                 results/perf/, plus the longitudinal timeline that
+                 ingests the committed BENCH_r*.json and results/
+                 Records
+  baseline.py    the ratchet: committed perf/baseline.json with
+                 noise-aware relative tolerance bands per metric class,
+                 gated by ``tpu-patterns perf diff`` (fail only on NEW
+                 regressions, ``--update-baseline`` preserves per-entry
+                 justifications — the same core/ratchet.py contract
+                 graftlint uses)
+  report.py      render the per-executable roofline table + trajectory
+
+Import discipline: this ``__init__`` stays light (provenance only) —
+``registry``/``report`` pull in jax + the model stack and are imported
+at the CLI/call site, so stamping a Record never costs a backend
+import.
+"""
+
+from __future__ import annotations
+
+from tpu_patterns.perf.provenance import (  # noqa: F401
+    RunStamp,
+    current_stamp,
+    mesh_fingerprint,
+    new_run,
+    stamp_dict,
+)

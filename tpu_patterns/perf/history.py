@@ -1,0 +1,162 @@
+"""The perf-trajectory store: snapshots over time, on one timeline.
+
+``append_snapshot`` banks one normalized capture (perf/registry.py) per
+run as a JSON line under ``results/perf/history.jsonl`` — the
+longitudinal memory the per-run Records never had.  ``build_timeline``
+joins three sources into one time-ordered view:
+
+* history snapshots (run_id / git SHA / mesh fingerprint stamped);
+* the repo's committed ``BENCH_r*.json`` driver captures — including
+  the failed rounds, whose error strings ("device backend unreachable")
+  ARE the trajectory of the hardware outage, and the one real r4 HBM
+  number;
+* Records banked under ``results/`` by sweep/serve/loadgen runs AND the
+  committed measured archive under ``docs/measured/`` — the stale r4
+  HBM capture and the v5e suite records join the same timeline
+  (pre-stamp archives join with an empty run field rather than being
+  dropped).
+
+The timeline is what ``tpu-patterns perf report`` renders: write-only
+artifacts become a history you can read end to end.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+from tpu_patterns.core.timing import wall_time_s
+
+DEFAULT_DIR = os.path.join("results", "perf")
+HISTORY_FILE = "history.jsonl"
+
+
+def history_path(perf_dir: str | None = None) -> str:
+    return os.path.join(perf_dir or DEFAULT_DIR, HISTORY_FILE)
+
+
+def append_snapshot(snapshot: dict, perf_dir: str | None = None) -> str:
+    """Bank one snapshot; one atomic O_APPEND write like every record
+    stream (a concurrent sweep must not interleave half-lines)."""
+    path = history_path(perf_dir)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    line = json.dumps(snapshot, sort_keys=True) + "\n"
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        os.write(fd, line.encode())
+    finally:
+        os.close(fd)
+    return path
+
+
+def load_history(perf_dir: str | None = None) -> list[dict]:
+    path = history_path(perf_dir)
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                snap = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # a torn tail line must not hide the history
+            if isinstance(snap, dict) and "executables" in snap:
+                out.append(snap)
+    return out
+
+
+def load_bench_rounds(root: str = ".") -> list[dict]:
+    """The committed driver captures: one row per BENCH_r*.json, with
+    the parsed headline metric or the error string that replaced it."""
+    rows = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        parsed = d.get("parsed") or {}
+        rows.append({
+            "kind": "bench",
+            "round": int(d.get("n", 0)),
+            "file": os.path.basename(path),
+            "metric": parsed.get("metric", ""),
+            "value": parsed.get("value"),
+            "unit": parsed.get("unit", ""),
+            "error": parsed.get("error", ""),
+        })
+    rows.sort(key=lambda r: r["round"])
+    return rows
+
+
+def load_result_records(results_dir: str = "results") -> list[dict]:
+    """Every Record banked under ``results/``: JSONL lines that carry
+    the Record surface (pattern/mode/verdict).  Metrics dumps and span
+    rings live in the same tree; anything without the surface is
+    skipped, not an error."""
+    rows = []
+    for path in sorted(
+        glob.glob(os.path.join(results_dir, "**", "*.jsonl"),
+                  recursive=True)
+    ):
+        try:
+            with open(path) as f:
+                lines = f.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not (
+                isinstance(d, dict) and "pattern" in d and "mode" in d
+                and "verdict" in d
+            ):
+                continue
+            rows.append({
+                "kind": "record",
+                "file": os.path.relpath(path, results_dir),
+                "ts": float(d.get("timestamp", 0.0)),
+                "pattern": d["pattern"],
+                "mode": d["mode"],
+                "verdict": d["verdict"],
+                "metrics": d.get("metrics", {}),
+                "run": d.get("run", {}),
+            })
+    rows.sort(key=lambda r: r["ts"])
+    return rows
+
+
+def build_timeline(
+    perf_dir: str | None = None,
+    results_dir: str = "results",
+    root: str = ".",
+) -> dict:
+    """Everything the trajectory knows, grouped by source.
+
+    Record sources: live artifacts under ``results_dir`` plus the
+    committed measured archive (``docs/measured/`` under ``root``) —
+    the r4 HBM capture and the v5e suite records are Records like any
+    other run's, write-only no more.
+    """
+    records = load_result_records(results_dir)
+    measured = os.path.join(root, "docs", "measured")
+    if os.path.isdir(measured):
+        records += load_result_records(measured)
+    records.sort(key=lambda r: r["ts"])
+    return {
+        "built_ts": wall_time_s(),
+        "bench_rounds": load_bench_rounds(root),
+        "records": records,
+        "snapshots": load_history(perf_dir),
+    }

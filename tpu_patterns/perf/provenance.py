@@ -1,0 +1,168 @@
+"""Run provenance: who produced this artifact, where, at what code.
+
+Every Record, metrics dump, and sweep/serve/loadgen artifact is stamped
+with one :class:`RunStamp` so runs are joinable across time — the
+longitudinal half of perfwatch.  Three fields:
+
+* ``run_id`` — unique per run, even for two runs inside one process
+  (warm workers serve many cells per process; ``cli.main`` rotates the
+  stamp per invocation via :func:`new_run`).
+* ``git_sha`` — the commit the code ran at (best-effort; "" outside a
+  git checkout).  ``+dirty`` marks uncommitted changes, because a
+  number measured on uncommitted code is not reproducible from the SHA.
+* ``mesh_fp`` — a fingerprint of the environment that shapes the
+  numbers: platform/device env knobs, the context env vars every Record
+  already carries, host CPU count, and the JAX version.  Two runs with
+  equal ``mesh_fp`` are comparable; the perf baseline gates
+  machine-dependent (measured) metrics only within a matching
+  fingerprint (perf/baseline.py).
+
+Import discipline: core/timing only — this module is imported from
+``core/results.py``'s stamping path and must never drag in jax or a
+backend init.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import threading
+
+from tpu_patterns.core.timing import wall_time_s
+
+# Environment knobs that shape measured numbers.  Supersets
+# core/results.py's _CONTEXT_ENV_VARS (which keeps its reference-parity
+# role of echoing the sweep config): these extend it with the platform/
+# device-count switches the test/CI meshes are built from.
+_FP_ENV_VARS = (
+    "JAX_PLATFORMS",
+    "XLA_FLAGS",
+    "LIBTPU_INIT_ARGS",
+    "JAX_DEFAULT_MATMUL_PRECISION",
+    "TPU_PATTERNS_PLATFORM",
+    "TPU_PATTERNS_CPU_DEVICES",
+    "TPU_PATTERNS_TEST_DEVICES",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class RunStamp:
+    run_id: str
+    git_sha: str
+    mesh_fp: str
+    started_s: float
+
+    def to_dict(self) -> dict[str, str]:
+        return {
+            "run_id": self.run_id,
+            "git_sha": self.git_sha,
+            "mesh_fp": self.mesh_fp,
+        }
+
+
+_GIT_SHA: str | None = None  # cached per process; the SHA cannot change
+
+
+def git_sha() -> str:
+    """HEAD commit of the repo the package runs from (best-effort)."""
+    global _GIT_SHA
+    if _GIT_SHA is not None:
+        return _GIT_SHA
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "--short=12", "HEAD"],
+            cwd=root, capture_output=True, text=True, timeout=10,
+        ).stdout.strip()
+        if sha:
+            # untracked (non-ignored) files count as dirty: a run whose
+            # behavior comes from a NEW source file is just as
+            # unreproducible from the bare SHA as one from an edit —
+            # .gitignore already keeps results/ and build noise out of
+            # porcelain, so this costs nothing
+            dirty = subprocess.run(
+                ["git", "status", "--porcelain"],
+                cwd=root, capture_output=True, text=True, timeout=10,
+            ).stdout.strip()
+            if dirty:
+                sha += "+dirty"
+    except (OSError, subprocess.SubprocessError):
+        sha = ""
+    _GIT_SHA = sha
+    return sha
+
+
+def mesh_fingerprint() -> str:
+    """Fingerprint of the measurement environment (12 hex chars).
+
+    Deliberately computable WITHOUT initializing a backend (platform
+    detection in the sweep parent must never touch one), and — just as
+    deliberately — NEVER reading live backend state: the same machine
+    must produce the same fingerprint whether the stamp is taken before
+    first backend use (a fresh CLI process) or after (a warm worker
+    re-invoking ``cli.main`` in-process), or machine-bound baseline
+    gates would silently stop matching between the two paths.  Env
+    knobs + host shape + versions identify the machine; the device
+    platform rides in the env knobs (JAX_PLATFORMS /
+    TPU_PATTERNS_PLATFORM / XLA_FLAGS) that select it.
+    """
+    import importlib.metadata
+    import sys
+
+    parts = [f"{k}={os.environ.get(k, '')}" for k in _FP_ENV_VARS]
+    parts.append(f"cpus={os.cpu_count()}")
+    parts.append(f"py={sys.version_info[:2]}")
+    try:
+        parts.append(f"jax={importlib.metadata.version('jax')}")
+    except importlib.metadata.PackageNotFoundError:
+        parts.append("jax=?")
+    return hashlib.sha1("|".join(parts).encode()).hexdigest()[:12]
+
+
+_LOCK = threading.Lock()
+_CURRENT: RunStamp | None = None
+_SEQ = 0
+
+
+def _make_stamp() -> RunStamp:
+    global _SEQ
+    _SEQ += 1
+    t = wall_time_s()
+    # time + pid make it unique across processes; the sequence number
+    # makes two runs in ONE process distinct (warm workers, tests)
+    rid = f"{int(t * 1000):x}-{os.getpid():x}-{_SEQ:x}"
+    return RunStamp(
+        run_id=rid,
+        git_sha=git_sha(),
+        mesh_fp=mesh_fingerprint(),
+        started_s=t,
+    )
+
+
+def current_stamp() -> RunStamp:
+    """The active run's stamp (created lazily on first use)."""
+    global _CURRENT
+    with _LOCK:
+        if _CURRENT is None:
+            _CURRENT = _make_stamp()
+        return _CURRENT
+
+
+def new_run() -> RunStamp:
+    """Rotate the stamp: everything banked from here on belongs to a
+    NEW run.  ``cli.main`` calls this per invocation, so a warm worker
+    serving many cells in one process stamps each cell distinctly."""
+    global _CURRENT
+    with _LOCK:
+        _CURRENT = _make_stamp()
+        return _CURRENT
+
+
+def stamp_dict() -> dict[str, str]:
+    """The stamp as the plain dict every artifact embeds."""
+    return current_stamp().to_dict()

@@ -1,0 +1,534 @@
+"""The executable registry: one capture = one normalized perf snapshot.
+
+Every jitted entry point the repo serves traffic through is registered
+here with (a) a builder that constructs the compiled program at a small
+but real config on the live mesh, (b) its closed-form analytic cost
+(perf/analytic.py), and (c) how it is *measured* — direct median-of-k
+timed calls for the compiled cores, and the engine-driven serve leg for
+``serve.step``, whose wall clock is read from the
+``tpu_patterns_serve_decode_wall_ms`` histogram the scheduler loop
+feeds (serve/engine.py) so injected faults and scheduler overhead are
+inside the measured window.
+
+Per executable the capture records:
+
+* ``analytic_flops`` / ``analytic_hbm_bytes`` — device-independent
+  model counts (metric class ``analytic``: ratcheted everywhere);
+* the compiler's own ``cost_analysis``/``memory_analysis`` figures via
+  the cache-dodging ``analysis_compile`` (class ``compiled``: ratcheted
+  within a matching mesh fingerprint — XLA versions move these);
+* ``compile_s``/``cached_compile_s``/``cache_hit`` (class ``compile``:
+  informational — compile time is tracked, never gated);
+* ``step_ms`` — median over ``k`` reps of mean-per-call wall time
+  (class ``measured``: noise-banded, machine-bound);
+* derived ``achieved_gflops``/``achieved_gbps``/
+  ``intensity_flops_per_byte`` (+ ``mfu`` when the chip peak is known)
+  — the roofline position.  On the CPU mesh these are relative numbers;
+  on hardware the same snapshot joins the v5e verdict tables.
+
+Every direct-timed rep runs inside an ``obs.span("perf.<name>")``, so
+the measured figures flow through the same span -> histogram machinery
+every other runner uses — the span/executable join is the measurement
+path, not a best-effort afterthought.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+
+import numpy as np
+
+from tpu_patterns.core.timing import clock_ns, wall_time_s
+
+
+# The capture's model/trace shape: small but real — every executable
+# compiles the same stacked-transformer machinery production configs
+# use, on the live mesh.
+@dataclasses.dataclass
+class PerfConfig:
+    """CLI ``perf`` subcommand (capture shape + measurement policy)."""
+
+    vocab: int = 256
+    embed: int = 64
+    heads: int = 4
+    head_dim: int = 16
+    mlp_mult: int = 4
+    depth: int = 2
+    dtype: str = "float32"
+    rope: bool = True
+    kv_heads: int = 0
+    cache_int8: bool = False
+    # decode/serve shape
+    slots: int = 4
+    block_len: int = 16
+    requests: int = 6
+    min_prompt: int = 8
+    max_prompt: int = 24
+    gen: int = 8
+    spec_width: int = 3  # drafted tokens per row in the verify capture
+    # train shape
+    batch: int = 8
+    seq: int = 32
+    # measurement policy: median of k reps, each rep averaging `inner`
+    # back-to-back calls (median-of-k is the noise floor the baseline's
+    # tolerance bands assume — see perf/baseline.py)
+    k: int = 5
+    inner: int = 16
+    # comma-separated subset of executable names ("" = the full
+    # registry); unknown names fail loudly, a typo must not silently
+    # capture nothing
+    include: str = ""
+    seed: int = 0
+
+
+EXECUTABLES = (
+    "train.step",
+    "zero.step",
+    "decoder.prefill",
+    "decoder.step",
+    "decoder.verify",
+    "copy_blocks",
+    "serve.step",
+)
+
+
+def _selected(cfg: PerfConfig) -> list[str]:
+    if not cfg.include:
+        return list(EXECUTABLES)
+    names = [n.strip() for n in cfg.include.split(",") if n.strip()]
+    unknown = sorted(set(names) - set(EXECUTABLES))
+    if unknown:
+        raise ValueError(
+            f"unknown executable(s) {unknown} — registry: "
+            f"{list(EXECUTABLES)}"
+        )
+    return names
+
+
+def _median_ms(reps: list[float]) -> float:
+    return statistics.median(reps)
+
+
+def _timed_reps(name: str, fn, cfg: PerfConfig) -> float:
+    """Median-of-k of mean-per-call milliseconds.  Each rep runs inside
+    a ``perf.<name>`` span so the measurement rides the span ->
+    histogram join like every other timed region."""
+    import jax
+
+    from tpu_patterns import obs
+
+    jax.block_until_ready(fn())  # warm: the jit call path compiles here
+    reps = []
+    for _ in range(cfg.k):
+        t0 = clock_ns()
+        with obs.span(f"perf.{name}", inner=cfg.inner):
+            for _ in range(cfg.inner):
+                out = fn()
+            jax.block_until_ready(out)
+        reps.append((clock_ns() - t0) / 1e6 / cfg.inner)
+    return _median_ms(reps)
+
+
+def _mcfg(cfg: PerfConfig):
+    from tpu_patterns.models.transformer import ModelConfig
+
+    return ModelConfig(
+        embed=cfg.embed, heads=cfg.heads, head_dim=cfg.head_dim,
+        mlp_mult=cfg.mlp_mult, causal=True, dtype=cfg.dtype,
+        depth=cfg.depth, kv_heads=cfg.kv_heads, rope=cfg.rope,
+    )
+
+
+def _train_mesh(mesh):
+    """A (2, n/4, tp) twin of the serve mesh when the devices allow —
+    train/ZeRO entries should exercise a real dp axis even though serve
+    pins dp=1."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.asarray(mesh.devices).reshape(-1)
+    tp = int(mesh.shape["tp"])
+    n = devs.size
+    if n % (2 * tp) == 0 and n >= 2 * tp:
+        return Mesh(devs.reshape(2, n // (2 * tp), tp), ("dp", "sp", "tp"))
+    return mesh
+
+
+# -- per-executable captures ----------------------------------------------
+
+
+def _capture_train(mesh, cfg: PerfConfig, *, zero: bool) -> dict:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_patterns.models.transformer import (
+        cost_metrics,
+        init_params,
+        shard_params,
+    )
+    from tpu_patterns.perf import analytic
+
+    mcfg = _mcfg(cfg)
+    tmesh = _train_mesh(mesh)
+    params = init_params(jax.random.key(cfg.seed), mcfg)
+    x = jax.device_put(
+        jnp.zeros((cfg.batch, cfg.seq, cfg.embed), jnp.dtype(cfg.dtype)),
+        NamedSharding(tmesh, P("dp", "sp", None)),
+    )
+    metrics: dict[str, float] = {
+        "analytic_flops": analytic.train_step_flops(
+            mcfg, cfg.batch, cfg.seq
+        ),
+        "analytic_hbm_bytes": analytic.train_step_hbm_bytes(
+            mcfg, cfg.batch, cfg.seq
+        ),
+    }
+    if zero:
+        from tpu_patterns.models.transformer import make_zero_train_step
+
+        step, init_fn, _specs = make_zero_train_step(
+            tmesh, mcfg, donate=True
+        )
+        shards, opt = init_fn(shard_params(params, tmesh, mcfg))
+        metrics.update(cost_metrics(step, shards, opt, x))
+        state = {"s": shards, "o": opt}
+
+        def call():
+            state["s"], state["o"], loss = step(state["s"], state["o"], x)
+            return loss
+
+        metrics["step_ms"] = _timed_reps("zero.step", call, cfg)
+    else:
+        from tpu_patterns.models.transformer import make_train_step
+
+        step, _pspecs = make_train_step(tmesh, mcfg, donate=True)
+        sharded = shard_params(params, tmesh, mcfg)
+        metrics.update(cost_metrics(step, sharded, x))
+        state = {"p": sharded}
+
+        def call():
+            state["p"], loss = step(state["p"], x)
+            return loss
+
+        metrics["step_ms"] = _timed_reps("train.step", call, cfg)
+    return metrics
+
+
+def _decoder(mesh, cfg: PerfConfig):
+    import jax
+
+    from tpu_patterns.models.lm import init_lm_params
+    from tpu_patterns.models.transformer import _n_experts
+    from tpu_patterns.serve.paged import make_paged_lm_decoder
+
+    mcfg = _mcfg(cfg)
+    max_len = cfg.max_prompt + cfg.gen
+    n_pages = -(-max_len // cfg.block_len)
+    # exactly one private table window per slot + the trash block: the
+    # direct-timed captures address blocks deterministically
+    n_blocks = cfg.slots * n_pages + 1
+    decoder = make_paged_lm_decoder(
+        mesh, mcfg, cfg.vocab,
+        n_blocks=n_blocks, block_len=cfg.block_len, max_len=max_len,
+        cache_int8=cfg.cache_int8,
+    )
+    flat = init_lm_params(
+        jax.random.key(cfg.seed), mcfg, cfg.vocab, _n_experts(mesh, mcfg)
+    )
+    return decoder, decoder.stack_params(flat), flat, mcfg
+
+
+def _tables(decoder, slots: int) -> np.ndarray:
+    """Row i owns blocks [1 + i*n_pages, ...) — the deterministic layout
+    the direct captures write through (block 0 stays TRASH)."""
+    n_pages = decoder.n_pages
+    return np.asarray(
+        [[1 + i * n_pages + j for j in range(n_pages)]
+         for i in range(slots)],
+        np.int32,
+    )
+
+
+def _capture_decoder(mesh, cfg: PerfConfig) -> dict[str, dict]:
+    """decoder.prefill / decoder.step / decoder.verify / copy_blocks —
+    direct-timed compiled cores over a donated pool."""
+    import jax.numpy as jnp
+
+    from tpu_patterns.models.transformer import cost_metrics
+    from tpu_patterns.perf import analytic
+
+    decoder, params, _flat, mcfg = _decoder(mesh, cfg)
+    rng = np.random.RandomState(cfg.seed)
+    slots = cfg.slots
+    tables = _tables(decoder, slots)
+    active = np.ones((slots,), bool)
+    out: dict[str, dict] = {}
+    state = {"pool": decoder.init_pool()}  # donated: rethread every call
+
+    # prefill: all rows at the full (padded) prompt — the length the
+    # analytic count is written for
+    lpad = cfg.max_prompt
+    tokens = rng.randint(0, cfg.vocab, size=(slots, lpad)).astype(np.int32)
+    lens_full = np.full((slots,), lpad, np.int32)
+    start0 = np.zeros((slots,), np.int32)
+    pre = decoder.prefill_jit(slots, lpad)
+
+    def call_prefill():
+        state["pool"], tok0 = pre(
+            params, state["pool"], tokens, lens_full, start0, tables,
+            active,
+        )
+        return tok0
+
+    m = {
+        "analytic_flops": analytic.prefill_flops(
+            mcfg, cfg.vocab, slots, lpad
+        ),
+        "analytic_hbm_bytes": analytic.prefill_hbm_bytes(
+            mcfg, cfg.vocab, slots, lpad, cfg.cache_int8
+        ),
+    }
+    m.update(cost_metrics(
+        pre, params, state["pool"], tokens, lens_full, start0, tables,
+        active,
+    ))
+    m["step_ms"] = _timed_reps("decoder.prefill", call_prefill, cfg)
+    out["decoder.prefill"] = m
+
+    # one-token step at context ~= the prompt
+    tok = rng.randint(0, cfg.vocab, size=(slots,)).astype(np.int32)
+    steps0 = np.zeros((slots,), np.int32)
+    stp = decoder.step_jit(slots)
+
+    def call_step():
+        state["pool"], nxt = stp(
+            params, state["pool"], tok, lens_full, steps0, tables, active
+        )
+        return nxt
+
+    m = {
+        "analytic_flops": analytic.step_flops(
+            mcfg, cfg.vocab, slots, cfg.max_prompt
+        ),
+        "analytic_hbm_bytes": analytic.step_hbm_bytes(
+            mcfg, cfg.vocab, slots, cfg.max_prompt, cfg.cache_int8
+        ),
+    }
+    m.update(cost_metrics(
+        stp, params, state["pool"], tok, lens_full, steps0, tables, active
+    ))
+    m["step_ms"] = _timed_reps("decoder.step", call_step, cfg)
+    out["decoder.step"] = m
+
+    # speculative wide step: last token + spec_width drafts per row
+    width = cfg.spec_width + 1
+    toks_w = rng.randint(0, cfg.vocab, size=(slots, width)).astype(
+        np.int32
+    )
+    n_draft = np.full((slots,), cfg.spec_width, np.int32)
+    ver = decoder.verify_jit(slots, width)
+
+    def call_verify():
+        state["pool"], o = ver(
+            params, state["pool"], toks_w, lens_full, steps0, n_draft,
+            tables, active,
+        )
+        return o
+
+    m = {
+        "analytic_flops": analytic.verify_flops(
+            mcfg, cfg.vocab, slots, width, cfg.max_prompt
+        ),
+        "analytic_hbm_bytes": float(
+            width * analytic.step_hbm_bytes(
+                mcfg, cfg.vocab, slots, cfg.max_prompt, cfg.cache_int8
+            )
+            - (width - 1) * analytic.param_bytes(mcfg, cfg.vocab)
+        ),  # params stream once for the whole wide step
+    }
+    m.update(cost_metrics(
+        ver, params, state["pool"], toks_w, lens_full, steps0, n_draft,
+        tables, active,
+    ))
+    m["step_ms"] = _timed_reps("decoder.verify", call_verify, cfg)
+    out["decoder.verify"] = m
+
+    # CoW boundary copy: clone 2 physical blocks (all layers)
+    n_copy = 2
+    src = np.asarray([1, 2], np.int32)
+    dst = np.asarray([3, 4], np.int32)
+    cpy = decoder.copy_jit(n_copy)
+
+    def call_copy():
+        state["pool"] = cpy(state["pool"], src, dst)
+        return state["pool"]["k"]
+
+    copy_bytes = float(
+        2 * n_copy * cfg.block_len
+        * analytic.kv_token_bytes(mcfg, cfg.cache_int8)
+    )  # read + write each copied slot across every layer
+    m = {"analytic_flops": 0.0, "analytic_hbm_bytes": copy_bytes}
+    m.update(cost_metrics(cpy, state["pool"], src, dst))
+    m["step_ms"] = _timed_reps("copy_blocks", call_copy, cfg)
+    out["copy_blocks"] = m
+    return out
+
+
+def _hist_state(name: str) -> tuple[float, int]:
+    from tpu_patterns import obs
+
+    h = obs.histogram(name)
+    return h.sum, h.count
+
+
+def _capture_serve(mesh, cfg: PerfConfig) -> dict:
+    """The loadgen-driven leg: a real trace through ServeEngine, k runs,
+    wall-per-decode-dispatch read from the engine's own
+    ``tpu_patterns_serve_decode_wall_ms`` histogram — fault injection
+    and scheduler overhead are inside the window, which is what lets a
+    ``serve.step`` sleep fault show up in ``perf diff``."""
+    from tpu_patterns.perf import analytic
+    from tpu_patterns.serve.engine import Request, ServeEngine
+
+    decoder, params, _flat, mcfg = _decoder(mesh, cfg)
+    rng = np.random.RandomState(cfg.seed + 1)
+    trace = [
+        Request(
+            rid=i,
+            tokens=rng.randint(
+                0, cfg.vocab,
+                size=rng.randint(cfg.min_prompt, cfg.max_prompt + 1),
+            ).tolist(),
+            n_gen=cfg.gen,
+        )
+        for i in range(cfg.requests)
+    ]
+    # warm every bucket the trace will hit, outside the timed reps
+    ServeEngine(decoder, params, slots=cfg.slots).run(
+        [dataclasses.replace(r) for r in trace]
+    )
+    reps = []
+    for _ in range(cfg.k):
+        s0, c0 = _hist_state("tpu_patterns_serve_decode_wall_ms")
+        eng = ServeEngine(decoder, params, slots=cfg.slots)
+        eng.run([dataclasses.replace(r) for r in trace])
+        s1, c1 = _hist_state("tpu_patterns_serve_decode_wall_ms")
+        if c1 > c0:
+            reps.append((s1 - s0) / (c1 - c0))
+    # mean served context: prompts average (min+max)/2, generation adds
+    # gen/2 on average over a request's lifetime
+    ctx = (cfg.min_prompt + cfg.max_prompt) // 2 + cfg.gen // 2
+    return {
+        "analytic_flops": analytic.step_flops(
+            mcfg, cfg.vocab, cfg.slots, ctx
+        ),
+        "analytic_hbm_bytes": analytic.step_hbm_bytes(
+            mcfg, cfg.vocab, cfg.slots, ctx, cfg.cache_int8
+        ),
+        "step_ms": _median_ms(reps) if reps else -1.0,
+    }
+
+
+# -- the snapshot ----------------------------------------------------------
+
+
+def _derive(metrics: dict[str, float], n_chips: int, dtype: str) -> None:
+    """Roofline position in place: achieved rates from analytic counts
+    over the measured step, MFU when the chip peak is known.  The peak
+    is looked up at the CAPTURE dtype — an f32 capture scored against
+    the bf16 peak would halve every MFU, the exact mismatch
+    runtime.chip_peak_tflops's own accounting warns about."""
+    from tpu_patterns.runtime import chip_peak_tflops
+
+    ms = metrics.get("step_ms", 0.0)
+    if ms <= 0:
+        return
+    s = ms / 1e3
+    flops = metrics.get("analytic_flops", 0.0)
+    byts = metrics.get("analytic_hbm_bytes", 0.0)
+    if flops > 0:
+        metrics["achieved_gflops"] = flops / s / 1e9
+    if byts > 0:
+        metrics["achieved_gbps"] = byts / s / 1e9
+    if flops > 0 and byts > 0:
+        metrics["intensity_flops_per_byte"] = flops / byts
+    peak = chip_peak_tflops(dtype=dtype)
+    if peak is not None and flops > 0:
+        metrics["mfu"] = (flops / s / 1e12) / (peak * n_chips)
+
+
+def _cache_hit(metrics: dict[str, float]) -> None:
+    """Persistent-cache evidence: a plain compile served well under the
+    real (cache-bypassed) compile's cost is a hit."""
+    real, cached = (
+        metrics.get("compile_s"), metrics.get("cached_compile_s")
+    )
+    if real and cached is not None and real > 0:
+        metrics["cache_hit"] = 1.0 if cached < 0.25 * real else 0.0
+
+
+def capture(mesh, cfg: PerfConfig, writer=None) -> dict:
+    """Run the registry and return one normalized snapshot."""
+    from tpu_patterns import obs
+    from tpu_patterns.perf.provenance import stamp_dict
+
+    names = _selected(cfg)
+
+    def say(msg: str) -> None:
+        if writer is not None:
+            writer.progress(msg)
+
+    executables: dict[str, dict] = {}
+    if "train.step" in names:
+        say("perf capture: train.step")
+        executables["train.step"] = _capture_train(mesh, cfg, zero=False)
+    if "zero.step" in names:
+        say("perf capture: zero.step")
+        executables["zero.step"] = _capture_train(mesh, cfg, zero=True)
+    if {n for n in names} & {
+        "decoder.prefill", "decoder.step", "decoder.verify", "copy_blocks"
+    }:
+        say("perf capture: decoder prefill/step/verify + copy_blocks")
+        dec = _capture_decoder(mesh, cfg)
+        for n, m in dec.items():
+            if n in names:
+                executables[n] = m
+    if "serve.step" in names:
+        say("perf capture: serve.step (engine-driven trace)")
+        executables["serve.step"] = _capture_serve(mesh, cfg)
+
+    n_chips = int(np.asarray(mesh.devices).size)
+    for name, metrics in executables.items():
+        _derive(metrics, n_chips, cfg.dtype)
+        _cache_hit(metrics)
+        obs.gauge(
+            "tpu_patterns_perf_step_ms", executable=name
+        ).set(metrics.get("step_ms", -1.0))
+        obs.gauge(
+            "tpu_patterns_perf_analytic_flops", executable=name
+        ).set(metrics.get("analytic_flops", 0.0))
+        if "achieved_gflops" in metrics:
+            obs.gauge(
+                "tpu_patterns_perf_achieved_gflops", executable=name
+            ).set(metrics["achieved_gflops"])
+        if "achieved_gbps" in metrics:
+            obs.gauge(
+                "tpu_patterns_perf_achieved_gbps", executable=name
+            ).set(metrics["achieved_gbps"])
+    obs.counter("tpu_patterns_perf_captures_total").inc()
+
+    import jax
+
+    return {
+        "run": stamp_dict(),
+        "ts": wall_time_s(),
+        "config": dataclasses.asdict(cfg),
+        "mesh": {
+            "shape": {k: int(v) for k, v in mesh.shape.items()},
+            "devices": n_chips,
+            "platform": jax.default_backend(),
+        },
+        "executables": executables,
+    }

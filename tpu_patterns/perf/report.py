@@ -1,0 +1,132 @@
+"""Render the perfwatch report: roofline table + trajectory.
+
+Text-only (the house style: grep-able markdown, no plotting deps).  Two
+sections:
+
+* **Executables** — one row per registry entry from the fresh snapshot:
+  analytic GFLOPs, measured step time, achieved GFLOP/s and GB/s
+  against the analytic traffic floor, arithmetic intensity, MFU when a
+  chip peak is known (CPU-mesh runs print rates without an MFU column
+  rather than a number against a meaningless peak), compile time and
+  cache evidence.
+* **Trajectory** — the longitudinal view from perf/history.py: per-
+  executable step_ms across banked snapshots (joinable by run_id/git
+  SHA), the committed BENCH_r* rounds (the hardware-outage record IS
+  part of the trajectory), and the Record population under results/.
+"""
+
+from __future__ import annotations
+
+
+def _fmt(v: float | None, spec: str = ".3g") -> str:
+    if v is None:
+        return "—"
+    return format(v, spec)
+
+
+def render_snapshot(snapshot: dict) -> str:
+    run = snapshot.get("run", {})
+    mesh = snapshot.get("mesh", {})
+    lines = [
+        "## perfwatch snapshot",
+        "",
+        f"- run {run.get('run_id', '?')} @ {run.get('git_sha', '?')} "
+        f"(mesh_fp {run.get('mesh_fp', '?')})",
+        f"- mesh {mesh.get('shape', {})} on "
+        f"{mesh.get('devices', '?')}x {mesh.get('platform', '?')}",
+        "",
+        "| executable | GFLOP | step ms | GFLOP/s | GB/s(floor) | "
+        "flops/byte | mfu | compile s | cache |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+    for name in sorted(snapshot.get("executables", {})):
+        m = snapshot["executables"][name]
+        flops = m.get("analytic_flops")
+        cache = m.get("cache_hit")
+        lines.append(
+            "| {} | {} | {} | {} | {} | {} | {} | {} | {} |".format(
+                name,
+                _fmt(flops / 1e9 if flops else None),
+                _fmt(m.get("step_ms"), ".4g"),
+                _fmt(m.get("achieved_gflops")),
+                _fmt(m.get("achieved_gbps")),
+                _fmt(m.get("intensity_flops_per_byte")),
+                _fmt(m.get("mfu"), ".2%") if "mfu" in m else "—",
+                _fmt(m.get("compile_s")),
+                "hit" if cache == 1.0 else
+                ("miss" if cache == 0.0 else "—"),
+            )
+        )
+    lines.append("")
+    return "\n".join(lines)
+
+
+def render_trajectory(timeline: dict) -> str:
+    lines = ["## perf trajectory", ""]
+
+    snaps = timeline.get("snapshots", [])
+    if snaps:
+        lines.append(f"### snapshots ({len(snaps)} banked runs)")
+        lines.append("")
+        names = sorted({
+            n for s in snaps for n in s.get("executables", {})
+        })
+        lines.append("| executable | step_ms over runs (old -> new) |")
+        lines.append("|---|---|")
+        for n in names:
+            series = []
+            for s in snaps:
+                v = s.get("executables", {}).get(n, {}).get("step_ms")
+                series.append("·" if v is None else f"{v:.3g}")
+            lines.append(f"| {n} | {' '.join(series)} |")
+        runs = [
+            f"{s.get('run', {}).get('run_id', '?')}"
+            f"@{s.get('run', {}).get('git_sha', '?')}"
+            for s in snaps
+        ]
+        lines.append("")
+        lines.append(f"runs: {', '.join(runs)}")
+        lines.append("")
+
+    rounds = timeline.get("bench_rounds", [])
+    if rounds:
+        lines.append("### driver captures (BENCH_r*.json)")
+        lines.append("")
+        for r in rounds:
+            if r["error"]:
+                lines.append(
+                    f"- r{r['round']:02d}: FAILED — {r['error']}"
+                )
+            else:
+                lines.append(
+                    f"- r{r['round']:02d}: {r['metric']} = "
+                    f"{r['value']:g} {r['unit']}"
+                )
+        lines.append("")
+
+    records = timeline.get("records", [])
+    if records:
+        stamped = sum(1 for r in records if r.get("run"))
+        run_ids = {
+            r["run"].get("run_id") for r in records if r.get("run")
+        }
+        by_pattern: dict[str, int] = {}
+        for r in records:
+            by_pattern[r["pattern"]] = by_pattern.get(r["pattern"], 0) + 1
+        lines.append(
+            f"### results/ records: {len(records)} total, {stamped} "
+            f"run-stamped across {len(run_ids)} distinct runs"
+        )
+        lines.append("")
+        for pat in sorted(by_pattern):
+            lines.append(f"- {pat}: {by_pattern[pat]}")
+        lines.append("")
+
+    if len(lines) == 2:
+        lines.append("(no history yet — run `tpu-patterns perf report`)")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def render(snapshot: dict, timeline: dict) -> str:
+    return render_snapshot(snapshot) + "\n" + render_trajectory(timeline)

@@ -868,6 +868,15 @@ class ServeEngine:
                             if self.spec_k
                             else (self._step, "serve.step")
                         )
+                        # engine-level wall clock around the WHOLE decode
+                        # dispatch — fault injection, retries, and host
+                        # scheduling included, unlike serve_step_ms which
+                        # times only the compiled call.  This is the
+                        # series perfwatch gates (perf/registry.py): an
+                        # injected sleep at serve.step fires BEFORE the
+                        # compiled-call span opens and would be invisible
+                        # to the narrower histogram.
+                        t_dispatch = clock_ns()
                         try:
                             faults.call_with_retry(
                                 step_fn,
@@ -880,6 +889,10 @@ class ServeEngine:
                                 casualties,
                                 f"decode step failed after retries: {e}",
                             )
+                        finally:
+                            obs.histogram(
+                                "tpu_patterns_serve_decode_wall_ms"
+                            ).observe((clock_ns() - t_dispatch) / 1e6)
                     self.stats["peak_blocks"] = max(
                         self.stats["peak_blocks"], self.allocated_blocks()
                     )
