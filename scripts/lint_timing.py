@@ -1,52 +1,23 @@
 #!/usr/bin/env python
-"""Lint: all timing in ``tpu_patterns/`` goes through ``core/timing.py``.
+"""DEPRECATED shim — use ``tpu-patterns lint --rules clock-discipline``.
 
-Thin shim over graftlint's ``clock-discipline`` rule
-(tpu_patterns/analysis/) so existing CI invocations keep working: same
-contract as always — exit 0 = clean, 1 = violations printed as
-``path:line: text``.  (Importing the package pulls in jax — the repo's
-baseline dependency everywhere — but the rule itself never inits a
-backend or compiles anything.)  The rule logic,
-file discovery (shared walker: __pycache__, build/, fixtures, generated
-files all excluded in ONE place), and suppression syntax now live in
-the framework; this script is strict mode (no ratchet baseline — a
-clock violation is never acceptable debt).
-
-Run directly, via CI (.github/workflows/ci.yml), or as the full
-catalog: ``tpu-patterns lint`` (docs/static-analysis.md).
+The timing lint has lived in graftlint since PR 6 (the
+``clock-discipline`` rule, tpu_patterns/analysis/); this script remains
+only so historical invocations keep working, and is now a bare exec of
+the CLI — no hand-rolled path handling, no duplicate discovery logic.
+CI and docs invoke the CLI directly.
 """
-
-from __future__ import annotations
 
 import os
 import sys
 
-# runnable as a loose script from anywhere in the repo
-sys.path.insert(
-    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-)
-
-
-def lint() -> int:
-    from tpu_patterns.analysis import run_lint
-
-    report = run_lint(
-        rules=["clock-discipline"], tier="a", use_baseline=False
-    )
-    violations = report.new
-    if violations:
-        print(
-            "bare time.time()/time.perf_counter() outside core/timing.py "
-            "— route durations through timing.clock_ns() and timestamps "
-            "through timing.wall_time_s():",
-            file=sys.stderr,
-        )
-        for f in violations:
-            print(f"  {f.path}:{f.line}: {f.snippet}", file=sys.stderr)
-        return 1
-    print("timing lint: clean")
-    return 0
-
-
-if __name__ == "__main__":
-    sys.exit(lint())
+env = dict(os.environ)  # loose-script runs: make the repo importable
+env["PYTHONPATH"] = os.pathsep.join(filter(None, (
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    env.get("PYTHONPATH"),
+)))
+os.execve(sys.executable, [
+    sys.executable, "-m", "tpu_patterns", "lint",
+    "--rules", "clock-discipline", "--tier", "a", "--strict",
+    *sys.argv[1:],
+], env)

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""CI smoke for shardlint (Tier C): clean tree green, seeded bug red.
+
+Two legs, both through the real machinery on the CPU backend:
+
+1. ``tpu-patterns lint --tier c`` over the committed tree must exit 0
+   (the full ``--tier all`` leg runs in the ``lint`` CI job; this one
+   isolates Tier C so a Tier A/B regression cannot mask it).
+2. A SEEDED violation — a fixture entry whose collective names a mesh
+   axis that does not exist (``"zz"``) — registered through the same
+   ``register_spmd_entry`` hook production code uses must make the lint
+   exit NONZERO with a ``collective-axis-discipline`` finding.  The
+   axis-name-typo class fails at lowering, and a lint that cannot see
+   a wrong axis name is not checking anything.
+
+Exit 0 iff both legs hold.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+SEEDED = r"""
+import sys
+
+from tpu_patterns.analysis import run_lint
+from tpu_patterns.perf import registry
+
+
+def _bad_axis_entry():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("sp",))
+    fn = jax.jit(jax.shard_map(
+        lambda x: lax.psum(x, "zz"),  # the seeded wrong axis name
+        mesh=mesh, in_specs=(P("sp"),), out_specs=P(),
+    ))
+    return fn, (jnp.ones((4,)),)
+
+
+registry.register_spmd_entry(registry.SpmdEntry(
+    "fixture.bad-axis", ("sp",), _bad_axis_entry,
+))
+report = run_lint(
+    tier="c", rules=["collective-axis-discipline"], use_baseline=False
+)
+for f in report.new:
+    print(f"{f.rule}: {f.message.splitlines()[0]}")
+sys.exit(report.exit_code)
+"""
+
+
+def run(label, argv, **kw):
+    print("+", label, flush=True)
+    return subprocess.run(argv, cwd=ROOT, env=ENV, **kw)
+
+
+def main() -> int:
+    # leg 1: the committed tree is Tier-C clean
+    clean = run("tpu-patterns lint --tier c --format github", [
+        sys.executable, "-m", "tpu_patterns", "lint", "--tier", "c",
+        "--format", "github",
+    ])
+    if clean.returncode != 0:
+        print("shardlint smoke: committed tree is NOT clean", file=sys.stderr)
+        return 1
+
+    # leg 2: the seeded wrong-axis entry must turn the exit nonzero
+    seeded = run(
+        "seeded wrong-axis entry via register_spmd_entry",
+        [sys.executable, "-c", SEEDED], capture_output=True, text=True,
+    )
+    sys.stdout.write(seeded.stdout)
+    sys.stderr.write(seeded.stderr)
+    if seeded.returncode == 0:
+        print(
+            "shardlint smoke: seeded wrong-axis entry passed the lint — "
+            "the checker is blind",
+            file=sys.stderr,
+        )
+        return 1
+    if "collective-axis-discipline" not in seeded.stdout:
+        print(
+            "shardlint smoke: nonzero exit but no "
+            "collective-axis-discipline finding named the seeded bug",
+            file=sys.stderr,
+        )
+        return 1
+    print("shardlint smoke: clean tree green, seeded wrong-axis red")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,6 @@
+# Committed anchor for the Tier-C suppression tests: a shardlint
+# finding whose SpmdEntry anchors here (line 5, below the allow) must be
+# suppressed by the standalone allow comment through the same
+# scan_finding_allows path the engine uses for registry-anchored debt.
+# graftlint: allow[collective-axis-discipline] -- fixture: committed Tier-C suppression anchor
+ANCHOR_LINE = 6  # the allow above covers this statement

@@ -591,7 +591,7 @@ class TestRecordsAndFormats:
 class TestWalker:
     def test_shared_exclusions(self, tmp_path):
         (tmp_path / "ok.py").write_text("x = 1\n")
-        for d in ("__pycache__", "build", "fixtures"):
+        for d in ("__pycache__", "build", "fixtures", "results", "docs"):
             (tmp_path / d).mkdir()
             (tmp_path / d / "no.py").write_text("x = 1\n")
         (tmp_path / "gen_pb2.py").write_text("x = 1\n")
@@ -600,6 +600,24 @@ class TestWalker:
         got = [os.path.basename(p)
                for p in walker.iter_source_files(str(tmp_path))]
         assert got == ["ok.py"]
+
+    def test_exclusion_list_pinned(self):
+        # the ONE exclusion policy every source-level tool shares:
+        # results/ and docs/ archive .py snippets (banked artifacts,
+        # doc excerpts) and fixture output dirs are machine-written —
+        # a tool walking any of them lints files nobody maintains
+        assert {
+            "__pycache__", "build", "dist", "fixtures", "results",
+            "docs", ".git", ".eggs", ".venv", "venv", "node_modules",
+        } <= set(walker.EXCLUDED_DIRS)
+
+    def test_repo_rooted_walk_skips_archives(self):
+        # the gap this pins: a walk from the REPO root (not the package)
+        # must not surface results/ or docs/ snippet files
+        for p in walker.iter_source_files(walker.repo_root()):
+            rel = os.path.relpath(p, walker.repo_root())
+            top = rel.split(os.sep)[0]
+            assert top not in ("results", "docs", "build"), rel
 
     def test_package_walk_skips_pycache(self):
         for p in walker.iter_source_files():
@@ -621,13 +639,16 @@ class TestRepoGate:
         assert missing == [], "baseline entries need a justification"
 
     def test_timing_shim_still_works(self):
+        # deprecated exec shim: same exit contract as always, body is
+        # now `tpu-patterns lint --rules clock-discipline --tier a`
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         proc = subprocess.run(
             [sys.executable, os.path.join(root, "scripts", "lint_timing.py")],
             capture_output=True, text=True, timeout=180,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
         )
-        assert proc.returncode == 0, proc.stderr
-        assert "timing lint: clean" in proc.stdout
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "## clock-discipline | tierA | SUCCESS" in proc.stdout
 
     # NB: the CLI tests run in a SUBPROCESS on purpose — cli.main()
     # calls setup_jax(), which enables the persistent compilation cache
@@ -645,6 +666,30 @@ class TestRepoGate:
         proc = self._cli("--tier", "a")
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "## clock-discipline | tierA | SUCCESS" in proc.stdout
+
+    def test_cli_strict_ignores_baseline(self, tmp_path):
+        # the timing gate's mode: a violation pinned in a baseline must
+        # STILL fail under --strict (a clock violation is never debt)
+        bad = tmp_path / "pkg"
+        bad.mkdir()
+        (bad / "mod.py").write_text("import time\nt = time.time()\n")
+        rep = engine.run_lint(
+            tier="a", rules=["clock-discipline"], root=str(bad),
+            baseline_path=str(tmp_path / "bl.json"),
+        )
+        fnd.save_baseline(str(tmp_path / "bl.json"), rep.new, {})
+        # baselined: the ratcheted run passes...
+        rep2 = engine.run_lint(
+            tier="a", rules=["clock-discipline"], root=str(bad),
+            baseline_path=str(tmp_path / "bl.json"),
+        )
+        assert rep2.exit_code == 0
+        # ...the strict run (the shim/CI gate) still fails
+        rep3 = engine.run_lint(
+            tier="a", rules=["clock-discipline"], root=str(bad),
+            baseline_path=str(tmp_path / "bl.json"), use_baseline=False,
+        )
+        assert rep3.exit_code == 1
 
     def test_cli_lint_unknown_rule_fails_loudly(self):
         proc = self._cli("--rules", "nope")
@@ -777,3 +822,634 @@ class TestTraceChecks:
         assert rep.new == [], [
             f"{f.location()}: [{f.rule}] {f.message}" for f in rep.new
         ]
+
+
+class TestMultiLineSuppression:
+    """Satellite: allow anchors cover whole logical statements, so a
+    finding anchored at a multi-line statement's first physical line is
+    covered by an allow riding any of its lines (or standing above a
+    decorator chain)."""
+
+    def test_trailing_allow_on_later_physical_line_covers_statement(self):
+        fs = _run(astlint.ClockDiscipline(), _sf("""
+            import time
+            t = (
+                time
+                .time()  # graftlint: allow[clock-discipline] -- fixture says so
+            )
+        """))
+        assert len(fs) == 1 and fs[0].suppressed
+
+    def test_standalone_allow_covers_implicit_continuation(self):
+        # the finding anchors INSIDE the bracketed continuation (line 2
+        # of the statement); the allow above the statement still covers
+        fs = _run(astlint.SleepOutsideBackoff(), _sf("""
+            import time
+            # graftlint: allow[sleep-outside-backoff] -- fixture says so
+            handlers = [
+                time.sleep,
+            ]
+        """))
+        assert len(fs) == 1 and fs[0].suppressed
+
+    def test_standalone_allow_covers_decorator_chain_and_def(self):
+        allows = fnd.scan_allows([
+            "# graftlint: allow[some-rule] -- fixture says so",
+            "@deco(",
+            "    1,",
+            ")",
+            "@other",
+            "def f():",
+            "    pass",
+        ])
+        assert 6 in allows  # the def header itself
+        assert allows[6].rules == frozenset({"some-rule"})
+        assert 7 not in allows  # the body is NOT blanket-covered
+
+    def test_decorator_chain_survives_interleaved_comments_and_blanks(self):
+        # blank and comment lines interleave legally in a decorator
+        # chain; the walk must still reach the def header
+        allows = fnd.scan_allows([
+            "# graftlint: allow[some-rule] -- fixture says so",
+            "@deco",
+            "# explanatory comment",
+            "",
+            "def f():",
+            "    pass",
+        ])
+        assert 5 in allows  # the def header, past the comment + blank
+        assert 6 not in allows
+
+    def test_multiline_decorator_argument_covered(self):
+        fs = _run(astlint.ClockDiscipline(), _sf("""
+            import time
+            # graftlint: allow[clock-discipline] -- fixture says so
+            @retry(
+                deadline=time.time(),
+            )
+            def f():
+                pass
+        """))
+        assert len(fs) == 1 and fs[0].suppressed
+
+    def test_coverage_stays_statement_scoped(self):
+        # the fix must not turn an allow into a file-wide blanket: a
+        # violation in the NEXT statement stays live
+        fs = _run(astlint.SleepOutsideBackoff(), _sf("""
+            import time
+            # graftlint: allow[sleep-outside-backoff] -- fixture says so
+            time.sleep(1)
+            time.sleep(2)
+        """))
+        assert len(fs) == 2
+        assert [f.suppressed for f in sorted(fs, key=lambda f: f.line)] \
+            == [True, False]
+
+
+class TestPruneStale:
+    """Satellite: --prune-stale drops fixed debt without re-pinning."""
+
+    def test_round_trip_preserves_survivor_justifications(self, corpus):
+        from tpu_patterns.core import ratchet
+
+        pkg, bl = corpus
+        (pkg / "mod2.py").write_text("import time\ntime.sleep(9)\n")
+        rep = engine.run_lint(tier="a", root=str(pkg), baseline_path=bl)
+        assert len(rep.new) == 2
+        fnd.save_baseline(bl, rep.new, {})
+        old = fnd.load_baseline(bl)
+        for fp in old:
+            old[fp]["justification"] = f"debt note for {fp}"
+        ratchet.save_entries(
+            bl, list(old.values()), version=fnd.BASELINE_VERSION
+        )
+
+        # fix ONE violation, prune: the fixed entry leaves the ledger,
+        # the survivor keeps its value AND justification byte-for-byte
+        (pkg / "mod2.py").write_text("y = 3\n")
+        rep2 = engine.run_lint(
+            tier="a", root=str(pkg), baseline_path=bl, prune_stale=True,
+        )
+        assert rep2.exit_code == 0
+        assert rep2.stale == []  # pruned this run, not just reported
+        after = fnd.load_baseline(bl)
+        assert len(after) == 1
+        (fp, entry), = after.items()
+        assert entry == old[fp]
+
+        # idempotent: a second prune with nothing stale changes nothing
+        engine.run_lint(
+            tier="a", root=str(pkg), baseline_path=bl, prune_stale=True,
+        )
+        assert fnd.load_baseline(bl) == after
+
+    def test_partial_rules_prune_only_their_own_entries(self, corpus):
+        from tpu_patterns.core import ratchet
+
+        pkg, bl = corpus
+        rep = engine.run_lint(tier="a", root=str(pkg), baseline_path=bl)
+        fnd.save_baseline(bl, rep.new, {})
+        # seed a foreign-rule entry the sleep-only run must NOT prune
+        old = fnd.load_baseline(bl)
+        foreign = {
+            "rule": "clock-discipline", "path": "gone.py",
+            "fingerprint": "aaaa000011112222", "text": "time.time()",
+            "justification": "other rule's debt",
+        }
+        ratchet.save_entries(
+            bl, list(old.values()) + [foreign],
+            version=fnd.BASELINE_VERSION,
+        )
+        engine.run_lint(
+            tier="a", root=str(pkg), baseline_path=bl,
+            rules=["sleep-outside-backoff"], prune_stale=True,
+        )
+        after = fnd.load_baseline(bl)
+        assert "aaaa000011112222" in after  # unexercised rule survived
+
+    def test_prune_refused_in_strict_mode(self, corpus):
+        pkg, bl = corpus
+        with pytest.raises(ValueError, match="strict mode"):
+            engine.run_lint(
+                tier="a", root=str(pkg), baseline_path=bl,
+                use_baseline=False, prune_stale=True,
+            )
+
+    def test_prune_and_update_are_mutually_exclusive(self, corpus):
+        pkg, bl = corpus
+        with pytest.raises(ValueError, match="pass one"):
+            engine.run_lint(
+                tier="all", root=str(pkg), baseline_path=bl,
+                update_baseline=True, prune_stale=True,
+            )
+
+    def test_core_prune_missing_file_is_noop(self, tmp_path):
+        from tpu_patterns.core import ratchet
+
+        kept, dropped = ratchet.prune_stale(
+            str(tmp_path / "absent.json"), ["x"], version=1
+        )
+        assert (kept, dropped) == (0, [])
+
+
+class TestTierPlumbing:
+    def test_rule_tiers(self):
+        assert engine.rule_tier("clock-discipline") == "A"
+        assert engine.rule_tier("trace-donation") == "B"
+        assert engine.rule_tier("mesh-axis-order") == "C"
+        assert engine.rule_tier("recompile-hazard") == "C"
+
+    def test_catalog_covers_all_tiers(self):
+        from tpu_patterns.analysis.shardlint import SHARD_CHECKS
+
+        names = set(engine.rule_names())
+        assert set(SHARD_CHECKS) <= names
+        docs = engine.rule_docs()
+        assert all(r in docs and docs[r] for r in names)
+
+    def test_both_excludes_tier_c(self, corpus):
+        pkg, bl = corpus
+        with pytest.raises(ValueError, match="no rule left"):
+            engine.run_lint(
+                tier="both", root=str(pkg), baseline_path=bl,
+                rules=["mesh-axis-order"],
+            )
+
+    def test_unknown_tier_rejected(self, corpus):
+        pkg, bl = corpus
+        with pytest.raises(ValueError, match="tier"):
+            engine.run_lint(tier="z", root=str(pkg), baseline_path=bl)
+
+    def test_update_baseline_requires_tier_all(self, corpus):
+        # "both" stopped being the full catalog when Tier C landed: a
+        # re-pin from it would drop every shardlint entry
+        pkg, bl = corpus
+        with pytest.raises(ValueError, match="FULL run"):
+            engine.run_lint(
+                tier="both", root=str(pkg), baseline_path=bl,
+                update_baseline=True,
+            )
+
+
+def _mesh8(names):
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = np.asarray(jax.devices())
+    if len(names) == 2:
+        return Mesh(devs.reshape(4, 2), names)
+    return Mesh(devs, names)
+
+
+def _spmd_fixture(name, build, **kw):
+    from tpu_patterns.perf.registry import SpmdEntry
+
+    return SpmdEntry(name, kw.pop("axes", ("sp", "tp")), build, **kw)
+
+
+class TestShardChecks:
+    """Tier C: every rule fires, passes, and suppresses on fixture
+    entries fed through the registry's fixture door."""
+
+    # -- collective-axis-discipline --------------------------------------
+
+    def _bad_axis_entry(self):
+        def build():
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+            from jax.sharding import PartitionSpec as P
+
+            m = _mesh8(("sp", "tp"))
+            fn = jax.jit(jax.shard_map(
+                lambda x: lax.psum(x, "zz"),
+                mesh=m, in_specs=(P("sp"),), out_specs=P(),
+            ))
+            return fn, (jnp.ones((8,)),)
+
+        return _spmd_fixture("fix.badaxis", build)
+
+    def test_axis_discipline_fires_on_wrong_axis(self):
+        from tpu_patterns.analysis import shardlint
+
+        fs = shardlint.run_shard_checks(
+            ["collective-axis-discipline"],
+            entries=[self._bad_axis_entry()],
+        )
+        assert len(fs) == 1
+        assert fs[0].rule == "collective-axis-discipline"
+        assert "failed to lower" in fs[0].message
+        assert fs[0].tier == "C"
+
+    def test_axis_discipline_fires_on_unused_axis(self):
+        from tpu_patterns.analysis import shardlint
+
+        def build():
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+            from jax.sharding import PartitionSpec as P
+
+            m = _mesh8(("sp", "tp"))  # tp (size 2) never referenced
+            fn = jax.jit(jax.shard_map(
+                lambda x: lax.psum(x, "sp"),
+                mesh=m, in_specs=(P("sp"),), out_specs=P(),
+            ))
+            return fn, (jnp.ones((8,)),)
+
+        fs = shardlint.run_shard_checks(
+            ["collective-axis-discipline"],
+            entries=[_spmd_fixture("fix.unused", build)],
+        )
+        assert len(fs) == 1 and "unused" in fs[0].message
+
+    def test_axis_discipline_clean(self):
+        from tpu_patterns.analysis import shardlint
+
+        def build():
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+            from jax.sharding import PartitionSpec as P
+
+            m = _mesh8(("sp", "tp"))
+            fn = jax.jit(jax.shard_map(
+                lambda x: lax.psum(lax.psum(x, "sp"), "tp"),
+                mesh=m, in_specs=(P(("sp", "tp")),), out_specs=P(),
+            ))
+            return fn, (jnp.ones((8,)),)
+
+        assert shardlint.run_shard_checks(
+            ["collective-axis-discipline"],
+            entries=[_spmd_fixture("fix.clean", build)],
+        ) == []
+
+    def test_shard_finding_suppressed_by_anchor_allow(self):
+        # the registration-anchored suppression contract: an allow on
+        # the entry's anchor line covers its findings
+        from tpu_patterns.analysis import shardlint
+
+        e = dataclasses_replace_anchor(
+            self._bad_axis_entry(), "tpu_patterns/fake/reg.py", 2
+        )
+        fs = shardlint.run_shard_checks(
+            ["collective-axis-discipline"], entries=[e]
+        )
+        allows = {e.path: fnd.scan_allows([
+            "# graftlint: allow[collective-axis-discipline] -- fixture says so",
+            "ENTRY = register(...)",
+        ])}
+        fnd.apply_suppressions(fs, allows)
+        assert len(fs) == 1 and fs[0].suppressed
+        assert fs[0].justification == "fixture says so"
+
+    def test_shard_finding_suppressed_through_engine_scan(self):
+        # end-to-end through the engine's scan_finding_allows: the
+        # committed fixture file's allow suppresses a finding anchored
+        # at it, with no Tier-A walk having loaded the file
+        from tpu_patterns.analysis import shardlint
+
+        e = dataclasses_replace_anchor(
+            self._bad_axis_entry(),
+            "tests/fixtures/shardlint_allow_fixture.py", 6,
+        )
+        fs = shardlint.run_shard_checks(
+            ["collective-axis-discipline"], entries=[e]
+        )
+        allows = engine.scan_finding_allows(fs, {})
+        fnd.apply_suppressions(fs, allows)
+        assert len(fs) == 1 and fs[0].suppressed
+
+    # -- mesh-axis-order -------------------------------------------------
+
+    def _order_entry(self, mesh_names, spec_axes):
+        def build():
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+            from jax.sharding import PartitionSpec as P
+
+            m = _mesh8(mesh_names)
+            fn = jax.jit(jax.shard_map(
+                lambda x: lax.psum(x, mesh_names),
+                mesh=m, in_specs=(P(spec_axes),), out_specs=P(),
+            ))
+            return fn, (jnp.ones((8,)),)
+
+        return _spmd_fixture("fix.order", build, axes=("sp", "tp"))
+
+    def test_mesh_axis_order_fires_on_reversed_mesh(self):
+        from tpu_patterns.analysis import shardlint
+
+        fs = shardlint.run_shard_checks(
+            ["mesh-axis-order"],
+            entries=[self._order_entry(("tp", "sp"), ("tp", "sp"))],
+        )
+        assert len(fs) == 1 and "canonical order" in fs[0].message
+
+    def test_mesh_axis_order_fires_on_merged_spec(self):
+        from tpu_patterns.analysis import shardlint
+
+        fs = shardlint.run_shard_checks(
+            ["mesh-axis-order"],
+            entries=[self._order_entry(("sp", "tp"), ("tp", "sp"))],
+        )
+        assert fs and all("against the canonical" in f.message for f in fs)
+
+    def test_mesh_axis_order_clean(self):
+        from tpu_patterns.analysis import shardlint
+
+        assert shardlint.run_shard_checks(
+            ["mesh-axis-order"],
+            entries=[self._order_entry(("sp", "tp"), ("sp", "tp"))],
+        ) == []
+
+    def test_mesh_axis_order_suppressed(self):
+        from tpu_patterns.analysis import shardlint
+
+        e = dataclasses_replace_anchor(
+            self._order_entry(("tp", "sp"), ("tp", "sp")),
+            "tpu_patterns/fake/reg.py", 2,
+        )
+        fs = shardlint.run_shard_checks(["mesh-axis-order"], entries=[e])
+        fnd.apply_suppressions(fs, {e.path: fnd.scan_allows([
+            "# graftlint: allow[mesh-axis-order] -- fixture says so",
+            "ENTRY = register(...)",
+        ])})
+        assert len(fs) == 1 and fs[0].suppressed
+
+    # -- collective-in-decode-hot-path -----------------------------------
+
+    def _hot_entry(self, declared):
+        def build():
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+            from jax.sharding import PartitionSpec as P
+
+            m = _mesh8(("sp", "tp"))
+            fn = jax.jit(jax.shard_map(
+                lambda x: lax.all_gather(lax.psum(x, "tp"), "sp"),
+                mesh=m, in_specs=(P("sp"),), out_specs=P(None, None),
+            ))
+            return fn, (jnp.ones((8,)),)
+
+        return _spmd_fixture(
+            "fix.hot", build, declared_collectives=declared,
+        )
+
+    def test_decode_collectives_fires_on_undeclared(self):
+        from tpu_patterns.analysis import shardlint
+
+        fs = shardlint.run_shard_checks(
+            ["collective-in-decode-hot-path"],
+            entries=[self._hot_entry(frozenset({("psum", ("tp",))}))],
+        )
+        assert len(fs) == 1
+        assert "NEW collective all_gather" in fs[0].message
+
+    def test_decode_collectives_clean_when_declared(self):
+        from tpu_patterns.analysis import shardlint
+
+        declared = frozenset({
+            ("psum", ("tp",)), ("all_gather", ("sp",)),
+        })
+        assert shardlint.run_shard_checks(
+            ["collective-in-decode-hot-path"],
+            entries=[self._hot_entry(declared)],
+        ) == []
+
+    def test_decode_collectives_suppressed(self):
+        from tpu_patterns.analysis import shardlint
+
+        e = dataclasses_replace_anchor(
+            self._hot_entry(frozenset()), "tpu_patterns/fake/reg.py", 2
+        )
+        fs = shardlint.run_shard_checks(
+            ["collective-in-decode-hot-path"], entries=[e]
+        )
+        fnd.apply_suppressions(fs, {e.path: fnd.scan_allows([
+            "# graftlint: allow[collective-in-decode-hot-path] -- fixture says so",
+            "ENTRY = register(...)",
+        ])})
+        assert fs and all(f.suppressed for f in fs)
+
+    # -- donation-coverage -----------------------------------------------
+
+    def _donate_entry(self, declare: bool):
+        def build():
+            import jax
+            import jax.numpy as jnp
+
+            kw = {"donate_argnums": (0,)} if declare else {}
+            fn = jax.jit(lambda a: a + 1, **kw)
+            return fn, (jnp.zeros((64, 64), jnp.float32),)
+
+        return _spmd_fixture("fix.donate", build, axes=(), donates=True)
+
+    def test_donation_coverage_fires(self):
+        from tpu_patterns.analysis import shardlint
+
+        fs = shardlint.run_shard_checks(
+            ["donation-coverage"], entries=[self._donate_entry(False)]
+        )
+        if not fs and shardlint.run_shard_checks(
+            ["donation-coverage"], entries=[self._donate_entry(True)]
+        ) == []:
+            pytest.skip("backend exposes no memory-analysis API")
+        assert len(fs) == 1 and "aliases 0 bytes" in fs[0].message
+
+    def test_donation_coverage_clean(self):
+        from tpu_patterns.analysis import shardlint
+
+        assert shardlint.run_shard_checks(
+            ["donation-coverage"], entries=[self._donate_entry(True)]
+        ) == []
+
+    def test_donation_coverage_suppressed(self):
+        from tpu_patterns.analysis import shardlint
+
+        e = dataclasses_replace_anchor(
+            self._donate_entry(False), "tpu_patterns/fake/reg.py", 2
+        )
+        fs = shardlint.run_shard_checks(["donation-coverage"], entries=[e])
+        if not fs:
+            pytest.skip("backend exposes no memory-analysis API")
+        fnd.apply_suppressions(fs, {e.path: fnd.scan_allows([
+            "# graftlint: allow[donation-coverage] -- fixture says so",
+            "ENTRY = register(...)",
+        ])})
+        assert fs[0].suppressed
+
+    # -- implicit-reshard ------------------------------------------------
+
+    def _reshard_entry(self, clean: bool):
+        def build():
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            m = _mesh8(("sp", "tp"))
+            x = jax.device_put(
+                jnp.ones((8, 8)), NamedSharding(m, P("sp", None))
+            )
+            if clean:
+                # elementwise: stays on the input sharding, no comm
+                return jax.jit(lambda a: a * 2), (x,)
+            # full reduction: the partitioner must insert an all-reduce
+            # the (collective-free) jaxpr never asked for
+            return jax.jit(lambda a: a.sum()), (x,)
+
+        return _spmd_fixture("fix.reshard", build, axes=(), hot=True)
+
+    def test_implicit_reshard_fires_on_inserted_collective(self):
+        from tpu_patterns.analysis import shardlint
+
+        fs = shardlint.run_shard_checks(
+            ["implicit-reshard"], entries=[self._reshard_entry(False)]
+        )
+        assert fs and "never asked for" in fs[0].message
+
+    def test_implicit_reshard_clean(self):
+        from tpu_patterns.analysis import shardlint
+
+        assert shardlint.run_shard_checks(
+            ["implicit-reshard"], entries=[self._reshard_entry(True)]
+        ) == []
+
+    def test_implicit_reshard_suppressed(self):
+        from tpu_patterns.analysis import shardlint
+
+        e = dataclasses_replace_anchor(
+            self._reshard_entry(False), "tpu_patterns/fake/reg.py", 2
+        )
+        fs = shardlint.run_shard_checks(["implicit-reshard"], entries=[e])
+        fnd.apply_suppressions(fs, {e.path: fnd.scan_allows([
+            "# graftlint: allow[implicit-reshard] -- fixture says so",
+            "ENTRY = register(...)",
+        ])})
+        assert fs and all(f.suppressed for f in fs)
+
+    # -- recompile-hazard ------------------------------------------------
+
+    def test_recompile_hazard_clean_fires_and_suppresses(self, monkeypatch):
+        # one engine-driven test for all three shapes (the scripted
+        # trace compiles real executables — keep it to one pass each)
+        from tpu_patterns.analysis import shardlint
+        from tpu_patterns.serve import engine as serve_engine
+
+        assert shardlint.run_shard_checks(["recompile-hazard"]) == []
+
+        monkeypatch.setattr(
+            serve_engine, "_bucket", lambda n, cap: min(n + 2, cap + 1)
+        )
+        fs = shardlint.run_shard_checks(["recompile-hazard"])
+        assert fs and all(f.rule == "recompile-hazard" for f in fs)
+        assert any("outside the declared bucket set" in f.message
+                   for f in fs)
+        # suppression: anchored at the scripted-trace registration
+        allows = {fs[0].path: {fs[0].line: fnd.Allow(
+            rules=frozenset({"recompile-hazard"}),
+            justification="fixture says so", line=fs[0].line,
+        )}}
+        fnd.apply_suppressions(fs, allows)
+        assert all(f.suppressed for f in fs)
+
+    # -- crash-to-finding + registry plumbing ----------------------------
+
+    def test_crashed_check_is_a_finding(self, monkeypatch):
+        from tpu_patterns.analysis import shardlint
+
+        def boom(_summaries):
+            raise RuntimeError("verifier exploded")
+
+        monkeypatch.setitem(
+            shardlint._SUMMARY_RULES, "mesh-axis-order", boom
+        )
+        fs = shardlint.run_shard_checks(["mesh-axis-order"], entries=[])
+        assert len(fs) == 1 and "check crashed" in fs[0].message
+
+    def test_skipped_entry_is_not_a_finding(self):
+        from tpu_patterns.analysis import shardlint
+        from tpu_patterns.perf.registry import SpmdSkip
+
+        def build():
+            raise SpmdSkip("world too small")
+
+        fs = shardlint.run_shard_checks(
+            ["collective-axis-discipline"],
+            entries=[_spmd_fixture("fix.skip", build)],
+        )
+        assert fs == []
+
+    def test_register_spmd_entry_feeds_the_catalog(self):
+        from tpu_patterns.perf import registry
+
+        e = _spmd_fixture("fix.registered", lambda: None)
+        registry.register_spmd_entry(e)
+        try:
+            assert e in registry.spmd_entries()
+        finally:
+            registry._EXTRA_SPMD_ENTRIES.remove(e)
+
+    def test_registry_declares_the_serve_family(self):
+        from tpu_patterns.perf import registry
+
+        entries = {e.name: e for e in registry.spmd_entries()}
+        for name in ("train.step", "zero.step", "decoder.prefill",
+                     "decoder.step", "decoder.verify", "copy_blocks",
+                     "moe.dispatch", "pipeline.apply", "longctx.ring",
+                     "longctx.ulysses", "longctx.flash", "comm.p2p",
+                     "comm.ring", "comm.hier"):
+            assert name in entries, name
+        assert entries["decoder.step"].hot
+        assert entries["decoder.verify"].hot
+        assert entries["train.step"].donates
+        assert entries["decoder.step"].declared_collectives
+
+
+def dataclasses_replace_anchor(entry, path, line):
+    import dataclasses as _dc
+
+    return _dc.replace(entry, anchor_path=path, anchor_line=line)

@@ -39,6 +39,12 @@ class TestParser:
             ["perf", "diff", "--include", "serve.step",
              "--measured_tol", "0.5"],
             ["perf", "update-baseline", "--baseline", "b.json"],
+            ["perf", "prune-stale"],
+            ["lint", "--tier", "c"],
+            ["lint", "--tier", "all", "--format", "github"],
+            ["lint", "--prune-stale"],
+            ["lint", "--strict", "--rules", "clock-discipline",
+             "--tier", "a"],
         ):
             args = p.parse_args(argv)
             assert args.cmd == argv[0]

@@ -624,3 +624,62 @@ class TestCaptureE2E:
         assert not any(
             f.metric == "step_ms" for f in d2.regressions
         ), [f.message() for f in d2.regressions]
+
+
+class TestPruneStale:
+    """`perf prune-stale`: drop entries whose executable left the
+    registry, leaving every surviving pin — value, justification —
+    byte-for-byte untouched (unlike a full re-pin)."""
+
+    def _seeded_baseline(self, tmp_path):
+        snap = {
+            "run": {"mesh_fp": "m-fp"},
+            "config": {"vocab": 16},
+            "mesh": {"shape": {"dp": 1, "sp": 1, "tp": 1}},
+            "executables": {
+                "train.step": {"analytic_flops": 1.0, "step_ms": 2.0},
+                "ghost.step": {"analytic_flops": 3.0},
+            },
+        }
+        bl = str(tmp_path / "b.json")
+        perf_baseline.save_baseline(bl, snap, {})
+        old = perf_baseline.load_baseline(bl)
+        for e in old.values():
+            e["justification"] = f"pinned {e['metric']}"
+        ratchet.save_entries(
+            bl, list(old.values()),
+            version=perf_baseline.BASELINE_VERSION,
+        )
+        return bl, old
+
+    def test_cli_prunes_removed_executables_only(self, tmp_path):
+        import subprocess
+        import sys
+
+        bl, old = self._seeded_baseline(tmp_path)
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_patterns", "perf", "prune-stale",
+             "--baseline", bl],
+            capture_output=True, text=True, timeout=240,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "ghost.step" in proc.stdout  # pruned entries are named
+        after = perf_baseline.load_baseline(bl)
+        assert {e["executable"] for e in after.values()} == {"train.step"}
+        for fp, e in after.items():
+            assert e == old[fp]  # survivors byte-for-byte, value included
+
+    def test_core_prune_preserves_entry_order(self, tmp_path):
+        bl, old = self._seeded_baseline(tmp_path)
+        keep = {
+            fp for fp, e in old.items()
+            if e["executable"] == "train.step"
+        }
+        ratchet.prune_stale(
+            bl, keep, version=perf_baseline.BASELINE_VERSION
+        )
+        with open(bl) as f:
+            entries = json.load(f)["entries"]
+        want = [e for e in old.values() if e["executable"] == "train.step"]
+        assert entries == want  # pure deletion: order + content intact

@@ -1,4 +1,4 @@
-"""graftlint: the repo's two-tier static-analysis subsystem.
+"""graftlint: the repo's three-tier static-analysis subsystem.
 
 Tier A walks the package ASTs (no backend init, no compilation)
 enforcing the source invariants five subsystems rest on — clock discipline, hot-path host
@@ -7,15 +7,21 @@ exception hygiene, backoff-owned sleeps, lock-guarded registry
 mutation.  Tier B abstract-evals the jitted entry points on CPU and
 interrogates the compiled artifacts — donation really aliases, no host
 callbacks or f64 upcasts in decode steps, scheduler buckets stay on
-the declared power-of-two set.
+the declared power-of-two set.  Tier C (shardlint) enumerates EVERY
+jitted entry point from the perf registry and checks the SPMD fabric
+contract — collective axis discipline, canonical mesh-axis order, the
+declared per-token collective set, whole-registry donation coverage,
+compiler-inserted resharding in hot executables, and serve-engine
+recompile hazards against the bucket budget.
 
 Findings ratchet against ``baseline.json``: CI fails only on NEW
 findings, inline ``# graftlint: allow[rule] -- why`` suppressions
 require a written justification, and every run emits one Record per
 rule plus ``tpu_patterns_lint_*`` metrics.  Run it::
 
-    tpu-patterns lint [--rules ...] [--tier a|b|both]
-                      [--format text|jsonl|github] [--update-baseline]
+    tpu-patterns lint [--rules ...] [--tier a|b|c|both|all]
+                      [--format text|jsonl|github]
+                      [--update-baseline | --prune-stale]
 
 docs/static-analysis.md is the catalog and workflow guide.
 """
@@ -26,6 +32,7 @@ from tpu_patterns.analysis.engine import (  # noqa: F401
     lint_sources,
     rule_docs,
     rule_names,
+    rule_tier,
     run_lint,
     write_records,
 )

@@ -1,7 +1,8 @@
 """graftlint orchestration: rules -> findings -> baseline -> Records.
 
-One run walks the package (Tier A) and/or traces the jitted entry
-points (Tier B), applies inline suppressions, diffs the surviving
+One run walks the package (Tier A), traces a handful of compiled
+artifacts (Tier B), and/or audits the full SPMD entry-point registry
+(Tier C, shardlint), applies inline suppressions, diffs the surviving
 findings against the committed ratchet baseline, and reports:
 
 * one Record per rule in the house SUCCESS/FAILURE shape (pattern
@@ -23,6 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import sys
 from typing import TextIO
 
@@ -30,6 +32,7 @@ from tpu_patterns.analysis import walker
 from tpu_patterns.core import ratchet
 from tpu_patterns.analysis.astlint import AST_RULES, Rule, SourceFile
 from tpu_patterns.analysis.findings import (
+    BASELINE_VERSION,
     Finding,
     apply_suppressions,
     default_baseline_path,
@@ -39,17 +42,45 @@ from tpu_patterns.analysis.findings import (
     scan_allows,
 )
 
-# the complete rule catalog: Tier A classes + Tier B check names
-def rule_names() -> list[str]:
+# the complete rule catalog: Tier A classes + Tier B/C check names
+def _tier_sets() -> dict[str, frozenset[str]]:
+    from tpu_patterns.analysis.shardlint import SHARD_CHECKS
     from tpu_patterns.analysis.tracelint import TRACE_CHECKS
 
-    return [r.name for r in AST_RULES] + list(TRACE_CHECKS)
+    return {
+        "A": frozenset(r.name for r in AST_RULES),
+        "B": frozenset(TRACE_CHECKS),
+        "C": frozenset(SHARD_CHECKS),
+    }
+
+
+def rule_tier(rule: str) -> str:
+    for tier, names in _tier_sets().items():
+        if rule in names:
+            return tier
+    return "?"
+
+
+def rule_names() -> list[str]:
+    from tpu_patterns.analysis.shardlint import SHARD_CHECKS
+    from tpu_patterns.analysis.tracelint import TRACE_CHECKS
+
+    return (
+        [r.name for r in AST_RULES]
+        + list(TRACE_CHECKS)
+        + list(SHARD_CHECKS)
+    )
 
 
 def rule_docs() -> dict[str, str]:
+    from tpu_patterns.analysis.shardlint import SHARD_DOCS
     from tpu_patterns.analysis.tracelint import TRACE_DOCS
 
-    return {**{r.name: r.doc for r in AST_RULES}, **TRACE_DOCS}
+    return {
+        **{r.name: r.doc for r in AST_RULES},
+        **TRACE_DOCS,
+        **SHARD_DOCS,
+    }
 
 
 @dataclasses.dataclass
@@ -68,6 +99,23 @@ class LintReport:
         return 1 if self.new else 0
 
 
+def scan_finding_allows(
+    findings: list[Finding], allows: dict[str, dict]
+) -> dict[str, dict]:
+    """Scan allow comments for files findings anchor at but the Tier-A
+    walk did not load (registry builders, entry-point modules), so a
+    line-anchored finding is suppressible no matter which tier produced
+    it.  Line-0 findings stay baseline-only.  Extends ``allows`` in
+    place (and returns it)."""
+    for rel in sorted({
+        f.path for f in findings if f.line > 0 and f.path not in allows
+    }):
+        abspath = os.path.join(walker.repo_root(), rel)
+        if os.path.exists(abspath):
+            allows[rel] = scan_allows(SourceFile.load(abspath).lines)
+    return allows
+
+
 def lint_sources(
     paths: list[str], rules: list[str] | None = None
 ) -> tuple[list[Finding], list[SourceFile]]:
@@ -81,20 +129,35 @@ def lint_sources(
     return findings, files
 
 
+# which rule tiers a --tier value selects ("both" = the pre-Tier-C
+# surface, kept so existing invocations keep meaning exactly what they
+# did; "all" is the full catalog and the CLI default)
+TIER_SELECT = {
+    "a": ("A",),
+    "b": ("B",),
+    "c": ("C",),
+    "both": ("A", "B"),
+    "all": ("A", "B", "C"),
+}
+
+
 def run_lint(
     *,
     rules: list[str] | None = None,
-    tier: str = "both",
+    tier: str = "all",
     root: str | None = None,
     baseline_path: str | None = None,
     use_baseline: bool = True,
     update_baseline: bool = False,
+    prune_stale: bool = False,
 ) -> LintReport:
     """Run graftlint and return the report (no printing; see ``emit``).
 
     ``use_baseline=False`` is strict mode (the lint_timing shim): every
-    unsuppressed finding is new.  ``rules`` filters both tiers by name;
+    unsuppressed finding is new.  ``rules`` filters every tier by name;
     unknown names raise (a typo'd --rules must not silently pass).
+    ``prune_stale`` drops stale baseline entries (fixed debt) without
+    re-pinning the survivors — the surgical half of --update-baseline.
     """
     known = set(rule_names())
     if rules is not None:
@@ -103,40 +166,55 @@ def run_lint(
             raise ValueError(
                 f"unknown rule(s) {unknown} — known: {sorted(known)}"
             )
-    if tier not in ("a", "b", "both"):
-        raise ValueError(f"tier must be a|b|both, got {tier!r}")
+    if tier not in TIER_SELECT:
+        raise ValueError(
+            f"tier must be one of {sorted(TIER_SELECT)}, got {tier!r}"
+        )
+    tiers = _tier_sets()
+    selected = frozenset().union(
+        *(tiers[t] for t in TIER_SELECT[tier])
+    )
+    ran = (set(rules) if rules is not None else known) & selected
+    if not ran:
+        # a --rules/--tier mismatch must not read as a clean lint that
+        # checked nothing (same contract as unknown rule names)
+        raise ValueError(
+            f"no rule left to run: --rules {sorted(rules or [])} all "
+            f"belong to another tier (--tier {tier})"
+        )
 
     findings: list[Finding] = []
     files: list[SourceFile] = []
-    if tier in ("a", "both"):
+    if ran & tiers["A"]:
         findings_a, files = lint_sources(
-            walker.iter_source_files(root), rules
+            walker.iter_source_files(root), sorted(ran & tiers["A"])
         )
         findings.extend(findings_a)
-    if tier in ("b", "both"):
+    if ran & tiers["B"]:
         from tpu_patterns.analysis.tracelint import run_trace_checks
 
-        findings.extend(run_trace_checks(rules))
+        findings.extend(
+            run_trace_checks(
+                None if rules is None else sorted(ran & tiers["B"])
+            )
+        )
+    if ran & tiers["C"]:
+        from tpu_patterns.analysis.shardlint import run_shard_checks
+
+        findings.extend(
+            run_shard_checks(
+                None if rules is None else sorted(ran & tiers["C"])
+            )
+        )
 
     allows = {sf.rel: scan_allows(sf.lines) for sf in files}
+    scan_finding_allows(findings, allows)
     apply_suppressions(findings, allows)
     fingerprint_findings(findings)
 
     bl_path = baseline_path or default_baseline_path()
     baseline = load_baseline(bl_path) if use_baseline else {}
     live = [f for f in findings if not f.suppressed]
-    ran = set(rules) if rules is not None else known
-    if tier == "a":
-        ran &= {r.name for r in AST_RULES}
-    elif tier == "b":
-        ran -= {r.name for r in AST_RULES}
-    if not ran:
-        # a --rules/--tier mismatch must not read as a clean lint that
-        # checked nothing (same contract as unknown rule names)
-        raise ValueError(
-            f"no rule left to run: --rules {sorted(rules or [])} all "
-            f"belong to the other tier (--tier {tier})"
-        )
     # the ratchet split is the shared contract (core/ratchet.py);
     # stale_filter: only rules that RAN can declare their baseline
     # entries stale — a --rules subset must not report the other rules'
@@ -152,13 +230,32 @@ def run_lint(
     if update_baseline:
         if not use_baseline:
             raise ValueError("cannot update a baseline in strict mode")
-        if rules is not None or tier != "both":
+        if prune_stale:
+            raise ValueError(
+                "--update-baseline already drops stale entries — pass "
+                "one of --update-baseline / --prune-stale"
+            )
+        if rules is not None or tier != "all":
             raise ValueError(
                 "--update-baseline needs the FULL run (no --rules/--tier "
                 "filter): a partial re-pin would drop other rules' entries"
             )
         save_baseline(bl_path, live, baseline)
         new, baselined, stale = [], live, []
+
+    if prune_stale:
+        if not use_baseline:
+            raise ValueError("cannot prune a baseline in strict mode")
+        # safe under --rules/--tier subsets, unlike --update-baseline:
+        # the stale filter only lets rules that RAN declare their own
+        # entries fixed, and survivors are never rewritten
+        ratchet.prune_stale(
+            bl_path,
+            (f.fingerprint for f in live),
+            version=BASELINE_VERSION,
+            stale_filter=lambda e: e["rule"] in ran,
+        )
+        stale = []  # pruned: the debt left the ledger this run
 
     return LintReport(
         findings=findings,
@@ -206,8 +303,7 @@ def write_records(report: LintReport, writer) -> None:
     by_rule: dict[str, list[Finding]] = {}
     for f in report.findings:
         by_rule.setdefault(f.rule, []).append(f)
-    tiers = {r: ("B" if r.startswith("trace-") else "A")
-             for r in report.rules_run}
+    tiers = {r: rule_tier(r) for r in report.rules_run}
     for rule in report.rules_run:
         fs = by_rule.get(rule, [])
         new = [f for f in fs if f in report.new]

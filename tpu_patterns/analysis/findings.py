@@ -84,13 +84,57 @@ class Allow:
     line: int  # where the comment itself lives
 
 
+def _logical_spans(lines: list[str]) -> dict[int, tuple[int, int]]:
+    """Map physical line -> (start, end) of its logical statement.
+
+    Built from ``tokenize`` (NEWLINE ends a logical line, NL does not),
+    so bracket continuations and backslash joins resolve exactly —
+    findings anchor at a statement's FIRST physical line while an allow
+    comment may sit on any of them (or the line above a decorator).
+    Unparseable source degrades to an empty map (per-line coverage only).
+    """
+    import io
+    import tokenize
+
+    spans: dict[int, tuple[int, int]] = {}
+    try:
+        toks = list(
+            tokenize.generate_tokens(io.StringIO("\n".join(lines)).readline)
+        )
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return spans
+    start = None
+    for tok in toks:
+        if tok.type in (
+            tokenize.NL, tokenize.COMMENT, tokenize.INDENT,
+            tokenize.DEDENT, tokenize.ENDMARKER,
+        ):
+            continue
+        if start is None:
+            start = tok.start[0]
+        if tok.type == tokenize.NEWLINE:
+            for ln in range(start, tok.end[0] + 1):
+                spans[ln] = (start, tok.end[0])
+            start = None
+    return spans
+
+
 def scan_allows(lines: list[str]) -> dict[int, Allow]:
     """Map of source line -> Allow covering it.
 
-    An allow comment covers its own line; a STANDALONE comment line
-    (nothing but the comment) also covers the next line, so long
-    statements can carry their suppression on the line above.
+    An allow comment covers every physical line of the logical statement
+    it rides on (a trailing comment on any line of a multi-line call
+    covers the whole call); a STANDALONE comment line (nothing but the
+    comment) covers the next statement in full — including, when that
+    statement is a decorator, the following decorator chain and the
+    ``def``/``class`` header they decorate, so a finding anchored at the
+    def line is still covered by an allow above the decorators.
     """
+    spans = _logical_spans(lines)
+
+    def span(ln: int) -> tuple[int, int]:
+        return spans.get(ln, (ln, ln))
+
     out: dict[int, Allow] = {}
     for i, raw in enumerate(lines, start=1):
         m = _ALLOW_RE.search(raw)
@@ -103,9 +147,35 @@ def scan_allows(lines: list[str]) -> dict[int, Allow]:
             justification=(m.group("why") or "").strip(),
             line=i,
         )
+
+        def cover(lo: int, hi: int) -> None:
+            for ln in range(lo, hi + 1):
+                out.setdefault(ln, allow)
+
         out[i] = allow
-        if raw.strip().startswith("#"):  # standalone: covers the next line
-            out.setdefault(i + 1, allow)
+        if not raw.strip().startswith("#"):
+            # trailing comment: cover the whole statement it rides on
+            cover(*span(i))
+            continue
+        # standalone: cover the next statement in full...
+        lo, hi = span(i + 1)
+        cover(lo, hi)
+        # ...and when it is a decorator (chain), keep extending through
+        # the chain — blank and comment lines interleave legally — and
+        # the decorated def/class header
+        while lines[lo - 1].lstrip().startswith("@"):
+            j = hi + 1
+            while j <= len(lines) and (
+                not lines[j - 1].strip()
+                or lines[j - 1].lstrip().startswith("#")
+            ):
+                j += 1
+            if j > len(lines):
+                break
+            lo, hi = span(j)
+            cover(lo, hi)
+            if not lines[lo - 1].lstrip().startswith("@"):
+                break
     return out
 
 

@@ -1,0 +1,615 @@
+"""Tier C: SPMD/collective discipline over every jitted entry point.
+
+Tier A reads source, Tier B interrogates a handful of compiled
+artifacts; this tier walks the WHOLE executable registry
+(perf/registry.py ``spmd_entries()``) — train/ZeRO steps, the paged
+decoder's prefill/step/verify/copy cores, the MoE dispatch, the
+pipeline conveyor, the long-context ring/Ulysses/flash attentions, and
+the comm patterns — lowers each on the local CPU mesh, and checks the
+SPMD contract baked into the closed jaxpr and (for hot entries) the
+compiled HLO.  The mesh axes, PartitionSpecs, and collectives inside a
+jitted executable are its *fabric contract*: a silent axis-name typo,
+an implicit compiler-inserted reshard, or a new all-reduce in the
+per-token path costs correctness or wall-clock that no unit test sees.
+
+* collective-axis-discipline — every collective's axis names must exist
+  on the binding mesh and be manual (non-auto) under the enclosing
+  ``shard_map``; a declared mesh axis of size > 1 that nothing shards
+  over or communicates across is flagged; a collective outside any
+  shard_map has no fabric to run on; an entry whose lowering crashes is
+  a finding here (the axis-typo class fails at trace time).
+* mesh-axis-order — the binding mesh's axis tuple must equal the
+  entry's canonical declaration (``(dp, sp, tp)`` for the model/serve
+  family) and every PartitionSpec dim (shard_map in/out names) must
+  reference axes in canonical order, merged tuples included.
+* collective-in-decode-hot-path — the collectives observed in
+  decoder.prefill/step/verify must be a subset of the DECLARED set
+  (serve/paged.py ``DECODE_DECLARED_COLLECTIVES``); each novel
+  (primitive, axes) pair is its own structurally-fingerprinted finding,
+  so a new per-token all-reduce is a NEW finding even while old debt is
+  baselined.
+* donation-coverage — every registered executable that declares a large
+  mutable operand (``donates=True``) must COMPILE to aliased bytes > 0,
+  the whole-registry generalization of Tier B's three-entry
+  trace-donation check.
+* implicit-reshard — hot entries (decoder.step/verify — the serve
+  engine's per-token dispatches) are compiled and their HLO scanned:
+  a collective KIND present in the executable but absent from the
+  jaxpr is compiler-inserted resharding; an input the executable wants
+  in a different sharding than the one it was built with forces a
+  reshard copy on every call.
+* recompile-hazard — a scripted request trace drives a real
+  ServeEngine and the decoder's compiled-executable caches (their keys
+  ARE the abstract call signatures) are audited against the declared
+  power-of-two bucket budget: an executable compiled for an off-budget
+  signature is unbounded compile churn in production.
+
+Findings anchor at the entry's REGISTRATION (perf/registry.py builder)
+so inline allows live next to the declaration; they carry the same
+content fingerprints and ride the same baseline/Record machinery as
+Tiers A/B.  Run as ``tpu-patterns lint --tier c`` (or the default
+``--tier all``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import traceback
+from typing import Callable
+
+from tpu_patterns.analysis.findings import Finding
+
+# data-moving / reducing collectives and the HLO op kind each lowers to
+COLLECTIVE_KINDS = {
+    "psum": "all-reduce",
+    "pmax": "all-reduce",
+    "pmin": "all-reduce",
+    "all_gather": "all-gather",
+    "all_to_all": "all-to-all",
+    "ppermute": "collective-permute",
+    "reduce_scatter": "reduce-scatter",
+}
+# axis *references* that are not byte movement (allowed anywhere the
+# axis is bound; excluded from the declared-collective diff)
+AXIS_REFERENCE_PRIMS = frozenset({"axis_index", "pbroadcast", "pcast"})
+
+_HLO_COLLECTIVE_RE = re.compile(
+    r"\b(all-reduce|all-gather|all-to-all|collective-permute|"
+    r"reduce-scatter)"
+)
+
+
+def _finding(rule: str, entry, message: str) -> Finding:
+    return Finding(
+        rule=rule,
+        path=entry.path,
+        line=entry.line,
+        message=f"{entry.name}: {message}",
+        tier="C",
+    )
+
+
+def _axis_names(eqn) -> tuple:
+    """Normalized axis-name tuple of a collective eqn (psum spells the
+    param ``axes``, the others ``axis_name``; either may be a bare str)."""
+    v = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+    if isinstance(v, str):
+        return (v,)
+    return tuple(a for a in v if isinstance(a, str))
+
+
+def _sub_jaxprs(v):
+    """Jaxprs nested inside one eqn param value (scan/cond/pjit bodies)."""
+    out = []
+    vals = v if isinstance(v, (list, tuple)) else (v,)
+    for s in vals:
+        if hasattr(s, "eqns"):
+            out.append(s)
+        elif hasattr(s, "jaxpr"):
+            out.append(s.jaxpr)
+    return out
+
+
+@dataclasses.dataclass
+class ShardMapInfo:
+    """One ``shard_map`` region: its mesh contract and what runs inside."""
+
+    axis_names: tuple
+    sizes: dict
+    auto: frozenset
+    in_names: tuple  # per-arg {dim: (axis, ...)}
+    out_names: tuple
+    collectives: list  # [(prim, axes)] anywhere in the body
+    axis_refs: list  # [(prim, axes)] axis_index-class references
+
+
+@dataclasses.dataclass
+class EntrySummary:
+    """One lowered entry: shard_map regions + stray collectives, or the
+    lowering error (kept for crash-to-finding attribution)."""
+
+    entry: object
+    maps: list
+    stray: list  # collectives OUTSIDE any shard_map
+    fn: object = None
+    args: tuple = ()
+    error: str = ""
+    skip: str = ""  # SpmdSkip reason (world-shape, not a violation)
+
+
+def _walk(jaxpr, current: ShardMapInfo | None, summary: EntrySummary):
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in COLLECTIVE_KINDS:
+            rec = (name, _axis_names(eqn))
+            if current is None:
+                summary.stray.append(rec)
+            else:
+                current.collectives.append(rec)
+        elif name in AXIS_REFERENCE_PRIMS and current is not None:
+            current.axis_refs.append((name, _axis_names(eqn)))
+        if name == "shard_map":
+            mesh = eqn.params["mesh"]
+            info = ShardMapInfo(
+                axis_names=tuple(mesh.axis_names),
+                sizes={a: int(s) for a, s in dict(mesh.shape).items()},
+                auto=frozenset(eqn.params.get("auto", ()) or ()),
+                in_names=tuple(
+                    dict(n) for n in eqn.params.get("in_names", ())
+                ),
+                out_names=tuple(
+                    dict(n) for n in eqn.params.get("out_names", ())
+                ),
+                collectives=[],
+                axis_refs=[],
+            )
+            summary.maps.append(info)
+            _walk(eqn.params["jaxpr"], info, summary)
+            continue
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                _walk(sub, current, summary)
+
+
+def summarize_entries(entries) -> list[EntrySummary]:
+    """Lower every entry and collect its SPMD summary.  A builder raising
+    :class:`~tpu_patterns.perf.registry.SpmdSkip` is a visible skip;
+    any other crash is kept on the summary for the discipline rule."""
+    import jax
+
+    from tpu_patterns.perf.registry import SpmdSkip
+
+    out: list[EntrySummary] = []
+    for entry in entries:
+        s = EntrySummary(entry=entry, maps=[], stray=[])
+        try:
+            s.fn, s.args = entry.build()
+            closed = jax.make_jaxpr(s.fn)(*s.args)
+            _walk(closed.jaxpr, None, s)
+        except SpmdSkip as e:
+            s.skip = str(e)
+        except Exception as e:
+            s.error = f"{type(e).__name__}: {e}"
+        out.append(s)
+    return out
+
+
+# -- collective-axis-discipline -------------------------------------------
+
+
+def check_axis_discipline(summaries) -> list[Finding]:
+    rule = "collective-axis-discipline"
+    out: list[Finding] = []
+    for s in summaries:
+        if s.skip:
+            continue
+        if s.error:
+            out.append(_finding(
+                rule, s.entry,
+                f"entry failed to lower — an axis-name typo in a "
+                f"collective fails exactly here ({s.error})",
+            ))
+            continue
+        for prim, axes in s.stray:
+            out.append(_finding(
+                rule, s.entry,
+                f"{prim} over {axes} outside any shard_map — no binding "
+                "mesh supplies these axes",
+            ))
+        for m in s.maps:
+            manual = set(m.axis_names) - set(m.auto)
+            comm_axes: set = set()
+            for prim, axes in m.collectives:
+                for a in axes:
+                    comm_axes.add(a)
+                    if a not in m.axis_names:
+                        out.append(_finding(
+                            rule, s.entry,
+                            f"{prim} over axis {a!r} which is not on the "
+                            f"binding mesh {m.axis_names}",
+                        ))
+                    elif a not in manual:
+                        out.append(_finding(
+                            rule, s.entry,
+                            f"{prim} over axis {a!r} which the enclosing "
+                            "shard_map leaves auto (not manually mapped)",
+                        ))
+            for _prim, axes in m.axis_refs:
+                comm_axes.update(axes)
+            spec_axes = {
+                a
+                for names in m.in_names + m.out_names
+                for t in names.values()
+                for a in t
+            }
+            for ax in m.axis_names:
+                if (
+                    m.sizes.get(ax, 1) > 1
+                    and ax not in spec_axes
+                    and ax not in comm_axes
+                ):
+                    out.append(_finding(
+                        rule, s.entry,
+                        f"declared mesh axis {ax!r} (size "
+                        f"{m.sizes[ax]}) is unused: no in/out spec "
+                        "shards over it and no collective crosses it — "
+                        "devices on that axis run fully replicated work",
+                    ))
+    return out
+
+
+# -- mesh-axis-order ------------------------------------------------------
+
+
+def check_mesh_axis_order(summaries) -> list[Finding]:
+    rule = "mesh-axis-order"
+    out: list[Finding] = []
+    for s in summaries:
+        if s.skip or s.error:
+            continue
+        canonical = tuple(s.entry.axes)
+        if not canonical:
+            continue  # single-device entries bind no mesh contract
+        canon_ix = {a: i for i, a in enumerate(canonical)}
+        for m in s.maps:
+            if tuple(m.axis_names) != canonical:
+                out.append(_finding(
+                    rule, s.entry,
+                    f"binding mesh declares axes {m.axis_names}, "
+                    f"canonical order is {canonical}",
+                ))
+                continue  # ordering below is relative to the canonical
+            for io, specs in (("in", m.in_names), ("out", m.out_names)):
+                for i, names in enumerate(specs):
+                    for dim, axes in sorted(names.items()):
+                        if list(axes) != sorted(axes, key=canon_ix.get):
+                            out.append(_finding(
+                                rule, s.entry,
+                                f"{io}_specs[{i}] dim {dim} merges axes "
+                                f"{axes} against the canonical "
+                                f"{canonical} order",
+                            ))
+                    seq = [
+                        a for _d, axes in sorted(names.items())
+                        for a in axes
+                    ]
+                    if seq != sorted(seq, key=canon_ix.get):
+                        out.append(_finding(
+                            rule, s.entry,
+                            f"{io}_specs[{i}] orders axes {tuple(seq)} "
+                            f"across dims against the canonical "
+                            f"{canonical} order",
+                        ))
+    return out
+
+
+# -- collective-in-decode-hot-path ----------------------------------------
+
+
+def check_decode_collectives(summaries) -> list[Finding]:
+    rule = "collective-in-decode-hot-path"
+    out: list[Finding] = []
+    for s in summaries:
+        declared = s.entry.declared_collectives
+        if s.skip or s.error or declared is None:
+            continue
+        observed = {
+            (prim, axes) for m in s.maps for prim, axes in m.collectives
+        }
+        for prim, axes in sorted(observed - set(declared)):
+            out.append(_finding(
+                rule, s.entry,
+                f"NEW collective {prim} over {axes} in the per-token "
+                "path — not in the declared set "
+                "(serve/paged.py DECODE_DECLARED_COLLECTIVES); every "
+                "decode step now pays it",
+            ))
+    return out
+
+
+# -- donation-coverage ----------------------------------------------------
+
+
+def check_donation_coverage(summaries) -> list[Finding]:
+    rule = "donation-coverage"
+    out: list[Finding] = []
+    for s in summaries:
+        if s.skip or s.error or not s.entry.donates:
+            continue
+        from tpu_patterns.models.transformer import donation_took
+
+        took = donation_took(s.fn, *s.args)
+        if took is None:
+            continue  # backend exposes no memory-analysis API
+        if not took:
+            out.append(_finding(
+                rule, s.entry,
+                "declares a large mutable operand (donates=True) but the "
+                "compiled program aliases 0 bytes — the backend declined "
+                "the donation, so every call holds input AND output "
+                "buffers live",
+            ))
+    return out
+
+
+# -- implicit-reshard -----------------------------------------------------
+
+
+def _committed_sharding(arg):
+    """The sharding an arg was deliberately placed with, or None for
+    uncommitted/host values (jit may place those freely)."""
+    import jax
+
+    if not isinstance(arg, jax.Array):
+        return None
+    if not getattr(arg, "_committed", False):
+        return None
+    return arg.sharding
+
+
+def check_implicit_reshard(summaries) -> list[Finding]:
+    rule = "implicit-reshard"
+    out: list[Finding] = []
+    for s in summaries:
+        if s.skip or s.error or not s.entry.hot:
+            continue
+        import jax
+
+        from tpu_patterns.models.transformer import analysis_compile
+
+        try:
+            compiled = analysis_compile(s.fn, *s.args)
+            hlo = compiled.as_text()
+        except Exception as e:
+            out.append(_finding(
+                rule, s.entry,
+                f"hot entry failed to compile for HLO interrogation: "
+                f"{type(e).__name__}: {e}",
+            ))
+            continue
+        declared_kinds = {
+            COLLECTIVE_KINDS[prim]
+            for m in s.maps
+            for prim, _axes in m.collectives
+        }
+        observed_kinds = set(_HLO_COLLECTIVE_RE.findall(hlo))
+        for kind in sorted(observed_kinds - declared_kinds):
+            out.append(_finding(
+                rule, s.entry,
+                f"compiled executable contains {kind} ops the jaxpr "
+                "never asked for — compiler-inserted resharding in a "
+                "hot per-token path",
+            ))
+        # the executable must accept the shardings it was BUILT with:
+        # wanting anything else forces a reshard copy on every call.
+        # input_shardings mirrors the call signature per top-level arg
+        # (a dict arg gets a dict of shardings), so compare leaf-wise.
+        try:
+            in_shardings = compiled.input_shardings[0]
+        except (AttributeError, IndexError, TypeError):
+            continue  # backend exposes no input_shardings API
+        for i, (arg, want) in enumerate(zip(s.args, in_shardings)):
+            arg_leaves = jax.tree_util.tree_leaves(arg)
+            want_leaves = jax.tree_util.tree_leaves(want)
+            if len(arg_leaves) != len(want_leaves):
+                continue  # pruned/restructured arg: nothing to compare
+            for leaf, w in zip(arg_leaves, want_leaves):
+                have = _committed_sharding(leaf)
+                if have is None:
+                    continue
+                try:
+                    same = w.is_equivalent_to(have, leaf.ndim)
+                except (AttributeError, TypeError, ValueError):
+                    continue  # shardings of incomparable kinds
+
+                if not same:
+                    out.append(_finding(
+                        rule, s.entry,
+                        f"compiled executable wants arg {i} resharded "
+                        f"({w} != the declared {have}) — every call "
+                        "pays an implicit reshard of that operand",
+                    ))
+    return out
+
+
+# -- recompile-hazard -----------------------------------------------------
+
+
+def _declared_buckets(cap: int) -> set:
+    """The DECLARED signature set: powers of two clipped at ``cap``,
+    plus ``cap`` itself — computed independently of the scheduler's
+    ``_bucket`` so a broken bucket function cannot move the goalposts
+    (same declared set as Tier B's trace-bucket-shapes)."""
+    out = {1 << e for e in range(max(cap, 1).bit_length())}
+    return {b for b in out if b <= cap} | {cap}
+
+
+def check_recompile_hazard() -> list[Finding]:
+    """Drive the scripted trace through a real ServeEngine, then audit
+    the decoder's compiled caches: the cache keys ARE the abstract call
+    signatures the engine compiled, and each must land inside the
+    declared bucket budget."""
+    from tpu_patterns.perf import registry
+    from tpu_patterns.serve.engine import ServeEngine
+
+    rule = "recompile-hazard"
+    # anchor on the trace declaration, same suppression surface as the
+    # builder-anchored rules
+    entry = registry.SpmdEntry(
+        "serve.step", ("dp", "sp", "tp"), registry.serve_scripted_trace
+    )
+    out: list[Finding] = []
+    decoder, params, requests, slots, _max_prompt = (
+        registry.serve_scripted_trace()
+    )
+    window = decoder.n_pages * decoder.layout.block_len
+    spec_k = 1
+    # both scheduler modes share the decoder, so the caches accumulate
+    # every signature the trace can reach: the plain one-token step AND
+    # the speculative wide verify
+    for k in (0, spec_k):
+        eng = ServeEngine(decoder, params, slots=slots, spec_k=k)
+        eng.run([dataclasses.replace(r) for r in requests])
+    row_buckets = _declared_buckets(slots)
+    prompt_buckets = _declared_buckets(window)
+    signatures = decoder.compiled_signatures()
+    budgets = {
+        # core -> (signatures actually compiled, allowed signature set)
+        "prefill": (
+            signatures["prefill"],
+            {(r, p) for r in row_buckets for p in prompt_buckets},
+        ),
+        "step": (
+            signatures["step"],
+            row_buckets,
+        ),
+        "verify": (
+            signatures["verify"],
+            {(r, spec_k + 1) for r in row_buckets},
+        ),
+        "copy": (
+            signatures["copy"],
+            _declared_buckets(slots),
+        ),
+    }
+    for core, (seen, allowed) in budgets.items():
+        for sig in sorted(seen - allowed):
+            out.append(_finding(
+                rule, entry,
+                f"{core} compiled for signature {sig} outside the "
+                f"declared bucket set {sorted(allowed)} — a novel "
+                "abstract signature per request shape is unbounded "
+                "executable churn",
+            ))
+        if len(seen) > len(allowed):
+            out.append(_finding(
+                rule, entry,
+                f"{core} compiled {len(seen)} executables against a "
+                f"bucket budget of {len(allowed)}",
+            ))
+    return out
+
+
+# -- the check table ------------------------------------------------------
+
+# rules that interrogate the lowered registry (share one summarize pass)
+_SUMMARY_RULES: dict[str, Callable] = {
+    "collective-axis-discipline": check_axis_discipline,
+    "mesh-axis-order": check_mesh_axis_order,
+    "collective-in-decode-hot-path": check_decode_collectives,
+    "donation-coverage": check_donation_coverage,
+    "implicit-reshard": check_implicit_reshard,
+}
+
+SHARD_CHECKS = tuple(_SUMMARY_RULES) + ("recompile-hazard",)
+
+SHARD_DOCS: dict[str, str] = {
+    "collective-axis-discipline": (
+        "Every collective's axis names exist on the binding mesh and "
+        "are manual under the enclosing shard_map; declared size>1 axes "
+        "nothing uses are flagged; a lowering crash (the axis-typo "
+        "class) is a finding."
+    ),
+    "mesh-axis-order": (
+        "The binding mesh and every PartitionSpec reference axes in the "
+        "entry's canonical order ((dp, sp, tp) for the model/serve "
+        "family) — one axis vocabulary across the whole SPMD surface."
+    ),
+    "collective-in-decode-hot-path": (
+        "Collectives in decoder.prefill/step/verify stay inside the "
+        "declared per-token set; each novel (primitive, axes) pair is "
+        "its own NEW finding."
+    ),
+    "donation-coverage": (
+        "Every registered executable declaring a large mutable operand "
+        "compiles to aliased bytes > 0 — the whole-registry "
+        "generalization of trace-donation."
+    ),
+    "implicit-reshard": (
+        "Hot executables' compiled HLO contains no collective kind the "
+        "jaxpr never asked for, and accepts its operands in the "
+        "shardings they were built with — no compiler-inserted reshard "
+        "per call."
+    ),
+    "recompile-hazard": (
+        "A scripted trace through the real ServeEngine may only compile "
+        "abstract signatures inside the declared power-of-two bucket "
+        "budget — the cache keys are audited, not trusted."
+    ),
+}
+
+
+def run_shard_checks(
+    names: list[str] | None = None, entries=None
+) -> list[Finding]:
+    """Run the selected Tier-C checks.  ``entries`` overrides the
+    registry (the tests' and seeded CI smoke's fixture door).  A crash
+    inside a check becomes a finding on that check — a broken verifier
+    is never a clean program."""
+    wanted = [n for n in SHARD_CHECKS if names is None or n in names]
+    if not wanted:
+        return []
+    out: list[Finding] = []
+    summaries = None
+    if any(n in _SUMMARY_RULES for n in wanted):
+        if entries is None:
+            from tpu_patterns.perf.registry import spmd_entries
+
+            entries = spmd_entries()
+        summaries = summarize_entries(entries)
+    for name in wanted:
+        try:
+            if name == "recompile-hazard":
+                found = check_recompile_hazard()
+            else:
+                found = _SUMMARY_RULES[name](summaries)
+        except Exception as e:
+            tb = traceback.format_exc(limit=3)
+            found = [Finding(
+                rule=name,
+                path="tpu_patterns/analysis/shardlint.py",
+                line=0,
+                message=(
+                    f"check crashed: {type(e).__name__}: {e} — a broken "
+                    f"verifier is not a clean program\n{tb}"
+                ),
+                tier="C",
+            )]
+        out.extend(found)
+    if summaries is not None:
+        _count_skips(summaries)
+    return out
+
+
+def _count_skips(summaries) -> None:
+    """Skipped entries are visible in the metrics stream, never silent."""
+    from tpu_patterns import obs
+
+    skipped = [s for s in summaries if s.skip]
+    obs.gauge("tpu_patterns_lint_spmd_entries").set(
+        float(len(summaries))
+    )
+    obs.gauge("tpu_patterns_lint_spmd_entries_skipped").set(
+        float(len(skipped))
+    )

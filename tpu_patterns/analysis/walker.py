@@ -12,13 +12,22 @@ from __future__ import annotations
 
 import os
 
-# directory names pruned anywhere in the tree
+# directory names pruned anywhere in the tree.  results/ and docs/
+# archive .py snippets (banked sweep artifacts, documentation excerpts)
+# and scripts/make_xplane_fixture.py banks its output under a fixtures/
+# dir — none are lintable sources, and walking them from a repo-rooted
+# run used to produce findings against files nobody maintains.
 EXCLUDED_DIRS = frozenset({
     "__pycache__",
     "build",
+    "dist",
     "fixtures",
+    "results",
+    "docs",
     ".git",
     ".eggs",
+    ".venv",
+    "venv",
     "node_modules",
 })
 

@@ -437,6 +437,39 @@ def _cmd_perf(args, writer: ResultWriter) -> None:
         # same contract as serve: the paged pool is scheduler-slot
         # shaped; the capture builds its own dp axis for train/ZeRO
         raise SystemExit("error: perf requires --dp 1 (fold devices into sp)")
+    if args.perf_cmd == "prune-stale":
+        # no capture: staleness here is REGISTRY truth (an entry whose
+        # executable no longer exists), so pruning never depends on the
+        # local mesh or measurement noise.  Shape-changed or
+        # machine-skipped entries are NOT stale debt — those re-pin via
+        # update-baseline, deliberately.  Surviving entries keep their
+        # pinned values and justifications byte-for-byte.
+        from tpu_patterns.core import ratchet
+
+        bl_path = args.baseline or perf_baseline.default_baseline_path()
+        old = perf_baseline.load_baseline(bl_path)
+        keep = {
+            fp for fp, e in old.items()
+            if e.get("executable") in perf_registry.EXECUTABLES
+        }
+        kept, dropped = ratchet.prune_stale(
+            bl_path, keep, version=perf_baseline.BASELINE_VERSION,
+        )
+        for e in dropped:
+            writer.progress(
+                f"pruned stale entry: {e.get('executable')}."
+                f"{e.get('metric')} {e.get('fingerprint')}"
+            )
+        writer.record(Record(
+            pattern="perf",
+            mode="prune-stale",
+            commands=bl_path,
+            metrics={
+                "entries": float(kept),
+                "dropped": float(len(dropped)),
+            },
+        ))
+        return
     cfg = _cfg_from_args(perf_registry.PerfConfig, args)
     if args.perf_cmd == "update-baseline" and cfg.include:
         raise SystemExit(
@@ -946,7 +979,9 @@ def _cmd_lint(args, writer: ResultWriter) -> int:
             rules=rules,
             tier=args.tier,
             baseline_path=args.baseline,
+            use_baseline=not args.strict,
             update_baseline=args.update_baseline,
+            prune_stale=args.prune_stale,
         )
     except ValueError as e:
         raise SystemExit(f"error: {e}") from e
@@ -960,6 +995,11 @@ def _cmd_lint(args, writer: ResultWriter) -> int:
         writer.progress(
             f"baseline re-pinned: {len(report.baselined)} entr(ies) at "
             f"{report.baseline_path}"
+        )
+    if args.prune_stale:
+        writer.progress(
+            f"stale baseline entries pruned at {report.baseline_path} "
+            "(surviving entries untouched, justifications intact)"
         )
     return report.exit_code
 
@@ -1287,11 +1327,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     pf.add_argument(
         "perf_cmd",
-        choices=("report", "diff", "update-baseline"),
+        choices=("report", "diff", "update-baseline", "prune-stale"),
         help="report: capture + render roofline/trajectory; diff: "
         "capture + gate vs the baseline (exit 1 on NEW regressions, "
         "named per-executable); update-baseline: capture + re-pin "
-        "(per-entry justifications survive)",
+        "(per-entry justifications survive); prune-stale: NO capture — "
+        "drop entries whose executable left the registry, surviving "
+        "pins keep their VALUES and justifications, unlike a full "
+        "re-pin",
     )
     from tpu_patterns.perf.registry import PerfConfig
 
@@ -1468,10 +1511,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     li.add_argument(
         "--tier",
-        choices=("a", "b", "both"),
-        default="both",
-        help="a = AST rules only (no backend init), b = trace checks "
-        "only, both (default)",
+        choices=("a", "b", "c", "both", "all"),
+        default="all",
+        help="a = AST rules only (no backend init), b = trace checks, "
+        "c = SPMD/collective discipline over the jitted entry-point "
+        "registry (shardlint), both = a+b (the pre-Tier-C surface), "
+        "all (default) = the full catalog",
     )
     li.add_argument(
         "--format",
@@ -1491,6 +1536,23 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="re-pin the baseline to the current findings (full run "
         "only — no --rules/--tier filter); justifications survive",
+    )
+    li.add_argument(
+        "--prune-stale",
+        action="store_true",
+        help="drop stale baseline entries (fixed debt) WITHOUT "
+        "re-pinning: surviving entries keep their justifications "
+        "byte-for-byte and new findings keep gating; safe under "
+        "--rules/--tier subsets (only rules that ran may declare "
+        "their own entries fixed), unlike --update-baseline",
+    )
+    li.add_argument(
+        "--strict",
+        action="store_true",
+        help="ignore the ratchet baseline: EVERY unsuppressed finding "
+        "is new and fails the run — the mode for rules whose "
+        "violations are never acceptable debt (the CI timing gate "
+        "runs clock-discipline this way)",
     )
 
     ob = sub.add_parser(

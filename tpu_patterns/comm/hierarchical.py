@@ -302,3 +302,30 @@ def run_hierarchical(
             rec.notes.append(note)
         records.append(writer.record(rec))
     return records
+
+
+def spmd_probe(mesh):
+    """Tiny jitted two-tier allreduce for shardlint
+    (analysis/shardlint.py): ``(jitted_fn, args)`` on the canonical
+    ``(dcn, ici)`` mesh — reduce_scatter over ICI, allreduce over DCN,
+    all_gather back, the module's whole collective surface in one
+    program."""
+    ici = int(mesh.shape["ici"])
+    dcn = int(mesh.shape["dcn"])
+
+    def block(a):  # [1, 1, E] local block -> allreduce the payload row
+        return hierarchical_allreduce(a[0, 0], "ici", ici, "dcn")[None, None]
+
+    fn = jax.jit(
+        jax.shard_map(
+            block,
+            mesh=mesh,
+            in_specs=(P("dcn", "ici", None),),
+            out_specs=P("dcn", "ici", None),
+        )
+    )
+    x = jax.device_put(
+        jnp.ones((dcn, ici, 4 * ici), jnp.float32),
+        NamedSharding(mesh, P("dcn", "ici", None)),
+    )
+    return fn, (x,)

@@ -66,6 +66,30 @@ def _exchange_chain(x, k, *, axis: str, perm):
     return jnp.sum(y.astype(jnp.float32))[None]
 
 
+def spmd_probe(mesh):
+    """Tiny jitted pair exchange for shardlint (analysis/shardlint.py):
+    ``(jitted_fn, args)`` on the canonical 1-D ``x`` mesh (odd/single
+    worlds degrade to the identity permutation — the ppermute is still
+    the traced collective under audit)."""
+    n = int(mesh.shape["x"])
+    perm = (
+        pair_permutation(n) if n >= 2 and n % 2 == 0
+        else [(i, i) for i in range(n)]
+    )
+    fn = jax.jit(
+        jax.shard_map(
+            lambda x: lax.ppermute(x, "x", perm),
+            mesh=mesh,
+            in_specs=(P("x"),),
+            out_specs=P("x"),
+        )
+    )
+    x = jax.device_put(
+        jnp.ones((8 * n,), jnp.float32), NamedSharding(mesh, P("x"))
+    )
+    return fn, (x,)
+
+
 def _shard_checksums(x, *, axis: str):
     return verify.checksum_device(x)[None]
 

@@ -138,3 +138,30 @@ def allreduce(x: jax.Array, axis_name: str, axis_size: int, variant: str, op=Non
     if variant == "ring_opt":
         return ring_allreduce_optimal(x, axis_name, axis_size, op=op)
     raise ValueError(f"unknown allreduce variant {variant!r}")
+
+
+def spmd_probe(mesh):
+    """Tiny jitted bandwidth-optimal ring for shardlint
+    (analysis/shardlint.py): ``(jitted_fn, args)`` on the canonical 1-D
+    ``x`` mesh — the manual reduce-scatter/all-gather ppermute chain is
+    exactly the collective surface the Tier-C rules audit."""
+    import functools
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = int(mesh.shape["x"])
+    fn = jax.jit(
+        jax.shard_map(
+            functools.partial(
+                ring_allreduce_optimal, axis_name="x", axis_size=n
+            ),
+            mesh=mesh,
+            in_specs=(P("x"),),
+            out_specs=P("x"),
+        )
+    )
+    # per-device length must divide by the ring size
+    x = jax.device_put(
+        jnp.ones((n * n,), jnp.float32), NamedSharding(mesh, P("x"))
+    )
+    return fn, (x,)

@@ -83,6 +83,45 @@ def save_entries(
     return len(entries)
 
 
+def prune_stale(
+    path: str,
+    seen: Iterable[str],
+    *,
+    version: int,
+    stale_filter=None,
+) -> tuple[int, list[dict]]:
+    """Drop stale entries (fingerprints ``seen`` no longer produces) from
+    the committed baseline WITHOUT re-pinning the survivors.
+
+    The gap this closes: ``--update-baseline`` re-pins everything — it
+    drops stale debt but also accepts whatever is NEW right now, and (for
+    value-carrying baselines like perf) overwrites every pinned value.
+    Pruning is the surgical half: fixed debt leaves the ledger, surviving
+    entries keep their values AND justifications byte-for-byte, and new
+    findings keep gating.  ``stale_filter`` restricts which entries a
+    partial run may declare fixed (same contract as ``split_entries``).
+
+    Returns ``(surviving_count, dropped_entries)``.  A missing baseline
+    file prunes nothing.
+    """
+    baseline = load_entries(path, version=version)
+    if not baseline:
+        return 0, []
+    _new, _pinned, stale = split_entries(
+        seen, baseline, stale_filter=stale_filter
+    )
+    if not stale:
+        return len(baseline), []
+    dropped_fps = {e["fingerprint"] for e in stale}
+    # dict preserves the file's entry order: survivors keep their slot so
+    # a prune diffs as pure deletions
+    survivors = [
+        e for fp, e in baseline.items() if fp not in dropped_fps
+    ]
+    save_entries(path, survivors, version=version)
+    return len(survivors), stale
+
+
 def split_entries(
     seen: Iterable[str],
     baseline: dict[str, dict],

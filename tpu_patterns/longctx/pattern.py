@@ -95,6 +95,39 @@ STRATEGIES = {
     "ulysses_pallas": ulysses_pallas,
     "flash": flash_local,
 }
+
+
+def spmd_probe(mesh, strategy: str):
+    """Tiny jitted attention core for shardlint (analysis/shardlint.py):
+    ``(jitted_fn, args)`` for the named lineage on the canonical 1-D
+    ``sp`` mesh (``flash`` is the single-device fused kernel: no mesh,
+    no collectives may appear in its jaxpr)."""
+    if strategy == "flash":
+        fn = jax.jit(functools.partial(
+            flash_local, causal=True, block_q=8, block_k=8
+        ))
+        q = jnp.ones((8, 2, 4), jnp.float32)
+        return fn, (q, q, q)
+    attn = {"ring": ring_attention, "ulysses": ulysses_attention}[strategy]
+    sp = int(mesh.shape["sp"])
+    # heads % sp == 0 is the Ulysses contract: size heads to the world
+    heads = max(2, sp) if strategy == "ulysses" else 2
+    spec = P("sp", None, None)
+    fn = jax.jit(
+        jax.shard_map(
+            functools.partial(
+                attn, axis_name="sp", axis_size=sp, causal=True
+            ),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+        )
+    )
+    q = jax.device_put(
+        jnp.ones((4 * sp, heads, 4), jnp.float32),
+        NamedSharding(mesh, spec),
+    )
+    return fn, (q, q, q)
 # Strategies needing check_vma=False on the shard_map — applied ONLY in
 # interpret mode (the `vma = name not in VMA_OFF or not interp` gate), so
 # hardware runs always keep the varying-axes check.  flash (and ulysses'

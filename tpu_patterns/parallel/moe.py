@@ -141,6 +141,42 @@ def moe_apply(
     return out * weight[:, None]
 
 
+def spmd_probe(mesh):
+    """Tiny jitted dispatch for shardlint (analysis/shardlint.py):
+    ``(jitted_fn, args)`` binding the canonical 1-D ``ep`` mesh — the
+    SPMD contract of this module, declared where the collectives live.
+    """
+    import functools
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    ep = int(mesh.shape["ep"])
+    dim, tokens = 8, 4
+    fn = jax.jit(
+        jax.shard_map(
+            functools.partial(
+                moe_apply,
+                lambda w, a: jnp.tanh(a @ w[0]),
+                axis_name="ep",
+                axis_size=ep,
+            ),
+            mesh=mesh,
+            in_specs=(P("ep", None, None), P(), P("ep", None)),
+            out_specs=P("ep", None),
+        )
+    )
+    we = jax.device_put(
+        jnp.ones((ep, dim, dim), jnp.float32),
+        NamedSharding(mesh, P("ep", None, None)),
+    )
+    wg = jnp.ones((dim, ep), jnp.float32)
+    xs = jax.device_put(
+        jnp.ones((tokens * ep, dim), jnp.float32),
+        NamedSharding(mesh, P("ep", None)),
+    )
+    return fn, (we, wg, xs)
+
+
 def all_to_all_bytes(ep: int, cap: int, e: int, itemsize: int) -> int:
     """Wire bytes per rank per moe_apply: two tiled all_to_alls (dispatch
     + return), each moving the full [ep, C, E] buffer minus the local

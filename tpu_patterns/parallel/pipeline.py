@@ -169,6 +169,41 @@ def pipeline_apply(
     return lax.psum(out * owner, axis_name)
 
 
+def spmd_probe(mesh):
+    """Tiny jitted conveyor for shardlint (analysis/shardlint.py):
+    ``(jitted_fn, args)`` binding the canonical 1-D ``pp`` mesh — the
+    module's SPMD contract (neighbor ppermutes + the one-hot psum),
+    declared where the collectives live."""
+    import functools
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    pp = int(mesh.shape["pp"])
+    dim, batch = 8, 2
+    spec = P("pp", None, None)
+    fn = jax.jit(
+        jax.shard_map(
+            functools.partial(
+                pipeline_apply,
+                lambda w, a: jnp.tanh(a @ w[0]),
+                axis_name="pp",
+                axis_size=pp,
+                micro_sharded=True,
+            ),
+            mesh=mesh,
+            in_specs=(spec, spec),
+            out_specs=P(),
+        )
+    )
+    w = jax.device_put(
+        jnp.ones((pp, dim, dim), jnp.float32), NamedSharding(mesh, spec)
+    )
+    micro = jax.device_put(
+        jnp.ones((pp, batch, dim), jnp.float32), NamedSharding(mesh, spec)
+    )
+    return fn, (w, micro)
+
+
 def pipeline_train_1f1b(
     stage_fn,
     stage_params,

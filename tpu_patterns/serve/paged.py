@@ -70,6 +70,20 @@ from tpu_patterns.models.transformer import (
 # physical block 0 absorbs routed-away writes and is never allocated
 TRASH_BLOCK = 0
 
+# The decode per-token collective budget, declared NEXT TO the cores
+# that pay it: every collective the paged prefill/step/verify programs
+# are allowed to run, by (primitive, axes).  shardlint's
+# collective-in-decode-hot-path rule (analysis/shardlint.py) diffs the
+# observed jaxpr collectives structurally against this set, so a new
+# per-token all-reduce is a deliberate edit HERE, never compiler drift.
+DECODE_DECLARED_COLLECTIVES = frozenset({
+    ("psum", ("tp",)),   # tensor-parallel matmul/embedding reductions
+    ("psum", ("sp",)),   # distributed-attention combine over sequence
+    ("pmax", ("sp",)),   # online-softmax running max across sp shards
+    ("pmax", ("tp",)),   # vocab-parallel greedy argmax (max half)
+    ("pmin", ("tp",)),   # vocab-parallel greedy argmax (index tiebreak)
+})
+
 
 class PagedLayout:
     """Closed-form slot math for the block pool.
@@ -489,6 +503,17 @@ class PagedDecoder:
 
     def compiled_buckets(self) -> tuple[int, int]:
         return len(self._prefill_cache), len(self._step_cache)
+
+    def compiled_signatures(self) -> dict[str, set]:
+        """The abstract call signatures this decoder has compiled, per
+        core — the cache keys ARE the signatures, exposed so shardlint's
+        recompile-hazard audit reads an API instead of private caches."""
+        return {
+            "prefill": set(self._prefill_cache),
+            "step": set(self._step_cache),
+            "verify": set(self._verify_cache),
+            "copy": set(self._copy_cache),
+        }
 
     def _build_prefill(self, prompt_len: int):
         cfg, layout = self.cfg, self.layout
