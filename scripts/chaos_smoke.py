@@ -29,7 +29,19 @@ heals itself, visibly:
       (``loadgen.arrive``) — the chaos Record must show full coverage
       (done + failed + dropped == scheduled, nothing silently lost),
       injected firings > 0, p99 e2e bounded by the scenario multiplier
-      vs the clean run, and the CLI exits 0.
+      vs the clean run, and the CLI exits 0;
+  (f) replica fail-over: a 2-replica fleet (``serve --replicas 2``)
+      whose FIRST spawn attempt errors (``replica.spawn`` — the
+      manager retries and respawns) and whose replica 1 is SIGKILLed
+      mid-trace by an injected ``serve.step:kill:replica=1`` — the
+      fleet must close the accounting identity
+      (done + failed + rerouted == scheduled, every request's ids
+      bit-identical to dense decode), leak zero blocks fleet-wide,
+      write a drain/checkpoint snapshot (the survivor banks progress
+      when the failure domain shrinks), and exit 0; a second leg
+      replaces the kill with REPEATED step errors on replica 1 — its
+      breaker opens, the parent drains it to a snapshot, and its
+      pending rows reroute to the survivor.
 
 Zero dependencies beyond the package; exit 0 = pass.
 """
@@ -288,10 +300,90 @@ def main() -> int:
             f"{m.get('requests')}"
         )
 
+    # (f) replica fail-over: two legs on the same 2-replica fleet
+    # shape.  Leg 1: spawn retry + SIGKILL of live replica 1
+    # mid-trace; leg 2: repeated step errors on replica 1 -> breaker
+    # opens -> drain-to-snapshot -> reroute.  Both must close the
+    # accounting identity with zero leaked blocks and exit 0.
+    def replica_leg(tag: str, faults: str, snap_dir: str):
+        jsonl = os.path.join(work, f"{tag}.jsonl")
+        rc = _run(
+            tag,
+            [*py, "--jsonl", jsonl, "serve", "--dp", "1", "--tp", "2",
+             "--vocab", "64", "--embed", "64", "--head_dim", "8",
+             "--depth", "1", "--requests", "8", "--min_prompt", "4",
+             "--max_prompt", "16", "--gen", "8", "--slots", "4",
+             "--block_len", "8", "--replicas", "2",
+             "--min_replica_speedup", "0",
+             "--replica_dir", snap_dir],
+            _env(faults),
+        )
+        if rc != 0:
+            return None
+        with open(jsonl) as f:
+            return [json.loads(ln) for ln in f if ln.strip()][-1]
+
+    for tag, faults in (
+        ("replica-kill",
+         "replica.spawn:error:count=1,"
+         "serve.step:kill:replica=1:after=4:count=1"),
+        ("replica-drain", "serve.step:error:replica=1:count=99"),
+    ):
+        snap_dir = os.path.join(work, tag)
+        rec = replica_leg(tag, faults, snap_dir)
+        if rec is None:
+            return fail(f"{tag}: fleet run exited nonzero — fail-over "
+                        "is a WARNING, not a crash")
+        m = rec.get("metrics", {})
+        print(f"  [{tag}] verdict={rec.get('verdict')} "
+              f"done={m.get('done')} failed={m.get('failed')} "
+              f"rerouted={m.get('rerouted')} "
+              f"done_total={m.get('done_total')} "
+              f"leaked={m.get('leaked_blocks')} "
+              f"exact={m.get('exact')} drains={m.get('drains')} "
+              f"spawn_retries={m.get('spawn_retries')}", flush=True)
+        if rec.get("verdict") == "FAILURE":
+            return fail(f"{tag}: fleet Record FAILED: {rec.get('notes')}")
+        if (
+            m.get("done", 0) + m.get("failed", 0) + m.get("rerouted", 0)
+            != m.get("scheduled")
+        ) or m.get("covered") != 1.0:
+            return fail(
+                f"{tag}: accounting identity broken — done "
+                f"{m.get('done')} + failed {m.get('failed')} + "
+                f"rerouted {m.get('rerouted')} != "
+                f"{m.get('scheduled')} scheduled"
+            )
+        if not m.get("rerouted", 0) > 0:
+            return fail(f"{tag}: the fault never forced a reroute")
+        if m.get("exact") != 1.0:
+            return fail(f"{tag}: rerouted requests diverged from "
+                        "dense decode")
+        if m.get("leaked_blocks") != 0.0:
+            return fail(f"{tag}: {m.get('leaked_blocks')} block(s) "
+                        "leaked fleet-wide through fail-over")
+        if tag == "replica-kill" and not m.get("spawn_retries", 0) > 0:
+            return fail("replica-kill: the injected spawn fault never "
+                        "forced a respawn retry")
+        snaps = [
+            d for d in (
+                os.listdir(os.path.join(snap_dir, "fleet2"))
+                if os.path.isdir(os.path.join(snap_dir, "fleet2"))
+                else []
+            )
+            if d.endswith("-snap") and os.listdir(
+                os.path.join(snap_dir, "fleet2", d)
+            )
+        ]
+        if not snaps:
+            return fail(f"{tag}: no drain/checkpoint snapshot written "
+                        "under the fleet work dir")
+
     print("chaos smoke: all gates passed "
           "(cell retry, worker fallback, preempt/resume exactness, "
           "verify-fault quarantine + refcount balance, "
-          "chaos-under-load coverage + bounded p99)",
+          "chaos-under-load coverage + bounded p99, "
+          "replica fail-over: kill + drain legs)",
           flush=True)
     return 0
 

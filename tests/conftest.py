@@ -53,6 +53,11 @@ def pytest_configure(config):
         "markers",
         "tpu: needs a real TPU backend (skipped under the CPU conftest)",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 `-m 'not slow'` gate "
+        "(multi-process end-to-end runs covered by the CI smokes)",
+    )
 
 
 @pytest.fixture(scope="session")

@@ -214,6 +214,59 @@ class TestScenarioGrammar:
             validate_config(LoadGenConfig(min_goodput=2.0))
 
 
+class TestSharedPrefixes:
+    """Chat-shaped shared system prompts (PR 12): ``prefix_groups`` x
+    ``shared_prefix`` opt in per spec; off by default so existing
+    schedules replay bit-identically."""
+
+    def test_grammar_spells_the_new_fields(self):
+        spec = parse_scenario("chat:prefix_groups=2:shared_prefix=16")
+        assert spec.prefix_groups == 2 and spec.shared_prefix == 16
+
+    def test_fields_come_together_or_not_at_all(self):
+        with pytest.raises(ValueError, match="come together"):
+            parse_scenario("chat:prefix_groups=2")
+        with pytest.raises(ValueError, match="come together"):
+            parse_scenario("chat:shared_prefix=8")
+
+    def test_shared_prefix_must_leave_a_private_suffix(self):
+        with pytest.raises(ValueError, match="private suffix"):
+            parse_scenario(
+                "chat:prefix_groups=2:shared_prefix=48"
+            )  # == chat max_prompt
+
+    def test_every_prompt_opens_with_a_group_prefix(self):
+        spec = parse_scenario(
+            "chat:requests=20:prefix_groups=3:shared_prefix=16"
+        )
+        sched = build_schedule(spec, vocab=64, seed=5)
+        prefixes = {
+            tuple(tr.request.tokens[:16]) for tr in sched
+        }
+        assert 1 <= len(prefixes) <= 3  # every prompt uses a pool entry
+        for tr in sched:
+            assert len(tr.request.tokens) > 16  # private tail exists
+            assert len(tr.request.tokens) <= spec.max_prompt
+
+    def test_prefix_free_schedules_are_unchanged(self):
+        # the feature draws its extra randoms only when enabled, so a
+        # prefix-free spec's schedule is byte-identical to the same
+        # spec before the fields existed (and to itself, trivially)
+        plain = parse_scenario("chat:requests=8")
+        assert plain.prefix_groups == 0 and plain.shared_prefix == 0
+        a = build_schedule(plain, vocab=64, seed=1)
+        b = build_schedule(plain, vocab=64, seed=1)
+        assert a == b
+        shared = parse_scenario(
+            "chat:requests=8:prefix_groups=2:shared_prefix=16"
+        )
+        c = build_schedule(shared, vocab=64, seed=1)
+        assert [t.arrival_s for t in a] == [t.arrival_s for t in c]
+        assert [t.request.tokens for t in a] != [
+            t.request.tokens for t in c
+        ]
+
+
 class TestScheduleReplay:
     def test_bit_identical_replay(self):
         spec = parse_scenario("agentic:requests=12")

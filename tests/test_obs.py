@@ -332,6 +332,53 @@ class TestMetrics:
         ] == 6
 
 
+    def test_router_and_replica_series_export_with_replica_label(self):
+        # the PR-12 fleet series (serve/router.py, serve/replica.py):
+        # routed / prefix-hit / reroute counters and the breaker-open
+        # gauge, all keyed by the `replica` label graftlint knows
+        reg = obs_metrics.Registry()
+        reg.counter(
+            "tpu_patterns_router_routed_total",
+            replica="0", mode="prefix",
+        ).inc(5)
+        reg.counter(
+            "tpu_patterns_router_prefix_hits_total", replica="0"
+        ).inc(3)
+        reg.counter(
+            "tpu_patterns_router_reroutes_total", replica="1"
+        ).inc()
+        reg.gauge(
+            "tpu_patterns_replica_breaker_open", replica="1"
+        ).set(1.0)
+        reg.counter(
+            "tpu_patterns_replica_drains_total",
+            replica="1", mode="drain",
+        ).inc()
+        text = reg.to_prom_text()
+        assert "# TYPE tpu_patterns_router_routed_total counter" in text
+        assert (
+            "# TYPE tpu_patterns_replica_breaker_open gauge" in text
+        )
+        samples = obs.parse_prom_text(text)
+        assert samples[(
+            "tpu_patterns_router_routed_total",
+            (("mode", "prefix"), ("replica", "0")),
+        )] == 5
+        assert samples[(
+            "tpu_patterns_router_prefix_hits_total",
+            (("replica", "0"),),
+        )] == 3
+        assert samples[(
+            "tpu_patterns_router_reroutes_total", (("replica", "1"),)
+        )] == 1
+        assert samples[(
+            "tpu_patterns_replica_breaker_open", (("replica", "1"),)
+        )] == 1.0
+        assert samples[(
+            "tpu_patterns_replica_drains_total",
+            (("mode", "drain"), ("replica", "1")),
+        )] == 1
+
     def test_serve_latency_metric_names_export_cleanly(self):
         # the request-lifecycle series PR 8 wires out of the engine:
         # queue wait, TTFT, TPOT — histograms under the one namespace,

@@ -1,0 +1,479 @@
+"""Router + replica fleet: hashing, fail-over accounting, fault sites.
+
+Parent-side machinery is tested against FAKE replica processes (no
+subprocess, no backend): the fail-over contract is pure accounting —
+quarantine releases every lease, every released lease reroutes or
+fails loudly, the identity ``done + failed + rerouted == scheduled``
+closes.  The real end-to-end fleet (two engine processes on disjoint
+mesh slices) runs as a ``slow``-marked test here and as the
+``replica-smoke`` / chaos-smoke case (f) CI jobs.
+"""
+
+import json
+import os
+import queue
+import random
+import sys
+
+import pytest
+
+from tpu_patterns import faults, rt
+from tpu_patterns.serve.engine import Request
+from tpu_patterns.serve.replica import (
+    FleetResult,
+    ReplicaHandle,
+    ReplicaManager,
+)
+from tpu_patterns.serve.router import (
+    ConsistentHashRing,
+    Router,
+    prefix_fingerprint,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.configure(None)
+    yield
+    faults.configure(None)
+
+
+class TestSiteRegistry:
+    def test_fleet_sites_are_registered_with_match_keys(self):
+        for site in ("router.route", "replica.spawn", "replica.drain"):
+            assert site in faults.KNOWN_SITES
+        assert "replica" in faults.MATCH_KEYS
+        (spec,) = faults.parse_spec("replica.spawn:error:replica=1")
+        assert spec.match == (("replica", "1"),)
+        (spec,) = faults.parse_spec("router.route:error:rid=3")
+        assert spec.match == (("rid", "3"),)
+
+
+class TestPrefixFingerprint:
+    def test_same_block_prefix_same_fingerprint(self):
+        bl = 8
+        a = list(range(16)) + [7, 7]
+        b = list(range(16)) + [9]
+        assert prefix_fingerprint(a, bl) == prefix_fingerprint(b, bl)
+
+    def test_divergence_inside_the_first_block_scatters(self):
+        bl = 8
+        a = [1] * 16
+        b = [2] + [1] * 15
+        assert prefix_fingerprint(a, bl) != prefix_fingerprint(b, bl)
+
+    def test_short_prompts_key_on_raw_tokens(self):
+        assert prefix_fingerprint([1, 2], 8) == prefix_fingerprint(
+            [1, 2], 8
+        )
+        assert prefix_fingerprint([1, 2], 8) != prefix_fingerprint(
+            [1, 3], 8
+        )
+
+    def test_depth_caps_the_key(self):
+        bl = 4
+        a = [1] * 8 + [5] * 4
+        b = [1] * 8 + [6] * 4
+        assert prefix_fingerprint(a, bl, 2) == prefix_fingerprint(
+            b, bl, 2
+        )
+        assert prefix_fingerprint(a, bl, 3) != prefix_fingerprint(
+            b, bl, 3
+        )
+
+
+class TestConsistentHashRing:
+    def test_removal_remaps_only_the_lost_arc(self):
+        ring = ConsistentHashRing(["0", "1", "2"], vnodes=64)
+        fps = [prefix_fingerprint([i] * 8, 8) for i in range(200)]
+        before = {fp: ring.lookup(fp) for fp in fps}
+        ring.remove("1")
+        for fp, owner in before.items():
+            after = ring.lookup(fp)
+            if owner != "1":
+                # survivors keep their arcs: prefix affinity preserved
+                assert after == owner
+            else:
+                assert after in ("0", "2")
+
+    def test_restore_brings_the_arc_back(self):
+        ring = ConsistentHashRing(["0", "1"], vnodes=32)
+        fp = prefix_fingerprint([3] * 8, 8)
+        owner = ring.lookup(fp)
+        ring.remove(owner)
+        assert ring.lookup(fp) != owner
+        ring.restore(owner)
+        assert ring.lookup(fp) == owner
+
+    def test_empty_live_set_is_none(self):
+        ring = ConsistentHashRing(["0"], vnodes=8)
+        ring.remove("0")
+        assert ring.lookup("deadbeef") is None
+
+
+class TestRouter:
+    def test_prefix_policy_co_locates_shared_prefixes(self):
+        r = Router(["0", "1"], block_len=8, policy="prefix")
+        shared = list(range(16))
+        a = r.route(0, shared + [1])
+        b = r.route(1, shared + [2, 3])
+        assert a == b
+        assert r.prefix_hits == 1  # the repeat fingerprint counted
+
+    def test_round_robin_rotates_over_the_live_set(self):
+        r = Router(["0", "1", "2"], block_len=8, policy="round_robin")
+        picks = [r.route(i, [i] * 4) for i in range(6)]
+        assert picks == ["0", "1", "2", "0", "1", "2"]
+
+    def test_quarantined_replica_leaves_rotation(self):
+        r = Router(["0", "1"], block_len=8, policy="prefix")
+        shared = list(range(16))
+        primary = r.route(0, shared)
+        r.quarantine(primary)
+        assert r.route(1, shared) != primary
+        assert r.live() == {"0", "1"} - {primary}
+
+    def test_fallback_counts_reroutes(self):
+        r = Router(["0", "1"], block_len=8, policy="prefix")
+        r.fallback(0, [1] * 8)
+        assert r.reroutes == 1
+
+    def test_no_live_replica_is_loud(self):
+        r = Router(["0"], block_len=8)
+        r.quarantine("0")
+        with pytest.raises(RuntimeError, match="no live replica"):
+            r.route(0, [1] * 8)
+
+    def test_unknown_policy_rejected(self):
+        with pytest.raises(ValueError, match="policy"):
+            Router(["0"], block_len=8, policy="least_loaded")
+
+    def test_route_site_fires_with_rid_and_replica_ctx(self):
+        # the router.route fault site: error fails the primary choice
+        faults.configure("router.route:error:rid=1:count=1")
+        r = Router(["0", "1"], block_len=8, policy="round_robin")
+        r.route(0, [1] * 8)  # rid mismatch: no firing
+        with pytest.raises(faults.InjectedFault):
+            r.route(1, [1] * 8)
+        r.route(1, [1] * 8)  # count spent: flows again
+
+
+class _FakeStdin:
+    def __init__(self):
+        self.sent = []
+        self.broken = False
+
+    def write(self, s):
+        if self.broken:
+            raise BrokenPipeError("gone")
+        self.sent.append(json.loads(s))
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
+class _FakeProc:
+    """A live 'process' whose stdout never speaks (the reader thread
+    parks on a queue-backed line iterator)."""
+
+    def __init__(self):
+        self.stdin = _FakeStdin()
+        self._lines: queue.Queue = queue.Queue()
+        self.stdout = iter(self._lines.get, None)
+        self.dead = False
+
+    def poll(self):
+        return 1 if self.dead else None
+
+    def wait(self, timeout=None):
+        return 0
+
+
+@pytest.fixture
+def no_real_kill(monkeypatch):
+    """ReplicaHandle.kill group-SIGKILLs proc.pid — lethal on a fake.
+    Neutralize the syscall, keep the bookkeeping."""
+    killed = []
+    monkeypatch.setattr(
+        "tpu_patterns.exec.proc.kill_process_group",
+        lambda p: killed.append(p),
+    )
+    return killed
+
+
+def _manager(n=2, policy="prefix"):
+    mgr = ReplicaManager.__new__(ReplicaManager)
+    mgr.n = n
+    mgr.base_env = {}
+    mgr.work_dir = ""
+    mgr.child_cfg = {"block_len": 8}
+    mgr.device_slices = [[i] for i in range(n)]
+    mgr.sp, mgr.tp = 1, 1
+    mgr.watchdog_s = 120.0
+    mgr.warm = []
+    mgr.retry_policy = rt.RetryPolicy(max_attempts=2, backoff_base_s=0.0)
+    mgr.router = Router(
+        [str(r) for r in range(n)], block_len=8, policy=policy
+    )
+    mgr.inbox = queue.Queue()
+    mgr.handles = {}
+    mgr.spawn_retries = 0
+    mgr.drains = 0
+    for r in range(n):
+        h = ReplicaHandle(str(r), _FakeProc(), mgr.inbox)
+        h.state = "ready"
+        mgr.handles[str(r)] = h
+    return mgr
+
+
+def _res(mgr, reqs):
+    return FleetResult(
+        scheduled=len(reqs),
+        requests_by_rid={r.rid: r for r in reqs},
+    )
+
+
+def _reqs(n, bl=8):
+    return [
+        Request(rid=i, tokens=[i % 3] * bl + [i], n_gen=4)
+        for i in range(n)
+    ]
+
+
+class TestFailover:
+    def test_quarantine_releases_every_lease(self, no_real_kill):
+        # the rt property the satellite pins: however many requests a
+        # replica holds when it goes down, its ledger must empty and
+        # every rid must land in rerouted/failed — never limbo
+        for seed in range(5):
+            rng = random.Random(seed)
+            mgr = _manager(3)
+            reqs = _reqs(rng.randint(1, 12))
+            res = _res(mgr, reqs)
+            for r in reqs:
+                mgr._dispatch(r, res)
+            victim = mgr.handles[rng.choice(["0", "1", "2"])]
+            held_before = set(victim.leases.held())
+            mgr._replica_down(victim, "test kill", res)
+            assert len(victim.leases) == 0
+            assert victim.state == "dead"
+            for rid in held_before:
+                assert rid in res.rerouted
+                # rerouted rids re-lease on a SURVIVOR
+                assert any(
+                    rid in h.leases
+                    for h in mgr.handles.values()
+                    if h is not victim
+                ) or rid in res.failed
+
+    def test_survivors_are_told_to_checkpoint_on_death(
+        self, no_real_kill
+    ):
+        mgr = _manager(2)
+        res = _res(mgr, [])
+        mgr._replica_down(mgr.handles["0"], "test", res)
+        sent = mgr.handles["1"].proc.stdin.sent
+        assert {"op": "checkpoint"} in sent
+
+    def test_drained_handback_reroutes_pending(self, no_real_kill):
+        mgr = _manager(2)
+        reqs = _reqs(4)
+        res = _res(mgr, reqs)
+        for r in reqs:
+            mgr._dispatch(r, res)
+        victim = mgr.handles["0"]
+        if not len(victim.leases):
+            victim = mgr.handles["1"]
+        held = set(victim.leases.held())
+        mgr._handle(victim.id, {
+            "op": "drained", "pending": sorted(held),
+            "snapshot_step": 3,
+            "stats": {"leaked_blocks": 0},
+        }, res)
+        assert victim.state == "drained"
+        assert len(victim.leases) == 0
+        assert held <= res.rerouted
+        assert mgr.drains == 1
+
+    def test_consecutive_failures_open_breaker_and_drain(
+        self, no_real_kill
+    ):
+        mgr = _manager(2)
+        reqs = _reqs(6)
+        res = _res(mgr, reqs)
+        for r in reqs:
+            mgr._dispatch(r, res)
+        victim = next(
+            h for h in mgr.handles.values() if len(h.leases) >= 2
+        )
+        rids = sorted(victim.leases.held())[:2]
+        for rid in rids:
+            mgr._handle(
+                victim.id,
+                {"op": "failed", "rid": rid, "reason": "step died"},
+                res,
+            )
+        assert victim.state == "quarantined"
+        assert {"op": "drain"} in victim.proc.stdin.sent
+        # the two failed rows rerouted instead of finalizing: the
+        # replica was sick, not the requests
+        assert set(rids) <= res.rerouted
+
+    def test_single_failure_on_healthy_replica_finalizes(
+        self, no_real_kill
+    ):
+        mgr = _manager(2)
+        reqs = _reqs(2)
+        res = _res(mgr, reqs)
+        for r in reqs:
+            mgr._dispatch(r, res)
+        victim = next(
+            h for h in mgr.handles.values() if len(h.leases)
+        )
+        rid = sorted(victim.leases.held())[0]
+        mgr._handle(
+            victim.id,
+            {"op": "failed", "rid": rid, "reason": "poisoned row"},
+            res,
+        )
+        for other in sorted(victim.leases.held()):
+            # later successes prove the replica healthy (breaker reset)
+            mgr._handle(
+                victim.id, {"op": "done", "rid": other, "ids": [1]},
+                res,
+            )
+        mgr._finalize_tentative(res)
+        assert res.failed.get(rid) == "poisoned row"
+        assert rid not in res.rerouted
+
+    def test_reroute_budget_is_one(self, no_real_kill):
+        mgr = _manager(3)
+        reqs = _reqs(1)
+        res = _res(mgr, reqs)
+        mgr._dispatch(reqs[0], res)
+        first = next(
+            h for h in mgr.handles.values() if len(h.leases)
+        )
+        mgr._replica_down(first, "kill 1", res)
+        second = next(
+            h for h in mgr.handles.values() if len(h.leases)
+        )
+        mgr._replica_down(second, "kill 2", res)
+        assert 0 in res.failed  # budget spent: loud, not limbo
+        assert res.covered()
+
+    def test_spawn_site_retries_then_succeeds(
+        self, monkeypatch, tmp_path, no_real_kill
+    ):
+        faults.configure("replica.spawn:error:count=1")
+        monkeypatch.setattr(
+            "tpu_patterns.exec.proc.popen_in_group",
+            lambda *a, **k: _FakeProc(),
+        )
+        mgr = _manager(1)
+        mgr.work_dir = str(tmp_path)
+        h = mgr._spawn_one(0)
+        assert mgr.spawn_retries == 1  # attempt 1 faulted, 2 spawned
+        assert h.proc.stdin.sent[0]["op"] == "init"
+
+    def test_spawn_deterministic_failure_quarantines(
+        self, monkeypatch, tmp_path, no_real_kill
+    ):
+        faults.configure("replica.spawn:error:count=99")
+        monkeypatch.setattr(
+            "tpu_patterns.exec.proc.popen_in_group",
+            lambda *a, **k: _FakeProc(),
+        )
+        mgr = _manager(1)
+        mgr.work_dir = str(tmp_path)
+        with pytest.raises(faults.Quarantined):
+            mgr._spawn_one(0)
+
+    def test_drain_site_error_reads_as_unresponsive(self, no_real_kill):
+        # replica.drain firing: the drain request fails -> the replica
+        # is treated exactly like a dead one (killed, leases settled)
+        faults.configure("replica.drain:error:count=1")
+        mgr = _manager(2)
+        reqs = _reqs(4)
+        res = _res(mgr, reqs)
+        for r in reqs:
+            mgr._dispatch(r, res)
+        victim = next(
+            h for h in mgr.handles.values() if len(h.leases)
+        )
+        held = set(victim.leases.held())
+        mgr._quarantine(victim, res)
+        assert victim.state == "dead"
+        assert len(victim.leases) == 0
+        assert held <= (res.rerouted | set(res.failed))
+
+    def test_counts_identity_closes(self, no_real_kill):
+        mgr = _manager(2)
+        reqs = _reqs(6)
+        res = _res(mgr, reqs)
+        for r in reqs:
+            mgr._dispatch(r, res)
+        victim = next(
+            h for h in mgr.handles.values() if len(h.leases)
+        )
+        survivor = next(
+            h for h in mgr.handles.values() if h is not victim
+        )
+        mgr._replica_down(victim, "chaos", res)
+        # the survivor completes everything it now holds
+        for rid in sorted(survivor.leases.held()):
+            mgr._handle(
+                survivor.id, {"op": "done", "rid": rid, "ids": [rid]},
+                res,
+            )
+        mgr._finalize_tentative(res)
+        c = res.counts()
+        assert (
+            c["done"] + c["failed"] + c["rerouted"] == res.scheduled
+        )
+        assert res.covered()
+
+
+@pytest.mark.slow
+class TestReplicaEndToEnd:
+    def test_two_replica_fleet_serves_exactly(self, tmp_path):
+        # the real thing: two engine processes on disjoint 4-device
+        # slices through the CLI entry (CI runs this as replica-smoke)
+        import subprocess as sp
+
+        jsonl = tmp_path / "fleet.jsonl"
+        env = {
+            k: v for k, v in os.environ.items() if k != "PYTHONPATH"
+        }
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        env.pop("TPU_PATTERNS_FAULTS", None)
+        rc = sp.run(
+            [sys.executable, "-m", "tpu_patterns", "--jsonl",
+             str(jsonl), "serve", "--dp", "1", "--tp", "2",
+             "--vocab", "64", "--embed", "64", "--head_dim", "8",
+             "--depth", "1", "--requests", "8", "--min_prompt", "4",
+             "--max_prompt", "16", "--gen", "6", "--slots", "4",
+             "--block_len", "8", "--replicas", "2",
+             "--min_replica_speedup", "0",
+             "--replica_dir", str(tmp_path / "work")],
+            env=env,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)
+            )),
+        ).returncode
+        assert rc == 0
+        rec = [
+            json.loads(ln) for ln in jsonl.read_text().splitlines()
+            if ln.strip()
+        ][-1]
+        m = rec["metrics"]
+        assert rec["verdict"] == "SUCCESS"
+        assert m["exact"] == 1.0 and m["covered"] == 1.0
+        assert m["leaked_blocks"] == 0.0
+        assert (
+            m["done"] + m["failed"] + m["rerouted"] == m["scheduled"]
+        )

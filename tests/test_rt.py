@@ -1,0 +1,340 @@
+"""rt/ — the shared runtime core: breaker, lease pool, lease table.
+
+The PR-12 extraction contract: exec/workers.py and serve/engine.py
+consume the SAME Breaker/LeasePool implementations (one half-open
+probe semantics, one ``TPU_PATTERNS_BREAKER_COOLDOWN_S`` knob), and a
+replica quarantine releases every lease — pinned here so the next
+"just inline a small breaker" PR fails loudly.
+"""
+
+import threading
+
+import pytest
+
+from tpu_patterns import obs, rt
+from tpu_patterns.core.timing import clock_ns
+
+
+class TestBreaker:
+    def test_opens_after_threshold_consecutive_failures(self):
+        b = rt.Breaker(threshold=2, cooldown_s=3600.0)
+        assert b.admit() == "closed"
+        assert not b.failure()
+        assert b.admit() == "closed"  # one failure absorbs a blip
+        assert b.failure()
+        assert b.opened
+        assert b.admit() == "open"  # not cooled: fall back instantly
+
+    def test_success_resets_the_streak(self):
+        b = rt.Breaker(threshold=2, cooldown_s=3600.0)
+        b.failure()
+        b.success()
+        assert not b.failure()  # streak restarted, not continued
+        assert not b.opened
+
+    def test_half_open_admits_exactly_one_probe(self):
+        b = rt.Breaker(threshold=1, cooldown_s=3600.0)
+        assert b.failure()
+        b.reopen_at(clock_ns() - int(7200 * 1e9))  # cool down
+        assert b.admit() == "probe"
+        assert b.admit() == "open"  # the slot is taken
+        b.success()
+        assert b.admit() == "closed"
+
+    def test_failed_probe_reopens_for_another_cooldown(self):
+        b = rt.Breaker(threshold=1, cooldown_s=3600.0)
+        b.failure()
+        b.reopen_at(clock_ns() - int(7200 * 1e9))
+        assert b.admit() == "probe"
+        assert b.failure(probe=True)
+        assert b.opened and not b.probing
+        assert b.admit() == "open"  # fresh cool-down started
+
+    def test_abort_probe_unlatches_and_restarts_the_clock(self):
+        b = rt.Breaker(threshold=1, cooldown_s=3600.0)
+        b.failure()
+        b.reopen_at(clock_ns() - int(7200 * 1e9))
+        assert b.admit() == "probe"
+        b.abort_probe()
+        assert not b.probing
+        assert b.admit() == "open"  # clock restarted, still open
+        b.reopen_at(clock_ns() - int(7200 * 1e9))
+        assert b.admit() == "probe"  # recovery not latched shut
+
+    def test_gauge_tracks_open_state_with_labels(self):
+        b = rt.Breaker(
+            threshold=1, cooldown_s=3600.0,
+            gauge="tpu_patterns_replica_breaker_open", replica="t0",
+        )
+        b.failure()
+        assert obs.gauge(
+            "tpu_patterns_replica_breaker_open", replica="t0"
+        ).value == 1.0
+        b.success()
+        assert obs.gauge(
+            "tpu_patterns_replica_breaker_open", replica="t0"
+        ).value == 0.0
+
+    def test_one_cooldown_knob_everywhere(self):
+        # exec re-exports the shared constant: ONE env var tunes every
+        # breaker in the tree (workers, replicas, engines)
+        from tpu_patterns.exec import workers
+
+        assert workers.BREAKER_COOLDOWN_S is rt.BREAKER_COOLDOWN_S
+        b = rt.Breaker()
+        assert b.cooldown_s == rt.BREAKER_COOLDOWN_S
+
+
+class _Item:
+    """Liveness-protocol item (the WarmWorker shape)."""
+
+    def __init__(self):
+        self.live = True
+        self.killed = 0
+        self.shut = 0
+        self.expired = False
+
+    def alive(self):
+        return self.live
+
+    def kill(self):
+        self.killed += 1
+        self.live = False
+
+    def shutdown(self):
+        self.shut += 1
+        self.live = False
+
+
+class TestLeasePool:
+    def test_lease_release_reuse_accounting(self):
+        pool = rt.LeasePool(2, spawn=_Item)
+        a = pool.lease()
+        assert isinstance(a, _Item) and pool.misses == 1
+        pool.release(a, reusable=True)
+        assert pool.lease() is a and pool.hits == 1
+        assert pool.stats()["hit_rate"] == 0.5
+
+    def test_max_leased_bounds_the_active_set(self):
+        pool = rt.LeasePool(4, max_leased=2, spawn=iter(range(10)).__next__)
+        a, b = pool.lease(), pool.lease()
+        assert a is not None and b is not None
+        assert pool.lease() is None  # width reached: defer, don't grow
+        pool.release(a, reusable=True)
+        assert pool.lease() is not None
+
+    def test_plain_items_need_no_liveness_protocol(self):
+        # the serve engine's scheduler slots are bare ints: always
+        # alive, never expired, free to discard
+        pool = rt.LeasePool(2, max_leased=2, spawn=iter(range(9)).__next__)
+        t = pool.lease()
+        pool.release(t, reusable=True)
+        assert pool.lease() == t
+
+    def test_unreusable_release_recycles(self):
+        pool = rt.LeasePool(2, spawn=_Item)
+        a = pool.lease()
+        pool.release(a, reusable=False)
+        assert a.killed == 1 and pool.recycled == 1
+        assert pool.lease() is not a
+
+    def test_expired_and_dead_items_never_come_back(self):
+        pool = rt.LeasePool(2, spawn=_Item)
+        a = pool.lease()
+        a.expired = True
+        pool.release(a, reusable=True)
+        assert a.killed == 1  # expired: recycled despite reusable
+        b = pool.lease()
+        pool.release(b, reusable=True)
+        b.live = False  # died while parked on the free list
+        c = pool.lease()
+        assert c is not b and b.killed >= 1
+
+    def test_overflow_release_shuts_down_politely(self):
+        pool = rt.LeasePool(1, spawn=_Item)
+        a, b = pool.lease(), pool.lease()
+        pool.release(a, reusable=True)  # fills the free list (size 1)
+        pool.release(b, reusable=True)
+        assert b.shut == 1  # no room: polite shutdown, not a kill
+
+    def test_shutdown_hammers_leased_and_drains_free(self):
+        pool = rt.LeasePool(2, spawn=_Item)
+        a, b = pool.lease(), pool.lease()
+        pool.release(a, reusable=True)
+        pool.shutdown()
+        assert a.shut == 1  # parked: polite
+        assert b.killed == 1  # still leased at teardown: the hammer
+
+    def test_breaker_gates_the_spawn_path(self):
+        fails = {"n": 0}
+
+        def spawn():
+            fails["n"] += 1
+            return None
+
+        pool = rt.LeasePool(
+            2, spawn=spawn,
+            breaker=rt.Breaker(threshold=2, cooldown_s=3600.0),
+        )
+        assert pool.lease() is None and pool.lease() is None
+        assert pool.breaker.opened
+        n = fails["n"]
+        assert pool.lease() is None  # open: no spawn attempt at all
+        assert fails["n"] == n
+
+    def test_spawn_exception_aborts_the_probe(self):
+        pool = rt.LeasePool(
+            1, breaker=rt.Breaker(threshold=1, cooldown_s=3600.0),
+        )
+
+        def boom():
+            raise RuntimeError("ENOSPC")
+
+        pool._spawn = boom
+        with pytest.raises(RuntimeError):
+            pool.lease()  # closed-state spawn crash propagates
+        pool.breaker.failure()  # open it
+        pool.breaker.reopen_at(clock_ns() - int(7200 * 1e9))
+        with pytest.raises(RuntimeError):
+            pool.lease()  # the probe crashes...
+        assert not pool.breaker.probing  # ...but never latches shut
+
+
+class TestLeaseTable:
+    def test_acquire_release_round_trip(self):
+        t = rt.LeaseTable()
+        t.acquire(7, meta="req")
+        assert 7 in t and len(t) == 1
+        assert t.release(7) == "req"
+        assert 7 not in t
+
+    def test_double_acquire_is_a_bug_not_a_shrug(self):
+        t = rt.LeaseTable()
+        t.acquire(1)
+        with pytest.raises(ValueError):
+            t.acquire(1)
+
+    def test_release_unheld_returns_none(self):
+        # a late message after fail-over already settled the rid
+        assert rt.LeaseTable().release(42) is None
+
+    def test_release_all_empties(self):
+        t = rt.LeaseTable()
+        for i in range(5):
+            t.acquire(i, meta=i * 10)
+        held = t.release_all()
+        assert held == {i: i * 10 for i in range(5)}
+        assert len(t) == 0
+
+    def test_thread_safety_under_contention(self):
+        t = rt.LeaseTable()
+        errs = []
+
+        def work(base):
+            try:
+                for i in range(200):
+                    t.acquire((base, i))
+                    t.release((base, i))
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=work, args=(b,)) for b in range(4)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errs and len(t) == 0
+
+
+class TestDedup:
+    """The extraction IS the point: both subsystems consume rt."""
+
+    def test_worker_pool_is_the_shared_lease_pool(self):
+        from tpu_patterns.exec.workers import WorkerPool
+
+        pool = WorkerPool(1, {})
+        try:
+            assert isinstance(pool, rt.LeasePool)
+            assert type(pool.breaker) is rt.Breaker
+            # the legacy knobs still drive the shared breaker
+            assert pool._dead is False
+            pool.breaker.failure()
+            pool.breaker.failure()
+            assert pool._dead is True
+            pool._opened_ns = 123
+            assert pool.breaker.opened_ns == 123
+        finally:
+            pool.shutdown()
+
+    def test_serve_engine_slots_are_the_shared_lease_pool(self, devices):
+        import jax
+
+        from tpu_patterns.models.lm import init_lm_params
+        from tpu_patterns.models.transformer import (
+            ModelConfig,
+            _n_experts,
+        )
+        from tpu_patterns.serve.engine import Request, ServeEngine
+        from tpu_patterns.serve.paged import make_paged_lm_decoder
+
+        mesh = jax.sharding.Mesh(
+            __import__("numpy").array(devices[:1]).reshape(1, 1, 1),
+            ("dp", "sp", "tp"),
+        )
+        mcfg = ModelConfig(
+            embed=16, heads=2, head_dim=4, mlp_mult=2, causal=True,
+            dtype="float32", depth=1,
+        )
+        decoder = make_paged_lm_decoder(
+            mesh, mcfg, 32, n_blocks=9, block_len=4, max_len=16
+        )
+        params = decoder.stack_params(
+            init_lm_params(
+                jax.random.key(0), mcfg, 32, _n_experts(mesh, mcfg)
+            )
+        )
+        eng = ServeEngine(
+            decoder, params, slots=2,
+            breaker=rt.Breaker(threshold=2, cooldown_s=3600.0),
+        )
+        assert isinstance(eng.slot_pool, rt.LeasePool)
+        assert type(eng.breaker) is rt.Breaker  # same class as workers'
+        # serving holds one slot lease per active row and releases on
+        # retire — the run must end with the pool fully settled
+        out = eng.run([
+            Request(rid=0, tokens=[1, 2, 3], n_gen=2),
+            Request(rid=1, tokens=[4, 5, 6, 7, 8], n_gen=2),
+            Request(rid=2, tokens=[9, 1], n_gen=1),
+        ])
+        assert set(out) == {0, 1, 2}
+        assert eng.slot_pool.outstanding() == 0
+        assert eng.leaked_blocks() == 0
+
+        # persistent decode-step faults must TRIP the breaker (stop
+        # with the queue intact), not grind through every request —
+        # and a successful PREFILL between failed waves must not reset
+        # the streak (each step failure empties the active set, so a
+        # prefill always runs in between; resetting there would make
+        # the threshold unreachable)
+        from tpu_patterns import faults
+
+        eng2 = ServeEngine(
+            decoder, params, slots=1,
+            breaker=rt.Breaker(threshold=2, cooldown_s=3600.0),
+        )
+        trace = [
+            Request(rid=i, tokens=[1 + i, 2, 3], n_gen=3)
+            for i in range(4)
+        ]
+        faults.configure("serve.step:error:count=99")
+        try:
+            eng2.run(trace)
+        finally:
+            faults.configure(None)
+        assert eng2.breaker_tripped
+        assert eng2.queue  # work handed back, not failed through
+        assert len(eng2.failed) == 2  # exactly the threshold's waves
+        assert eng2.leaked_blocks() == 0
+        assert eng2.slot_pool.outstanding() == 0

@@ -10,6 +10,13 @@ if __name__ == "__main__":
         from tpu_patterns.exec.worker import main as worker_main
 
         sys.exit(worker_main())
+    # Serve-replica server mode: the replica manager (serve/replica.py)
+    # pre-forks engine processes pinned to disjoint mesh slices; same
+    # before-the-CLI dispatch discipline as the warm worker.
+    if os.environ.get("_TPU_PATTERNS_REPLICA"):
+        from tpu_patterns.serve.replica import replica_main
+
+        sys.exit(replica_main())
     from tpu_patterns.cli import main
 
     sys.exit(main())

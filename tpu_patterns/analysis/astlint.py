@@ -418,6 +418,9 @@ class MetricNaming(Rule):
         "scenario",
         # perfwatch series are keyed by registry entry (perf/registry.py)
         "executable",
+        # fleet series are keyed by replica id (serve/replica.py,
+        # serve/router.py — PR 12)
+        "replica",
     })
     PREFIX = "tpu_patterns_"
 

@@ -377,9 +377,32 @@ def _cmd_serve(args, writer: ResultWriter) -> None:
 
     if args.dp != 1:
         # the paged pool is shared state over sp/tp; batch rows are
-        # scheduler slots, not a data axis — fail fast with the reason
-        raise SystemExit("error: serve requires --dp 1 (fold devices into sp)")
+        # scheduler slots, not a data axis.  Data-parallel SERVING is
+        # spelled --replicas: N engine processes on disjoint mesh
+        # slices behind the prefix-aware router (docs/serving.md)
+        raise SystemExit(
+            "error: serve requires --dp 1 (fold devices into sp); for "
+            "data-parallel serving use --replicas N — N engine "
+            "replicas behind the prefix-aware router"
+        )
     cfg = _cfg_from_args(ServeConfig, args)
+    if cfg.replicas:
+        # parse-time surface for the fleet path: flag-combo and policy
+        # typos read as one line (runtime ValueErrors keep tracebacks)
+        from tpu_patterns.serve.router import Router
+
+        if cfg.snapshot_dir or cfg.resume or cfg.ids_out:
+            raise SystemExit(
+                "error: serve --replicas owns its snapshot dirs (one "
+                "per replica under --replica_dir); run preemption via "
+                "the single-engine trace instead"
+            )
+        if cfg.replica_policy not in Router.POLICIES:
+            raise SystemExit(
+                f"error: unknown --replica_policy "
+                f"{cfg.replica_policy!r} (want one of "
+                f"{Router.POLICIES})"
+            )
     if cfg.scenario:
         # parse-time checks up front so spec typos and rejected flag
         # combos read as one line (same surface as loadgen); runtime

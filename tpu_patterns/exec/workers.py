@@ -23,6 +23,13 @@ failure re-opens it for another cool-down.  One bad minute no longer
 disables warm workers for the whole night.  Every spawn failure and
 every warm-path fallback is counted in the obs metrics registry
 (``tpu_patterns_exec_spawn_failures_total`` / ``..._fallbacks_total``).
+
+Since PR 12 both halves live in the shared runtime core: the breaker
+state machine is ``rt.Breaker`` and the lease/release/recycle
+accounting is ``rt.LeasePool`` (tpu_patterns/rt/) — the same classes
+the serve replica manager runs its fleet on.  This module keeps only
+the worker-shaped parts: the process protocol, the exec metric names,
+and the legacy knobs the sweep tests pin.
 """
 
 from __future__ import annotations
@@ -33,6 +40,7 @@ import subprocess
 import threading
 from typing import Mapping
 
+from tpu_patterns import rt
 from tpu_patterns.exec import proc as _proc
 from tpu_patterns.exec.worker import ENV_FLAG
 
@@ -43,9 +51,8 @@ DEFAULT_RECYCLE_AFTER = int(
 # double the sweep preflight budget, not the cell budget
 READY_TIMEOUT_S = float(os.environ.get("TPU_PATTERNS_WORKER_READY_S", "180"))
 # open-breaker cool-down before a half-open probe spawn is allowed
-BREAKER_COOLDOWN_S = float(
-    os.environ.get("TPU_PATTERNS_BREAKER_COOLDOWN_S", "30")
-)
+# (the ONE env var, read by the shared core)
+BREAKER_COOLDOWN_S = rt.BREAKER_COOLDOWN_S
 
 
 class WorkerError(RuntimeError):
@@ -172,13 +179,19 @@ class WarmWorker:
         self.kill()
 
 
-class WorkerPool:
-    """Bounded pool with reuse accounting.
+class WorkerPool(rt.LeasePool):
+    """Bounded pool with reuse accounting — ``rt.LeasePool`` with the
+    worker-shaped spawn hook and the exec metric names.
 
     ``stats()`` feeds the engine Record: a cell served by a worker that
     had already served at least one cell is a reuse HIT (it paid zero
     init tax); a fresh spawn's first cell is a MISS (it paid the init,
-    though concurrently with other work).
+    though concurrently with other work).  The circuit breaker lives in
+    the shared core (rt/breaker.py): two consecutive spawn/ready
+    failures open it — without it, a wedged worker init costs
+    READY_TIMEOUT_S per CELL, making ``--jobs`` strictly slower than
+    ``--no-warm-workers`` on exactly the broken-backend hosts the
+    engine's history is about.
     """
 
     def __init__(
@@ -189,30 +202,35 @@ class WorkerPool:
         recycle_after: int = DEFAULT_RECYCLE_AFTER,
         breaker_cooldown_s: float = BREAKER_COOLDOWN_S,
     ):
-        self.size = max(1, int(size))
+        super().__init__(
+            size,
+            breaker=rt.Breaker(
+                threshold=2,  # one retry absorbs a blip
+                cooldown_s=breaker_cooldown_s,
+                gauge="tpu_patterns_exec_breaker_open",
+            ),
+            fallback_counter="tpu_patterns_exec_fallbacks_total",
+            spawn_failure_counter="tpu_patterns_exec_spawn_failures_total",
+        )
         self.base_env = dict(base_env)
         self.log_dir = log_dir
         self.recycle_after = recycle_after
         self.breaker_cooldown_s = breaker_cooldown_s
-        self._lock = threading.Lock()
-        self._free: list[WarmWorker] = []  # graftlint: guarded-by[_lock]
-        self._leased: set[WarmWorker] = set()  # graftlint: guarded-by[_lock]
         self._spawned = 0  # graftlint: guarded-by[_lock]
-        self.hits = 0  # graftlint: guarded-by[_lock]
-        self.misses = 0  # graftlint: guarded-by[_lock]
-        self.recycled = 0  # graftlint: guarded-by[_lock]
-        # circuit breaker: after two consecutive spawn/ready failures
-        # the warm path is declared dead and every later lease()
-        # returns None instantly — without it, a wedged worker init
-        # costs READY_TIMEOUT_S per CELL, making --jobs strictly slower
-        # than --no-warm-workers on exactly the broken-backend hosts
-        # the engine's history is about.  After breaker_cooldown_s one
-        # lease probes a fresh spawn (half-open): success re-arms the
-        # warm path, failure re-opens the breaker.
-        self._spawn_failures = 0  # graftlint: guarded-by[_lock]
-        self._dead = False  # graftlint: guarded-by[_lock]
-        self._opened_ns = 0  # graftlint: guarded-by[_lock]
-        self._probing = False  # graftlint: guarded-by[_lock]
+
+    # legacy names the sweep tests (and a generation of debugging
+    # muscle memory) read/poke — now views onto the shared breaker
+    @property
+    def _dead(self) -> bool:
+        return self.breaker.opened
+
+    @property
+    def _opened_ns(self) -> int:
+        return self.breaker.opened_ns
+
+    @_opened_ns.setter
+    def _opened_ns(self, ns: int) -> None:
+        self.breaker.reopen_at(ns)
 
     def _spawn(self) -> WarmWorker | None:
         with self._lock:
@@ -237,103 +255,7 @@ class WorkerPool:
         """A ready worker, or None when warm execution is unavailable
         (spawn/init failed, or the breaker is open) — the caller then
         runs the subprocess path."""
-        from tpu_patterns import obs
-        from tpu_patterns.core.timing import clock_ns
-
-        probe = False
-        with self._lock:
-            while self._free:
-                w = self._free.pop()
-                if w.alive():
-                    self.hits += 1
-                    self._leased.add(w)
-                    return w
-                w.kill()
-            if self._dead:
-                cooled = (
-                    clock_ns() - self._opened_ns
-                ) / 1e9 >= self.breaker_cooldown_s
-                if not cooled or self._probing:
-                    self.misses += 1
-                    obs.counter(
-                        "tpu_patterns_exec_fallbacks_total",
-                        reason="breaker_open",
-                    ).inc()
-                    return None
-                # half-open: exactly ONE lease probes a fresh spawn;
-                # the rest keep falling back until the probe verdict
-                self._probing = probe = True
-        try:
-            w = self._spawn()
-        except BaseException:
-            # an exception escaping _spawn (ENOSPC on the log dir, a
-            # kill/wait error) must not leave _probing latched True —
-            # that would disable half-open recovery for good
-            if probe:
-                with self._lock:
-                    self._probing = False
-                    self._opened_ns = clock_ns()
-            raise
-        if w is None:
-            with self._lock:
-                self.misses += 1
-                self._spawn_failures += 1
-                if probe:
-                    # failed probe: re-open for another cool-down
-                    self._probing = False
-                    self._opened_ns = clock_ns()
-                elif self._spawn_failures >= 2:  # one retry absorbs a blip
-                    self._dead = True
-                    self._opened_ns = clock_ns()
-            obs.counter("tpu_patterns_exec_spawn_failures_total").inc()
-            obs.counter(
-                "tpu_patterns_exec_fallbacks_total", reason="spawn_failed"
-            ).inc()
-            obs.gauge("tpu_patterns_exec_breaker_open").set(
-                1.0 if self._dead else 0.0
-            )
-            return None
-        with self._lock:
-            self._spawn_failures = 0
-            self._dead = False
-            self._probing = False
-            # a fresh worker's first cell still skipped nothing: count
-            # the cold init it paid (concurrently, but paid)
-            self.misses += 1
-            self._leased.add(w)
-        obs.gauge("tpu_patterns_exec_breaker_open").set(0.0)
-        return w
-
-    def release(self, worker: WarmWorker, reusable: bool) -> None:
-        with self._lock:
-            self._leased.discard(worker)
-        if not reusable or worker.expired or not worker.alive():
-            # release() runs on every scheduler thread: the recycle
-            # counter is pool state like hits/misses and takes the lock
-            with self._lock:
-                self.recycled += 1
-            worker.kill()
-            return
-        with self._lock:  # decide under the lock, act outside it: a
-            # shutdown's bounded waits must not stall every other
-            # lease/release on the pool
-            keep = len(self._free) < self.size
-            if keep:
-                self._free.append(worker)
-        if not keep:
-            worker.shutdown()
-
-    def shutdown(self) -> None:
-        with self._lock:
-            workers, self._free = self._free, []
-            leased, self._leased = set(self._leased), set()
-        # leased workers still out at teardown are wedged or mid-abort:
-        # group-SIGKILL (no polite drain) so their cells — and anything
-        # those cells spawned — cannot hang pool teardown behind them
-        for w in leased:
-            w.kill()
-        for w in workers:
-            w.shutdown()
+        return super().lease()
 
     def stats(self) -> dict:
         total = self.hits + self.misses

@@ -37,12 +37,24 @@ and docs/robustness.md):
                  scenario): ``sleep``/``hang`` DELAYS the arrival,
                  ``error`` DROPS it — the runner records the drop so
                  done + failed + dropped still covers the trace
+  router.route   serve/router.py, per routing decision (ctx: rid,
+                 replica): ``error`` fails the primary choice — the
+                 manager falls back to any live replica and counts a
+                 reroute; ``sleep``/``hang`` stalls the front door
+  replica.spawn  serve/replica.py (parent), before each replica
+                 process spawn (ctx: replica): ``error`` retries under
+                 the replica RetryPolicy — attempt 2 respawns
+  replica.drain  serve/replica.py (parent), before a drain/checkpoint
+                 request to a replica (ctx: replica): ``error`` means
+                 the replica is unresponsive — it is killed and its
+                 in-flight leases reroute to the survivors
 """
 
 from tpu_patterns.faults.injector import (  # noqa: F401
     ENV_SPEC,
     ENV_STATE,
     KNOWN_SITES,
+    MATCH_KEYS,
     FaultSpec,
     InjectedFault,
     active,

@@ -71,14 +71,17 @@ ACTIONS = frozenset(
 KNOWN_SITES = frozenset({
     "worker.ready", "cell.run", "ckpt.save", "ckpt.restore",
     "train.step", "serve.prefill", "serve.step", "serve.verify",
-    "loadgen.arrive",
+    "loadgen.arrive", "router.route", "replica.spawn", "replica.drain",
 })
 
 # ctx keys the call sites actually pass — the only keys a match
 # predicate can ever see (a misspelled count= / after= would otherwise
-# fall through to an unmatchable predicate and never fire)
+# fall through to an unmatchable predicate and never fire).  `replica`
+# rides every serve-engine and fleet site so a chaos spec can target
+# ONE replica of a fleet (serve.step:kill:replica=1).
 MATCH_KEYS = frozenset({
     "pid", "cmd", "cell", "step", "proc", "rows", "rid", "scenario",
+    "replica",
 })
 
 

@@ -303,13 +303,9 @@ def _publish_gauges(spec: ScenarioSpec, st: dict) -> None:
 
 
 def _injected_total() -> float:
-    from tpu_patterns import obs
+    from tpu_patterns import rt
 
-    return sum(
-        m.value
-        for m in obs.metrics_registry().metrics()
-        if m.name == "tpu_patterns_faults_injected_total"
-    )
+    return rt.metric_total("tpu_patterns_faults_injected_total")
 
 
 def _scenario_commands(cfg: LoadGenConfig, spec: ScenarioSpec) -> str:

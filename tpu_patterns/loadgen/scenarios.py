@@ -58,6 +58,15 @@ class ScenarioSpec:
     # chaos gate: p99 e2e under faults may degrade at most this factor
     # over the clean run of the same schedule
     chaos_p99_mult: float
+    # shared system prompts: with both > 0 each request opens with one
+    # of ``prefix_groups`` distinct ``shared_prefix``-token prefixes
+    # (drawn once per schedule, assignment seeded per request) — the
+    # chat-traffic shape the prefix cache and the prefix-aware router
+    # exist for.  Both default 0: existing schedules replay
+    # bit-identically.  Spell e.g. ``chat:prefix_groups=2:
+    # shared_prefix=16`` to turn it on.
+    prefix_groups: int = 0
+    shared_prefix: int = 0
 
     def __post_init__(self):
         if self.arrival not in ARRIVAL_PROCESSES:
@@ -88,6 +97,17 @@ class ScenarioSpec:
         if self.chaos_p99_mult < 1.0:
             raise ValueError(
                 f"scenario {self.name!r}: chaos_p99_mult must be >= 1"
+            )
+        if (self.prefix_groups > 0) != (self.shared_prefix > 0):
+            raise ValueError(
+                f"scenario {self.name!r}: prefix_groups and "
+                "shared_prefix come together (both > 0) or not at all"
+            )
+        if self.shared_prefix and self.shared_prefix >= self.max_prompt:
+            raise ValueError(
+                f"scenario {self.name!r}: shared_prefix "
+                f"{self.shared_prefix} leaves no room for a private "
+                f"suffix under max_prompt {self.max_prompt}"
             )
 
     def deadline_ms(self, n_gen: int) -> float:
@@ -211,11 +231,28 @@ def build_schedule(
     offsets = arrival_offsets(
         spec.arrival, spec.requests, spec.rate_rps, rng
     )
+    # shared system prompts: one pool of group prefixes per schedule.
+    # Drawn BEFORE the per-request loop (and only when enabled), so a
+    # prefix-free spec's draw sequence — and therefore its schedule —
+    # is bit-identical to what it was before this feature existed.
+    prefixes: list[list[int]] = []
+    if spec.prefix_groups > 0:
+        prefixes = [
+            [rng.randrange(vocab) for _ in range(spec.shared_prefix)]
+            for _ in range(spec.prefix_groups)
+        ]
     out: list[TimedRequest] = []
     for rid, off in enumerate(offsets):
         lp = _tri(rng, spec.min_prompt, spec.mean_prompt, spec.max_prompt)
         n_gen = _tri(rng, spec.min_gen, spec.mean_gen, spec.max_gen)
-        tokens = [rng.randrange(vocab) for _ in range(lp)]
+        if prefixes:
+            group = prefixes[rng.randrange(len(prefixes))]
+            tail = max(1, lp - spec.shared_prefix)
+            tokens = group + [
+                rng.randrange(vocab) for _ in range(tail)
+            ]
+        else:
+            tokens = [rng.randrange(vocab) for _ in range(lp)]
         out.append(
             TimedRequest(
                 request=Request(

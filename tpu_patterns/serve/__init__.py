@@ -10,6 +10,13 @@
              retire / defer) with refcounted CoW prefix sharing and
              self-drafting speculative decoding + the ``serve``
              measured patterns
+  router.py  prefix-aware front door: consistent hashing on the radix
+             index's block-key scheme, so shared prefixes land on the
+             replica already holding their blocks
+  replica.py multi-replica fleet: N engine processes on disjoint mesh
+             slices (topo/placement.py), breaker-quarantined,
+             drain-to-snapshot fail-over, reroute accounting —
+             ``serve --replicas N``
 
 See docs/serving.md for the layout diagram, scheduler states, and how
 to read the verdict Records.
@@ -20,6 +27,11 @@ from tpu_patterns.serve.engine import (  # noqa: F401
     ServeConfig,
     ServeEngine,
     run_serve,
+)
+from tpu_patterns.serve.router import (  # noqa: F401
+    ConsistentHashRing,
+    Router,
+    prefix_fingerprint,
 )
 from tpu_patterns.serve.paged import (  # noqa: F401
     PagedDecoder,

@@ -43,7 +43,7 @@ import threading
 
 import numpy as np
 
-from tpu_patterns import ckpt, faults
+from tpu_patterns import ckpt, faults, rt
 from tpu_patterns.core.timing import clock_ns
 from tpu_patterns.serve.paged import TRASH_BLOCK, make_paged_lm_decoder
 from tpu_patterns.serve.prefix import PrefixIndex
@@ -103,6 +103,7 @@ class _Slot:
     t_admit_ns: int = 0
     t_first_ns: int = 0
     t_last_ns: int = 0
+    slot: int = -1  # scheduler-slot lease token (rt.LeasePool)
 
 
 class ServeEngine:
@@ -117,7 +118,8 @@ class ServeEngine:
     def __init__(self, decoder, params, *, slots: int,
                  watchdog_s: float = 0.0, snapshot_dir: str | None = None,
                  retry_policy=None, fingerprint=None,
-                 prefix_share: bool = False, spec_k: int = 0):
+                 prefix_share: bool = False, spec_k: int = 0,
+                 breaker: rt.Breaker | None = None, replica: str = ""):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if spec_k < 0:
@@ -125,6 +127,25 @@ class ServeEngine:
         self.decoder = decoder
         self.params = params
         self.slots = slots
+        # the active-set ceiling is a leased resource like everything
+        # else bounded in this tree: admission leases one scheduler slot
+        # from the shared runtime core's pool, retire/quarantine release
+        # it — the same rt.LeasePool the warm-worker pool runs on
+        self.slot_pool = rt.LeasePool(
+            slots, max_leased=slots, spawn=itertools.count().__next__
+        )
+        # opt-in decode-health breaker (rt.Breaker, the warm-worker
+        # state machine): consecutive whole-batch step/prefill
+        # quarantines OPEN it and the loop STOPS, leaving the queue
+        # intact for the caller to reroute — a sick replica hands its
+        # work back instead of failing every remaining request.  None
+        # (the single-engine default) keeps the grind-through behavior:
+        # the engine quarantines per-wave and keeps admitting.
+        self.breaker = breaker
+        self.breaker_tripped = False
+        # fleet identity: rides every fault-injection ctx (so a chaos
+        # spec can target ONE replica of a fleet) and the obs labels
+        self.replica = replica
         self.watchdog_s = watchdog_s
         self.layout = decoder.layout
         self.n_pages = decoder.n_pages
@@ -247,6 +268,7 @@ class ServeEngine:
             if len(s.out) >= s.n_gen:
                 for b in s.table:
                     self._release_block(b)
+                self.slot_pool.release(s.slot, reusable=True)
                 self.done[s.rid] = s.out
                 self._finalize_lifecycle(s, "done")
                 obs.counter("tpu_patterns_serve_requests_total").inc()
@@ -332,7 +354,14 @@ class ServeEngine:
         from tpu_patterns import obs
 
         admitted: list[tuple[Request, _Slot]] = []
-        while self.queue and len(self.active) + len(admitted) < self.slots:
+        while self.queue:
+            # one scheduler slot per active row, leased from the shared
+            # runtime core's pool (max_leased == slots) — None means
+            # the active set is full, which ends admission (not a
+            # deferral: deferral is pool pressure, this is width)
+            slot_tok = self.slot_pool.lease()
+            if slot_tok is None:
+                break
             req, t_submit = self.queue[0]
             need = self._blocks_needed(req)
             plan = (
@@ -347,6 +376,7 @@ class ServeEngine:
             # counts no table row ever releases
             aliased = aliased[:need]
             if need - len(aliased) > len(self.free):
+                self.slot_pool.release(slot_tok, reusable=True)
                 self.stats["deferrals"] += 1
                 obs.counter("tpu_patterns_serve_deferrals_total").inc()
                 obs.event(
@@ -393,7 +423,7 @@ class ServeEngine:
                 write_from=min(write_from, len(req.tokens)),
                 own_blocks=own_blocks,
                 scenario=req.scenario, deadline_ms=req.deadline_ms,
-                t_admit_ns=now,
+                t_admit_ns=now, slot=slot_tok,
             )
             wait_ns = now - t_submit
             self.stats["queue_wait_ns"].append(wait_ns)
@@ -446,7 +476,9 @@ class ServeEngine:
         fn = self.decoder.prefill_jit(rows, lpad)
         # fault site: before the compiled call — no engine state has
         # been mutated yet, so an ``error`` here is safely retryable
-        faults.inject("serve.prefill", rows=len(reqs))
+        faults.inject(
+            "serve.prefill", rows=len(reqs), replica=self.replica
+        )
         t0 = clock_ns()
         with obs.span(
             "serve.prefill",
@@ -496,7 +528,9 @@ class ServeEngine:
         # ``error`` retries cleanly); ``preempt`` raises SIGTERM — the
         # handler sets the flag, THIS step still completes, and the loop
         # snapshots at the iteration boundary
-        faults.inject("serve.step", step=self.stats["steps"])
+        faults.inject(
+            "serve.step", step=self.stats["steps"], replica=self.replica
+        )
         t0 = clock_ns()
         with obs.span(
             "serve.step",
@@ -585,7 +619,7 @@ class ServeEngine:
         # ``error`` retries cleanly; exhaustion quarantines the active
         # set with refcounts released, same contract as serve.step)
         faults.inject("serve.verify", step=self.stats["steps"],
-                      rows=len(self.active))
+                      rows=len(self.active), replica=self.replica)
         t0 = clock_ns()
         with obs.span(
             "serve.verify",
@@ -639,10 +673,30 @@ class ServeEngine:
         for s in slots:
             for b in s.table:
                 self._release_block(b)
+            self.slot_pool.release(s.slot, reusable=True)
             self.failed[s.rid] = reason
             self._finalize_lifecycle(s, "failed")
             obs.counter("tpu_patterns_serve_quarantined_total").inc()
             obs.event("serve.quarantine", rid=str(s.rid), reason=reason)
+
+    def _book_health(self, ok: bool, decode: bool = False) -> None:
+        """Feed the opt-in decode-health breaker (rt.Breaker): a
+        whole-wave quarantine (prefill or decode) is one failure, and
+        only a SERVED DECODE wave resets the streak — a step-sick
+        engine still prefills fine, and letting that success clear the
+        streak would make the threshold unreachable (each step failure
+        empties the active set, so a prefill always runs in between).
+        When the breaker OPENS the loop stops at the next iteration
+        boundary with the queue intact — the caller (the replica
+        manager) drains and reroutes instead of letting a sick engine
+        fail every remaining request."""
+        if self.breaker is None:
+            return
+        if ok:
+            if decode:
+                self.breaker.success()
+        elif self.breaker.failure():
+            self.breaker_tripped = True
 
     def _on_preempt_signal(self, signum, frame) -> None:
         # async-signal-safe ONLY: the handler interrupts the main thread,
@@ -772,6 +826,7 @@ class ServeEngine:
                 n_gen=a["n_gen"], table=list(a["table"]),
                 last_tok=a["last_tok"], out=list(a["out"]),
                 t_submit_ns=now, prompt=list(a["prompt"]),
+                slot=self.slot_pool.lease(),
             )
             for a in state["active"]
         ]
@@ -857,7 +912,9 @@ class ServeEngine:
                             self._quarantine(
                                 slots, f"prefill failed after retries: {e}"
                             )
+                            self._book_health(False)
                         else:
+                            self._book_health(True)
                             self._retire()  # n_gen == 1 finish at prefill
                     if self.active:
                         # speculative decoding swaps the one-token step
@@ -889,6 +946,9 @@ class ServeEngine:
                                 casualties,
                                 f"decode step failed after retries: {e}",
                             )
+                            self._book_health(False, decode=True)
+                        else:
+                            self._book_health(True, decode=True)
                         finally:
                             obs.histogram(
                                 "tpu_patterns_serve_decode_wall_ms"
@@ -904,6 +964,18 @@ class ServeEngine:
                     obs.gauge("tpu_patterns_serve_active_rows").set(
                         len(self.active)
                     )
+                    if self.breaker_tripped:
+                        # the engine declared itself unhealthy: stop at
+                        # this iteration boundary with queue + verdicts
+                        # intact so the caller can drain and reroute
+                        obs.counter(
+                            "tpu_patterns_replica_breaker_trips_total",
+                        ).inc()
+                        obs.event(
+                            "serve.breaker_open", replica=self.replica,
+                            queued=len(self.queue),
+                        )
+                        break
                     if self._preempt.is_set():
                         self._take_preemption()
                         break
@@ -977,6 +1049,22 @@ class ServeConfig:
     # superseded (spell overrides inside the spec, "chat:requests=64");
     # snapshot_dir/resume/ids_out are rejected (docs/serving.md)
     scenario: str = ""
+    time_scale: float = 1.0  # compress scenario ARRIVALS onto the wall
+    # multi-replica serving (serve/replica.py): N engine replicas, each
+    # its own PROCESS pinned to a disjoint mesh slice
+    # (topo/placement.py), behind the prefix-aware router
+    # (serve/router.py).  0 = the single-engine paths above.  With
+    # --scenario set the fleet serves the scenario schedule under BOTH
+    # router policies and banks the routing-comparison Record.
+    replicas: int = 0
+    replica_policy: str = "prefix"  # prefix | round_robin
+    route_blocks: int = 0  # prefix-fingerprint depth in blocks (0 = 2)
+    # the 1 -> N scaling gate: aggregate tokens/s over N replicas vs
+    # ONE replica on the same slice size; 0 skips the baseline leg
+    # (the fail-over smokes measure recovery, not scaling)
+    min_replica_speedup: float = 1.8
+    replica_watchdog_s: float = 120.0  # no-message deadline per replica
+    replica_dir: str = ""  # fleet work dir (logs + drain snapshots)
 
 
 def _auto_blocks(cfg: ServeConfig) -> int:
@@ -1023,7 +1111,8 @@ def _serve_fingerprint(cfg: ServeConfig, n_blocks: int) -> dict:
     everything that shapes the pool, the trace, or the token stream."""
     fp = dataclasses.asdict(cfg)
     for k in ("snapshot_dir", "resume", "ids_out", "watchdog_s",
-              "min_speedup", "min_block_savings", "min_accepted"):
+              "min_speedup", "min_block_savings", "min_accepted",
+              "min_replica_speedup", "replica_watchdog_s", "replica_dir"):
         fp.pop(k, None)
     fp["n_blocks"] = n_blocks  # resolved, not the 0=auto sentinel
     return fp
@@ -1202,6 +1291,24 @@ def _repetitive_trace(cfg: ServeConfig, rng) -> list:
                     n_gen=cfg.gen)
         )
     return reqs
+
+
+def random_trace(cfg: ServeConfig) -> list:
+    """The canonical serve trace: deterministic from cfg (seed + 1) —
+    shared by the single-engine speedup race and the replica fleet so
+    both measure the same workload."""
+    rng = np.random.RandomState(cfg.seed + 1)
+    return [
+        Request(
+            rid=i,
+            tokens=rng.randint(
+                0, cfg.vocab,
+                size=rng.randint(cfg.min_prompt, cfg.max_prompt + 1),
+            ).tolist(),
+            n_gen=cfg.gen,
+        )
+        for i in range(cfg.requests)
+    ]
 
 
 def _serve_commands(cfg: ServeConfig) -> str:
@@ -1421,6 +1528,20 @@ def run_serve(mesh, cfg: ServeConfig, writer) -> list:
         kv_heads=cfg.kv_heads,
         rope=cfg.rope,
     )
+    if cfg.replicas:
+        # the multi-replica fleet (serve/replica.py): N engine
+        # processes on disjoint mesh slices behind the prefix-aware
+        # router — scaling, fail-over, and (with --scenario) the
+        # routing-comparison measured patterns
+        if cfg.snapshot_dir or cfg.resume or cfg.ids_out:
+            raise ValueError(
+                "serve --replicas owns its snapshot dirs (one per "
+                "replica under --replica_dir); run preemption via the "
+                "single-engine trace instead"
+            )
+        from tpu_patterns.serve.replica import run_replicas
+
+        return run_replicas(mesh, cfg, writer)
     if cfg.scenario:
         # the loadgen bridge: the model/pool knobs map one-to-one, the
         # SCENARIO owns the trace shape — --requests/--min_prompt/
@@ -1446,6 +1567,7 @@ def run_serve(mesh, cfg: ServeConfig, writer) -> list:
                 n_blocks=cfg.n_blocks, spec_k=cfg.spec_k,
                 prefix_share=cfg.prefix_share,
                 watchdog_s=cfg.watchdog_s, seed=cfg.seed,
+                time_scale=cfg.time_scale,
                 scenarios=(cfg.scenario,),
             ),
             writer,
@@ -1464,18 +1586,7 @@ def run_serve(mesh, cfg: ServeConfig, writer) -> list:
     )
     params = decoder.stack_params(flat_params)
 
-    rng = np.random.RandomState(cfg.seed + 1)
-    trace = [
-        Request(
-            rid=i,
-            tokens=rng.randint(
-                0, cfg.vocab,
-                size=rng.randint(cfg.min_prompt, cfg.max_prompt + 1),
-            ).tolist(),
-            n_gen=cfg.gen,
-        )
-        for i in range(cfg.requests)
-    ]
+    trace = random_trace(cfg)
     total_tokens = sum(r.n_gen for r in trace)
 
     if cfg.resume and not cfg.snapshot_dir:

@@ -1,0 +1,1325 @@
+"""Multi-replica serving: N engine processes behind the prefix router.
+
+The serve CLI's single engine is one failure domain: any fault that
+quarantines it takes everything down with it.  This module runs
+``--replicas N`` :class:`~tpu_patterns.serve.engine.ServeEngine`
+instances, each in its OWN process pinned to a disjoint mesh slice
+(topo/placement.py: the reference's rank->tile binding, cut into
+contiguous co-located runs), fronted by the prefix-aware router
+(serve/router.py) and settled through the shared runtime core
+(tpu_patterns/rt/): one :class:`rt.LeaseTable` of in-flight requests
+per replica, one :class:`rt.Breaker` per replica in the parent, and
+one *inside* each child engine.
+
+Protocol (line JSON, the exec/worker.py idiom — fd 1 is claimed for
+the protocol before the backend can scribble on it):
+
+  parent -> child : {"op":"init", replica, devices, sp, tp, cfg,
+                     snapshot_dir, warm}           (first line)
+                    {"op":"req", rid, tokens, n_gen[, deadline_ms]}
+                    {"op":"fin"} | {"op":"drain"} |
+                    {"op":"checkpoint"} | {"op":"shutdown"}
+  child -> parent : {"ready": true, pid, replica, platform}
+                    {"op":"done", rid, ids} | {"op":"failed", rid,
+                     reason} | {"op":"hb", steps, tokens}
+                    {"op":"checkpointed", step}
+                    {"op":"drained"|"quarantined", pending,
+                     snapshot_step, stats}
+                    {"op":"fin", stats}
+
+The fail-over state machine (docs/serving.md has the diagram):
+
+  * a replica whose parent-side breaker OPENS (consecutive request
+    failures) is QUARANTINED: the router takes it out of the ring, the
+    parent sends ``drain`` — the child stops at the next iteration
+    boundary, commits pool + scheduler state through the existing
+    ``--snapshot_dir`` machinery, and hands back its pending rids;
+  * a replica that DIES (SIGKILL, OOM, protocol EOF) or HANGS (no
+    message inside the watchdog deadline while holding leases) is
+    killed and settled from the parent's lease ledger alone — and the
+    SURVIVORS are told to ``checkpoint`` (the failure domain just
+    shrank; bank progress now);
+  * either way, every released lease REROUTES (budget: one reroute per
+    request) via the router's consistent ring, so only the lost
+    replica's arc remaps and the survivors' prefix affinity is kept.
+
+Accounting is an identity, not a hope:
+``done + failed + rerouted == scheduled`` and ``leaked_blocks == 0``
+across the fleet, with every completed request's ids bit-identical to
+its per-request dense decode — gated by the Records below and by
+scripts/replica_smoke.py + chaos_smoke.py case (f) in CI.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import os
+import queue
+import subprocess
+import sys
+import threading
+
+import numpy as np
+
+from tpu_patterns import faults, rt
+from tpu_patterns.core.timing import clock_ns
+from tpu_patterns.serve.engine import Request
+from tpu_patterns.serve.router import Router
+
+ENV_FLAG = "_TPU_PATTERNS_REPLICA"
+# replica init = interpreter + JAX import + backend init + executable
+# warm-up; generous like the worker READY deadline, and parallel
+READY_TIMEOUT_S = float(
+    os.environ.get("TPU_PATTERNS_REPLICA_READY_S", "600")
+)
+_HB_NS = int(0.5e9)  # child heartbeat cadence
+
+
+class ReplicaError(RuntimeError):
+    """A replica died or broke protocol — the parent fails it over."""
+
+
+# -- child side ------------------------------------------------------------
+
+
+class _StdinSource:
+    """The child engine's arrival source: requests stream in over
+    stdin (a reader thread feeds the queue), completions/heartbeats
+    stream back out — called once per scheduler iteration on the
+    engine loop thread, so every send happens at a consistent
+    iteration boundary."""
+
+    def __init__(self, lines, engine, send):
+        self._engine = engine
+        self._send = send
+        self._q: queue.Queue = queue.Queue()
+        self.fin = False
+        self.closed = False  # shutdown/EOF seen: the parent is done
+        self.drain_requested = False
+        self._reported_done: set[int] = set()
+        self._reported_failed: set[int] = set()
+        self._last_hb_ns = 0
+        t = threading.Thread(
+            target=self._read, args=(lines,), daemon=True
+        )
+        t.start()
+
+    def _read(self, lines) -> None:
+        for line in lines:
+            if not line.strip():
+                continue
+            try:
+                self._q.put(json.loads(line))
+            except ValueError:
+                self._q.put({"op": "_garbled"})
+                return
+        self._q.put({"op": "_eof"})
+
+    def report(self) -> None:
+        """Stream newly-terminal requests + a bounded-rate heartbeat."""
+        eng = self._engine
+        for rid in list(eng.done):
+            if rid not in self._reported_done:
+                self._reported_done.add(rid)
+                self._send(
+                    {"op": "done", "rid": rid, "ids": eng.done[rid]}
+                )
+        for rid in list(eng.failed):
+            if rid not in self._reported_failed:
+                self._reported_failed.add(rid)
+                self._send({
+                    "op": "failed", "rid": rid,
+                    "reason": eng.failed[rid],
+                })
+        now = clock_ns()
+        if now - self._last_hb_ns >= _HB_NS:
+            self._last_hb_ns = now
+            self._send({
+                "op": "hb", "steps": eng.stats["steps"],
+                "tokens": eng.stats["tokens"],
+            })
+
+    def __call__(self, idle: bool = False):
+        self.report()
+        batch = []
+        block = idle and not self.fin
+        while True:
+            try:
+                msg = self._q.get(timeout=0.05) if block else (
+                    self._q.get_nowait()
+                )
+            except queue.Empty:
+                break
+            block = False
+            op = msg.get("op")
+            if op == "req":
+                batch.append(Request(
+                    rid=int(msg["rid"]),
+                    tokens=[int(t) for t in msg["tokens"]],
+                    n_gen=int(msg["n_gen"]),
+                    deadline_ms=float(msg.get("deadline_ms", 0.0)),
+                ))
+            elif op == "fin":
+                self.fin = True
+            elif op == "drain":
+                # stop at the next iteration boundary through the
+                # engine's preemption machinery: finish the in-flight
+                # step, snapshot, return — rows in flight are banked,
+                # not lost
+                self.drain_requested = True
+                self._engine._preempt.set()
+            elif op == "checkpoint":
+                # precautionary snapshot (a sibling replica just died):
+                # the source runs between iterations, so state is
+                # consistent here
+                if self._engine.snapshot_dir:
+                    self._engine.snapshot()
+                from tpu_patterns import obs
+
+                obs.counter(
+                    "tpu_patterns_replica_drains_total",
+                    replica=self._engine.replica, mode="checkpoint",
+                ).inc()
+                self._send({
+                    "op": "checkpointed",
+                    "step": self._engine.stats["steps"],
+                })
+            elif op in ("shutdown", "_eof", "_garbled"):
+                # parent is gone or done with us: stop taking work
+                self.fin = True
+                self.closed = True
+        eng = self._engine
+        if (
+            self.fin
+            and not batch
+            and not eng.queue
+            and not eng.active
+        ):
+            return None  # exhausted: the engine loop may exit
+        return batch
+
+
+    def wait_shutdown(self, timeout_s: float = 60.0) -> None:
+        """Linger for the parent's shutdown op THROUGH the reader
+        thread's queue — that thread is still parked on stdin, and a
+        second reader racing it would swallow the handshake line (two
+        threads on one buffered stream is not even safe)."""
+        if self.closed:
+            return
+        deadline = clock_ns() + int(timeout_s * 1e9)
+        while clock_ns() < deadline:
+            try:
+                msg = self._q.get(timeout=1.0)
+            except queue.Empty:
+                continue
+            if msg.get("op") in (
+                "shutdown", "drain", "_eof", "_garbled"
+            ):
+                return
+
+
+def _child_stats(eng) -> dict:
+    return {
+        "steps": eng.stats["steps"],
+        "tokens": eng.stats["tokens"],
+        "prefix_hit_blocks": eng.stats["prefix_hit_blocks"],
+        "cow_copies": eng.stats["cow_copies"],
+        "deferrals": eng.stats["deferrals"],
+        "peak_blocks": eng.stats["peak_blocks"],
+        "done": len(eng.done),
+        "failed": len(eng.failed),
+        "leaked_blocks": eng.leaked_blocks(),
+    }
+
+
+def replica_main() -> int:
+    """Child entry (``_TPU_PATTERNS_REPLICA=1``, dispatched by
+    ``__main__.py`` before the CLI import): build the engine on the
+    assigned mesh slice, warm the executables, then serve stdin."""
+    # claim the protocol channel FIRST; stray prints land on stderr
+    proto_fd = os.dup(1)
+    os.dup2(2, 1)
+    proto_out = os.fdopen(proto_fd, "w")
+
+    def send(obj: dict) -> None:
+        proto_out.write(json.dumps(obj) + "\n")
+        proto_out.flush()
+
+    init = json.loads(sys.stdin.readline())
+    replica = str(init["replica"])
+    cfg = init["cfg"]
+    try:
+        from tpu_patterns.runtime import warm_backend
+
+        platform = warm_backend()
+        import jax
+        from jax.sharding import Mesh
+
+        from tpu_patterns.models.lm import init_lm_params
+        from tpu_patterns.models.transformer import (
+            ModelConfig,
+            _n_experts,
+        )
+        from tpu_patterns.serve.engine import ServeEngine
+        from tpu_patterns.serve.paged import make_paged_lm_decoder
+
+        devs = jax.devices()
+        sub = [devs[i] for i in init["devices"]]
+        sp, tp = int(init["sp"]), int(init["tp"])
+        mesh = Mesh(
+            np.array(sub).reshape(1, sp, tp), ("dp", "sp", "tp")
+        )
+        mcfg = ModelConfig(
+            embed=cfg["embed"], heads=cfg["heads"],
+            head_dim=cfg["head_dim"], mlp_mult=cfg["mlp_mult"],
+            causal=True, dtype=cfg["dtype"], depth=cfg["depth"],
+            kv_heads=cfg["kv_heads"], rope=cfg["rope"],
+        )
+        decoder = make_paged_lm_decoder(
+            mesh, mcfg, cfg["vocab"], n_blocks=cfg["n_blocks"],
+            block_len=cfg["block_len"], max_len=cfg["max_len"],
+            cache_int8=cfg["cache_int8"],
+        )
+        # SAME seed in every replica -> bit-identical params -> a
+        # rerouted request decodes to the same ids anywhere
+        flat_params = init_lm_params(
+            jax.random.key(cfg["seed"]), mcfg, cfg["vocab"],
+            _n_experts(mesh, mcfg),
+        )
+        params = decoder.stack_params(flat_params)
+
+        def make_engine():
+            return ServeEngine(
+                decoder, params, slots=cfg["slots"],
+                watchdog_s=cfg["watchdog_s"],
+                snapshot_dir=init.get("snapshot_dir") or None,
+                prefix_share=cfg["prefix_share"],
+                spec_k=cfg["spec_k"],
+                breaker=rt.Breaker(
+                    threshold=2,
+                    gauge="tpu_patterns_replica_breaker_open",
+                    replica=replica,
+                ),
+                replica=replica,
+            )
+
+        # warm-up: serve the parent-supplied warm trace through a
+        # THROWAWAY engine so every bucket the real trace needs is
+        # compiled before "ready" — the scaling Record then measures
+        # serving, not XLA's compile queue
+        warm = init.get("warm") or []
+        if warm:
+            weng = make_engine()
+            weng.snapshot_dir = None  # the warm-up must not snapshot
+            # warm-up is infrastructure, not serving: a chaos spec must
+            # neither fire here nor have its ordinals consumed here
+            faults.configure("")
+            try:
+                weng.run([
+                    Request(rid=i, tokens=list(t), n_gen=int(g))
+                    for i, (t, g) in enumerate(warm)
+                ])
+            finally:
+                faults.configure(None)
+        eng = make_engine()
+    except Exception as e:  # init must answer, not hang the parent
+        send({"ready": False, "error": f"{type(e).__name__}: {e}"})
+        return 1
+
+    send({
+        "ready": True, "pid": os.getpid(), "replica": replica,
+        "platform": platform,
+    })
+    source = _StdinSource(sys.stdin, eng, send)
+    eng.run([], source=source)
+    source.report()  # flush the tail
+    pending = [r.rid for r, _ in eng.queue] + [
+        s.rid for s in eng.active
+    ]
+    if eng.breaker_tripped:
+        # sick engine: bank what we hold, hand the rest back
+        step = -1
+        if eng.snapshot_dir:
+            eng.snapshot()
+            step = eng.stats["steps"]
+        send({
+            "op": "quarantined", "pending": pending,
+            "snapshot_step": step, "stats": _child_stats(eng),
+            "reason": "engine breaker open "
+            "(consecutive decode-wave failures)",
+        })
+    elif source.drain_requested:
+        send({
+            "op": "drained", "pending": pending,
+            "snapshot_step": (
+                eng.preempted_at if eng.preempted_at is not None else -1
+            ),
+            "stats": _child_stats(eng),
+        })
+    else:
+        send({"op": "fin", "stats": _child_stats(eng)})
+    # linger for the shutdown op (or EOF) so the parent reads our last
+    # message before the pipe closes — via the reader thread's queue,
+    # which owns stdin
+    source.wait_shutdown()
+    return 0
+
+
+# -- parent side -----------------------------------------------------------
+
+
+class ReplicaHandle:
+    """Parent-side view of one replica process: the protocol pipe, the
+    in-flight lease ledger, and the health breaker."""
+
+    def __init__(self, replica_id: str, proc, inbox: queue.Queue):
+        self.id = replica_id
+        self.proc = proc
+        self.state = "spawning"  # ready|quarantined|drained|dead|done
+        self.leases = rt.LeaseTable()
+        self.breaker = rt.Breaker(
+            threshold=2,
+            gauge="tpu_patterns_replica_breaker_open",
+            replica=replica_id,
+        )
+        self.last_msg_ns = clock_ns()
+        self.stats: dict = {}
+        self.tentative_failed: dict[int, str] = {}
+        self.snapshotted = False
+        self._reader = threading.Thread(
+            target=self._read, args=(inbox,), daemon=True
+        )
+        self._reader.start()
+
+    def _read(self, inbox: queue.Queue) -> None:
+        try:
+            for line in self.proc.stdout:
+                if not line.strip():
+                    continue
+                try:
+                    inbox.put((self.id, json.loads(line)))
+                except ValueError:
+                    inbox.put((self.id, {"op": "_garbled"}))
+                    return
+        except (ValueError, OSError):
+            pass
+        inbox.put((self.id, {"op": "_eof"}))
+
+    def send(self, obj: dict) -> None:
+        try:
+            self.proc.stdin.write(json.dumps(obj) + "\n")
+            self.proc.stdin.flush()
+        except (BrokenPipeError, OSError, ValueError) as e:
+            raise ReplicaError(
+                f"replica {self.id}: pipe closed: {e}"
+            ) from e
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def kill(self) -> None:
+        from tpu_patterns.exec import proc as _proc
+
+        _proc.kill_process_group(self.proc)
+        try:
+            self.proc.wait(timeout=10)
+        except (OSError, subprocess.TimeoutExpired):
+            pass  # already reaped, or wedged in D-state
+        for f in (self.proc.stdin, self.proc.stdout):
+            close = getattr(f, "close", None)
+            try:
+                if close is not None:
+                    close()
+            except OSError:
+                pass
+
+
+@dataclasses.dataclass
+class FleetResult:
+    """One fleet run, settled: every scheduled rid is in exactly one
+    terminal bucket (``done`` holds ids for rerouted completions too —
+    ``rerouted`` marks which rids took the detour)."""
+
+    scheduled: int = 0
+    done: dict[int, list[int]] = dataclasses.field(default_factory=dict)
+    failed: dict[int, str] = dataclasses.field(default_factory=dict)
+    rerouted: set[int] = dataclasses.field(default_factory=set)
+    requests_by_rid: dict[int, Request] = dataclasses.field(
+        default_factory=dict
+    )
+    t_done_ns: dict[int, int] = dataclasses.field(default_factory=dict)
+    arrival_ms: dict[int, float] = dataclasses.field(
+        default_factory=dict
+    )
+    t0_ns: int = 0
+    wall_s: float = 0.0
+    drains: int = 0
+    spawn_retries: int = 0
+    replica_stats: dict[str, dict] = dataclasses.field(
+        default_factory=dict
+    )
+    router_routed: int = 0
+    router_prefix_hits: int = 0
+    router_reroutes: int = 0
+
+    def covered(self) -> bool:
+        return set(self.done) | set(self.failed) == set(
+            range(self.scheduled)
+        ) and not (set(self.done) & set(self.failed))
+
+    def leaked_blocks(self) -> int:
+        """Fleet-wide refcount hygiene over every engine that reported
+        (a SIGKILLed replica's pool died with its process — nothing to
+        leak into)."""
+        return int(sum(
+            s.get("leaked_blocks", 0) for s in self.replica_stats.values()
+        ))
+
+    def prefix_hit_blocks(self) -> int:
+        return int(sum(
+            s.get("prefix_hit_blocks", 0)
+            for s in self.replica_stats.values()
+        ))
+
+    def tokens(self) -> int:
+        return sum(len(ids) for ids in self.done.values())
+
+    def counts(self) -> dict:
+        """The identity the Records gate:
+        done + failed + rerouted == scheduled (done/failed count the
+        DIRECT outcomes; a rerouted rid lands in ``rerouted`` whatever
+        its second act was)."""
+        done_direct = len(set(self.done) - self.rerouted)
+        failed_direct = len(set(self.failed) - self.rerouted)
+        return {
+            "done": done_direct,
+            "failed": failed_direct,
+            "rerouted": len(self.rerouted),
+            "done_total": len(self.done),
+            "failed_total": len(self.failed),
+        }
+
+
+class ReplicaManager:
+    """Spawns, routes to, watches, drains, and settles a replica fleet
+    (module docstring has the fail-over state machine)."""
+
+    def __init__(
+        self,
+        n: int,
+        *,
+        base_env: dict,
+        work_dir: str,
+        child_cfg: dict,
+        device_slices: list[list[int]],
+        sp: int,
+        tp: int,
+        policy: str = "prefix",
+        route_blocks: int = 2,
+        vnodes: int = 64,
+        watchdog_s: float = 120.0,
+        warm: list | None = None,
+        retry_policy=None,
+    ):
+        if n < 1:
+            raise ValueError(f"replicas must be >= 1, got {n}")
+        if len(device_slices) < n:
+            raise ValueError(
+                f"{n} replicas need {n} device slices, got "
+                f"{len(device_slices)}"
+            )
+        self.n = n
+        self.base_env = dict(base_env)
+        self.work_dir = work_dir
+        self.child_cfg = dict(child_cfg)
+        self.device_slices = [list(s) for s in device_slices[:n]]
+        self.sp, self.tp = sp, tp
+        self.watchdog_s = watchdog_s
+        self.warm = warm or []
+        self.retry_policy = retry_policy or rt.RetryPolicy(
+            max_attempts=2, backoff_base_s=0.1
+        )
+        self.router = Router(
+            [str(r) for r in range(n)],
+            block_len=int(child_cfg["block_len"]),
+            policy=policy,
+            route_blocks=route_blocks,
+            vnodes=vnodes,
+        )
+        self.inbox: queue.Queue = queue.Queue()
+        self.handles: dict[str, ReplicaHandle] = {}
+        self.spawn_retries = 0
+        self.drains = 0
+
+    # -- lifecycle -------------------------------------------------------
+
+    def _spawn_one(self, r: int) -> ReplicaHandle:
+        from tpu_patterns import obs
+        from tpu_patterns.exec import proc as _proc
+
+        rid = str(r)
+        os.makedirs(self.work_dir, exist_ok=True)
+        stderr_path = os.path.join(self.work_dir, f"replica-{rid}.log")
+        attempts = {"n": 0}
+
+        def attempt():
+            attempts["n"] += 1
+            # fault site: before the process spawn — an ``error`` here
+            # is a failed exec/fork, retried under the replica policy
+            faults.inject("replica.spawn", replica=rid)
+            stderr_f = open(stderr_path, "ab")
+            try:
+                return _proc.popen_in_group(
+                    [*_proc.python_argv(), "-m", "tpu_patterns"],
+                    env={**self.base_env, ENV_FLAG: "1"},
+                    stdin=subprocess.PIPE,
+                    stdout=subprocess.PIPE,
+                    stderr=stderr_f,
+                    text=True,
+                )
+            finally:
+                stderr_f.close()
+
+        proc = faults.call_with_retry(
+            attempt,
+            policy=self.retry_policy,
+            site="replica.spawn",
+            retry_on=(OSError,),
+        )
+        self.spawn_retries += attempts["n"] - 1
+        obs.counter(
+            "tpu_patterns_replica_spawns_total", replica=rid
+        ).inc()
+        handle = ReplicaHandle(rid, proc, self.inbox)
+        handle.send({
+            "op": "init", "replica": rid,
+            "devices": self.device_slices[r],
+            "sp": self.sp, "tp": self.tp,
+            "cfg": self.child_cfg,
+            "snapshot_dir": os.path.join(
+                self.work_dir, f"replica-{rid}-snap"
+            ),
+            "warm": self.warm,
+        })
+        return handle
+
+    def spawn_all(self) -> None:
+        """Spawn every replica, then await all ready handshakes — the
+        N inits (JAX import, backend, compile warm-up) run in
+        PARALLEL, which is the entire point of process replicas."""
+        for r in range(self.n):
+            self.handles[str(r)] = self._spawn_one(r)
+        waiting = set(self.handles)
+        deadline = clock_ns() + int(READY_TIMEOUT_S * 1e9)
+        while waiting:
+            timeout = max(0.05, (deadline - clock_ns()) / 1e9)
+            try:
+                rid, msg = self.inbox.get(timeout=timeout)
+            except queue.Empty:
+                raise ReplicaError(
+                    f"replica(s) {sorted(waiting)} not ready within "
+                    f"{READY_TIMEOUT_S:.0f}s — see "
+                    f"{self.work_dir}/replica-*.log"
+                ) from None
+            if msg.get("ready") is True and rid in waiting:
+                self.handles[rid].state = "ready"
+                self.handles[rid].last_msg_ns = clock_ns()
+                waiting.discard(rid)
+            elif msg.get("ready") is False or msg.get("op") == "_eof":
+                raise ReplicaError(
+                    f"replica {rid} failed init: "
+                    f"{msg.get('error', 'died before ready')} — see "
+                    f"{self.work_dir}/replica-{rid}.log"
+                )
+
+    def shutdown(self) -> None:
+        for h in self.handles.values():
+            try:
+                h.send({"op": "shutdown"})
+            except ReplicaError:
+                pass  # already dead: the kill below settles it
+        for h in self.handles.values():
+            h.kill()
+
+    # -- fail-over -------------------------------------------------------
+
+    def _live(self) -> list[ReplicaHandle]:
+        return [
+            h for h in self.handles.values() if h.state == "ready"
+        ]
+
+    def _settle_leases(self, h: ReplicaHandle, res: FleetResult) -> None:
+        """Release EVERY lease the replica held and reroute it, plus
+        every row it tentatively failed while going down — the no-leak
+        half of fail-over (the rt property tests pin the table empties
+        here)."""
+        redo = dict(h.leases.release_all())
+        for rid in h.tentative_failed:
+            redo.setdefault(rid, None)
+        h.tentative_failed = {}
+        for rid, meta in sorted(redo.items()):
+            req = meta if isinstance(meta, Request) else None
+            self._reroute(rid, req, res)
+
+    def _reroute(self, rid: int, req, res: FleetResult) -> None:
+        from tpu_patterns import obs
+
+        if req is None:
+            req = res.requests_by_rid.get(rid)
+        if req is None or rid in res.done or rid in res.failed:
+            return
+        if rid in res.rerouted:
+            # reroute budget spent: a request that failed over twice is
+            # deterministically broken, not unlucky
+            res.failed[rid] = (
+                "rerouted replica failed too — reroute budget spent"
+            )
+            return
+        res.rerouted.add(rid)
+        try:
+            target = self.router.fallback(rid, req.tokens)
+        except RuntimeError as e:
+            res.failed[rid] = str(e)
+            return
+        h = self.handles[target]
+        if h.state != "ready":
+            # the survivor already finished its run (a drain handback
+            # raced the fin round): fail loudly, never strand silently
+            res.failed[rid] = (
+                f"no serving replica left to reroute to "
+                f"(survivor {target} already finished)"
+            )
+            return
+        try:
+            h.leases.acquire(rid, meta=req)
+            h.send(_req_msg(req))
+        except ReplicaError:
+            self._replica_down(h, "send failed mid-reroute", res)
+        obs.event("replica.reroute", rid=str(rid), replica=target)
+
+    def _quarantine(self, h: ReplicaHandle, res: FleetResult) -> None:
+        """Parent-side breaker opened on ``h``: out of the ring, then
+        DRAIN — or, if it will not even take the drain, the hammer."""
+        from tpu_patterns import obs
+
+        if h.state != "ready":
+            return
+        h.state = "quarantined"
+        self.router.quarantine(h.id)
+        obs.counter(
+            "tpu_patterns_replica_quarantines_total", replica=h.id
+        ).inc()
+        obs.event("replica.quarantine", replica=h.id)
+        try:
+            # fault site: the drain request itself — ``error`` means an
+            # unresponsive replica, which is handled exactly like death
+            faults.inject("replica.drain", replica=h.id)
+            h.send({"op": "drain"})
+        except (faults.InjectedFault, ReplicaError):
+            h.state = "dead"
+            h.kill()
+            self._settle_leases(h, res)
+            return
+        # the rows it already failed reroute NOW; rows still in flight
+        # keep their leases until the drained handback (or EOF)
+        # settles them — so the fleet loop cannot exit under a drain
+        redo = dict(h.tentative_failed)
+        h.tentative_failed = {}
+        for rid in sorted(redo):
+            self._reroute(rid, None, res)
+
+    def _replica_down(
+        self, h: ReplicaHandle, why: str, res: FleetResult
+    ) -> None:
+        """Unexpected death (or hang): kill the corpse's group, settle
+        its ledger, and have the survivors checkpoint — at most one
+        step of fleet progress is now unbanked."""
+        from tpu_patterns import obs
+
+        if h.state in ("dead", "drained"):
+            return
+        h.state = "dead"
+        self.router.quarantine(h.id)
+        h.kill()
+        obs.counter(
+            "tpu_patterns_replica_failovers_total", replica=h.id
+        ).inc()
+        obs.event("replica.down", replica=h.id, why=why)
+        self._settle_leases(h, res)
+        for s in list(self._live()):
+            try:
+                faults.inject("replica.drain", replica=s.id)
+                s.send({"op": "checkpoint"})
+            except (faults.InjectedFault, ReplicaError):
+                self._replica_down(s, "checkpoint request failed", res)
+
+    # -- the fleet loop --------------------------------------------------
+
+    def serve(
+        self, timed: list[tuple[float, Request]]
+    ) -> FleetResult:
+        """Serve ``timed`` [(arrival_s, request)] to settlement: every
+        rid ends in done or failed, whatever the replicas do."""
+        res = FleetResult(
+            scheduled=len(timed),
+            requests_by_rid={r.rid: r for _, r in timed},
+        )
+        pending = collections.deque(
+            sorted(timed, key=lambda ar: (ar[0], ar[1].rid))
+        )
+        res.t0_ns = t0 = clock_ns()
+
+        def outstanding() -> int:
+            return sum(len(h.leases) for h in self.handles.values())
+
+        while pending or outstanding():
+            now_s = (clock_ns() - t0) / 1e9
+            while pending and pending[0][0] <= now_s:
+                _, req = pending.popleft()
+                self._dispatch(req, res)
+            if not pending and not outstanding():
+                break
+            wait = 0.25
+            if pending:
+                wait = min(wait, max(pending[0][0] - now_s, 0.0) + 1e-3)
+            try:
+                rid, msg = self.inbox.get(timeout=wait)
+            except queue.Empty:
+                self._check_watchdogs(res)
+                continue
+            self._handle(rid, msg, res)
+            if not self.router.live() and (pending or outstanding()):
+                # the whole fleet is gone: settle what remains as
+                # failed so the accounting identity still closes
+                for r in res.requests_by_rid:
+                    if r not in res.done and r not in res.failed:
+                        res.failed[r] = "no live replica left"
+                pending.clear()
+                break
+        self._finish(res)
+        res.wall_s = (clock_ns() - t0) / 1e9
+        res.drains = self.drains
+        res.spawn_retries = self.spawn_retries
+        res.router_routed = self.router.routed
+        res.router_prefix_hits = self.router.prefix_hits
+        res.router_reroutes = self.router.reroutes
+        return res
+
+    def _dispatch(self, req: Request, res: FleetResult) -> None:
+        try:
+            target = self.router.route(req.rid, req.tokens)
+        except faults.InjectedFault:
+            # the routing decision itself faulted: fall back to any
+            # live replica, counted as a reroute
+            try:
+                target = self.router.fallback(req.rid, req.tokens)
+            except RuntimeError as e:
+                res.failed[req.rid] = str(e)
+                return
+        except RuntimeError as e:
+            res.failed[req.rid] = str(e)
+            return
+        h = self.handles[target]
+        try:
+            h.leases.acquire(req.rid, meta=req)
+            h.send(_req_msg(req))
+        except ReplicaError:
+            self._replica_down(h, "send failed", res)
+
+    def _handle(self, rid: str, msg: dict, res: FleetResult) -> None:
+        h = self.handles.get(rid)
+        if h is None:
+            return
+        h.last_msg_ns = clock_ns()
+        op = msg.get("op")
+        if op == "done":
+            r = int(msg["rid"])
+            h.leases.release(r)
+            if r not in res.done and r not in res.failed:
+                res.done[r] = [int(t) for t in msg["ids"]]
+                res.t_done_ns[r] = clock_ns()
+            h.breaker.success()
+        elif op == "failed":
+            r = int(msg["rid"])
+            h.leases.release(r)
+            if h.state != "ready":
+                # a known-sick replica's failures reroute, not finalize
+                self._reroute(r, None, res)
+                return
+            # hold the verdict: if this replica turns out to be sick
+            # (breaker opens / dies), its failures reroute instead —
+            # tentative rows finalize as failed only once the replica
+            # proves healthy (run end) or the reroute budget is spent
+            h.tentative_failed[r] = str(msg.get("reason", "failed"))
+            if h.breaker.failure():
+                self._quarantine(h, res)
+        elif op in ("drained", "quarantined"):
+            from tpu_patterns import obs
+
+            if msg.get("snapshot_step", -1) is not None and msg.get(
+                "snapshot_step", -1
+            ) >= 0:
+                h.snapshotted = True
+                self.drains += 1
+                obs.counter(
+                    "tpu_patterns_replica_drains_total",
+                    replica=h.id, mode="drain",
+                ).inc()
+            if op == "quarantined":
+                # the child's engine breaker tripped: book it in THE
+                # PARENT registry — the child's own counters die with
+                # its process and never reach the run's metrics dump
+                obs.counter(
+                    "tpu_patterns_replica_breaker_trips_total"
+                ).inc()
+            h.stats = msg.get("stats") or {}
+            res.replica_stats[h.id] = h.stats
+            if h.state == "ready":
+                # child self-quarantined (its engine breaker tripped)
+                # before the parent's breaker saw enough failures
+                self.router.quarantine(h.id)
+            h.state = "drained"
+            self._settle_leases(h, res)
+        elif op == "checkpointed":
+            from tpu_patterns import obs
+
+            h.snapshotted = True
+            self.drains += 1
+            # parent-side mirror of the child's checkpoint counter
+            # (same reason as breaker trips: child registries are
+            # invisible to the run's dump)
+            obs.counter(
+                "tpu_patterns_replica_drains_total",
+                replica=h.id, mode="checkpoint",
+            ).inc()
+        elif op == "fin":
+            h.stats = msg.get("stats") or {}
+            res.replica_stats[h.id] = h.stats
+            h.state = "done"
+        elif op in ("_eof", "_garbled"):
+            if h.state in ("done", "drained"):
+                return
+            self._replica_down(h, op.strip("_"), res)
+        # hb / checkpointed: the timestamp update above is the point
+
+    def _check_watchdogs(self, res: FleetResult) -> None:
+        now = clock_ns()
+        watchdog_ns = int(self.watchdog_s * 1e9)
+        for h in list(self.handles.values()):
+            if h.state != "ready":
+                continue
+            if not h.alive():
+                self._replica_down(h, "process exited", res)
+            elif (
+                len(h.leases)
+                and now - h.last_msg_ns > watchdog_ns
+            ):
+                self._replica_down(h, "watchdog: no heartbeat", res)
+
+    def _finalize_tentative(self, res: FleetResult) -> None:
+        """Failures on replicas that stayed healthy are genuine request
+        failures — finalize them so the accounting identity closes."""
+        for h in self.handles.values():
+            for rid, reason in h.tentative_failed.items():
+                if rid not in res.done and rid not in res.failed:
+                    res.failed[rid] = reason
+            h.tentative_failed = {}
+
+    def _finish(self, res: FleetResult) -> None:
+        """All leases settled: collect final stats from live replicas
+        and any still-pending drain handbacks, then finalize."""
+        waiting = set()
+        for h in self._live():
+            try:
+                h.send({"op": "fin"})
+                waiting.add(h.id)
+            except ReplicaError:
+                self._replica_down(h, "send failed at fin", res)
+        # a quarantined replica's drained message may still be in
+        # flight — its stats (leaked_blocks!) must land before verdict
+        waiting |= {
+            h.id for h in self.handles.values()
+            if h.state == "quarantined"
+        }
+        deadline = clock_ns() + int(60e9)
+        while waiting and clock_ns() < deadline:
+            try:
+                rid, msg = self.inbox.get(timeout=1.0)
+            except queue.Empty:
+                for r in list(waiting):
+                    if not self.handles[r].alive():
+                        self._replica_down(
+                            self.handles[r], "died before fin", res
+                        )
+                        waiting.discard(r)
+                continue
+            self._handle(rid, msg, res)
+            h = self.handles.get(rid)
+            if h is not None and h.state in ("done", "dead", "drained"):
+                waiting.discard(rid)
+        self._finalize_tentative(res)
+
+
+def _req_msg(req: Request) -> dict:
+    return {
+        "op": "req", "rid": req.rid, "tokens": list(req.tokens),
+        "n_gen": req.n_gen, "deadline_ms": req.deadline_ms,
+    }
+
+
+# -- measured patterns -----------------------------------------------------
+
+
+def _goodput(res: FleetResult) -> float:
+    """Router-side goodput-under-SLO: the fraction of generated tokens
+    from requests whose scheduled-arrival -> last-token wall time met
+    their deadline (0-deadline requests always meet it).  Measured at
+    the FRONT DOOR, so replica queueing, rerouting, and fail-over
+    stalls all count — the latency the user felt."""
+    total = sum(r.n_gen for r in res.requests_by_rid.values())
+    if not total:
+        return 0.0
+    good = 0
+    for rid, ids in res.done.items():
+        req = res.requests_by_rid[rid]
+        if req.deadline_ms <= 0:
+            good += len(ids)
+            continue
+        # arrival offsets were encoded into dispatch times by the
+        # manager's pacing loop; t0 is the fleet clock zero
+        e2e_ms = (res.t_done_ns[rid] - res.t0_ns) / 1e6 - (
+            res.arrival_ms.get(rid, 0.0)
+        )
+        if e2e_ms <= req.deadline_ms:
+            good += len(ids)
+    return good / total
+
+
+def run_replicas(mesh, cfg, writer) -> list:
+    """The ``serve --replicas N`` measured patterns.
+
+    Plain trace: the fleet serves :func:`engine.random_trace` and
+    banks the scaling/fail-over Record — coverage identity
+    (done + failed + rerouted == scheduled), fleet-wide
+    ``leaked_blocks == 0``, completed ids bit-identical to per-request
+    dense decode, and (with ``min_replica_speedup`` > 0) aggregate
+    tokens/s over N replicas >= the gate x ONE replica on the same
+    slice size.
+
+    With ``--scenario``: the same fleet serves the scenario schedule
+    under BOTH router policies and banks the routing-comparison Record
+    — prefix-aware routing must beat round-robin on fleet-wide
+    ``prefix_hit_blocks`` and front-door goodput.
+    """
+    import tempfile
+
+    import jax  # noqa: F401  (parent backend is already up)
+
+    from tpu_patterns import obs
+    from tpu_patterns.core.results import Record, Verdict
+    from tpu_patterns.models.lm import init_lm_params
+    from tpu_patterns.models.transformer import ModelConfig, _n_experts
+    from tpu_patterns.serve.engine import (
+        _dense_expected,
+        _serve_commands,
+        random_trace,
+    )
+    from tpu_patterns.topo import placement, topology
+
+    n = int(cfg.replicas)
+    if n < 1:
+        raise ValueError(f"replicas must be >= 1, got {n}")
+    if cfg.replica_policy not in Router.POLICIES:
+        raise ValueError(
+            f"unknown replica_policy {cfg.replica_policy!r} "
+            f"(want one of {Router.POLICIES})"
+        )
+    flat = [d for d in np.asarray(mesh.devices).flat]
+    tp = int(mesh.shape["tp"])
+    per = len(flat) // n
+    if per < 1 or per % tp:
+        raise ValueError(
+            f"{len(flat)} devices / {n} replicas = {per} per replica, "
+            f"which must be a positive multiple of tp={tp}"
+        )
+    child_sp = per // tp
+    topo_obj = topology.discover(flat)
+    slices = placement.partition_devices(
+        n, topo_obj, devices_per_group=per
+    )
+
+    mcfg = ModelConfig(
+        embed=cfg.embed, heads=cfg.heads, head_dim=cfg.head_dim,
+        mlp_mult=cfg.mlp_mult, causal=True, dtype=cfg.dtype,
+        depth=cfg.depth, kv_heads=cfg.kv_heads, rope=cfg.rope,
+    )
+    flat_params = init_lm_params(
+        jax.random.key(cfg.seed), mcfg, cfg.vocab, _n_experts(mesh, mcfg)
+    )
+    sp_parent = int(mesh.shape["sp"])
+
+    prefix_share = cfg.prefix_share
+    if cfg.scenario:
+        from tpu_patterns.loadgen.scenarios import (
+            build_schedule,
+            parse_scenario,
+        )
+
+        spec = parse_scenario(cfg.scenario)
+        schedule = build_schedule(
+            spec, vocab=cfg.vocab, seed=cfg.seed,
+            time_scale=cfg.time_scale,
+        )
+        timed = [(tr.arrival_s, tr.request) for tr in schedule]
+        max_len = spec.max_prompt + spec.max_gen
+        oracle_cfg = dataclasses.replace(
+            cfg, max_prompt=spec.max_prompt, gen=spec.max_gen
+        )
+        if not prefix_share:
+            # the routing comparison is ABOUT the prefix cache: without
+            # engine-side sharing there are no hit blocks to win
+            prefix_share = True
+            writer.progress(
+                "serve --replicas --scenario: enabling --prefix_share "
+                "(the routing-comparison Record measures cache hits)"
+            )
+    else:
+        spec = None
+        timed = [(0.0, r) for r in random_trace(cfg)]
+        max_len = cfg.max_prompt + cfg.gen
+        oracle_cfg = cfg
+
+    per_row = -(-max_len // cfg.block_len)
+    n_blocks = cfg.n_blocks or (cfg.slots * per_row + 1)
+    child_cfg = {
+        "vocab": cfg.vocab, "embed": cfg.embed, "heads": cfg.heads,
+        "head_dim": cfg.head_dim, "mlp_mult": cfg.mlp_mult,
+        "depth": cfg.depth, "dtype": cfg.dtype, "rope": cfg.rope,
+        "kv_heads": cfg.kv_heads, "cache_int8": cfg.cache_int8,
+        "slots": cfg.slots, "block_len": cfg.block_len,
+        "n_blocks": n_blocks, "max_len": max_len, "seed": cfg.seed,
+        "prefix_share": prefix_share, "spec_k": cfg.spec_k,
+        "watchdog_s": cfg.watchdog_s,
+    }
+    # warm every executable bucket the trace will touch BEFORE timing:
+    # a slice of the real trace, generation capped so warm-up is cheap
+    warm = [
+        [list(r.tokens), min(r.n_gen, 4)]
+        for _, r in timed[: min(len(timed), 2 * cfg.slots)]
+    ]
+    work_root = cfg.replica_dir or tempfile.mkdtemp(
+        prefix="tpu_patterns_replicas_"
+    )
+    base_env = dict(os.environ)
+    route_blocks = cfg.route_blocks or 2
+
+    def fleet(n_replicas: int, policy: str, tag: str) -> FleetResult:
+        mgr = ReplicaManager(
+            n_replicas,
+            base_env=base_env,
+            work_dir=os.path.join(work_root, tag),
+            child_cfg=child_cfg,
+            device_slices=slices,
+            sp=child_sp, tp=tp,
+            policy=policy,
+            route_blocks=route_blocks,
+            watchdog_s=cfg.replica_watchdog_s,
+            warm=warm,
+        )
+        writer.progress(
+            f"fleet[{tag}]: spawning {n_replicas} replica(s) x "
+            f"{per} devices (sp{child_sp} x tp{tp}), policy={policy}"
+        )
+        with obs.span(
+            "serve.fleet", replicas=n_replicas, policy=policy
+        ):
+            # spawn_all inside the try: a mid-startup failure (ready
+            # timeout, quarantined spawn) must still kill the replicas
+            # that DID spawn, not orphan their engine processes
+            try:
+                mgr.spawn_all()
+                res = mgr.serve(timed)
+            finally:
+                mgr.shutdown()
+        # arrival offsets for front-door goodput
+        res.arrival_ms = {
+            r.rid: a * 1e3 for a, r in timed
+        }
+        writer.progress(
+            f"fleet[{tag}]: {res.counts()} in {res.wall_s:.2f}s "
+            f"({res.tokens() / res.wall_s if res.wall_s else 0:.1f} "
+            "tok/s)"
+        )
+        return res
+
+    def exactness(res: FleetResult, want: dict | None = None):
+        reqs = [
+            res.requests_by_rid[rid] for rid in sorted(res.done)
+        ]
+        if not reqs:
+            return 0.0, []
+        if want is None:
+            want = _dense_expected(
+                mesh, sp_parent, mcfg, oracle_cfg, flat_params, reqs
+            )
+        bad = [
+            r.rid for r in reqs if res.done[r.rid] != want[r.rid]
+        ]
+        return (0.0 if bad else 1.0), bad
+
+    if spec is not None:
+        # -- routing-comparison Record (chat preset, both policies) --
+        res_p = fleet(n, "prefix", "prefix")
+        res_r = fleet(n, "round_robin", "rr")
+        # the oracle depends on the requests, not the routing policy:
+        # ONE dense decode of the schedule serves both legs
+        want_all = _dense_expected(
+            mesh, sp_parent, mcfg, oracle_cfg, flat_params,
+            [r for _, r in timed],
+        )
+        exact_p, bad_p = exactness(res_p, want_all)
+        exact_r, bad_r = exactness(res_r, want_all)
+        good_p, good_r = _goodput(res_p), _goodput(res_r)
+        phb_p = res_p.prefix_hit_blocks()
+        phb_r = res_r.prefix_hit_blocks()
+        ok = (
+            res_p.covered() and res_r.covered()
+            and exact_p == 1.0 and exact_r == 1.0
+            and res_p.leaked_blocks() == 0
+            and res_r.leaked_blocks() == 0
+            and phb_p > phb_r
+            and good_p >= good_r
+        )
+        rec = Record(
+            pattern="serve",
+            mode=f"router_{spec.name}_r{n}_sp{child_sp}",
+            commands=(
+                f"{cfg.scenario} | {n} replicas x sp{child_sp}tp{tp}"
+            ),
+            metrics={
+                "requests": float(len(timed)),
+                "goodput_prefix": round(good_p, 4),
+                "goodput_round_robin": round(good_r, 4),
+                "prefix_hit_blocks_prefix": float(phb_p),
+                "prefix_hit_blocks_round_robin": float(phb_r),
+                "router_prefix_hits": float(res_p.router_prefix_hits),
+                "exact": float(exact_p == 1.0 and exact_r == 1.0),
+                "done_prefix": float(len(res_p.done)),
+                "done_round_robin": float(len(res_r.done)),
+                "failed": float(
+                    len(res_p.failed) + len(res_r.failed)
+                ),
+                "reroutes": float(
+                    res_p.router_reroutes + res_r.router_reroutes
+                ),
+                "leaked_blocks": float(
+                    res_p.leaked_blocks() + res_r.leaked_blocks()
+                ),
+            },
+            verdict=Verdict.SUCCESS if ok else Verdict.FAILURE,
+        )
+        if not (phb_p > phb_r):
+            rec.notes.append(
+                f"prefix-aware routing hit {phb_p} prefix blocks vs "
+                f"round-robin's {phb_r} — affinity routing bought "
+                "nothing on this trace"
+            )
+        if good_p < good_r:
+            rec.notes.append(
+                f"goodput {good_p:.3f} under prefix routing < "
+                f"{good_r:.3f} under round-robin"
+            )
+        for tag, bad in (("prefix", bad_p), ("round_robin", bad_r)):
+            if bad:
+                rec.notes.append(
+                    f"exactness FAILED under {tag} routing for "
+                    f"request(s) {bad[:8]}"
+                )
+        writer.record(rec)
+        return [rec]
+
+    # -- scaling / fail-over Record (plain trace) --------------------
+    res_n = fleet(n, cfg.replica_policy, f"fleet{n}")
+    counts = res_n.counts()
+    exact, bad = exactness(res_n)
+    agg_tps = res_n.tokens() / res_n.wall_s if res_n.wall_s else 0.0
+
+    single_tps, speedup = -1.0, -1.0
+    if cfg.min_replica_speedup > 0 and n > 1:
+        res_1 = fleet(1, cfg.replica_policy, "fleet1")
+        single_tps = (
+            res_1.tokens() / res_1.wall_s if res_1.wall_s else 0.0
+        )
+        speedup = agg_tps / single_tps if single_tps > 0 else 0.0
+
+    leaked = res_n.leaked_blocks()
+    covered = res_n.covered()
+    obs.gauge("tpu_patterns_replica_fleet_tokens_per_s").set(agg_tps)
+    ok = covered and exact == 1.0 and leaked == 0
+    if speedup >= 0:
+        ok = ok and speedup >= cfg.min_replica_speedup
+    healed = bool(
+        counts["rerouted"] or counts["failed"] or res_n.drains
+        or res_n.spawn_retries
+    )
+    verdict = Verdict.SUCCESS if ok else Verdict.FAILURE
+    if ok and healed:
+        verdict = Verdict.WARNING  # recovered, but not unscathed
+    rec = Record(
+        pattern="serve",
+        mode=f"replicas{n}_sp{child_sp}_tp{tp}",
+        commands=_serve_commands(cfg) + f" x{n} replicas",
+        metrics={
+            "scheduled": float(res_n.scheduled),
+            "done": float(counts["done"]),
+            "failed": float(counts["failed"]),
+            "rerouted": float(counts["rerouted"]),
+            "done_total": float(counts["done_total"]),
+            "covered": float(covered),
+            "exact": exact,
+            "leaked_blocks": float(leaked),
+            "aggregate_tokens_per_s": round(agg_tps, 1),
+            "single_replica_tokens_per_s": round(single_tps, 1),
+            "replica_speedup": round(speedup, 3),
+            "reroutes": float(res_n.router_reroutes),
+            "drains": float(res_n.drains),
+            "spawn_retries": float(res_n.spawn_retries),
+            "prefix_hit_blocks": float(res_n.prefix_hit_blocks()),
+            "tokens": float(res_n.tokens()),
+        },
+        verdict=verdict,
+    )
+    if not covered:
+        missing = sorted(
+            set(res_n.requests_by_rid)
+            - set(res_n.done) - set(res_n.failed)
+        )
+        rec.notes.append(
+            f"coverage identity broken: request(s) {missing[:8]} "
+            "neither completed nor failed — "
+            "done + failed + rerouted must equal scheduled"
+        )
+    if bad:
+        rec.notes.append(
+            f"exactness FAILED for request(s) {bad[:8]}: ids diverged "
+            "from per-request dense decode after fleet serving"
+        )
+    if leaked:
+        rec.notes.append(
+            f"{leaked} block(s) leaked fleet-wide — refcount "
+            "bookkeeping broke in a surviving engine"
+        )
+    if 0 <= speedup < cfg.min_replica_speedup:
+        rec.notes.append(
+            f"aggregate speedup {speedup:.2f}x < "
+            f"{cfg.min_replica_speedup}x gate over one replica on the "
+            "same slice size"
+        )
+    for rid in sorted(res_n.failed)[:8]:
+        rec.notes.append(
+            f"request {rid} FAILED: {res_n.failed[rid]}"
+        )
+    writer.record(rec)
+    return [rec]

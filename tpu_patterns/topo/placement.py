@@ -90,6 +90,48 @@ def select_devices(
     return [order[i % len(order)] for i in range(num)]
 
 
+def partition_devices(
+    n_groups: int,
+    topo: Topology | None = None,
+    mode: PlacementMode = PlacementMode.COMPACT,
+    devices_per_group: int | None = None,
+) -> list[list[int]]:
+    """DISJOINT device-index slices for ``n_groups`` independent
+    replicas: the mode's ordering, cut into contiguous equal runs.
+
+    This is the fleet form of the reference's rank->tile binding: under
+    ``compact``/``plan`` a group's devices are coordinate- (or ring-)
+    adjacent — each replica owns a co-located plane of the fabric and
+    its collectives stay one hop — while ``spread`` deals round-robin
+    (each replica sees every chip; maximum per-replica bandwidth, no
+    locality).  Unlike :func:`select_devices`, groups never overlap:
+    replicas are failure DOMAINS, and a shared device would couple
+    them.
+    """
+    if n_groups < 1:
+        raise ValueError(f"n_groups must be >= 1, got {n_groups}")
+    topo = topo or discover()
+    order = order_devices(topo, mode)
+    per = (
+        devices_per_group
+        if devices_per_group is not None
+        else len(order) // n_groups
+    )
+    if per < 1:
+        raise ValueError(
+            f"{len(order)} devices cannot give {n_groups} groups at "
+            "least one device each"
+        )
+    if n_groups * per > len(order):
+        raise ValueError(
+            f"{n_groups} groups x {per} devices = {n_groups * per} > "
+            f"{len(order)} available — replica slices must be disjoint"
+        )
+    return [
+        order[g * per : (g + 1) * per] for g in range(n_groups)
+    ]
+
+
 def make_mesh(
     axis_names: Sequence[str] = ("x",),
     shape: Sequence[int] | None = None,
