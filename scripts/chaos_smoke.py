@@ -41,7 +41,15 @@ heals itself, visibly:
       when the failure domain shrinks), and exit 0; a second leg
       replaces the kill with REPEATED step errors on replica 1 — its
       breaker opens, the parent drains it to a snapshot, and its
-      pending rows reroute to the survivor.
+      pending rows reroute to the survivor.  BOTH legs additionally
+      gate the fleet timeline (PR 13): the shipped child metrics must
+      reproduce the accounting identity on their own
+      (``fleet_consistent``, zero mirror mismatches; on the drain leg
+      ``0 < fleet_shipped_failed <= failed + rerouted`` — queued rows
+      rerouted at drain were never wave-quarantined child-side), and
+      the merged Chrome trace (``obs fleet``) must contain >= 2
+      replica process lanes with at least one rerouted request's
+      journey stitched as ONE flow spanning both replicas.
 
 Zero dependencies beyond the package; exit 0 = pass.
 """
@@ -309,7 +317,9 @@ def main() -> int:
         jsonl = os.path.join(work, f"{tag}.jsonl")
         rc = _run(
             tag,
-            [*py, "--jsonl", jsonl, "serve", "--dp", "1", "--tp", "2",
+            [*py, "--jsonl", jsonl,
+             "--obs-dir", os.path.join(snap_dir, "obs"), "--obs-dump",
+             "serve", "--dp", "1", "--tp", "2",
              "--vocab", "64", "--embed", "64", "--head_dim", "8",
              "--depth", "1", "--requests", "8", "--min_prompt", "4",
              "--max_prompt", "16", "--gen", "8", "--slots", "4",
@@ -322,6 +332,49 @@ def main() -> int:
             return None
         with open(jsonl) as f:
             return [json.loads(ln) for ln in f if ln.strip()][-1]
+
+    def fleet_trace_gates(tag: str, snap_dir: str):
+        """Merge the leg's fleet dumps and require: >= 2 replica
+        process lanes, and a rerouted journey stitched as one flow
+        whose anchors span BOTH replica processes."""
+        obs_dir = os.path.join(snap_dir, "obs")
+        trace_out = os.path.join(snap_dir, "fleet_trace.json")
+        rc = _run(
+            f"{tag}-trace",
+            [*py, "obs", "fleet", obs_dir, "--chrome-trace", trace_out],
+            _env(),
+        )
+        if rc != 0:
+            return f"{tag}: obs fleet exited nonzero"
+        with open(trace_out) as f:
+            evs = json.load(f).get("traceEvents", [])
+        pnames = {
+            ev["pid"]: ev["args"]["name"]
+            for ev in evs
+            if ev.get("ph") == "M" and ev.get("name") == "process_name"
+        }
+        replica_pids = {
+            pid for pid, name in pnames.items()
+            if name.startswith("replica ")
+        }
+        if len(replica_pids) < 2:
+            return (f"{tag}: merged trace shows {len(replica_pids)} "
+                    "replica process lane(s); want >= 2")
+        flows: dict = {}
+        for ev in evs:
+            if ev.get("ph") in ("s", "t", "f"):
+                flows.setdefault(ev["id"], set()).add(ev["pid"])
+        stitched = [
+            jid for jid, pids in flows.items()
+            if len(pids & replica_pids) >= 2
+        ]
+        print(f"  [{tag}] merged trace: {sorted(pnames.values())}, "
+              f"{len(flows)} journey flow(s), {len(stitched)} spanning "
+              "both replicas", flush=True)
+        if not stitched:
+            return (f"{tag}: no journey flow spans both replicas — the "
+                    "rerouted request did not stitch")
+        return None
 
     for tag, faults in (
         ("replica-kill",
@@ -365,6 +418,38 @@ def main() -> int:
         if tag == "replica-kill" and not m.get("spawn_retries", 0) > 0:
             return fail("replica-kill: the injected spawn fault never "
                         "forced a respawn retry")
+        # fleet-metrics identity: the shipped child metrics alone must
+        # reproduce the front door's ledger, and the PR-12 parent
+        # mirrors must agree with the shipped truth
+        if m.get("fleet_consistent") != 1.0:
+            return fail(
+                f"{tag}: shipped child metrics "
+                f"(fleet_shipped_done={m.get('fleet_shipped_done')}) "
+                f"did not reproduce done_total={m.get('done_total')}"
+            )
+        if m.get("mirror_mismatches") != 0.0:
+            return fail(f"{tag}: {m.get('mirror_mismatches')} parent "
+                        "mirror(s) disagreed with shipped child metrics")
+        if tag == "replica-drain" and not (
+            0
+            < m.get("fleet_shipped_failed", 0)
+            <= m.get("failed", 0) + m.get("rerouted", 0)
+        ):
+            # every child-side wave quarantine reroutes or finalizes
+            # (upper bound); rows rerouted while still QUEUED on the
+            # drained replica were never wave-quarantined, so equality
+            # is not guaranteed — but the injected step errors must
+            # have left a shipped trail (lower bound)
+            return fail(
+                f"{tag}: shipped quarantine count "
+                f"{m.get('fleet_shipped_failed')} outside (0, failed "
+                f"{m.get('failed')} + rerouted {m.get('rerouted')}] — "
+                "the fault's trail is not reproducible from child "
+                "metrics"
+            )
+        err = fleet_trace_gates(tag, snap_dir)
+        if err:
+            return fail(err)
         snaps = [
             d for d in (
                 os.listdir(os.path.join(snap_dir, "fleet2"))
@@ -383,7 +468,8 @@ def main() -> int:
           "(cell retry, worker fallback, preempt/resume exactness, "
           "verify-fault quarantine + refcount balance, "
           "chaos-under-load coverage + bounded p99, "
-          "replica fail-over: kill + drain legs)",
+          "replica fail-over: kill + drain legs incl. fleet-metric "
+          "identity + stitched cross-replica journeys)",
           flush=True)
     return 0
 

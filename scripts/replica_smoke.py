@@ -20,6 +20,13 @@ Two real-CLI invocations on the simulated 8-device CPU mesh:
       goodput >= round-robin's — PR 7's per-engine prefix-cache win
       made fleet-wide.
 
+The scaling leg also gates the FLEET TIMELINE (PR 13): it runs with
+``--obs-dump``, the Record must show the shipped child metrics
+reproducing the front door's ledger (``fleet_consistent``) with zero
+mirror mismatches, and ``tpu-patterns obs fleet`` over the obs dir
+must produce one merged Chrome trace with >= 2 replica process lanes
+plus the router's.
+
 Zero dependencies beyond the package; exit 0 = pass.
 """
 
@@ -54,9 +61,11 @@ CHAT_SPEC = (
 )
 
 
-def _run_cli(tag: str, jsonl: str, args: list[str], env: dict):
+def _run_cli(tag: str, jsonl: str, args: list[str], env: dict,
+             global_args: list[str] | None = None):
     cmd = [
         sys.executable, "-m", "tpu_patterns", "--jsonl", jsonl,
+        *(global_args or []),
         "serve", "--dp", "1", "--tp", "2", *args,
     ]
     print(f"+ [{tag}]", " ".join(cmd), flush=True)
@@ -85,13 +94,16 @@ def main() -> int:
     env.pop("TPU_PATTERNS_FAULTS", None)
     work = tempfile.mkdtemp(prefix="replica_smoke_")
 
-    # (a) scaling: 2 replicas vs 1 on the same slice size
+    # (a) scaling: 2 replicas vs 1 on the same slice size — with the
+    # obs layer dumping, so (c) below can merge the fleet timeline
+    obs_dir = os.path.join(work, "obs")
     rec = _run_cli(
         "scaling", os.path.join(work, "scaling.jsonl"),
         [*SERVE_ARGS, "--replicas", "2",
          "--min_replica_speedup", str(MIN_SPEEDUP),
          "--replica_dir", os.path.join(work, "scaling")],
         env,
+        global_args=["--obs-dir", obs_dir, "--obs-dump"],
     )
     if rec is None:
         return 1
@@ -130,6 +142,47 @@ def main() -> int:
             f"aggregate speedup {m.get('replica_speedup')} < "
             f"{MIN_SPEEDUP} over one replica at the same slice size"
         )
+    if m.get("fleet_consistent") != 1.0:
+        return fail(
+            "shipped child metrics did not reproduce the front door's "
+            f"ledger (fleet_shipped_done={m.get('fleet_shipped_done')} "
+            f"vs done_total={m.get('done_total')})"
+        )
+    if m.get("mirror_mismatches") != 0.0:
+        return fail(
+            f"{m.get('mirror_mismatches')} parent mirror(s) disagreed "
+            "with the shipped child metrics"
+        )
+
+    # (c) the fleet timeline: merge parent + replica dumps into ONE
+    # Chrome trace and require a process lane per replica + the router
+    trace_out = os.path.join(work, "fleet_trace.json")
+    cmd = [
+        sys.executable, "-m", "tpu_patterns", "obs", "fleet", obs_dir,
+        "--chrome-trace", trace_out,
+    ]
+    print("+ [fleet-trace]", " ".join(cmd), flush=True)
+    if subprocess.run(cmd, env=env, cwd=ROOT).returncode != 0:
+        return fail("obs fleet exited nonzero on the scaling run's dumps")
+    with open(trace_out) as f:
+        trace = json.load(f)
+    pnames = {
+        ev["pid"]: ev["args"]["name"]
+        for ev in trace.get("traceEvents", [])
+        if ev.get("ph") == "M" and ev.get("name") == "process_name"
+    }
+    replica_lanes = [v for v in pnames.values() if v.startswith("replica ")]
+    print(
+        f"replica smoke: merged trace processes={sorted(pnames.values())}",
+        flush=True,
+    )
+    if len(replica_lanes) < 2:
+        return fail(
+            f"merged fleet trace has {len(replica_lanes)} replica "
+            "process lane(s); want >= 2"
+        )
+    if "router" not in pnames.values():
+        return fail("merged fleet trace lost the router's process lane")
 
     # (b) routing: prefix-aware vs round-robin on the shared-prefix
     # chat preset — one invocation banks the comparison Record

@@ -34,6 +34,9 @@ class TestParser:
             ["hlocheck", "--seq", "1024", "--depth", "2"],
             ["obs", "summarize"],
             ["obs", "export", "--chrome-trace", "t.json", "--prom"],
+            ["obs", "fleet", "results/obs"],
+            ["obs", "fleet"],
+            ["obs", "journey", "j1a2b-3"],
             ["doctor", "--watch_jsonl", "w.jsonl"],
             ["perf", "report", "--tp", "2"],
             ["perf", "diff", "--include", "serve.step",

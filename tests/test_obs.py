@@ -559,6 +559,275 @@ class TestSummaries:
         assert "render.me" in out
 
 
+def _write_dump(path, entries, wall_ts, clock_ns_base):
+    """A fake flight-recorder dump: meta header carrying the (wall,
+    monotonic) clock pair the fleet merge aligns processes with."""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(json.dumps({
+            "kind": "meta", "reason": "test", "pid": 1,
+            "capacity": 16, "entries": len(entries), "dropped": 0,
+            "wall_ts": wall_ts, "clock_ns": clock_ns_base,
+        }) + "\n")
+        for e in entries:
+            f.write(json.dumps(e) + "\n")
+
+
+def _span(name, t0, dur, tid, span_id, **attrs):
+    return {"kind": "span", "name": name, "t0_ns": t0, "dur_ns": dur,
+            "span_id": span_id, "parent_id": 0, "depth": 0, "tid": tid,
+            "thread": "t", "attrs": attrs}
+
+
+def _event(name, t0, tid, **attrs):
+    return {"kind": "event", "name": name, "t0_ns": t0, "dur_ns": 0,
+            "span_id": 0, "parent_id": 0, "depth": 0, "tid": tid,
+            "thread": "t", "attrs": attrs}
+
+
+def _fake_fleet_dir(root):
+    """Parent + two replica dirs telling one rerouted-request story:
+    route -> admit/fail @r1 -> reroute -> done @r0, on three different
+    monotonic clocks that only the meta pairs can align."""
+    _write_dump(
+        os.path.join(root, "spans.jsonl"),
+        [
+            _event("journey.route", 100, 77, jid="j1", rid="0",
+                   replica="1"),
+            _event("journey.reroute", 300, 77, jid="j1", rid="0",
+                   replica="0"),
+        ],
+        wall_ts=1000.0, clock_ns_base=0,
+    )
+    _write_dump(
+        os.path.join(root, "replica-0", "spans.jsonl"),
+        [
+            _span("req.queued", 1_000_150, 50, 1_000_000, 5, rid=0,
+                  jid="j1", replica="0"),
+            _span("req.retired", 1_000_400, 0, 1_000_000, 6, rid=0,
+                  jid="j1", replica="0"),
+        ],
+        wall_ts=1000.0, clock_ns_base=1_000_000,
+    )
+    failed = _span("req.failed", 2_000_150, 0, 1_000_000, 5, rid=0,
+                   jid="j1", replica="1")
+    _write_dump(
+        os.path.join(root, "replica-1", "spans.jsonl"),
+        [failed],
+        wall_ts=1000.0, clock_ns_base=2_000_000,
+    )
+    # the shipped copy of the SAME span, still open: the per-process
+    # dedupe must collapse it, closed-beats-open
+    _write_dump(
+        os.path.join(root, "replica-1", "shipped.jsonl"),
+        [{**failed, "open": True}],
+        wall_ts=1000.0, clock_ns_base=2_000_000,
+    )
+
+
+class TestDedupeMultiProcess:
+    def test_dir_dump_and_shipped_batch_collapse(self):
+        # the fleet overlap: a replica's own dump and the shipped copy
+        # of the same ring — closed beats open, first-seen order stable
+        closed = _span("req.failed", 10, 5, 1, 3, rid=0)
+        open_twin = {**closed, "open": True}
+        other = _span("serve.step", 20, 5, 1, 4)
+        out = obs_export.dedupe_entries([open_twin, other, closed])
+        assert out == [closed, other]
+
+    def test_same_ids_from_different_replicas_stay_apart(self):
+        # span ids and monotonic clocks restart per process: identical
+        # (span_id, t0, tid, name) from two replicas are DIFFERENT spans
+        a = {**_span("req.queued", 10, 5, 1, 3, rid=0), "replica": "0"}
+        b = {**_span("req.queued", 10, 5, 1, 3, rid=0), "replica": "1"}
+        assert obs_export.dedupe_entries([a, b]) == [a, b]
+
+
+class TestFleetMerge:
+    def test_merge_aligns_clocks_tags_processes_and_dedupes(
+        self, tmp_path
+    ):
+        from tpu_patterns.obs import fleet as obs_fleet
+
+        _fake_fleet_dir(str(tmp_path))
+        merged, procs = obs_fleet.merge_fleet(str(tmp_path))
+        assert procs[obs_fleet.ROUTER_PID] == "router"
+        assert procs[0] == "replica 0" and procs[1] == "replica 1"
+        # the shipped open twin collapsed into the closed dir-dump span
+        fails = [e for e in merged if e["name"] == "req.failed"]
+        assert len(fails) == 1 and not fails[0].get("open")
+        # three different monotonic clocks, ONE wall-aligned timeline
+        order = [e["name"] for e in merged]
+        assert order == [
+            "journey.route", "req.queued", "req.failed",
+            "journey.reroute", "req.retired",
+        ]
+        assert merged[0]["t0_ns"] == 0  # rebased to the earliest entry
+        assert merged[0]["pid"] == obs_fleet.ROUTER_PID
+        assert fails[0]["pid"] == 1 and fails[0]["replica"] == "1"
+
+    def test_merged_chrome_trace_has_replica_lanes_and_one_flow(
+        self, tmp_path
+    ):
+        from tpu_patterns.obs import fleet as obs_fleet
+
+        _fake_fleet_dir(str(tmp_path))
+        merged, procs = obs_fleet.merge_fleet(str(tmp_path))
+        trace = obs_export.chrome_trace(merged, process_names=procs)
+        evs = trace["traceEvents"]
+        pnames = {
+            ev["pid"]: ev["args"]["name"]
+            for ev in evs
+            if ev["ph"] == "M" and ev["name"] == "process_name"
+        }
+        assert pnames[0] == "replica 0" and pnames[1] == "replica 1"
+        assert pnames[obs_fleet.ROUTER_PID] == "router"
+        # the lane-collision fix: both replicas restart rids at 0, the
+        # merged lanes qualify the window by replica id
+        lanes = {
+            (ev["pid"], ev["args"]["name"])
+            for ev in evs
+            if ev["ph"] == "M" and ev["name"] == "thread_name"
+        }
+        assert (0, "req 0 @r0") in lanes
+        assert (1, "req 0 @r1") in lanes
+        # the journey renders as ONE flow: s at the router's route,
+        # f at the rerouted completion on replica 0
+        flows = [ev for ev in evs if ev.get("ph") in ("s", "t", "f")]
+        assert {f["id"] for f in flows} == {"j1"}
+        assert [f["ph"] for f in flows].count("s") == 1
+        assert [f["ph"] for f in flows].count("f") == 1
+        s = next(f for f in flows if f["ph"] == "s")
+        fin = next(f for f in flows if f["ph"] == "f")
+        assert s["pid"] == obs_fleet.ROUTER_PID
+        assert fin["pid"] == 0
+        # the failed leg is a mid-journey step on replica 1
+        assert any(
+            f["ph"] == "t" and f["pid"] == 1 for f in flows
+        )
+        json.dumps(trace)
+
+    def test_journey_table_tells_the_whole_story(self, tmp_path):
+        from tpu_patterns.obs import fleet as obs_fleet
+
+        _fake_fleet_dir(str(tmp_path))
+        merged, _ = obs_fleet.merge_fleet(str(tmp_path))
+        out = obs_fleet.journey_table(merged, "j1")
+        assert "journey j1" in out
+        for token in ("journey.route", "req.failed", "journey.reroute",
+                      "req.retired", "router", "replica 1",
+                      "replica 0"):
+            assert token in out
+        # a rid resolves to its journey too
+        assert obs_fleet.resolve_journey(merged, "0") == "j1"
+        assert "no journey" in obs_fleet.journey_table(merged, "999")
+
+    def test_reset_base_drops_stale_replica_dirs(self, tmp_path):
+        # the default obs dir is fixed, never timestamped: a new fleet
+        # must claim the replica-* namespace or `obs fleet` would merge
+        # last run's shipped spans (append-mode!) and ghost replicas
+        from tpu_patterns.obs import fleet as obs_fleet
+
+        _fake_fleet_dir(str(tmp_path))
+        fo = obs_fleet.FleetObs(str(tmp_path))
+        fo.reset_base()
+        merged, procs = obs_fleet.merge_fleet(str(tmp_path))
+        # the parent's own dumps survive; every replica dir is gone
+        assert set(procs) == {obs_fleet.ROUTER_PID}
+        assert all(e.get("replica") is None for e in merged)
+        obs_fleet.FleetObs(None).reset_base()  # in-memory: a no-op
+
+    def test_fleet_series_naming_keeps_the_conventions(self):
+        from tpu_patterns.obs import fleet as obs_fleet
+
+        # the graftlint metric-naming contract, applied to the DYNAMIC
+        # fleet namespace: prefix preserved, counters keep _total
+        assert obs_fleet.fleet_name(
+            "tpu_patterns_serve_requests_total"
+        ) == "tpu_patterns_fleet_serve_requests_total"
+        assert obs_fleet.fleet_name(
+            "tpu_patterns_serve_requests_total"
+        ).endswith("_total")
+        with pytest.raises(ValueError):
+            obs_fleet.fleet_name("rogue_series")
+
+    def test_fleet_series_export_with_replica_label(self):
+        # shipped child counters merge into tpu_patterns_fleet_* and
+        # export like any first-class series
+        reg = obs_metrics.Registry()
+        reg.counter(
+            "tpu_patterns_fleet_serve_requests_total", replica="0"
+        ).inc(5)
+        reg.counter(
+            "tpu_patterns_fleet_serve_requests_total", replica="1"
+        ).inc(3)
+        reg.counter(
+            "tpu_patterns_fleet_replica_drains_total",
+            replica="1", mode="checkpoint",
+        ).inc()
+        text = reg.to_prom_text()
+        assert (
+            "# TYPE tpu_patterns_fleet_serve_requests_total counter"
+            in text
+        )
+        samples = obs.parse_prom_text(text)
+        assert samples[(
+            "tpu_patterns_fleet_serve_requests_total",
+            (("replica", "0"),),
+        )] == 5
+        assert samples[(
+            "tpu_patterns_fleet_serve_requests_total",
+            (("replica", "1"),),
+        )] == 3
+        from tpu_patterns import rt
+
+        assert rt.metric_total(
+            "tpu_patterns_fleet_serve_requests_total", registry=reg
+        ) == 8.0
+        assert rt.metric_total(
+            "tpu_patterns_fleet_serve_requests_total",
+            registry=reg, replica="1",
+        ) == 3.0
+
+
+class TestObsShipper:
+    def test_tap_feeds_deltas_and_metrics_ship_once(self):
+        from tpu_patterns.obs import fleet as obs_fleet
+
+        shipper = obs_fleet.ObsShipper(max_batch=8)
+        try:
+            with obs.span("shipped.region"):
+                pass
+            obs.counter("tpu_patterns_test_ship_total").inc(2)
+            b1 = shipper.batch()
+            assert [e["name"] for e in b1["entries"]] == [
+                "shipped.region"
+            ]
+            assert {
+                m["metric"]: m["value"] for m in b1["metrics"]
+            }["tpu_patterns_test_ship_total"] == 2.0
+            # nothing changed: no batch at the next boundary
+            assert shipper.batch() is None
+            # a counter moves: only the DELTA-carrying series reships,
+            # as its new cumulative value
+            obs.counter("tpu_patterns_test_ship_total").inc()
+            b2 = shipper.batch()
+            assert b2["entries"] == []
+            assert {
+                m["metric"]: m["value"] for m in b2["metrics"]
+            } == {"tpu_patterns_test_ship_total": 3.0}
+        finally:
+            shipper.close()
+
+    def test_closed_tap_stops_feeding(self):
+        from tpu_patterns.obs import fleet as obs_fleet
+
+        shipper = obs_fleet.ObsShipper()
+        shipper.close()
+        obs.event("after.close")
+        assert not shipper._tap
+
+
 class TestObsCLI:
     def _dump_some_spans(self, d):
         with obs.span("cli.span", n=1):
@@ -603,6 +872,48 @@ class TestObsCLI:
 
         with pytest.raises(SystemExit):
             main(["--obs-dir", str(tmp_path), "obs", "summarize"])
+
+    def test_fleet_merges_and_exports(self, tmp_path, capsys):
+        from tpu_patterns.cli import main
+        from tpu_patterns.obs import fleet as obs_fleet
+
+        _fake_fleet_dir(str(tmp_path))
+        rc = main(["obs", "fleet", str(tmp_path)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "req.failed" in out  # merged summarize saw child spans
+        trace = json.load(open(tmp_path / "fleet_trace.json"))
+        pids = {
+            ev["pid"]
+            for ev in trace["traceEvents"]
+            if ev.get("ph") == "M" and ev["name"] == "process_name"
+        }
+        assert {0, 1, obs_fleet.ROUTER_PID} <= pids
+
+    def test_fleet_empty_dir_is_an_error(self, tmp_path):
+        from tpu_patterns.cli import main
+
+        with pytest.raises(SystemExit):
+            main(["obs", "fleet", str(tmp_path)])
+
+    def test_journey_by_jid_and_rid(self, tmp_path, capsys):
+        from tpu_patterns.cli import main
+
+        _fake_fleet_dir(str(tmp_path))
+        rc = main(["--obs-dir", str(tmp_path), "obs", "journey", "j1"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "journey j1" in out and "req.failed" in out
+        rc = main(["--obs-dir", str(tmp_path), "obs", "journey", "0"])
+        assert rc == 0
+        assert "journey j1" in capsys.readouterr().out
+
+    def test_journey_without_target_is_an_error(self, tmp_path):
+        from tpu_patterns.cli import main
+
+        _fake_fleet_dir(str(tmp_path))
+        with pytest.raises(SystemExit):
+            main(["--obs-dir", str(tmp_path), "obs", "journey"])
 
     def test_host_device_join_reads_profile(self, tmp_path, capsys):
         from tpu_patterns.cli import main

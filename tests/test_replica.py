@@ -18,11 +18,13 @@ import sys
 import pytest
 
 from tpu_patterns import faults, rt
+from tpu_patterns.obs.fleet import FleetObs
 from tpu_patterns.serve.engine import Request
 from tpu_patterns.serve.replica import (
     FleetResult,
     ReplicaHandle,
     ReplicaManager,
+    _StdinSource,
 )
 from tpu_patterns.serve.router import (
     ConsistentHashRing,
@@ -40,13 +42,16 @@ def _clean_faults():
 
 class TestSiteRegistry:
     def test_fleet_sites_are_registered_with_match_keys(self):
-        for site in ("router.route", "replica.spawn", "replica.drain"):
+        for site in ("router.route", "replica.spawn", "replica.drain",
+                     "replica.obs_ship"):
             assert site in faults.KNOWN_SITES
         assert "replica" in faults.MATCH_KEYS
         (spec,) = faults.parse_spec("replica.spawn:error:replica=1")
         assert spec.match == (("replica", "1"),)
         (spec,) = faults.parse_spec("router.route:error:rid=3")
         assert spec.match == (("rid", "3"),)
+        (spec,) = faults.parse_spec("replica.obs_ship:error:replica=1")
+        assert spec.match == (("replica", "1"),)
 
 
 class TestPrefixFingerprint:
@@ -204,7 +209,7 @@ def no_real_kill(monkeypatch):
     return killed
 
 
-def _manager(n=2, policy="prefix"):
+def _manager(n=2, policy="prefix", obs_base=None):
     mgr = ReplicaManager.__new__(ReplicaManager)
     mgr.n = n
     mgr.base_env = {}
@@ -213,6 +218,7 @@ def _manager(n=2, policy="prefix"):
     mgr.device_slices = [[i] for i in range(n)]
     mgr.sp, mgr.tp = 1, 1
     mgr.watchdog_s = 120.0
+    mgr.obs_watchdog_s = 120.0
     mgr.warm = []
     mgr.retry_policy = rt.RetryPolicy(max_attempts=2, backoff_base_s=0.0)
     mgr.router = Router(
@@ -222,6 +228,8 @@ def _manager(n=2, policy="prefix"):
     mgr.handles = {}
     mgr.spawn_retries = 0
     mgr.drains = 0
+    mgr.fleet_obs = FleetObs(obs_base)
+    mgr.obs_stalls = 0
     for r in range(n):
         h = ReplicaHandle(str(r), _FakeProc(), mgr.inbox)
         h.state = "ready"
@@ -435,6 +443,234 @@ class TestFailover:
             c["done"] + c["failed"] + c["rerouted"] == res.scheduled
         )
         assert res.covered()
+
+
+class _FakeEngine:
+    """Just enough engine surface for _StdinSource.report()."""
+
+    def __init__(self, replica="1"):
+        self.done = {}
+        self.failed = {}
+        self.stats = {"steps": 0, "tokens": 0}
+        self.replica = replica
+        self.queue = []
+        self.active = []
+
+
+@pytest.fixture(autouse=True)
+def _isolated_obs(tmp_path):
+    from tpu_patterns import obs
+
+    obs.flight_recorder().clear()
+    obs.metrics_registry().clear()
+    obs.configure(str(tmp_path))
+    yield
+    obs.flight_recorder().clear()
+    obs.metrics_registry().clear()
+    obs.configure(None)
+
+
+class TestFleetObsShipping:
+    def _source(self, shipper):
+        sent = []
+        src = _StdinSource(
+            iter([]), _FakeEngine(), sent.append, shipper=shipper
+        )
+        src._last_hb_ns = 0
+        return src, sent
+
+    def test_report_ships_bounded_batches_after_control_traffic(self):
+        from tpu_patterns import obs
+        from tpu_patterns.obs.fleet import ObsShipper
+
+        shipper = ObsShipper(max_batch=4)
+        for i in range(10):
+            obs.event("spam", i=i)
+        src, sent = self._source(shipper)
+        src.report()
+        ops = [m["op"] for m in sent]
+        # hb first, obs last; the batch is bounded at max_batch
+        assert ops.index("hb") < ops.index("obs")
+        batch = next(m for m in sent if m["op"] == "obs")
+        assert len(batch["entries"]) == 4
+        assert batch["backlog"] == 6
+        assert "clock_ns" in batch["clock"]
+        # the tail drains the rest
+        src.ship_tail()
+        total = sum(
+            len(m["entries"]) for m in sent if m["op"] == "obs"
+        )
+        assert total == 10
+
+    def test_obs_ship_fault_suppresses_the_batch_not_the_heartbeat(
+        self,
+    ):
+        from tpu_patterns import obs, rt
+        from tpu_patterns.obs.fleet import ObsShipper
+
+        faults.configure("replica.obs_ship:error:count=1")
+        shipper = ObsShipper()
+        obs.event("something")
+        src, sent = self._source(shipper)
+        src.report()
+        assert any(m["op"] == "hb" for m in sent)
+        assert not any(m["op"] == "obs" for m in sent)
+        assert rt.metric_total(
+            "tpu_patterns_faults_injected_total",
+            site="replica.obs_ship",
+        ) == 1.0
+        # count spent: the suppressed entries ship at the next boundary
+        src._last_hb_ns = 0
+        src.report()
+        batch = next(m for m in sent if m["op"] == "obs")
+        assert any(
+            e.get("name") == "something" for e in batch["entries"]
+        )
+
+    def test_obs_message_absorbs_into_fleet_series_and_disk(
+        self, tmp_path, no_real_kill
+    ):
+        from tpu_patterns import rt
+
+        mgr = _manager(2, obs_base=str(tmp_path))
+        res = _res(mgr, [])
+        mgr._handle("1", {
+            "op": "obs",
+            "entries": [
+                {"kind": "span", "name": "req.queued", "t0_ns": 5,
+                 "dur_ns": 2, "tid": 9, "span_id": 1,
+                 "attrs": {"rid": 0}},
+            ],
+            "metrics": [
+                {"metric": "tpu_patterns_serve_requests_total",
+                 "type": "counter", "labels": {}, "value": 3.0},
+            ],
+            "clock": {"wall_ts": 100.0, "clock_ns": 50},
+        }, res)
+        # cumulative -> delta merge into the fleet namespace
+        assert rt.metric_total(
+            "tpu_patterns_fleet_serve_requests_total", replica="1"
+        ) == 3.0
+        mgr._handle("1", {
+            "op": "obs", "entries": [],
+            "metrics": [
+                {"metric": "tpu_patterns_serve_requests_total",
+                 "type": "counter", "labels": {}, "value": 5.0},
+            ],
+        }, res)
+        assert rt.metric_total(
+            "tpu_patterns_fleet_serve_requests_total", replica="1"
+        ) == 5.0
+        shipped = tmp_path / "replica-1" / "shipped.jsonl"
+        lines = [
+            json.loads(ln)
+            for ln in shipped.read_text().splitlines() if ln.strip()
+        ]
+        assert any(ln.get("kind") == "meta" for ln in lines)
+        assert any(ln.get("name") == "req.queued" for ln in lines)
+        assert mgr.fleet_obs.total(
+            "tpu_patterns_serve_requests_total"
+        ) == 5.0
+        mgr.fleet_obs.close()
+
+    def test_dispatch_stamps_journey_id_and_route_anchor(
+        self, no_real_kill
+    ):
+        from tpu_patterns import obs
+
+        mgr = _manager(2)
+        req = _reqs(1)[0]
+        res = _res(mgr, [req])
+        mgr._dispatch(req, res)
+        assert req.jid.startswith("j")
+        routes = [
+            e for e in obs.flight_recorder().snapshot()
+            if e["name"] == "journey.route"
+        ]
+        assert len(routes) == 1
+        assert routes[0]["attrs"]["jid"] == req.jid
+        # the dispatched protocol message carries the journey id
+        sent = [
+            m
+            for h in mgr.handles.values()
+            for m in h.proc.stdin.sent
+            if m.get("op") == "req"
+        ]
+        assert sent[0]["jid"] == req.jid
+        # a reroute keeps the SAME journey (one stitched flow)
+        victim = next(
+            h for h in mgr.handles.values() if len(h.leases)
+        )
+        mgr._replica_down(victim, "test", res)
+        reroutes = [
+            e for e in obs.flight_recorder().snapshot()
+            if e["name"] == "journey.reroute"
+        ]
+        assert reroutes and reroutes[0]["attrs"]["jid"] == req.jid
+
+    def test_obs_stall_watchdog_warns_once_without_killing(
+        self, tmp_path, no_real_kill
+    ):
+        from tpu_patterns import obs, rt
+        from tpu_patterns.core.timing import clock_ns
+
+        mgr = _manager(2)
+        mgr.obs_watchdog_s = 1.0
+        res = _res(mgr, [])
+        h = mgr.handles["1"]
+        h.leases.acquire(0, meta=None)
+        h.last_msg_ns = clock_ns()  # heartbeat fresh...
+        h.last_obs_ns = clock_ns() - int(10e9)  # ...obs channel silent
+        mgr._check_watchdogs(res)
+        assert h.obs_stalled and h.state == "ready"  # WARN, not kill
+        assert mgr.obs_stalls == 1
+        assert rt.metric_total(
+            "tpu_patterns_replica_obs_stalls_total", replica="1"
+        ) == 1.0
+        ring = [
+            e["name"] for e in obs.flight_recorder().snapshot()
+        ]
+        assert "replica.obs_stall" in ring
+        wd = tmp_path / "watchdog.jsonl"
+        rec = json.loads(wd.read_text().splitlines()[-1])
+        assert rec["mode"] == "watchdog_obs_stall"
+        assert rec["verdict"] == "WARNING"
+        # fires once: a second poll stays quiet
+        mgr._check_watchdogs(res)
+        assert mgr.obs_stalls == 1
+
+    def test_mirrors_reconcile_against_shipped_truth(self):
+        mgr = _manager(2)
+        res = _res(mgr, [])
+        # replica 0 checkpoints AND ships the counter: mirror must
+        # match the shipped truth and NOT double into the fleet series
+        mgr._handle("0", {"op": "obs", "entries": [], "metrics": [
+            {"metric": "tpu_patterns_replica_drains_total",
+             "type": "counter",
+             "labels": {"replica": "0", "mode": "checkpoint"},
+             "value": 1.0},
+        ]}, res)
+        mgr._handle("0", {"op": "checkpointed", "step": 3}, res)
+        # replica 1 checkpoints but dies before its first ship: the
+        # mirror is the fallback
+        mgr._handle("1", {"op": "checkpointed", "step": 3}, res)
+        notes = mgr.fleet_obs.reconcile()
+        assert notes == []
+        assert mgr.fleet_obs.total(
+            "tpu_patterns_replica_drains_total", mode="checkpoint"
+        ) == 2.0
+
+    def test_mirror_mismatch_is_loud(self):
+        mgr = _manager(2)
+        res = _res(mgr, [])
+        # replica 0 shipped (so mirrors are demoted to assertions) but
+        # its shipped ledger never saw the drain counter
+        mgr._handle("0", {"op": "obs", "entries": [], "metrics": []},
+                    res)
+        mgr._handle("0", {"op": "checkpointed", "step": 3}, res)
+        notes = mgr.fleet_obs.reconcile()
+        assert len(notes) == 1
+        assert "mirror" in notes[0]
 
 
 @pytest.mark.slow

@@ -1029,7 +1029,8 @@ def _cmd_lint(args, writer: ResultWriter) -> int:
 
 def _cmd_obs(args, writer: ResultWriter) -> None:
     """Read the obs layer's dumps: span summaries, Chrome-trace and
-    Prometheus export, host+device join against a captured profile."""
+    Prometheus export, host+device join against a captured profile,
+    fleet-wide merged timelines and request journeys."""
     import glob
     import os
 
@@ -1038,6 +1039,54 @@ def _cmd_obs(args, writer: ResultWriter) -> None:
     from tpu_patterns.obs import metrics as obs_metrics
 
     obs_dir = args.obs_dir or obs.run_dir()
+
+    if args.action == "fleet":
+        # merged summarize + trace export over parent + replica-*/ dumps
+        from tpu_patterns.obs import fleet as obs_fleet
+
+        fleet_dir = args.target or obs_dir
+        merged, procs = obs_fleet.merge_fleet(fleet_dir)
+        if not merged:
+            raise SystemExit(
+                f"no fleet dumps under {fleet_dir} — run `serve "
+                "--replicas N --obs-dump` (replica dirs land under "
+                "<obs_dir>/replica-<id>/) first"
+            )
+        n_replicas = sum(
+            1 for p in procs if p != obs_fleet.ROUTER_PID
+        )
+        writer.progress(
+            f"{len(merged)} merged entries from {len(procs)} "
+            f"process(es) ({n_replicas} replica(s)) under {fleet_dir}"
+        )
+        print(obs_export.summarize(merged))
+        out = args.chrome_trace or os.path.join(
+            fleet_dir, "fleet_trace.json"
+        )
+        obs_export.write_chrome_trace(merged, out, process_names=procs)
+        js = obs_fleet.journeys(merged)
+        writer.progress(
+            f"fleet chrome trace ({n_replicas} replica lanes + router) "
+            f"-> {out} (open in Perfetto / chrome://tracing)"
+        )
+        writer.progress(
+            f"{len(js)} journey(s) stitched; inspect one with: "
+            "tpu-patterns obs journey <jid|rid>"
+        )
+        return
+
+    if args.action == "journey":
+        from tpu_patterns.obs import fleet as obs_fleet
+
+        if not args.target:
+            raise SystemExit(
+                "obs journey: pass a journey id (j...) or a request id"
+            )
+        merged, _ = obs_fleet.merge_fleet(obs_dir)
+        if not merged:
+            raise SystemExit(f"no fleet dumps under {obs_dir}")
+        print(obs_fleet.journey_table(merged, args.target))
+        return
     if args.input:
         span_files = [args.input]
     else:
@@ -1582,13 +1631,25 @@ def build_parser() -> argparse.ArgumentParser:
         "obs",
         help="observability layer: summarize recorded spans, export "
         "Chrome traces (Perfetto-openable) and Prometheus metrics, join "
-        "host spans against a device-plane profile breakdown",
+        "host spans against a device-plane profile breakdown, merge a "
+        "replica fleet's dumps into one timeline, stitch request "
+        "journeys",
     )
     ob.add_argument(
         "action",
-        choices=("summarize", "export"),
+        choices=("summarize", "export", "fleet", "journey"),
         help="summarize = per-span table (+device join with "
-        "--profile-dir); export = --chrome-trace / --prom",
+        "--profile-dir); export = --chrome-trace / --prom; fleet <dir> "
+        "= merged summarize + per-process Chrome trace over the "
+        "parent's dumps and every replica-*/ dir; journey <jid|rid> = "
+        "one request's full cross-process story as a table",
+    )
+    ob.add_argument(
+        "target",
+        nargs="?",
+        default=None,
+        help="fleet: the obs dir to merge (default --obs-dir); "
+        "journey: the journey id (j...) or request id to stitch",
     )
     ob.add_argument(
         "--input",

@@ -72,6 +72,7 @@ KNOWN_SITES = frozenset({
     "worker.ready", "cell.run", "ckpt.save", "ckpt.restore",
     "train.step", "serve.prefill", "serve.step", "serve.verify",
     "loadgen.arrive", "router.route", "replica.spawn", "replica.drain",
+    "replica.obs_ship",
 })
 
 # ctx keys the call sites actually pass — the only keys a match

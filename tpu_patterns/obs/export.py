@@ -44,15 +44,19 @@ def dedupe_entries(entries: Iterable[dict]) -> list[dict]:
 
     A run where the watchdog fired AND --obs-dump exported at end of run
     wrote the same entries twice (hang_*.jsonl then spans.jsonl) — and
-    the hung span twice more, once open and once closed.  Identity is
-    (span_id, t0_ns, tid, name); the closed form of a span wins over its
-    still-open snapshot.  First-seen order is preserved.
+    the hung span twice more, once open and once closed.  The fleet
+    merge (obs/fleet.py) adds a third overlap: a replica's own dir dump
+    and the shipped copy of the same ring.  Identity is (pid, replica,
+    span_id, t0_ns, tid, name) — the process tags keep two replicas'
+    same-numbered spans apart (span ids and monotonic clocks restart
+    per process); the closed form of a span wins over its still-open
+    snapshot.  First-seen order is preserved.
     """
     best: dict[tuple, dict] = {}
     order: list[tuple] = []
     for e in entries:
-        key = (e.get("span_id"), e.get("t0_ns"), e.get("tid"),
-               e.get("name"))
+        key = (e.get("pid"), e.get("replica"), e.get("span_id"),
+               e.get("t0_ns"), e.get("tid"), e.get("name"))
         prev = best.get(key)
         if prev is None:
             best[key] = e
@@ -62,18 +66,34 @@ def dedupe_entries(entries: Iterable[dict]) -> list[dict]:
     return [best[k] for k in order]
 
 
-def chrome_trace(entries: Iterable[dict]) -> dict:
+def chrome_trace(
+    entries: Iterable[dict],
+    process_names: dict[int, str] | None = None,
+) -> dict:
     """trace_event JSON object format: spans -> "X" (complete) events,
     events -> "i" (instant); ts/dur in microseconds per the schema.
 
     Entries whose attrs carry a request id (the serve engine's
     per-request lifecycle spans) get their lane named ``req <rid>`` via
-    thread_name metadata, so Perfetto shows one labeled row per request
-    — queued, prefill, decode, retired — under the scheduler's own
-    thread rows."""
+    thread_name metadata — qualified ``req <rid> @r<k>`` when the entry
+    carries a replica id, because every replica restarts rids at 0 and
+    a merged fleet trace would otherwise overlay different requests
+    onto one label.  Lanes are keyed (pid, tid): fleet-merged entries
+    (obs/fleet.py) carry their own ``pid`` per process, single-process
+    dumps fall back to this process's pid.  ``process_names`` adds
+    process_name metadata rows (the fleet merge passes
+    {pid: "replica <k>" / "router"}).
+
+    Entries carrying a ``jid`` attr on the journey anchor names
+    (obs/fleet.py) additionally emit Chrome FLOW events (``ph`` s/t/f,
+    one shared id per journey), so a request that was routed, failed on
+    one replica, and rerouted to another renders as one arrow across
+    the process lanes."""
+    from tpu_patterns.obs import fleet as _fleet
+
     trace_events = []
-    pid = os.getpid()
-    lanes: dict[int, str] = {}
+    default_pid = os.getpid()
+    lanes: dict[tuple, str] = {}
     entries = list(entries)
     for e in entries:
         attrs = e.get("attrs") or {}
@@ -87,16 +107,19 @@ def chrome_trace(entries: Iterable[dict]) -> dict:
             and e.get("tid") is not None
         ):
             label = f"req {attrs['rid']}"
+            rep = attrs.get("replica") or e.get("replica")
+            if rep not in (None, ""):
+                label += f" @r{rep}"
             if attrs.get("scenario"):
                 label += f" [{attrs['scenario']}]"
-            lanes.setdefault(e["tid"], label)
+            lanes.setdefault((e.get("pid", default_pid), e["tid"]), label)
     for e in entries:
         ev = {
             "name": e.get("name", "?"),
             "cat": "tpu_patterns" + (",open" if e.get("open") else ""),
             "ph": "X" if e.get("kind") == "span" else "i",
             "ts": e.get("t0_ns", 0) / 1e3,
-            "pid": pid,
+            "pid": e.get("pid", default_pid),
             "tid": e.get("tid", 0),
             "args": dict(e.get("attrs") or {}),
         }
@@ -105,23 +128,51 @@ def chrome_trace(entries: Iterable[dict]) -> dict:
         else:
             ev["s"] = "t"  # instant scope: thread
         trace_events.append(ev)
+    # journey flows: one s -> t... -> f chain per jid across its anchors
+    for jid, anchors in sorted(_fleet.journeys(entries).items()):
+        if len(anchors) < 2:
+            continue
+        for i, a in enumerate(anchors):
+            ph = "s" if i == 0 else ("f" if i == len(anchors) - 1 else "t")
+            flow = {
+                "name": "journey",
+                "cat": "journey",
+                "ph": ph,
+                "id": jid,
+                "ts": a.get("t0_ns", 0) / 1e3,
+                "pid": a.get("pid", default_pid),
+                "tid": a.get("tid", 0),
+            }
+            if ph == "f":
+                flow["bp"] = "e"  # bind to the enclosing slice
+            trace_events.append(flow)
     trace_events.sort(key=lambda ev: ev["ts"])
     meta = [
+        {
+            "name": "process_name", "ph": "M", "ts": 0.0, "pid": pid,
+            "tid": 0, "args": {"name": label},
+        }
+        for pid, label in sorted((process_names or {}).items())
+    ] + [
         {
             "name": "thread_name", "ph": "M", "ts": 0.0, "pid": pid,
             "tid": tid, "args": {"name": label},
         }
-        for tid, label in sorted(lanes.items())
+        for (pid, tid), label in sorted(lanes.items())
     ]
     return {"traceEvents": meta + trace_events, "displayTimeUnit": "ms"}
 
 
-def write_chrome_trace(entries: Iterable[dict], out_path: str) -> str:
+def write_chrome_trace(
+    entries: Iterable[dict],
+    out_path: str,
+    process_names: dict[int, str] | None = None,
+) -> str:
     d = os.path.dirname(out_path)
     if d:
         os.makedirs(d, exist_ok=True)
     with open(out_path, "w") as f:
-        json.dump(chrome_trace(entries), f)
+        json.dump(chrome_trace(entries, process_names=process_names), f)
     return out_path
 
 

@@ -47,6 +47,10 @@ class FlightRecorder:
         self._ring: collections.deque = collections.deque(maxlen=capacity)  # graftlint: guarded-by[_lock]
         self._dropped = 0  # graftlint: guarded-by[_lock] -- wraparound count
         self._lock = threading.Lock()  # dumps/clears only, never appends
+        # taps: bounded side-queues fed by append (obs/fleet.py's span
+        # shipper drains one at iteration boundaries).  Almost always
+        # empty, so the hot path pays one truthiness check.
+        self._taps: list[collections.deque] = []  # graftlint: guarded-by[_lock]
 
     def append(self, entry: dict) -> None:
         # deque.append with maxlen is atomic under the GIL; counting the
@@ -58,6 +62,25 @@ class FlightRecorder:
             self._dropped += 1
         # graftlint: allow[lock-discipline] -- deque.append(maxlen) is GIL-atomic; the lock guards dump/clear only (design constraint above)
         self._ring.append(entry)
+        if self._taps:
+            # graftlint: allow[lock-discipline] -- same GIL-atomic deque.append argument as the ring itself; taps are bounded (maxlen)
+            for t in self._taps:
+                t.append(entry)
+
+    def open_tap(self, capacity: int = 65536) -> collections.deque:
+        """Register a bounded side-queue every future ``append`` also
+        lands in — the span-shipping source for fleet observability.
+        A tap that overflows drops oldest-first (deque maxlen); the ring
+        and the on-disk dumps still hold the full history."""
+        tap: collections.deque = collections.deque(maxlen=capacity)
+        with self._lock:
+            self._taps.append(tap)
+        return tap
+
+    def close_tap(self, tap: collections.deque) -> None:
+        with self._lock:
+            if tap in self._taps:
+                self._taps.remove(tap)
 
     def __len__(self) -> int:
         return len(self._ring)

@@ -182,7 +182,6 @@ def _fire(sp) -> None:
         f"deadline {sp.deadline_ns / 1e9:.1f}s",
     )
     stacks_path = dump_all_stacks(base + "_stacks.txt")
-    _fired_paths.append(ring_path)
     writer = ResultWriter(
         jsonl_path=os.path.join(out_dir, "watchdog.jsonl"),
         stream=sys.stderr,  # the hang may be wedging stdout's consumer;
@@ -206,6 +205,10 @@ def _fire(sp) -> None:
             f"thread stacks: {stacks_path}",
         ],
     ))
+    # publish LAST: fired_dumps() is the "the watchdog fired" signal
+    # watchers poll, and the ring + stacks + Record must all exist by
+    # the time it becomes visible
+    _fired_paths.append(ring_path)
 
 
 def _fire_queued(w: QueueWatch) -> None:
@@ -228,7 +231,6 @@ def _fire_queued(w: QueueWatch) -> None:
         f"starting, deadline {w.deadline_ns / 1e9:.1f}s",
     )
     stacks_path = dump_all_stacks(base + "_stacks.txt")
-    _fired_paths.append(ring_path)
     writer = ResultWriter(
         jsonl_path=os.path.join(out_dir, "watchdog.jsonl"),
         stream=sys.stderr,
@@ -251,6 +253,7 @@ def _fire_queued(w: QueueWatch) -> None:
             f"thread stacks: {stacks_path}",
         ],
     ))
+    _fired_paths.append(ring_path)  # publish last (same contract as _fire)
 
 
 def fired_dumps() -> list[str]:

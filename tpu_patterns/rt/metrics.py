@@ -16,17 +16,25 @@ from __future__ import annotations
 import json
 
 
-def metric_total(name: str, registry=None) -> float:
+def metric_total(name: str, registry=None, **labels) -> float:
     """Sum ``name`` over all label sets in a metrics registry
-    (default: the process-wide obs registry)."""
+    (default: the process-wide obs registry).  ``labels`` narrows the
+    sum to series matching every given label — the fleet merge asks
+    per-replica questions this way (``metric_total(
+    "tpu_patterns_fleet_serve_requests_total", replica="1")``)."""
     if registry is None:
         from tpu_patterns import obs
 
         registry = obs.metrics_registry()
+    want = {str(k): str(v) for k, v in labels.items()}
     return sum(
         m.value
         for m in registry.metrics()
-        if m.name == name and hasattr(m, "value")
+        if m.name == name
+        and hasattr(m, "value")
+        and all(
+            str(m.labels.get(k)) == v for k, v in want.items()
+        )
     )
 
 

@@ -80,6 +80,11 @@ class Request:
     # engine records, the loadgen runner judges)
     scenario: str = ""
     deadline_ms: float = 0.0
+    # fleet journey id (obs/fleet.py): stamped by the router at route
+    # time, propagated through submit/reroute so one rerouted request
+    # stitches into ONE flow across every process it touched ("" =
+    # single-engine run, no journey)
+    jid: str = ""
 
 
 @dataclasses.dataclass
@@ -100,6 +105,7 @@ class _Slot:
     # these at retire/quarantine time, never from extra device syncs
     scenario: str = ""
     deadline_ms: float = 0.0
+    jid: str = ""  # fleet journey id (rides the lifecycle spans)
     t_admit_ns: int = 0
     t_first_ns: int = 0
     t_last_ns: int = 0
@@ -317,6 +323,13 @@ class ServeEngine:
         attrs = {"rid": s.rid}
         if s.scenario:
             attrs["scenario"] = s.scenario
+        # fleet identity: the replica id qualifies the merged-trace lane
+        # (every replica restarts rids at 0) and the journey id turns
+        # the lifecycle spans into flow anchors (obs/fleet.py)
+        if self.replica:
+            attrs["replica"] = self.replica
+        if s.jid:
+            attrs["jid"] = s.jid
         if s.t_admit_ns:
             obs.complete_span(
                 "req.queued", s.t_submit_ns, s.t_admit_ns - s.t_submit_ns,
@@ -423,8 +436,17 @@ class ServeEngine:
                 write_from=min(write_from, len(req.tokens)),
                 own_blocks=own_blocks,
                 scenario=req.scenario, deadline_ms=req.deadline_ms,
-                t_admit_ns=now, slot=slot_tok,
+                jid=req.jid, t_admit_ns=now, slot=slot_tok,
             )
+            if req.jid:
+                # journey anchor at ADMISSION: it ships at the next
+                # iteration boundary, so even a replica that is later
+                # SIGKILLed mid-request has placed the request on its
+                # leg of the journey (obs/fleet.py)
+                obs.event(
+                    "journey.admit", jid=req.jid, rid=str(req.rid),
+                    replica=self.replica,
+                )
             wait_ns = now - t_submit
             self.stats["queue_wait_ns"].append(wait_ns)
             obs.histogram("tpu_patterns_serve_queue_wait_ms").observe(
@@ -967,9 +989,14 @@ class ServeEngine:
                     if self.breaker_tripped:
                         # the engine declared itself unhealthy: stop at
                         # this iteration boundary with queue + verdicts
-                        # intact so the caller can drain and reroute
+                        # intact so the caller can drain and reroute.
+                        # Fleet engines label the trip with their
+                        # replica id — the series ships to the parent
+                        # and must match the parent's mirror key
                         obs.counter(
                             "tpu_patterns_replica_breaker_trips_total",
+                            **({"replica": self.replica}
+                               if self.replica else {}),
                         ).inc()
                         obs.event(
                             "serve.breaker_open", replica=self.replica,

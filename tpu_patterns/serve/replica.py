@@ -15,17 +15,33 @@ Protocol (line JSON, the exec/worker.py idiom — fd 1 is claimed for
 the protocol before the backend can scribble on it):
 
   parent -> child : {"op":"init", replica, devices, sp, tp, cfg,
-                     snapshot_dir, warm}           (first line)
-                    {"op":"req", rid, tokens, n_gen[, deadline_ms]}
+                     snapshot_dir, warm, obs_dir}  (first line)
+                    {"op":"req", rid, tokens, n_gen[, deadline_ms,
+                     jid, scenario]}
                     {"op":"fin"} | {"op":"drain"} |
                     {"op":"checkpoint"} | {"op":"shutdown"}
   child -> parent : {"ready": true, pid, replica, platform}
                     {"op":"done", rid, ids} | {"op":"failed", rid,
                      reason} | {"op":"hb", steps, tokens}
+                    {"op":"obs", entries, metrics, backlog, clock}
                     {"op":"checkpointed", step}
                     {"op":"drained"|"quarantined", pending,
                      snapshot_step, stats}
                     {"op":"fin", stats}
+
+Observability is multi-process too (obs/fleet.py): each child opens
+its flight recorder against ``<obs_dir>/replica-<id>/`` and ALSO
+streams span/event/counter deltas to the parent at iteration
+boundaries over the same pipe (``obs`` messages, bounded batch size so
+a chatty child can never starve ``done``/``hb`` traffic, behind the
+``replica.obs_ship`` fault site).  The parent persists shipped entries
+next to the child's own dumps, merges child counters into
+``tpu_patterns_fleet_*`` series, stamps a fleet-unique journey id on
+every request at route time, and watchdogs the obs channel: a replica
+whose heartbeat arrives but whose obs batches stall past the deadline
+draws a ``watchdog_obs_stall`` WARNING — sick shipping is visible,
+never a silent drop.  A dead child's partial data still merges from
+its dir (dumps are torn-line tolerant).
 
 The fail-over state machine (docs/serving.md has the diagram):
 
@@ -65,6 +81,7 @@ import numpy as np
 
 from tpu_patterns import faults, rt
 from tpu_patterns.core.timing import clock_ns
+from tpu_patterns.obs.fleet import FleetObs, new_journey_id
 from tpu_patterns.serve.engine import Request
 from tpu_patterns.serve.router import Router
 
@@ -91,7 +108,8 @@ class _StdinSource:
     engine loop thread, so every send happens at a consistent
     iteration boundary."""
 
-    def __init__(self, lines, engine, send):
+    def __init__(self, lines, engine, send, *, shipper=None,
+                 dump_obs: bool = False):
         self._engine = engine
         self._send = send
         self._q: queue.Queue = queue.Queue()
@@ -101,6 +119,12 @@ class _StdinSource:
         self._reported_done: set[int] = set()
         self._reported_failed: set[int] = set()
         self._last_hb_ns = 0
+        # fleet observability (obs/fleet.py): span/counter deltas ship
+        # at iteration boundaries; dump_obs banks ring + metrics into
+        # the per-replica obs dir at checkpoint/exit so a SIGKILLed
+        # child's partial history still merges from disk
+        self._shipper = shipper
+        self.dump_obs = dump_obs
         t = threading.Thread(
             target=self._read, args=(lines,), daemon=True
         )
@@ -140,6 +164,42 @@ class _StdinSource:
                 "op": "hb", "steps": eng.stats["steps"],
                 "tokens": eng.stats["tokens"],
             })
+        # obs shipping LAST: control traffic (done/failed/hb) always
+        # goes first, and the batch itself is bounded, so a chatty obs
+        # stream can never starve the messages fail-over settles on
+        self._ship_obs()
+
+    def _ship_obs(self) -> None:
+        if self._shipper is None:
+            return
+        try:
+            # fault site: the obs channel itself — an ``error`` drops
+            # this boundary's batch (the parent's obs watchdog makes
+            # the resulting stall visible), a ``sleep`` stalls it
+            faults.inject(
+                "replica.obs_ship",
+                replica=getattr(self._engine, "replica", ""),
+            )
+            batch = self._shipper.batch()
+            if batch is not None:
+                self._send(batch)
+        except faults.InjectedFault:
+            pass  # suppressed batch: ring + child dir still hold it
+
+    def ship_tail(self, max_batches: int = 64) -> None:
+        """Final flush before a terminal message: everything still in
+        the tap plus the last metric deltas (bounded)."""
+        if self._shipper is None:
+            return
+        try:
+            faults.inject(
+                "replica.obs_ship",
+                replica=getattr(self._engine, "replica", ""),
+            )
+            for batch in self._shipper.drain(max_batches=max_batches):
+                self._send(batch)
+        except faults.InjectedFault:
+            pass
 
     def __call__(self, idle: bool = False):
         self.report()
@@ -160,6 +220,8 @@ class _StdinSource:
                     tokens=[int(t) for t in msg["tokens"]],
                     n_gen=int(msg["n_gen"]),
                     deadline_ms=float(msg.get("deadline_ms", 0.0)),
+                    scenario=str(msg.get("scenario", "")),
+                    jid=str(msg.get("jid", "")),
                 ))
             elif op == "fin":
                 self.fin = True
@@ -182,6 +244,12 @@ class _StdinSource:
                     "tpu_patterns_replica_drains_total",
                     replica=self._engine.replica, mode="checkpoint",
                 ).inc()
+                if self.dump_obs:
+                    # bank the ring + registry alongside the engine
+                    # snapshot: if this replica is later SIGKILLed, the
+                    # fleet merge still has everything up to here
+                    obs.dump(reason="checkpoint")
+                    obs.dump_metrics()
                 self._send({
                     "op": "checkpointed",
                     "step": self._engine.stats["steps"],
@@ -250,6 +318,15 @@ def replica_main() -> int:
     init = json.loads(sys.stdin.readline())
     replica = str(init["replica"])
     cfg = init["cfg"]
+    from tpu_patterns import obs
+
+    # per-replica obs dir (obs/fleet.py): this child's flight-recorder
+    # dumps, crash dumps, and metrics land in <obs_dir>/replica-<id>/
+    # where the fleet merge finds them even if the process dies
+    obs_dir = init.get("obs_dir") or None
+    if obs_dir:
+        obs.configure(obs_dir)
+        obs.install_crash_handlers()
     try:
         from tpu_patterns.runtime import warm_backend
 
@@ -323,6 +400,13 @@ def replica_main() -> int:
                 ])
             finally:
                 faults.configure(None)
+            # warm-up is infrastructure, and its spans/counters must
+            # not pollute the SERVING observability either: the fleet
+            # merge would overlay warm rids onto real request lanes,
+            # and the shipped `serve_*` totals must reproduce the
+            # front door's accounting from serving alone
+            obs.flight_recorder().clear()
+            obs.metrics_registry().clear()
         eng = make_engine()
     except Exception as e:  # init must answer, not hang the parent
         send({"ready": False, "error": f"{type(e).__name__}: {e}"})
@@ -332,9 +416,21 @@ def replica_main() -> int:
         "ready": True, "pid": os.getpid(), "replica": replica,
         "platform": platform,
     })
-    source = _StdinSource(sys.stdin, eng, send)
+    from tpu_patterns.obs import fleet as obs_fleet
+
+    source = _StdinSource(
+        sys.stdin, eng, send,
+        shipper=obs_fleet.ObsShipper(), dump_obs=bool(obs_dir),
+    )
     eng.run([], source=source)
+    # (a breaker trip was already booked by the engine itself, labeled
+    # with this replica id — it ships in the tail below and the
+    # parent's mirror reconciles against it at fleet settlement)
     source.report()  # flush the tail
+    source.ship_tail()
+    if obs_dir:
+        obs.dump(reason="end_of_run")
+        obs.dump_metrics()
     pending = [r.rid for r, _ in eng.queue] + [
         s.rid for s in eng.active
     ]
@@ -385,6 +481,10 @@ class ReplicaHandle:
             replica=replica_id,
         )
         self.last_msg_ns = clock_ns()
+        # the obs-channel watchdog's clock: any hb proves the child
+        # alive, but only obs batches prove the SHIPPING healthy
+        self.last_obs_ns = clock_ns()
+        self.obs_stalled = False  # stall WARNING fires once per replica
         self.stats: dict = {}
         self.tentative_failed: dict[int, str] = {}
         self.snapshotted = False
@@ -463,6 +563,14 @@ class FleetResult:
     router_routed: int = 0
     router_prefix_hits: int = 0
     router_reroutes: int = 0
+    # fleet observability settlement (obs/fleet.py): child-shipped
+    # metric truth + the mirror-reconciliation verdict
+    shipped_done: float = 0.0
+    shipped_failed: float = 0.0
+    mirror_mismatches: list[str] = dataclasses.field(
+        default_factory=list
+    )
+    obs_stalls: int = 0
 
     def covered(self) -> bool:
         return set(self.done) | set(self.failed) == set(
@@ -520,6 +628,8 @@ class ReplicaManager:
         route_blocks: int = 2,
         vnodes: int = 64,
         watchdog_s: float = 120.0,
+        obs_watchdog_s: float | None = None,
+        obs_base: str | None = None,
         warm: list | None = None,
         retry_policy=None,
     ):
@@ -552,6 +662,14 @@ class ReplicaManager:
         self.handles: dict[str, ReplicaHandle] = {}
         self.spawn_retries = 0
         self.drains = 0
+        # fleet observability sink (obs/fleet.py): shipped batches land
+        # here; obs_base None = in-memory only (unit tests).  The obs
+        # watchdog defaults to the liveness deadline.
+        self.obs_watchdog_s = (
+            watchdog_s if obs_watchdog_s is None else obs_watchdog_s
+        )
+        self.fleet_obs = FleetObs(obs_base)
+        self.obs_stalls = 0
 
     # -- lifecycle -------------------------------------------------------
 
@@ -602,6 +720,11 @@ class ReplicaManager:
                 self.work_dir, f"replica-{rid}-snap"
             ),
             "warm": self.warm,
+            "obs_dir": (
+                self.fleet_obs.replica_dir(rid)
+                if self.fleet_obs.obs_base is not None
+                else None
+            ),
         })
         return handle
 
@@ -609,6 +732,11 @@ class ReplicaManager:
         """Spawn every replica, then await all ready handshakes — the
         N inits (JAX import, backend, compile warm-up) run in
         PARALLEL, which is the entire point of process replicas."""
+        # this fleet owns the replica-* namespace under its obs base:
+        # stale dirs from a previous run (shipped.jsonl is append-mode,
+        # and a smaller fleet would leave ghost replicas) must not
+        # merge into this run's timeline
+        self.fleet_obs.reset_base()
         for r in range(self.n):
             self.handles[str(r)] = self._spawn_one(r)
         waiting = set(self.handles)
@@ -642,6 +770,7 @@ class ReplicaManager:
                 pass  # already dead: the kill below settles it
         for h in self.handles.values():
             h.kill()
+        self.fleet_obs.close()
 
     # -- fail-over -------------------------------------------------------
 
@@ -697,7 +826,16 @@ class ReplicaManager:
             h.send(_req_msg(req))
         except ReplicaError:
             self._replica_down(h, "send failed mid-reroute", res)
-        obs.event("replica.reroute", rid=str(rid), replica=target)
+        obs.event(
+            "replica.reroute", rid=str(rid), replica=target,
+            jid=req.jid,
+        )
+        if req.jid:
+            # journey anchor: the reroute leg of the stitched flow
+            obs.event(
+                "journey.reroute", jid=req.jid, rid=str(rid),
+                replica=target,
+            )
 
     def _quarantine(self, h: ReplicaHandle, res: FleetResult) -> None:
         """Parent-side breaker opened on ``h``: out of the ring, then
@@ -805,9 +943,28 @@ class ReplicaManager:
         res.router_routed = self.router.routed
         res.router_prefix_hits = self.router.prefix_hits
         res.router_reroutes = self.router.reroutes
+        # settle fleet observability: mirrors reconcile against the
+        # shipped truth (fallback only for dead-before-first-ship
+        # children), and the shipped child metrics must reproduce the
+        # front door's accounting on their own
+        res.mirror_mismatches = self.fleet_obs.reconcile()
+        res.shipped_done = self.fleet_obs.total(
+            "tpu_patterns_serve_requests_total"
+        )
+        res.shipped_failed = self.fleet_obs.total(
+            "tpu_patterns_serve_quarantined_total"
+        )
+        res.obs_stalls = self.obs_stalls
         return res
 
     def _dispatch(self, req: Request, res: FleetResult) -> None:
+        from tpu_patterns import obs
+
+        # the journey id is stamped at ROUTE time and rides the request
+        # through submit and any reroute — the one thread every
+        # per-process trace fragment of this request shares
+        if not req.jid:
+            req.jid = new_journey_id()
         try:
             target = self.router.route(req.rid, req.tokens)
         except faults.InjectedFault:
@@ -821,6 +978,10 @@ class ReplicaManager:
         except RuntimeError as e:
             res.failed[req.rid] = str(e)
             return
+        obs.event(
+            "journey.route", jid=req.jid, rid=str(req.rid),
+            replica=target,
+        )
         h = self.handles[target]
         try:
             h.leases.acquire(req.rid, meta=req)
@@ -834,6 +995,12 @@ class ReplicaManager:
             return
         h.last_msg_ns = clock_ns()
         op = msg.get("op")
+        if op == "obs":
+            # shipped span/counter deltas: persist next to the child's
+            # own dumps, merge counters into tpu_patterns_fleet_*
+            h.last_obs_ns = clock_ns()
+            self.fleet_obs.absorb(h.id, msg)
+            return
         if op == "done":
             r = int(msg["rid"])
             h.leases.release(r)
@@ -856,24 +1023,26 @@ class ReplicaManager:
             if h.breaker.failure():
                 self._quarantine(h, res)
         elif op in ("drained", "quarantined"):
-            from tpu_patterns import obs
-
             if msg.get("snapshot_step", -1) is not None and msg.get(
                 "snapshot_step", -1
             ) >= 0:
                 h.snapshotted = True
                 self.drains += 1
+                from tpu_patterns import obs
+
                 obs.counter(
                     "tpu_patterns_replica_drains_total",
                     replica=h.id, mode="drain",
                 ).inc()
             if op == "quarantined":
-                # the child's engine breaker tripped: book it in THE
-                # PARENT registry — the child's own counters die with
-                # its process and never reach the run's metrics dump
-                obs.counter(
-                    "tpu_patterns_replica_breaker_trips_total"
-                ).inc()
+                # parent-side MIRROR of the child's breaker-trip
+                # counter: since PR 13 the child ships the real one
+                # over the obs channel, so the mirror is reconciled
+                # against that truth at settlement and only stands in
+                # for a child that died before its first ship
+                self.fleet_obs.mirror(
+                    h.id, "tpu_patterns_replica_breaker_trips_total"
+                )
             h.stats = msg.get("stats") or {}
             res.replica_stats[h.id] = h.stats
             if h.state == "ready":
@@ -883,17 +1052,14 @@ class ReplicaManager:
             h.state = "drained"
             self._settle_leases(h, res)
         elif op == "checkpointed":
-            from tpu_patterns import obs
-
             h.snapshotted = True
             self.drains += 1
-            # parent-side mirror of the child's checkpoint counter
-            # (same reason as breaker trips: child registries are
-            # invisible to the run's dump)
-            obs.counter(
-                "tpu_patterns_replica_drains_total",
-                replica=h.id, mode="checkpoint",
-            ).inc()
+            # parent-side mirror of the child's checkpoint counter —
+            # reconciled against the shipped truth like breaker trips
+            self.fleet_obs.mirror(
+                h.id, "tpu_patterns_replica_drains_total",
+                mode="checkpoint",
+            )
         elif op == "fin":
             h.stats = msg.get("stats") or {}
             res.replica_stats[h.id] = h.stats
@@ -907,6 +1073,7 @@ class ReplicaManager:
     def _check_watchdogs(self, res: FleetResult) -> None:
         now = clock_ns()
         watchdog_ns = int(self.watchdog_s * 1e9)
+        obs_watchdog_ns = int(self.obs_watchdog_s * 1e9)
         for h in list(self.handles.values()):
             if h.state != "ready":
                 continue
@@ -917,6 +1084,57 @@ class ReplicaManager:
                 and now - h.last_msg_ns > watchdog_ns
             ):
                 self._replica_down(h, "watchdog: no heartbeat", res)
+            elif (
+                len(h.leases)
+                and not h.obs_stalled
+                and obs_watchdog_ns > 0
+                and now - h.last_obs_ns > obs_watchdog_ns
+            ):
+                # the heartbeat is arriving (the branch above did not
+                # fire) but obs batches stopped: a serving replica
+                # produces span/metric deltas every iteration, so a
+                # silent obs channel means the fleet timeline is going
+                # blind on this replica — WARN, once, never kill
+                self._obs_stall(h, now)
+
+    def _obs_stall(self, h: ReplicaHandle, now: int) -> None:
+        from tpu_patterns import obs
+        from tpu_patterns.core.results import (
+            Record,
+            ResultWriter,
+            Verdict,
+        )
+
+        h.obs_stalled = True
+        self.obs_stalls += 1
+        stalled_s = (now - h.last_obs_ns) / 1e9
+        obs.counter(
+            "tpu_patterns_replica_obs_stalls_total", replica=h.id
+        ).inc()
+        obs.event("replica.obs_stall", replica=h.id)
+        writer = ResultWriter(
+            jsonl_path=os.path.join(obs.run_dir(), "watchdog.jsonl"),
+            stream=sys.stderr,
+        )
+        writer.record(Record(
+            pattern="obs",
+            mode="watchdog_obs_stall",
+            commands=f"replica {h.id}",
+            metrics={
+                "stalled_s": round(stalled_s, 3),
+                "deadline_s": round(self.obs_watchdog_s, 3),
+                "leases": float(len(h.leases)),
+            },
+            verdict=Verdict.WARNING,
+            notes=[
+                f"replica {h.id} heartbeats are arriving but no obs "
+                f"batch landed for {stalled_s:.1f}s (deadline "
+                f"{self.obs_watchdog_s:.1f}s) while it holds "
+                f"{len(h.leases)} lease(s) — the fleet timeline is "
+                "blind on this replica; its own dumps under "
+                "replica-*/ remain the fallback",
+            ],
+        ))
 
     def _finalize_tentative(self, res: FleetResult) -> None:
         """Failures on replicas that stayed healthy are genuine request
@@ -966,6 +1184,7 @@ def _req_msg(req: Request) -> dict:
     return {
         "op": "req", "rid": req.rid, "tokens": list(req.tokens),
         "n_gen": req.n_gen, "deadline_ms": req.deadline_ms,
+        "scenario": req.scenario, "jid": req.jid,
     }
 
 
@@ -1115,7 +1334,14 @@ def run_replicas(mesh, cfg, writer) -> list:
     base_env = dict(os.environ)
     route_blocks = cfg.route_blocks or 2
 
-    def fleet(n_replicas: int, policy: str, tag: str) -> FleetResult:
+    def fleet(
+        n_replicas: int, policy: str, tag: str, primary: bool = False
+    ) -> FleetResult:
+        # the PRIMARY leg's per-replica obs dirs live under the run's
+        # obs dir (`<obs_dir>/replica-<id>/`), where `obs fleet` /
+        # `obs journey` merge them with the parent's own dumps;
+        # baseline/comparison legs keep theirs under the work dir so
+        # they cannot overwrite the measured fleet's timeline
         mgr = ReplicaManager(
             n_replicas,
             base_env=base_env,
@@ -1126,6 +1352,10 @@ def run_replicas(mesh, cfg, writer) -> list:
             policy=policy,
             route_blocks=route_blocks,
             watchdog_s=cfg.replica_watchdog_s,
+            obs_base=(
+                obs.run_dir() if primary
+                else os.path.join(work_root, tag, "obs")
+            ),
             warm=warm,
         )
         writer.progress(
@@ -1171,7 +1401,7 @@ def run_replicas(mesh, cfg, writer) -> list:
 
     if spec is not None:
         # -- routing-comparison Record (chat preset, both policies) --
-        res_p = fleet(n, "prefix", "prefix")
+        res_p = fleet(n, "prefix", "prefix", primary=True)
         res_r = fleet(n, "round_robin", "rr")
         # the oracle depends on the requests, not the routing policy:
         # ONE dense decode of the schedule serves both legs
@@ -1241,7 +1471,7 @@ def run_replicas(mesh, cfg, writer) -> list:
         return [rec]
 
     # -- scaling / fail-over Record (plain trace) --------------------
-    res_n = fleet(n, cfg.replica_policy, f"fleet{n}")
+    res_n = fleet(n, cfg.replica_policy, f"fleet{n}", primary=True)
     counts = res_n.counts()
     exact, bad = exactness(res_n)
     agg_tps = res_n.tokens() / res_n.wall_s if res_n.wall_s else 0.0
@@ -1257,7 +1487,15 @@ def run_replicas(mesh, cfg, writer) -> list:
     leaked = res_n.leaked_blocks()
     covered = res_n.covered()
     obs.gauge("tpu_patterns_replica_fleet_tokens_per_s").set(agg_tps)
-    ok = covered and exact == 1.0 and leaked == 0
+    # the shipped child metrics must reproduce the front door's ledger
+    # on their own: every completion was counted by exactly one child
+    # engine, and done/hb messages share the iteration boundary with
+    # the obs batch, so the two channels cannot diverge unnoticed
+    fleet_consistent = res_n.shipped_done == float(len(res_n.done))
+    ok = (
+        covered and exact == 1.0 and leaked == 0
+        and not res_n.mirror_mismatches and fleet_consistent
+    )
     if speedup >= 0:
         ok = ok and speedup >= cfg.min_replica_speedup
     healed = bool(
@@ -1288,6 +1526,11 @@ def run_replicas(mesh, cfg, writer) -> list:
             "spawn_retries": float(res_n.spawn_retries),
             "prefix_hit_blocks": float(res_n.prefix_hit_blocks()),
             "tokens": float(res_n.tokens()),
+            "fleet_shipped_done": float(res_n.shipped_done),
+            "fleet_shipped_failed": float(res_n.shipped_failed),
+            "fleet_consistent": float(fleet_consistent),
+            "mirror_mismatches": float(len(res_n.mirror_mismatches)),
+            "obs_stalls": float(res_n.obs_stalls),
         },
         verdict=verdict,
     )
@@ -1317,6 +1560,15 @@ def run_replicas(mesh, cfg, writer) -> list:
             f"{cfg.min_replica_speedup}x gate over one replica on the "
             "same slice size"
         )
+    if not fleet_consistent:
+        rec.notes.append(
+            f"shipped child metrics claim {res_n.shipped_done:g} "
+            f"completions but the front door settled "
+            f"{len(res_n.done)} — the obs channel and the control "
+            "channel disagree"
+        )
+    for note in res_n.mirror_mismatches[:8]:
+        rec.notes.append(f"mirror reconciliation: {note}")
     for rid in sorted(res_n.failed)[:8]:
         rec.notes.append(
             f"request {rid} FAILED: {res_n.failed[rid]}"
