@@ -49,7 +49,17 @@ heals itself, visibly:
       rerouted at drain were never wave-quarantined child-side), and
       the merged Chrome trace (``obs fleet``) must contain >= 2
       replica process lanes with at least one rerouted request's
-      journey stitched as ONE flow spanning both replicas.
+      journey stitched as ONE flow spanning both replicas;
+  (g) kill mid-evict: a tiered-KV session run (``--kv_host_tier
+      --session_dir``) is SIGKILLed by an injected ``serve.evict``
+      fault AFTER its first eviction wave committed — the atomic
+      session commit must leave either the old device-resident state
+      or the committed host copy, never a torn block: the session dir
+      must hold a committed manifest, and a clean rerun into it must
+      complete the whole trace with greedy ids bit-identical to dense
+      decode (exact==1) and leak zero blocks (the loader drops the
+      partial session's orphaned leaf chains rather than fabricate
+      coverage — completeness is the kv-tier smoke's restart gate).
 
 Zero dependencies beyond the package; exit 0 = pass.
 """
@@ -464,12 +474,70 @@ def main() -> int:
             return fail(f"{tag}: no drain/checkpoint snapshot written "
                         "under the fleet work dir")
 
+    # (g) kill MID-EVICT on a tiered-KV session run: the first evict
+    # wave commits the session cache, the second is SIGKILLed before
+    # its commit — the atomic-commit contract says the session dir
+    # holds exactly the first wave, and a clean rerun must load it,
+    # finish the trace, and stay bit-identical to dense decode.
+    kv_args = [
+        "serve", "--dp", "1", "--tp", "2",
+        "--vocab", "64", "--embed", "64", "--head_dim", "8",
+        "--depth", "1", "--requests", "12", "--gen", "6",
+        "--slots", "4", "--block_len", "8",
+        "--kv_host_tier", "true",
+        "--session_dir", os.path.join(work, "kv-session"),
+    ]
+    rc = _run(
+        "evict-kill",
+        [*py, "--jsonl", os.path.join(work, "evict-kill.jsonl"),
+         *kv_args],
+        _env("serve.evict:kill:after=1:count=1"),
+    )
+    if rc == 0:
+        return fail("evict-kill leg exited 0 — the injected SIGKILL "
+                    "mid-evict never fired")
+    import glob as _glob
+
+    committed = _glob.glob(
+        os.path.join(work, "kv-session", "step_*", "manifest.json")
+    )
+    if not committed:
+        return fail("no committed session step survived the mid-evict "
+                    "kill — the first wave's atomic commit is missing")
+    kv_jsonl = os.path.join(work, "evict-resume.jsonl")
+    rc = _run("evict-resume", [*py, "--jsonl", kv_jsonl, *kv_args],
+              _env())
+    if rc != 0:
+        return fail("rerun after the mid-evict kill exited nonzero")
+    with open(kv_jsonl) as f:
+        kv = [json.loads(ln) for ln in f if ln.strip()][-1]
+    m = kv.get("metrics", {})
+    print(f"  [evict-resume] verdict={kv.get('verdict')} "
+          f"exact={m.get('exact')} "
+          f"session_loaded={m.get('session_loaded')} "
+          f"leaked={m.get('leaked_blocks')}", flush=True)
+    if kv.get("verdict") != "SUCCESS" or m.get("exact") != 1.0:
+        return fail(
+            f"evict-resume verdict {kv.get('verdict')} exact "
+            f"{m.get('exact')} — a mid-evict kill tore a block "
+            f"(notes: {kv.get('notes')})"
+        )
+    # session_loaded is legitimately 0 here: mid-run evictions are
+    # leaf-first, so the partial session holds leaves whose parent
+    # chains were still device-resident when the kill landed — the
+    # loader drops such orphans rather than fabricate coverage (the
+    # kv-tier smoke's restart leg gates the complete-session case)
+    if m.get("leaked_blocks") != 0.0:
+        return fail(f"evict-resume leaked {m.get('leaked_blocks')} "
+                    "block(s)")
+
     print("chaos smoke: all gates passed "
           "(cell retry, worker fallback, preempt/resume exactness, "
           "verify-fault quarantine + refcount balance, "
           "chaos-under-load coverage + bounded p99, "
           "replica fail-over: kill + drain legs incl. fleet-metric "
-          "identity + stitched cross-replica journeys)",
+          "identity + stitched cross-replica journeys, "
+          "mid-evict kill -> session-cache resume exactness)",
           flush=True)
     return 0
 

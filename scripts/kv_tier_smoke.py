@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""CI gate for the tiered KV cache (docs/serving.md "Tiered KV cache").
+
+Runs the REAL CLI on the simulated 8-device CPU mesh and gates the
+degradation ladder (alias -> evict -> defer) end to end:
+
+  (a) admit-where-deferred: ``serve --kv_host_tier`` serves the
+      oversubscribed conversation trace with the tier on vs the
+      defer-only engine through pools of identical size — the Record
+      must be SUCCESS with exact==1 (greedy ids bit-identical to
+      per-request dense decode), tier deferrals == 0 where the
+      defer-only baseline deferred (> 0), evictions > 0 AND onload
+      hits > 0 (the host tier really moved blocks both ways),
+      served tokens/s strictly above the defer-only leg, and
+      leaked_blocks == 0 across every evict/restore;
+  (b) session survival: the same trace served twice into one
+      ``--session_dir`` — the SECOND (restarted) run must load the
+      committed session cache (session_loaded > 0), restore its
+      history via onload hits, allocate ZERO fresh prompt full
+      blocks (``prompt_fresh_full_blocks == 0`` — a resumed
+      conversation re-admits with no prefill blocks for its history),
+      stay exact, and leak nothing.
+
+Zero dependencies beyond the package; exit 0 = pass.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+KV_ARGS = [
+    "--dp", "1", "--tp", "2",
+    "--vocab", "64", "--embed", "64", "--head_dim", "8", "--depth", "1",
+    "--requests", "12", "--gen", "6", "--slots", "4", "--block_len", "8",
+    "--kv_host_tier", "true",
+]
+
+
+def _env() -> dict:
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.pop("TPU_PATTERNS_FAULTS", None)
+    return env
+
+
+def _run(tag: str, cmd: list[str]) -> int:
+    print(f"+ [{tag}]", " ".join(cmd), flush=True)
+    t0 = time.monotonic()
+    proc = subprocess.run(cmd, env=_env(), cwd=ROOT)
+    print(f"  [{tag}] rc={proc.returncode} "
+          f"wall={time.monotonic() - t0:.1f}s", flush=True)
+    return proc.returncode
+
+
+def _last_record(jsonl: str) -> dict:
+    with open(jsonl) as f:
+        return [json.loads(ln) for ln in f if ln.strip()][-1]
+
+
+def fail(msg: str) -> int:
+    print(f"kv tier smoke: {msg}", file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    work = tempfile.mkdtemp(prefix="kv_tier_smoke_")
+    py = [sys.executable, "-m", "tpu_patterns"]
+
+    # (a) the tier-vs-defer-only A/B on the oversubscribed trace
+    ab_jsonl = os.path.join(work, "kv_tier.jsonl")
+    if _run("kv-tier", [*py, "--jsonl", ab_jsonl, "serve", *KV_ARGS]):
+        return fail("serve --kv_host_tier exited nonzero")
+    rec = _last_record(ab_jsonl)
+    m = rec.get("metrics", {})
+    print(f"  [kv-tier] verdict={rec.get('verdict')} "
+          f"exact={m.get('exact')} deferrals={m.get('deferrals')} "
+          f"baseline_deferrals={m.get('defer_baseline_deferrals')} "
+          f"evictions={m.get('evictions')} onload={m.get('onload_hits')} "
+          f"speedup={m.get('goodput_speedup')} "
+          f"leaked={m.get('leaked_blocks')}", flush=True)
+    if rec.get("verdict") != "SUCCESS":
+        return fail(f"kv_tier Record not SUCCESS: {rec.get('notes')}")
+    if m.get("exact") != 1.0:
+        return fail("evict/restore changed greedy ids vs dense decode")
+    if not m.get("defer_baseline_deferrals", 0) > 0:
+        return fail("the defer-only baseline never deferred — the "
+                    "trace did not oversubscribe the pool")
+    if m.get("deferrals") != 0.0:
+        return fail(f"tiered engine deferred {m.get('deferrals')} "
+                    "time(s) where it should have admitted")
+    if not (m.get("evictions", 0) > 0 and m.get("onload_hits", 0) > 0):
+        return fail("the host tier never moved blocks both ways "
+                    f"(evictions={m.get('evictions')}, "
+                    f"onload={m.get('onload_hits')})")
+    if not m.get("goodput_speedup", 0) > 1.0:
+        return fail(f"goodput speedup {m.get('goodput_speedup')} <= 1 "
+                    "over the defer-only baseline")
+    if m.get("leaked_blocks") != 0.0:
+        return fail(f"{m.get('leaked_blocks')} block(s) leaked through "
+                    "evict/restore")
+
+    # (b) session survival across an engine restart
+    session = os.path.join(work, "session")
+    for leg in ("session-run1", "session-run2"):
+        leg_jsonl = os.path.join(work, f"{leg}.jsonl")
+        if _run(leg, [*py, "--jsonl", leg_jsonl, "serve", *KV_ARGS,
+                      "--session_dir", session]):
+            return fail(f"{leg} exited nonzero")
+    rec = _last_record(os.path.join(work, "session-run2.jsonl"))
+    m = rec.get("metrics", {})
+    print(f"  [session-run2] verdict={rec.get('verdict')} "
+          f"exact={m.get('exact')} "
+          f"session_loaded={m.get('session_loaded')} "
+          f"onload={m.get('onload_hits')} "
+          f"fresh_prompt_blocks={m.get('prompt_fresh_full_blocks')} "
+          f"leaked={m.get('leaked_blocks')}", flush=True)
+    if rec.get("verdict") != "SUCCESS" or m.get("exact") != 1.0:
+        return fail(
+            f"restarted session run verdict {rec.get('verdict')} "
+            f"exact {m.get('exact')} — notes: {rec.get('notes')}"
+        )
+    if not m.get("session_loaded", 0) > 0:
+        return fail("the restarted engine loaded nothing from the "
+                    "committed session cache")
+    if not m.get("onload_hits", 0) > 0:
+        return fail("the restarted engine never paged a session block "
+                    "back in")
+    if m.get("prompt_fresh_full_blocks") != 0.0:
+        return fail(
+            f"{m.get('prompt_fresh_full_blocks')} fresh prompt "
+            "block(s) allocated on resume — the session cache did not "
+            "cover the conversations' history"
+        )
+    if m.get("leaked_blocks") != 0.0:
+        return fail(f"{m.get('leaked_blocks')} block(s) leaked on the "
+                    "session leg")
+
+    print("kv tier smoke: all gates passed "
+          "(admit-where-deferred + goodput over the defer baseline + "
+          "exactness through evict/restore; session restart with zero "
+          "fresh history prefill blocks)", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

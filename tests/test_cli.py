@@ -299,16 +299,20 @@ class TestSweep:
         assert any("755MB" in s.name for s in asym)
         srv = sweep.specs_for("serve", quick=True)
         # base engine + int8 pool + gqa pool (full-verdict cells) + the
-        # PR-7 prefix-sharing and speculative-decoding record cells
+        # PR-7 prefix-sharing and speculative-decoding record cells +
+        # the tiered-KV-cache admit-where-deferred cell
         assert {s.name for s in srv} == {
             "serve.continuous", "serve.int8_pool", "serve.gqa_pool",
-            "serve.prefix_share", "serve.spec_decode",
+            "serve.prefix_share", "serve.spec_decode", "serve.kv_tier",
         }
         assert all(s.argv[0] == "serve" for s in srv)
         pre = next(s for s in srv if s.name == "serve.prefix_share")
         assert "--prefix_share" in pre.argv
         spc = next(s for s in srv if s.name == "serve.spec_decode")
         assert "--spec_k" in spc.argv
+        kvt = next(s for s in srv if s.name == "serve.kv_tier")
+        assert "--kv_host_tier" in kvt.argv
+        assert any("working_set_mult" in a for a in kvt.argv)
         lg = sweep.specs_for("loadgen", quick=True)
         # one SLO cell per scenario preset + the chaos-under-load cell
         assert {s.name for s in lg} == {

@@ -176,6 +176,35 @@ class TestScenarioGrammar:
         with pytest.raises(ValueError, match="not a int"):
             parse_scenario("chat:requests=many")
 
+    def test_working_set_mult_spellable_and_validated(self):
+        # the memory-pressure knob (tiered KV cache): spellable in the
+        # grammar, defaults off, negatives rejected at parse time
+        spec = parse_scenario("chat:working_set_mult=1.4")
+        assert spec.working_set_mult == 1.4
+        assert parse_scenario("chat").working_set_mult == 0.0
+        with pytest.raises(ValueError, match="working_set_mult"):
+            parse_scenario("chat:working_set_mult=-1")
+
+    def test_working_set_mult_does_not_move_the_schedule(self):
+        # pool sizing is the runner's business: the schedule itself
+        # (arrivals, lengths, tokens) must replay bit-identically with
+        # the knob on or off
+        a = build_schedule(parse_scenario("chat"), vocab=64, seed=3)
+        b = build_schedule(
+            parse_scenario("chat:working_set_mult=2"), vocab=64, seed=3
+        )
+        assert [
+            (t.arrival_s, t.request.tokens, t.request.n_gen) for t in a
+        ] == [
+            (t.arrival_s, t.request.tokens, t.request.n_gen) for t in b
+        ]
+
+    def test_session_dir_requires_kv_host_tier(self):
+        from tpu_patterns.loadgen import LoadGenConfig, validate_config
+
+        with pytest.raises(ValueError, match="kv_host_tier"):
+            validate_config(LoadGenConfig(session_dir="/tmp/x"))
+
     def test_inconsistent_ranges_rejected(self):
         with pytest.raises(ValueError, match="min_prompt <= mean_prompt"):
             parse_scenario("chat:mean_prompt=100")

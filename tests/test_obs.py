@@ -332,6 +332,42 @@ class TestMetrics:
         ] == 6
 
 
+    def test_kv_tier_series_export_cleanly(self):
+        # the tiered-KV-cache series (serve/engine.py evict/onload):
+        # counters carry _total, the byte-traffic histograms export
+        # bucket/sum/count triplets, all under the tpu_patterns_ glob
+        reg = obs_metrics.Registry()
+        reg.counter("tpu_patterns_serve_kv_evictions_total").inc(5)
+        reg.counter("tpu_patterns_serve_kv_onload_hits_total").inc(2)
+        reg.counter("tpu_patterns_serve_kv_tier_fallbacks_total").inc()
+        ev = reg.histogram("tpu_patterns_serve_kv_evict_bytes")
+        ev.observe(16384.0)
+        ev.observe(32768.0)
+        reg.histogram("tpu_patterns_serve_kv_onload_bytes").observe(
+            16384.0
+        )
+        text = reg.to_prom_text()
+        assert (
+            "# TYPE tpu_patterns_serve_kv_evictions_total counter"
+            in text
+        )
+        assert (
+            "# TYPE tpu_patterns_serve_kv_evict_bytes histogram" in text
+        )
+        samples = obs.parse_prom_text(text)
+        assert samples[
+            ("tpu_patterns_serve_kv_evictions_total", ())
+        ] == 5
+        assert samples[
+            ("tpu_patterns_serve_kv_onload_hits_total", ())
+        ] == 2
+        assert samples[
+            ("tpu_patterns_serve_kv_evict_bytes_count", ())
+        ] == 2
+        assert samples[
+            ("tpu_patterns_serve_kv_evict_bytes_sum", ())
+        ] == 49152.0
+
     def test_router_and_replica_series_export_with_replica_label(self):
         # the PR-12 fleet series (serve/router.py, serve/replica.py):
         # routed / prefix-hit / reroute counters and the breaker-open

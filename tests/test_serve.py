@@ -829,3 +829,619 @@ class TestRunServe:
         )
         assert obs.histogram("tpu_patterns_serve_step_ms").count > 0
         assert obs.histogram("tpu_patterns_serve_queue_wait_ms").count > 0
+
+
+# -- tiered KV cache (serve/kvtier.py) ----------------------------------
+
+
+def _conv_reqs(n_conv, bl=8, n_gen=4, seed=4, vocab=VOCAB):
+    """The conversation-shaped tier trace: one shared 2-block system
+    prompt, per-conversation history growing by one block per turn,
+    submitted turn-major (turn 2 arrives after turn 1 retired)."""
+    rng = np.random.RandomState(seed)
+    shared = rng.randint(0, vocab, size=2 * bl).tolist()
+    convs = [
+        rng.randint(0, vocab, size=2 * bl).tolist() for _ in range(n_conv)
+    ]
+    reqs, rid = [], 0
+    for turn in (1, 2):
+        for g in range(n_conv):
+            reqs.append(Request(
+                rid=rid, tokens=shared + convs[g][: turn * bl],
+                n_gen=n_gen,
+            ))
+            rid += 1
+    return reqs
+
+
+def _assert_tier_invariants(eng):
+    """The tiered refcount contract: refcounts mirror live table
+    references exactly, retained blocks are allocated-but-ref-0, the
+    free list is disjoint from both the indexed and the retained sets,
+    host-resident handles exist in the tier store and nowhere on
+    device, and nothing leaks."""
+    from collections import Counter
+
+    live = Counter(
+        b for s in eng.active for b in s.table if b != TRASH_BLOCK
+    )
+    assert dict(eng.ref) == dict(live)
+    assert TRASH_BLOCK not in eng.ref and TRASH_BLOCK not in eng.free
+    allocated = set(range(1, eng.layout.n_blocks)) - set(eng.free)
+    assert allocated == set(live) | set(eng.retained)
+    assert not set(eng.retained) & set(live)
+    assert not set(eng.free) & eng.index.blocks()
+    assert not set(eng.free) & set(eng.retained)
+    assert eng.index.host_handles() == set(eng.tier.store)
+    assert eng.leaked_blocks() == 0
+
+
+def _tier_engine(devices, *, n_blocks=15, session_dir=None, slots=4,
+                 fingerprint=None, cache_int8=False, shape=(1, 1, 1)):
+    mesh = _mesh(devices, shape)
+    mcfg = ModelConfig(**CFG, depth=1)
+    dec, params, flat = _decoder_and_params(
+        mesh, mcfg, n_blocks=n_blocks, block_len=8, max_len=40,
+        cache_int8=cache_int8,
+    )
+    eng = ServeEngine(
+        dec, params, slots=slots, kv_host_tier=True,
+        session_dir=session_dir, fingerprint=fingerprint,
+    )
+    return eng, dec, params, mesh, mcfg, flat
+
+
+class TestHostTier:
+    """kvtier.HostTier unit contract: store/capacity/commit/load."""
+
+    LEAVES = {
+        "k": ((1, 8, 2, 4), np.dtype(np.float32)),
+        "v": ((1, 8, 2, 4), np.dtype(np.float32)),
+    }
+
+    def _block(self, seed):
+        rng = np.random.RandomState(seed)
+        return {
+            n: rng.randn(*shape).astype(dt)
+            for n, (shape, dt) in self.LEAVES.items()
+        }
+
+    def test_put_get_discard_and_capacity_order(self):
+        from tpu_patterns.serve.kvtier import HostTier
+
+        tier = HostTier(self.LEAVES, block_len=8, capacity_blocks=2)
+        h0 = tier.put(self._block(0), (1, 2))
+        h1 = tier.put(self._block(1), (1, 2, 3))
+        assert len(tier) == 2 and not tier.over_capacity()
+        h2 = tier.put(self._block(2), (4,))
+        assert tier.over_capacity() and tier.oldest() == h0
+        tier.discard(h0)
+        assert not tier.over_capacity() and tier.oldest() == h1
+        assert np.array_equal(tier.get(h2)["k"], self._block(2)["k"])
+        with pytest.raises(ValueError, match="leaves"):
+            tier.put({"k": self._block(0)["k"]}, (9,))
+        with pytest.raises(ValueError, match="shape"):
+            tier.put(
+                {"k": np.zeros((2, 8, 2, 4), np.float32),
+                 "v": np.zeros((2, 8, 2, 4), np.float32)},
+                (9,),
+            )
+
+    def test_commit_load_round_trip_bit_exact(self, tmp_path):
+        from tpu_patterns.serve.kvtier import HostTier
+
+        sd = str(tmp_path / "sess")
+        tier = HostTier(
+            self.LEAVES, block_len=8, session_dir=sd,
+            fingerprint={"cfg": 1},
+        )
+        blocks = {h: self._block(h) for h in range(3)}
+        handles = {
+            tier.put(
+                {n: a.copy() for n, a in blocks[i].items()}, (10 + i,)
+            ): i
+            for i in range(3)
+        }
+        assert tier.commit() is not None
+        fresh = HostTier(
+            self.LEAVES, block_len=8, session_dir=sd,
+            fingerprint={"cfg": 1},
+        )
+        entries = fresh.load_session()
+        assert sorted(p for p, _ in entries) == [(10,), (11,), (12,)]
+        for path, h in entries:
+            want = blocks[path[0] - 10]
+            got = fresh.get(h)
+            for name in want:
+                assert np.array_equal(got[name], want[name])
+
+    def test_load_rejects_foreign_fingerprint(self, tmp_path):
+        from tpu_patterns.serve.kvtier import HostTier
+
+        sd = str(tmp_path / "sess")
+        tier = HostTier(
+            self.LEAVES, block_len=8, session_dir=sd,
+            fingerprint={"cfg": 1},
+        )
+        tier.put(self._block(0), (1,))
+        tier.commit()
+        other = HostTier(
+            self.LEAVES, block_len=8, session_dir=sd,
+            fingerprint={"cfg": 2},
+        )
+        with pytest.raises(ValueError, match="different pool/model"):
+            other.load_session()
+
+    def test_empty_and_missing_sessions(self, tmp_path):
+        from tpu_patterns.serve.kvtier import HostTier
+
+        sd = str(tmp_path / "sess")
+        tier = HostTier(self.LEAVES, block_len=8, session_dir=sd)
+        assert tier.load_session() == []  # nothing committed yet
+        tier.commit()  # an EMPTY tier commits and loads back empty
+        fresh = HostTier(self.LEAVES, block_len=8, session_dir=sd)
+        assert fresh.load_session() == []
+        assert HostTier(self.LEAVES, block_len=8).commit() is None
+
+
+class TestPrefixIndexHost:
+    """Host-resident node state transitions on the radix index."""
+
+    def _index_with(self, tokens, blocks):
+        idx = PrefixIndex(block_len=4)
+        idx.insert(tokens, blocks)
+        idx.materialize(blocks)
+        return idx
+
+    def test_evict_restore_round_trip(self):
+        idx = self._index_with(list(range(8)), [5, 6])
+        assert idx.has_resident_children(5)
+        assert not idx.has_resident_children(6)
+        idx.evict_block(6, handle=0)
+        assert idx.blocks() == {5} and idx.host_handles() == {0}
+        plan = idx.plan(list(range(8)))
+        assert plan.aliased == (5,) and plan.restores == (0,)
+        idx.restore_block(0, 9)  # back onto a DIFFERENT physical id
+        assert idx.blocks() == {5, 9} and not idx.host_handles()
+        assert idx.plan(list(range(8))).aliased == (5, 9)
+
+    def test_plan_stops_at_device_below_host(self):
+        idx = self._index_with(list(range(12)), [3, 4, 5])
+        idx.evict_block(4, handle=7)  # middle of the chain
+        plan = idx.plan(list(range(12)))
+        # device prefix, then the host run; the device node BELOW the
+        # unrestored host node is unreachable coverage — not offered
+        assert plan.aliased == (3,)
+        assert plan.restores == (7,)
+
+    def test_host_nodes_never_donate(self):
+        idx = self._index_with(list(range(8)), [5, 6])
+        idx.evict_block(6, handle=0)
+        plan = idx.plan(list(range(4)) + [4, 5, 99])
+        assert plan.donor is None  # the matching child is host-resident
+
+    def test_node_path_and_add_host_path(self):
+        idx = self._index_with(list(range(8)), [5, 6])
+        assert idx.node_path(6) == tuple(range(8))
+        fresh = PrefixIndex(block_len=4)
+        # orphan (parent chain missing) is refused
+        assert not fresh.add_host_path(tuple(range(8)), 1)
+        assert fresh.add_host_path(tuple(range(4)), 0)
+        assert fresh.add_host_path(tuple(range(8)), 1)
+        assert fresh.add_host_path(tuple(range(8)), 2) is False  # dup
+        plan = fresh.plan(list(range(8)))
+        assert plan.aliased == () and plan.restores == (0, 1)
+
+    def test_remove_handle_drops_host_subtree(self):
+        fresh = PrefixIndex(block_len=4)
+        fresh.add_host_path(tuple(range(4)), 0)
+        fresh.add_host_path(tuple(range(8)), 1)
+        assert sorted(fresh.remove_handle(0)) == [1]
+        assert fresh.host_handles() == set()
+        assert fresh.plan(list(range(8))).restores == ()
+
+    def test_state_round_trip_with_host_nodes(self):
+        idx = self._index_with(list(range(8)), [5, 6])
+        idx.evict_block(6, handle=3)
+        clone = PrefixIndex.from_state(4, idx.to_state())
+        assert clone.to_state() == idx.to_state()
+        assert clone.blocks() == {5} and clone.host_handles() == {3}
+        # tier-free trees keep the pre-tier 4-element encoding
+        plain = self._index_with(list(range(4)), [2])
+        assert all(len(e) == 4 for e in plain.to_state())
+
+
+class TestKVTier:
+    """The degradation ladder (alias -> evict -> defer) end to end."""
+
+    def test_ladder_admits_where_defer_only_defers(self, devices):
+        mesh = _mesh(devices, (1, 1, 1))
+        mcfg = ModelConfig(**CFG, depth=1)
+        dec, params, _ = _decoder_and_params(
+            mesh, mcfg, n_blocks=15, block_len=8, max_len=40
+        )
+        reqs = _conv_reqs(6)
+        base = ServeEngine(dec, params, slots=4)
+        out_base = base.run([dataclasses.replace(r) for r in reqs])
+        tier = ServeEngine(dec, params, slots=4, kv_host_tier=True)
+        out_tier = tier.run([dataclasses.replace(r) for r in reqs])
+        assert base.stats["deferrals"] > 0
+        assert tier.stats["deferrals"] == 0
+        assert tier.stats["pressure_admits"] > 0
+        assert tier.stats["evictions"] > 0
+        assert tier.stats["onload_hits"] > 0
+        assert tier.stats["steps"] < base.stats["steps"]
+        assert out_tier == out_base  # eviction invisible in the ids
+        assert tier.leaked_blocks() == 0
+        assert len(tier.retained) + len(tier.free) == 14  # all settled
+
+    def test_leaf_first_keeps_shared_parents_hot(self, devices):
+        eng, dec, params, *_ = _tier_engine(devices, n_blocks=33)
+        reqs = _conv_reqs(2)[:2]  # turn 1 only
+        eng.run([dataclasses.replace(r) for r in reqs])
+        # retained now: S1, S2 (shared, parents) + 2 private leaves
+        assert len(eng.retained) == 4
+        shared_blocks = {
+            b for b in eng.index.blocks()
+            if eng.index.has_resident_children(b)
+        }
+        assert len(shared_blocks) == 2  # S1 (child S2), S2 (child privs)
+        evicted = eng._evict_for(1, set())
+        assert evicted == 1
+        # the shared prefix stayed device-resident; a leaf went to host
+        assert shared_blocks <= eng.index.blocks()
+        assert len(eng.tier) == 1
+        _assert_tier_invariants(eng)
+
+    def test_restored_blocks_bit_identical(self, devices):
+        eng, dec, params, *_ = _tier_engine(devices, n_blocks=15)
+        stored: dict[int, dict] = {}
+        checked = []
+        orig_evict = eng.index.evict_block
+        orig_restore = eng.index.restore_block
+
+        def evict_hook(block, handle):
+            stored[handle] = {
+                n: np.array(a) for n, a in eng.tier.get(handle).items()
+            }
+            orig_evict(block, handle)
+
+        def restore_hook(handle, block):
+            orig_restore(handle, block)
+            got = dec.gather_jit(1)(
+                eng.pool, np.asarray([block], np.int32)
+            )
+            for n, a in stored[handle].items():
+                assert np.array_equal(np.asarray(got[n])[:, 0], a), n
+            checked.append(handle)
+
+        eng.index.evict_block = evict_hook
+        eng.index.restore_block = restore_hook
+        eng.run([dataclasses.replace(r) for r in _conv_reqs(6)])
+        assert eng.stats["onload_hits"] > 0
+        assert len(checked) == eng.stats["onload_hits"]
+
+    @pytest.mark.parametrize("int8", [False, True])
+    def test_session_survives_restart_bit_exact(
+        self, devices, tmp_path, int8
+    ):
+        sd = str(tmp_path / "sess")
+        eng1, dec, params, *_ = _tier_engine(
+            devices, session_dir=sd, fingerprint={"t": 1},
+            cache_int8=int8,
+        )
+        reqs = _conv_reqs(6)
+        out1 = eng1.run([dataclasses.replace(r) for r in reqs])
+        saved = {
+            eng1.tier.paths[h]: {
+                n: np.array(a) for n, a in eng1.tier.get(h).items()
+            }
+            for h in eng1.tier.store
+        }
+        assert saved  # the session banked host blocks
+        eng2, *_ = _tier_engine(
+            devices, session_dir=sd, fingerprint={"t": 1},
+            cache_int8=int8,
+        )
+        assert eng2.stats["session_loaded"] == len(saved)
+        # committed bytes reload bit-exactly, path for path
+        for h, path in eng2.tier.paths.items():
+            for n, a in eng2.tier.get(h).items():
+                assert np.array_equal(a, saved[path][n]), (path, n)
+        out2 = eng2.run([dataclasses.replace(r) for r in reqs])
+        assert out2 == out1
+        assert eng2.stats["onload_hits"] > 0
+        assert eng2.stats["prompt_fresh_full_blocks"] == 0
+        assert eng2.leaked_blocks() == 0
+
+    def test_property_random_admit_retire_evict_restore_quarantine(
+        self, devices
+    ):
+        """Satellite property test: a seeded random op sequence —
+        admissions from a shared-prefix family, scheduler iterations,
+        forced evictions, row quarantines — holds every tier invariant
+        (refcounts == live references, free/host/retained disjoint,
+        leaked == 0, host handles consistent) at every step, and the
+        pool settles clean."""
+        eng, dec, params, *_ = _tier_engine(devices, n_blocks=17)
+        rng = np.random.RandomState(7)
+        pending = _conv_reqs(8, n_gen=3) + _trace(4, n_gen=2, seed=11)
+        for i, r in enumerate(pending):
+            r.rid = i
+        pending = pending[::-1]
+        for _ in range(60):
+            op = rng.randint(4)
+            if op == 0 and pending:
+                eng.submit(pending.pop())
+            eng._retire()
+            _assert_tier_invariants(eng)
+            # (between _admit and _prefill the admitted slots hold
+            # refs but are not yet in eng.active — the loop treats
+            # admit+prefill as one transition, and so does this test)
+            admitted = eng._admit()
+            if admitted:
+                eng._prefill(admitted)
+                eng._retire()
+            _assert_tier_invariants(eng)
+            if op == 1 and eng.active:
+                victim = eng.active.pop(
+                    rng.randint(len(eng.active))
+                )
+                eng._quarantine([victim], "property-test")
+                _assert_tier_invariants(eng)
+            if op == 2:
+                eng._evict_for(rng.randint(1, 4), set())
+                _assert_tier_invariants(eng)
+            if eng.active:
+                eng._step()
+                _assert_tier_invariants(eng)
+            if not (pending or eng.queue or eng.active):
+                break
+        while eng.queue or eng.active:
+            eng._retire()
+            admitted = eng._admit()
+            if admitted:
+                eng._prefill(admitted)
+                eng._retire()
+            if eng.active:
+                eng._step()
+            _assert_tier_invariants(eng)
+        assert not pending
+        done = set(eng.done) | set(eng.failed)
+        assert done == set(range(20))  # every rid accounted
+        _assert_tier_invariants(eng)
+
+    def test_evict_transient_error_retries(self, devices):
+        from tpu_patterns import faults
+
+        eng, *_ = _tier_engine(devices, n_blocks=15)
+        try:
+            faults.configure("serve.evict:error:count=1")
+            out = eng.run(
+                [dataclasses.replace(r) for r in _conv_reqs(6)]
+            )
+        finally:
+            faults.configure(None)
+        # one transient error, retried through: the ladder still ran
+        assert eng.stats["evictions"] > 0
+        assert eng.stats["tier_fallbacks"] == 0
+        assert eng.stats["deferrals"] == 0
+        assert sorted(out) == list(range(12))
+        _assert_tier_invariants(eng)
+
+    def test_evict_deterministic_error_falls_back_to_defer(
+        self, devices
+    ):
+        from tpu_patterns import faults
+
+        mesh = _mesh(devices, (1, 1, 1))
+        mcfg = ModelConfig(**CFG, depth=1)
+        dec, params, _ = _decoder_and_params(
+            mesh, mcfg, n_blocks=15, block_len=8, max_len=40
+        )
+        reqs = _conv_reqs(6)
+        want = ServeEngine(dec, params, slots=4).run(
+            [dataclasses.replace(r) for r in reqs]
+        )
+        eng = ServeEngine(dec, params, slots=4, kv_host_tier=True)
+        try:
+            # every firing, forever: pressure re-attempts eviction on
+            # each deferred iteration, so a small count would run out
+            # and let a late wave through
+            faults.configure("serve.evict:error:count=1000000")
+            out = eng.run([dataclasses.replace(r) for r in reqs])
+        finally:
+            faults.configure(None)
+        # every eviction attempt quarantined deterministically: the
+        # engine degraded the blocks to the SEED lifetime model —
+        # discarded, nothing on host, defer the only remaining rung —
+        # and still served the whole trace exactly, corrupting nothing
+        assert eng.stats["evictions"] == 0
+        assert eng.stats["tier_fallbacks"] > 0
+        assert len(eng.tier) == 0  # no host copy ever landed
+        assert out == want
+        _assert_tier_invariants(eng)
+
+    def test_onload_deterministic_error_prefills_fresh(
+        self, devices, tmp_path
+    ):
+        from tpu_patterns import faults
+
+        sd = str(tmp_path / "sess")
+        reqs = _conv_reqs(6)
+        eng1, dec, params, *_ = _tier_engine(
+            devices, session_dir=sd, fingerprint={"t": 2}
+        )
+        out1 = eng1.run([dataclasses.replace(r) for r in reqs])
+        eng2, *_ = _tier_engine(
+            devices, session_dir=sd, fingerprint={"t": 2}
+        )
+        assert eng2.stats["session_loaded"] > 0
+        try:
+            faults.configure("serve.onload:error:count=99")
+            out2 = eng2.run([dataclasses.replace(r) for r in reqs])
+        finally:
+            faults.configure(None)
+        # restores all failed deterministically: forgotten, prefilled
+        # fresh — recompute, never corruption
+        assert eng2.stats["onload_hits"] == 0
+        assert eng2.stats["tier_fallbacks"] > 0
+        assert eng2.stats["prompt_fresh_full_blocks"] > 0
+        assert out2 == out1
+        assert eng2.leaked_blocks() == 0
+
+    def test_session_dir_requires_tier_and_replica_combo_rejected(
+        self, devices
+    ):
+        mesh = _mesh(devices, (1, 1, 1))
+        mcfg = ModelConfig(**CFG, depth=1)
+        dec, params, _ = _decoder_and_params(mesh, mcfg)
+        with pytest.raises(ValueError, match="requires kv_host_tier"):
+            ServeEngine(dec, params, slots=2, session_dir="/tmp/x")
+
+    def test_tier_metrics_reach_the_registry(self, devices):
+        from tpu_patterns import obs
+
+        evict_c = obs.counter("tpu_patterns_serve_kv_evictions_total")
+        onload_c = obs.counter(
+            "tpu_patterns_serve_kv_onload_hits_total"
+        )
+        ev_h = obs.histogram("tpu_patterns_serve_kv_evict_bytes")
+        on_h = obs.histogram("tpu_patterns_serve_kv_onload_bytes")
+        before = (evict_c.value, onload_c.value, ev_h.count, on_h.count)
+        eng, *_ = _tier_engine(devices, n_blocks=15)
+        eng.run([dataclasses.replace(r) for r in _conv_reqs(6)])
+        assert evict_c.value - before[0] == eng.stats["evictions"]
+        assert onload_c.value - before[1] == eng.stats["onload_hits"]
+        assert ev_h.count > before[2] and on_h.count > before[3]
+
+
+class TestRunServeKVTier:
+    def test_kv_tier_record_succeeds(self, devices):
+        from tpu_patterns.core.results import ResultWriter
+
+        mesh = _mesh(devices, (1, 4, 2))
+        cfg = ServeConfig(
+            vocab=VOCAB, embed=64, head_dim=8, depth=1, requests=12,
+            gen=6, slots=4, block_len=8, kv_host_tier=True,
+        )
+        (rec,) = run_serve(mesh, cfg, ResultWriter())
+        assert rec.verdict.value == "SUCCESS", rec.notes
+        m = rec.metrics
+        assert m["exact"] == 1.0
+        assert m["defer_baseline_deferrals"] > 0 and m["deferrals"] == 0
+        assert m["evictions"] > 0 and m["onload_hits"] > 0
+        assert m["goodput_speedup"] > 1.0
+        assert m["leaked_blocks"] == 0.0
+
+    def test_kv_session_record_restarts_with_zero_history_prefill(
+        self, devices, tmp_path
+    ):
+        from tpu_patterns.core.results import ResultWriter
+
+        mesh = _mesh(devices, (1, 2, 1))
+        cfg = ServeConfig(
+            vocab=VOCAB, embed=64, head_dim=8, depth=1, requests=12,
+            gen=6, slots=4, block_len=8, kv_host_tier=True,
+            session_dir=str(tmp_path / "sess"),
+        )
+        (rec1,) = run_serve(mesh, cfg, ResultWriter())
+        assert rec1.verdict.value == "SUCCESS", rec1.notes
+        assert rec1.metrics["session_loaded"] == 0.0
+        (rec2,) = run_serve(mesh, cfg, ResultWriter())
+        assert rec2.verdict.value == "SUCCESS", rec2.notes
+        m = rec2.metrics
+        assert m["exact"] == 1.0
+        assert m["session_loaded"] > 0
+        assert m["onload_hits"] > 0
+        assert m["prompt_fresh_full_blocks"] == 0.0
+
+    def test_session_dir_without_tier_rejected(self, devices):
+        from tpu_patterns.core.results import ResultWriter
+
+        mesh = _mesh(devices, (1, 1, 1))
+        cfg = ServeConfig(
+            vocab=VOCAB, embed=64, head_dim=8, depth=1,
+            session_dir="/tmp/nope",
+        )
+        with pytest.raises(ValueError, match="requires --kv_host_tier"):
+            run_serve(mesh, cfg, ResultWriter())
+
+
+class TestKVTierReviewRegressions:
+    """Pinned fixes from the pre-commit review of the tier machinery."""
+
+    def test_host_tier_put_copies_never_views(self):
+        # a stored block must own its bytes: callers hand over slices
+        # of a whole gathered wave, and keeping a view would pin the
+        # full padded wave array per block
+        from tpu_patterns.serve.kvtier import HostTier
+
+        wave = np.arange(1 * 4 * 8 * 2 * 4, dtype=np.float32).reshape(
+            1, 4, 8, 2, 4
+        )
+        tier = HostTier(
+            {"k": ((1, 8, 2, 4), np.dtype(np.float32)),
+             "v": ((1, 8, 2, 4), np.dtype(np.float32))},
+            block_len=8,
+        )
+        h = tier.put({"k": wave[:, 1], "v": wave[:, 2]}, (1,))
+        assert not np.shares_memory(tier.get(h)["k"], wave)
+        assert np.array_equal(tier.get(h)["k"], wave[:, 1])
+
+    def test_insert_never_indexes_beneath_a_host_node(self):
+        # a failed onload leaves a host node mid-path; the fresh blocks
+        # prefilled beneath it must NOT be indexed there (a device
+        # child under a host parent breaks the leaf-first shape)
+        idx = PrefixIndex(block_len=4)
+        idx.insert(list(range(8)), [5, 6])
+        idx.materialize([5, 6])
+        idx.evict_block(6, handle=0)
+        new = idx.insert(list(range(12)), [5, 7, 8])
+        assert new == []  # nothing indexed past the host node
+        assert idx.blocks() == {5}
+        assert idx.plan(list(range(12))).restores == (0,)
+
+    def test_drop_block_subtree_cascades_host_descendants(self):
+        idx = PrefixIndex(block_len=4)
+        idx.insert(list(range(8)), [5, 6])
+        idx.materialize([5, 6])
+        idx.evict_block(6, handle=3)
+        assert sorted(idx.drop_block_subtree(5)) == [3]
+        assert idx.blocks() == set() and idx.host_handles() == set()
+
+    def test_bounded_host_tier_serves_whole_trace(self, devices):
+        # host_tier_blocks=1: capacity drops constantly forget handles
+        # — including ones a plan wanted to restore — and the engine
+        # must truncate, prefill fresh, and stay exact (this path used
+        # to KeyError inside _onload)
+        mesh = _mesh(devices, (1, 1, 1))
+        mcfg = ModelConfig(**CFG, depth=1)
+        dec, params, _ = _decoder_and_params(
+            mesh, mcfg, n_blocks=15, block_len=8, max_len=40
+        )
+        reqs = _conv_reqs(6)
+        want = ServeEngine(dec, params, slots=4).run(
+            [dataclasses.replace(r) for r in reqs]
+        )
+        eng = ServeEngine(
+            dec, params, slots=4, kv_host_tier=True,
+            host_tier_blocks=1,
+        )
+        out = eng.run([dataclasses.replace(r) for r in reqs])
+        assert out == want
+        assert len(eng.tier) <= 1
+        _assert_tier_invariants(eng)
+
+    def test_pending_cow_donor_never_evicted(self, devices):
+        # a retained ref-0 donor queued for a CoW boundary copy must be
+        # ineligible for eviction until the copy flushes
+        eng, *_ = _tier_engine(devices, n_blocks=33)
+        eng._pending_cow = [(7, 9)]
+        eng.retained = {7: 0, 8: 1}
+        eng.index.insert([0] * 16, [7, 8])
+        eng.index.materialize([7, 8])
+        cands = eng._evict_candidates(set())
+        assert 7 not in cands and 8 in cands
+        eng._pending_cow = []
+        eng.retained = {}

@@ -492,6 +492,16 @@ def check_recompile_hazard() -> list[Finding]:
             signatures["copy"],
             _declared_buckets(slots),
         ),
+        # KV-tier block movers: evict/onload waves bucket over block
+        # counts bounded by the allocatable pool
+        "gather": (
+            signatures["gather"],
+            _declared_buckets(decoder.layout.n_blocks - 1),
+        ),
+        "onload": (
+            signatures["onload"],
+            _declared_buckets(decoder.layout.n_blocks - 1),
+        ),
     }
     for core, (seen, allowed) in budgets.items():
         for sig in sorted(seen - allowed):

@@ -32,6 +32,17 @@ and docs/robustness.md):
                  compiled call (``spec_k > 0`` replaces serve.step with
                  this site; same recovery contract — retries, then
                  quarantine with shared-block refcounts released)
+  serve.evict    serve/engine.py, before each KV-tier eviction wave's
+                 device→host copy (ctx: rid, rows, replica): ``error``
+                 retries under the serve policy; deterministic failure
+                 falls back to defer-only admission (WARNING Record,
+                 device state untouched); ``kill``/``crash`` mid-evict
+                 must leave either the device-resident state or the
+                 previously committed session copy — never a torn block
+  serve.onload   serve/engine.py, before each host→device page-back
+                 (ctx: rid, rows, replica): ``error`` retries;
+                 deterministic failure forgets the restore — those
+                 positions prefill fresh (recompute, never corruption)
   loadgen.arrive loadgen/runner.py, per scheduled arrival as the load
                  generator releases it into the engine (ctx: rid,
                  scenario): ``sleep``/``hang`` DELAYS the arrival,

@@ -71,6 +71,7 @@ ACTIONS = frozenset(
 KNOWN_SITES = frozenset({
     "worker.ready", "cell.run", "ckpt.save", "ckpt.restore",
     "train.step", "serve.prefill", "serve.step", "serve.verify",
+    "serve.evict", "serve.onload",
     "loadgen.arrive", "router.route", "replica.spawn", "replica.drain",
     "replica.obs_ship",
 })

@@ -134,6 +134,14 @@ class LoadGenConfig:
     n_blocks: int = 0  # 0 = auto: full slots x max_len rectangle + trash
     spec_k: int = 0  # speculative decoding under load (engine flag)
     prefix_share: bool = False  # CoW prefix sharing under load
+    # tiered KV cache under load: each scenario serves TWICE — tier on
+    # vs the defer-only engine — and banks a comparison Record gating
+    # admit-where-deferred + goodput strictly above the defer baseline
+    # (pair with a scenario spec carrying working_set_mult > 1 so the
+    # pool is genuinely oversubscribed)
+    kv_host_tier: bool = False
+    session_dir: str = ""  # persist evicted prefixes across restarts
+    host_tier_blocks: int = 0
     watchdog_s: float = 0.0
     # the workload: comma-separated scenario specs
     # ("chat,rag:requests=16" — scenarios.parse_scenario grammar)
@@ -200,24 +208,51 @@ def validate_config(cfg: LoadGenConfig) -> None:
             f"min_goodput is a token fraction in [0, 1], got "
             f"{cfg.min_goodput}"
         )
+    if cfg.session_dir and not cfg.kv_host_tier:
+        raise ValueError("session_dir requires kv_host_tier")
+
+
+def _session_fingerprint(cfg: LoadGenConfig) -> dict:
+    """The config surface a committed session's K/V depends on — the
+    model weights (seed + dims) and the block-content layout.  Passed
+    through the engine to HostTier so a session dir committed under a
+    DIFFERENT model is rejected loudly instead of silently restoring
+    wrong K/V (pool size and scenario shape deliberately excluded:
+    block contents do not depend on them)."""
+    return {
+        k: getattr(cfg, k)
+        for k in (
+            "vocab", "embed", "heads", "head_dim", "mlp_mult", "depth",
+            "dtype", "rope", "kv_heads", "cache_int8", "block_len",
+            "seed",
+        )
+    }
 
 
 def _drive(
     decoder, params, cfg: LoadGenConfig, spec: ScenarioSpec,
-    schedule: list[TimedRequest],
-) -> tuple[ServeEngine, ArrivalSource]:
+    schedule: list[TimedRequest], *, kv_tier: bool = False,
+    use_session: bool = True,
+) -> tuple[ServeEngine, ArrivalSource, float]:
     from tpu_patterns import obs
 
     eng = ServeEngine(
         decoder, params, slots=cfg.slots, watchdog_s=cfg.watchdog_s,
         prefix_share=cfg.prefix_share, spec_k=cfg.spec_k,
+        kv_host_tier=kv_tier,
+        session_dir=(
+            (cfg.session_dir or None) if kv_tier and use_session else None
+        ),
+        host_tier_blocks=cfg.host_tier_blocks,
+        fingerprint=_session_fingerprint(cfg) if kv_tier else None,
     )
     source = ArrivalSource(schedule, scenario=spec.name)
+    t0 = clock_ns()
     with obs.span(
         "loadgen.scenario", scenario=spec.name, requests=len(schedule)
     ):
         eng.run([], source=source)
-    return eng, source
+    return eng, source, (clock_ns() - t0) / 1e9
 
 
 def _pending_rids(source: ArrivalSource) -> list[int]:
@@ -348,6 +383,16 @@ def run_loadgen(mesh, cfg: LoadGenConfig, writer) -> list:
     # default pool: the full rectangle — SLO runs measure queueing and
     # latency, so deferral should come from load, not a starved pool
     n_blocks = cfg.n_blocks or (cfg.slots * per_row + 1)
+    ws_mult = max((s.working_set_mult for s in specs), default=0.0)
+    if not cfg.n_blocks and ws_mult > 0:
+        # memory-pressure mode: the scenario declares its concurrent
+        # block working set (slots rows at the worst-case request)
+        # EXCEEDS the pool by working_set_mult — the defer-only engine
+        # stalls on this pool, the tiered engine must not
+        import math
+
+        ws = cfg.slots * per_row
+        n_blocks = max(math.ceil(ws / ws_mult), per_row + 1) + 1
     decoder = make_paged_lm_decoder(
         mesh, mcfg, cfg.vocab, n_blocks=n_blocks,
         block_len=cfg.block_len, max_len=max_len,
@@ -371,7 +416,10 @@ def run_loadgen(mesh, cfg: LoadGenConfig, writer) -> list:
             f"{schedule[-1].arrival_s:.2f}s "
             f"({_scenario_commands(cfg, spec)})"
         )
-        eng, source = _drive(decoder, params, cfg, spec, schedule)
+        eng, source, wall_s = _drive(
+            decoder, params, cfg, spec, schedule,
+            kv_tier=cfg.kv_host_tier,
+        )
         st = _stats(eng, source, schedule)
         _publish_gauges(spec, st)
         ttft_p = _pcts(st["ttft"])
@@ -429,11 +477,123 @@ def run_loadgen(mesh, cfg: LoadGenConfig, writer) -> list:
         writer.record(rec)
         records.append(rec)
 
+        if cfg.kv_host_tier:
+            records.append(_kv_tier_loadgen_record(
+                decoder, params, cfg, spec, schedule, sp, writer,
+            ))
         if cfg.chaos:
             records.append(_chaos_record(
                 decoder, params, cfg, spec, schedule, st, sp, writer
             ))
     return records
+
+
+def _kv_tier_loadgen_record(
+    decoder, params, cfg, spec, schedule, sp, writer,
+):
+    """The same schedule served by the tiered engine vs the DEFER-ONLY
+    engine (the seed behavior: no retention, no tier) through the same
+    pool — both on WARM executables (the main scenario leg already
+    compiled every bucket plus the gather/onload cores, so neither leg
+    pays compile inside its measured window) — and the comparison
+    Record the ``serve.kv_tier`` sweep cell gates:
+
+    * admit-where-deferred — the defer-only leg defers (> 0) on the
+      oversubscribed pool where the tiered leg defers ZERO times;
+    * the tier really worked — evictions > 0 and ``leaked_blocks==0``
+      on the tiered leg;
+    * goodput strictly above — served tokens per wall second beats
+      the defer-only leg, and goodput-under-SLO is no worse."""
+    from tpu_patterns import obs
+    from tpu_patterns.core.results import Record, Verdict
+
+    # warm pass: wave shapes (and so gather/onload/prefill bucket
+    # sizes) depend on arrival timing, so the main leg alone does not
+    # guarantee every tier core this race will dispatch is compiled —
+    # an in-race compile would charge XLA's compiler to the ladder
+    _drive(
+        decoder, params, cfg, spec, schedule, kv_tier=True,
+        use_session=False,
+    )
+    with obs.span("loadgen.kv_tier", scenario=spec.name):
+        # session off for the race: a session cache committed by the
+        # main leg would hand this leg its history for free and the
+        # contrast would measure the cache, not the ladder
+        tier_eng, tier_source, tier_wall_s = _drive(
+            decoder, params, cfg, spec, schedule, kv_tier=True,
+            use_session=False,
+        )
+    tier_st = _stats(tier_eng, tier_source, schedule)
+    with obs.span("loadgen.kv_defer_baseline", scenario=spec.name):
+        eng, source, wall_s = _drive(
+            decoder, params, cfg, spec, schedule, kv_tier=False,
+        )
+    base_st = _stats(eng, source, schedule)
+    tier_tps = tier_st["tokens"] / tier_wall_s if tier_wall_s > 0 else 0.0
+    base_tps = base_st["tokens"] / wall_s if wall_s > 0 else 0.0
+    speedup = tier_tps / base_tps if base_tps > 0 else 0.0
+    est = tier_eng.stats
+    ok = (
+        not tier_st["unaccounted"] and not base_st["unaccounted"]
+        and base_st["deferrals"] > 0
+        and tier_st["deferrals"] == 0
+        and est["evictions"] > 0
+        and tier_eng.leaked_blocks() == 0
+        and tier_tps > base_tps
+        and tier_st["goodput"] >= base_st["goodput"]
+    )
+    rec = Record(
+        pattern="loadgen",
+        mode=f"{spec.name}_kv_tier_sp{sp}",
+        commands=(
+            f"{_scenario_commands(cfg, spec)} "
+            f"ws_mult{spec.working_set_mult:g}"
+        ),
+        metrics={
+            "goodput": round(tier_st["goodput"], 4),
+            "defer_goodput": round(base_st["goodput"], 4),
+            "tokens_per_s": round(tier_tps, 1),
+            "defer_tokens_per_s": round(base_tps, 1),
+            "goodput_speedup": round(speedup, 3),
+            "deferrals": float(tier_st["deferrals"]),
+            "defer_baseline_deferrals": float(base_st["deferrals"]),
+            "evictions": float(est["evictions"]),
+            "evict_MB": round(est["evict_bytes"] / 1e6, 4),
+            "onload_hits": float(est["onload_hits"]),
+            "onload_MB": round(est["onload_bytes"] / 1e6, 4),
+            "pressure_admits": float(est["pressure_admits"]),
+            "retained_peak": float(est["retained_peak"]),
+            "leaked_blocks": float(tier_eng.leaked_blocks()),
+        },
+        verdict=Verdict.SUCCESS if ok else Verdict.FAILURE,
+    )
+    if not base_st["deferrals"] > 0:
+        rec.notes.append(
+            "the defer-only leg never deferred — working_set_mult did "
+            "not oversubscribe the pool, the contrast is vacuous"
+        )
+    if tier_st["deferrals"] > 0:
+        rec.notes.append(
+            f"tiered leg deferred {tier_st['deferrals']} time(s) — "
+            "the ladder fell through to the cliff"
+        )
+    if est["evictions"] == 0:
+        rec.notes.append(
+            "tiered leg never evicted — retention alone absorbed the "
+            "pressure, the host tier went unexercised"
+        )
+    if not tier_tps > base_tps:
+        rec.notes.append(
+            f"goodput {tier_tps:.1f} tok/s <= defer-only "
+            f"{base_tps:.1f} — admitting earlier did not pay"
+        )
+    if tier_st["goodput"] < base_st["goodput"]:
+        rec.notes.append(
+            f"SLO goodput {tier_st['goodput']:.3f} < defer-only "
+            f"{base_st['goodput']:.3f}"
+        )
+    writer.record(rec)
+    return rec
 
 
 def _chaos_record(
@@ -447,7 +607,15 @@ def _chaos_record(
     faults.configure(cfg.chaos)
     try:
         with obs.span("loadgen.chaos", scenario=spec.name):
-            eng, source = _drive(decoder, params, cfg, spec, schedule)
+            # session OFF: the clean leg committed its session at the
+            # run boundary, and inheriting it would hand the chaos leg
+            # its history for free — the p99 bound must compare
+            # like-for-like workloads (and chaos evictions must not
+            # pollute the user's session dir)
+            eng, source, _wall = _drive(
+                decoder, params, cfg, spec, schedule,
+                kv_tier=cfg.kv_host_tier, use_session=False,
+            )
     finally:
         faults.configure(None)
     injected = _injected_total() - injected_before

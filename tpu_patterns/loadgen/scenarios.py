@@ -67,6 +67,14 @@ class ScenarioSpec:
     # shared_prefix=16`` to turn it on.
     prefix_groups: int = 0
     shared_prefix: int = 0
+    # memory-pressure knob: with > 0 the loadgen runner sizes the
+    # device pool to (concurrent block working set) / mult — a mult
+    # above 1 makes the trace's working set EXCEED the pool, the
+    # regime where the defer-only engine stalls and the tiered KV
+    # cache (--kv_host_tier) must degrade gracefully instead.  0 (the
+    # default) keeps the full-rectangle pool every existing scenario
+    # runs under.
+    working_set_mult: float = 0.0
 
     def __post_init__(self):
         if self.arrival not in ARRIVAL_PROCESSES:
@@ -108,6 +116,12 @@ class ScenarioSpec:
                 f"scenario {self.name!r}: shared_prefix "
                 f"{self.shared_prefix} leaves no room for a private "
                 f"suffix under max_prompt {self.max_prompt}"
+            )
+        if self.working_set_mult < 0:
+            raise ValueError(
+                f"scenario {self.name!r}: working_set_mult must be "
+                f">= 0 (0 = full-rectangle pool), got "
+                f"{self.working_set_mult}"
             )
 
     def deadline_ms(self, n_gen: int) -> float:

@@ -110,6 +110,14 @@ METRIC_CLASS = {
     "temp_bytes": "compiled",
     "alias_bytes": "compiled",
     "step_ms": "measured",
+    # KV-tier offload accounting (perf/registry.py _capture_kv_tier):
+    # exact host-side byte/count bookkeeping at a fixed deterministic
+    # trace — analytic-banded so a thrashing regression (evict traffic
+    # exploding at the same trace) gates everywhere, both directions
+    "kv_evict_bytes": "analytic",
+    "kv_onload_bytes": "analytic",
+    "kv_evictions": "analytic",
+    "kv_onload_hits": "analytic",
     "compile_s": "compile",
     "cached_compile_s": "compile",
     "cache_hit": "compile",

@@ -90,6 +90,7 @@ EXECUTABLES = (
     "decoder.verify",
     "copy_blocks",
     "serve.step",
+    "serve.kv_tier",
 )
 
 
@@ -789,6 +790,73 @@ def _capture_serve(mesh, cfg: PerfConfig) -> dict:
     }
 
 
+def _capture_kv_tier(mesh, cfg: PerfConfig) -> dict:
+    """The tiered-KV offload leg: the deterministic conversation trace
+    (serve/engine.py's session trace — NO wall-clock arrivals, so the
+    eviction/onload schedule is a pure function of the trace) served
+    through the oversubscribed pool with the host tier on.  Books the
+    offload traffic itself — ``kv_evict_bytes``/``kv_onload_bytes``/
+    ``kv_evictions``/``kv_onload_hits`` are exact host-side accounting,
+    ratcheted in the ``analytic`` class (±0.1%, machine-free): a
+    thrashing regression (evict bytes exploding at the fixed trace)
+    fails ``perf diff`` the same way a FLOP-count drift would —
+    plus the measured decode wall clock of the leg."""
+    from tpu_patterns.serve.engine import (
+        ServeConfig,
+        ServeEngine,
+        _kv_tier_pool,
+        _session_trace,
+    )
+
+    scfg = ServeConfig(
+        vocab=cfg.vocab, embed=cfg.embed, heads=cfg.heads,
+        head_dim=cfg.head_dim, mlp_mult=cfg.mlp_mult, depth=cfg.depth,
+        dtype=cfg.dtype, rope=cfg.rope, kv_heads=cfg.kv_heads,
+        cache_int8=cfg.cache_int8, slots=cfg.slots,
+        block_len=cfg.block_len, requests=cfg.requests, gen=cfg.gen,
+        seed=cfg.seed,
+    )
+    trace, _gen = _session_trace(scfg)
+    mcfg = _mcfg(cfg)
+
+    import jax
+
+    from tpu_patterns.models.lm import init_lm_params
+    from tpu_patterns.models.transformer import _n_experts
+
+    flat = init_lm_params(
+        jax.random.key(cfg.seed), mcfg, cfg.vocab, _n_experts(mesh, mcfg)
+    )
+    decoder, params, _n_blocks = _kv_tier_pool(mesh, scfg, mcfg, flat)
+
+    def run_once():
+        eng = ServeEngine(
+            decoder, params, slots=scfg.slots, kv_host_tier=True
+        )
+        eng.run([dataclasses.replace(r) for r in trace])
+        return eng
+
+    run_once()  # warm every bucket (gather/onload included)
+    reps = []
+    eng = None
+    for _ in range(cfg.k):
+        s0, c0 = _hist_state("tpu_patterns_serve_decode_wall_ms")
+        eng = run_once()
+        s1, c1 = _hist_state("tpu_patterns_serve_decode_wall_ms")
+        if c1 > c0:
+            reps.append((s1 - s0) / (c1 - c0))
+    st = eng.stats
+    return {
+        # exact offload accounting at the fixed trace — deterministic,
+        # so it rides the analytic ratchet band
+        "kv_evict_bytes": float(st["evict_bytes"]),
+        "kv_onload_bytes": float(st["onload_bytes"]),
+        "kv_evictions": float(st["evictions"]),
+        "kv_onload_hits": float(st["onload_hits"]),
+        "step_ms": _median_ms(reps) if reps else -1.0,
+    }
+
+
 # -- the snapshot ----------------------------------------------------------
 
 
@@ -856,6 +924,9 @@ def capture(mesh, cfg: PerfConfig, writer=None) -> dict:
     if "serve.step" in names:
         say("perf capture: serve.step (engine-driven trace)")
         executables["serve.step"] = _capture_serve(mesh, cfg)
+    if "serve.kv_tier" in names:
+        say("perf capture: serve.kv_tier (tiered-KV offload trace)")
+        executables["serve.kv_tier"] = _capture_kv_tier(mesh, cfg)
 
     n_chips = int(np.asarray(mesh.devices).size)
     for name, metrics in executables.items():

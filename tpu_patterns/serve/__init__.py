@@ -6,9 +6,15 @@
   prefix.py  host-side radix index over admitted prompts — the CoW
              block-sharing planner (alias whole-block matches, copy
              the partial boundary block)
+  kvtier.py  host KV tier: pinned host buffers cold prefix blocks
+             evict to under memory pressure (and page back from on a
+             prefix hit), plus the ckpt-committed session cache that
+             survives engine restarts — the degradation ladder
+             alias -> evict -> defer
   engine.py  iteration-level scheduler (admit / prefill / step /
-             retire / defer) with refcounted CoW prefix sharing and
-             self-drafting speculative decoding + the ``serve``
+             retire / defer) with refcounted CoW prefix sharing,
+             self-drafting speculative decoding, and the tiered KV
+             cache (retain / evict / onload) + the ``serve``
              measured patterns
   router.py  prefix-aware front door: consistent hashing on the radix
              index's block-key scheme, so shared prefixes land on the
@@ -39,6 +45,7 @@ from tpu_patterns.serve.paged import (  # noqa: F401
     TRASH_BLOCK,
     make_paged_lm_decoder,
 )
+from tpu_patterns.serve.kvtier import HostTier  # noqa: F401
 from tpu_patterns.serve.prefix import (  # noqa: F401
     PrefixIndex,
     SharePlan,

@@ -45,6 +45,7 @@ import numpy as np
 
 from tpu_patterns import ckpt, faults, rt
 from tpu_patterns.core.timing import clock_ns
+from tpu_patterns.serve.kvtier import HostTier
 from tpu_patterns.serve.paged import TRASH_BLOCK, make_paged_lm_decoder
 from tpu_patterns.serve.prefix import PrefixIndex
 
@@ -125,11 +126,16 @@ class ServeEngine:
                  watchdog_s: float = 0.0, snapshot_dir: str | None = None,
                  retry_policy=None, fingerprint=None,
                  prefix_share: bool = False, spec_k: int = 0,
-                 breaker: rt.Breaker | None = None, replica: str = ""):
+                 breaker: rt.Breaker | None = None, replica: str = "",
+                 kv_host_tier: bool = False,
+                 session_dir: str | None = None,
+                 host_tier_blocks: int = 0):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if spec_k < 0:
             raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        if session_dir and not kv_host_tier:
+            raise ValueError("session_dir requires kv_host_tier")
         self.decoder = decoder
         self.params = params
         self.slots = slots
@@ -164,11 +170,42 @@ class ServeEngine:
         # the live table references — the invariant the property tests
         # pin.  TRASH_BLOCK never appears here.
         self.ref: dict[int, int] = {}
-        # copy-on-write prefix sharing over admitted prompts
-        self.prefix_share = prefix_share
-        self.index = PrefixIndex(self.layout.block_len) if prefix_share \
+        # copy-on-write prefix sharing over admitted prompts.  The host
+        # KV tier rides the radix index (eviction/restore are node
+        # state transitions), so kv_host_tier implies the index even
+        # when sharing was not asked for explicitly.
+        self.prefix_share = prefix_share or kv_host_tier
+        self.index = (
+            PrefixIndex(self.layout.block_len)
+            if self.prefix_share
             else None
+        )
         self._pending_cow: list[tuple[int, int]] = []  # (src, dst)
+        # the host KV tier (serve/kvtier.py): retained ref-0 prefix
+        # blocks stay allocated (device-resident prefix cache), evict
+        # to host buffers when the free list runs dry (LRU by
+        # last-reference, leaf-first), and page back on a prefix hit —
+        # the degradation ladder alias -> evict -> defer
+        self.tier: HostTier | None = None
+        # device-resident retained blocks: refcount 0 but kept out of
+        # the free list so a future prefix hit can alias them; value is
+        # a monotonic last-reference stamp (LRU order, clock-free so
+        # replays are deterministic)
+        self.retained: dict[int, int] = {}
+        self._lru_clock = itertools.count()
+        if kv_host_tier:
+            leaves = decoder._pool_leaves()
+            leaf_meta = {
+                name: ((shape[0], *shape[2:]), dt)
+                for name, (shape, dt) in leaves.items()
+            }
+            self.tier = HostTier(
+                leaf_meta,
+                block_len=self.layout.block_len,
+                session_dir=session_dir,
+                capacity_blocks=host_tier_blocks,
+                fingerprint=dict(fingerprint or {}),
+            )
         # self-drafting speculative decoding: propose up to spec_k
         # tokens per row per step, verify all of them in ONE wide call
         self.spec_k = spec_k
@@ -189,6 +226,12 @@ class ServeEngine:
             "max_occupancy": 0.0, "queue_wait_ns": [],
             "peak_blocks": 0, "prefix_hit_blocks": 0, "cow_copies": 0,
             "spec_steps": 0, "spec_row_steps": 0, "spec_tokens": 0,
+            # host KV tier accounting (all 0 with the tier off)
+            "evictions": 0, "evict_bytes": 0,
+            "onload_hits": 0, "onload_bytes": 0,
+            "tier_fallbacks": 0, "pressure_admits": 0,
+            "session_loaded": 0, "prompt_fresh_full_blocks": 0,
+            "retained_peak": 0,
         }
         # preemption safety: SIGTERM/SIGINT (or an injected ``preempt``)
         # sets the event; the loop finishes the current decode step,
@@ -200,6 +243,16 @@ class ServeEngine:
         self.preempted_at: int | None = None
         self._preempt = threading.Event()
         self._preempt_signum: int | None = None
+        if self.tier is not None and session_dir:
+            # session cache: rebuild host-resident index nodes from the
+            # latest committed tier (shallow-first; orphaned chains are
+            # dropped, never fabricated) — a resumed conversation's
+            # history restores instead of re-prefilling
+            for path, handle in self.tier.load_session():
+                if not self.index.add_host_path(path, handle):
+                    self.tier.discard(handle)
+                else:
+                    self.stats["session_loaded"] += 1
 
     # -- bookkeeping -----------------------------------------------------
 
@@ -244,17 +297,23 @@ class ServeEngine:
         return self.layout.n_blocks - 1 - len(self.free)
 
     def leaked_blocks(self) -> int:
-        """Allocated blocks no live table references — 0 unless the
-        refcount bookkeeping broke (the chaos smoke gates on this)."""
-        live = sum(
-            1 for s in self.active for b in s.table if b != TRASH_BLOCK
-        )
-        return self.allocated_blocks() - live
+        """Allocated blocks neither a live table references nor the
+        tier retains — 0 unless the refcount bookkeeping broke (the
+        chaos smoke gates on this).  Retained blocks are deliberate
+        allocations (the device-resident prefix cache), accounted
+        separately so a genuine leak still reads as a leak."""
+        live = {
+            b for s in self.active for b in s.table if b != TRASH_BLOCK
+        }
+        return self.allocated_blocks() - len(live) - len(self.retained)
 
     def _release_block(self, b: int) -> None:
         """Drop one table reference; the LAST reference frees the block
         and (with sharing on) retires its index node — the index never
-        outlives the live shareable set."""
+        outlives the live shareable set.  With the host KV tier on, a
+        materialized indexed block is RETAINED instead of freed: it
+        stays allocated (and aliasable) until memory pressure evicts it
+        to host or a new row re-references it."""
         if b == TRASH_BLOCK:
             return
         n = self.ref.get(b, 0) - 1
@@ -262,9 +321,269 @@ class ServeEngine:
             self.ref[b] = n
             return
         self.ref.pop(b, None)
+        if self.tier is not None and self.index.is_materialized(b):
+            self.retained[b] = next(self._lru_clock)
+            self.stats["retained_peak"] = max(
+                self.stats["retained_peak"], len(self.retained)
+            )
+            return
         if self.index is not None:
             self.index.remove_block(b)
         self.free.append(b)
+
+    # -- host KV tier (serve/kvtier.py) ----------------------------------
+
+    def _tier_fallback(self, op: str, err: Exception) -> None:
+        """A tier operation failed deterministically: fall back to the
+        defer-only behavior for this wave — engine state is unchanged
+        (never torn) — and leave a visible WARNING trail."""
+        import os
+        import sys
+
+        from tpu_patterns import obs
+        from tpu_patterns.core.results import Record, ResultWriter, Verdict
+
+        self.stats["tier_fallbacks"] += 1
+        obs.counter("tpu_patterns_serve_kv_tier_fallbacks_total").inc()
+        obs.event("serve.kv_tier_fallback", op=op, error=str(err))
+        try:
+            ResultWriter(
+                jsonl_path=os.path.join(obs.run_dir(), "serve.jsonl"),
+                stream=sys.stderr,
+            ).record(Record(
+                pattern="serve",
+                mode="kv_tier_fallback",
+                commands=op,
+                metrics={"pid": float(os.getpid())},
+                verdict=Verdict.WARNING,
+                notes=[
+                    f"kv tier {op} failed after retries ({err}); "
+                    "falling back to defer-only admission for this "
+                    "wave — device state unchanged, never torn"
+                ],
+            ))
+        # graftlint: allow[bare-except-in-runtime] -- the fallback trail is best-effort; a logging failure must not turn a healed defer into a crash
+        except Exception:
+            pass
+
+    def _evict_wave(self, blocks: list[int], rid: int = -1) -> int:
+        """Evict ``blocks`` (retained, leaf-first-safe) to the host
+        tier in one compiled gather.  Ordering is the mid-evict crash
+        contract: device→host copy first (read-only — the pool is NOT
+        donated into the gather), then the atomic session commit, and
+        only then the engine-state transition (node→host, block→free).
+        A crash anywhere leaves either the device-resident state or
+        the previously committed host copy — never a torn block.
+        Returns how many blocks actually evicted (0 on fallback)."""
+        from tpu_patterns import obs
+
+        if not blocks:
+            return 0
+
+        def attempt():
+            # fault site: before the copy — nothing mutated, so an
+            # ``error`` here is safely retryable and a ``kill`` mid-
+            # evict leaves the device state authoritative
+            faults.inject(
+                "serve.evict", rid=rid, rows=len(blocks),
+                replica=self.replica,
+            )
+            n = _bucket(len(blocks), max(self.layout.n_blocks - 1, 1))
+            src = np.full((n,), TRASH_BLOCK, np.int32)
+            for i, b in enumerate(blocks):
+                src[i] = b
+            out = self.decoder.gather_jit(n)(self.pool, src)
+            # graftlint: allow[host-sync-in-hot-path] -- this sync IS the eviction: the device->host block copy the tier exists to make, on the cold path behind a dry free list
+            host = {name: np.asarray(leaf) for name, leaf in out.items()}
+            return [
+                (
+                    b,
+                    {name: host[name][:, i] for name in host},
+                    self.index.node_path(b),
+                )
+                for i, b in enumerate(blocks)
+            ]
+
+        try:
+            entries = faults.call_with_retry(
+                attempt, policy=self.retry_policy, site="serve.evict"
+            )
+        except (OSError, faults.Quarantined) as e:
+            self._tier_fallback("evict", e)
+            return 0
+        handles = [
+            self.tier.put(data, path) for _, data, path in entries
+        ]
+        try:
+            # commit BEFORE the state transition: from here back a
+            # crash resumes from the previous committed session with
+            # the device state intact; from here on the host copy is
+            # durable, so freeing the device block cannot tear it
+            self.tier.commit()
+        except OSError as e:  # ckpt.save already retried transients
+            for h in handles:
+                self.tier.discard(h)
+            self._tier_fallback("evict-commit", e)
+            return 0
+        for (b, _, _), h in zip(entries, handles):
+            self.index.evict_block(b, h)
+            self.retained.pop(b, None)
+            self.free.append(b)
+        n_bytes = self.tier.block_nbytes() * len(entries)
+        self.stats["evictions"] += len(entries)
+        self.stats["evict_bytes"] += n_bytes
+        obs.counter("tpu_patterns_serve_kv_evictions_total").inc(
+            len(entries)
+        )
+        obs.histogram("tpu_patterns_serve_kv_evict_bytes").observe(
+            float(n_bytes)
+        )
+        obs.event(
+            "serve.kv_evict", blocks=str(len(entries)),
+            host_blocks=str(len(self.tier)),
+        )
+        # host capacity bound: forget the least-recently-stored blocks
+        # (their subtrees with them) — a forgotten prefix re-prefills,
+        # it never corrupts
+        while self.tier.over_capacity():
+            h = self.tier.oldest()
+            for dropped in self.index.remove_handle(h):
+                self.tier.discard(dropped)
+            self.tier.discard(h)
+        return len(entries)
+
+    def _evict_candidates(self, protect: set[int]) -> list[int]:
+        """Retained blocks eligible for eviction right now: LRU by
+        last-reference stamp, leaf-first (no device-resident child —
+        shared prefix roots stay hot while anything below them does),
+        minus the blocks this admission is about to alias and minus
+        pending CoW donors — a retained ref-0 donor queued in
+        ``_pending_cow`` must keep its physical id (and contents) until
+        the wave's ``_cow_copy`` flushes, or the boundary copy would
+        read whatever reused the block."""
+        pending_donors = {src for src, _ in self._pending_cow}
+        return [
+            b
+            for b in sorted(self.retained, key=self.retained.get)
+            if b not in protect
+            and b not in pending_donors
+            and not self.index.has_resident_children(b)
+        ]
+
+    def _evict_for(
+        self, k: int, protect: set[int], rid: int = -1
+    ) -> int:
+        """Free >= ``k`` blocks by evicting cold retained blocks to the
+        host tier, leaf-first waves (evicting a leaf can make its
+        parent eligible).  A wave that fails DETERMINISTICALLY (already
+        retried) degrades those blocks to the seed lifetime model
+        instead: retained blocks are a cache of recomputable K/V, so
+        they are DISCARDED — freed with no host copy, index node
+        dropped — which is exactly what the pre-tier engine did at
+        their last release.  That keeps admission progressing (defer
+        then means genuine active-set pressure, never a wedged cache)
+        and can never corrupt: the discarded prefix simply re-prefills
+        on its next request."""
+        if self.tier is None or k <= 0:
+            return 0
+        freed = 0
+        while freed < k:
+            cands = self._evict_candidates(protect)
+            if not cands:
+                break
+            wave = cands[: k - freed]
+            done = self._evict_wave(wave, rid=rid)
+            if not done:
+                for b in wave:
+                    self.retained.pop(b, None)
+                    # cascade: a discarded block's host-resident
+                    # descendants become unreachable with it — their
+                    # tier copies must go too, or they would pin host
+                    # memory (and ride every session commit) forever
+                    for h in self.index.drop_block_subtree(b):
+                        self.tier.discard(h)
+                    self.free.append(b)
+                done = len(wave)
+            freed += done
+        return freed
+
+    def _onload(self, handles: list[int], rid: int = -1) -> list[int]:
+        """Page host-tier ``handles`` back onto fresh physical blocks
+        in one compiled scatter (table adoption / prefix hit).  Returns
+        the physical blocks, now device-resident and index-bound; on
+        deterministic failure returns [] with the free list restored —
+        the caller prefills those positions instead (never corruption,
+        at worst recompute)."""
+        from tpu_patterns import obs
+
+        if not handles:
+            return []
+        blocks = [self.free.pop() for _ in handles]
+
+        def attempt():
+            # fault site: before the scatter — the target blocks came
+            # off the free list and hold garbage either way, so an
+            # ``error`` retries cleanly
+            faults.inject(
+                "serve.onload", rid=rid, rows=len(handles),
+                replica=self.replica,
+            )
+            n = _bucket(len(handles), max(self.layout.n_blocks - 1, 1))
+            dst = np.full((n,), TRASH_BLOCK, np.int32)
+            vals = {
+                name: np.zeros((shape[0], n, *shape[1:]), dt)
+                for name, (shape, dt) in self.tier.leaf_meta.items()
+            }
+            for i, h in enumerate(handles):
+                dst[i] = blocks[i]
+                data = self.tier.get(h)
+                for name in vals:
+                    vals[name][:, i] = data[name]
+            self.pool = self.decoder.onload_jit(n)(self.pool, vals, dst)
+
+        try:
+            faults.call_with_retry(
+                attempt, policy=self.retry_policy, site="serve.onload"
+            )
+        except (OSError, faults.Quarantined) as e:
+            self.free.extend(blocks)
+            self._tier_fallback("onload", e)
+            return []
+        for h, b in zip(handles, blocks):
+            self.index.restore_block(h, b)
+            self.tier.discard(h)
+        n_bytes = self.tier.block_nbytes() * len(handles)
+        self.stats["onload_hits"] += len(handles)
+        self.stats["onload_bytes"] += n_bytes
+        obs.counter("tpu_patterns_serve_kv_onload_hits_total").inc(
+            len(handles)
+        )
+        obs.histogram("tpu_patterns_serve_kv_onload_bytes").observe(
+            float(n_bytes)
+        )
+        obs.event(
+            "serve.kv_onload", blocks=str(len(handles)),
+            host_blocks=str(len(self.tier)),
+        )
+        return blocks
+
+    def save_session(self) -> None:
+        """Persist the session cache: evict every retained block to the
+        tier (leaf-first waves) and commit — finished conversations'
+        prefixes survive an engine restart with zero fresh prefill
+        blocks for their history.  No-op without a session dir."""
+        if self.tier is None or not self.tier.session_dir:
+            return
+        while True:
+            cands = self._evict_candidates(set())
+            if not cands or not self._evict_wave(cands):
+                break
+        # a final commit even when nothing evicted: restores may have
+        # drained the store since the last eviction-wave commit
+        try:
+            self.tier.commit()
+        except OSError as e:
+            self._tier_fallback("session-commit", e)
 
     def _retire(self) -> None:
         from tpu_patterns import obs
@@ -388,26 +707,74 @@ class ServeEngine:
             # defensively: aliasing MORE than the table would hold ref
             # counts no table row ever releases
             aliased = aliased[:need]
-            if need - len(aliased) > len(self.free):
+            restores = (
+                list(plan.restores)[: need - len(aliased)]
+                if plan and self.tier is not None
+                else []
+            )
+            # the ladder's middle rung: restore targets and fresh
+            # blocks both draw on the free list — when it runs dry,
+            # evict cold retained blocks to host BEFORE giving up.
+            # The blocks this admission aliases (and its CoW donor)
+            # are protected: they are ref-0 right now but about to be
+            # referenced.
+            device_need = need - len(aliased)
+            if device_need > len(self.free):
+                protect = set(aliased)
+                if plan and plan.donor is not None:
+                    protect.add(plan.donor)
+                self._evict_for(
+                    device_need - len(self.free), protect, rid=req.rid
+                )
+            if device_need > len(self.free):
                 self.slot_pool.release(slot_tok, reusable=True)
                 self.stats["deferrals"] += 1
                 obs.counter("tpu_patterns_serve_deferrals_total").inc()
                 obs.event(
                     "serve.defer", rid=str(req.rid),
-                    need=need - len(aliased), free=len(self.free),
+                    need=device_need, free=len(self.free),
                 )
                 break  # FIFO: later (smaller) requests must not starve it
             self.queue.pop(0)
+            if aliased and need > len(self.free):
+                # without the aliased blocks this request's full
+                # rectangle would NOT have fit right now: a
+                # pressure admit, the gate the kv-tier Record counts
+                self.stats["pressure_admits"] += 1
+            # re-validate the restore run AFTER eviction: a bounded
+            # tier's capacity drop may have forgotten exactly these
+            # (oldest) handles — truncate at the first missing one so
+            # the coverage stays a contiguous prefix and the rest
+            # prefills fresh
+            for i, h in enumerate(restores):
+                if h not in self.tier.store:
+                    restores = restores[:i]
+                    break
+            restored = self._onload(restores, rid=req.rid)
+            if restores and not restored:
+                # deterministic onload failure: forget the restore run
+                # (those positions prefill fresh below) — correctness
+                # first, the host copy is only ever an optimization
+                restores = []
             fresh = [
-                self.free.pop() for _ in range(need - len(aliased))
+                self.free.pop()
+                for _ in range(need - len(aliased) - len(restored))
             ]
-            table = aliased + fresh
-            for b in aliased:
+            table = aliased + restored + fresh
+            for b in aliased + restored:
                 self.ref[b] = self.ref.get(b, 0) + 1
+                self.retained.pop(b, None)
             for b in fresh:
                 self.ref[b] = 1
-            write_from = len(aliased) * self.layout.block_len
-            if plan and plan.donor is not None and fresh:
+            covered = len(aliased) + len(restored)
+            write_from = covered * self.layout.block_len
+            # the CoW donor was planned below the deepest matched node;
+            # it only covers real positions if every restore before it
+            # actually landed
+            donor_ok = plan is not None and plan.donor is not None and (
+                not plan.restores or len(restored) == len(plan.restores)
+            )
+            if donor_ok and fresh:
                 # CoW: the boundary block copies the donor, then this
                 # row overwrites its private tail from the split point
                 self._pending_cow.append((plan.donor, fresh[0]))
@@ -418,11 +785,14 @@ class ServeEngine:
                     "serve.cow_copy", rid=str(req.rid),
                     donor=plan.donor, dst=fresh[0],
                 )
-            if aliased:
-                self.stats["prefix_hit_blocks"] += len(aliased)
+            if covered:
+                self.stats["prefix_hit_blocks"] += covered
                 obs.counter(
                     "tpu_patterns_serve_prefix_hit_blocks_total"
-                ).inc(len(aliased))
+                ).inc(covered)
+            self.stats["prompt_fresh_full_blocks"] += max(
+                0, len(req.tokens) // self.layout.block_len - covered
+            )
             own_blocks: tuple[int, ...] = ()
             if self.index is not None:
                 own_blocks = tuple(
@@ -789,8 +1159,28 @@ class ServeEngine:
                 k: v for k, v in self.stats.items() if k != "queue_wait_ns"
             },
         }
+        tree = {"pool": self.pool}
+        if self.tier is not None:
+            # the tier rides the SAME atomic commit: retained stamps +
+            # host handles/paths in the sidecar, host block contents as
+            # array leaves — a resumed engine reconstructs both tiers
+            import jax.numpy as jnp
+
+            handles, arrays = self.tier.state_arrays()
+            state["retained"] = {
+                str(b): n for b, n in self.retained.items()
+            }
+            state["tier"] = {
+                "handles": handles,
+                "paths": {
+                    str(h): list(self.tier.paths[h]) for h in handles
+                },
+            }
+            tree["tier"] = {
+                name: jnp.asarray(a) for name, a in arrays.items()
+            }
         path = ckpt.save(
-            self.snapshot_dir, step, {"pool": self.pool},
+            self.snapshot_dir, step, tree,
             extras={"engine.json": json.dumps(state)},
         )
         obs.event("serve.snapshot", step=str(step))
@@ -833,9 +1223,39 @@ class ServeEngine:
                 f"(mismatched: {sorted(diff)}) — resume with the flags "
                 "of the preempted run"
             )
-        self.pool = ckpt.restore(
-            self.snapshot_dir, {"pool": self.pool}, step=step
-        )["pool"]
+        template = {"pool": self.pool}
+        if self.tier is not None and state.get("tier") is not None:
+            import jax
+
+            n_host = len(state["tier"]["handles"])
+            template["tier"] = {
+                name: jax.ShapeDtypeStruct((n_host, *shape), dt)
+                for name, (shape, dt) in self.tier.leaf_meta.items()
+            }
+        restored_tree = ckpt.restore(
+            self.snapshot_dir, template, step=step
+        )
+        self.pool = restored_tree["pool"]
+        if "tier" in template:
+            handles = [int(h) for h in state["tier"]["handles"]]
+            paths = {
+                h: tuple(state["tier"]["paths"][str(h)]) for h in handles
+            }
+            self.tier.load_arrays(
+                handles, paths,
+                {
+                    name: np.asarray(a)
+                    for name, a in restored_tree["tier"].items()
+                },
+            )
+        self.retained = {
+            int(b): int(n)
+            for b, n in (state.get("retained") or {}).items()
+        }
+        if self.retained:
+            self._lru_clock = itertools.count(
+                max(self.retained.values()) + 1
+            )
         now = clock_ns()
         self.queue = [
             (Request(rid=q["rid"], tokens=list(q["tokens"]),
@@ -1006,6 +1426,12 @@ class ServeEngine:
                     if self._preempt.is_set():
                         self._take_preemption()
                         break
+            if self.tier is not None and self.tier.session_dir:
+                # bank the session cache at the run boundary: every
+                # retained prefix evicts to host and commits, so a
+                # restarted engine re-admits resumed conversations
+                # with zero fresh prefill blocks for their history
+                self.save_session()
         finally:
             restore_handlers()
         return dict(self.done)
@@ -1066,6 +1492,18 @@ class ServeConfig:
     snapshot_dir: str = ""
     resume: bool = False
     ids_out: str = ""  # write {rid: generated ids} JSON on completion
+    # tiered KV cache (serve/kvtier.py): retain ref-0 prefix blocks as
+    # a device-resident cache, evict them to pinned host buffers when
+    # the free list runs dry (LRU by last-reference, leaf-first), page
+    # back on prefix hit — the degradation ladder alias -> evict ->
+    # defer.  Plain runs bank the tier-vs-defer-only measured Record
+    # (admit-where-deferred, goodput strictly above, exactness);
+    # --session_dir additionally persists evicted prefixes across
+    # engine restarts through the ckpt atomic commit (session cache)
+    kv_host_tier: bool = False
+    session_dir: str = ""
+    host_tier_blocks: int = 0  # host-tier capacity in blocks (0 = unbounded)
+    min_tier_speedup: float = 1.0  # tier-vs-defer tokens/s gate
     # trace-driven load generation: a loadgen scenario spec
     # ("chat", "rag:requests=16", ... — loadgen/scenarios.py grammar).
     # Set, the run becomes the SLO measured pattern: the scenario's
@@ -1139,7 +1577,8 @@ def _serve_fingerprint(cfg: ServeConfig, n_blocks: int) -> dict:
     fp = dataclasses.asdict(cfg)
     for k in ("snapshot_dir", "resume", "ids_out", "watchdog_s",
               "min_speedup", "min_block_savings", "min_accepted",
-              "min_replica_speedup", "replica_watchdog_s", "replica_dir"):
+              "min_replica_speedup", "replica_watchdog_s", "replica_dir",
+              "session_dir", "host_tier_blocks", "min_tier_speedup"):
         fp.pop(k, None)
     fp["n_blocks"] = n_blocks  # resolved, not the 0=auto sentinel
     return fp
@@ -1162,6 +1601,9 @@ def _run_preemptible(
         snapshot_dir=cfg.snapshot_dir,
         fingerprint=_serve_fingerprint(cfg, n_blocks),
         prefix_share=cfg.prefix_share, spec_k=cfg.spec_k,
+        kv_host_tier=cfg.kv_host_tier,
+        session_dir=cfg.session_dir or None,
+        host_tier_blocks=cfg.host_tier_blocks,
     )
     resumed_from = None
     if cfg.resume:
@@ -1318,6 +1760,291 @@ def _repetitive_trace(cfg: ServeConfig, rng) -> list:
                     n_gen=cfg.gen)
         )
     return reqs
+
+
+def _session_trace(cfg: ServeConfig) -> tuple[list, int]:
+    """The conversation-shaped chat trace the KV-tier patterns serve:
+    ``G`` users sharing one system prompt (2 blocks), each with a
+    growing private history (turn 1 adds one block, turn 2 two),
+    submitted turn-major — so turn-2 requests arrive only after their
+    turn-1 wave retired, which is exactly the regime where the seed
+    engine has already freed (and must re-prefill) the history the
+    tier retains/evicts/restores.  Returns (requests, gen)."""
+    bl = cfg.block_len
+    if cfg.slots < 3:
+        raise ValueError(
+            "the kv-tier trace needs --slots >= 3 (the oversubscribed "
+            f"pool geometry degenerates below that), got {cfg.slots}"
+        )
+    n_conv = max(cfg.slots + 2, cfg.requests // 2)
+    gen = max(2, min(cfg.gen, bl))
+    rng = np.random.RandomState(cfg.seed + 4)
+    shared = rng.randint(0, cfg.vocab, size=2 * bl).tolist()
+    convs = [
+        rng.randint(0, cfg.vocab, size=2 * bl).tolist()
+        for _ in range(n_conv)
+    ]
+    reqs, rid = [], 0
+    for turn in (1, 2):
+        for g in range(n_conv):
+            reqs.append(
+                Request(
+                    rid=rid,
+                    tokens=shared + convs[g][: turn * bl],
+                    n_gen=gen,
+                )
+            )
+            rid += 1
+    return reqs, gen
+
+
+def _kv_tier_pool(mesh, cfg: ServeConfig, mcfg, flat_params):
+    """The oversubscribed pool both KV-tier patterns share: allocatable
+    blocks = shared prefix (2) + ``slots`` concurrent turn-2 private
+    working sets (3 each) — strictly under the defer-only engine's
+    turn-1 wave demand (``slots * 4``), so the seed behavior on this
+    trace is deferral while the tiered engine admits."""
+    bl = cfg.block_len
+    n_blocks = 2 + 3 * cfg.slots + 1  # + trash
+    decoder = make_paged_lm_decoder(
+        mesh, mcfg, cfg.vocab, n_blocks=n_blocks, block_len=bl,
+        max_len=5 * bl, cache_int8=cfg.cache_int8,
+    )
+    return decoder, decoder.stack_params(flat_params), n_blocks
+
+
+def _kv_oracle_cfg(cfg: ServeConfig, gen: int) -> ServeConfig:
+    """The dense-oracle shape for the session trace (prompts reach 4
+    blocks regardless of --max_prompt)."""
+    return dataclasses.replace(
+        cfg, max_prompt=4 * cfg.block_len, gen=gen
+    )
+
+
+def _kv_tier_record(mesh, sp, cfg, writer, flat_params, mcfg) -> object:
+    """Measured pattern: the SAME oversubscribed chat-session trace
+    served with the host KV tier on vs the defer-only engine (the seed
+    behavior), through pools of identical size.  Gates:
+
+    * admit-where-deferred: the defer-only leg defers (> 0) where the
+      tiered leg admits every request with zero deferrals, at least
+      one admission squeezing through only because retained blocks
+      aliased (``pressure_admits``);
+    * the tier machinery really ran: evictions > 0 AND onload hits
+      > 0 on this trace (pressure forces cold prefixes to host and a
+      later turn pages one back);
+    * goodput strictly above: served tokens/s beats the defer-only
+      leg by > ``min_tier_speedup``;
+    * exactness: every request's greedy ids bit-identical to the
+      per-request dense decode AND to the defer-only leg — eviction/
+      restore must be invisible in the token stream;
+    * hygiene: ``leaked_blocks == 0``, nothing quarantined."""
+    from tpu_patterns import obs
+    from tpu_patterns.core.results import Record, Verdict
+
+    trace, gen = _session_trace(cfg)
+    decoder, params, n_blocks = _kv_tier_pool(mesh, cfg, mcfg, flat_params)
+    total_tokens = sum(r.n_gen for r in trace)
+
+    def serve_once(tier: bool):
+        def build():
+            return ServeEngine(
+                decoder, params, slots=cfg.slots,
+                watchdog_s=cfg.watchdog_s, kv_host_tier=tier,
+                host_tier_blocks=cfg.host_tier_blocks,
+            )
+
+        build().run([dataclasses.replace(r) for r in trace])  # warm
+        eng = build()
+        t0 = clock_ns()
+        out = eng.run([dataclasses.replace(r) for r in trace])
+        return out, (clock_ns() - t0) / 1e9, eng
+
+    with obs.span("serve.kv_tier", requests=len(trace)):
+        out_tier, tier_s, eng_t = serve_once(True)
+    with obs.span("serve.kv_defer_baseline"):
+        out_base, base_s, eng_b = serve_once(False)
+
+    want_ids = _dense_expected(
+        mesh, sp, mcfg, _kv_oracle_cfg(cfg, gen), flat_params, trace
+    )
+    exact = out_tier == out_base
+    for r in trace:
+        if out_tier.get(r.rid) != want_ids[r.rid]:
+            exact = False
+            writer.progress(
+                f"kv-tier exactness: request {r.rid} diverged from "
+                f"dense decode (got {out_tier.get(r.rid)}, "
+                f"want {want_ids[r.rid]})"
+            )
+            break
+
+    tier_tps = total_tokens / tier_s if tier_s > 0 else 0.0
+    base_tps = total_tokens / base_s if base_s > 0 else 0.0
+    speedup = tier_tps / base_tps if base_tps > 0 else 0.0
+    st = eng_t.stats
+    ok = (
+        exact
+        and eng_b.stats["deferrals"] > 0
+        and st["deferrals"] == 0
+        and st["pressure_admits"] > 0
+        and st["evictions"] > 0
+        and st["onload_hits"] > 0
+        and np.isfinite(speedup)
+        and speedup > cfg.min_tier_speedup
+        and eng_t.leaked_blocks() == 0
+        and not eng_t.failed and not eng_b.failed
+    )
+    rec = Record(
+        pattern="serve",
+        mode=f"kv_tier_slots{cfg.slots}_bl{cfg.block_len}_sp{sp}",
+        commands=(
+            f"req{len(trace)} conv{len(trace) // 2}x2turns "
+            f"gen{gen} pool{n_blocks} V{cfg.vocab} depth{cfg.depth} "
+            f"{cfg.dtype}"
+        ),
+        metrics={
+            "exact": float(exact),
+            "tokens_per_s": round(tier_tps, 1),
+            "defer_tokens_per_s": round(base_tps, 1),
+            "goodput_speedup": round(speedup, 3),
+            "deferrals": float(st["deferrals"]),
+            "defer_baseline_deferrals": float(
+                eng_b.stats["deferrals"]
+            ),
+            "pressure_admits": float(st["pressure_admits"]),
+            "evictions": float(st["evictions"]),
+            "evict_MB": round(st["evict_bytes"] / 1e6, 4),
+            "onload_hits": float(st["onload_hits"]),
+            "onload_MB": round(st["onload_bytes"] / 1e6, 4),
+            "retained_peak": float(st["retained_peak"]),
+            "tier_fallbacks": float(st["tier_fallbacks"]),
+            "decode_steps": float(st["steps"]),
+            "defer_decode_steps": float(eng_b.stats["steps"]),
+            "leaked_blocks": float(eng_t.leaked_blocks()),
+        },
+        verdict=Verdict.SUCCESS if ok else Verdict.FAILURE,
+    )
+    if not exact:
+        rec.notes.append(
+            "exactness gate FAILED: evict/restore changed a request's "
+            "greedy ids vs per-request dense decode"
+        )
+    if not eng_b.stats["deferrals"] > 0:
+        rec.notes.append(
+            "the defer-only baseline never deferred — the trace did "
+            "not oversubscribe the pool, the contrast is vacuous"
+        )
+    if st["deferrals"] > 0 or st["pressure_admits"] == 0:
+        rec.notes.append(
+            f"admit-where-deferred gate FAILED: tier deferred "
+            f"{st['deferrals']} time(s), pressure admits "
+            f"{st['pressure_admits']}"
+        )
+    if st["evictions"] == 0 or st["onload_hits"] == 0:
+        rec.notes.append(
+            f"tier traffic gate FAILED: evictions {st['evictions']}, "
+            f"onload hits {st['onload_hits']} — the trace never "
+            "exercised the host tier"
+        )
+    if not speedup > cfg.min_tier_speedup:
+        rec.notes.append(
+            f"goodput {tier_tps:.1f} tok/s <= {cfg.min_tier_speedup}x "
+            f"the defer-only baseline's {base_tps:.1f} — the ladder "
+            "did not beat the cliff on this trace"
+        )
+    if eng_t.leaked_blocks():
+        rec.notes.append(
+            f"{eng_t.leaked_blocks()} block(s) leaked through "
+            "evict/restore"
+        )
+    writer.record(rec)
+    return rec
+
+
+def _kv_session_record(mesh, sp, cfg, writer, flat_params, mcfg) -> object:
+    """Measured pattern: one pass of the session trace with the tier
+    AND the session cache on (``--session_dir``).  Exactness-gated vs
+    the dense oracle; the Record carries the session-cache vitals a
+    restart leg gates on — ``session_loaded`` (host blocks adopted
+    from the committed cache at startup), ``onload_hits``, and
+    ``prompt_fresh_full_blocks`` (fresh allocations inside prompts'
+    full-block span: 0 on a resumed run means zero prefill blocks for
+    the history — the session-cache contract)."""
+    from tpu_patterns import obs
+    from tpu_patterns.core.results import Record, Verdict
+
+    trace, gen = _session_trace(cfg)
+    decoder, params, n_blocks = _kv_tier_pool(mesh, cfg, mcfg, flat_params)
+    eng = ServeEngine(
+        decoder, params, slots=cfg.slots, watchdog_s=cfg.watchdog_s,
+        kv_host_tier=True, session_dir=cfg.session_dir,
+        host_tier_blocks=cfg.host_tier_blocks,
+        fingerprint=_serve_fingerprint(cfg, n_blocks),
+    )
+    with obs.span("serve.kv_session", requests=len(trace)):
+        out = eng.run([dataclasses.replace(r) for r in trace])
+
+    want_ids = _dense_expected(
+        mesh, sp, mcfg, _kv_oracle_cfg(cfg, gen), flat_params,
+        [r for r in trace if r.rid in out],
+    )
+    mismatched = [
+        r.rid for r in trace
+        if r.rid in out and out[r.rid] != want_ids[r.rid]
+    ]
+    unaccounted = [
+        r.rid for r in trace
+        if r.rid not in out and r.rid not in eng.failed
+    ]
+    exact = not mismatched
+    st = eng.stats
+    verdict = Verdict.SUCCESS
+    if mismatched or unaccounted or eng.leaked_blocks():
+        verdict = Verdict.FAILURE
+    elif eng.failed or st["tier_fallbacks"]:
+        verdict = Verdict.WARNING
+    rec = Record(
+        pattern="serve",
+        mode=f"kv_session_slots{cfg.slots}_bl{cfg.block_len}_sp{sp}",
+        commands=(
+            f"req{len(trace)} conv{len(trace) // 2}x2turns gen{gen} "
+            f"pool{n_blocks} session={bool(cfg.session_dir)}"
+        ),
+        metrics={
+            "exact": float(exact),
+            "done_requests": float(len(out)),
+            "quarantined": float(len(eng.failed)),
+            "session_loaded": float(st["session_loaded"]),
+            "onload_hits": float(st["onload_hits"]),
+            "evictions": float(st["evictions"]),
+            "prompt_fresh_full_blocks": float(
+                st["prompt_fresh_full_blocks"]
+            ),
+            "pressure_admits": float(st["pressure_admits"]),
+            "tier_fallbacks": float(st["tier_fallbacks"]),
+            "deferrals": float(st["deferrals"]),
+            "leaked_blocks": float(eng.leaked_blocks()),
+        },
+        verdict=verdict,
+    )
+    if mismatched:
+        rec.notes.append(
+            f"exactness gate FAILED for request(s) {mismatched[:8]}: "
+            "ids diverged from the dense per-request decode (a "
+            "restored block was not bit-identical?)"
+        )
+    if unaccounted:
+        rec.notes.append(
+            f"request(s) {unaccounted[:8]} neither completed nor "
+            "quarantined — scheduler bug"
+        )
+    if eng.leaked_blocks():
+        rec.notes.append(
+            f"{eng.leaked_blocks()} block(s) leaked through the tier"
+        )
+    writer.record(rec)
+    return rec
 
 
 def random_trace(cfg: ServeConfig) -> list:
@@ -1566,6 +2293,11 @@ def run_serve(mesh, cfg: ServeConfig, writer) -> list:
                 "replica under --replica_dir); run preemption via the "
                 "single-engine trace instead"
             )
+        if cfg.kv_host_tier or cfg.session_dir:
+            raise ValueError(
+                "serve --replicas does not run the host KV tier; run "
+                "--kv_host_tier through the single-engine path"
+            )
         from tpu_patterns.serve.replica import run_replicas
 
         return run_replicas(mesh, cfg, writer)
@@ -1593,6 +2325,9 @@ def run_serve(mesh, cfg: ServeConfig, writer) -> list:
                 slots=cfg.slots, block_len=cfg.block_len,
                 n_blocks=cfg.n_blocks, spec_k=cfg.spec_k,
                 prefix_share=cfg.prefix_share,
+                kv_host_tier=cfg.kv_host_tier,
+                session_dir=cfg.session_dir,
+                host_tier_blocks=cfg.host_tier_blocks,
                 watchdog_s=cfg.watchdog_s, seed=cfg.seed,
                 time_scale=cfg.time_scale,
                 scenarios=(cfg.scenario,),
@@ -1638,6 +2373,21 @@ def run_serve(mesh, cfg: ServeConfig, writer) -> list:
         )
     if cfg.ids_out:
         raise ValueError("serve --ids_out requires --snapshot_dir")
+    if cfg.kv_host_tier:
+        # the tiered-KV measured patterns own their oversubscribed
+        # pool and conversation trace; --session_dir swaps the A/B
+        # race for the one-pass session-cache leg (run it twice with
+        # the same dir: the second run's Record proves zero fresh
+        # prefill blocks for the resumed history)
+        if cfg.session_dir:
+            return [
+                _kv_session_record(
+                    mesh, sp, cfg, writer, flat_params, mcfg
+                )
+            ]
+        return [_kv_tier_record(mesh, sp, cfg, writer, flat_params, mcfg)]
+    if cfg.session_dir:
+        raise ValueError("serve --session_dir requires --kv_host_tier")
     if cfg.prefix_share or cfg.spec_k:
         # the PR-7 measured patterns: each flag banks its own Record
         # (CoW prefix sharing's peak-block saving; speculative

@@ -1,0 +1,299 @@
+"""Host KV tier: pinned host buffers behind the paged device pool.
+
+A pool sized for production chat traffic cannot hold every
+conversation's blocks in device HBM.  The engine's first answer to a
+dry free list used to be its ONLY answer: DEFER admission — a hard
+degradation cliff.  This module is the middle rung of the ladder
+(alias → **evict** → defer): cold radix-index blocks move to host
+buffers when the free list runs dry and page back on a prefix hit or
+table adoption, so memory pressure reads as extra PCIe traffic, not
+lost sharing.
+
+The discipline is the one PAPER.md's interop suite exercises — one
+allocation's contents shared across two runtimes.  Here the two
+runtimes are the XLA device pool (``serve/paged.py``) and the host:
+the handoff happens only at block granularity, through the compiled
+``gather_blocks``/``onload_blocks`` cores, and a block is EITHER
+device-resident (a physical pool id, attended through tables) OR
+host-resident (a tier handle, invisible to attention) — never both,
+never torn.  The engine's free list and the host-resident set are
+disjoint by construction; the property tests pin it.
+
+Persistence (the session cache) rides ``ckpt/checkpoint.py``'s
+atomic-commit machinery: each eviction wave that must survive a crash
+commits the whole tier — block contents as array leaves, the radix
+paths as a ``session.json`` sidecar using the snapshot format-2 index
+serialization — under ``--session_dir``.  A crash mid-evict therefore
+leaves either the old device-resident state (eviction mutates engine
+state only AFTER the commit) or the previously committed host copy;
+restore ignores torn ``.tmp`` dirs.  A restarted engine reloads the
+committed tier, so a resumed conversation re-admits with zero fresh
+prefill blocks for its history.
+
+Host buffers are plain page-locked-eligible numpy arrays (the CPU-mesh
+CI cannot express device↔host memory kinds; on hardware the same
+block-granular protocol would target pinned allocations — noted, not
+implemented).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+# the session cache reuses the serve snapshot's format discipline: the
+# index fragment is serialized with the same nested encoding as
+# PrefixIndex.to_state (snapshot format 2), and older/foreign session
+# dirs are rejected loudly rather than resumed with silently-absent
+# blocks
+SESSION_FORMAT = 2
+
+
+class HostTier:
+    """Host-side block store keyed by integer handles.
+
+    ``leaf_meta`` maps pool leaf names to ``(block_shape, dtype)`` where
+    ``block_shape`` is the GLOBAL per-block shape (the pool leaf's shape
+    with the block axis removed, e.g. ``(depth, block_len, Hkv, D)``) —
+    host copies are unsharded, which is what lets a restore land the
+    block on any free physical id under any mesh.
+
+    ``capacity_blocks`` bounds host memory (0 = unbounded): the engine
+    drops the least-recently-stored handle past the cap — a forgotten
+    prefix re-prefills, it never corrupts.
+    """
+
+    def __init__(
+        self,
+        leaf_meta: dict[str, tuple[tuple, np.dtype]],
+        *,
+        block_len: int,
+        session_dir: str | None = None,
+        capacity_blocks: int = 0,
+        fingerprint: dict | None = None,
+    ):
+        if block_len < 1:
+            raise ValueError(f"block_len must be >= 1, got {block_len}")
+        if capacity_blocks < 0:
+            raise ValueError(
+                f"capacity_blocks must be >= 0, got {capacity_blocks}"
+            )
+        self.leaf_meta = {
+            name: (tuple(shape), np.dtype(dt))
+            for name, (shape, dt) in leaf_meta.items()
+        }
+        self.block_len = block_len
+        self.session_dir = session_dir or None
+        self.capacity_blocks = capacity_blocks
+        self.fingerprint = dict(fingerprint or {})
+        # handle -> {leaf name: host array}; dict order IS the
+        # least-recently-stored order the capacity bound drops from
+        self.store: dict[int, dict[str, np.ndarray]] = {}
+        # handle -> the block's radix path (token ids, root to node) —
+        # what the session cache needs to rebuild host-resident index
+        # nodes in a fresh engine
+        self.paths: dict[int, tuple[int, ...]] = {}
+        self._next_handle = 0
+        self._commit_step = 0
+
+    # -- in-memory store -------------------------------------------------
+
+    def block_nbytes(self) -> int:
+        """Host bytes one block costs (every leaf, global shape)."""
+        return sum(
+            int(np.prod(shape)) * dt.itemsize
+            for shape, dt in self.leaf_meta.values()
+        )
+
+    def put(self, data: dict[str, np.ndarray], path: tuple[int, ...]) -> int:
+        """Store one block's leaves; returns the tier handle."""
+        if set(data) != set(self.leaf_meta):
+            raise ValueError(
+                f"tier block leaves {sorted(data)} != pool leaves "
+                f"{sorted(self.leaf_meta)}"
+            )
+        for name, arr in data.items():
+            shape, dt = self.leaf_meta[name]
+            if tuple(arr.shape) != shape:
+                raise ValueError(
+                    f"tier block leaf {name}: shape {tuple(arr.shape)} "
+                    f"!= declared {shape}"
+                )
+            # always COPY: callers pass slices of a whole gathered
+            # wave, and a contiguous view would pin the full padded
+            # wave array in host memory for as long as this one block
+            # lives in the store
+            data[name] = np.array(arr, dtype=dt, order="C")
+        h = self._next_handle
+        self._next_handle += 1
+        self.store[h] = data
+        self.paths[h] = tuple(int(t) for t in path)
+        return h
+
+    def get(self, handle: int) -> dict[str, np.ndarray]:
+        return self.store[handle]
+
+    def discard(self, handle: int) -> None:
+        self.store.pop(handle, None)
+        self.paths.pop(handle, None)
+
+    def oldest(self) -> int | None:
+        """Least-recently-stored handle (the capacity-drop victim)."""
+        return next(iter(self.store), None)
+
+    def over_capacity(self) -> bool:
+        return 0 < self.capacity_blocks < len(self.store)
+
+    def __len__(self) -> int:
+        return len(self.store)
+
+    # -- engine-snapshot interchange -------------------------------------
+
+    def state_arrays(self) -> tuple[list[int], dict[str, np.ndarray]]:
+        """(handles, stacked arrays) — the tier's contents as one array
+        per leaf, in handle order, for riding a ckpt.save tree."""
+        handles = sorted(self.store)
+        arrays = {
+            name: np.stack([self.store[h][name] for h in handles])
+            if handles
+            else np.zeros((0, *shape), dt)
+            for name, (shape, dt) in self.leaf_meta.items()
+        }
+        return handles, arrays
+
+    def load_arrays(
+        self,
+        handles: list[int],
+        paths: dict[int, tuple[int, ...]],
+        arrays: dict[str, np.ndarray],
+    ) -> None:
+        """Rebuild the store from :meth:`state_arrays` output."""
+        self.store.clear()
+        self.paths.clear()
+        for i, h in enumerate(handles):
+            self.store[int(h)] = {
+                # copies, not views: a view would pin the whole
+                # stacked session array per block
+                name: np.array(arrays[name][i], order="C")
+                for name in self.leaf_meta
+            }
+            self.paths[int(h)] = tuple(int(t) for t in paths[h])
+        self._next_handle = max(
+            [self._next_handle] + [int(h) + 1 for h in handles]
+        )
+
+    # -- the session cache (atomic, restart-surviving) -------------------
+
+    def commit(self) -> str | None:
+        """Commit the whole tier atomically under ``session_dir``.
+
+        Array leaves ride a :func:`tpu_patterns.ckpt.save` tree; the
+        radix paths, leaf table, and config fingerprint ride the
+        ``session.json`` sidecar in the SAME commit, so a crash at any
+        point leaves the previous committed step intact (restore scans
+        for committed manifests, torn ``.tmp`` dirs are ignored and
+        swept).  No-op without a session dir.
+
+        Cost note: each commit rewrites the WHOLE tier — O(stored
+        blocks) per eviction wave, O(H^2) over a run that accumulates
+        H host blocks.  Correct and simple at pattern scale; a
+        production deployment would commit per-wave deltas (one array
+        file per handle under the same manifest-last marker) to make
+        it O(wave) — noted, not implemented."""
+        if not self.session_dir:
+            return None
+        import jax.numpy as jnp
+
+        from tpu_patterns import ckpt
+
+        handles, arrays = self.state_arrays()
+        meta = {
+            "format": SESSION_FORMAT,
+            "fingerprint": self.fingerprint,
+            "block_len": self.block_len,
+            "handles": handles,
+            "paths": {str(h): list(self.paths[h]) for h in handles},
+            "leaves": {
+                name: {"shape": list(shape), "dtype": str(dt)}
+                for name, (shape, dt) in self.leaf_meta.items()
+            },
+        }
+        self._commit_step += 1
+        # keep=2: the previous committed session survives until this
+        # one's rename lands — a mid-commit crash resumes from it
+        return ckpt.save(
+            self.session_dir,
+            self._commit_step,
+            {name: jnp.asarray(a) for name, a in arrays.items()},
+            extras={"session.json": json.dumps(meta)},
+            keep=2,
+        )
+
+    def load_session(self) -> list[tuple[tuple[int, ...], int]]:
+        """Load the latest committed session into the store; returns
+        ``[(path, handle), ...]`` sorted shallow-first so the caller can
+        rebuild host-resident index nodes parent-before-child.  An
+        empty/missing session dir returns ``[]``; a session committed
+        under a different pool/model fingerprint fails loudly."""
+        if not self.session_dir:
+            return []
+        import jax
+
+        from tpu_patterns import ckpt
+
+        step = ckpt.latest_step(self.session_dir)
+        if step is None:
+            return []
+        meta = json.loads(
+            ckpt.read_extra(self.session_dir, "session.json", step=step)
+        )
+        if meta.get("format") != SESSION_FORMAT:
+            raise ValueError(
+                f"session cache format {meta.get('format')} != "
+                f"{SESSION_FORMAT} under {self.session_dir}"
+            )
+        if (
+            self.fingerprint
+            and meta.get("fingerprint")
+            and meta["fingerprint"] != self.fingerprint
+        ):
+            diff = {
+                k
+                for k in set(self.fingerprint) | set(meta["fingerprint"])
+                if self.fingerprint.get(k) != meta["fingerprint"].get(k)
+            }
+            raise ValueError(
+                "session cache was committed under a different "
+                f"pool/model config (mismatched: {sorted(diff)}) — "
+                "point --session_dir at a fresh directory or rerun "
+                "with the original flags"
+            )
+        saved = {
+            name: (tuple(info["shape"]), np.dtype(info["dtype"]))
+            for name, info in meta["leaves"].items()
+        }
+        if saved != self.leaf_meta:
+            raise ValueError(
+                f"session cache leaf table {saved} != pool leaf table "
+                f"{self.leaf_meta}"
+            )
+        handles = [int(h) for h in meta["handles"]]
+        template = {
+            name: jax.ShapeDtypeStruct(
+                (len(handles), *shape), dt
+            )
+            for name, (shape, dt) in self.leaf_meta.items()
+        }
+        tree = ckpt.restore(self.session_dir, template, step=step)
+        arrays = {name: np.asarray(a) for name, a in tree.items()}
+        paths = {
+            h: tuple(int(t) for t in meta["paths"][str(h)])
+            for h in handles
+        }
+        self.load_arrays(handles, paths, arrays)
+        self._commit_step = step
+        return sorted(
+            ((self.paths[h], h) for h in handles),
+            key=lambda e: (len(e[0]), e[0]),
+        )

@@ -406,6 +406,8 @@ class PagedDecoder:
         object.__setattr__(self, "_step_cache", {})
         object.__setattr__(self, "_verify_cache", {})
         object.__setattr__(self, "_copy_cache", {})
+        object.__setattr__(self, "_gather_cache", {})
+        object.__setattr__(self, "_onload_cache", {})
 
     # -- pool ------------------------------------------------------------
 
@@ -501,6 +503,18 @@ class PagedDecoder:
             fn = self._copy_cache[n] = self._build_copy()
         return fn
 
+    def gather_jit(self, n: int):
+        fn = self._gather_cache.get(n)
+        if fn is None:
+            fn = self._gather_cache[n] = self._build_gather()
+        return fn
+
+    def onload_jit(self, n: int):
+        fn = self._onload_cache.get(n)
+        if fn is None:
+            fn = self._onload_cache[n] = self._build_onload()
+        return fn
+
     def compiled_buckets(self) -> tuple[int, int]:
         return len(self._prefill_cache), len(self._step_cache)
 
@@ -513,6 +527,8 @@ class PagedDecoder:
             "step": set(self._step_cache),
             "verify": set(self._verify_cache),
             "copy": set(self._copy_cache),
+            "gather": set(self._gather_cache),
+            "onload": set(self._onload_cache),
         }
 
     def _build_prefill(self, prompt_len: int):
@@ -666,6 +682,57 @@ class PagedDecoder:
                 body,
                 mesh=self.mesh,
                 in_specs=(pool_specs, P(), P()),
+                out_specs=pool_specs,
+                check_vma=False,
+            ),
+            donate_argnums=(0,),
+        )
+
+    def _build_gather(self):
+        """Device→host half of the KV tier handoff: read pool blocks
+        ``src[i]`` out of every layer and leaf (scales included) into a
+        fresh ``[depth, n, ...]`` array sharded exactly like the pool —
+        a block-axis gather, rank-local, no collective; the host side
+        (serve/kvtier.py) assembles the global value off-device.  The
+        pool is NOT donated: until the host copy is committed, the
+        device-resident state stays the authoritative one (the
+        mid-evict crash contract)."""
+
+        def body(pool, src):
+            return {n: leaf[:, src] for n, leaf in pool.items()}
+
+        pool_specs = self.pool_specs()
+        return jax.jit(
+            jax.shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=(pool_specs, P()),
+                out_specs=pool_specs,
+                check_vma=False,
+            ),
+        )
+
+    def _build_onload(self):
+        """Host→device half: scatter tier block contents ``vals``
+        (sharded like the pool) into physical blocks ``dst[i]`` across
+        every layer and leaf — the page-back that lets a restored
+        prefix alias again.  Padding lanes pass ``dst == TRASH_BLOCK``
+        with garbage values (the trash block absorbs them).  The pool
+        IS donated: a restore replaces free-list blocks whose contents
+        were already garbage."""
+
+        def body(pool, vals, dst):
+            return {
+                n: leaf.at[:, dst].set(vals[n])
+                for n, leaf in pool.items()
+            }
+
+        pool_specs = self.pool_specs()
+        return jax.jit(
+            jax.shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=(pool_specs, pool_specs, P()),
                 out_specs=pool_specs,
                 check_vma=False,
             ),

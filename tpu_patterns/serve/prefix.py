@@ -40,6 +40,19 @@ the block and calls :meth:`PrefixIndex.remove_block`, so the index
 always describes exactly the live shareable set (no eviction policy to
 tune, and ``sum(refcounts) == live table references`` stays an exact
 invariant — see tests/test_serve.py::TestRefcountInvariants).
+
+With the host KV tier on (serve/kvtier.py) a node has a THIRD state
+beyond "live" and "gone": **host-resident**.  A retained (refcount-0)
+block evicted under memory pressure keeps its node, but the node now
+carries a tier ``host`` handle instead of a physical ``block`` id
+(exactly one of the two at any time — a block is never torn between
+the runtimes).  :meth:`plan` reports host-resident continuations of
+the matched path as ``restores``; the engine pages them back onto
+fresh physical blocks (``restore_block``) when a prefix hit or table
+adoption wants them.  Eviction is leaf-first — a node may go to host
+only when it has no device-resident child (``has_resident_children``)
+— so shared prefix roots stay hot on device as long as anything below
+them does.
 """
 
 from __future__ import annotations
@@ -51,12 +64,16 @@ from typing import Iterator
 @dataclasses.dataclass
 class _Node:
     """One full block: ``key`` is its BL-token tuple, ``block`` the
-    physical id, ``parent`` the preceding block's node (or the root)."""
+    physical id, ``parent`` the preceding block's node (or the root).
+    A host-resident node (evicted to the KV tier) has ``block == -1``
+    and ``host`` set to its tier handle — exactly one of the two
+    identities at any time."""
 
     key: tuple[int, ...]
     block: int
     parent: "_Node"
     materialized: bool = False
+    host: int | None = None
     children: dict[tuple[int, ...], "_Node"] = dataclasses.field(
         default_factory=dict
     )
@@ -65,17 +82,24 @@ class _Node:
 @dataclasses.dataclass(frozen=True)
 class SharePlan:
     """What the index can do for one prompt: ``aliased`` physical blocks
-    covering its first ``len(aliased)`` logical blocks, an optional
-    ``donor`` block for a CoW boundary copy covering ``donor_len`` more
-    tokens, and ``shared_len`` — the total prefix of positions whose K/V
-    need not be recomputed (``len(aliased)*BL + donor_len``)."""
+    covering its first ``len(aliased)`` logical blocks, then (KV tier
+    only) ``restores`` — tier handles for the host-resident run that
+    CONTINUES the device-resident prefix, each wanting a fresh physical
+    block paged back from host — an optional ``donor`` block for a CoW
+    boundary copy covering ``donor_len`` more tokens, and ``shared_len``
+    — the total prefix of positions whose K/V need not be recomputed
+    (``(len(aliased) + len(restores))*BL + donor_len``)."""
 
     aliased: tuple[int, ...] = ()
     donor: int | None = None
     donor_len: int = 0
+    restores: tuple[int, ...] = ()
 
     def shared_len(self, block_len: int) -> int:
-        return len(self.aliased) * block_len + self.donor_len
+        return (
+            (len(self.aliased) + len(self.restores)) * block_len
+            + self.donor_len
+        )
 
 
 class PrefixIndex:
@@ -88,6 +112,7 @@ class PrefixIndex:
         self.root = _Node(key=(), block=-1, parent=None)  # type: ignore
         self.root.materialized = True
         self._by_block: dict[int, _Node] = {}
+        self._by_handle: dict[int, _Node] = {}
 
     # -- queries ---------------------------------------------------------
 
@@ -101,24 +126,36 @@ class PrefixIndex:
 
         Descends whole-block matches (aliasable regardless of
         materialization — same-wave aliases resolve inside the batched
-        prefill), then looks among the deepest node's MATERIALIZED
-        children for the longest partial-boundary donor."""
+        prefill), then (KV tier) the host-resident RUN continuing that
+        device prefix — handles the engine must page back before the
+        table can adopt them — then looks among the deepest matched
+        node's MATERIALIZED device children for the longest
+        partial-boundary donor.  Descent stops where the device→host
+        pattern breaks: a device child below an unrestored host node
+        would leave a coverage gap no table may contain."""
         node = self.root
         aliased: list[int] = []
+        restores: list[int] = []
         consumed = 0
         for key in self._full_blocks(tokens):
             child = node.children.get(key)
             if child is None:
                 break
-            aliased.append(child.block)
+            if child.host is not None:
+                restores.append(child.host)
+            elif restores:
+                break  # device below host: the restore run ended
+            else:
+                aliased.append(child.block)
             consumed += self.block_len
             node = child
         # boundary: longest common prefix with a materialized child
+        # still on device (a host child cannot donate without a restore)
         rest = tuple(tokens[consumed : consumed + self.block_len])
         donor, donor_len = None, 0
         if rest:
             for key, child in node.children.items():
-                if not child.materialized:
+                if not child.materialized or child.host is not None:
                     continue
                 m = 0
                 for a, b in zip(rest, key):
@@ -128,7 +165,8 @@ class PrefixIndex:
                 if m > donor_len:
                     donor, donor_len = child.block, m
         return SharePlan(
-            aliased=tuple(aliased), donor=donor, donor_len=donor_len
+            aliased=tuple(aliased), donor=donor, donor_len=donor_len,
+            restores=tuple(restores),
         )
 
     # -- mutation --------------------------------------------------------
@@ -137,11 +175,18 @@ class PrefixIndex:
         """Register ``tokens``'s fully-covered prompt blocks under the
         physical ids ``blocks`` (the request's table prefix).  Existing
         nodes are kept (they ARE the aliased blocks); new nodes start
-        unmaterialized.  Returns the newly indexed physical ids."""
+        unmaterialized.  Descent STOPS at a host-resident node (a
+        failed onload leaves one mid-path): indexing a device block
+        beneath an unrestored host parent would break the leaf-first
+        shape every other transition preserves — that row's private
+        tail simply goes unindexed.  Returns the newly indexed
+        physical ids."""
         node = self.root
         new: list[int] = []
         for j, key in enumerate(self._full_blocks(tokens)):
             child = node.children.get(key)
+            if child is not None and child.host is not None:
+                break
             if child is None:
                 child = _Node(key=key, block=blocks[j], parent=node)
                 node.children[key] = child
@@ -173,6 +218,121 @@ class PrefixIndex:
         ) is node:
             del node.parent.children[node.key]
 
+    # -- host-tier state transitions (serve/kvtier.py) -------------------
+
+    def has_resident_children(self, block: int) -> bool:
+        """Whether ``block``'s node still has a DEVICE-resident child —
+        leaf-first eviction's guard: such a node must stay hot (its
+        children's rows reference it, or a retained child below it
+        would be stranded under a host parent)."""
+        node = self._by_block.get(block)
+        if node is None:
+            return False
+        return any(c.host is None for c in node.children.values())
+
+    def evict_block(self, block: int, handle: int) -> None:
+        """Move ``block``'s node to host-resident under tier ``handle``
+        (the engine has committed the host copy and is freeing the
+        physical block)."""
+        node = self._by_block.pop(block)
+        node.block = -1
+        node.host = handle
+        self._by_handle[handle] = node
+
+    def restore_block(self, handle: int, block: int) -> None:
+        """Page ``handle``'s node back onto physical ``block`` (the
+        engine onloaded the host copy into it) — device-resident
+        again, ready to alias."""
+        node = self._by_handle.pop(handle)
+        node.host = None
+        node.block = block
+        self._by_block[block] = node
+
+    def is_materialized(self, block: int) -> bool:
+        """Whether ``block`` is indexed AND its wave's prefill
+        committed — the retention predicate (only such blocks are
+        worth keeping as a device-resident cache)."""
+        node = self._by_block.get(block)
+        return node is not None and node.materialized
+
+    def _unlink_subtree(self, node: _Node) -> list[int]:
+        """Unlink ``node`` from its parent and drop every HOST-RESIDENT
+        descendant from the handle map (device descendants cannot exist
+        below a droppable node — leaf-first); returns the descendant
+        handles so the caller can release the tier copies too."""
+        dropped: list[int] = []
+
+        def drop(n: _Node) -> None:
+            for c in n.children.values():
+                if c.host is not None:
+                    self._by_handle.pop(c.host, None)
+                    dropped.append(c.host)
+                drop(c)
+
+        drop(node)
+        if node.parent is not None and node.parent.children.get(
+            node.key
+        ) is node:
+            del node.parent.children[node.key]
+        return dropped
+
+    def remove_handle(self, handle: int) -> list[int]:
+        """Drop a host-resident node entirely (tier capacity drop or a
+        failed restore being forgotten).  Host-resident children are
+        unlinked with it — a host subtree under a removed node could
+        never be restored through a plan again.  Returns the DESCENDANT
+        handles dropped alongside, so the caller can discard their tier
+        blocks too."""
+        node = self._by_handle.pop(handle, None)
+        if node is None:
+            return []
+        return self._unlink_subtree(node)
+
+    def drop_block_subtree(self, block: int) -> list[int]:
+        """Remove ``block``'s node like :meth:`remove_block`, but also
+        unlink its HOST-RESIDENT descendants (a discarded retained
+        block may have evicted children) and return their handles so
+        the caller can release the tier copies too."""
+        node = self._by_block.pop(block, None)
+        if node is None:
+            return []
+        return self._unlink_subtree(node)
+
+    def node_path(self, block: int) -> tuple[int, ...]:
+        """``block``'s full token path root→node — the content identity
+        the session cache persists."""
+        node = self._by_block[block]
+        path: list[int] = []
+        while node is not self.root:
+            path[:0] = node.key
+            node = node.parent
+        return tuple(path)
+
+    def add_host_path(self, tokens: tuple[int, ...], handle: int) -> bool:
+        """Rebuild one host-resident node from a session-cache entry:
+        ``tokens`` is the node's full root→node path.  Every ancestor
+        must already exist (entries load shallow-first); an orphaned
+        entry returns False and is skipped — a partially persisted
+        chain must never fabricate coverage."""
+        if len(tokens) % self.block_len or not tokens:
+            return False
+        node = self.root
+        keys = list(self._full_blocks(list(tokens)))
+        for key in keys[:-1]:
+            node = node.children.get(key)
+            if node is None:
+                return False
+        leaf_key = keys[-1]
+        if leaf_key in node.children:
+            return False  # already present (device or host)
+        child = _Node(
+            key=leaf_key, block=-1, parent=node, materialized=True,
+            host=handle,
+        )
+        node.children[leaf_key] = child
+        self._by_handle[handle] = child
+        return True
+
     # -- accounting + snapshot -------------------------------------------
 
     def __len__(self) -> int:
@@ -181,16 +341,25 @@ class PrefixIndex:
     def blocks(self) -> set[int]:
         return set(self._by_block)
 
+    def host_handles(self) -> set[int]:
+        return set(self._by_handle)
+
     def to_state(self) -> list:
-        """JSON-friendly nested encoding (preorder, exact round-trip)."""
+        """JSON-friendly nested encoding (preorder, exact round-trip).
+        Snapshot format 2; the optional 5th element is the host-tier
+        handle (absent for the common all-device tree, so tier-free
+        snapshots are byte-identical to pre-tier ones)."""
 
         def enc(node: _Node) -> list:
-            return [
+            out = [
                 list(node.key),
                 node.block,
                 bool(node.materialized),
                 [enc(c) for _, c in sorted(node.children.items())],
             ]
+            if node.host is not None:
+                out.append(node.host)
+            return out
 
         return [enc(c) for _, c in sorted(self.root.children.items())]
 
@@ -199,15 +368,20 @@ class PrefixIndex:
         idx = cls(block_len)
 
         def dec(parent: _Node, enc: list) -> None:
-            key, block, materialized, children = enc
+            key, block, materialized, children = enc[:4]
+            host = enc[4] if len(enc) > 4 else None
             node = _Node(
                 key=tuple(int(t) for t in key),
                 block=int(block),
                 parent=parent,
                 materialized=bool(materialized),
+                host=int(host) if host is not None else None,
             )
             parent.children[node.key] = node
-            idx._by_block[node.block] = node
+            if node.host is not None:
+                idx._by_handle[node.host] = node
+            else:
+                idx._by_block[node.block] = node
             for c in children:
                 dec(node, c)
 

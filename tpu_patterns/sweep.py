@@ -484,6 +484,16 @@ def serve_specs(quick: bool = False) -> list[SweepSpec]:
         "24", "--gen", "6", "--slots", "8", "--block_len", "8",
         "--shared_prefix", "16",
     )
+    # the kv-tier cell owns its trace through the scenario spec (the
+    # 26-30-token prompts there assume block_len 8); only the model/
+    # pool dims ride the flags
+    kv_dims = (
+        ("--vocab", "64", "--embed", "64", "--head_dim", "8",
+         "--depth", "1", "--slots", "4", "--block_len", "8")
+        if quick
+        else ("--embed", "256", "--vocab", "1024", "--slots", "8",
+              "--block_len", "8")
+    )
     env = (("TPU_PATTERNS_SWEEP_CONFIG", "serve"),)
     return [
         SweepSpec(name="serve.continuous", argv=("serve", *small), env=env),
@@ -505,6 +515,27 @@ def serve_specs(quick: bool = False) -> list[SweepSpec]:
         SweepSpec(
             name="serve.spec_decode",
             argv=("serve", *small, "--spec_k", "4"),
+            env=env,
+        ),
+        # tiered KV cache under load: the chat preset's working_set_mult
+        # sizes the pool UNDER the concurrent working set (prompts
+        # pinned at 26-30 tokens so every request needs exactly 5
+        # blocks: the defer-only leg must defer on every full wave, the
+        # tiered leg — aliasing the 2-block shared prefix — must defer
+        # never), and the kv_tier Record gates admit-where-deferred +
+        # served tokens/s strictly above the defer-only baseline
+        SweepSpec(
+            name="serve.kv_tier",
+            argv=(
+                "serve", *kv_dims, "--kv_host_tier", "true",
+                "--time_scale", "0.02",
+                "--scenario",
+                "chat:requests=16:min_prompt=26:mean_prompt=28"
+                ":max_prompt=30:min_gen=8:mean_gen=9:max_gen=10"
+                ":prefix_groups=1:shared_prefix=16"
+                ":working_set_mult=1.4"
+                ":slo_ttft_ms=60000:slo_tpot_ms=20000",
+            ),
             env=env,
         ),
     ]
