@@ -1,0 +1,281 @@
+#!/usr/bin/env python
+"""CI gate for the live telemetry plane + SLO burn-rate mitigation
+(docs/observability.md "Live endpoints & SLO burn rate").
+
+Two legs through the REAL CLI on the simulated 8-device CPU mesh:
+
+Leg 1 (live): the chat scenario preset with ``--obs_http`` — while the
+run is IN FLIGHT the script must
+
+  (a) scrape ``/healthz`` with verdict ok (engine attached, breaker
+      closed) while the CLI process is still alive,
+  (b) scrape ``/metrics`` and find the LIVE percentile gauges
+      (``tpu_patterns_slo_live_ttft_p99_ms``) — tail latency visible
+      mid-run, not post-mortem,
+  (c) scrape ``/statusz`` at least once,
+
+and the run itself must exit 0 with a SUCCESS Record.
+
+Leg 2 (burn): the same preset under a chaos spec of injected
+``serve.step`` sleeps with ``--burn_mitigation shed`` and a tight TPOT
+budget — the stalled decode burns the SLO budget, so the run must
+
+  (d) fire the burn-rate WARNING Record (``slo.jsonl`` in the obs dir,
+      mode ``slo_burn``),
+  (e) shed admissions (chaos Record ``shed`` > 0) with the accounting
+      identity done + failed + dropped + shed == scheduled,
+  (f) still exit 0 — mitigation is degradation, not failure.
+
+Zero dependencies beyond the package; exit 0 = pass.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# small model, enough requests spread over a few wall seconds that the
+# run is reliably alive when the script scrapes it mid-flight
+MODEL = [
+    "--vocab", "64", "--embed", "64", "--head_dim", "8", "--depth", "1",
+    "--slots", "2", "--block_len", "8",
+]
+CHAT_LIVE = (
+    "chat:requests=16:rate_rps=4:min_prompt=4:mean_prompt=8"
+    ":max_prompt=16:min_gen=4:mean_gen=8:max_gen=12"
+)
+CHAT_BURN = (
+    "chat:requests=12:rate_rps=8:min_prompt=4:mean_prompt=8"
+    ":max_prompt=16:min_gen=4:mean_gen=6:max_gen=8"
+    ":chaos_p99_mult=10000"
+)
+
+PORT_RE = re.compile(r"obs http plane live on http://127\.0\.0\.1:(\d+)")
+
+
+def fail(msg: str) -> int:
+    print(f"obs live smoke: {msg}", file=sys.stderr)
+    return 1
+
+
+def _env() -> dict:
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.pop("TPU_PATTERNS_FAULTS", None)
+    return env
+
+
+def _spawn(tag: str, cmd: list[str], env: dict):
+    print(f"+ [{tag}]", " ".join(cmd), flush=True)
+    proc = subprocess.Popen(
+        cmd, env=env, cwd=ROOT, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, bufsize=1,
+    )
+    lines: list[str] = []
+
+    def drain():
+        for line in proc.stdout:
+            lines.append(line)
+            sys.stdout.write(f"  [{tag}] {line}")
+    t = threading.Thread(target=drain, daemon=True)
+    t.start()
+    return proc, lines, t
+
+
+def _wait_port(lines: list[str], proc, timeout_s: float = 120.0) -> int:
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        for ln in list(lines):
+            m = PORT_RE.search(ln)
+            if m:
+                return int(m.group(1))
+        if proc.poll() is not None:
+            return -1
+        time.sleep(0.05)
+    return -1
+
+
+def _get(port: int, path: str):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5
+        ) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def leg_live(work: str) -> int:
+    jsonl = os.path.join(work, "live.jsonl")
+    obs_dir = os.path.join(work, "obs_live")
+    proc, lines, drainer = _spawn("live", [
+        sys.executable, "-m", "tpu_patterns",
+        "--jsonl", jsonl, "--obs-dir", obs_dir,
+        "loadgen", "--dp", "1", "--tp", "2", *MODEL,
+        "--obs_http", "18931",
+        "--time_scale", "1.0",
+        "--slo_ttft_ms", "60000", "--slo_tpot_ms", "20000",
+        "--scenarios", CHAT_LIVE,
+    ], _env())
+    port = _wait_port(lines, proc)
+    if port < 0:
+        proc.kill()
+        return fail("the plane's announce line never appeared")
+
+    saw_health = saw_live_gauge = saw_statusz = False
+    while proc.poll() is None:
+        try:
+            code, body = _get(port, "/healthz")
+            if code == 200:
+                h = json.loads(body)
+                if h["verdict"] == "ok" and h["engine"] is not None:
+                    saw_health = True
+            code, body = _get(port, "/statusz")
+            saw_statusz = saw_statusz or code == 200
+            code, body = _get(port, "/metrics")
+            if (
+                code == 200
+                and "tpu_patterns_slo_live_ttft_p99_ms" in body
+                and proc.poll() is None
+            ):
+                saw_live_gauge = True
+        except OSError:
+            pass  # plane winding down with the run
+        if saw_health and saw_live_gauge and saw_statusz:
+            break
+        time.sleep(0.1)
+    rc = proc.wait(timeout=300)
+    drainer.join(timeout=10)
+    if rc != 0:
+        return fail(f"live leg CLI exited {rc}")
+    if not saw_health:
+        return fail("/healthz never answered ok with an engine mid-run")
+    if not saw_live_gauge:
+        return fail(
+            "/metrics never served the live ttft p99 gauge mid-run"
+        )
+    if not saw_statusz:
+        return fail("/statusz never answered mid-run")
+    with open(jsonl) as f:
+        recs = [json.loads(ln) for ln in f if ln.strip()]
+    if not recs or recs[-1].get("verdict") != "SUCCESS":
+        return fail(f"live leg Record not SUCCESS: {recs and recs[-1]}")
+    print(
+        "obs live smoke: leg 1 PASS (mid-run healthz ok, live p99 "
+        "gauge served, statusz answered)", flush=True,
+    )
+    return 0
+
+
+def leg_burn(work: str) -> int:
+    jsonl = os.path.join(work, "burn.jsonl")
+    obs_dir = os.path.join(work, "obs_burn")
+    cmd = [
+        sys.executable, "-m", "tpu_patterns",
+        "--jsonl", jsonl, "--obs-dir", obs_dir, "--obs-dump",
+        "loadgen", "--dp", "1", "--tp", "2", *MODEL,
+        "--time_scale", "0.02",
+        # tight TPOT so the injected decode stalls read as bad tokens;
+        # min_goodput 0 keeps the CLEAN leg's verdict about coverage,
+        # not CPU latency (the chaos twin carries the mitigation gates)
+        "--slo_ttft_ms", "2000", "--slo_tpot_ms", "150",
+        "--min_goodput", "0",
+        "--burn_mitigation", "shed",
+        "--slo_fast_s", "3", "--slo_slow_s", "10",
+        "--slo_budget", "0.05", "--burn_multiplier", "1.0",
+        "--scenarios", CHAT_BURN,
+        "--chaos", "serve.step:sleep:delay_s=0.5:count=8:after=1",
+    ]
+    print("+ [burn]", " ".join(cmd), flush=True)
+    t0 = time.monotonic()
+    proc = subprocess.run(cmd, env=_env(), cwd=ROOT)
+    print(
+        f"  [burn] rc={proc.returncode} "
+        f"wall={time.monotonic() - t0:.1f}s", flush=True,
+    )
+    if proc.returncode != 0:
+        return fail(f"burn leg CLI exited {proc.returncode} — "
+                    "mitigation must degrade, never fail the run")
+    with open(jsonl) as f:
+        recs = [json.loads(ln) for ln in f if ln.strip()]
+    chaos = [r for r in recs if "_chaos_" in r.get("mode", "")]
+    if not chaos:
+        return fail(f"no chaos Record banked ({[r.get('mode') for r in recs]})")
+    m = chaos[-1]["metrics"]
+    print(
+        f"obs live smoke: chaos verdict={chaos[-1].get('verdict')} "
+        f"done={m.get('done')} failed={m.get('failed')} "
+        f"dropped={m.get('dropped')} shed={m.get('shed')} "
+        f"burn_fires={m.get('slo_burn_fires')}", flush=True,
+    )
+    if chaos[-1].get("verdict") == "FAILURE":
+        return fail("chaos Record FAILURE")
+    # (d) the burn WARNING Record fired
+    slo_path = os.path.join(obs_dir, "slo.jsonl")
+    if not os.path.exists(slo_path):
+        return fail("no slo.jsonl — the burn WARNING Record never fired")
+    with open(slo_path) as f:
+        burns = [
+            json.loads(ln) for ln in f
+            if ln.strip() and '"slo_burn"' in ln
+        ]
+    if not any(
+        b.get("mode") == "slo_burn" and b.get("verdict") == "WARNING"
+        for b in burns
+    ):
+        return fail(f"slo.jsonl holds no slo_burn WARNING ({burns})")
+    # (e) sheds happened and the identity closes
+    if not m.get("shed", 0) > 0:
+        return fail("chaos leg shed nothing — mitigation never engaged")
+    total = (
+        m.get("done", 0) + m.get("failed", 0) + m.get("dropped", 0)
+        + m.get("shed", 0)
+    )
+    if total != m.get("requests"):
+        return fail(
+            f"identity broken: done {m.get('done')} + failed "
+            f"{m.get('failed')} + dropped {m.get('dropped')} + shed "
+            f"{m.get('shed')} != {m.get('requests')} scheduled"
+        )
+    if m.get("covered") != 1.0:
+        return fail("chaos coverage gate failed")
+    # the shed counter reached the metrics dump too
+    mpath = os.path.join(obs_dir, "metrics.jsonl")
+    with open(mpath) as f:
+        shed_total = sum(
+            float(json.loads(ln).get("value", 0))
+            for ln in f
+            if ln.strip()
+            and json.loads(ln).get("metric")
+            == "tpu_patterns_serve_shed_total"
+        )
+    if not shed_total > 0:
+        return fail("tpu_patterns_serve_shed_total missing from the dump")
+    print(
+        f"obs live smoke: leg 2 PASS (burn WARNING fired, "
+        f"{int(m['shed'])} shed, identity closed)", flush=True,
+    )
+    return 0
+
+
+def main() -> int:
+    work = tempfile.mkdtemp(prefix="obs_live_smoke_")
+    rc = leg_live(work)
+    if rc:
+        return rc
+    return leg_burn(work)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

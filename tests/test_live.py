@@ -1,0 +1,508 @@
+"""Live telemetry plane (obs/live.py) + SLO burn-rate monitor
+(obs/slo.py): dual-window burn accounting, the shed / spec_off
+mitigation ladder in the serve engine, the /metrics /healthz /statusz
+endpoints (race-free scrapes, fault-injected 503s, fleet lanes), the
+engine's rt.LeaseTable in-flight ledger, and the `obs watch` poller."""
+
+import dataclasses
+import io
+import json
+import time
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_patterns import faults, obs, rt
+from tpu_patterns.obs import live as obs_live
+from tpu_patterns.obs.live import ObsHttp
+from tpu_patterns.obs.slo import SloConfig, SloMonitor
+from tpu_patterns.serve import Request, ServeEngine
+
+from test_serve import CFG, _decoder_and_params, _mesh, _trace
+from tpu_patterns.models.transformer import ModelConfig
+
+MCFG = ModelConfig(**CFG)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.configure("")
+    yield
+    faults.configure(None)
+
+
+# NB: no autouse detach fixture — the class-scoped ``served_engine``
+# plane stays attached across its whole test class; tests that attach
+# their own target detach it themselves (engine.run() detaches on exit
+# by contract).
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5
+        ) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _get_json(port, path):
+    code, body = _get(port, path)
+    return code, json.loads(body)
+
+
+# -- the burn-rate monitor -------------------------------------------------
+
+
+class TestSloMonitor:
+    def test_good_tokens_keep_burn_at_zero(self):
+        m = SloMonitor(SloConfig(
+            fast_window_s=1.0, slow_window_s=2.0, budget=0.1,
+            multiplier=1.0,
+        ))
+        for _ in range(5):
+            m.observe(tokens=10, met=True)
+        snap = m.snapshot()
+        assert snap["burn_rate_fast"] == 0.0
+        assert not m.mitigating()
+        assert m.fires == 0
+
+    def test_bad_tokens_trip_once_and_recover_on_the_window(self):
+        m = SloMonitor(SloConfig(
+            fast_window_s=0.2, slow_window_s=0.4, budget=0.1,
+            multiplier=1.0,
+        ))
+        m.observe(tokens=10, met=True)
+        m.observe(tokens=10, met=False)  # 50% bad >> 10% budget
+        assert m.mitigating()
+        assert m.fires == 1
+        m.observe(tokens=10, met=False)  # still burning: no re-fire
+        assert m.fires == 1
+        # the episode ends when the buckets age out — no new traffic,
+        # no operator action
+        time.sleep(0.5)
+        assert not m.mitigating()
+        # a fresh burst trips a NEW episode
+        m.observe(tokens=10, met=False)
+        assert m.mitigating()
+        assert m.fires == 2
+
+    def test_burn_warning_record_and_gauges_published(self, tmp_path):
+        obs.configure(str(tmp_path))
+        try:
+            m = SloMonitor(SloConfig(
+                fast_window_s=1.0, slow_window_s=2.0, budget=0.1,
+                multiplier=1.0,
+            ))
+            m.observe(tokens=20, met=False, ttft_ms=12.0, tpot_ms=3.0)
+            assert m.mitigating()
+        finally:
+            obs.configure(None)
+        recs = [
+            json.loads(ln)
+            for ln in (tmp_path / "slo.jsonl").read_text().splitlines()
+        ]
+        assert recs[-1]["mode"] == "slo_burn"
+        assert recs[-1]["verdict"] == "WARNING"
+        assert recs[-1]["metrics"]["burn_rate_fast"] > 1.0
+        reg = obs.metrics_registry()
+        samples = obs.parse_prom_text(reg.render())
+        assert samples[(
+            "tpu_patterns_slo_burn_rate", (("window", "fast"),)
+        )] > 1.0
+        # live tail-latency gauges reached the registry too
+        assert samples[("tpu_patterns_slo_live_ttft_p99_ms", ())] == 12.0
+        assert samples[("tpu_patterns_slo_live_tpot_p99_ms", ())] == 3.0
+
+    def test_config_invariants_rejected(self):
+        with pytest.raises(ValueError):
+            SloConfig(fast_window_s=10.0, slow_window_s=5.0)
+        with pytest.raises(ValueError):
+            SloConfig(budget=0.0)
+        with pytest.raises(ValueError):
+            SloConfig(multiplier=2.0, recover=3.0)
+
+
+# -- the mitigation ladder in the engine -----------------------------------
+
+
+def _bad_deadline(reqs):
+    """The same trace with an impossible deadline: every completed
+    request books BAD tokens — the deterministic burn trigger."""
+    return [
+        dataclasses.replace(r, tokens=list(r.tokens), deadline_ms=1e-6)
+        for r in reqs
+    ]
+
+
+class TestShedMitigation:
+    def test_burn_sheds_admissions_identity_closes(self, devices):
+        mesh = _mesh(devices, (1, 2, 2))
+        dec, params, _ = _decoder_and_params(mesh, MCFG)
+        eng = ServeEngine(
+            dec, params, slots=1, burn_mitigation="shed",
+            slo=SloConfig(
+                fast_window_s=30, slow_window_s=60, budget=0.01,
+                multiplier=1.0,
+            ),
+        )
+        trace = _bad_deadline(_trace(6, min_p=3, max_p=8, n_gen=4))
+        out = eng.run(trace)
+        # the first request completes (slots=1), books its tokens bad,
+        # trips the fast window, and every later admission sheds —
+        # counted, never silently dropped
+        assert eng.slo.fires >= 1
+        assert eng.shed and eng.stats["sheds"] == len(eng.shed)
+        assert len(out) + len(eng.failed) + len(eng.shed) == len(trace)
+        assert eng.leaked_blocks() == 0
+        assert len(eng.inflight) == 0
+        assert rt.metric_total("tpu_patterns_serve_shed_total") >= len(
+            eng.shed
+        )
+
+    def test_window_recovery_reopens_admission(self, devices):
+        mesh = _mesh(devices, (1, 2, 2))
+        dec, params, _ = _decoder_and_params(mesh, MCFG)
+        eng = ServeEngine(
+            dec, params, slots=1, burn_mitigation="shed",
+            slo=SloConfig(
+                fast_window_s=0.2, slow_window_s=0.4, budget=0.01,
+                multiplier=1.0,
+            ),
+        )
+        trace = _bad_deadline(_trace(4, min_p=3, max_p=8, n_gen=4))
+        eng.run(trace)
+        shed_before = len(eng.shed)
+        assert shed_before > 0
+        time.sleep(0.5)  # the fast window drains
+        more = _trace(2, min_p=3, max_p=8, n_gen=4, seed=7)
+        for r in more:
+            r.rid += 100
+        out = eng.run(more)
+        # recovered: the new requests ADMIT (no deadline -> all good)
+        assert all(100 + i in out for i in range(2))
+        assert len(eng.shed) == shed_before
+
+    def test_shed_site_error_fails_open_to_admission(self, devices):
+        mesh = _mesh(devices, (1, 2, 2))
+        dec, params, _ = _decoder_and_params(mesh, MCFG)
+        eng = ServeEngine(
+            dec, params, slots=1, burn_mitigation="shed",
+            slo=SloConfig(
+                fast_window_s=30, slow_window_s=60, budget=0.01,
+                multiplier=1.0,
+            ),
+        )
+        faults.configure("serve.shed:error:count=1")
+        trace = _bad_deadline(_trace(5, min_p=3, max_p=8, n_gen=4))
+        out = eng.run(trace)
+        # the injected error aborted ONE shed: that request admitted
+        # (and completed) instead — mitigation degrades to
+        # no-mitigation, never to a lost request
+        assert len(out) >= 2  # rid 0 plus the failed-open shed victim
+        assert len(out) + len(eng.failed) + len(eng.shed) == len(trace)
+        assert eng.shed  # the rest still shed
+
+    def test_spec_off_degrades_to_plain_decode_ids_exact(self, devices):
+        mesh = _mesh(devices, (1, 2, 2))
+        dec, params, _ = _decoder_and_params(mesh, MCFG)
+        trace = _trace(4, min_p=6, max_p=12, n_gen=6, seed=3)
+
+        def run(mitigation, pre_trip):
+            eng = ServeEngine(
+                dec, params, slots=4, spec_k=2,
+                burn_mitigation=mitigation,
+                slo=SloConfig(
+                    fast_window_s=60, slow_window_s=120, budget=0.01,
+                    multiplier=1.0,
+                ),
+            )
+            if pre_trip:
+                eng.slo.observe(tokens=50, met=False)
+                assert eng.slo.mitigating()
+            out = eng.run(
+                [dataclasses.replace(r, tokens=list(r.tokens))
+                 for r in trace]
+            )
+            return out, eng
+
+        out_plain, eng_off = run("spec_off", pre_trip=True)
+        assert eng_off.stats["spec_steps"] == 0  # degraded all the way
+        out_spec, eng_spec = run("off", pre_trip=True)
+        assert eng_spec.stats["spec_steps"] > 0  # ladder off: spec ran
+        assert out_plain == out_spec  # bit-identical either way
+
+    def test_total_failure_outage_still_burns(self, devices):
+        """A request that fails with ZERO tokens out must still book
+        bad tokens (its whole n_gen budget): a total outage — every
+        request quarantining at prefill — has to fire the burn WARNING
+        and engage mitigation, not sail under the radar because n_out
+        weighting saw nothing."""
+        mesh = _mesh(devices, (1, 2, 2))
+        dec, params, _ = _decoder_and_params(mesh, MCFG)
+        eng = ServeEngine(
+            dec, params, slots=2, burn_mitigation="shed",
+            slo=SloConfig(
+                fast_window_s=30, slow_window_s=60, budget=0.01,
+                multiplier=1.0,
+            ),
+        )
+        # every prefill fails deterministically -> every admitted row
+        # quarantines with out == [] (0 tokens generated)
+        faults.configure("serve.prefill:error:count=999")
+        out = eng.run(_trace(6, min_p=3, max_p=8, n_gen=4))
+        assert not out and eng.failed  # the outage really was total
+        snap = eng.slo.snapshot()
+        assert snap["bad_tokens"] > 0
+        assert eng.slo.fires >= 1
+        # and the ladder engaged: later admissions shed
+        assert eng.shed
+        assert len(eng.failed) + len(eng.shed) == 6
+
+    def test_bad_mitigation_rejected(self, devices):
+        mesh = _mesh(devices, (1, 2, 2))
+        dec, params, _ = _decoder_and_params(mesh, MCFG)
+        with pytest.raises(ValueError, match="burn_mitigation"):
+            ServeEngine(dec, params, slots=1, burn_mitigation="panic")
+
+
+class TestInflightLedger:
+    def test_table_fills_mid_run_and_settles_empty(self, devices):
+        mesh = _mesh(devices, (1, 2, 2))
+        dec, params, _ = _decoder_and_params(mesh, MCFG)
+        eng = ServeEngine(dec, params, slots=4)
+        seen = []
+
+        def source(idle=False):
+            seen.append(len(eng.inflight))
+            return None  # exhausted: the pre-submitted trace drains
+
+        eng.run(_trace(4, min_p=3, max_p=8, n_gen=6), source=source)
+        assert len(eng.inflight) == 0  # settled
+        # the ledger held rows while the loop ran
+        assert max(seen, default=0) > 0 or len(eng.done) == 4
+
+
+# -- the HTTP plane --------------------------------------------------------
+
+
+@pytest.fixture(scope="class")
+def served_engine(request, devices):
+    """One tiny engine run to completion + a live plane attached to it
+    — module-shaped state every endpoint test reads."""
+    mesh = _mesh(devices, (1, 2, 2))
+    dec, params, _ = _decoder_and_params(mesh, MCFG)
+    eng = ServeEngine(dec, params, slots=4)
+    eng.run(_trace(4, min_p=3, max_p=8, n_gen=4))
+    obs_live.attach_engine(eng)
+    plane = ObsHttp(0)
+    port = plane.start()
+    request.cls.eng = eng
+    request.cls.port = port
+    yield
+    plane.stop()
+    obs_live.detach_engine(eng)
+
+
+@pytest.mark.usefixtures("served_engine")
+class TestObsHttp:
+    def test_metrics_serves_registry_render_byte_identical(self):
+        code, body = _get(self.port, "/metrics")
+        assert code == 200
+
+        def without_scrape_counter(text):
+            # the scrape books ITSELF into the requests counter (after
+            # rendering), so that one series differs between a scrape
+            # and a later render — everything else is byte-identical
+            return "\n".join(
+                ln for ln in text.splitlines()
+                if "tpu_patterns_obs_http_requests_total" not in ln
+            )
+
+        assert without_scrape_counter(body) == without_scrape_counter(
+            obs.metrics_registry().render()
+        )
+        samples = obs.parse_prom_text(body)
+        assert any(
+            name == "tpu_patterns_serve_tokens_total"
+            for name, _ in samples
+        )
+
+    def test_healthz_verdict_and_pool_state(self):
+        code, h = _get_json(self.port, "/healthz")
+        assert code == 200
+        assert h["verdict"] in ("ok", "degraded")
+        e = h["engine"]
+        assert e["active_rows"] == 0 and e["queued"] == 0
+        assert e["done"] == 4 and e["failed"] == 0
+        assert (
+            e["pool"]["free_blocks"] == e["pool"]["allocatable_blocks"]
+        )
+        assert "burn_rate_fast" in h["slo"]
+        assert "fired" in h["watchdog"]
+
+    def test_statusz_settled_engine_has_no_rows(self):
+        code, s = _get_json(self.port, "/statusz")
+        assert code == 200
+        assert s["engine"]["requests"] == []
+        assert s["engine"]["done"] == 4
+        recent = s["engine"]["recent"]
+        assert recent and all(r["status"] == "done" for r in recent)
+
+    def test_unknown_path_is_404(self):
+        code, body = _get(self.port, "/nope")
+        assert code == 404
+        assert "/metrics" in body
+
+    def test_scrape_fault_answers_503_counted_never_crashes(self):
+        before = rt.metric_total(
+            "tpu_patterns_obs_http_requests_total", endpoint="healthz"
+        )
+        faults.configure("obs.scrape:error:count=1:endpoint=healthz")
+        code, _ = _get(self.port, "/healthz")
+        assert code == 503
+        # the plane healed: the very next scrape answers
+        code, _ = _get(self.port, "/healthz")
+        assert code == 200
+        after = rt.metric_total(
+            "tpu_patterns_obs_http_requests_total", endpoint="healthz"
+        )
+        assert after >= before + 2  # the 503 was counted too
+
+    def test_watch_renders_one_line_per_poll(self):
+        out = io.StringIO()
+        rc = obs_live.watch(
+            f"http://127.0.0.1:{self.port}",
+            interval_s=0.01, count=2, out=out,
+        )
+        assert rc == 0
+        lines = out.getvalue().splitlines()
+        assert len(lines) == 2
+        assert "burn=" in lines[0] and "act=" in lines[0]
+
+    def test_watch_no_plane_is_an_error(self):
+        out = io.StringIO()
+        rc = obs_live.watch(
+            "http://127.0.0.1:9", interval_s=0.01, count=1, out=out,
+        )
+        assert rc == 1
+
+
+class TestObsHttpMidRun:
+    def test_mid_run_scrape_sees_inflight_rows(self, devices):
+        """The acceptance shape: /healthz ok and /statusz showing the
+        in-flight table WHILE the scheduler loop runs (the source hook
+        scrapes from inside an iteration boundary)."""
+        mesh = _mesh(devices, (1, 2, 2))
+        dec, params, _ = _decoder_and_params(mesh, MCFG)
+        eng = ServeEngine(dec, params, slots=2)
+        plane = ObsHttp(0)
+        port = plane.start()
+        captured = {}
+
+        def source(idle=False):
+            if eng.active and "status" not in captured:
+                captured["health"] = _get_json(port, "/healthz")[1]
+                captured["status"] = _get_json(port, "/statusz")[1]
+            # [] keeps the loop polling; None (exhausted) once the
+            # pre-submitted trace settled lets the run end
+            done = len(eng.done) + len(eng.failed) >= 4
+            return None if done else []
+
+        try:
+            eng.run(_trace(4, min_p=3, max_p=8, n_gen=6), source=source)
+        finally:
+            plane.stop()
+        assert captured, "the loop never had active rows"
+        assert captured["health"]["verdict"] == "ok"
+        assert captured["health"]["engine"]["active_rows"] > 0
+        rows = captured["status"]["engine"]["requests"]
+        assert rows and {"rid", "generated", "n_gen", "age_ms"} <= set(
+            rows[0]
+        )
+
+    def test_unhealthy_engine_answers_503(self, devices):
+        mesh = _mesh(devices, (1, 2, 2))
+        dec, params, _ = _decoder_and_params(mesh, MCFG)
+        eng = ServeEngine(dec, params, slots=2, breaker=rt.Breaker())
+        eng.breaker_tripped = True
+        obs_live.attach_engine(eng)
+        plane = ObsHttp(0)
+        port = plane.start()
+        try:
+            code, h = _get_json(port, "/healthz")
+        finally:
+            plane.stop()
+            obs_live.detach_engine(eng)
+        assert code == 503
+        assert h["verdict"] == "unhealthy"
+
+    def test_nothing_attached_is_ok_not_an_error(self):
+        obs_live.attach_engine(None)
+        plane = ObsHttp(0)
+        port = plane.start()
+        try:
+            code, h = _get_json(port, "/healthz")
+            assert code == 200
+            assert h["engine"] is None
+            code, s = _get_json(port, "/statusz")
+            assert code == 200 and s["engine"] is None
+        finally:
+            plane.stop()
+
+
+class TestFleetLanes:
+    def _fake_manager(self):
+        def handle(rid, state, rids):
+            leases = rt.LeaseTable()
+            for r in rids:
+                leases.acquire(r)
+            return types.SimpleNamespace(
+                id=rid, state=state, leases=leases,
+                breaker=rt.Breaker(),
+                obs_stalled=False,
+                last_msg_ns=0,
+                alive=lambda: state in ("spawning", "ready"),
+            )
+
+        return types.SimpleNamespace(
+            handles={
+                "0": handle("0", "ready", [1, 3]),
+                "1": handle("1", "quarantined", []),
+            },
+            fleet_obs=None,
+        )
+
+    def test_statusz_has_one_lane_per_replica(self):
+        mgr = self._fake_manager()
+        obs_live.attach_fleet(mgr)
+        plane = ObsHttp(0)
+        port = plane.start()
+        try:
+            _, s = _get_json(port, "/statusz")
+        finally:
+            plane.stop()
+            obs_live.detach_fleet(mgr)
+        lanes = {l["replica"]: l for l in s["fleet"]["replicas"]}
+        assert lanes["0"]["inflight"] == [1, 3]
+        assert lanes["1"]["state"] == "quarantined"
+
+    def test_healthz_degraded_on_sick_replica_unhealthy_on_none(self):
+        mgr = self._fake_manager()
+        obs_live.attach_fleet(mgr)
+        plane = ObsHttp(0)
+        port = plane.start()
+        try:
+            code, h = _get_json(port, "/healthz")
+            assert code == 200 and h["verdict"] == "degraded"
+            for handle in mgr.handles.values():
+                handle.state = "dead"
+                handle.alive = lambda: False
+            code, h = _get_json(port, "/healthz")
+        finally:
+            plane.stop()
+            obs_live.detach_fleet(mgr)
+        assert code == 503 and h["verdict"] == "unhealthy"

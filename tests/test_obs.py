@@ -499,6 +499,123 @@ class TestMetrics:
         )
         assert back.to_prom_text() == text
 
+    def test_render_and_dump_text_are_byte_identical(self):
+        # the /metrics scrape (obs/live.py) calls render(); the
+        # --obs-dump/export path calls to_prom_text() — same state must
+        # produce the same bytes, or dump and scrape disagree about a
+        # run (the PR-15 render() satellite pin)
+        reg = obs_metrics.Registry()
+        reg.run_stamp = {"run_id": "pin", "git_sha": "0", "mesh_fp": "m"}
+        reg.counter("tpu_patterns_scrape_pin_total", site="a").inc(3)
+        reg.gauge("tpu_patterns_scrape_pin_gauge").set(-0.5)
+        h = reg.histogram("tpu_patterns_scrape_pin_ns", buckets=(10,))
+        h.observe(5)
+        assert reg.render() == reg.to_prom_text()
+        # and the scrape text round-trips through the parser
+        assert obs.parse_prom_text(reg.render())[
+            ("tpu_patterns_scrape_pin_total", (("site", "a"),))
+        ] == 3
+
+    def test_scrape_under_writer_load_is_lossless(self):
+        # N writer threads hammer counters/gauges/histograms while M
+        # scrapers render() concurrently: every intermediate render
+        # must PARSE (no torn lines), and the final totals must be
+        # lossless — the race-free-scrape contract /metrics relies on
+        import threading
+
+        reg = obs_metrics.Registry()
+        reg.run_stamp = {"run_id": "load"}
+        n_writers, per_writer = 4, 400
+        stop = threading.Event()
+        errors: list = []
+
+        def write(k: int):
+            c = reg.counter("tpu_patterns_writer_total", worker=str(k))
+            shared = reg.counter("tpu_patterns_shared_total")
+            h = reg.histogram(
+                "tpu_patterns_writer_ns", buckets=(10, 100)
+            )
+            for i in range(per_writer):
+                c.inc()
+                shared.inc()
+                h.observe(float(i % 200))
+                reg.gauge("tpu_patterns_writer_gauge").set(float(i))
+
+        def scrape():
+            while not stop.is_set():
+                try:
+                    obs.parse_prom_text(reg.render())
+                except Exception as e:  # pragma: no cover - the failure
+                    errors.append(e)
+                    return
+
+        writers = [
+            threading.Thread(target=write, args=(k,))
+            for k in range(n_writers)
+        ]
+        scrapers = [threading.Thread(target=scrape) for _ in range(2)]
+        for t in scrapers + writers:
+            t.start()
+        for t in writers:
+            t.join()
+        stop.set()
+        for t in scrapers:
+            t.join()
+        assert not errors, f"scrape tore mid-write: {errors[0]}"
+        samples = obs.parse_prom_text(reg.render())
+        assert samples[("tpu_patterns_shared_total", ())] == (
+            n_writers * per_writer
+        )
+        for k in range(n_writers):
+            assert samples[(
+                "tpu_patterns_writer_total", (("worker", str(k)),)
+            )] == per_writer
+        assert samples[("tpu_patterns_writer_ns_count", ())] == (
+            n_writers * per_writer
+        )
+
+    def test_obs_http_and_slo_series_export_cleanly(self):
+        # the live-telemetry-plane series (obs/live.py + obs/slo.py):
+        # scrape accounting keyed by endpoint+status, burn-rate gauges
+        # keyed by window, live percentile gauges, the shed counter —
+        # naming-convention-clean and parseable
+        reg = obs_metrics.Registry()
+        reg.counter(
+            "tpu_patterns_obs_http_requests_total",
+            endpoint="metrics", status="200",
+        ).inc(7)
+        reg.counter(
+            "tpu_patterns_obs_http_requests_total",
+            endpoint="healthz", status="503",
+        ).inc()
+        reg.gauge("tpu_patterns_slo_burn_rate", window="fast").set(2.5)
+        reg.gauge("tpu_patterns_slo_burn_rate", window="slow").set(0.8)
+        reg.counter("tpu_patterns_slo_burn_warnings_total").inc()
+        reg.gauge("tpu_patterns_slo_live_ttft_p99_ms").set(41.5)
+        reg.gauge("tpu_patterns_slo_live_tpot_p99_ms").set(3.25)
+        reg.counter("tpu_patterns_serve_shed_total").inc(5)
+        text = reg.to_prom_text()
+        assert (
+            "# TYPE tpu_patterns_obs_http_requests_total counter" in text
+        )
+        assert "# TYPE tpu_patterns_slo_burn_rate gauge" in text
+        samples = obs.parse_prom_text(text)
+        assert samples[(
+            "tpu_patterns_obs_http_requests_total",
+            (("endpoint", "metrics"), ("status", "200")),
+        )] == 7
+        assert samples[(
+            "tpu_patterns_slo_burn_rate", (("window", "fast"),)
+        )] == 2.5
+        assert samples[
+            ("tpu_patterns_slo_live_ttft_p99_ms", ())
+        ] == 41.5
+        assert samples[("tpu_patterns_serve_shed_total", ())] == 5
+        back = obs_metrics.registry_from_jsonl(
+            reg.to_jsonl().splitlines()
+        )
+        assert back.to_prom_text() == text
+
 
 class TestChromeTrace:
     def test_schema_and_ordering(self, tmp_path):

@@ -421,6 +421,11 @@ class MetricNaming(Rule):
         # fleet series are keyed by replica id (serve/replica.py,
         # serve/router.py — PR 12)
         "replica",
+        # live telemetry plane: scrape accounting per endpoint + HTTP
+        # status (obs/live.py), burn-rate gauges per rolling window
+        # (obs/slo.py) — PR 15
+        "endpoint",
+        "window",
     })
     PREFIX = "tpu_patterns_"
 

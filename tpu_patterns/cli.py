@@ -1040,6 +1040,24 @@ def _cmd_obs(args, writer: ResultWriter) -> None:
 
     obs_dir = args.obs_dir or obs.run_dir()
 
+    if args.action == "watch":
+        # poll a live telemetry plane (serve/loadgen --obs_http) into a
+        # one-line-per-interval terminal view
+        from tpu_patterns.obs import live as obs_live
+
+        if not args.target:
+            raise SystemExit(
+                "obs watch: pass the plane URL "
+                "(http://127.0.0.1:PORT — start one with "
+                "`serve --obs_http PORT`)"
+            )
+        rc = obs_live.watch(
+            args.target, interval_s=args.interval, count=args.count
+        )
+        if rc:
+            raise SystemExit(rc)
+        return
+
     if args.action == "fleet":
         # merged summarize + trace export over parent + replica-*/ dumps
         from tpu_patterns.obs import fleet as obs_fleet
@@ -1637,19 +1655,35 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ob.add_argument(
         "action",
-        choices=("summarize", "export", "fleet", "journey"),
+        choices=("summarize", "export", "fleet", "journey", "watch"),
         help="summarize = per-span table (+device join with "
         "--profile-dir); export = --chrome-trace / --prom; fleet <dir> "
         "= merged summarize + per-process Chrome trace over the "
         "parent's dumps and every replica-*/ dir; journey <jid|rid> = "
-        "one request's full cross-process story as a table",
+        "one request's full cross-process story as a table; watch "
+        "<url> = poll a live --obs_http plane (/healthz + /metrics) "
+        "into a one-line-per-interval view",
     )
     ob.add_argument(
         "target",
         nargs="?",
         default=None,
         help="fleet: the obs dir to merge (default --obs-dir); "
-        "journey: the journey id (j...) or request id to stitch",
+        "journey: the journey id (j...) or request id to stitch; "
+        "watch: the plane URL (http://127.0.0.1:PORT)",
+    )
+    ob.add_argument(
+        "--interval",
+        type=float,
+        default=1.0,
+        help="watch: seconds between polls (default 1.0)",
+    )
+    ob.add_argument(
+        "--count",
+        type=int,
+        default=0,
+        help="watch: stop after N successful polls (0 = poll until "
+        "the plane goes away — the watched run finishing exits 0)",
     )
     ob.add_argument(
         "--input",

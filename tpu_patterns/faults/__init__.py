@@ -59,6 +59,17 @@ and docs/robustness.md):
                  request to a replica (ctx: replica): ``error`` means
                  the replica is unresponsive — it is killed and its
                  in-flight leases reroute to the survivors
+  serve.shed     serve/engine.py, per admission the SLO burn-rate
+                 monitor sheds under ``--burn_mitigation shed`` (ctx:
+                 rid, replica): ``error`` aborts THAT shed and the
+                 request admits normally — the mitigation path fails
+                 OPEN to no-mitigation, never to a lost request
+  obs.scrape     obs/live.py, per HTTP request to the live telemetry
+                 plane (ctx: endpoint = metrics|healthz|statusz|
+                 other): any error answers 503, counted in
+                 ``tpu_patterns_obs_http_requests_total`` — a broken
+                 scrape must never crash (or block) the scheduler
+                 thread it observes
 """
 
 from tpu_patterns.faults.injector import (  # noqa: F401

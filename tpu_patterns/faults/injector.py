@@ -71,9 +71,9 @@ ACTIONS = frozenset(
 KNOWN_SITES = frozenset({
     "worker.ready", "cell.run", "ckpt.save", "ckpt.restore",
     "train.step", "serve.prefill", "serve.step", "serve.verify",
-    "serve.evict", "serve.onload",
+    "serve.evict", "serve.onload", "serve.shed",
     "loadgen.arrive", "router.route", "replica.spawn", "replica.drain",
-    "replica.obs_ship",
+    "replica.obs_ship", "obs.scrape",
 })
 
 # ctx keys the call sites actually pass — the only keys a match
@@ -84,6 +84,9 @@ KNOWN_SITES = frozenset({
 MATCH_KEYS = frozenset({
     "pid", "cmd", "cell", "step", "proc", "rows", "rid", "scenario",
     "replica",
+    # the live telemetry plane's scrape site is matchable per endpoint
+    # (metrics | healthz | statusz | other — obs/live.py)
+    "endpoint",
 })
 
 

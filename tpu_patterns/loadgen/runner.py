@@ -29,7 +29,7 @@ from tpu_patterns.loadgen.scenarios import (
     build_schedule,
     parse_scenario,
 )
-from tpu_patterns.serve.engine import ServeEngine
+from tpu_patterns.serve.engine import ServeEngine, _slo_kwargs
 
 
 class ArrivalSource:
@@ -155,6 +155,17 @@ class LoadGenConfig:
     # SECOND time under it, gating bounded p99 + full trace coverage
     chaos: str = ""
     chaos_p99_mult: float = 0.0  # > 0 overrides the scenario preset
+    # live telemetry plane + SLO burn-rate mitigation (obs/live.py,
+    # obs/slo.py — the same knobs as `serve`): --obs_http > 0 serves
+    # /metrics /healthz /statusz on 127.0.0.1 for the whole run;
+    # --burn_mitigation shed|spec_off arms the engine's degradation
+    # ladder against the rolling burn windows
+    obs_http: int = 0
+    burn_mitigation: str = "off"
+    slo_fast_s: float = 60.0
+    slo_slow_s: float = 300.0
+    slo_budget: float = 0.1
+    burn_multiplier: float = 2.0
 
 
 def _resolved_specs(cfg: LoadGenConfig) -> list[ScenarioSpec]:
@@ -210,6 +221,18 @@ def validate_config(cfg: LoadGenConfig) -> None:
         )
     if cfg.session_dir and not cfg.kv_host_tier:
         raise ValueError("session_dir requires kv_host_tier")
+    if cfg.burn_mitigation not in ("off", "shed", "spec_off"):
+        raise ValueError(
+            f"burn_mitigation must be off | shed | spec_off, got "
+            f"{cfg.burn_mitigation!r}"
+        )
+    # the SloConfig invariants, surfaced at parse time as one line
+    from tpu_patterns.obs.slo import SloConfig
+
+    SloConfig(
+        fast_window_s=cfg.slo_fast_s, slow_window_s=cfg.slo_slow_s,
+        budget=cfg.slo_budget, multiplier=cfg.burn_multiplier,
+    )
 
 
 def _session_fingerprint(cfg: LoadGenConfig) -> dict:
@@ -245,6 +268,9 @@ def _drive(
         ),
         host_tier_blocks=cfg.host_tier_blocks,
         fingerprint=_session_fingerprint(cfg) if kv_tier else None,
+        # _slo_kwargs reads the same field names off either config
+        # class — one monitor config for every engine built here
+        **_slo_kwargs(cfg),
     )
     source = ArrivalSource(schedule, scenario=spec.name)
     t0 = clock_ns()
@@ -290,6 +316,9 @@ def _stats(
     scheduled = {tr.request.rid for tr in schedule}
     accounted = (
         set(eng.lifecycle) | set(source.dropped)
+        # shed admissions (burn-rate mitigation) are a terminal bucket:
+        # counted, never silently lost
+        | set(eng.shed)
         # preemption returns mid-trace: still-pending work is accounted,
         # not lost — the coverage gate distinguishes the two
         | {r.rid for r, _ in eng.queue} | {s.rid for s in eng.active}
@@ -298,6 +327,7 @@ def _stats(
     return {
         "ttft": ttft, "tpot": tpot, "e2e": e2e,
         "done": done, "failed": failed, "dropped": len(source.dropped),
+        "sheds": len(eng.shed),
         "goodput": good_tokens / total_tokens if total_tokens else 0.0,
         "tokens": sum(
             lc["n_out"] for lc in eng.lifecycle.values()
@@ -370,6 +400,25 @@ def run_loadgen(mesh, cfg: LoadGenConfig, writer) -> list:
     from tpu_patterns.models.lm import init_lm_params
     from tpu_patterns.models.transformer import ModelConfig, _n_experts
     from tpu_patterns.serve.paged import make_paged_lm_decoder
+
+    if cfg.obs_http:
+        # the live telemetry plane covers the whole run (clean, kv-tier
+        # and chaos legs alike — each engine attaches at run() entry)
+        from tpu_patterns.obs.live import ObsHttp
+
+        plane = ObsHttp(cfg.obs_http)
+        port = plane.start()
+        writer.progress(
+            f"obs http plane live on http://127.0.0.1:{port} "
+            "(/metrics /healthz /statusz; poll it with "
+            f"`tpu-patterns obs watch http://127.0.0.1:{port}`)"
+        )
+        try:
+            return run_loadgen(
+                mesh, dataclasses.replace(cfg, obs_http=0), writer
+            )
+        finally:
+            plane.stop()
 
     specs = _resolved_specs(cfg)
     mcfg = ModelConfig(
@@ -451,6 +500,7 @@ def run_loadgen(mesh, cfg: LoadGenConfig, writer) -> list:
                 "done": float(st["done"]),
                 "failed": float(st["failed"]),
                 "dropped": float(st["dropped"]),
+                "shed": float(st["sheds"]),
                 "deferrals": float(st["deferrals"]),
                 "tokens": float(st["tokens"]),
                 "slo_ttft_ms": spec.slo_ttft_ms,
@@ -473,6 +523,12 @@ def run_loadgen(mesh, cfg: LoadGenConfig, writer) -> list:
                 "deadline misses under the scenario SLO "
                 f"(ttft {spec.slo_ttft_ms:g}ms + "
                 f"tpot {spec.slo_tpot_ms:g}ms/token)"
+            )
+        if st["sheds"]:
+            rec.notes.append(
+                f"{st['sheds']} admission(s) shed by burn-rate "
+                "mitigation on the clean leg — the SLO budget burned "
+                "under the scenario's own load"
             )
         writer.record(rec)
         records.append(rec)
@@ -632,7 +688,7 @@ def _chaos_record(
     verdict = Verdict.SUCCESS
     if not covered or not bounded:
         verdict = Verdict.FAILURE
-    elif st["failed"] or st["dropped"] or injected == 0:
+    elif st["failed"] or st["dropped"] or st["sheds"] or injected == 0:
         verdict = Verdict.WARNING  # healed (or inert) — not unscathed
     rec = Record(
         pattern="loadgen",
@@ -649,6 +705,8 @@ def _chaos_record(
             "done": float(st["done"]),
             "failed": float(st["failed"]),
             "dropped": float(st["dropped"]),
+            "shed": float(st["sheds"]),
+            "slo_burn_fires": float(eng.slo.fires),
             "covered": float(covered),
             "leaked_blocks": float(eng.leaked_blocks()),
         },

@@ -12,6 +12,11 @@ training/inference stack has, dependency-free:
                all-thread-stack dump + WARNING Record, live
   metrics.py   counters/gauges/histograms, JSONL + Prometheus text export
   export.py    Chrome trace, span summaries, host+device profile join
+  live.py      opt-in HTTP plane (/metrics /healthz /statusz on a
+               daemon thread — ``serve --obs_http PORT``) + the
+               ``obs watch`` poller: the stack answered live, mid-run
+  slo.py       rolling dual-window SLO burn-rate monitor feeding the
+               serve engine's shed/spec_off mitigation ladder
 
 Usage (the whole API most call sites need)::
 

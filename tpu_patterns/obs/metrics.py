@@ -92,14 +92,21 @@ class Histogram(_Metric):
                     self.counts[i] += 1
                     break
 
-    def cumulative(self) -> list[tuple[float, int]]:
-        """(le, cumulative count) pairs — the Prometheus exposition shape."""
+    def snapshot(self) -> tuple[list[tuple[float, int]], float, int]:
+        """(cumulative pairs, sum, count) under ONE lock acquisition —
+        a concurrent scrape must never render a ``_count`` that
+        disagrees with ``bucket{le="+Inf"}`` (the exposition invariant;
+        reading them in separate steps races with ``observe``)."""
         out, acc = [], 0
         with self._lock:
             for b, c in zip(self.buckets, self.counts):
                 acc += c
                 out.append((b, acc))
-        return out
+            return out, self.sum, self.count
+
+    def cumulative(self) -> list[tuple[float, int]]:
+        """(le, cumulative count) pairs — the Prometheus exposition shape."""
+        return self.snapshot()[0]
 
 
 class Registry:
@@ -149,8 +156,20 @@ class Registry:
 
     # -- export ----------------------------------------------------------
 
-    def to_prom_text(self) -> str:
-        """Prometheus text exposition format 0.0.4.
+    def render(self) -> str:
+        """Prometheus text exposition format 0.0.4 — the one renderer
+        behind BOTH consumers: the ``--obs-dump`` file path
+        (``obs export --prom``) and the live ``/metrics`` scrape
+        (obs/live.py), so dump and scrape are byte-identical for the
+        same registry state (pinned by tests).
+
+        Race-free against writer threads: the metric LIST is
+        snapshotted under the registry lock (:meth:`metrics`), each
+        histogram's cumulative view under its own metric lock, and
+        counter/gauge value reads are single attribute loads published
+        under their metric locks — a concurrent scrape may land
+        between two increments but never tears a sample or loses a
+        count (the N-writers-vs-M-scrapers test pins totals lossless).
 
         The first line is a run-provenance comment (``# RUN k=v ...``)
         — comments are ignored by every exposition parser including
@@ -168,23 +187,30 @@ class Registry:
             lines.append(f"# TYPE {name} {group[0].kind}")
             for m in group:
                 if isinstance(m, Histogram):
-                    for le, acc in m.cumulative():
+                    pairs, h_sum, h_count = m.snapshot()
+                    for le, acc in pairs:
                         lines.append(
                             f"{name}_bucket"
                             f"{_prom_labels(m.labels, le=_prom_float(le))}"
                             f" {acc}"
                         )
                     lines.append(
-                        f"{name}_sum{_prom_labels(m.labels)} {_num(m.sum)}"
+                        f"{name}_sum{_prom_labels(m.labels)} {_num(h_sum)}"
                     )
                     lines.append(
-                        f"{name}_count{_prom_labels(m.labels)} {m.count}"
+                        f"{name}_count{_prom_labels(m.labels)} {h_count}"
                     )
                 else:
                     lines.append(
                         f"{name}{_prom_labels(m.labels)} {_num(m.value)}"
                     )
         return "\n".join(lines) + "\n"
+
+    def to_prom_text(self) -> str:
+        """Alias of :meth:`render` — the pre-PR-15 name every dump
+        path calls; kept so dump and scrape visibly share one
+        implementation."""
+        return self.render()
 
     def to_jsonl(self) -> str:
         """One JSON object per metric — the suite's JSONL discipline.
@@ -205,10 +231,11 @@ class Registry:
                 "ts": ts,
             }
             if isinstance(m, Histogram):
-                d["sum"] = m.sum
-                d["count"] = m.count
+                pairs, h_sum, h_count = m.snapshot()
+                d["sum"] = h_sum
+                d["count"] = h_count
                 d["buckets"] = [
-                    [_prom_float(le), acc] for le, acc in m.cumulative()
+                    [_prom_float(le), acc] for le, acc in pairs
                 ]
             else:
                 d["value"] = m.value

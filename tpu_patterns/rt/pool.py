@@ -248,6 +248,13 @@ class LeaseTable:
         with self._lock:
             return list(self._held)
 
+    def snapshot(self) -> dict:
+        """A point-in-time ``{key: meta}`` copy — the live telemetry
+        plane's /statusz reads the in-flight table through this so a
+        scrape never iterates a dict the scheduler is mutating."""
+        with self._lock:
+            return dict(self._held)
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._held)

@@ -45,6 +45,7 @@ import numpy as np
 
 from tpu_patterns import ckpt, faults, rt
 from tpu_patterns.core.timing import clock_ns
+from tpu_patterns.obs.slo import SloConfig, SloMonitor
 from tpu_patterns.serve.kvtier import HostTier
 from tpu_patterns.serve.paged import TRASH_BLOCK, make_paged_lm_decoder
 from tpu_patterns.serve.prefix import PrefixIndex
@@ -129,13 +130,20 @@ class ServeEngine:
                  breaker: rt.Breaker | None = None, replica: str = "",
                  kv_host_tier: bool = False,
                  session_dir: str | None = None,
-                 host_tier_blocks: int = 0):
+                 host_tier_blocks: int = 0,
+                 slo: SloConfig | None = None,
+                 burn_mitigation: str = "off"):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if spec_k < 0:
             raise ValueError(f"spec_k must be >= 0, got {spec_k}")
         if session_dir and not kv_host_tier:
             raise ValueError("session_dir requires kv_host_tier")
+        if burn_mitigation not in ("off", "shed", "spec_off"):
+            raise ValueError(
+                f"burn_mitigation must be off | shed | spec_off, got "
+                f"{burn_mitigation!r}"
+            )
         self.decoder = decoder
         self.params = params
         self.slots = slots
@@ -209,6 +217,31 @@ class ServeEngine:
         # self-drafting speculative decoding: propose up to spec_k
         # tokens per row per step, verify all of them in ONE wide call
         self.spec_k = spec_k
+        # the live SLO burn-rate monitor (obs/slo.py): every finalized
+        # request books its tokens good/bad against the loadgen
+        # deadline stamped on it.  Always on (a deadline-free trace
+        # never books a bad token); the degradation ladder is opt-in:
+        #   off      — observe only (the monitor still publishes burn
+        #              gauges + live percentile gauges and fires the
+        #              WARNING Record)
+        #   shed     — while a burn episode is active, new admissions
+        #              are SHED (counted in ``self.shed`` and
+        #              tpu_patterns_serve_shed_total, never dropped
+        #              silently: done+failed+shed covers the trace)
+        #   spec_off — while mitigating, the speculative wide step
+        #              degrades to plain one-token decode (bit-identical
+        #              output by construction, less work per step)
+        self.slo = SloMonitor(slo, replica=replica)
+        self.burn_mitigation = burn_mitigation
+        # admissions the burn monitor shed: {rid: reason} — a terminal
+        # bucket like ``failed``, so accounting identities close
+        self.shed: dict[int, str] = {}
+        # the in-flight ledger (rt.LeaseTable, the same type the
+        # replica parent settles fail-over against): rid -> its _Slot,
+        # acquired at admission, released at retire/quarantine — the
+        # /statusz per-request table reads it without touching the
+        # scheduler's own lists
+        self.inflight = rt.LeaseTable()
         self.queue: list[tuple[Request, int]] = []  # (request, t_submit)
         self.active: list[_Slot] = []
         self.done: dict[int, list[int]] = {}
@@ -232,6 +265,8 @@ class ServeEngine:
             "tier_fallbacks": 0, "pressure_admits": 0,
             "session_loaded": 0, "prompt_fresh_full_blocks": 0,
             "retained_peak": 0,
+            # burn-rate mitigation accounting (0 with the ladder off)
+            "sheds": 0,
         }
         # preemption safety: SIGTERM/SIGINT (or an injected ``preempt``)
         # sets the event; the loop finishes the current decode step,
@@ -594,6 +629,7 @@ class ServeEngine:
                 for b in s.table:
                     self._release_block(b)
                 self.slot_pool.release(s.slot, reusable=True)
+                self.inflight.release(s.rid)
                 self.done[s.rid] = s.out
                 self._finalize_lifecycle(s, "done")
                 obs.counter("tpu_patterns_serve_requests_total").inc()
@@ -631,6 +667,17 @@ class ServeEngine:
             "ttft_ms": ttft_ms, "tpot_ms": tpot_ms, "e2e_ms": e2e_ms,
             "deadline_ms": s.deadline_ms, "met": met,
         }
+        # the live burn-rate monitor books this request's tokens against
+        # its deadline verdict (and its tails into the live percentile
+        # gauges) the moment it finalizes — mid-run, not post-mortem.
+        # A FAILED request books its whole n_gen budget as bad (the
+        # goodput it can never deliver): weighting by n_out alone would
+        # make a total outage — every request quarantining with zero
+        # tokens out — invisible to the burn windows
+        self.slo.observe(
+            tokens=n_out if status == "done" else max(s.n_gen, 1),
+            met=met, ttft_ms=ttft_ms, tpot_ms=tpot_ms,
+        )
         if ttft_ms is not None:
             obs.histogram("tpu_patterns_serve_ttft_ms").observe(ttft_ms)
         if tpot_ms is not None:
@@ -687,6 +734,31 @@ class ServeEngine:
 
         admitted: list[tuple[Request, _Slot]] = []
         while self.queue:
+            # the burn-rate mitigation ladder's first rung: while an
+            # SLO burn episode is active (obs/slo.py), new admissions
+            # are SHED — counted, never dropped silently, and the
+            # window recovering (buckets aging out) re-opens admission
+            # without any operator action.  The shed itself is a fault
+            # site; an injected error there fails OPEN: the request
+            # admits normally (mitigation degrades to no mitigation,
+            # never to a lost request).
+            if self.burn_mitigation == "shed" and self.slo.mitigating():
+                req, _t = self.queue[0]
+                try:
+                    faults.inject(
+                        "serve.shed", rid=req.rid, replica=self.replica
+                    )
+                except faults.InjectedFault:
+                    pass  # fail open: fall through to normal admission
+                else:
+                    self.queue.pop(0)
+                    self.shed[req.rid] = (
+                        "shed: slo burn-rate mitigation active"
+                    )
+                    self.stats["sheds"] += 1
+                    obs.counter("tpu_patterns_serve_shed_total").inc()
+                    obs.event("serve.shed", rid=str(req.rid))
+                    continue
             # one scheduler slot per active row, leased from the shared
             # runtime core's pool (max_leased == slots) — None means
             # the active set is full, which ends admission (not a
@@ -808,6 +880,7 @@ class ServeEngine:
                 scenario=req.scenario, deadline_ms=req.deadline_ms,
                 jid=req.jid, t_admit_ns=now, slot=slot_tok,
             )
+            self.inflight.acquire(req.rid, slot)
             if req.jid:
                 # journey anchor at ADMISSION: it ships at the next
                 # iteration boundary, so even a replica that is later
@@ -1066,6 +1139,7 @@ class ServeEngine:
             for b in s.table:
                 self._release_block(b)
             self.slot_pool.release(s.slot, reusable=True)
+            self.inflight.release(s.rid)
             self.failed[s.rid] = reason
             self._finalize_lifecycle(s, "failed")
             obs.counter("tpu_patterns_serve_quarantined_total").inc()
@@ -1155,6 +1229,7 @@ class ServeEngine:
             ),
             "done": {str(k): v for k, v in self.done.items()},
             "failed": {str(k): v for k, v in self.failed.items()},
+            "shed": {str(k): v for k, v in self.shed.items()},
             "stats": {
                 k: v for k, v in self.stats.items() if k != "queue_wait_ns"
             },
@@ -1278,8 +1353,13 @@ class ServeEngine:
             self.index = PrefixIndex.from_state(
                 self.layout.block_len, state["index"]
             )
+        for s in self.active:
+            self.inflight.acquire(s.rid, s)
         self.done = {int(k): v for k, v in state["done"].items()}
         self.failed = {int(k): v for k, v in state["failed"].items()}
+        self.shed = {
+            int(k): v for k, v in (state.get("shed") or {}).items()
+        }
         for k, v in state["stats"].items():
             if k in self.stats and k != "queue_wait_ns":
                 self.stats[k] = v
@@ -1311,9 +1391,15 @@ class ServeEngine:
         keeping the scheduler loop itself sleep-free."""
         from tpu_patterns import obs
 
+        from tpu_patterns.obs import live as obs_live
+
         for r in requests:
             self.submit(r)
         restore_handlers = self._install_preempt_handlers()
+        # announce to the live telemetry plane (obs/live.py): while this
+        # loop runs, /healthz and /statusz answer from THIS engine —
+        # detached at exit so sequential legs never read stale state
+        obs_live.attach_engine(self)
         try:
             with obs.span("serve.run", requests=len(requests)):
                 while True:
@@ -1361,10 +1447,19 @@ class ServeEngine:
                     if self.active:
                         # speculative decoding swaps the one-token step
                         # for the drafted wide step, under its own
-                        # fault site with the same recovery contract
+                        # fault site with the same recovery contract.
+                        # Under --burn_mitigation spec_off, an active
+                        # burn episode degrades back to plain decode
+                        # (bit-identical output by construction —
+                        # speculation only changes the schedule) until
+                        # the window recovers.
+                        use_spec = bool(self.spec_k) and not (
+                            self.burn_mitigation == "spec_off"
+                            and self.slo.mitigating()
+                        )
                         step_fn, site = (
                             (self._verify_step, "serve.verify")
-                            if self.spec_k
+                            if use_spec
                             else (self._step, "serve.step")
                         )
                         # engine-level wall clock around the WHOLE decode
@@ -1433,6 +1528,7 @@ class ServeEngine:
                 # with zero fresh prefill blocks for their history
                 self.save_session()
         finally:
+            obs_live.detach_engine(self)
             restore_handlers()
         return dict(self.done)
 
@@ -1515,6 +1611,21 @@ class ServeConfig:
     # snapshot_dir/resume/ids_out are rejected (docs/serving.md)
     scenario: str = ""
     time_scale: float = 1.0  # compress scenario ARRIVALS onto the wall
+    # live telemetry plane (obs/live.py): > 0 binds 127.0.0.1:<port>,
+    # 0 = off, serving /metrics (Prometheus text, render()-
+    # snapshotted), /healthz (breaker/watchdog/pool/SLO verdict),
+    # /statusz (per-request in-flight table; per-replica lanes on a
+    # fleet parent).  `tpu-patterns obs watch URL` polls it.
+    obs_http: int = 0
+    # SLO burn-rate mitigation ladder (obs/slo.py): off = observe only,
+    # shed = shed new admissions while a burn episode is active
+    # (counted — done+failed+shed covers the trace), spec_off = degrade
+    # speculative decoding to plain decode until the window recovers
+    burn_mitigation: str = "off"
+    slo_fast_s: float = 60.0  # fast burn window (reacts)
+    slo_slow_s: float = 300.0  # slow burn window (contextualizes)
+    slo_budget: float = 0.1  # allowed bad-token fraction
+    burn_multiplier: float = 2.0  # fast-window burn that trips the ladder
     # multi-replica serving (serve/replica.py): N engine replicas, each
     # its own PROCESS pinned to a disjoint mesh slice
     # (topo/placement.py), behind the prefix-aware router
@@ -1530,6 +1641,22 @@ class ServeConfig:
     min_replica_speedup: float = 1.8
     replica_watchdog_s: float = 120.0  # no-message deadline per replica
     replica_dir: str = ""  # fleet work dir (logs + drain snapshots)
+
+
+def _slo_kwargs(cfg) -> dict:
+    """The burn-monitor engine kwargs from a ServeConfig OR a
+    LoadGenConfig (both carry the same field names) — every engine a
+    measured pattern builds gets the same monitor config, so the flags
+    are never silently ignored on any serve path."""
+    return {
+        "burn_mitigation": cfg.burn_mitigation,
+        "slo": SloConfig(
+            fast_window_s=cfg.slo_fast_s,
+            slow_window_s=cfg.slo_slow_s,
+            budget=cfg.slo_budget,
+            multiplier=cfg.burn_multiplier,
+        ),
+    }
 
 
 def _auto_blocks(cfg: ServeConfig) -> int:
@@ -1578,7 +1705,13 @@ def _serve_fingerprint(cfg: ServeConfig, n_blocks: int) -> dict:
     for k in ("snapshot_dir", "resume", "ids_out", "watchdog_s",
               "min_speedup", "min_block_savings", "min_accepted",
               "min_replica_speedup", "replica_watchdog_s", "replica_dir",
-              "session_dir", "host_tier_blocks", "min_tier_speedup"):
+              "session_dir", "host_tier_blocks", "min_tier_speedup",
+              # the telemetry plane and burn ladder never shape the
+              # token stream (shed requests are terminal bookkeeping,
+              # spec_off is bit-identical) — a resumed run may change
+              # them freely
+              "obs_http", "burn_mitigation", "slo_fast_s", "slo_slow_s",
+              "slo_budget", "burn_multiplier"):
         fp.pop(k, None)
     fp["n_blocks"] = n_blocks  # resolved, not the 0=auto sentinel
     return fp
@@ -1604,6 +1737,7 @@ def _run_preemptible(
         kv_host_tier=cfg.kv_host_tier,
         session_dir=cfg.session_dir or None,
         host_tier_blocks=cfg.host_tier_blocks,
+        **_slo_kwargs(cfg),
     )
     resumed_from = None
     if cfg.resume:
@@ -1852,6 +1986,7 @@ def _kv_tier_record(mesh, sp, cfg, writer, flat_params, mcfg) -> object:
                 decoder, params, slots=cfg.slots,
                 watchdog_s=cfg.watchdog_s, kv_host_tier=tier,
                 host_tier_blocks=cfg.host_tier_blocks,
+                **_slo_kwargs(cfg),
             )
 
         build().run([dataclasses.replace(r) for r in trace])  # warm
@@ -1981,6 +2116,7 @@ def _kv_session_record(mesh, sp, cfg, writer, flat_params, mcfg) -> object:
         kv_host_tier=True, session_dir=cfg.session_dir,
         host_tier_blocks=cfg.host_tier_blocks,
         fingerprint=_serve_fingerprint(cfg, n_blocks),
+        **_slo_kwargs(cfg),
     )
     with obs.span("serve.kv_session", requests=len(trace)):
         out = eng.run([dataclasses.replace(r) for r in trace])
@@ -2097,7 +2233,7 @@ def _prefix_record(mesh, sp, cfg, writer, flat_params, mcfg) -> object:
     def serve_once(share: bool):
         eng = ServeEngine(
             decoder, params, slots=cfg.slots, watchdog_s=cfg.watchdog_s,
-            prefix_share=share,
+            prefix_share=share, **_slo_kwargs(cfg),
         )
         out = eng.run([dataclasses.replace(r) for r in trace])
         return out, eng
@@ -2187,12 +2323,13 @@ def _spec_record(
     with obs.span("serve.spec_decode", k=cfg.spec_k):
         eng_spec = ServeEngine(
             decoder, params, slots=cfg.slots, watchdog_s=cfg.watchdog_s,
-            spec_k=cfg.spec_k,
+            spec_k=cfg.spec_k, **_slo_kwargs(cfg),
         )
         out_spec = eng_spec.run([dataclasses.replace(r) for r in trace])
     with obs.span("serve.spec_baseline"):
         eng_plain = ServeEngine(
             decoder, params, slots=cfg.slots, watchdog_s=cfg.watchdog_s,
+            **_slo_kwargs(cfg),
         )
         out_plain = eng_plain.run([dataclasses.replace(r) for r in trace])
 
@@ -2271,6 +2408,27 @@ def run_serve(mesh, cfg: ServeConfig, writer) -> list:
     from tpu_patterns.models.lm import init_lm_params, make_lm_decoder
     from tpu_patterns.models.transformer import ModelConfig, _n_experts
 
+    if cfg.obs_http:
+        # the live telemetry plane wraps the WHOLE run (every engine a
+        # measured pattern builds announces itself to it at run()
+        # entry), started here so one recursion covers every serve
+        # path below — including the replica fleet parent
+        from tpu_patterns.obs.live import ObsHttp
+
+        plane = ObsHttp(cfg.obs_http)
+        port = plane.start()
+        writer.progress(
+            f"obs http plane live on http://127.0.0.1:{port} "
+            "(/metrics /healthz /statusz; poll it with "
+            f"`tpu-patterns obs watch http://127.0.0.1:{port}`)"
+        )
+        try:
+            return run_serve(
+                mesh, dataclasses.replace(cfg, obs_http=0), writer
+            )
+        finally:
+            plane.stop()
+
     mcfg = ModelConfig(
         embed=cfg.embed,
         heads=cfg.heads,
@@ -2297,6 +2455,12 @@ def run_serve(mesh, cfg: ServeConfig, writer) -> list:
             raise ValueError(
                 "serve --replicas does not run the host KV tier; run "
                 "--kv_host_tier through the single-engine path"
+            )
+        if cfg.burn_mitigation != "off":
+            raise ValueError(
+                "serve --replicas does not run the burn-mitigation "
+                "ladder (the parent routes, children decode); run "
+                "--burn_mitigation through the single-engine paths"
             )
         from tpu_patterns.serve.replica import run_replicas
 
@@ -2331,6 +2495,10 @@ def run_serve(mesh, cfg: ServeConfig, writer) -> list:
                 watchdog_s=cfg.watchdog_s, seed=cfg.seed,
                 time_scale=cfg.time_scale,
                 scenarios=(cfg.scenario,),
+                burn_mitigation=cfg.burn_mitigation,
+                slo_fast_s=cfg.slo_fast_s, slo_slow_s=cfg.slo_slow_s,
+                slo_budget=cfg.slo_budget,
+                burn_multiplier=cfg.burn_multiplier,
             ),
             writer,
         )
@@ -2408,11 +2576,13 @@ def run_serve(mesh, cfg: ServeConfig, writer) -> list:
 
     def timed_run(slots: int):
         eng = ServeEngine(
-            decoder, params, slots=slots, watchdog_s=cfg.watchdog_s
+            decoder, params, slots=slots, watchdog_s=cfg.watchdog_s,
+            **_slo_kwargs(cfg),
         )
         eng.run([dataclasses.replace(r) for r in trace])  # warm compile
         eng2 = ServeEngine(
-            decoder, params, slots=slots, watchdog_s=cfg.watchdog_s
+            decoder, params, slots=slots, watchdog_s=cfg.watchdog_s,
+            **_slo_kwargs(cfg),
         )
         t0 = clock_ns()
         out = eng2.run([dataclasses.replace(r) for r in trace])

@@ -900,6 +900,8 @@ class ReplicaManager:
     ) -> FleetResult:
         """Serve ``timed`` [(arrival_s, request)] to settlement: every
         rid ends in done or failed, whatever the replicas do."""
+        from tpu_patterns.obs import live as obs_live
+
         res = FleetResult(
             scheduled=len(timed),
             requests_by_rid={r.rid: r for _, r in timed},
@@ -907,35 +909,45 @@ class ReplicaManager:
         pending = collections.deque(
             sorted(timed, key=lambda ar: (ar[0], ar[1].rid))
         )
+        # announce to the live telemetry plane (obs/live.py): while the
+        # fleet serves, /healthz and /statusz answer with one LANE per
+        # replica — the parent's lease ledgers joined with the shipped
+        # obs stream, no RPC to the children needed
+        obs_live.attach_fleet(self)
         res.t0_ns = t0 = clock_ns()
 
         def outstanding() -> int:
             return sum(len(h.leases) for h in self.handles.values())
 
-        while pending or outstanding():
-            now_s = (clock_ns() - t0) / 1e9
-            while pending and pending[0][0] <= now_s:
-                _, req = pending.popleft()
-                self._dispatch(req, res)
-            if not pending and not outstanding():
-                break
-            wait = 0.25
-            if pending:
-                wait = min(wait, max(pending[0][0] - now_s, 0.0) + 1e-3)
-            try:
-                rid, msg = self.inbox.get(timeout=wait)
-            except queue.Empty:
-                self._check_watchdogs(res)
-                continue
-            self._handle(rid, msg, res)
-            if not self.router.live() and (pending or outstanding()):
-                # the whole fleet is gone: settle what remains as
-                # failed so the accounting identity still closes
-                for r in res.requests_by_rid:
-                    if r not in res.done and r not in res.failed:
-                        res.failed[r] = "no live replica left"
-                pending.clear()
-                break
+        try:
+            while pending or outstanding():
+                now_s = (clock_ns() - t0) / 1e9
+                while pending and pending[0][0] <= now_s:
+                    _, req = pending.popleft()
+                    self._dispatch(req, res)
+                if not pending and not outstanding():
+                    break
+                wait = 0.25
+                if pending:
+                    wait = min(
+                        wait, max(pending[0][0] - now_s, 0.0) + 1e-3
+                    )
+                try:
+                    rid, msg = self.inbox.get(timeout=wait)
+                except queue.Empty:
+                    self._check_watchdogs(res)
+                    continue
+                self._handle(rid, msg, res)
+                if not self.router.live() and (pending or outstanding()):
+                    # the whole fleet is gone: settle what remains as
+                    # failed so the accounting identity still closes
+                    for r in res.requests_by_rid:
+                        if r not in res.done and r not in res.failed:
+                            res.failed[r] = "no live replica left"
+                    pending.clear()
+                    break
+        finally:
+            obs_live.detach_fleet(self)
         self._finish(res)
         res.wall_s = (clock_ns() - t0) / 1e9
         res.drains = self.drains
