@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""CI gate for the elastic fleet (docs/serving.md "Elastic fleet").
+
+One real-CLI invocation on the simulated 8-device CPU mesh: a diurnal
+ramp (``batch-summarize`` with ``bulk_fraction``) thrown at an
+UNDERSIZED fleet — 1 replica live, 1 slice reserved — with the host
+tier, bulk preemption, and the shed ladder all on.  The run banks the
+elastic Record (the diurnal-ramp A/B: elastic vs static fleet on the
+identical seeded schedule, one shared dense oracle), and this script
+gates it:
+
+  - the elastic fleet fired at least one SCALE-OUT (the ramp sustained
+    occupancy over the high water and the reserve slice was used);
+  - interactive goodput on the elastic leg held AT OR ABOVE the static
+    baseline's (relaxed below 4 cores, the replica-smoke precedent —
+    a second engine process cannot overlap on a starved host);
+  - at least one bulk request was PREEMPTED mid-flight and RESUMED,
+    and every completion — resumed legs included — is bit-identical
+    to dense decode (``exact``), with the coverage identity closed
+    and zero leaked blocks on both legs.
+
+Zero dependencies beyond the package; exit 0 = pass.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# growing the fleet only pays when the host has cores for the second
+# engine process; below 4 cores the goodput A/B relaxes (visibly)
+# instead of false-failing — scripts/replica_smoke.py MIN_SPEEDUP
+CORES = os.cpu_count() or 2
+STRICT_GOODPUT = CORES >= 4
+
+# a compressed nightly batch window: the diurnal ramp fills 1 replica
+# x 2 slots many times over, so occupancy sustains above the high
+# water early; half the requests are bulk so the ladder and priority
+# admission both have victims
+RAMP_SPEC = (
+    "batch-summarize:requests=24:rate_rps=12:bulk_fraction=0.5"
+    ":min_prompt=8:mean_prompt=14:max_prompt=20"
+    ":min_gen=4:mean_gen=8:max_gen=12"
+    ":slo_ttft_ms=60000:slo_tpot_ms=20000"
+)
+
+SERVE_ARGS = [
+    "--vocab", "64", "--embed", "64", "--head_dim", "8", "--depth", "1",
+    "--slots", "2", "--block_len", "8",
+    "--replicas", "1", "--elastic_reserve", "1",
+    "--scale_out_occupancy", "1.1", "--scale_in_occupancy", "0.1",
+    "--scale_sustain_s", "0.1", "--scale_cooldown_s", "0.5",
+    "--kv_host_tier", "true", "--preempt", "bulk",
+    "--burn_mitigation", "shed",
+    "--time_scale", "0.05", "--scenario", RAMP_SPEC,
+]
+
+
+def fail(msg: str) -> int:
+    print(f"elastic smoke: {msg}", file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.pop("TPU_PATTERNS_FAULTS", None)
+    work = tempfile.mkdtemp(prefix="elastic_smoke_")
+    jsonl = os.path.join(work, "elastic.jsonl")
+
+    cmd = [
+        sys.executable, "-m", "tpu_patterns", "--jsonl", jsonl,
+        "serve", "--dp", "1", "--tp", "2", *SERVE_ARGS,
+        "--replica_dir", os.path.join(work, "fleet"),
+    ]
+    print("+ [ramp]", " ".join(cmd), flush=True)
+    t0 = time.monotonic()
+    proc = subprocess.run(cmd, env=env, cwd=ROOT)
+    print(f"  [ramp] rc={proc.returncode} "
+          f"wall={time.monotonic() - t0:.1f}s", flush=True)
+    if proc.returncode != 0:
+        return fail(f"CLI exited {proc.returncode}")
+    with open(jsonl) as f:
+        recs = [json.loads(ln) for ln in f if ln.strip()]
+    rec = next(
+        (r for r in reversed(recs)
+         if str(r.get("mode", "")).startswith("elastic_")),
+        None,
+    )
+    if rec is None:
+        return fail("no elastic Record in the run's jsonl")
+    m = rec.get("metrics", {})
+    print(
+        f"elastic smoke: verdict={rec.get('verdict')} "
+        f"scale_outs={m.get('scale_outs')} "
+        f"scale_ins={m.get('scale_ins')} "
+        f"preempted={m.get('preempted')} "
+        f"resumed={m.get('preempted_resumed')} "
+        f"goodput_i={m.get('goodput_interactive_elastic')} vs "
+        f"static={m.get('goodput_interactive_static')} "
+        f"shed={m.get('shed_elastic')}/{m.get('shed_static')} "
+        f"exact={m.get('exact')} covered={m.get('covered')} "
+        f"leaked={m.get('leaked_blocks')}",
+        flush=True,
+    )
+
+    # correctness gates hold on ANY host: identity, exactness, leaks
+    if m.get("covered") != 1.0:
+        return fail(
+            f"coverage identity broken — notes: {rec.get('notes')}"
+        )
+    if m.get("exact") != 1.0:
+        return fail(
+            "a completion diverged from dense decode (resumed legs "
+            f"gate here too) — notes: {rec.get('notes')}"
+        )
+    if m.get("leaked_blocks") != 0.0:
+        return fail(f"{m.get('leaked_blocks')} leaked block(s)")
+
+    # the elastic gates: the ramp must have forced a scale-out, and at
+    # least one bulk row must have been parked AND brought back
+    if not m.get("scale_outs", 0) >= 1:
+        return fail(
+            "the fleet never scaled out — the ramp did not sustain "
+            f"occupancy over the high water; notes: {rec.get('notes')}"
+        )
+    if not (m.get("preempted", 0) >= 1
+            and m.get("preempted_resumed", 0) >= 1):
+        return fail(
+            f"preempted={m.get('preempted')} "
+            f"resumed={m.get('preempted_resumed')} — want >= 1 of "
+            "each: no bulk row exercised the park-and-resume path"
+        )
+
+    # the A/B: growing into the reserve must hold interactive goodput
+    good_e = m.get("goodput_interactive_elastic", 0.0)
+    good_s = m.get("goodput_interactive_static", 0.0)
+    if good_e < good_s:
+        if STRICT_GOODPUT:
+            return fail(
+                f"interactive goodput {good_e} elastic < {good_s} "
+                "static — growing the fleet did not pay"
+            )
+        print(
+            f"elastic smoke: WARNING — interactive goodput {good_e} < "
+            f"{good_s} static on a {CORES}-core host; the goodput A/B "
+            "is INERT (engine processes cannot overlap), correctness "
+            "gates still apply",
+            flush=True,
+        )
+    elif rec.get("verdict") != "SUCCESS":
+        return fail(
+            f"verdict {rec.get('verdict')} — notes: {rec.get('notes')}"
+        )
+
+    print("elastic smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,6 +52,34 @@ class TestParser:
             args = p.parse_args(argv)
             assert args.cmd == argv[0]
 
+    def test_serve_fleet_mitigation_combo_parses(self):
+        # parse-pin for the PR 16 unlock: --replicas together with
+        # --burn_mitigation (the ladder runs per-replica now) plus the
+        # whole elastic/priority flag family
+        args = build_parser().parse_args([
+            "serve", "--replicas", "2", "--burn_mitigation", "shed",
+            "--scenario", "diurnal:bulk_fraction=0.4",
+            "--kv_host_tier", "true", "--preempt", "bulk",
+            "--elastic_reserve", "1",
+            "--scale_out_occupancy", "1.5",
+            "--scale_in_occupancy", "0.2",
+            "--scale_sustain_s", "0.25",
+            "--scale_cooldown_s", "1.0",
+            "--min_live_replicas", "1",
+        ])
+        assert args.cmd == "serve"
+        assert args.replicas == 2
+        assert args.burn_mitigation == "shed"
+        assert args.preempt == "bulk"
+        assert args.kv_host_tier is True
+        assert args.elastic_reserve == 1
+        assert args.scale_out_occupancy == 1.5
+        assert args.scale_in_occupancy == 0.2
+        assert args.scale_sustain_s == 0.25
+        assert args.scale_cooldown_s == 1.0
+        assert args.min_live_replicas == 1
+        assert args.scenario == "diurnal:bulk_fraction=0.4"
+
     def test_config_fields_become_flags(self):
         args = build_parser().parse_args(["p2p", "--count", "123", "--dtype", "bfloat16"])
         assert args.count == 123 and args.dtype == "bfloat16"

@@ -1,0 +1,135 @@
+"""Elastic fleet policy (tpu_patterns/serve/elastic.py).
+
+The decision half of the self-sizing fleet is PURE — no mesh, no
+processes, no wall clock — so every hysteresis property the serving
+doc promises is pinned here directly: separate high/low waters, the
+sustain window, the cooldown, the scale-in floor, and the
+shrink-must-fit guard.
+"""
+
+import pytest
+
+from tpu_patterns.serve.elastic import (
+    ElasticConfig,
+    ElasticPolicy,
+    FleetSignals,
+)
+
+
+def _sig(leases, *, live=2, spare=1, slots=4, pending=0):
+    return FleetSignals(
+        leases=leases, pending=pending, live=live, spare=spare,
+        slots=slots,
+    )
+
+
+def _cfg(**kw):
+    kw.setdefault("reserve", 1)
+    kw.setdefault("sustain_s", 0.5)
+    kw.setdefault("cooldown_s", 2.0)
+    return ElasticConfig(**kw)
+
+
+class TestConfigValidation:
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            dict(reserve=-1),
+            dict(in_occupancy=1.5, out_occupancy=1.25),  # inverted
+            dict(in_occupancy=-0.1),
+            dict(sustain_s=-1.0),
+            dict(cooldown_s=-1.0),
+            dict(min_live=0),
+        ],
+    )
+    def test_bad_knobs_rejected(self, bad):
+        with pytest.raises(ValueError):
+            _cfg(**bad)
+
+    def test_occupancy_is_per_live_slot(self):
+        assert _sig(8, live=2, slots=4).occupancy() == 1.0
+        assert _sig(8, live=1, slots=4).occupancy() == 2.0
+        assert _sig(6, live=2, slots=4, pending=2).occupancy() == 1.0
+        # degenerate fleets never divide by zero
+        assert _sig(4, live=0, slots=4).occupancy() == 1.0
+
+
+class TestScaleOut:
+    def test_sustained_pressure_scales_out(self):
+        pol = ElasticPolicy(_cfg())
+        hot = _sig(16, live=2, slots=4)  # occ 2.0 > 1.25
+        assert pol.decide(0.0, hot) is None  # sustain not met yet
+        assert pol.decide(0.2, hot) is None
+        assert pol.decide(0.6, hot) == "out"
+        assert pol.decisions == [(0.6, "out")]
+
+    def test_bursty_pressure_never_scales(self):
+        pol = ElasticPolicy(_cfg())
+        hot, calm = _sig(16), _sig(4)
+        for t in (0.0, 0.4, 0.8, 1.2):
+            assert pol.decide(t, hot if int(t * 10) % 8 == 0 else calm
+                              ) is None
+        assert pol.decisions == []
+
+    def test_no_spare_no_scale_out(self):
+        pol = ElasticPolicy(_cfg())
+        hot = _sig(16, spare=0)
+        assert pol.decide(0.0, hot) is None
+        assert pol.decide(1.0, hot) is None  # sustained, but no slice
+
+    def test_cooldown_gates_consecutive_actions(self):
+        pol = ElasticPolicy(_cfg(reserve=2))
+        hot = _sig(16, spare=2)
+        pol.decide(0.0, hot)
+        assert pol.decide(0.5, hot) == "out"
+        # still over-water and sustained, but inside the cooldown
+        assert pol.decide(1.0, hot) is None
+        assert pol.decide(2.0, hot) is None
+        # past the cooldown the (re-started) sustain window acts again
+        assert pol.decide(3.1, hot) == "out"
+
+    def test_sustain_tracks_through_cooldown(self):
+        # a burst that STARTS during cooldown counts its full duration:
+        # at cooldown expiry the policy acts immediately, it does not
+        # restart the sustain clock
+        pol = ElasticPolicy(_cfg(reserve=2, cooldown_s=5.0))
+        hot = _sig(16, spare=2)
+        pol.decide(0.0, hot)
+        assert pol.decide(0.5, hot) == "out"  # action at t=0.5
+        assert pol.decide(1.0, hot) is None  # cooling; over since 1.0
+        assert pol.decide(5.6, hot) == "out"  # sustained 4.6s >= 0.5s
+
+
+class TestScaleIn:
+    def test_sustained_idle_scales_in(self):
+        pol = ElasticPolicy(_cfg())
+        idle = _sig(1, live=2, slots=4)  # occ 0.125 < 0.25
+        assert pol.decide(0.0, idle) is None
+        assert pol.decide(0.6, idle) == "in"
+
+    def test_min_live_floor_holds(self):
+        pol = ElasticPolicy(_cfg(min_live=2))
+        idle = _sig(0, live=2)
+        assert pol.decide(0.0, idle) is None
+        assert pol.decide(1.0, idle) is None  # at the floor: never "in"
+
+    def test_shrink_must_fit_survivors(self):
+        # occupancy is under the low water but the surviving slots
+        # could not hold the in-flight work: the drain would only
+        # re-queue the pressure it claims to relieve
+        pol = ElasticPolicy(_cfg(in_occupancy=0.9, out_occupancy=1.0))
+        tight = _sig(7, live=2, slots=4)  # occ 0.875; survivors hold 4
+        assert pol.decide(0.0, tight) is None
+        assert pol.decide(1.0, tight) is None
+        # the under-water window was already sustained — the moment
+        # the in-flight work fits the survivors, the shrink goes
+        loose = _sig(3, live=2, slots=4)  # fits one replica
+        assert pol.decide(2.0, loose) == "in"
+
+    def test_out_and_in_waters_are_disjoint(self):
+        # between the waters the policy holds steady in BOTH directions
+        pol = ElasticPolicy(_cfg())
+        mid = _sig(4, live=2, slots=4)  # occ 0.5: 0.25 < occ < 1.25
+        for t in (0.0, 1.0, 2.0, 3.0):
+            assert pol.decide(t, mid) is None
+        assert pol.decisions == []

@@ -18,6 +18,13 @@ documented recovery behavior — the acceptance bar of the robustness PR:
   serve.verify   transient error -> wide step retried, ids exact;
                  deterministic error -> rows quarantined with shared-
                  block refcounts balanced (nothing leaks, nothing lost)
+
+PR 16's elastic-fleet sites fire next to the machinery they cut into:
+serve.preempt (fail-open: preemption aborts, the ladder degrades to
+shed, the victim keeps running) in tests/test_serve.py
+TestPreemption; fleet.scale_out / fleet.scale_in (the scale attempt
+aborts, the fleet stays at its current size) in tests/test_replica.py
+TestElasticFleet.
 """
 
 import dataclasses

@@ -580,3 +580,51 @@ class TestRunLoadgen:
             ("tpu_patterns_loadgen_e2e_p99_ms", (("scenario", "chat"),))
         ]
         assert p99.value > 0
+
+
+class TestPriorityClasses:
+    """``bulk_fraction`` tags arrivals with priority classes for the
+    PR 16 preemption ladder — spelled in the scenario grammar, drawn
+    LAST so priority-free schedules replay bit-identically."""
+
+    def test_grammar_spells_and_validates_bulk_fraction(self):
+        # batch-summarize is the diurnal-ramp preset the elastic
+        # smoke drives; bulk_fraction rides any preset
+        spec = parse_scenario("batch-summarize:bulk_fraction=0.4")
+        assert spec.arrival == "diurnal"
+        assert spec.bulk_fraction == 0.4
+        with pytest.raises(ValueError, match="bulk_fraction"):
+            parse_scenario("chat:bulk_fraction=1.5")
+
+    def test_priority_free_schedules_are_unchanged(self):
+        # the conditional-last draw: enabling bulk_fraction must not
+        # move arrivals, prompts, or lengths — only the priority tags
+        plain = parse_scenario("chat:requests=12")
+        assert plain.bulk_fraction == 0.0
+        a = build_schedule(plain, vocab=64, seed=1)
+        assert all(t.request.priority == "interactive" for t in a)
+        a2 = build_schedule(plain, vocab=64, seed=1)
+        assert a == a2  # no hidden draw when the feature is off
+        mixed = parse_scenario("chat:requests=12:bulk_fraction=0.5")
+        b = build_schedule(mixed, vocab=64, seed=1)
+        # arrivals are drawn up front: the class draw never moves them
+        assert [t.arrival_s for t in a] == [t.arrival_s for t in b]
+        # request 0's lengths/tokens predate the first class draw
+        assert a[0].request.tokens == b[0].request.tokens
+
+    def test_bulk_draw_tags_both_classes_and_replays(self):
+        spec = parse_scenario("chat:requests=20:bulk_fraction=0.5")
+        a = build_schedule(spec, vocab=64, seed=7)
+        classes = {t.request.priority for t in a}
+        assert classes == {"interactive", "bulk"}
+        b = build_schedule(spec, vocab=64, seed=7)
+        assert a == b  # priorities ride the seeded replay
+
+    def test_preempt_config_validated(self):
+        from tpu_patterns.loadgen import LoadGenConfig, validate_config
+
+        validate_config(LoadGenConfig(kv_host_tier=True, preempt="bulk"))
+        with pytest.raises(ValueError, match="preempt must be"):
+            validate_config(LoadGenConfig(preempt="everything"))
+        with pytest.raises(ValueError, match="requires kv_host_tier"):
+            validate_config(LoadGenConfig(preempt="bulk"))

@@ -942,6 +942,56 @@ class TestFleetMerge:
             registry=reg, replica="1",
         ) == 3.0
 
+    def test_elastic_series_export_with_their_labels(self):
+        # the PR 16 elastic plane's series: scale events keyed by
+        # action + replica, preemptions and sheds keyed by priority
+        # class — all first-class prom exports
+        reg = obs_metrics.Registry()
+        reg.counter(
+            "tpu_patterns_fleet_scale_events_total",
+            action="out", replica="2",
+        ).inc()
+        reg.counter(
+            "tpu_patterns_fleet_scale_events_total",
+            action="in", replica="2",
+        ).inc(2)
+        reg.counter(
+            "tpu_patterns_serve_preempted_total", priority="bulk"
+        ).inc(3)
+        reg.counter(
+            "tpu_patterns_serve_shed_total", priority="interactive"
+        ).inc()
+        text = reg.to_prom_text()
+        assert (
+            "# TYPE tpu_patterns_fleet_scale_events_total counter"
+            in text
+        )
+        assert (
+            "# TYPE tpu_patterns_serve_preempted_total counter" in text
+        )
+        samples = obs.parse_prom_text(text)
+        assert samples[(
+            "tpu_patterns_fleet_scale_events_total",
+            (("action", "out"), ("replica", "2")),
+        )] == 1
+        assert samples[(
+            "tpu_patterns_fleet_scale_events_total",
+            (("action", "in"), ("replica", "2")),
+        )] == 2
+        assert samples[(
+            "tpu_patterns_serve_preempted_total",
+            (("priority", "bulk"),),
+        )] == 3
+        from tpu_patterns import rt
+
+        assert rt.metric_total(
+            "tpu_patterns_fleet_scale_events_total", registry=reg
+        ) == 3.0
+        assert rt.metric_total(
+            "tpu_patterns_serve_shed_total",
+            registry=reg, priority="interactive",
+        ) == 1.0
+
 
 class TestObsShipper:
     def test_tap_feeds_deltas_and_metrics_ship_once(self):

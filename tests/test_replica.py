@@ -48,6 +48,14 @@ class TestSiteRegistry:
         assert "replica" in faults.MATCH_KEYS
         (spec,) = faults.parse_spec("replica.spawn:error:replica=1")
         assert spec.match == (("replica", "1"),)
+        # PR 16 elastic-fleet sites, with their match keys
+        for site in ("fleet.scale_out", "fleet.scale_in",
+                     "serve.preempt"):
+            assert site in faults.KNOWN_SITES
+        (spec,) = faults.parse_spec("fleet.scale_out:error:replica=2")
+        assert spec.match == (("replica", "2"),)
+        (spec,) = faults.parse_spec("serve.preempt:error:rid=7")
+        assert spec.match == (("rid", "7"),)
         (spec,) = faults.parse_spec("router.route:error:rid=3")
         assert spec.match == (("rid", "3"),)
         (spec,) = faults.parse_spec("replica.obs_ship:error:replica=1")
@@ -230,6 +238,8 @@ def _manager(n=2, policy="prefix", obs_base=None):
     mgr.drains = 0
     mgr.fleet_obs = FleetObs(obs_base)
     mgr.obs_stalls = 0
+    mgr.elastic = None
+    mgr._spare = []
     for r in range(n):
         h = ReplicaHandle(str(r), _FakeProc(), mgr.inbox)
         h.state = "ready"
@@ -451,6 +461,7 @@ class _FakeEngine:
     def __init__(self, replica="1"):
         self.done = {}
         self.failed = {}
+        self.shed = {}
         self.stats = {"steps": 0, "tokens": 0}
         self.replica = replica
         self.queue = []
@@ -713,3 +724,233 @@ class TestReplicaEndToEnd:
         assert (
             m["done"] + m["failed"] + m["rerouted"] == m["scheduled"]
         )
+
+
+def _elastic_manager(n=1, reserve=1, slots=2, **ecfg_kw):
+    """A fake-process manager with the PR 16 elastic plane attached:
+    router ring over ALL n + reserve ids, reserves quarantined, a
+    zero-hysteresis policy (the policy's own hysteresis is pinned in
+    test_elastic.py — these tests exercise the ACTIONS)."""
+    from tpu_patterns.serve.elastic import ElasticConfig, ElasticPolicy
+
+    mgr = _manager(n)
+    n_total = n + reserve
+    mgr.child_cfg = {"block_len": 8, "slots": slots}
+    mgr.device_slices = [[i] for i in range(n_total)]
+    mgr.router = Router(
+        [str(r) for r in range(n_total)], block_len=8
+    )
+    ecfg_kw.setdefault("sustain_s", 0.0)
+    ecfg_kw.setdefault("cooldown_s", 0.0)
+    mgr.elastic = ElasticPolicy(
+        ElasticConfig(reserve=reserve, **ecfg_kw)
+    )
+    mgr._spare = list(range(n, n_total))
+    for r in mgr._spare:
+        mgr.router.quarantine(str(r))
+    return mgr
+
+
+class TestElasticFleet:
+    def test_scale_out_is_warm_up_masked(
+        self, monkeypatch, tmp_path, no_real_kill
+    ):
+        # the spawn only forks + sends init; the reserve joins the
+        # ring when its READY handshake lands — never before
+        monkeypatch.setattr(
+            "tpu_patterns.exec.proc.popen_in_group",
+            lambda *a, **k: _FakeProc(),
+        )
+        mgr = _elastic_manager()
+        mgr.work_dir = str(tmp_path)
+        res = _res(mgr, [])
+        assert mgr.router.live() == {"0"}
+        mgr._scale_out(1.0, res)
+        assert mgr._spare == []
+        h = mgr.handles["1"]
+        assert h.state == "spawning"
+        assert h.proc.stdin.sent[0]["op"] == "init"
+        assert mgr.router.live() == {"0"}  # not routable yet
+        assert res.scale_events == [(1.0, "out", "1")]
+        mgr._handle("1", {"ready": True, "pid": 1}, res)
+        assert h.state == "ready"
+        assert mgr.router.live() == {"0", "1"}
+
+    def test_scale_out_fault_aborts_attempt(
+        self, monkeypatch, tmp_path, no_real_kill
+    ):
+        # satellite firing test: fleet.scale_out error -> THIS attempt
+        # aborts (no spawn, slice stays reserved); the policy simply
+        # re-decides after its cooldown
+        from tpu_patterns import obs
+
+        monkeypatch.setattr(
+            "tpu_patterns.exec.proc.popen_in_group",
+            lambda *a, **k: _FakeProc(),
+        )
+        faults.configure("fleet.scale_out:error:count=1")
+        before = obs.counter(
+            "tpu_patterns_faults_injected_total",
+            site="fleet.scale_out", action="error",
+        ).value
+        mgr = _elastic_manager()
+        mgr.work_dir = str(tmp_path)
+        res = _res(mgr, [])
+        mgr._scale_out(1.0, res)
+        assert obs.counter(
+            "tpu_patterns_faults_injected_total",
+            site="fleet.scale_out", action="error",
+        ).value == before + 1
+        assert mgr._spare == [1]  # slice kept
+        assert "1" not in mgr.handles
+        assert res.scale_events == []
+        # the spec burned: the next attempt goes through
+        mgr._scale_out(3.0, res)
+        assert mgr._spare == [] and "1" in mgr.handles
+
+    def test_spawn_failure_keeps_slice_reserved(
+        self, monkeypatch, tmp_path, no_real_kill
+    ):
+        # replica.spawn exhausting its retries mid-scale-out must not
+        # burn the reserve: the slice stays available for a later try
+        faults.configure("replica.spawn:error:count=99")
+        monkeypatch.setattr(
+            "tpu_patterns.exec.proc.popen_in_group",
+            lambda *a, **k: _FakeProc(),
+        )
+        mgr = _elastic_manager()
+        mgr.work_dir = str(tmp_path)
+        res = _res(mgr, [])
+        mgr._scale_out(1.0, res)
+        assert mgr._spare == [1] and "1" not in mgr.handles
+        assert res.scale_events == []
+
+    def test_scale_in_drains_coldest_and_retires_spawns_first(
+        self, no_real_kill
+    ):
+        mgr = _elastic_manager(n=2, reserve=0)
+        res = _res(mgr, [])
+        # equal (zero) leases: the tie retires the HIGHER id — elastic
+        # spawns go back before the core fleet shrinks
+        mgr._scale_in(5.0, res)
+        victim = mgr.handles["1"]
+        assert victim.state == "quarantined"
+        assert {"op": "drain"} in victim.proc.stdin.sent
+        assert mgr.router.live() == {"0"}
+        assert res.scale_events == [(5.0, "in", "1")]
+
+    def test_scale_in_prefers_fewest_leases(self, no_real_kill):
+        mgr = _elastic_manager(n=2, reserve=0)
+        reqs = _reqs(3)
+        res = _res(mgr, reqs)
+        hot = mgr.handles["1"]
+        for r in reqs:
+            hot.leases.acquire(r.rid, r)
+        mgr._scale_in(5.0, res)
+        assert mgr.handles["0"].state == "quarantined"  # the cold one
+        assert hot.state == "ready"
+
+    def test_scale_in_fault_aborts_and_fleet_stays_put(
+        self, no_real_kill
+    ):
+        # satellite firing test: fleet.scale_in error -> the fleet
+        # never shrinks below its current size on a faulted drain
+        faults.configure("fleet.scale_in:error:count=1")
+        mgr = _elastic_manager(n=2, reserve=0)
+        res = _res(mgr, [])
+        mgr._scale_in(5.0, res)
+        assert all(
+            h.state == "ready" for h in mgr.handles.values()
+        )
+        assert mgr.router.live() == {"0", "1"}
+        assert res.scale_events == []
+        assert not any(
+            {"op": "drain"} in h.proc.stdin.sent
+            for h in mgr.handles.values()
+        )
+
+    def test_elastic_tick_scales_out_under_sustained_pressure(
+        self, monkeypatch, tmp_path, no_real_kill
+    ):
+        monkeypatch.setattr(
+            "tpu_patterns.exec.proc.popen_in_group",
+            lambda *a, **k: _FakeProc(),
+        )
+        mgr = _elastic_manager(slots=2)
+        mgr.work_dir = str(tmp_path)
+        reqs = _reqs(5)  # 5 leases / (1 live * 2 slots) = 2.5 > 1.25
+        res = _res(mgr, reqs)
+        for r in reqs:
+            mgr._dispatch(r, res)
+        mgr._elastic_tick(1.0, res)
+        assert [e[1] for e in res.scale_events] == ["out"]
+        assert "1" in mgr.handles
+
+    def test_elastic_tick_scales_in_when_idle(self, no_real_kill):
+        mgr = _elastic_manager(n=2, reserve=0, slots=2)
+        res = _res(mgr, [])
+        mgr._elastic_tick(1.0, res)  # 0 leases: under the low water
+        assert [e[1] for e in res.scale_events] == ["in"]
+
+    def test_scale_events_book_the_fleet_counter(
+        self, monkeypatch, tmp_path, no_real_kill
+    ):
+        from tpu_patterns import obs
+
+        monkeypatch.setattr(
+            "tpu_patterns.exec.proc.popen_in_group",
+            lambda *a, **k: _FakeProc(),
+        )
+        before = obs.counter(
+            "tpu_patterns_fleet_scale_events_total",
+            action="out", replica="1",
+        ).value
+        mgr = _elastic_manager()
+        mgr.work_dir = str(tmp_path)
+        mgr._scale_out(1.0, res := _res(mgr, []))
+        assert obs.counter(
+            "tpu_patterns_fleet_scale_events_total",
+            action="out", replica="1",
+        ).value == before + 1
+
+
+class TestFleetResultShed:
+    def test_shed_op_releases_lease_and_books_terminal(
+        self, no_real_kill
+    ):
+        mgr = _manager(2)
+        reqs = _reqs(2)
+        res = _res(mgr, reqs)
+        for r in reqs:
+            mgr._dispatch(r, res)
+        h = next(x for x in mgr.handles.values() if len(x.leases))
+        rid = sorted(h.leases.held())[0]
+        fails_before = h.breaker.failures
+        mgr._handle(
+            h.id, {"op": "shed", "rid": rid, "reason": "burn"}, res
+        )
+        assert rid not in h.leases
+        assert res.shed[rid] == "burn"
+        # mitigation working is not replica sickness
+        assert h.breaker.failures == fails_before
+
+    def test_covered_and_counts_include_shed(self, no_real_kill):
+        res = FleetResult(scheduled=3)
+        res.done[0] = [1]
+        res.failed[1] = "x"
+        assert not res.covered()
+        res.shed[2] = "burn"
+        assert res.covered()
+        c = res.counts()
+        assert c["shed_total"] == 1.0
+        assert (
+            c["done_total"] + c["failed_total"] + c["shed_total"]
+            == res.scheduled
+        )
+
+    def test_scale_event_accessors(self):
+        res = FleetResult(scheduled=0)
+        res.scale_events += [(1.0, "out", "2"), (2.0, "in", "2"),
+                             (3.0, "out", "2")]
+        assert res.scale_outs() == 2
+        assert res.scale_ins() == 1

@@ -1445,3 +1445,346 @@ class TestKVTierReviewRegressions:
         assert 7 not in cands and 8 in cands
         eng._pending_cow = []
         eng.retained = {}
+
+
+def _mixed_reqs(n_bulk=2, n_inter=2, n_gen_bulk=8, n_gen_inter=3,
+                seed=9, vocab=VOCAB):
+    """bulk first (they admit and run), interactive behind them (they
+    arrive at a full fleet and must claim their slots)."""
+    rng = np.random.RandomState(seed)
+    reqs = []
+    for i in range(n_bulk + n_inter):
+        bulk = i < n_bulk
+        reqs.append(Request(
+            rid=i,
+            tokens=rng.randint(
+                0, vocab, size=rng.randint(9, 14)
+            ).tolist(),
+            n_gen=n_gen_bulk if bulk else n_gen_inter,
+            priority="bulk" if bulk else "interactive",
+        ))
+    return reqs
+
+
+def _preempt_engine(devices, *, slots=2, n_blocks=21, **kw):
+    mesh = _mesh(devices, (1, 1, 1))
+    mcfg = ModelConfig(**CFG, depth=1)
+    dec, params, _ = _decoder_and_params(
+        mesh, mcfg, n_blocks=n_blocks, block_len=8, max_len=40
+    )
+    eng = ServeEngine(
+        dec, params, slots=slots, kv_host_tier=True, preempt="bulk",
+        **kw,
+    )
+    return eng, dec, params
+
+
+class TestPreemption:
+    """Priority classes + mid-flight preemption (``preempt="bulk"``):
+    a running bulk row parks into the host KV tier and resumes with
+    zero recompute — the stitched stream is bit-identical."""
+
+    def test_preempt_requires_kv_host_tier(self, devices):
+        mesh = _mesh(devices, (1, 1, 1))
+        mcfg = ModelConfig(**CFG, depth=1)
+        dec, params, _ = _decoder_and_params(mesh, mcfg)
+        with pytest.raises(ValueError, match="requires kv_host_tier"):
+            ServeEngine(dec, params, slots=2, preempt="bulk")
+        with pytest.raises(ValueError, match="preempt must be"):
+            ServeEngine(dec, params, slots=2, preempt="sometimes")
+
+    def test_interactive_preempts_bulk_and_resume_is_bit_identical(
+        self, devices
+    ):
+        # slots full of running bulk; interactive arrivals claim their
+        # slots by parking a bulk row.  Every request — including the
+        # preempted-and-resumed bulk — must retire bit-identical to an
+        # unpreempted run of the same trace.
+        eng, dec, params = _preempt_engine(devices)
+        reqs = _mixed_reqs()
+        want = ServeEngine(dec, params, slots=2).run(
+            [dataclasses.replace(r) for r in reqs]
+        )
+        out = eng.run([dataclasses.replace(r) for r in reqs])
+        assert eng.stats["preempted"] >= 1
+        assert eng.stats["preempted_resumed"] >= 1
+        assert out == want  # stitched partial + resumed tail, exact
+        assert not eng.failed and not eng.shed
+        assert not eng.preempted_partial  # every banked partial retired
+        assert not eng.preempted_first_ns
+        # the lifecycle sees the WHOLE stream: a preempted-and-resumed
+        # request's n_out counts its banked tokens too, so goodput
+        # accounting never books a preemption as lost tokens
+        assert {
+            rid: lc["n_out"] for rid, lc in eng.lifecycle.items()
+        } == {r.rid: r.n_gen for r in reqs}
+        _assert_tier_invariants(eng)
+
+    def test_preempt_fault_fails_open_victim_untouched(self, devices):
+        # satellite gate: a deterministic serve.preempt failure aborts
+        # THE PREEMPTION — the victim keeps running, the interactive
+        # request waits for a natural slot, and nothing is lost
+        from tpu_patterns import faults, obs
+
+        eng, dec, params = _preempt_engine(devices)
+        reqs = _mixed_reqs()
+        want = ServeEngine(dec, params, slots=2).run(
+            [dataclasses.replace(r) for r in reqs]
+        )
+        before = obs.counter(
+            "tpu_patterns_faults_injected_total",
+            site="serve.preempt", action="error",
+        ).value
+        try:
+            faults.configure("serve.preempt:error:count=99")
+            out = eng.run([dataclasses.replace(r) for r in reqs])
+        finally:
+            faults.configure(None)
+        assert obs.counter(
+            "tpu_patterns_faults_injected_total",
+            site="serve.preempt", action="error",
+        ).value > before
+        assert eng.stats["preempted"] == 0
+        assert out == want  # nobody lost, nobody corrupted
+        assert not eng.failed and not eng.shed
+        _assert_tier_invariants(eng)
+
+    def test_mitigation_ladder_sheds_bulk_before_interactive(
+        self, devices
+    ):
+        # rung order under an active burn episode: queued bulk sheds
+        # FIRST (tagged "bulk first"), the interactive head only when
+        # the bulk rungs exhaust
+        eng, dec, params = _preempt_engine(
+            devices, burn_mitigation="shed"
+        )
+        inter = _trace(2, n_gen=6, seed=3)
+        for r in inter:
+            eng.submit(r)
+        adm = eng._admit()
+        eng._prefill(adm)  # slots full of INTERACTIVE rows
+        late = _mixed_reqs(n_bulk=1, n_inter=1, seed=5)
+        i2, b3 = late[1], late[0]
+        i2.rid, b3.rid = 2, 3
+        eng.submit(i2)  # head of the queue
+        eng.submit(b3)
+        eng.slo.mitigating = lambda: True
+        try:
+            assert eng._admit() == []
+        finally:
+            del eng.slo.mitigating
+        assert list(eng.shed) == [3, 2]  # bulk shed first
+        assert "bulk first" in eng.shed[3]
+        assert "bulk first" not in eng.shed[2]
+        assert eng.stats["preempted"] == 0  # no bulk was running
+        while eng.queue or eng.active:
+            eng._retire()
+            adm = eng._admit()
+            if adm:
+                eng._prefill(adm)
+                eng._retire()
+            if eng.active:
+                eng._step()
+        assert sorted(eng.done) == [0, 1]
+        assert len(eng.done) + len(eng.shed) == 4  # identity closes
+        _assert_tier_invariants(eng)
+
+    def test_mitigation_preempt_rung_parks_bulk_then_resumes(
+        self, devices
+    ):
+        # one mitigating poll with no queued bulk: the ladder's middle
+        # rung preempts a RUNNING bulk row (work parked, not lost);
+        # when the episode clears, the parked leg resumes and retires
+        # bit-identical
+        eng, dec, params = _preempt_engine(devices)
+        reqs = _mixed_reqs()
+        want = ServeEngine(dec, params, slots=2).run(
+            [dataclasses.replace(r) for r in reqs]
+        )
+        for r in reqs[:2]:  # the two bulk rows admit and run
+            eng.submit(dataclasses.replace(r))
+        adm = eng._admit()
+        eng._prefill(adm)
+        for r in reqs[2:]:  # interactive arrivals find the fleet full
+            eng.submit(dataclasses.replace(r))
+        eng.burn_mitigation = "shed"
+        episodes = iter([True])  # ONE mitigating poll, then clear
+        eng.slo.mitigating = lambda: next(episodes, False)
+        try:
+            adm = eng._admit()  # rung 2 parks a bulk row, then admits
+        finally:
+            del eng.slo.mitigating
+        assert eng.stats["preempted"] >= 1
+        if adm:
+            eng._prefill(adm)
+        while eng.queue or eng.active:
+            eng._retire()
+            adm = eng._admit()
+            if adm:
+                eng._prefill(adm)
+                eng._retire()
+            if eng.active:
+                eng._step()
+        eng._retire()
+        assert eng.stats["preempted_resumed"] >= 1
+        assert eng.done == want
+        assert not eng.shed and not eng.failed
+        _assert_tier_invariants(eng)
+
+    def test_mitigation_preempt_fault_degrades_to_shed(self, devices):
+        # the satellite's ladder-degradation gate: serve.preempt fails
+        # deterministically while mitigating -> the preempt rung fails
+        # OPEN and the ladder falls through to the shed rung; running
+        # bulk rows are untouched and still retire exactly
+        from tpu_patterns import faults
+
+        eng, dec, params = _preempt_engine(
+            devices, burn_mitigation="shed"
+        )
+        reqs = _mixed_reqs()
+        want = ServeEngine(dec, params, slots=2).run(
+            [dataclasses.replace(r) for r in reqs]
+        )
+        for r in reqs[:2]:  # the two bulk rows
+            eng.submit(dataclasses.replace(r))
+        adm = eng._admit()
+        eng._prefill(adm)
+        assert all(s.priority == "bulk" for s in eng.active)
+        eng.submit(dataclasses.replace(reqs[2]))  # interactive head
+        eng.slo.mitigating = lambda: True
+        try:
+            faults.configure("serve.preempt:error:count=99")
+            assert eng._admit() == []
+        finally:
+            faults.configure(None)
+            del eng.slo.mitigating
+        assert eng.stats["preempted"] == 0
+        assert len(eng.active) == 2  # victims untouched, still running
+        assert list(eng.shed) == [2]  # the head shed, loudly
+        while eng.queue or eng.active:
+            eng._retire()
+            adm = eng._admit()
+            if adm:
+                eng._prefill(adm)
+                eng._retire()
+            if eng.active:
+                eng._step()
+        assert eng.done[0] == want[0] and eng.done[1] == want[1]
+        assert len(eng.done) + len(eng.shed) == 3
+        _assert_tier_invariants(eng)
+
+    def test_preempted_state_survives_snapshot_restore(
+        self, devices, tmp_path
+    ):
+        # a SIGTERM-style snapshot lands while a priority preemption is
+        # in flight (banked partial, resumed leg queued): the restored
+        # engine finishes the trace bit-identical — the preemption
+        # state serializes round-trip
+        from tpu_patterns import ckpt, faults
+
+        eng, dec, params = _preempt_engine(
+            devices, snapshot_dir=str(tmp_path / "snap"),
+            fingerprint={"t": 16},
+        )
+        reqs = _mixed_reqs()
+        want = ServeEngine(dec, params, slots=2).run(
+            [dataclasses.replace(r) for r in reqs]
+        )
+        faults.configure("serve.step:preempt:after=3:count=1")
+        try:
+            eng.run([dataclasses.replace(r) for r in reqs])
+        finally:
+            faults.configure(None)
+        assert eng.preempted_at is not None
+        assert eng.stats["preempted"] >= 1
+        assert eng.preempted_partial  # a banked partial is in flight
+        eng2, *_ = _preempt_engine(
+            devices, snapshot_dir=str(tmp_path / "snap"),
+            fingerprint={"t": 16},
+        )
+        assert eng2.restore_snapshot() == eng.preempted_at
+        assert eng2.preempted_partial == eng.preempted_partial
+        got = eng2.run([])
+        assert got == want
+        assert eng2.stats["preempted_resumed"] >= 1
+        _assert_tier_invariants(eng2)
+
+    def test_property_random_preempt_shed_quarantine_interleavings(
+        self, devices
+    ):
+        """Satellite property test: seeded random interleavings of
+        admit / preempt / shed / quarantine / evict hold the tier +
+        refcount invariants at every step, and the lifecycle identity
+        done + failed + shed == scheduled closes at settlement with
+        zero leaked blocks."""
+        eng, dec, params = _preempt_engine(devices, slots=3,
+                                           n_blocks=17)
+        rng = np.random.RandomState(13)
+        pending = []
+        for i in range(14):
+            pending.append(Request(
+                rid=i,
+                tokens=rng.randint(
+                    0, VOCAB, size=rng.randint(9, 14)
+                ).tolist(),
+                n_gen=int(rng.randint(3, 7)),
+                priority="bulk" if i % 2 else "interactive",
+            ))
+        pending = pending[::-1]
+        scheduled = 14
+        for _ in range(80):
+            op = rng.randint(5)
+            if op == 0 and pending:
+                eng.submit(pending.pop())
+            eng._retire()
+            _assert_tier_invariants(eng)
+            admitted = eng._admit()
+            if admitted:
+                eng._prefill(admitted)
+                eng._retire()
+            _assert_tier_invariants(eng)
+            if op == 1:
+                eng._try_preempt()
+                _assert_tier_invariants(eng)
+            if op == 2 and eng.queue:
+                req, _t = eng.queue.pop(
+                    rng.randint(len(eng.queue))
+                )
+                eng._shed_request(
+                    req.rid, "property-test", priority=req.priority
+                )
+                _assert_tier_invariants(eng)
+            if op == 3 and eng.active:
+                victim = eng.active.pop(
+                    rng.randint(len(eng.active))
+                )
+                eng._quarantine([victim], "property-test")
+                _assert_tier_invariants(eng)
+            if op == 4:
+                eng._evict_for(rng.randint(1, 4), set())
+                _assert_tier_invariants(eng)
+            if eng.active:
+                eng._step()
+                _assert_tier_invariants(eng)
+            if not (pending or eng.queue or eng.active):
+                break
+        while eng.queue or eng.active:
+            eng._retire()
+            admitted = eng._admit()
+            if admitted:
+                eng._prefill(admitted)
+                eng._retire()
+            if eng.active:
+                eng._step()
+            _assert_tier_invariants(eng)
+        assert not pending
+        assert eng.stats["preempted"] > 0  # the seed exercises the path
+        terminal = set(eng.done) | set(eng.failed) | set(eng.shed)
+        assert terminal == set(range(scheduled))
+        assert (
+            len(eng.done) + len(eng.failed) + len(eng.shed)
+            == scheduled
+        )
+        assert not eng.preempted_partial  # nothing banked dangles
+        assert eng.leaked_blocks() == 0
+        _assert_tier_invariants(eng)

@@ -426,6 +426,9 @@ class MetricNaming(Rule):
         # (obs/slo.py) — PR 15
         "endpoint",
         "window",
+        # priority classes: shed/preempt series are keyed by request
+        # class (serve/engine.py — PR 16, interactive > bulk)
+        "priority",
     })
     PREFIX = "tpu_patterns_"
 

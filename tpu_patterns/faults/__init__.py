@@ -70,6 +70,20 @@ and docs/robustness.md):
                  ``tpu_patterns_obs_http_requests_total`` — a broken
                  scrape must never crash (or block) the scheduler
                  thread it observes
+  serve.preempt  serve/engine.py, before a running bulk request is
+                 preempted into the host tier (ctx: rid, replica):
+                 ``error`` aborts THAT preemption and the mitigation
+                 ladder degrades to its shed rung — the victim keeps
+                 running untouched, the queued request sheds loudly;
+                 the request is never lost or corrupted
+  fleet.scale_out serve/replica.py (parent), before the elastic
+                 controller spawns a replica on a reserved slice (ctx:
+                 replica): ``error`` aborts that scale-out attempt (the
+                 policy re-decides on a later tick); ``sleep`` stalls it
+  fleet.scale_in serve/replica.py (parent), before the elastic
+                 controller drains the coldest replica (ctx: replica):
+                 ``error`` aborts that scale-in attempt — the fleet
+                 stays at its current size, never below it
 """
 
 from tpu_patterns.faults.injector import (  # noqa: F401

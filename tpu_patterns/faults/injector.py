@@ -71,9 +71,10 @@ ACTIONS = frozenset(
 KNOWN_SITES = frozenset({
     "worker.ready", "cell.run", "ckpt.save", "ckpt.restore",
     "train.step", "serve.prefill", "serve.step", "serve.verify",
-    "serve.evict", "serve.onload", "serve.shed",
+    "serve.evict", "serve.onload", "serve.shed", "serve.preempt",
     "loadgen.arrive", "router.route", "replica.spawn", "replica.drain",
     "replica.obs_ship", "obs.scrape",
+    "fleet.scale_out", "fleet.scale_in",
 })
 
 # ctx keys the call sites actually pass — the only keys a match

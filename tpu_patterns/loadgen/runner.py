@@ -142,6 +142,11 @@ class LoadGenConfig:
     kv_host_tier: bool = False
     session_dir: str = ""  # persist evicted prefixes across restarts
     host_tier_blocks: int = 0
+    # mid-flight preemption of running bulk requests (engine flag;
+    # requires kv_host_tier — a preempted row is forced through the
+    # evict path and resumed with zero recompute).  Pair with a
+    # scenario carrying bulk_fraction > 0 so there are bulk victims.
+    preempt: str = "off"
     watchdog_s: float = 0.0
     # the workload: comma-separated scenario specs
     # ("chat,rag:requests=16" — scenarios.parse_scenario grammar)
@@ -221,6 +226,15 @@ def validate_config(cfg: LoadGenConfig) -> None:
         )
     if cfg.session_dir and not cfg.kv_host_tier:
         raise ValueError("session_dir requires kv_host_tier")
+    if cfg.preempt not in ("off", "bulk"):
+        raise ValueError(
+            f"preempt must be off | bulk, got {cfg.preempt!r}"
+        )
+    if cfg.preempt != "off" and not cfg.kv_host_tier:
+        raise ValueError(
+            "preempt requires kv_host_tier: a preempted row is forced "
+            "through the evict path into the host tier"
+        )
     if cfg.burn_mitigation not in ("off", "shed", "spec_off"):
         raise ValueError(
             f"burn_mitigation must be off | shed | spec_off, got "
@@ -255,7 +269,7 @@ def _session_fingerprint(cfg: LoadGenConfig) -> dict:
 def _drive(
     decoder, params, cfg: LoadGenConfig, spec: ScenarioSpec,
     schedule: list[TimedRequest], *, kv_tier: bool = False,
-    use_session: bool = True,
+    use_session: bool = True, use_preempt: bool = True,
 ) -> tuple[ServeEngine, ArrivalSource, float]:
     from tpu_patterns import obs
 
@@ -267,6 +281,11 @@ def _drive(
             (cfg.session_dir or None) if kv_tier and use_session else None
         ),
         host_tier_blocks=cfg.host_tier_blocks,
+        # the defer-only baseline legs run tierless, so they cannot
+        # preempt either; the kv_tier A/B race passes use_preempt=False
+        # on ITS tiered legs too, so the contrast stays tier-vs-defer
+        # instead of charging preemption overhead to the ladder
+        preempt=cfg.preempt if (kv_tier and use_preempt) else "off",
         fingerprint=_session_fingerprint(cfg) if kv_tier else None,
         # _slo_kwargs reads the same field names off either config
         # class — one monitor config for every engine built here
@@ -328,6 +347,8 @@ def _stats(
         "ttft": ttft, "tpot": tpot, "e2e": e2e,
         "done": done, "failed": failed, "dropped": len(source.dropped),
         "sheds": len(eng.shed),
+        "preempted": eng.stats["preempted"],
+        "preempted_resumed": eng.stats["preempted_resumed"],
         "goodput": good_tokens / total_tokens if total_tokens else 0.0,
         "tokens": sum(
             lc["n_out"] for lc in eng.lifecycle.values()
@@ -501,6 +522,8 @@ def run_loadgen(mesh, cfg: LoadGenConfig, writer) -> list:
                 "failed": float(st["failed"]),
                 "dropped": float(st["dropped"]),
                 "shed": float(st["sheds"]),
+                "preempted": float(st["preempted"]),
+                "preempted_resumed": float(st["preempted_resumed"]),
                 "deferrals": float(st["deferrals"]),
                 "tokens": float(st["tokens"]),
                 "slo_ttft_ms": spec.slo_ttft_ms,
@@ -569,15 +592,17 @@ def _kv_tier_loadgen_record(
     # an in-race compile would charge XLA's compiler to the ladder
     _drive(
         decoder, params, cfg, spec, schedule, kv_tier=True,
-        use_session=False,
+        use_session=False, use_preempt=False,
     )
     with obs.span("loadgen.kv_tier", scenario=spec.name):
         # session off for the race: a session cache committed by the
         # main leg would hand this leg its history for free and the
-        # contrast would measure the cache, not the ladder
+        # contrast would measure the cache, not the ladder; preempt
+        # off for the same reason — the race measures the tier ladder,
+        # not priority scheduling
         tier_eng, tier_source, tier_wall_s = _drive(
             decoder, params, cfg, spec, schedule, kv_tier=True,
-            use_session=False,
+            use_session=False, use_preempt=False,
         )
     tier_st = _stats(tier_eng, tier_source, schedule)
     with obs.span("loadgen.kv_defer_baseline", scenario=spec.name):

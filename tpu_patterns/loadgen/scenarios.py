@@ -75,6 +75,12 @@ class ScenarioSpec:
     # default) keeps the full-rectangle pool every existing scenario
     # runs under.
     working_set_mult: float = 0.0
+    # priority mix: with > 0 each request draws its class — ``bulk``
+    # with this probability, ``interactive`` otherwise.  Priority-aware
+    # admission sheds/preempts bulk first (docs/robustness.md's
+    # degradation ladder).  0 (the default) tags nothing and draws
+    # nothing, so priority-free schedules replay bit-identically.
+    bulk_fraction: float = 0.0
 
     def __post_init__(self):
         if self.arrival not in ARRIVAL_PROCESSES:
@@ -122,6 +128,11 @@ class ScenarioSpec:
                 f"scenario {self.name!r}: working_set_mult must be "
                 f">= 0 (0 = full-rectangle pool), got "
                 f"{self.working_set_mult}"
+            )
+        if not 0.0 <= self.bulk_fraction <= 1.0:
+            raise ValueError(
+                f"scenario {self.name!r}: bulk_fraction must be in "
+                f"[0, 1], got {self.bulk_fraction}"
             )
 
     def deadline_ms(self, n_gen: int) -> float:
@@ -267,12 +278,19 @@ def build_schedule(
             ]
         else:
             tokens = [rng.randrange(vocab) for _ in range(lp)]
+        # priority draw LAST and only when enabled, so priority-free
+        # specs keep their exact historical draw sequence
+        priority = "interactive"
+        if spec.bulk_fraction > 0:
+            if rng.random() < spec.bulk_fraction:
+                priority = "bulk"
         out.append(
             TimedRequest(
                 request=Request(
                     rid=rid, tokens=tokens, n_gen=n_gen,
                     scenario=spec.name,
                     deadline_ms=spec.deadline_ms(n_gen),
+                    priority=priority,
                 ),
                 arrival_s=off * time_scale,
             )

@@ -87,6 +87,10 @@ class Request:
     # stitches into ONE flow across every process it touched ("" =
     # single-engine run, no journey)
     jid: str = ""
+    # priority class: ``interactive`` > ``bulk``.  The degradation
+    # ladder sheds/preempts bulk first and touches interactive only
+    # when the ladder exhausts (docs/robustness.md)
+    priority: str = "interactive"
 
 
 @dataclasses.dataclass
@@ -108,6 +112,7 @@ class _Slot:
     scenario: str = ""
     deadline_ms: float = 0.0
     jid: str = ""  # fleet journey id (rides the lifecycle spans)
+    priority: str = "interactive"  # interactive | bulk (preemptible)
     t_admit_ns: int = 0
     t_first_ns: int = 0
     t_last_ns: int = 0
@@ -132,7 +137,8 @@ class ServeEngine:
                  session_dir: str | None = None,
                  host_tier_blocks: int = 0,
                  slo: SloConfig | None = None,
-                 burn_mitigation: str = "off"):
+                 burn_mitigation: str = "off",
+                 preempt: str = "off"):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if spec_k < 0:
@@ -143,6 +149,15 @@ class ServeEngine:
             raise ValueError(
                 f"burn_mitigation must be off | shed | spec_off, got "
                 f"{burn_mitigation!r}"
+            )
+        if preempt not in ("off", "bulk"):
+            raise ValueError(
+                f"preempt must be off | bulk, got {preempt!r}"
+            )
+        if preempt != "off" and not kv_host_tier:
+            raise ValueError(
+                "preempt requires kv_host_tier: a preempted row is "
+                "forced through the evict path into the host tier"
             )
         self.decoder = decoder
         self.params = params
@@ -236,6 +251,19 @@ class ServeEngine:
         # admissions the burn monitor shed: {rid: reason} — a terminal
         # bucket like ``failed``, so accounting identities close
         self.shed: dict[int, str] = {}
+        # mid-flight preemption of bulk rows (``preempt="bulk"``): under
+        # pressure a running bulk row is forced through the evict path
+        # into the host tier and re-queued as a forced session — its
+        # partial output banks here until the resumed leg retires, so
+        # the final ids stitch bit-identically (zero recompute for
+        # every full KV block by the tier invariants)
+        self.preempt = preempt
+        self.preempted_partial: dict[int, list[int]] = {}
+        # the original leg's first-token timestamp: the resumed leg's
+        # lifecycle must report the TTFT the client actually saw, not
+        # the re-admission's
+        self.preempted_first_ns: dict[int, int] = {}
+        self.preempted_rids: set[int] = set()
         # the in-flight ledger (rt.LeaseTable, the same type the
         # replica parent settles fail-over against): rid -> its _Slot,
         # acquired at admission, released at retire/quarantine — the
@@ -267,6 +295,10 @@ class ServeEngine:
             "retained_peak": 0,
             # burn-rate mitigation accounting (0 with the ladder off)
             "sheds": 0,
+            # priority preemption accounting (0 with preempt="off"):
+            # preempted counts preemption EVENTS, preempted_resumed
+            # counts requests that were preempted and later retired
+            "preempted": 0, "preempted_resumed": 0,
         }
         # preemption safety: SIGTERM/SIGINT (or an injected ``preempt``)
         # sets the event; the loop finishes the current decode step,
@@ -630,6 +662,20 @@ class ServeEngine:
                     self._release_block(b)
                 self.slot_pool.release(s.slot, reusable=True)
                 self.inflight.release(s.rid)
+                if s.rid in self.preempted_partial:
+                    # a resumed leg retiring: stitch the banked partial
+                    # output in front of this leg's ids — the final
+                    # stream is bit-identical to an unpreempted decode.
+                    # The lifecycle sees the WHOLE stream: n_out counts
+                    # the banked tokens and TTFT is the original leg's
+                    # first token, so goodput accounting never charges
+                    # a preemption as lost tokens or a late first token
+                    s.out = self.preempted_partial.pop(s.rid) + s.out
+                    s.t_first_ns = (
+                        self.preempted_first_ns.pop(s.rid, None)
+                        or s.t_first_ns
+                    )
+                    self.stats["preempted_resumed"] += 1
                 self.done[s.rid] = s.out
                 self._finalize_lifecycle(s, "done")
                 obs.counter("tpu_patterns_serve_requests_total").inc()
@@ -718,6 +764,125 @@ class ServeEngine:
             last, 0, tid=lane, **attrs,
         )
 
+    def _shed_request(
+        self, rid: int, reason: str, priority: str = "interactive"
+    ) -> None:
+        """Terminal shed bookkeeping (the burn ladder's shed rungs):
+        counted, never dropped silently — done+failed+shed(+resumed)
+        still covers the trace."""
+        from tpu_patterns import obs
+
+        self.shed[rid] = reason
+        # a shed resumed leg abandons its banked partial: the request
+        # is terminally accounted (shed), nothing dangles
+        self.preempted_partial.pop(rid, None)
+        self.preempted_first_ns.pop(rid, None)
+        self.stats["sheds"] += 1
+        obs.counter(
+            "tpu_patterns_serve_shed_total", priority=priority
+        ).inc()
+        obs.event("serve.shed", rid=str(rid), priority=priority)
+
+    def _preempt_victim(self) -> _Slot | None:
+        """The bulk row to preempt next: the most recently admitted
+        bulk slot (LIFO — the oldest bulk row has banked the most
+        decode work and is closest to retiring).  Rows whose blocks
+        ride a pending CoW copy are skipped: the boundary copy must
+        read the donor before anything reuses it."""
+        pending = {b for pair in self._pending_cow for b in pair}
+        for s in reversed(self.active):
+            if s.priority != "bulk":
+                continue
+            if len(s.out) >= s.n_gen:
+                continue  # finished, awaiting retire: nothing to park
+            if any(b in pending for b in s.table):
+                continue
+            return s
+        return None
+
+    def _preempt_slot(self, s: _Slot, protect=frozenset()) -> None:
+        """Preempt running row ``s`` mid-flight: index its decoded
+        context (every full KV block becomes a shareable radix node),
+        release the row, force the now-retained blocks through the
+        evict path into the host tier, and re-queue the request as a
+        forced session carrying its partial output.  Re-admission
+        restores/aliases those blocks — zero recompute for every full
+        block, and the stitched stream is bit-identical because the
+        tier restore is bit-identical.  ``protect`` blocks (an in-
+        flight admission's alias/donor set) stay device-resident."""
+        from tpu_patterns import obs
+
+        self.active.remove(s)
+        # KV is written for positions [0, lens + steps): the prompt
+        # plus every FED generated token (the newest sampled token's
+        # K/V lands next step).  Index exactly the fully-written
+        # blocks of the current context — never a half-written one.
+        ctx = s.prompt + s.out
+        n_kv = s.lens + s.steps
+        new_ids = self.index.insert(ctx[:n_kv], s.table)
+        self.index.materialize(list(new_ids))
+        for b in s.table:
+            self._release_block(b)
+        self.slot_pool.release(s.slot, reusable=True)
+        self.inflight.release(s.rid)
+        # force the parked context to host, leaf-first waves; a block
+        # another row still references (or a protected one) stays
+        # device-resident and simply aliases on resume — fail-soft
+        want = {b for b in s.table if b in self.retained} - set(protect)
+        while want:
+            wave = [
+                b for b in self._evict_candidates(set(protect))
+                if b in want
+            ]
+            if not wave or not self._evict_wave(wave, rid=s.rid):
+                break
+            want -= set(wave)
+        self.preempted_partial[s.rid] = (
+            self.preempted_partial.get(s.rid, []) + list(s.out)
+        )
+        if s.t_first_ns and s.rid not in self.preempted_first_ns:
+            self.preempted_first_ns[s.rid] = s.t_first_ns
+        self.preempted_rids.add(s.rid)
+        # re-queue the remainder as a forced session, at the BACK (bulk
+        # waits); the original submit time rides along so the eventual
+        # e2e latency still counts the full wait
+        self.queue.append((
+            Request(
+                rid=s.rid, tokens=ctx, n_gen=s.n_gen - len(s.out),
+                scenario=s.scenario, deadline_ms=s.deadline_ms,
+                jid=s.jid, priority="bulk",
+            ),
+            s.t_submit_ns,
+        ))
+        self.stats["preempted"] += 1
+        obs.counter(
+            "tpu_patterns_serve_preempted_total", priority="bulk"
+        ).inc()
+        obs.event(
+            "serve.preempted", rid=str(s.rid), replica=self.replica,
+            banked=str(len(s.out)),
+        )
+
+    def _try_preempt(self, protect=frozenset()) -> bool:
+        """One guarded preemption attempt: pick a bulk victim and force
+        it out.  The ``serve.preempt`` fault site fails OPEN — an
+        injected error aborts THE PREEMPTION (victim untouched, still
+        running) and the caller degrades to its shed/defer rung; the
+        victim request is never lost or corrupted."""
+        if self.preempt != "bulk":
+            return False
+        victim = self._preempt_victim()
+        if victim is None:
+            return False
+        try:
+            faults.inject(
+                "serve.preempt", rid=victim.rid, replica=self.replica
+            )
+        except faults.InjectedFault:
+            return False  # fail open: degrade to shed, victim untouched
+        self._preempt_slot(victim, protect=protect)
+        return True
+
     def _admit(self) -> list[tuple[Request, _Slot]]:
         """Pull queued requests into free slots while blocks last; a
         request the pool cannot cover right now DEFERS (stays queued, a
@@ -743,7 +908,27 @@ class ServeEngine:
             # admits normally (mitigation degrades to no mitigation,
             # never to a lost request).
             if self.burn_mitigation == "shed" and self.slo.mitigating():
-                req, _t = self.queue[0]
+                # priority-aware ladder: shed-bulk -> preempt-bulk ->
+                # shed-interactive.  Queued bulk sheds first (no work
+                # lost — it never started); with no shedable bulk
+                # queued, a RUNNING bulk row preempts into the host
+                # tier (work parked, not lost); only when both rungs
+                # exhaust does the head shed whatever its class.
+                # Resumed legs (banked partial output) are exempt from
+                # the bulk-shed rung: the preempt rung chose to park
+                # that work, the shed rung must not throw it away.
+                bi = next(
+                    (
+                        i for i, (r, _) in enumerate(self.queue)
+                        if r.priority == "bulk"
+                        and r.rid not in self.preempted_partial
+                    ),
+                    None,
+                )
+                if bi is None and self._try_preempt():
+                    continue
+                shed_i = bi if bi is not None else 0
+                req, _t = self.queue[shed_i]
                 try:
                     faults.inject(
                         "serve.shed", rid=req.rid, replica=self.replica
@@ -751,13 +936,13 @@ class ServeEngine:
                 except faults.InjectedFault:
                     pass  # fail open: fall through to normal admission
                 else:
-                    self.queue.pop(0)
-                    self.shed[req.rid] = (
+                    self.queue.pop(shed_i)
+                    self._shed_request(
+                        req.rid,
                         "shed: slo burn-rate mitigation active"
+                        + (" (bulk first)" if bi is not None else ""),
+                        priority=req.priority,
                     )
-                    self.stats["sheds"] += 1
-                    obs.counter("tpu_patterns_serve_shed_total").inc()
-                    obs.event("serve.shed", rid=str(req.rid))
                     continue
             # one scheduler slot per active row, leased from the shared
             # runtime core's pool (max_leased == slots) — None means
@@ -765,7 +950,17 @@ class ServeEngine:
             # deferral: deferral is pool pressure, this is width)
             slot_tok = self.slot_pool.lease()
             if slot_tok is None:
-                break
+                # priority admission: a queued INTERACTIVE request may
+                # claim its slot by preempting a running bulk row (the
+                # fault site inside fails open — no preemption, the
+                # active set stays full, admission simply ends)
+                if (
+                    self.queue[0][0].priority == "interactive"
+                    and self._try_preempt()
+                ):
+                    slot_tok = self.slot_pool.lease()
+                if slot_tok is None:
+                    break
             req, t_submit = self.queue[0]
             need = self._blocks_needed(req)
             plan = (
@@ -791,10 +986,22 @@ class ServeEngine:
             # are protected: they are ref-0 right now but about to be
             # referenced.
             device_need = need - len(aliased)
+            protect = set(aliased)
+            if plan and plan.donor is not None:
+                protect.add(plan.donor)
             if device_need > len(self.free):
-                protect = set(aliased)
-                if plan and plan.donor is not None:
-                    protect.add(plan.donor)
+                self._evict_for(
+                    device_need - len(self.free), protect, rid=req.rid
+                )
+            # priority admission under pool pressure: an interactive
+            # request still short after eviction preempts bulk rows —
+            # each preemption frees the victim's blocks (evicted to
+            # host or straight to the free list) before deferring
+            while (
+                device_need > len(self.free)
+                and req.priority == "interactive"
+                and self._try_preempt(protect=protect)
+            ):
                 self._evict_for(
                     device_need - len(self.free), protect, rid=req.rid
                 )
@@ -878,7 +1085,8 @@ class ServeEngine:
                 write_from=min(write_from, len(req.tokens)),
                 own_blocks=own_blocks,
                 scenario=req.scenario, deadline_ms=req.deadline_ms,
-                jid=req.jid, t_admit_ns=now, slot=slot_tok,
+                jid=req.jid, priority=req.priority,
+                t_admit_ns=now, slot=slot_tok,
             )
             self.inflight.acquire(req.rid, slot)
             if req.jid:
@@ -1140,6 +1348,10 @@ class ServeEngine:
                 self._release_block(b)
             self.slot_pool.release(s.slot, reusable=True)
             self.inflight.release(s.rid)
+            # a quarantined resumed leg is terminally FAILED: drop the
+            # banked partial so nothing dangles in the accounting
+            self.preempted_partial.pop(s.rid, None)
+            self.preempted_first_ns.pop(s.rid, None)
             self.failed[s.rid] = reason
             self._finalize_lifecycle(s, "failed")
             obs.counter("tpu_patterns_serve_quarantined_total").inc()
@@ -1210,7 +1422,8 @@ class ServeEngine:
             "format": SNAPSHOT_FORMAT,
             "fingerprint": self.fingerprint,
             "queue": [
-                {"rid": r.rid, "tokens": r.tokens, "n_gen": r.n_gen}
+                {"rid": r.rid, "tokens": r.tokens, "n_gen": r.n_gen,
+                 "priority": r.priority}
                 for r, _ in self.queue
             ],
             "active": [
@@ -1218,7 +1431,7 @@ class ServeEngine:
                     "rid": s.rid, "lens": s.lens, "steps": s.steps,
                     "n_gen": s.n_gen, "table": s.table,
                     "last_tok": s.last_tok, "out": s.out,
-                    "prompt": s.prompt,
+                    "prompt": s.prompt, "priority": s.priority,
                 }
                 for s in self.active
             ],
@@ -1230,6 +1443,13 @@ class ServeEngine:
             "done": {str(k): v for k, v in self.done.items()},
             "failed": {str(k): v for k, v in self.failed.items()},
             "shed": {str(k): v for k, v in self.shed.items()},
+            "preempted_partial": {
+                str(k): v for k, v in self.preempted_partial.items()
+            },
+            "preempted_first_ns": {
+                str(k): v for k, v in self.preempted_first_ns.items()
+            },
+            "preempted_rids": sorted(self.preempted_rids),
             "stats": {
                 k: v for k, v in self.stats.items() if k != "queue_wait_ns"
             },
@@ -1334,7 +1554,8 @@ class ServeEngine:
         now = clock_ns()
         self.queue = [
             (Request(rid=q["rid"], tokens=list(q["tokens"]),
-                     n_gen=q["n_gen"]), now)
+                     n_gen=q["n_gen"],
+                     priority=q.get("priority", "interactive")), now)
             for q in state["queue"]
         ]
         self.active = [
@@ -1343,6 +1564,7 @@ class ServeEngine:
                 n_gen=a["n_gen"], table=list(a["table"]),
                 last_tok=a["last_tok"], out=list(a["out"]),
                 t_submit_ns=now, prompt=list(a["prompt"]),
+                priority=a.get("priority", "interactive"),
                 slot=self.slot_pool.lease(),
             )
             for a in state["active"]
@@ -1359,6 +1581,17 @@ class ServeEngine:
         self.failed = {int(k): v for k, v in state["failed"].items()}
         self.shed = {
             int(k): v for k, v in (state.get("shed") or {}).items()
+        }
+        self.preempted_partial = {
+            int(k): list(v)
+            for k, v in (state.get("preempted_partial") or {}).items()
+        }
+        self.preempted_first_ns = {
+            int(k): int(v)
+            for k, v in (state.get("preempted_first_ns") or {}).items()
+        }
+        self.preempted_rids = {
+            int(r) for r in (state.get("preempted_rids") or [])
         }
         for k, v in state["stats"].items():
             if k in self.stats and k != "queue_wait_ns":
@@ -1626,6 +1859,13 @@ class ServeConfig:
     slo_slow_s: float = 300.0  # slow burn window (contextualizes)
     slo_budget: float = 0.1  # allowed bad-token fraction
     burn_multiplier: float = 2.0  # fast-window burn that trips the ladder
+    # priority classes + mid-flight preemption (docs/robustness.md):
+    # with ``bulk``, a running bulk row under pressure (burn episode, a
+    # full active set blocking an interactive admit, or pool pressure)
+    # is forced through the evict path into the host tier and re-queued
+    # as a forced session — resumed later with zero recompute for every
+    # full KV block, final ids bit-identical.  Requires --kv_host_tier.
+    preempt: str = "off"  # off | bulk
     # multi-replica serving (serve/replica.py): N engine replicas, each
     # its own PROCESS pinned to a disjoint mesh slice
     # (topo/placement.py), behind the prefix-aware router
@@ -1641,6 +1881,19 @@ class ServeConfig:
     min_replica_speedup: float = 1.8
     replica_watchdog_s: float = 120.0  # no-message deadline per replica
     replica_dir: str = ""  # fleet work dir (logs + drain snapshots)
+    # the elastic fleet (serve/elastic.py): partition N + R disjoint
+    # slices up front, start N replicas, and let the parent's policy
+    # loop scale OUT onto a reserved slice (warm-up-masked spawn) when
+    # lease occupancy sustains above the high water, and scale IN by
+    # draining the coldest replica (sessions banked via the per-replica
+    # session dir) when it sustains below the low water.  0 = static
+    # fleet (every PR 12–15 path unchanged).
+    elastic_reserve: int = 0
+    scale_out_occupancy: float = 1.25  # leases per slot, high water
+    scale_in_occupancy: float = 0.25  # leases per slot, low water
+    scale_sustain_s: float = 0.5  # signal must hold this long to act
+    scale_cooldown_s: float = 2.0  # min gap between scale actions
+    min_live_replicas: int = 1  # scale-in floor
 
 
 def _slo_kwargs(cfg) -> dict:
@@ -1711,7 +1964,12 @@ def _serve_fingerprint(cfg: ServeConfig, n_blocks: int) -> dict:
               # spec_off is bit-identical) — a resumed run may change
               # them freely
               "obs_http", "burn_mitigation", "slo_fast_s", "slo_slow_s",
-              "slo_budget", "burn_multiplier"):
+              "slo_budget", "burn_multiplier",
+              # preemption and the elastic policy shape the SCHEDULE,
+              # never the token stream (resume is bit-identical)
+              "preempt", "elastic_reserve", "scale_out_occupancy",
+              "scale_in_occupancy", "scale_sustain_s",
+              "scale_cooldown_s", "min_live_replicas"):
         fp.pop(k, None)
     fp["n_blocks"] = n_blocks  # resolved, not the 0=auto sentinel
     return fp
@@ -2451,16 +2709,16 @@ def run_serve(mesh, cfg: ServeConfig, writer) -> list:
                 "replica under --replica_dir); run preemption via the "
                 "single-engine trace instead"
             )
-        if cfg.kv_host_tier or cfg.session_dir:
+        if cfg.session_dir:
             raise ValueError(
-                "serve --replicas does not run the host KV tier; run "
-                "--kv_host_tier through the single-engine path"
+                "serve --replicas owns its session dirs (one per "
+                "replica under --replica_dir, banked on drain); run "
+                "--session_dir through the single-engine path"
             )
-        if cfg.burn_mitigation != "off":
+        if cfg.preempt != "off" and not cfg.kv_host_tier:
             raise ValueError(
-                "serve --replicas does not run the burn-mitigation "
-                "ladder (the parent routes, children decode); run "
-                "--burn_mitigation through the single-engine paths"
+                "serve --preempt requires --kv_host_tier (a preempted "
+                "row parks in the host tier)"
             )
         from tpu_patterns.serve.replica import run_replicas
 
@@ -2499,10 +2757,23 @@ def run_serve(mesh, cfg: ServeConfig, writer) -> list:
                 slo_fast_s=cfg.slo_fast_s, slo_slow_s=cfg.slo_slow_s,
                 slo_budget=cfg.slo_budget,
                 burn_multiplier=cfg.burn_multiplier,
+                preempt=cfg.preempt,
             ),
             writer,
         )
 
+    if cfg.elastic_reserve:
+        raise ValueError(
+            "serve --elastic_reserve requires --replicas (the elastic "
+            "fleet scales a replica fleet; there is nothing to scale "
+            "on the single-engine paths)"
+        )
+    if cfg.preempt != "off":
+        raise ValueError(
+            "serve --preempt runs through --scenario (a priority-"
+            "tagged trace) or --replicas; the plain measured patterns "
+            "have no priority classes to preempt"
+        )
     sp = int(mesh.shape["sp"])
     max_len = cfg.max_prompt + cfg.gen
     n_blocks = cfg.n_blocks or _auto_blocks(cfg)

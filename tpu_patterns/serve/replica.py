@@ -15,14 +15,16 @@ Protocol (line JSON, the exec/worker.py idiom — fd 1 is claimed for
 the protocol before the backend can scribble on it):
 
   parent -> child : {"op":"init", replica, devices, sp, tp, cfg,
-                     snapshot_dir, warm, obs_dir}  (first line)
+                     snapshot_dir, session_dir, warm, obs_dir}
+                    (first line)
                     {"op":"req", rid, tokens, n_gen[, deadline_ms,
-                     jid, scenario]}
+                     jid, scenario, priority]}
                     {"op":"fin"} | {"op":"drain"} |
                     {"op":"checkpoint"} | {"op":"shutdown"}
   child -> parent : {"ready": true, pid, replica, platform}
                     {"op":"done", rid, ids} | {"op":"failed", rid,
-                     reason} | {"op":"hb", steps, tokens}
+                     reason} | {"op":"shed", rid, reason} |
+                    {"op":"hb", steps, tokens}
                     {"op":"obs", entries, metrics, backlog, clock}
                     {"op":"checkpointed", step}
                     {"op":"drained"|"quarantined", pending,
@@ -82,6 +84,11 @@ import numpy as np
 from tpu_patterns import faults, rt
 from tpu_patterns.core.timing import clock_ns
 from tpu_patterns.obs.fleet import FleetObs, new_journey_id
+from tpu_patterns.serve.elastic import (
+    ElasticConfig,
+    ElasticPolicy,
+    FleetSignals,
+)
 from tpu_patterns.serve.engine import Request
 from tpu_patterns.serve.router import Router
 
@@ -118,6 +125,7 @@ class _StdinSource:
         self.drain_requested = False
         self._reported_done: set[int] = set()
         self._reported_failed: set[int] = set()
+        self._reported_shed: set[int] = set()
         self._last_hb_ns = 0
         # fleet observability (obs/fleet.py): span/counter deltas ship
         # at iteration boundaries; dump_obs banks ring + metrics into
@@ -156,6 +164,16 @@ class _StdinSource:
                 self._send({
                     "op": "failed", "rid": rid,
                     "reason": eng.failed[rid],
+                })
+        # burn-mitigation sheds are TERMINAL child-side: ship them so
+        # the parent releases the lease and the fleet identity
+        # (done + failed + shed + rerouted == scheduled) still closes
+        for rid in list(eng.shed):
+            if rid not in self._reported_shed:
+                self._reported_shed.add(rid)
+                self._send({
+                    "op": "shed", "rid": rid,
+                    "reason": eng.shed[rid],
                 })
         now = clock_ns()
         if now - self._last_hb_ns >= _HB_NS:
@@ -222,6 +240,7 @@ class _StdinSource:
                     deadline_ms=float(msg.get("deadline_ms", 0.0)),
                     scenario=str(msg.get("scenario", "")),
                     jid=str(msg.get("jid", "")),
+                    priority=str(msg.get("priority", "interactive")),
                 ))
             elif op == "fin":
                 self.fin = True
@@ -298,6 +317,9 @@ def _child_stats(eng) -> dict:
         "peak_blocks": eng.stats["peak_blocks"],
         "done": len(eng.done),
         "failed": len(eng.failed),
+        "sheds": len(eng.shed),
+        "preempted": eng.stats["preempted"],
+        "preempted_resumed": eng.stats["preempted_resumed"],
         "leaked_blocks": eng.leaked_blocks(),
     }
 
@@ -367,13 +389,47 @@ def replica_main() -> int:
         )
         params = decoder.stack_params(flat_params)
 
-        def make_engine():
+        from tpu_patterns.obs.slo import SloConfig
+
+        tiered = bool(cfg.get("kv_host_tier"))
+
+        def make_engine(warming: bool = False):
             return ServeEngine(
                 decoder, params, slots=cfg["slots"],
                 watchdog_s=cfg["watchdog_s"],
                 snapshot_dir=init.get("snapshot_dir") or None,
                 prefix_share=cfg["prefix_share"],
                 spec_k=cfg["spec_k"],
+                # the fleet config bridge (PR 15/16 knobs ride
+                # child_cfg; .get defaults keep older parents speaking
+                # the same protocol): the mitigation ladder, the host
+                # tier, and mid-flight bulk preemption all run
+                # per-replica with the parent-assigned session dir —
+                # a drained replica banks its warm prefixes there
+                kv_host_tier=tiered,
+                host_tier_blocks=cfg.get("host_tier_blocks", 0),
+                session_dir=(
+                    None if warming
+                    else (init.get("session_dir") or None)
+                ),
+                fingerprint=(
+                    {
+                        k: cfg[k] for k in (
+                            "vocab", "embed", "heads", "head_dim",
+                            "mlp_mult", "depth", "dtype", "rope",
+                            "kv_heads", "cache_int8", "block_len",
+                            "seed",
+                        )
+                    } if tiered else None
+                ),
+                preempt=cfg.get("preempt", "off"),
+                burn_mitigation=cfg.get("burn_mitigation", "off"),
+                slo=SloConfig(
+                    fast_window_s=cfg.get("slo_fast_s", 60.0),
+                    slow_window_s=cfg.get("slo_slow_s", 300.0),
+                    budget=cfg.get("slo_budget", 0.1),
+                    multiplier=cfg.get("burn_multiplier", 2.0),
+                ),
                 breaker=rt.Breaker(
                     threshold=2,
                     gauge="tpu_patterns_replica_breaker_open",
@@ -388,7 +444,9 @@ def replica_main() -> int:
         # serving, not XLA's compile queue
         warm = init.get("warm") or []
         if warm:
-            weng = make_engine()
+            # warming=True: the warm-up engine must neither snapshot
+            # nor bank warm traffic into the replica's session dir
+            weng = make_engine(warming=True)
             weng.snapshot_dir = None  # the warm-up must not snapshot
             # warm-up is infrastructure, not serving: a chaos spec must
             # neither fire here nor have its ordinals consumed here
@@ -545,7 +603,17 @@ class FleetResult:
     scheduled: int = 0
     done: dict[int, list[int]] = dataclasses.field(default_factory=dict)
     failed: dict[int, str] = dataclasses.field(default_factory=dict)
+    # burn-mitigation sheds are a TERMINAL bucket fleet-wide (PR 16):
+    # a child's ladder shed ships up, releases the lease, and lands
+    # here — counted, never silently lost
+    shed: dict[int, str] = dataclasses.field(default_factory=dict)
     rerouted: set[int] = dataclasses.field(default_factory=set)
+    # elastic controller actions: (t_s on the fleet clock, "out"|"in",
+    # replica id) — also booked as tpu_patterns_fleet_scale_events_total
+    # and fleet.scale_out/in trace instants
+    scale_events: list[tuple[float, str, str]] = dataclasses.field(
+        default_factory=list
+    )
     requests_by_rid: dict[int, Request] = dataclasses.field(
         default_factory=dict
     )
@@ -573,9 +641,11 @@ class FleetResult:
     obs_stalls: int = 0
 
     def covered(self) -> bool:
-        return set(self.done) | set(self.failed) == set(
-            range(self.scheduled)
-        ) and not (set(self.done) & set(self.failed))
+        buckets = (set(self.done), set(self.failed), set(self.shed))
+        union = set().union(*buckets)
+        return union == set(range(self.scheduled)) and sum(
+            len(b) for b in buckets
+        ) == len(union)
 
     def leaked_blocks(self) -> int:
         """Fleet-wide refcount hygiene over every engine that reported
@@ -594,19 +664,42 @@ class FleetResult:
     def tokens(self) -> int:
         return sum(len(ids) for ids in self.done.values())
 
+    def preempted(self) -> int:
+        """Preemption EVENTS across every engine that reported."""
+        return int(sum(
+            s.get("preempted", 0) for s in self.replica_stats.values()
+        ))
+
+    def preempted_resumed(self) -> int:
+        """Requests preempted mid-flight and later retired (their ids
+        stitched bit-identically) across the fleet."""
+        return int(sum(
+            s.get("preempted_resumed", 0)
+            for s in self.replica_stats.values()
+        ))
+
+    def scale_outs(self) -> int:
+        return sum(1 for _, a, _ in self.scale_events if a == "out")
+
+    def scale_ins(self) -> int:
+        return sum(1 for _, a, _ in self.scale_events if a == "in")
+
     def counts(self) -> dict:
         """The identity the Records gate:
-        done + failed + rerouted == scheduled (done/failed count the
-        DIRECT outcomes; a rerouted rid lands in ``rerouted`` whatever
-        its second act was)."""
+        done + failed + shed + rerouted == scheduled (done/failed/shed
+        count the DIRECT outcomes; a rerouted rid lands in ``rerouted``
+        whatever its second act was)."""
         done_direct = len(set(self.done) - self.rerouted)
         failed_direct = len(set(self.failed) - self.rerouted)
+        shed_direct = len(set(self.shed) - self.rerouted)
         return {
             "done": done_direct,
             "failed": failed_direct,
+            "shed": shed_direct,
             "rerouted": len(self.rerouted),
             "done_total": len(self.done),
             "failed_total": len(self.failed),
+            "shed_total": len(self.shed),
         }
 
 
@@ -632,19 +725,31 @@ class ReplicaManager:
         obs_base: str | None = None,
         warm: list | None = None,
         retry_policy=None,
+        elastic: ElasticConfig | None = None,
     ):
         if n < 1:
             raise ValueError(f"replicas must be >= 1, got {n}")
-        if len(device_slices) < n:
+        # elastic fleet (serve/elastic.py): the ring is built over ALL
+        # n + reserve ids up front with the reserves quarantined —
+        # scale-out is ring.restore (only the reserve's own arc remaps)
+        # and scale-in is the drain-to-snapshot path, sessions banked
+        self.elastic: ElasticPolicy | None = None
+        self._spare: list[int] = []
+        n_total = n
+        if elastic is not None and elastic.reserve > 0:
+            self.elastic = ElasticPolicy(elastic)
+            n_total = n + elastic.reserve
+            self._spare = list(range(n, n_total))
+        if len(device_slices) < n_total:
             raise ValueError(
-                f"{n} replicas need {n} device slices, got "
-                f"{len(device_slices)}"
+                f"{n} replicas + {n_total - n} reserve(s) need "
+                f"{n_total} device slices, got {len(device_slices)}"
             )
         self.n = n
         self.base_env = dict(base_env)
         self.work_dir = work_dir
         self.child_cfg = dict(child_cfg)
-        self.device_slices = [list(s) for s in device_slices[:n]]
+        self.device_slices = [list(s) for s in device_slices[:n_total]]
         self.sp, self.tp = sp, tp
         self.watchdog_s = watchdog_s
         self.warm = warm or []
@@ -652,12 +757,16 @@ class ReplicaManager:
             max_attempts=2, backoff_base_s=0.1
         )
         self.router = Router(
-            [str(r) for r in range(n)],
+            [str(r) for r in range(n_total)],
             block_len=int(child_cfg["block_len"]),
             policy=policy,
             route_blocks=route_blocks,
             vnodes=vnodes,
         )
+        for r in self._spare:
+            # reserved slices are ring members but not routable until
+            # the elastic controller spawns them
+            self.router.quarantine(str(r))
         self.inbox: queue.Queue = queue.Queue()
         self.handles: dict[str, ReplicaHandle] = {}
         self.spawn_retries = 0
@@ -718,6 +827,13 @@ class ReplicaManager:
             "cfg": self.child_cfg,
             "snapshot_dir": os.path.join(
                 self.work_dir, f"replica-{rid}-snap"
+            ),
+            # per-replica session bank (kv_host_tier only): a drained
+            # replica commits its warm prefixes here at run end, and a
+            # later spawn on the same slice id resumes them
+            "session_dir": (
+                os.path.join(self.work_dir, f"replica-{rid}-sessions")
+                if self.child_cfg.get("kv_host_tier") else None
             ),
             "warm": self.warm,
             "obs_dir": (
@@ -797,7 +913,9 @@ class ReplicaManager:
 
         if req is None:
             req = res.requests_by_rid.get(rid)
-        if req is None or rid in res.done or rid in res.failed:
+        if req is None or rid in res.done or rid in res.failed or (
+            rid in res.shed
+        ):
             return
         if rid in res.rerouted:
             # reroute budget spent: a request that failed over twice is
@@ -893,6 +1011,92 @@ class ReplicaManager:
             except (faults.InjectedFault, ReplicaError):
                 self._replica_down(s, "checkpoint request failed", res)
 
+    # -- elastic scaling -------------------------------------------------
+
+    def _elastic_tick(self, now_s: float, res: FleetResult) -> None:
+        """One poll of the scale policy (every fleet-loop iteration):
+        the parent's lease ledger IS the occupancy signal — queued +
+        active work per live replica slot — so no RPC to the children
+        is needed to decide."""
+        if self.elastic is None:
+            return
+        sig = FleetSignals(
+            leases=sum(
+                len(h.leases) for h in self.handles.values()
+            ),
+            pending=0,  # the fleet loop dispatches due arrivals first
+            live=len(self._live()),
+            spare=len(self._spare),
+            slots=int(self.child_cfg["slots"]),
+        )
+        action = self.elastic.decide(now_s, sig)
+        if action == "out":
+            self._scale_out(now_s, res)
+        elif action == "in":
+            self._scale_in(now_s, res)
+
+    def _scale_out(self, now_s: float, res: FleetResult) -> None:
+        """Spawn a replica on the next reserved slice.  The spawn is
+        warm-up-masked (the PR 12 protocol): this call only forks and
+        sends init — the child joins the ring when its ready handshake
+        lands in :meth:`_handle`, executables already compiled."""
+        from tpu_patterns import obs
+
+        r = self._spare[0]
+        rid = str(r)
+        try:
+            # fault site: before the spawn — an ``error`` aborts this
+            # scale-out attempt; the policy re-decides after cooldown
+            faults.inject("fleet.scale_out", replica=rid)
+        except faults.InjectedFault:
+            return
+        try:
+            handle = self._spawn_one(r)
+        except (faults.Quarantined, OSError):
+            return  # spawn retries exhausted; the slice stays reserved
+        self._spare.pop(0)
+        self.handles[rid] = handle
+        res.scale_events.append((round(now_s, 3), "out", rid))
+        obs.counter(
+            "tpu_patterns_fleet_scale_events_total",
+            action="out", replica=rid,
+        ).inc()
+        obs.event("fleet.scale_out", replica=rid)
+
+    def _scale_in(self, now_s: float, res: FleetResult) -> None:
+        """Drain the COLDEST live replica (fewest ledgered leases; ties
+        retire elastic spawns before the core fleet) through the
+        existing drain-to-snapshot path: its in-flight leases reroute
+        on the drained handback and its session bank keeps its warm
+        prefixes on disk."""
+        from tpu_patterns import obs
+
+        live = self._live()
+        if not live:
+            return
+        h = min(live, key=lambda x: (len(x.leases), -int(x.id)))
+        try:
+            # fault site: before the drain — an ``error`` aborts this
+            # scale-in attempt; the fleet stays at its current size
+            faults.inject("fleet.scale_in", replica=h.id)
+        except faults.InjectedFault:
+            return
+        res.scale_events.append((round(now_s, 3), "in", h.id))
+        obs.counter(
+            "tpu_patterns_fleet_scale_events_total",
+            action="in", replica=h.id,
+        ).inc()
+        obs.event("fleet.scale_in", replica=h.id)
+        h.state = "quarantined"  # drains like one; the handback settles
+        self.router.quarantine(h.id)
+        try:
+            faults.inject("replica.drain", replica=h.id)
+            h.send({"op": "drain"})
+        except (faults.InjectedFault, ReplicaError):
+            h.state = "dead"
+            h.kill()
+            self._settle_leases(h, res)
+
     # -- the fleet loop --------------------------------------------------
 
     def serve(
@@ -925,6 +1129,7 @@ class ReplicaManager:
                 while pending and pending[0][0] <= now_s:
                     _, req = pending.popleft()
                     self._dispatch(req, res)
+                self._elastic_tick(now_s, res)
                 if not pending and not outstanding():
                     break
                 wait = 0.25
@@ -938,11 +1143,17 @@ class ReplicaManager:
                     self._check_watchdogs(res)
                     continue
                 self._handle(rid, msg, res)
-                if not self.router.live() and (pending or outstanding()):
-                    # the whole fleet is gone: settle what remains as
-                    # failed so the accounting identity still closes
+                if not self.router.live() and not self._spare and (
+                    pending or outstanding()
+                ):
+                    # the whole fleet is gone (and no reserve could
+                    # replace it): settle what remains as failed so the
+                    # accounting identity still closes
                     for r in res.requests_by_rid:
-                        if r not in res.done and r not in res.failed:
+                        if (
+                            r not in res.done and r not in res.failed
+                            and r not in res.shed
+                        ):
                             res.failed[r] = "no live replica left"
                     pending.clear()
                     break
@@ -1002,10 +1213,27 @@ class ReplicaManager:
             self._replica_down(h, "send failed", res)
 
     def _handle(self, rid: str, msg: dict, res: FleetResult) -> None:
+        from tpu_patterns import obs
+
         h = self.handles.get(rid)
         if h is None:
             return
         h.last_msg_ns = clock_ns()
+        if msg.get("ready") is True:
+            if h.state == "spawning":
+                # a late (elastic) spawn came up mid-run: NOW it joins
+                # the ring — only its own reserved arc remaps to it,
+                # every survivor's prefix affinity is untouched
+                h.state = "ready"
+                self.router.restore(h.id)
+                obs.event("fleet.scale_ready", replica=h.id)
+            return
+        if msg.get("ready") is False:
+            # a late spawn failed init: it never joined the ring and
+            # holds no leases — settle the corpse, the fleet stays put
+            h.state = "dead"
+            h.kill()
+            return
         op = msg.get("op")
         if op == "obs":
             # shipped span/counter deltas: persist next to the child's
@@ -1020,6 +1248,18 @@ class ReplicaManager:
                 res.done[r] = [int(t) for t in msg["ids"]]
                 res.t_done_ns[r] = clock_ns()
             h.breaker.success()
+        elif op == "shed":
+            # the child's burn ladder shed this admission: terminal,
+            # lease released, counted in its own bucket — a shed is
+            # mitigation working, not replica sickness, so the breaker
+            # is not touched either way
+            r = int(msg["rid"])
+            h.leases.release(r)
+            if (
+                r not in res.done and r not in res.failed
+                and r not in res.shed
+            ):
+                res.shed[r] = str(msg.get("reason", "shed"))
         elif op == "failed":
             r = int(msg["rid"])
             h.leases.release(r)
@@ -1153,7 +1393,10 @@ class ReplicaManager:
         failures — finalize them so the accounting identity closes."""
         for h in self.handles.values():
             for rid, reason in h.tentative_failed.items():
-                if rid not in res.done and rid not in res.failed:
+                if (
+                    rid not in res.done and rid not in res.failed
+                    and rid not in res.shed
+                ):
                     res.failed[rid] = reason
             h.tentative_failed = {}
 
@@ -1197,24 +1440,33 @@ def _req_msg(req: Request) -> dict:
         "op": "req", "rid": req.rid, "tokens": list(req.tokens),
         "n_gen": req.n_gen, "deadline_ms": req.deadline_ms,
         "scenario": req.scenario, "jid": req.jid,
+        "priority": req.priority,
     }
 
 
 # -- measured patterns -----------------------------------------------------
 
 
-def _goodput(res: FleetResult) -> float:
+def _goodput(res: FleetResult, priority: str | None = None) -> float:
     """Router-side goodput-under-SLO: the fraction of generated tokens
     from requests whose scheduled-arrival -> last-token wall time met
     their deadline (0-deadline requests always meet it).  Measured at
     the FRONT DOOR, so replica queueing, rerouting, and fail-over
-    stalls all count — the latency the user felt."""
-    total = sum(r.n_gen for r in res.requests_by_rid.values())
+    stalls all count — the latency the user felt.  ``priority``
+    restricts the sample to one class (the elastic Record gates the
+    INTERACTIVE class: bulk is exactly what mitigation may sacrifice)."""
+    reqs = {
+        rid: r for rid, r in res.requests_by_rid.items()
+        if priority is None or r.priority == priority
+    }
+    total = sum(r.n_gen for r in reqs.values())
     if not total:
         return 0.0
     good = 0
     for rid, ids in res.done.items():
-        req = res.requests_by_rid[rid]
+        req = reqs.get(rid)
+        if req is None:
+            continue
         if req.deadline_ms <= 0:
             good += len(ids)
             continue
@@ -1267,18 +1519,30 @@ def run_replicas(mesh, cfg, writer) -> list:
             f"unknown replica_policy {cfg.replica_policy!r} "
             f"(want one of {Router.POLICIES})"
         )
+    reserve = int(cfg.elastic_reserve)
+    if reserve and not cfg.scenario:
+        raise ValueError(
+            "serve --elastic_reserve needs --scenario: the elastic "
+            "Record is the diurnal-ramp A/B, and priority classes ride "
+            "the scenario schedule"
+        )
     flat = [d for d in np.asarray(mesh.devices).flat]
     tp = int(mesh.shape["tp"])
-    per = len(flat) // n
+    # the elastic fleet pre-partitions n + reserve DISJOINT slices up
+    # front: every replica (reserves included) gets the same slice
+    # size, so the A/B below compares fleets of equal per-replica shape
+    n_total = n + reserve
+    per = len(flat) // n_total
     if per < 1 or per % tp:
         raise ValueError(
-            f"{len(flat)} devices / {n} replicas = {per} per replica, "
-            f"which must be a positive multiple of tp={tp}"
+            f"{len(flat)} devices / {n_total} replica slice(s) "
+            f"({n} replicas + {reserve} reserve(s)) = {per} per "
+            f"replica, which must be a positive multiple of tp={tp}"
         )
     child_sp = per // tp
     topo_obj = topology.discover(flat)
     slices = placement.partition_devices(
-        n, topo_obj, devices_per_group=per
+        n_total, topo_obj, devices_per_group=per
     )
 
     mcfg = ModelConfig(
@@ -1333,6 +1597,16 @@ def run_replicas(mesh, cfg, writer) -> list:
         "n_blocks": n_blocks, "max_len": max_len, "seed": cfg.seed,
         "prefix_share": prefix_share, "spec_k": cfg.spec_k,
         "watchdog_s": cfg.watchdog_s,
+        # the fleet config bridge: the PR 15 mitigation ladder and the
+        # PR 16 tier/preemption knobs run PER-REPLICA — each child owns
+        # its burn windows and its own host tier
+        "burn_mitigation": cfg.burn_mitigation,
+        "slo_fast_s": cfg.slo_fast_s, "slo_slow_s": cfg.slo_slow_s,
+        "slo_budget": cfg.slo_budget,
+        "burn_multiplier": cfg.burn_multiplier,
+        "kv_host_tier": cfg.kv_host_tier,
+        "host_tier_blocks": cfg.host_tier_blocks,
+        "preempt": cfg.preempt,
     }
     # warm every executable bucket the trace will touch BEFORE timing:
     # a slice of the real trace, generation capped so warm-up is cheap
@@ -1347,7 +1621,8 @@ def run_replicas(mesh, cfg, writer) -> list:
     route_blocks = cfg.route_blocks or 2
 
     def fleet(
-        n_replicas: int, policy: str, tag: str, primary: bool = False
+        n_replicas: int, policy: str, tag: str, primary: bool = False,
+        elastic: ElasticConfig | None = None,
     ) -> FleetResult:
         # the PRIMARY leg's per-replica obs dirs live under the run's
         # obs dir (`<obs_dir>/replica-<id>/`), where `obs fleet` /
@@ -1369,6 +1644,7 @@ def run_replicas(mesh, cfg, writer) -> list:
                 else os.path.join(work_root, tag, "obs")
             ),
             warm=warm,
+            elastic=elastic,
         )
         writer.progress(
             f"fleet[{tag}]: spawning {n_replicas} replica(s) x "
@@ -1410,6 +1686,119 @@ def run_replicas(mesh, cfg, writer) -> list:
             r.rid for r in reqs if res.done[r.rid] != want[r.rid]
         ]
         return (0.0 if bad else 1.0), bad
+
+    if spec is not None and reserve:
+        # -- elastic Record (diurnal-ramp A/B: elastic vs static) ----
+        # Both fleets start UNDERSIZED at n replicas of the same slice
+        # size; only the elastic leg may grow into the reserve slices.
+        # The gate: the elastic fleet fires at least one scale-out and
+        # holds INTERACTIVE goodput at or above the static fleet's —
+        # with every completion (preempted-and-resumed included)
+        # bit-identical to its dense decode and zero blocks leaked.
+        ecfg = ElasticConfig(
+            reserve=reserve,
+            out_occupancy=cfg.scale_out_occupancy,
+            in_occupancy=cfg.scale_in_occupancy,
+            sustain_s=cfg.scale_sustain_s,
+            cooldown_s=cfg.scale_cooldown_s,
+            min_live=cfg.min_live_replicas,
+        )
+        res_e = fleet(
+            n, cfg.replica_policy, "elastic", primary=True,
+            elastic=ecfg,
+        )
+        res_s = fleet(n, cfg.replica_policy, "static")
+        # one dense decode of the schedule serves both legs: the
+        # oracle depends on the requests, not on fleet sizing
+        want_all = _dense_expected(
+            mesh, sp_parent, mcfg, oracle_cfg, flat_params,
+            [r for _, r in timed],
+        )
+        exact_e, bad_e = exactness(res_e, want_all)
+        exact_s, bad_s = exactness(res_s, want_all)
+        good_e = _goodput(res_e, priority="interactive")
+        good_s = _goodput(res_s, priority="interactive")
+        outs, ins = res_e.scale_outs(), res_e.scale_ins()
+        ok = (
+            res_e.covered() and res_s.covered()
+            and exact_e == 1.0 and exact_s == 1.0
+            and res_e.leaked_blocks() == 0
+            and res_s.leaked_blocks() == 0
+            and outs >= 1
+            and good_e >= good_s
+        )
+        counts_e, counts_s = res_e.counts(), res_s.counts()
+        rec = Record(
+            pattern="serve",
+            mode=f"elastic_{spec.name}_r{n}p{reserve}_sp{child_sp}",
+            commands=(
+                f"{cfg.scenario} | {n}+{reserve} replicas x "
+                f"sp{child_sp}tp{tp} preempt={cfg.preempt} "
+                f"mitigation={cfg.burn_mitigation}"
+            ),
+            metrics={
+                "requests": float(len(timed)),
+                "goodput_interactive_elastic": round(good_e, 4),
+                "goodput_interactive_static": round(good_s, 4),
+                "goodput_elastic": round(_goodput(res_e), 4),
+                "goodput_static": round(_goodput(res_s), 4),
+                "scale_outs": float(outs),
+                "scale_ins": float(ins),
+                "preempted": float(res_e.preempted()),
+                "preempted_resumed": float(res_e.preempted_resumed()),
+                "shed_elastic": float(counts_e["shed_total"]),
+                "shed_static": float(counts_s["shed_total"]),
+                "done_elastic": float(counts_e["done_total"]),
+                "done_static": float(counts_s["done_total"]),
+                "failed": float(
+                    counts_e["failed_total"] + counts_s["failed_total"]
+                ),
+                "rerouted_elastic": float(counts_e["rerouted"]),
+                "drains_elastic": float(res_e.drains),
+                "exact": float(exact_e == 1.0 and exact_s == 1.0),
+                "covered": float(res_e.covered() and res_s.covered()),
+                "leaked_blocks": float(
+                    res_e.leaked_blocks() + res_s.leaked_blocks()
+                ),
+            },
+            verdict=Verdict.SUCCESS if ok else Verdict.FAILURE,
+        )
+        if outs < 1:
+            rec.notes.append(
+                "the elastic fleet never scaled out — the ramp never "
+                "sustained occupancy above the high water "
+                f"({cfg.scale_out_occupancy:g} leases/slot for "
+                f"{cfg.scale_sustain_s:g}s); the A/B is vacuous"
+            )
+        if good_e < good_s:
+            rec.notes.append(
+                f"interactive goodput {good_e:.3f} elastic < "
+                f"{good_s:.3f} static — growing the fleet did not pay"
+            )
+        for tag, bad in (("elastic", bad_e), ("static", bad_s)):
+            if bad:
+                rec.notes.append(
+                    f"exactness FAILED on the {tag} leg for request(s) "
+                    f"{bad[:8]}: ids diverged from dense decode "
+                    "(preempted-and-resumed completions gate here too)"
+                )
+        for tag, r in (("elastic", res_e), ("static", res_s)):
+            if not r.covered():
+                missing = sorted(
+                    set(r.requests_by_rid) - set(r.done)
+                    - set(r.failed) - set(r.shed)
+                )
+                rec.notes.append(
+                    f"coverage identity broken on the {tag} leg: "
+                    f"request(s) {missing[:8]} unaccounted — done + "
+                    "failed + shed + rerouted must equal scheduled"
+                )
+        for t_s, action, rid in res_e.scale_events[:12]:
+            rec.notes.append(
+                f"scale event @ {t_s:.2f}s: {action} replica {rid}"
+            )
+        writer.record(rec)
+        return [rec]
 
     if spec is not None:
         # -- routing-comparison Record (chat preset, both policies) --

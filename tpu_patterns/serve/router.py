@@ -149,6 +149,13 @@ class Router:
         """Take ``replica`` out of rotation (breaker open / dead)."""
         self.ring.remove(replica)
 
+    def restore(self, replica: str) -> None:
+        """Put ``replica`` back in rotation (elastic scale-out of a
+        reserved slice): its vnodes were fixed at construction, so only
+        its OWN arc remaps back — every other replica's prefix affinity
+        is untouched (the PR 12 membership property, in reverse)."""
+        self.ring.restore(replica)
+
     def live(self) -> set[str]:
         return self.ring.live()
 
