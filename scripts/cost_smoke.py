@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""CI gate for the resource-attribution + decision-audit plane
+(docs/observability.md "Cost attribution & decision audit").
+
+Runs a preempting chat scenario (bulk + interactive classes, tiered KV,
+``--preempt bulk``) through the REAL CLI on the simulated 8-device CPU
+mesh with ``--obs-dump``, then gates the dumped artifacts:
+
+  (a) attribution identity, recomputed from the raw integers in
+      ``cost.jsonl`` (not the dump's own verdict booleans): the sum of
+      per-request attributed decode/prefill ns plus the unattributed
+      residue equals the measured wall EXACTLY — integer equality, no
+      tolerance;
+  (b) block-second conservation, same discipline: busy + free block·ns
+      == pool_blocks x elapsed_ns exactly;
+  (c) ledger-vs-counter identity per action: for every action present
+      in ``metrics.jsonl``, ``tpu_patterns_decision_events_total``
+      equals the pre-existing counter it shadows (deferrals, evictions,
+      sheds, preemptions...) — a gap means a scheduler decision
+      happened that the ledger never explained.  The run must actually
+      preempt (>= 1) so the gate is not vacuous;
+  (d) ``obs explain <rid>`` through the CLI resolves a preempted
+      request's story end to end: the decision.preempt instant with
+      its rationale AND the request's retirement in one table.
+
+Zero dependencies beyond the package; exit 0 = pass.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# seed 16 schedules bulk requests first (they admit and occupy both
+# slots) with interactive arrivals close behind — the deterministic
+# preemption shape (test_serve._mixed_reqs, through the loadgen path)
+CHAT = (
+    "chat:requests=12:min_prompt=4:mean_prompt=8:max_prompt=16"
+    ":min_gen=2:mean_gen=6:max_gen=10:bulk_fraction=0.5"
+)
+LOADGEN_ARGS = [
+    "--vocab", "64", "--embed", "64", "--head_dim", "8", "--depth", "1",
+    "--slots", "2", "--block_len", "8", "--time_scale", "0.02",
+    "--slo_ttft_ms", "60000", "--slo_tpot_ms", "20000",
+    "--kv_host_tier", "true", "--preempt", "bulk", "--seed", "16",
+    "--scenarios", CHAT,
+]
+
+# action -> the counter it must stay in identity with
+# (tpu_patterns/obs/decisions.py COUNTER_IDENTITIES, spelled out here
+# so a drift in either place trips this gate)
+PAIRS = {
+    "defer": "tpu_patterns_serve_deferrals_total",
+    "evict": "tpu_patterns_serve_kv_evictions_total",
+    "shed": "tpu_patterns_serve_shed_total",
+    "preempt": "tpu_patterns_serve_preempted_total",
+}
+
+
+def _run(tag: str, cmd: list[str], env: dict, capture: bool = False):
+    print(f"+ [{tag}]", " ".join(cmd), flush=True)
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        cmd, env=env, cwd=ROOT,
+        capture_output=capture, text=capture,
+    )
+    print(f"  [{tag}] rc={proc.returncode} "
+          f"wall={time.monotonic() - t0:.1f}s", flush=True)
+    return proc
+
+
+def fail(msg: str) -> int:
+    print(f"cost smoke: {msg}", file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.pop("TPU_PATTERNS_FAULTS", None)
+    work = tempfile.mkdtemp(prefix="cost_smoke_")
+    jsonl = os.path.join(work, "loadgen.jsonl")
+    obs_dir = os.path.join(work, "obs")
+    py = [sys.executable, "-m", "tpu_patterns"]
+
+    proc = _run(
+        "preempting-chat",
+        [*py, "--jsonl", jsonl, "--obs-dir", obs_dir, "--obs-dump",
+         "loadgen", "--dp", "1", "--tp", "2", *LOADGEN_ARGS],
+        env,
+    )
+    if proc.returncode != 0:
+        return fail(f"loadgen CLI exited {proc.returncode}")
+
+    # (a)+(b) — recompute both identities from the raw dump
+    cost_path = os.path.join(obs_dir, "cost.jsonl")
+    if not os.path.exists(cost_path):
+        return fail("--obs-dump produced no cost.jsonl")
+    metas, reqs = [], []
+    with open(cost_path) as f:
+        for ln in f:
+            d = json.loads(ln)
+            (metas if d["kind"] == "cost_meta" else reqs).append(d)
+    if len(metas) != 1:
+        return fail(f"want exactly one cost_meta line, got {len(metas)}")
+    m = metas[0]
+    att_dec = sum(r["decode_ns"] for r in reqs)
+    att_pre = sum(r["prefill_ns"] for r in reqs)
+    if att_dec + m["unattributed_decode_ns"] != m["decode_wall_ns"]:
+        return fail(
+            f"decode attribution identity OPEN: {att_dec} attributed + "
+            f"{m['unattributed_decode_ns']} unattributed != "
+            f"{m['decode_wall_ns']} measured"
+        )
+    if att_pre + m["unattributed_prefill_ns"] != m["prefill_wall_ns"]:
+        return fail(
+            f"prefill attribution identity OPEN: {att_pre} + "
+            f"{m['unattributed_prefill_ns']} != {m['prefill_wall_ns']}"
+        )
+    if m["busy_block_ns"] + m["free_block_ns"] != (
+        m["pool_blocks"] * m["elapsed_ns"]
+    ):
+        return fail(
+            f"block-second conservation OPEN: busy {m['busy_block_ns']} "
+            f"+ free {m['free_block_ns']} != pool {m['pool_blocks']} x "
+            f"elapsed {m['elapsed_ns']}"
+        )
+    if m["decode_wall_ns"] <= 0 or not reqs:
+        return fail("the identities closed on an EMPTY book — no walls "
+                    "were measured, the gate is vacuous")
+    classes = {r["priority"] for r in reqs}
+    if classes != {"interactive", "bulk"}:
+        return fail(f"want both priority classes attributed, got "
+                    f"{sorted(classes)}")
+    print(
+        f"cost smoke: identities closed exactly (decode "
+        f"{m['decode_wall_ns'] / 1e6:.1f}ms over {len(reqs)} requests, "
+        f"pool {m['pool_blocks']} x {m['elapsed_ns'] / 1e9:.2f}s)",
+        flush=True,
+    )
+
+    # (c) — ledger-vs-counter identity per action present in the dump
+    totals: dict[str, float] = {}
+    decisions: dict[str, float] = {}
+    with open(os.path.join(obs_dir, "metrics.jsonl")) as f:
+        for ln in f:
+            d = json.loads(ln)
+            if d.get("type") != "counter":
+                continue
+            if d["metric"] == "tpu_patterns_decision_events_total":
+                decisions[d["labels"]["action"]] = (
+                    decisions.get(d["labels"]["action"], 0) + d["value"]
+                )
+            else:
+                totals[d["metric"]] = (
+                    totals.get(d["metric"], 0) + d["value"]
+                )
+    if decisions.get("preempt", 0) < 1:
+        return fail("the run never preempted — the ledger gate is "
+                    "vacuous (schedule drift?)")
+    for action, counter in PAIRS.items():
+        booked = decisions.get(action, 0)
+        counted = totals.get(counter, 0)
+        if booked != counted:
+            return fail(
+                f"ledger identity OPEN for {action!r}: "
+                f"{booked} decisions booked != {counted} on {counter} — "
+                "a decision fired without an explanation"
+            )
+    print(
+        "cost smoke: ledger matches counters per action "
+        f"({ {a: int(v) for a, v in sorted(decisions.items())} })",
+        flush=True,
+    )
+
+    # (d) — explain a preempted request's story through the CLI
+    victim = None
+    with open(os.path.join(obs_dir, "spans.jsonl")) as f:
+        for ln in f:
+            d = json.loads(ln)
+            if d.get("name") == "decision.preempt":
+                victim = d["attrs"]["rid"]
+                break
+    if victim is None:
+        return fail("decisions counted but no decision.preempt event "
+                    "in spans.jsonl — the ledger lost its transport")
+    proc = _run(
+        "explain",
+        [*py, "--obs-dir", obs_dir, "obs", "explain", str(victim)],
+        env, capture=True,
+    )
+    if proc.returncode != 0:
+        return fail(f"obs explain exited {proc.returncode}: "
+                    f"{proc.stderr}")
+    out = proc.stdout
+    for token in ("decision.preempt", "bulk victim parked",
+                  "req.retired"):
+        if token not in out:
+            return fail(
+                f"obs explain {victim} lacks {token!r} — the preempted "
+                "request's story does not reconstruct end to end:\n"
+                + out
+            )
+    print(
+        f"cost smoke: PASS (obs explain {victim} tells the "
+        "preempt-then-retire story, all identities exact)",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,308 @@
+"""Resource attribution + decision ledger (obs/cost.py, obs/decisions.py):
+the exact-identity contracts (attributed + unattributed == measured wall,
+busy + free block-seconds == pool x elapsed), per-request residency across
+preempt/resume, the fail-open ``obs.cost_book`` fault site, the
+ledger-vs-counter identity per action, and ``obs explain`` reconstructing
+a preempted request's story end to end."""
+
+import dataclasses
+import json
+
+import pytest
+
+from tpu_patterns import faults, obs
+from tpu_patterns.obs.cost import CostBook, cost_table, load_dir, rollup
+from tpu_patterns.obs.decisions import (
+    ACTIONS,
+    COUNTER_IDENTITIES,
+    DecisionLedger,
+    decision_entries,
+    explain_table,
+)
+from tpu_patterns.serve import ServeEngine
+
+from test_serve import _mixed_reqs, _preempt_engine
+
+
+@pytest.fixture(autouse=True)
+def _isolated(tmp_path):
+    faults.configure("")
+    obs.flight_recorder().clear()
+    obs.metrics_registry().clear()
+    obs.configure(str(tmp_path))
+    yield
+    faults.configure(None)
+    obs.flight_recorder().clear()
+    obs.metrics_registry().clear()
+    obs.configure(None)
+
+
+ROWS = [(0, "chat", "interactive"), (1, "chat", "bulk"), (2, "chat", "bulk")]
+
+
+class TestCostBook:
+    def test_equal_share_attribution_is_exact_with_remainder(self):
+        # 1_000_001 ns over 3 rows does not divide: the first rem rows
+        # take the extra ns and the sum closes EXACTLY, by construction
+        book = CostBook(pool_blocks=4)
+        book.start(0)
+        book.book_decode(1_000_001, ROWS)
+        got = [book.requests[r].decode_ns for r, _, _ in ROWS]
+        assert sum(got) == 1_000_001
+        assert max(got) - min(got) <= 1
+        snap = book.snapshot()
+        assert snap["decode_identity_ok"]
+        assert snap["attributed_decode_ns"] == 1_000_001
+        assert snap["unattributed_decode_ns"] == 0
+
+    def test_empty_wave_books_unattributed_identity_still_closes(self):
+        book = CostBook(pool_blocks=4)
+        book.start(0)
+        book.book_decode(500, [])
+        book.book_prefill(700, [])
+        snap = book.snapshot()
+        assert snap["unattributed_decode_ns"] == 500
+        assert snap["unattributed_prefill_ns"] == 700
+        assert snap["decode_identity_ok"] and snap["prefill_identity_ok"]
+
+    def test_pool_conservation_holds_across_every_tick(self):
+        book = CostBook(pool_blocks=7)
+        book.start(0)
+        for alloc in (3, 7, 2, 0, 5):
+            book.tick(alloc)
+            snap = book.snapshot()
+            assert snap["conservation_ok"]
+            assert (
+                snap["busy_block_ns"] + snap["free_block_ns"]
+                == 7 * snap["elapsed_ns"]
+            )
+        book.close(0)
+        assert book.snapshot()["conservation_ok"]
+
+    def test_residency_settles_on_drop_and_preempt_rehold(self):
+        book = CostBook(pool_blocks=8)
+        book.start(0)
+        book.hold(5, 3, scenario="chat", priority="bulk")
+        book.drop(5)  # preempt-park: first leg settles
+        first_leg = book.requests[5].block_ns
+        assert first_leg >= 0
+        first_exported = obs.counter(
+            "tpu_patterns_cost_block_ns_total", priority="bulk"
+        ).value
+        assert first_exported == first_leg
+        book.hold(5, 3, scenario="chat", priority="bulk")  # resume
+        book.drop(5)  # retire
+        assert book.requests[5].block_ns >= first_leg
+        # the metric got the DELTA on the second drop, not the first
+        # leg twice: counter total == per-request total exactly
+        assert obs.counter(
+            "tpu_patterns_cost_block_ns_total", priority="bulk"
+        ).value == book.requests[5].block_ns
+        assert not book._holding
+
+    def test_drop_without_hold_is_a_noop(self):
+        book = CostBook(pool_blocks=4)
+        book.start(0)
+        book.drop(99)  # hold skipped by a fault or never admitted
+        assert 99 not in book.requests
+
+    def test_snapshot_rollups_group_by_class_and_scenario(self):
+        book = CostBook(pool_blocks=4)
+        book.start(0)
+        book.book_decode(900, ROWS)
+        snap = book.snapshot()
+        by_cls = snap["by_priority"]
+        assert by_cls["interactive"]["requests"] == 1
+        assert by_cls["bulk"]["requests"] == 2
+        assert (
+            by_cls["interactive"]["decode_ns"]
+            + by_cls["bulk"]["decode_ns"] == 900
+        )
+        assert snap["by_scenario"]["chat"]["requests"] == 3
+        assert rollup(snap["requests"], "scenario")["chat"][
+            "decode_ns"
+        ] == 900
+
+    def test_jsonl_roundtrip_and_table_render(self, tmp_path):
+        book = CostBook(pool_blocks=4, replica="2")
+        book.start(0)
+        book.book_decode(1_000_000, ROWS)
+        book.book_prefill(600_000, ROWS[:1])
+        (tmp_path / "cost.jsonl").write_text(book.to_jsonl())
+        metas, reqs = load_dir(str(tmp_path))
+        assert len(metas) == 1 and len(reqs) == 3
+        assert metas[0]["decode_identity_ok"]
+        assert all(r["replica"] == "2" for r in reqs)
+        text = cost_table(metas, reqs)
+        assert "identities OK" in text
+        assert "interactive" in text and "bulk" in text
+
+    def test_table_without_dumps_says_so(self):
+        assert "no cost.jsonl" in cost_table([], [])
+
+    def test_booking_fault_fails_open_identities_intact(self):
+        # an injected obs.cost_book error skips the WHOLE booking —
+        # total and shares move together, so the identity never opens
+        book = CostBook(pool_blocks=4)
+        book.start(0)
+        faults.configure("obs.cost_book:error:count=1")
+        book.book_decode(1_000, ROWS)  # skipped (fault fires once)
+        book.book_decode(2_000, ROWS)  # lands
+        snap = book.snapshot()
+        assert snap["decode_wall_ns"] == 2_000
+        assert snap["attributed_decode_ns"] == 2_000
+        assert snap["decode_identity_ok"]
+
+    def test_hold_fault_fails_open_drop_stays_safe(self):
+        book = CostBook(pool_blocks=4)
+        book.start(0)
+        faults.configure("obs.cost_book:error:count=1")
+        book.hold(0, 2, scenario="chat", priority="bulk")  # skipped
+        book.drop(0)  # must not raise on the missing holding
+        assert not book._holding
+
+
+class TestDecisionLedger:
+    def test_book_counts_and_exports_the_identity_counter(self):
+        led = DecisionLedger(replica="1")
+        led.book("defer", rid=3, rationale="pool pressure", free=0)
+        led.book("evict", count=4, victims="5,6,7,8")
+        assert led.count() == 5
+        assert led.count("defer") == 1
+        assert led.count("evict") == 4
+        assert obs.counter(
+            "tpu_patterns_decision_events_total", action="defer"
+        ).value == 1
+        assert obs.counter(
+            "tpu_patterns_decision_events_total", action="evict"
+        ).value == 4
+        assert led.events[0]["inputs"] == {"free": 0}
+        assert led.events[0]["replica"] == "1"
+
+    def test_unknown_action_rejected(self):
+        with pytest.raises(ValueError, match="unknown decision action"):
+            DecisionLedger().book("panic")
+
+    def test_every_action_has_a_counter_identity(self):
+        assert set(COUNTER_IDENTITIES) == set(ACTIONS)
+
+    def test_booking_fault_drops_record_and_counter_together(self):
+        led = DecisionLedger()
+        faults.configure("obs.cost_book:error:count=1")
+        led.book("shed", rid=1)  # skipped whole
+        led.book("shed", rid=2)  # lands
+        assert led.count("shed") == 1
+        assert obs.counter(
+            "tpu_patterns_decision_events_total", action="shed"
+        ).value == 1
+
+    def test_events_land_in_the_flight_recorder(self, tmp_path):
+        led = DecisionLedger()
+        led.book(
+            "preempt", rid=7, jid="j-7",
+            rationale="bulk victim parked", banked=4,
+        )
+        path = obs.dump(str(tmp_path / "spans.jsonl"))
+        entries = [
+            json.loads(ln) for ln in open(path) if ln.strip()
+        ]
+        ev = [e for e in entries if e.get("name") == "decision.preempt"]
+        assert len(ev) == 1
+        assert ev[0]["attrs"]["rid"] == "7"
+        assert ev[0]["attrs"]["jid"] == "j-7"
+        assert ev[0]["attrs"]["banked"] == "4"
+
+
+class TestExplain:
+    def _entries(self):
+        led = DecisionLedger()
+        led.book("defer", rid=1, rationale="pool pressure", free=0)
+        led.book("preempt", rid=2, rationale="bulk victim", banked=3)
+        obs.event("serve.preempted", rid="2", priority="bulk")
+        obs.event("journey.admit", rid="1")
+        return [dict(e) for e in obs.flight_recorder().snapshot()]
+
+    def test_filter_by_rid_includes_story_events(self):
+        got = decision_entries(self._entries(), key="2")
+        names = [e["name"] for e in got]
+        assert "decision.preempt" in names
+        assert "serve.preempted" in names
+        assert "decision.defer" not in names  # rid 1's story, not 2's
+
+    def test_filter_by_action_is_fleet_wide(self):
+        got = decision_entries(self._entries(), action="defer")
+        assert [e["name"] for e in got] == ["decision.defer"]
+
+    def test_table_renders_rationale_and_inputs(self):
+        text = explain_table(self._entries(), key="2")
+        assert "story for 2" in text
+        assert "bulk victim" in text
+        assert "banked=3" in text
+
+    def test_no_match_says_so(self):
+        assert "no decisions" in explain_table([], key=None)
+
+
+class TestEngineAttribution:
+    """The integration contract on a real preempting run: every identity
+    closes, the ledger matches the engine's own stats, and the explain
+    story reconstructs the preempted request end to end."""
+
+    def test_preempting_run_closes_every_identity(self, devices):
+        eng, dec, params = _preempt_engine(devices)
+        reqs = _mixed_reqs()
+        out = eng.run([dataclasses.replace(r) for r in reqs])
+        assert out and not eng.failed
+        assert eng.stats["preempted"] >= 1
+
+        snap = eng.cost.snapshot()
+        assert snap["decode_identity_ok"]
+        assert snap["prefill_identity_ok"]
+        assert snap["conservation_ok"]
+        assert not eng.cost._holding  # every residency settled
+        # every served request got device time attributed, tagged with
+        # its class
+        assert {r["rid"] for r in snap["requests"]} >= {
+            r.rid for r in reqs
+        }
+        classes = {
+            r["rid"]: r["priority"] for r in snap["requests"]
+        }
+        for r in reqs:
+            assert classes[r.rid] == r.priority
+        # decode attribution really is the measured wall, split
+        assert snap["attributed_decode_ns"] > 0
+
+        # ledger-vs-stats identity: the preempt decisions booked are
+        # exactly the preemptions the engine counted
+        assert eng.decisions.count("preempt") == eng.stats["preempted"]
+        ev = [
+            e for e in eng.decisions.events if e["action"] == "preempt"
+        ]
+        assert all(e["rationale"] for e in ev)
+        assert all("free" in e["inputs"] for e in ev)
+
+    def test_explain_reconstructs_a_preempted_request(
+        self, devices, tmp_path
+    ):
+        eng, dec, params = _preempt_engine(devices)
+        out = eng.run(
+            [dataclasses.replace(r) for r in _mixed_reqs()]
+        )
+        assert out
+        victims = [
+            e for e in eng.decisions.events if e["action"] == "preempt"
+        ]
+        assert victims
+        rid = victims[0]["rid"]
+        path = obs.dump(str(tmp_path / "spans.jsonl"))
+        entries = [
+            json.loads(ln) for ln in open(path)
+            if ln.strip() and json.loads(ln).get("kind") != "meta"
+        ]
+        text = explain_table(entries, key=str(rid))
+        assert f"story for {rid}" in text
+        assert "decision.preempt" in text
+        assert "serve.preempted" in text
+        # the request retired after the preemption: the story ends well
+        assert "req.retired" in text

@@ -785,6 +785,64 @@ class TestServeSites:
         got = eng2.run([])
         assert got == want  # bit-identical, including pre-preempt rows
 
+    def test_step_outer_span_covers_the_injected_sleep(
+        self, devices, tmp_path
+    ):
+        """The PR 9 perfwatch blind spot, closed: ``serve.step`` opens
+        AFTER the fault-injection site inside the step, so an injected
+        sleep (or retry backoff) was invisible to span summaries.
+        ``serve.step_outer`` wraps inject + retries — under a 50ms
+        injected sleep the outer total must exceed the inner by it."""
+        from tpu_patterns.serve import ServeEngine
+
+        _, _, dec, params, _ = self._engine_bits(devices)
+        obs.flight_recorder().clear()
+        faults.configure("serve.step:sleep:delay_s=0.05:count=1")
+        eng = ServeEngine(dec, params, slots=2,
+                          retry_policy=_fast_policy())
+        out = eng.run([dataclasses.replace(r) for r in _trace(2, n_gen=3)])
+        assert out and not eng.failed  # sleep delays, never fails
+        path = obs.dump(str(tmp_path / "spans.jsonl"))
+        inner = outer = 0
+        for ln in open(path):
+            e = json.loads(ln)
+            if e.get("name") == "serve.step":
+                inner += e["dur_ns"]
+            elif e.get("name") == "serve.step_outer":
+                outer += e["dur_ns"]
+        assert inner > 0 and outer > 0
+        # both series export; the injected 50ms lands ONLY in the outer
+        assert outer >= inner + 40_000_000
+
+    def test_cost_book_site_fires_and_fails_open(self, devices):
+        """``obs.cost_book`` faults skip the booking whole and never
+        touch the serve path: the run completes bit-identical and the
+        book's internal identities stay closed (totals and shares are
+        skipped together)."""
+        from tpu_patterns.serve import ServeEngine
+
+        _, _, dec, params, _ = self._engine_bits(devices)
+        reqs = _trace(3, n_gen=3)
+        want = ServeEngine(dec, params, slots=2).run(
+            [dataclasses.replace(r) for r in reqs]
+        )
+        before = _counter_value(
+            "tpu_patterns_faults_injected_total",
+            site="obs.cost_book", action="error",
+        )
+        faults.configure("obs.cost_book:error:count=3")
+        eng = ServeEngine(dec, params, slots=2)
+        got = eng.run([dataclasses.replace(r) for r in reqs])
+        assert got == want and not eng.failed  # serving untouched
+        assert _counter_value(
+            "tpu_patterns_faults_injected_total",
+            site="obs.cost_book", action="error",
+        ) == before + 3
+        snap = eng.cost.snapshot()
+        assert snap["decode_identity_ok"]
+        assert snap["prefill_identity_ok"]
+        assert snap["conservation_ok"]
+
     def test_resume_rejects_mismatched_fingerprint(self, devices, tmp_path):
         from tpu_patterns.serve import ServeEngine
 

@@ -20,7 +20,14 @@ from tpu_patterns.obs.live import ObsHttp
 from tpu_patterns.obs.slo import SloConfig, SloMonitor
 from tpu_patterns.serve import Request, ServeEngine
 
-from test_serve import CFG, _decoder_and_params, _mesh, _trace
+from test_serve import (
+    CFG,
+    _decoder_and_params,
+    _mesh,
+    _mixed_reqs,
+    _preempt_engine,
+    _trace,
+)
 from tpu_patterns.models.transformer import ModelConfig
 
 MCFG = ModelConfig(**CFG)
@@ -352,10 +359,29 @@ class TestObsHttp:
         recent = s["engine"]["recent"]
         assert recent and all(r["status"] == "done" for r in recent)
 
+    def test_costz_serves_the_book_with_identities(self):
+        code, c = _get_json(self.port, "/costz")
+        assert code == 200
+        snap = c["engine"]
+        assert snap["decode_identity_ok"]
+        assert snap["prefill_identity_ok"]
+        assert snap["conservation_ok"]
+        # every retired request has an attribution row with its class
+        assert len(snap["requests"]) == 4
+        assert all(
+            r["priority"] == "interactive" for r in snap["requests"]
+        )
+        assert sum(
+            r["decode_ns"] for r in snap["requests"]
+        ) == snap["attributed_decode_ns"]
+        # ledger coverage rides along (no decisions on a clean run)
+        assert snap["decisions"] == {}
+
     def test_unknown_path_is_404(self):
         code, body = _get(self.port, "/nope")
         assert code == 404
         assert "/metrics" in body
+        assert "/costz" in body  # the endpoint list names it
 
     def test_scrape_fault_answers_503_counted_never_crashes(self):
         before = rt.metric_total(
@@ -382,6 +408,11 @@ class TestObsHttp:
         lines = out.getvalue().splitlines()
         assert len(lines) == 2
         assert "burn=" in lines[0] and "act=" in lines[0]
+        # per-class tail columns (PR 17): the run's requests were all
+        # interactive, so the int_ columns appear and bulk_ stay off
+        assert "int_ttft_p99=" in lines[0]
+        assert "int_tpot_p99=" in lines[0]
+        assert "bulk_ttft_p99=" not in lines[0]
 
     def test_watch_no_plane_is_an_error(self):
         out = io.StringIO()
@@ -424,6 +455,59 @@ class TestObsHttpMidRun:
             rows[0]
         )
 
+    def test_statusz_flags_parked_rows_with_banked_tokens(
+        self, devices
+    ):
+        """A preempting run scraped mid-flight: the parked (preempted)
+        bulk row shows in ``parked`` with its banked-token count, the
+        in-flight rows carry their priority class, and once the victim
+        resumes its row is flagged ``resumed``."""
+        eng, dec, params = _preempt_engine(devices)
+        reqs = _mixed_reqs()
+        plane = ObsHttp(0)
+        port = plane.start()
+        obs_live.attach_engine(eng)
+        captured = {}
+
+        def source(idle=False):
+            parked = [
+                r.rid for r, _ in eng.queue
+                if r.rid in eng.preempted_partial
+            ]
+            if parked and "status" not in captured:
+                captured["status"] = _get_json(port, "/statusz")[1]
+            if (
+                "status" in captured and "resumed" not in captured
+                and any(
+                    s.rid in eng.preempted_rids for s in eng.active
+                )
+            ):
+                captured["resumed"] = _get_json(port, "/statusz")[1]
+            done = len(eng.done) + len(eng.failed) >= len(reqs)
+            return None if done else []
+
+        try:
+            eng.run(
+                [dataclasses.replace(r) for r in reqs], source=source
+            )
+        finally:
+            plane.stop()
+            obs_live.detach_engine(eng)
+        assert eng.stats["preempted"] >= 1
+        assert "status" in captured, "no scrape saw a parked row"
+        s = captured["status"]["engine"]
+        parked = s["parked"]
+        assert parked and all(p["banked"] >= 1 for p in parked)
+        assert all(p["remaining"] > 0 for p in parked)
+        # the rows that preempted the victim carry their class
+        rows = s["requests"]
+        assert rows and all("priority" in r for r in rows)
+        assert any(r["priority"] == "interactive" for r in rows)
+        if "resumed" in captured:
+            rows = captured["resumed"]["engine"]["requests"]
+            back = [r for r in rows if r.get("resumed")]
+            assert back and all(r["banked"] >= 1 for r in back)
+
     def test_unhealthy_engine_answers_503(self, devices):
         mesh = _mesh(devices, (1, 2, 2))
         dec, params, _ = _decoder_and_params(mesh, MCFG)
@@ -450,6 +534,8 @@ class TestObsHttpMidRun:
             assert h["engine"] is None
             code, s = _get_json(port, "/statusz")
             assert code == 200 and s["engine"] is None
+            code, c = _get_json(port, "/costz")
+            assert code == 200 and c["engine"] is None
         finally:
             plane.stop()
 

@@ -992,6 +992,63 @@ class TestFleetMerge:
             registry=reg, priority="interactive",
         ) == 1.0
 
+    def test_cost_and_decision_series_export(self):
+        # the PR 17 attribution plane's series are first-class prom
+        # exports: per-class cost counters booked by a real CostBook,
+        # the ledger's per-action identity counter, and the shed-rung
+        # series — through the default registry, as the engine does it
+        from tpu_patterns.obs.cost import CostBook
+        from tpu_patterns.obs.decisions import DecisionLedger
+
+        book = CostBook(pool_blocks=4)
+        book.start(0)
+        book.book_decode(
+            1_000_001,
+            [(0, "chat", "interactive"), (1, "chat", "bulk")],
+        )
+        book.book_prefill(500_000, [(1, "chat", "bulk")])
+        book.hold(0, 2, scenario="chat", priority="interactive")
+        book.drop(0)
+        led = DecisionLedger()
+        led.book("defer", rid=0, rationale="pool pressure", free=0)
+        led.book("preempt", rid=1, jid="j-1", banked=4)
+        obs.counter(
+            "tpu_patterns_decision_shed_rung_total", rung="bulk"
+        ).inc()
+        text = obs.metrics_registry().to_prom_text()
+        assert "# TYPE tpu_patterns_cost_decode_ns_total counter" in text
+        samples = obs.parse_prom_text(text)
+        # the odd nanosecond lands on the first row: the exported
+        # per-class split closes the measured wall exactly
+        assert samples[(
+            "tpu_patterns_cost_decode_ns_total",
+            (("priority", "interactive"),),
+        )] == 500_001
+        assert samples[(
+            "tpu_patterns_cost_decode_ns_total",
+            (("priority", "bulk"),),
+        )] == 500_000
+        assert samples[(
+            "tpu_patterns_cost_prefill_ns_total",
+            (("priority", "bulk"),),
+        )] == 500_000
+        assert (
+            "tpu_patterns_cost_block_ns_total",
+            (("priority", "interactive"),),
+        ) in samples
+        assert samples[(
+            "tpu_patterns_decision_events_total",
+            (("action", "defer"),),
+        )] == 1
+        assert samples[(
+            "tpu_patterns_decision_events_total",
+            (("action", "preempt"),),
+        )] == 1
+        assert samples[(
+            "tpu_patterns_decision_shed_rung_total",
+            (("rung", "bulk"),),
+        )] == 1
+
 
 class TestObsShipper:
     def test_tap_feeds_deltas_and_metrics_ship_once(self):
@@ -1133,3 +1190,76 @@ class TestObsCLI:
         assert "cli.span" in out
         for token in ("MXU", "ICI", "HBM"):
             assert token in out
+
+    def test_cost_merges_dumps_and_writes_rollup(self, tmp_path, capsys):
+        from tpu_patterns.cli import main
+        from tpu_patterns.obs.cost import CostBook
+
+        book = CostBook(pool_blocks=4)
+        book.start(0)
+        book.book_decode(1_000_000, [(0, "chat", "interactive")])
+        (tmp_path / "cost.jsonl").write_text(book.to_jsonl())
+        rep = tmp_path / "replica-0"
+        rep.mkdir()
+        child = CostBook(pool_blocks=4, replica="0")
+        child.start(0)
+        child.book_decode(2_000_000, [(1, "chat", "bulk")])
+        (rep / "cost.jsonl").write_text(child.to_jsonl())
+        rc = main(["--obs-dir", str(tmp_path), "obs", "cost"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "identities OK" in out
+        assert "replica 0" in out  # the child dump merged in
+        roll = [
+            json.loads(ln)
+            for ln in (tmp_path / "cost_rollup.jsonl").read_text()
+            .splitlines()
+        ]
+        by_cls = {r["key"]: r for r in roll if r["by"] == "priority"}
+        assert by_cls["bulk"]["decode_ns"] == 2_000_000
+        assert by_cls["interactive"]["decode_ns"] == 1_000_000
+
+    def test_cost_empty_dir_is_an_error(self, tmp_path):
+        from tpu_patterns.cli import main
+
+        with pytest.raises(SystemExit, match="no cost.jsonl"):
+            main(["--obs-dir", str(tmp_path), "obs", "cost"])
+
+    def test_explain_by_rid_and_by_action(self, tmp_path, capsys):
+        from tpu_patterns.cli import main
+
+        _fake_fleet_dir(str(tmp_path))
+        # a decision instant on the parent's timeline, same request
+        with open(tmp_path / "spans.jsonl", "a") as f:
+            f.write(json.dumps(_event(
+                "decision.preempt", 200, 77, rid="0",
+                rationale="bulk victim parked", banked="3",
+            )) + "\n")
+        rc = main(["--obs-dir", str(tmp_path), "obs", "explain", "0"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "story for 0" in out
+        assert "decision.preempt" in out
+        assert "bulk victim parked" in out
+        assert "req.retired" in out  # lifecycle context rides along
+        rc = main([
+            "--obs-dir", str(tmp_path), "obs", "explain",
+            "--action", "preempt",
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "decision.preempt fleet-wide" in out
+
+    def test_explain_without_target_or_action_is_an_error(
+        self, tmp_path
+    ):
+        from tpu_patterns.cli import main
+
+        _fake_fleet_dir(str(tmp_path))
+        with pytest.raises(SystemExit, match="obs explain"):
+            main(["--obs-dir", str(tmp_path), "obs", "explain"])
+        with pytest.raises(SystemExit, match="unknown --action"):
+            main([
+                "--obs-dir", str(tmp_path), "obs", "explain",
+                "--action", "panic",
+            ])

@@ -18,6 +18,7 @@ import sys
 import pytest
 
 from tpu_patterns import faults, rt
+from tpu_patterns.obs.decisions import DecisionLedger
 from tpu_patterns.obs.fleet import FleetObs
 from tpu_patterns.serve.engine import Request
 from tpu_patterns.serve.replica import (
@@ -197,12 +198,27 @@ class _FakeProc:
         self._lines: queue.Queue = queue.Queue()
         self.stdout = iter(self._lines.get, None)
         self.dead = False
+        _FAKE_PROCS.append(self)
 
     def poll(self):
         return 1 if self.dead else None
 
     def wait(self, timeout=None):
         return 0
+
+
+# every _FakeProc parks a real ReplicaHandle reader thread on its line
+# iterator; without a release the full suite accumulates one blocked
+# thread per handle ever created.  The autouse fixture below feeds each
+# iterator its None sentinel at test teardown so the reader exits.
+_FAKE_PROCS: list = []
+
+
+@pytest.fixture(autouse=True)
+def _release_fake_readers():
+    yield
+    while _FAKE_PROCS:
+        _FAKE_PROCS.pop()._lines.put(None)
 
 
 @pytest.fixture
@@ -240,6 +256,7 @@ def _manager(n=2, policy="prefix", obs_base=None):
     mgr.obs_stalls = 0
     mgr.elastic = None
     mgr._spare = []
+    mgr.decisions = DecisionLedger()
     for r in range(n):
         h = ReplicaHandle(str(r), _FakeProc(), mgr.inbox)
         h.state = "ready"

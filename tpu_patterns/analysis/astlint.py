@@ -429,6 +429,9 @@ class MetricNaming(Rule):
         # priority classes: shed/preempt series are keyed by request
         # class (serve/engine.py — PR 16, interactive > bulk)
         "priority",
+        # decision ledger: shed events are keyed by the ladder rung
+        # that fired (serve/engine.py — PR 17, head vs bulk-first)
+        "rung",
     })
     PREFIX = "tpu_patterns_"
 

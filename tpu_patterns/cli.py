@@ -1105,6 +1105,62 @@ def _cmd_obs(args, writer: ResultWriter) -> None:
             raise SystemExit(f"no fleet dumps under {obs_dir}")
         print(obs_fleet.journey_table(merged, args.target))
         return
+
+    if args.action == "cost":
+        # merged cost attribution: cost.jsonl from the router dir +
+        # every replica-*/ under it, rolled up with identity verdicts
+        import json as _json
+
+        from tpu_patterns.obs import cost as obs_cost
+
+        cost_dir = args.target or obs_dir
+        metas, reqs = obs_cost.load_dir(cost_dir)
+        if not metas:
+            raise SystemExit(
+                f"no cost.jsonl under {cost_dir} — run a serve/loadgen "
+                "pattern with --obs-dump first"
+            )
+        print(obs_cost.cost_table(metas, reqs))
+        out = os.path.join(cost_dir, "cost_rollup.jsonl")
+        with open(out, "w") as f:
+            for key in ("priority", "scenario", "replica"):
+                for k, g in sorted(
+                    obs_cost.rollup(reqs, key).items()
+                ):
+                    f.write(_json.dumps(
+                        {"kind": "cost_rollup", "by": key, "key": k, **g}
+                    ) + "\n")
+        writer.progress(f"merged cost rollup -> {out}")
+        return
+
+    if args.action == "explain":
+        # the decision-audit query: one request's (or one action's)
+        # decisions on the merged fleet timeline, with rationale and
+        # the signal inputs read at decision time
+        from tpu_patterns.obs import decisions as obs_decisions
+        from tpu_patterns.obs import fleet as obs_fleet
+
+        if not args.target and not args.filter_action:
+            raise SystemExit(
+                "obs explain: pass a request/journey id, or filter "
+                "fleet-wide with --action "
+                f"({'|'.join(obs_decisions.ACTIONS)})"
+            )
+        if (
+            args.filter_action
+            and args.filter_action not in obs_decisions.ACTIONS
+        ):
+            raise SystemExit(
+                f"obs explain: unknown --action {args.filter_action!r} "
+                f"(want one of {sorted(obs_decisions.ACTIONS)})"
+            )
+        merged, _ = obs_fleet.merge_fleet(obs_dir)
+        if not merged:
+            raise SystemExit(f"no fleet dumps under {obs_dir}")
+        print(obs_decisions.explain_table(
+            merged, key=args.target, action=args.filter_action
+        ))
+        return
     if args.input:
         span_files = [args.input]
     else:
@@ -1655,22 +1711,35 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ob.add_argument(
         "action",
-        choices=("summarize", "export", "fleet", "journey", "watch"),
+        choices=("summarize", "export", "fleet", "journey", "watch",
+                 "cost", "explain"),
         help="summarize = per-span table (+device join with "
         "--profile-dir); export = --chrome-trace / --prom; fleet <dir> "
         "= merged summarize + per-process Chrome trace over the "
         "parent's dumps and every replica-*/ dir; journey <jid|rid> = "
         "one request's full cross-process story as a table; watch "
         "<url> = poll a live --obs_http plane (/healthz + /metrics) "
-        "into a one-line-per-interval view",
+        "into a one-line-per-interval view; cost <dir> = merged "
+        "per-request/class/scenario/replica attribution table with "
+        "identity verdicts (+ cost_rollup.jsonl); explain <jid|rid> = "
+        "the decision ledger's story for one request (or --action "
+        "KIND fleet-wide)",
     )
     ob.add_argument(
         "target",
         nargs="?",
         default=None,
-        help="fleet: the obs dir to merge (default --obs-dir); "
-        "journey: the journey id (j...) or request id to stitch; "
-        "watch: the plane URL (http://127.0.0.1:PORT)",
+        help="fleet/cost: the obs dir to merge (default --obs-dir); "
+        "journey/explain: the journey id (j...) or request id to "
+        "stitch; watch: the plane URL (http://127.0.0.1:PORT)",
+    )
+    ob.add_argument(
+        "--action",
+        dest="filter_action",
+        default=None,
+        metavar="KIND",
+        help="explain: filter to one decision kind fleet-wide "
+        "(defer|evict|shed|preempt|scale_out|scale_in|breaker|reroute)",
     )
     ob.add_argument(
         "--interval",
@@ -1869,6 +1938,10 @@ def main(argv: list[str] | None = None) -> int:
     if args.obs_dump and args.cmd != "obs":
         writer.progress(f"obs spans -> {obs.dump(reason='end_of_run')}")
         writer.progress(f"obs metrics -> {obs.dump_metrics()}")
+        from tpu_patterns.obs import cost as _cost
+
+        if _cost.books():  # serve/loadgen paths register engine books
+            writer.progress(f"obs cost -> {obs.dump_cost()}")
     return writer.exit_code
 
 

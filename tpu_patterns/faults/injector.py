@@ -75,6 +75,10 @@ KNOWN_SITES = frozenset({
     "loadgen.arrive", "router.route", "replica.spawn", "replica.drain",
     "replica.obs_ship", "obs.scrape",
     "fleet.scale_out", "fleet.scale_in",
+    # cost/decision booking (obs/cost.py, obs/decisions.py): fails
+    # OPEN at every call site — a booking error skips the record,
+    # never the scheduler action being recorded
+    "obs.cost_book",
 })
 
 # ctx keys the call sites actually pass — the only keys a match

@@ -314,24 +314,51 @@ def _stats(
     e2e = StreamingPercentiles()
     good_tokens = 0
     done = failed = 0
+    # per-priority-class sketches keyed off the lifecycle's priority
+    # field — same mergeable-sketch shape as the flat series, so the
+    # interactive/bulk split is a strict refinement, never a second
+    # measurement path
+    by_class: dict[str, dict] = {}
+
+    def _cls(priority: str) -> dict:
+        return by_class.setdefault(priority or "interactive", {
+            "ttft": StreamingPercentiles(),
+            "tpot": StreamingPercentiles(),
+            "good_tokens": 0,
+        })
+
     for lc in eng.lifecycle.values():
         # FAILED requests stay in the latency sample (e2e = time until
         # the engine gave up, retries and backoff included): excluding
         # them would let a fault that quarantines the slowest rows
         # SHRINK the chaos p99 and pass the bounded-degradation gate on
         # a survivor-biased sample
+        cls = _cls(lc.get("priority", ""))
         if lc["ttft_ms"] is not None:
             ttft.observe(lc["ttft_ms"])
+            cls["ttft"].observe(lc["ttft_ms"])
         if lc["tpot_ms"] is not None:
             tpot.observe(lc["tpot_ms"])
+            cls["tpot"].observe(lc["tpot_ms"])
         e2e.observe(lc["e2e_ms"])
         if lc["status"] == "done":
             done += 1
             if lc["met"]:
                 good_tokens += lc["n_out"]
+                cls["good_tokens"] += lc["n_out"]
         else:
             failed += 1
     total_tokens = sum(tr.request.n_gen for tr in schedule)
+    # per-class goodput denominator comes from the SCHEDULE (every token
+    # the class was asked for), not the lifecycle — shed/dropped work
+    # counts against the class it belonged to
+    class_tokens: dict[str, int] = {}
+    for tr in schedule:
+        key = tr.request.priority or "interactive"
+        class_tokens[key] = class_tokens.get(key, 0) + tr.request.n_gen
+    for key, cls in by_class.items():
+        tot = class_tokens.get(key, 0)
+        cls["goodput"] = cls["good_tokens"] / tot if tot else 0.0
     scheduled = {tr.request.rid for tr in schedule}
     accounted = (
         set(eng.lifecycle) | set(source.dropped)
@@ -356,6 +383,8 @@ def _stats(
         ),
         "unaccounted": sorted(scheduled - accounted),
         "deferrals": eng.stats["deferrals"],
+        "by_class": by_class,
+        "cost": eng.cost.snapshot(),
     }
 
 
@@ -364,6 +393,32 @@ def _pcts(sk: StreamingPercentiles) -> tuple[float, float, float]:
     if not sk.count:
         return (-1.0, -1.0, -1.0)
     return (sk.quantile(0.5), sk.quantile(0.95), sk.quantile(0.99))
+
+
+def _class_cost_metrics(st: dict) -> dict:
+    """Record refinements that ride every loadgen leg: per-priority-
+    class latency/goodput (interactive vs bulk under the same SLO) and
+    the engine's cost-attribution totals with the identity verdict the
+    cost smoke gates (1.0 == attributed + unattributed equals the
+    measured wall exactly AND busy + free block-seconds equal
+    pool x elapsed exactly)."""
+    out: dict = {}
+    for cname, cls in sorted(st["by_class"].items()):
+        for key in ("ttft", "tpot"):
+            p50, p95, p99 = _pcts(cls[key])
+            out[f"{cname}_{key}_p50_ms"] = round(p50, 3)
+            out[f"{cname}_{key}_p95_ms"] = round(p95, 3)
+            out[f"{cname}_{key}_p99_ms"] = round(p99, 3)
+        out[f"{cname}_goodput"] = round(cls["goodput"], 4)
+    c = st["cost"]
+    out["cost_decode_ms"] = round(c["decode_wall_ns"] / 1e6, 3)
+    out["cost_prefill_ms"] = round(c["prefill_wall_ns"] / 1e6, 3)
+    out["cost_busy_block_s"] = round(c["busy_block_ns"] / 1e9, 3)
+    out["cost_identity_ok"] = float(
+        c["decode_identity_ok"] and c["prefill_identity_ok"]
+        and c["conservation_ok"]
+    )
+    return out
 
 
 def _publish_gauges(spec: ScenarioSpec, st: dict) -> None:
@@ -531,6 +586,7 @@ def run_loadgen(mesh, cfg: LoadGenConfig, writer) -> list:
             },
             verdict=Verdict.SUCCESS if ok else Verdict.FAILURE,
         )
+        rec.metrics.update(_class_cost_metrics(st))
         if st["unaccounted"]:
             rec.notes.append(
                 f"request(s) {st['unaccounted'][:8]} neither completed "
@@ -556,7 +612,12 @@ def run_loadgen(mesh, cfg: LoadGenConfig, writer) -> list:
         writer.record(rec)
         records.append(rec)
 
-        if cfg.kv_host_tier:
+        if cfg.kv_host_tier and spec.working_set_mult > 0:
+            # the tier-vs-defer A/B race needs a scenario that DECLARES
+            # memory pressure: on an unsqueezed pool the defer-only leg
+            # never defers and the contrast is vacuous (its own gate
+            # says so) — tiering without ws_mult still serves the main
+            # leg above (preemption, sessions), it just isn't raced
             records.append(_kv_tier_loadgen_record(
                 decoder, params, cfg, spec, schedule, sp, writer,
             ))

@@ -17,6 +17,14 @@ training/inference stack has, dependency-free:
                ``obs watch`` poller: the stack answered live, mid-run
   slo.py       rolling dual-window SLO burn-rate monitor feeding the
                serve engine's shed/spec_off mitigation ladder
+  cost.py      resource attribution: measured decode/prefill walls
+               apportioned per request (exact, integer ns), pool
+               block-second integrals with a conservation identity,
+               rollups for ``obs cost`` / ``/costz`` / cost.jsonl
+  decisions.py the scheduler decision ledger: one structured event per
+               defer/evict/shed/preempt/scale/breaker/reroute carrying
+               the signals that drove it, counter-identity-gated,
+               queryable as ``obs explain``
 
 Usage (the whole API most call sites need)::
 
@@ -39,6 +47,16 @@ from __future__ import annotations
 import os
 
 from tpu_patterns.obs import recorder as _recorder
+from tpu_patterns.obs.cost import (  # noqa: F401
+    CostBook,
+    cost_table,
+    load_dir as load_cost_dir,
+)
+from tpu_patterns.obs.decisions import (  # noqa: F401
+    DecisionLedger,
+    decision_entries,
+    explain_table,
+)
 from tpu_patterns.obs.metrics import (  # noqa: F401
     counter,
     default as metrics_registry,
@@ -98,6 +116,15 @@ def dump_metrics(path: str | None = None) -> str:
     with open(path, "w") as f:
         f.write(_metrics.default().to_jsonl())
     return path
+
+
+def dump_cost(path: str | None = None) -> str:
+    """Write every registered cost book (obs/cost.py) as JSONL next to
+    the metrics dump; returns the path."""
+    from tpu_patterns.obs import cost as _cost
+
+    path = path or os.path.join(_recorder.run_dir(), "cost.jsonl")
+    return _cost.dump_all(path)
 
 
 _CRASH_INSTALLED = False

@@ -20,9 +20,16 @@ to the serving plane:
              parser to decide
   /statusz   the per-request in-flight table: one row per rid from the
              engine's rt.LeaseTable with lifecycle timestamps (age,
-             TTFT, tokens out of budget); on a replica-fleet parent,
-             one LANE per replica aggregated from the parent's lease
-             ledgers + the shipped obs stream
+             TTFT, tokens out of budget), resumed legs flagged with
+             their banked token counts and parked (preempted, queued)
+             rows listed; on a replica-fleet parent, one LANE per
+             replica aggregated from the parent's lease ledgers + the
+             shipped obs stream
+  /costz     the attribution plane live (obs/cost.py): measured
+             decode/prefill walls apportioned per request with the
+             identity verdicts, the pool block-second integral, and
+             the priority/scenario rollups — "who is paying for this
+             device" answered mid-run
 
 The server is a daemon thread on stdlib ``http.server`` (the container
 bakes nothing in) bound to 127.0.0.1, opt-in via ``serve --obs_http
@@ -42,7 +49,7 @@ import time
 
 from tpu_patterns.core.timing import clock_ns
 
-ENDPOINTS = ("/metrics", "/healthz", "/statusz")
+ENDPOINTS = ("/metrics", "/healthz", "/statusz", "/costz")
 
 # -- the current scrape target --------------------------------------------
 #
@@ -218,12 +225,19 @@ def _engine_status(eng) -> dict:
     now = clock_ns()
     rows = []
     for rid, slot in sorted(eng.inflight.snapshot().items()):
+        # a resumed leg (preempted earlier, re-admitted) carries its
+        # banked partial output: the table counts those tokens so
+        # "generated" plus "banked" reads as the client-visible stream
+        banked = len(eng.preempted_partial.get(rid, ()))
         rows.append({
             "rid": rid,
             "scenario": slot.scenario or None,
+            "priority": slot.priority or None,
             "jid": slot.jid or None,
             "prompt_tokens": slot.lens,
             "generated": len(slot.out),
+            "banked": banked or None,
+            "resumed": rid in eng.preempted_rids or None,
             "n_gen": slot.n_gen,
             "age_ms": round((now - slot.t_submit_ns) / 1e6, 3),
             "ttft_ms": (
@@ -237,13 +251,26 @@ def _engine_status(eng) -> dict:
             k: lc[k]
             for k in ("status", "scenario", "n_out", "ttft_ms", "e2e_ms",
                       "met")
-        }}
+        }, "priority": lc.get("priority")}
         for rid, lc in list(eng.lifecycle.items())[-8:]
+    ]
+    # parked rows: preempted mid-flight, banked partial output, waiting
+    # in the queue as forced sessions — flagged here so the in-flight
+    # table never silently loses a request the scheduler parked
+    parked = [
+        {
+            "rid": r.rid,
+            "banked": len(eng.preempted_partial.get(r.rid, ())),
+            "remaining": r.n_gen,
+        }
+        for r, _ in eng.queue
+        if r.rid in eng.preempted_partial
     ]
     return {
         "replica": eng.replica or None,
         "requests": rows,
         "queued": [r.rid for r, _ in eng.queue],
+        "parked": parked,
         "done": len(eng.done),
         "failed": len(eng.failed),
         "shed": len(eng.shed),
@@ -291,6 +318,29 @@ def status_snapshot() -> dict:
     if fleet is not None:
         out["fleet"] = _fleet_status(fleet)
     return out
+
+
+def cost_snapshot(max_requests: int = 32) -> dict:
+    """The /costz body: the attached engine's cost book (obs/cost.py)
+    with the per-request list capped for scrape size — the full list
+    lands in ``cost.jsonl`` at dump time.  A replica-fleet parent
+    answers for its OWN engine only; the children's books dump next to
+    their metrics and merge offline via ``obs cost``."""
+    eng = current_engine()
+    if eng is None:
+        return {"engine": None}
+    snap = eng.cost.snapshot()
+    n = len(snap["requests"])
+    if n > max_requests:
+        snap["requests"] = snap["requests"][:max_requests]
+        snap["requests_elided"] = n - max_requests
+    # decision-ledger coverage rides along: per-action booked counts,
+    # so a /costz scrape can spot a ledger-vs-counter identity gap live
+    snap["decisions"] = {
+        a: eng.decisions.count(a)
+        for a in sorted({e["action"] for e in eng.decisions.events})
+    }
+    return {"engine": snap}
 
 
 # -- the server ------------------------------------------------------------
@@ -389,6 +439,11 @@ def _make_handler():
                     body = json.dumps(
                         status_snapshot(), sort_keys=True
                     ).encode()
+                elif path == "/costz":
+                    code = 200
+                    body = json.dumps(
+                        cost_snapshot(), sort_keys=True
+                    ).encode()
                 else:
                     code = 404
                     body = json.dumps({
@@ -465,6 +520,20 @@ def _watch_line(n: int, health: dict, samples: dict) -> str:
         f"shed={_fmt(_sample(samples, 'tpu_patterns_serve_shed_total'), nd=0)}",
         f"defer={_fmt(_sample(samples, 'tpu_patterns_serve_deferrals_total'), nd=0)}",
     ]
+    # per-class tails (PR 17): the priority-labeled live gauges appear
+    # once a classed request finalizes — columns show up only when the
+    # trace actually carries that class, keeping class-free lines short
+    for cls, tag in (("interactive", "int"), ("bulk", "bulk")):
+        v = _sample(
+            samples, "tpu_patterns_slo_live_ttft_p99_ms", priority=cls
+        )
+        if v is not None:
+            parts.append(f"{tag}_ttft_p99={_fmt(v, 'ms')}")
+        v = _sample(
+            samples, "tpu_patterns_slo_live_tpot_p99_ms", priority=cls
+        )
+        if v is not None:
+            parts.append(f"{tag}_tpot_p99={_fmt(v, 'ms')}")
     if "fleet" in health:
         lanes = health["fleet"]["replicas"]
         live = sum(

@@ -120,6 +120,11 @@ class SloMonitor:
         self.bad_total = 0.0
         self.ttft = StreamingPercentiles()
         self.tpot = StreamingPercentiles()
+        # per-priority-class live tails + goodput split (PR 16 classes,
+        # PR 17 breakdown): {priority: {"ttft": sketch, "tpot": sketch,
+        # "good": float, "bad": float}} — keyed lazily so a class-free
+        # trace stays one flat pair of sketches
+        self.by_class: dict[str, dict] = {}
 
     # -- ring ------------------------------------------------------------
 
@@ -155,23 +160,43 @@ class SloMonitor:
         met: bool,
         ttft_ms: float | None = None,
         tpot_ms: float | None = None,
+        priority: str = "",
     ) -> None:
         """Book one finalized request: its generated tokens against the
-        deadline verdict, its latencies into the live sketches."""
+        deadline verdict, its latencies into the live sketches (the
+        flat ones and, when the request carries a ``priority`` class,
+        that class's keyed pair too)."""
+        from tpu_patterns.loadgen.percentiles import StreamingPercentiles
+
         fired = recovered = False
         with self._lock:
             self._advance(clock_ns())
             slot = self._head % N_BUCKETS
+            cls = None
+            if priority:
+                cls = self.by_class.setdefault(priority, {
+                    "ttft": StreamingPercentiles(),
+                    "tpot": StreamingPercentiles(),
+                    "good": 0.0, "bad": 0.0,
+                })
             if met:
                 self._good[slot] += tokens
                 self.good_total += tokens
+                if cls is not None:
+                    cls["good"] += tokens
             else:
                 self._bad[slot] += tokens
                 self.bad_total += tokens
+                if cls is not None:
+                    cls["bad"] += tokens
             if ttft_ms is not None:
                 self.ttft.observe(ttft_ms)
+                if cls is not None:
+                    cls["ttft"].observe(ttft_ms)
             if tpot_ms is not None:
                 self.tpot.observe(tpot_ms)
+                if cls is not None:
+                    cls["tpot"].observe(tpot_ms)
             gf, bf = self._window(self._fast_k)
             gs, bs = self._window(N_BUCKETS)
             burn_fast, burn_slow = self._burn(gf, bf), self._burn(gs, bs)
@@ -229,6 +254,21 @@ class SloMonitor:
                 obs.gauge(
                     f"tpu_patterns_slo_live_{key}_{label}_ms"
                 ).set(sk.quantile(q))
+        # per-class tails ride the SAME series names with a priority
+        # label — the unlabeled gauges above keep their exact keys
+        # (test_live pins them), the labeled ones add the breakdown
+        for cls, d in self.by_class.items():
+            for key in ("ttft", "tpot"):
+                sk = d[key]
+                if not sk.count:
+                    continue
+                for q, label in (
+                    (0.5, "p50"), (0.95, "p95"), (0.99, "p99")
+                ):
+                    obs.gauge(
+                        f"tpu_patterns_slo_live_{key}_{label}_ms",
+                        priority=cls,
+                    ).set(sk.quantile(q))
 
     def _fire(
         self, burn_fast: float, burn_slow: float, good: float, bad: float
@@ -309,4 +349,13 @@ class SloMonitor:
                 "multiplier": self.cfg.multiplier,
                 "ttft_p99_ms": self.ttft.quantile(0.99),
                 "tpot_p99_ms": self.tpot.quantile(0.99),
+                "by_class": {
+                    cls: {
+                        "good_tokens": d["good"],
+                        "bad_tokens": d["bad"],
+                        "ttft_p99_ms": d["ttft"].quantile(0.99),
+                        "tpot_p99_ms": d["tpot"].quantile(0.99),
+                    }
+                    for cls, d in self.by_class.items()
+                },
             }

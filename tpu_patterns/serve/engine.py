@@ -45,6 +45,8 @@ import numpy as np
 
 from tpu_patterns import ckpt, faults, rt
 from tpu_patterns.core.timing import clock_ns
+from tpu_patterns.obs.cost import CostBook, register as _register_cost
+from tpu_patterns.obs.decisions import DecisionLedger
 from tpu_patterns.obs.slo import SloConfig, SloMonitor
 from tpu_patterns.serve.kvtier import HostTier
 from tpu_patterns.serve.paged import TRASH_BLOCK, make_paged_lm_decoder
@@ -248,6 +250,18 @@ class ServeEngine:
         #              output by construction, less work per step)
         self.slo = SloMonitor(slo, replica=replica)
         self.burn_mitigation = burn_mitigation
+        # the attribution plane (obs/cost.py, obs/decisions.py): the
+        # cost book apportions measured decode/prefill walls across the
+        # rows that rode each wave and integrates pool block-seconds;
+        # the decision ledger explains every defer/evict/shed/preempt/
+        # breaker with the signals read at decision time.  Registered
+        # so obs.dump_cost() lands this engine's book next to
+        # metrics.jsonl.  Both fail OPEN (obs.cost_book fault site):
+        # booking can never block the scheduler.
+        self.cost = _register_cost(
+            CostBook(self.layout.n_blocks - 1, replica=replica)
+        )
+        self.decisions = DecisionLedger(replica=replica)
         # admissions the burn monitor shed: {rid: reason} — a terminal
         # bucket like ``failed``, so accounting identities close
         self.shed: dict[int, str] = {}
@@ -502,6 +516,22 @@ class ServeEngine:
         obs.counter("tpu_patterns_serve_kv_evictions_total").inc(
             len(entries)
         )
+        # decision ledger: one event per WAVE, count = blocks evicted
+        # (counter identity with the per-block series above); the
+        # victim set and the pressure signals at decision time ride
+        # along.  len(self.free) already includes this wave's blocks,
+        # so free_before subtracts them back out.
+        self.decisions.book(
+            "evict",
+            rid=rid if rid >= 0 else None,
+            count=len(entries),
+            rationale="free list dry: evict cold retained blocks "
+                      "(LRU by last reference, leaf-first) to host",
+            victims=",".join(str(b) for b, _, _ in entries),
+            free_before=len(self.free) - len(entries),
+            retained=len(self.retained),
+            host_blocks=len(self.tier),
+        )
         obs.histogram("tpu_patterns_serve_kv_evict_bytes").observe(
             float(n_bytes)
         )
@@ -662,6 +692,7 @@ class ServeEngine:
                     self._release_block(b)
                 self.slot_pool.release(s.slot, reusable=True)
                 self.inflight.release(s.rid)
+                self.cost.drop(s.rid)
                 if s.rid in self.preempted_partial:
                     # a resumed leg retiring: stitch the banked partial
                     # output in front of this leg's ids — the final
@@ -708,6 +739,7 @@ class ServeEngine:
         )
         self.lifecycle[s.rid] = {
             "status": status, "scenario": s.scenario, "n_out": n_out,
+            "priority": s.priority,
             "submit_ns": s.t_submit_ns, "admit_ns": s.t_admit_ns,
             "first_ns": s.t_first_ns, "last_ns": last,
             "ttft_ms": ttft_ms, "tpot_ms": tpot_ms, "e2e_ms": e2e_ms,
@@ -723,6 +755,7 @@ class ServeEngine:
         self.slo.observe(
             tokens=n_out if status == "done" else max(s.n_gen, 1),
             met=met, ttft_ms=ttft_ms, tpot_ms=tpot_ms,
+            priority=s.priority,
         )
         if ttft_ms is not None:
             obs.histogram("tpu_patterns_serve_ttft_ms").observe(ttft_ms)
@@ -765,11 +798,14 @@ class ServeEngine:
         )
 
     def _shed_request(
-        self, rid: int, reason: str, priority: str = "interactive"
+        self, rid: int, reason: str, priority: str = "interactive",
+        rung: str = "head",
     ) -> None:
         """Terminal shed bookkeeping (the burn ladder's shed rungs):
         counted, never dropped silently — done+failed+shed(+resumed)
-        still covers the trace."""
+        still covers the trace.  ``rung`` names which ladder rung shed
+        this request (``bulk`` = queued-bulk-first, ``head`` = both
+        earlier rungs exhausted)."""
         from tpu_patterns import obs
 
         self.shed[rid] = reason
@@ -781,7 +817,18 @@ class ServeEngine:
         obs.counter(
             "tpu_patterns_serve_shed_total", priority=priority
         ).inc()
+        obs.counter(
+            "tpu_patterns_decision_shed_rung_total", rung=rung
+        ).inc()
         obs.event("serve.shed", rid=str(rid), priority=priority)
+        burn = self.slo.snapshot()
+        self.decisions.book(
+            "shed", rid=rid,
+            rationale=reason, rung=rung, priority=priority,
+            burn_fast=round(burn.get("burn_rate_fast", 0.0), 4),
+            burn_slow=round(burn.get("burn_rate_slow", 0.0), 4),
+            queue=len(self.queue), active=len(self.active),
+        )
 
     def _preempt_victim(self) -> _Slot | None:
         """The bulk row to preempt next: the most recently admitted
@@ -821,10 +868,16 @@ class ServeEngine:
         n_kv = s.lens + s.steps
         new_ids = self.index.insert(ctx[:n_kv], s.table)
         self.index.materialize(list(new_ids))
+        # pressure signals at decision time, read BEFORE the release
+        # below frees the victim's blocks (the ledger must carry what
+        # the scheduler saw, not the post-action state)
+        free_at_decision = len(self.free)
+        occ_at_decision = round(self._occupancy(), 4)
         for b in s.table:
             self._release_block(b)
         self.slot_pool.release(s.slot, reusable=True)
         self.inflight.release(s.rid)
+        self.cost.drop(s.rid)
         # force the parked context to host, leaf-first waves; a block
         # another row still references (or a protected one) stays
         # device-resident and simply aliases on resume — fail-soft
@@ -861,6 +914,16 @@ class ServeEngine:
         obs.event(
             "serve.preempted", rid=str(s.rid), replica=self.replica,
             banked=str(len(s.out)),
+        )
+        burn = self.slo.snapshot()
+        self.decisions.book(
+            "preempt", rid=s.rid, jid=s.jid,
+            rationale="bulk victim parked to host tier (LIFO: least "
+                      "banked decode work), remainder re-queued as "
+                      "forced session",
+            banked=len(s.out), free=free_at_decision,
+            occupancy=occ_at_decision, queue=len(self.queue),
+            burn_fast=round(burn.get("burn_rate_fast", 0.0), 4),
         )
 
     def _try_preempt(self, protect=frozenset()) -> bool:
@@ -942,6 +1005,7 @@ class ServeEngine:
                         "shed: slo burn-rate mitigation active"
                         + (" (bulk first)" if bi is not None else ""),
                         priority=req.priority,
+                        rung="bulk" if bi is not None else "head",
                     )
                     continue
             # one scheduler slot per active row, leased from the shared
@@ -1012,6 +1076,14 @@ class ServeEngine:
                 obs.event(
                     "serve.defer", rid=str(req.rid),
                     need=device_need, free=len(self.free),
+                )
+                self.decisions.book(
+                    "defer", rid=req.rid, jid=req.jid,
+                    rationale="pool pressure: fresh-block need exceeds "
+                              "free list after evict/preempt rungs",
+                    need=device_need, free=len(self.free),
+                    queue=len(self.queue), active=len(self.active),
+                    occupancy=round(self._occupancy(), 4),
                 )
                 break  # FIFO: later (smaller) requests must not starve it
             self.queue.pop(0)
@@ -1089,6 +1161,12 @@ class ServeEngine:
                 t_admit_ns=now, slot=slot_tok,
             )
             self.inflight.acquire(req.rid, slot)
+            # residency integral opens: this row holds len(table)
+            # block references until retire/quarantine/preempt drops it
+            self.cost.hold(
+                req.rid, len(table),
+                scenario=req.scenario, priority=req.priority,
+            )
             if req.jid:
                 # journey anchor at ADMISSION: it ships at the next
                 # iteration boundary, so even a replica that is later
@@ -1165,8 +1243,16 @@ class ServeEngine:
             )
             # graftlint: allow[host-sync-in-hot-path] -- the scheduler's ONE designed sync per iteration: sampled ids must reach the host to retire/admit
             tok0 = np.asarray(tok0)
+        prefill_wall_ns = clock_ns() - t0
         obs.histogram("tpu_patterns_serve_prefill_ms").observe(
-            (clock_ns() - t0) / 1e6
+            prefill_wall_ns / 1e6
+        )
+        # attribution: the wave's measured wall splits equal-share
+        # across its bucket occupants (integer ns — Σ attributed ==
+        # measured exactly; a retried wave books each attempt's wall)
+        self.cost.book_prefill(
+            prefill_wall_ns,
+            [(r.rid, r.scenario, r.priority) for r in reqs],
         )
         self._pending_cow = []
         t_tok = clock_ns()  # the wave's first tokens are on the host now
@@ -1348,6 +1434,7 @@ class ServeEngine:
                 self._release_block(b)
             self.slot_pool.release(s.slot, reusable=True)
             self.inflight.release(s.rid)
+            self.cost.drop(s.rid)
             # a quarantined resumed leg is terminally FAILED: drop the
             # banked partial so nothing dangles in the accounting
             self.preempted_partial.pop(s.rid, None)
@@ -1633,6 +1720,9 @@ class ServeEngine:
         # loop runs, /healthz and /statusz answer from THIS engine —
         # detached at exit so sequential legs never read stale state
         obs_live.attach_engine(self)
+        # open the cost-accounting window (obs/cost.py): the pool
+        # integral and wall attribution cover exactly this loop
+        self.cost.start(self.allocated_blocks())
         try:
             with obs.span("serve.run", requests=len(requests)):
                 while True:
@@ -1660,7 +1750,14 @@ class ServeEngine:
                             break
                         continue
                     self._retire()
+                    # sample the pool integral at the release/alloc
+                    # transitions, not just decode boundaries: retire
+                    # frees blocks and admit takes them, and a coarse
+                    # step function here would book the (long, possibly
+                    # compiling) prefill window at the stale count
+                    self.cost.tick(self.allocated_blocks())
                     admitted = self._admit()
+                    self.cost.tick(self.allocated_blocks())
                     if admitted:
                         slots = [s for _, s in admitted]
                         try:
@@ -1703,13 +1800,34 @@ class ServeEngine:
                         # injected sleep at serve.step fires BEFORE the
                         # compiled-call span opens and would be invisible
                         # to the narrower histogram.
+                        # the wave's identity for cost attribution,
+                        # captured BEFORE dispatch: a quarantined wave
+                        # empties self.active, but those rows still
+                        # consumed the device wall (obs/cost.py)
+                        wave = [
+                            (s.rid, s.scenario, s.priority)
+                            for s in self.active
+                        ]
                         t_dispatch = clock_ns()
                         try:
-                            faults.call_with_retry(
-                                step_fn,
-                                policy=self.retry_policy,
-                                site=site,
-                            )
+                            # serve.step_outer closes the PR 9
+                            # perfwatch blind spot: serve.step /
+                            # serve.verify open AFTER the fault-
+                            # injection site inside step_fn, so an
+                            # injected sleep or a retry storm was
+                            # invisible to span summaries.  This outer
+                            # window covers inject + every retry —
+                            # outer >= inner always (test_faults pins
+                            # it under an injected sleep).
+                            with obs.span(
+                                "serve.step_outer",
+                                rows=len(self.active),
+                            ):
+                                faults.call_with_retry(
+                                    step_fn,
+                                    policy=self.retry_policy,
+                                    site=site,
+                                )
                         except (OSError, faults.Quarantined) as e:
                             casualties, self.active = self.active, []
                             self._quarantine(
@@ -1720,9 +1838,14 @@ class ServeEngine:
                         else:
                             self._book_health(True, decode=True)
                         finally:
+                            decode_wall_ns = clock_ns() - t_dispatch
                             obs.histogram(
                                 "tpu_patterns_serve_decode_wall_ms"
-                            ).observe((clock_ns() - t_dispatch) / 1e6)
+                            ).observe(decode_wall_ns / 1e6)
+                            # equal-share attribution of the SAME
+                            # measured wall: Σ per-request == total,
+                            # exactly, in integer ns
+                            self.cost.book_decode(decode_wall_ns, wave)
                     self.stats["peak_blocks"] = max(
                         self.stats["peak_blocks"], self.allocated_blocks()
                     )
@@ -1734,6 +1857,10 @@ class ServeEngine:
                     obs.gauge("tpu_patterns_serve_active_rows").set(
                         len(self.active)
                     )
+                    # advance the block-second step integral: between
+                    # ticks the allocated count was constant, so
+                    # busy + free == pool x elapsed closes exactly
+                    self.cost.tick(self.allocated_blocks())
                     if self.breaker_tripped:
                         # the engine declared itself unhealthy: stop at
                         # this iteration boundary with queue + verdicts
@@ -1750,6 +1877,15 @@ class ServeEngine:
                             "serve.breaker_open", replica=self.replica,
                             queued=len(self.queue),
                         )
+                        self.decisions.book(
+                            "breaker",
+                            rationale="consecutive whole-wave decode "
+                                      "quarantines opened the health "
+                                      "breaker; stopping at the "
+                                      "iteration boundary",
+                            queue=len(self.queue),
+                            active=len(self.active),
+                        )
                         break
                     if self._preempt.is_set():
                         self._take_preemption()
@@ -1761,6 +1897,10 @@ class ServeEngine:
                 # with zero fresh prefill blocks for their history
                 self.save_session()
         finally:
+            # close the accounting window: final pool tick + settle
+            # every still-held residency (breaker/preempt exits can
+            # leave rows holding blocks past the loop)
+            self.cost.close(self.allocated_blocks())
             obs_live.detach_engine(self)
             restore_handlers()
         return dict(self.done)

@@ -269,6 +269,7 @@ class _StdinSource:
                     # fleet merge still has everything up to here
                     obs.dump(reason="checkpoint")
                     obs.dump_metrics()
+                    obs.dump_cost()
                 self._send({
                     "op": "checkpointed",
                     "step": self._engine.stats["steps"],
@@ -489,6 +490,7 @@ def replica_main() -> int:
     if obs_dir:
         obs.dump(reason="end_of_run")
         obs.dump_metrics()
+        obs.dump_cost()
     pending = [r.rid for r, _ in eng.queue] + [
         s.rid for s in eng.active
     ]
@@ -767,6 +769,12 @@ class ReplicaManager:
             # reserved slices are ring members but not routable until
             # the elastic controller spawns them
             self.router.quarantine(str(r))
+        # fleet-level decision ledger (obs/decisions.py): scale out/in
+        # and reroutes book here with the signals that drove them —
+        # counter-identity against the existing fleet/router series
+        from tpu_patterns.obs.decisions import DecisionLedger
+
+        self.decisions = DecisionLedger()
         self.inbox: queue.Queue = queue.Queue()
         self.handles: dict[str, ReplicaHandle] = {}
         self.spawn_retries = 0
@@ -930,6 +938,16 @@ class ReplicaManager:
         except RuntimeError as e:
             res.failed[rid] = str(e)
             return
+        # one decision per successful fallback pick — identity with
+        # tpu_patterns_router_reroutes_total, which fallback() itself
+        # increments (even if the send below then fails, the PICK
+        # happened and both series count it)
+        self.decisions.book(
+            "reroute", rid=rid, jid=req.jid,
+            rationale="replica lost the request mid-flight; "
+                      "rerouted to the ring fallback",
+            target=target, live=len(self._live()),
+        )
         h = self.handles[target]
         if h.state != "ready":
             # the survivor already finished its run (a drain handback
@@ -1031,11 +1049,32 @@ class ReplicaManager:
         )
         action = self.elastic.decide(now_s, sig)
         if action == "out":
-            self._scale_out(now_s, res)
+            self._scale_out(now_s, res, sig)
         elif action == "in":
-            self._scale_in(now_s, res)
+            self._scale_in(now_s, res, sig)
 
-    def _scale_out(self, now_s: float, res: FleetResult) -> None:
+    def _scale_inputs(self, sig: FleetSignals | None) -> dict:
+        """The occupancy-window values that drove a scale decision —
+        the ledger carries what the policy read, not the post-action
+        state."""
+        if sig is None:
+            return {}
+        cfg = self.elastic.cfg if self.elastic is not None else None
+        out = {
+            "occupancy": round(sig.occupancy(), 4),
+            "leases": sig.leases, "live": sig.live,
+            "spare": sig.spare, "slots": sig.slots,
+        }
+        if cfg is not None:
+            out["out_occupancy"] = cfg.out_occupancy
+            out["in_occupancy"] = cfg.in_occupancy
+            out["sustain_s"] = cfg.sustain_s
+        return out
+
+    def _scale_out(
+        self, now_s: float, res: FleetResult,
+        sig: FleetSignals | None = None,
+    ) -> None:
         """Spawn a replica on the next reserved slice.  The spawn is
         warm-up-masked (the PR 12 protocol): this call only forks and
         sends init — the child joins the ring when its ready handshake
@@ -1062,8 +1101,17 @@ class ReplicaManager:
             action="out", replica=rid,
         ).inc()
         obs.event("fleet.scale_out", replica=rid)
+        self.decisions.book(
+            "scale_out",
+            rationale="sustained occupancy above the scale-out "
+                      "threshold; spawning on the reserved slice",
+            target=rid, **self._scale_inputs(sig),
+        )
 
-    def _scale_in(self, now_s: float, res: FleetResult) -> None:
+    def _scale_in(
+        self, now_s: float, res: FleetResult,
+        sig: FleetSignals | None = None,
+    ) -> None:
         """Drain the COLDEST live replica (fewest ledgered leases; ties
         retire elastic spawns before the core fleet) through the
         existing drain-to-snapshot path: its in-flight leases reroute
@@ -1087,6 +1135,12 @@ class ReplicaManager:
             action="in", replica=h.id,
         ).inc()
         obs.event("fleet.scale_in", replica=h.id)
+        self.decisions.book(
+            "scale_in",
+            rationale="sustained occupancy below the scale-in "
+                      "threshold; draining the coldest live replica",
+            target=h.id, **self._scale_inputs(sig),
+        )
         h.state = "quarantined"  # drains like one; the handback settles
         self.router.quarantine(h.id)
         try:
@@ -1198,6 +1252,12 @@ class ReplicaManager:
             except RuntimeError as e:
                 res.failed[req.rid] = str(e)
                 return
+            self.decisions.book(
+                "reroute", rid=req.rid, jid=req.jid,
+                rationale="primary route choice faulted at the "
+                          "router; fell back to a live replica",
+                target=target, live=len(self._live()),
+            )
         except RuntimeError as e:
             res.failed[req.rid] = str(e)
             return
