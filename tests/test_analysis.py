@@ -1453,3 +1453,80 @@ def dataclasses_replace_anchor(entry, path, line):
     import dataclasses as _dc
 
     return _dc.replace(entry, anchor_path=path, anchor_line=line)
+
+
+class TestPagedKernelEntries:
+    """PR-18 registry surface: the pallas decode twins and the fused-
+    sampling core are enumerated, and the decode hot-path audit sees
+    the sampling all_gather — firing without the declaration, clean
+    with it."""
+
+    def test_registry_declares_the_pallas_family(self):
+        from tpu_patterns.perf import registry
+        from tpu_patterns.serve.paged import (
+            DECODE_DECLARED_COLLECTIVES,
+            SAMPLED_DECODE_DECLARED_COLLECTIVES,
+        )
+
+        entries = {e.name: e for e in registry.spmd_entries()}
+        for name in ("decoder.step_pallas", "decoder.verify_pallas",
+                     "decoder.step_sampled"):
+            assert name in entries, name
+            assert entries[name].hot and entries[name].donates
+        # the kernel is rank-local; its sp combine runs outside, so the
+        # pallas twins declare EXACTLY the dense budget
+        assert (entries["decoder.step_pallas"].declared_collectives
+                == DECODE_DECLARED_COLLECTIVES)
+        assert (entries["decoder.step_sampled"].declared_collectives
+                == SAMPLED_DECODE_DECLARED_COLLECTIVES)
+        assert (("all_gather", ("tp",))
+                in SAMPLED_DECODE_DECLARED_COLLECTIVES)
+
+    def _sampled_entry(self, declared):
+        import dataclasses as _dc
+
+        from tpu_patterns.perf import registry
+
+        e = next(x for x in registry.spmd_entries()
+                 if x.name == "decoder.step_sampled")
+        return _dc.replace(e, declared_collectives=declared)
+
+    def test_sampling_gather_fires_against_dense_budget(self):
+        # the REAL sampled core against the dense declaration: the
+        # candidate all_gather over tp is a NEW finding
+        from tpu_patterns.analysis import shardlint
+        from tpu_patterns.serve.paged import DECODE_DECLARED_COLLECTIVES
+
+        fs = shardlint.run_shard_checks(
+            ["collective-in-decode-hot-path"],
+            entries=[self._sampled_entry(DECODE_DECLARED_COLLECTIVES)],
+        )
+        assert fs
+        assert any("all_gather" in f.message for f in fs)
+
+    def test_sampling_gather_clean_with_declared_budget(self):
+        from tpu_patterns.analysis import shardlint
+        from tpu_patterns.serve.paged import (
+            SAMPLED_DECODE_DECLARED_COLLECTIVES,
+        )
+
+        assert shardlint.run_shard_checks(
+            ["collective-in-decode-hot-path"],
+            entries=[
+                self._sampled_entry(SAMPLED_DECODE_DECLARED_COLLECTIVES)
+            ],
+        ) == []
+
+    def test_pallas_step_clean_on_decode_audit(self):
+        # kernel enabled, dense budget: no new collective — the fused
+        # path must not widen the decode collective footprint
+        from tpu_patterns.analysis import shardlint
+        from tpu_patterns.perf import registry
+
+        entries = [e for e in registry.spmd_entries()
+                   if e.name in ("decoder.step_pallas",
+                                 "decoder.verify_pallas")]
+        assert len(entries) == 2
+        assert shardlint.run_shard_checks(
+            ["collective-in-decode-hot-path"], entries=entries
+        ) == []

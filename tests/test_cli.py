@@ -328,12 +328,16 @@ class TestSweep:
         srv = sweep.specs_for("serve", quick=True)
         # base engine + int8 pool + gqa pool (full-verdict cells) + the
         # PR-7 prefix-sharing and speculative-decoding record cells +
-        # the tiered-KV-cache admit-where-deferred cell
+        # the tiered-KV-cache admit-where-deferred cell + the fused
+        # paged-attention lever (A/B vs serve.continuous)
         assert {s.name for s in srv} == {
             "serve.continuous", "serve.int8_pool", "serve.gqa_pool",
             "serve.prefix_share", "serve.spec_decode", "serve.kv_tier",
+            "serve.pallas_attn",
         }
         assert all(s.argv[0] == "serve" for s in srv)
+        pal = next(s for s in srv if s.name == "serve.pallas_attn")
+        assert "--paged_attn" in pal.argv and "pallas" in pal.argv
         pre = next(s for s in srv if s.name == "serve.prefix_share")
         assert "--prefix_share" in pre.argv
         spc = next(s for s in srv if s.name == "serve.spec_decode")

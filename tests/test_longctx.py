@@ -955,3 +955,58 @@ class TestCompactCausalGridBackward:
         )
         recs = run_longctx_grad(mesh, cfg, ResultWriter())
         assert recs[0].verdict is Verdict.SUCCESS, recs[0].notes
+
+
+class TestSharedTuning:
+    """The block-size auto-tuner moved to longctx/tuning.py (shared
+    with serve/paged_kernel.py): flash's re-exports stay the same
+    objects and the tuned choices are pinned — an extraction, not a
+    behavior change."""
+
+    def test_flash_reexports_are_the_tuning_objects(self):
+        from tpu_patterns.longctx import flash, tuning
+
+        for name in ("LANES", "NEG_INF", "VMEM_BUDGET", "DEFAULT_BLOCK_Q",
+                     "DEFAULT_BLOCK_K", "FLASH_TUNED_PATH", "_auto_block",
+                     "_vmem_estimate", "load_tuned_blocks"):
+            assert getattr(flash, name) is getattr(tuning, name), name
+
+    def test_auto_block_choices_pinned(self):
+        from tpu_patterns.longctx.tuning import _auto_block
+
+        # the documented v5e ladder: the (1024, 1024) d=128 bf16 forward
+        # fits (13.1 MB < 14 MB); a 2048-square request shrinks back to
+        # it; tiny shapes pass through unclamped
+        assert _auto_block(4096, 4096, 128, 2, 2, 1024, 1024) == (
+            1024, 1024,
+        )
+        assert _auto_block(4096, 4096, 128, 2, 2, 2048, 2048) == (
+            1024, 1024,
+        )
+        assert _auto_block(8, 8, 64, 4, 2, 1024, 1024) == (8, 8)
+        # the backward's 4 score tiles tighten the ladder one rung
+        # (the q side halves first — bq >= bk breaks toward bq)
+        assert _auto_block(4096, 4096, 128, 2, 4, 1024, 1024) == (
+            512, 1024,
+        )
+        # blocks never shrink below the 128-lane floor when the problem
+        # is at least that large
+        bq, bk = _auto_block(4096, 4096, 512, 4, 4, 2048, 2048)
+        assert bq >= 128 and bk >= 128
+
+    def test_vmem_estimate_monotone_and_calibrated(self):
+        from tpu_patterns.longctx.tuning import (
+            VMEM_BUDGET,
+            _vmem_estimate,
+        )
+
+        # the two calibration anchors from the hardware ladder
+        assert _vmem_estimate(1024, 1024, 128, 2, 2) < VMEM_BUDGET
+        assert _vmem_estimate(2048, 2048, 128, 2, 2) > VMEM_BUDGET
+        # monotone in every argument the ladder moves
+        base = _vmem_estimate(512, 512, 64, 2, 2)
+        assert _vmem_estimate(1024, 512, 64, 2, 2) > base
+        assert _vmem_estimate(512, 1024, 64, 2, 2) > base
+        assert _vmem_estimate(512, 512, 128, 2, 2) > base
+        assert _vmem_estimate(512, 512, 64, 4, 2) > base
+        assert _vmem_estimate(512, 512, 64, 2, 4) > base

@@ -1,0 +1,252 @@
+"""Fused Pallas paged-attention decode kernel (serve/paged_kernel.py):
+interpret-mode agreement with the dense gather path on random block
+tables (ragged positions, trash pages, inactive rows, int8 pools, the
+speculative wide step), the sp-sharded combine, and the backend A/B at
+the engine level — greedy ids must be bit-identical dense vs pallas."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_patterns.models.lm import init_lm_params
+from tpu_patterns.models.transformer import ModelConfig, _n_experts
+from tpu_patterns.serve import (
+    Request,
+    ServeEngine,
+    TRASH_BLOCK,
+    make_paged_lm_decoder,
+)
+from tpu_patterns.serve.paged import PagedLayout, _pool_attend
+from tpu_patterns.serve.paged_kernel import block_tile, paged_attend
+
+CFG = dict(embed=64, heads=8, head_dim=8, causal=True, dtype="float32")
+VOCAB = 64
+
+
+def _mesh(devices, shape):
+    n = int(np.prod(shape))
+    return Mesh(np.array(devices[:n]).reshape(shape), ("dp", "sp", "tp"))
+
+
+def _rand_pool(rng, n_blocks, bl_loc, hkv, d, int8=False):
+    shape = (n_blocks, bl_loc, hkv, d)
+    if not int8:
+        return {
+            "k": jnp.asarray(rng.randn(*shape), jnp.float32),
+            "v": jnp.asarray(rng.randn(*shape), jnp.float32),
+        }
+    return {
+        "k": jnp.asarray(rng.randint(-127, 128, size=shape), jnp.int8),
+        "v": jnp.asarray(rng.randint(-127, 128, size=shape), jnp.int8),
+        "ks": jnp.asarray(
+            rng.uniform(0.005, 0.02, size=shape[:3]), jnp.float32
+        ),
+        "vs": jnp.asarray(
+            rng.uniform(0.005, 0.02, size=shape[:3]), jnp.float32
+        ),
+    }
+
+
+def _dense_ref(pool_l, q, tables, pos0, active, layout, sp_axis=None):
+    """The dense path's exact mask (the _paged_verify_layer
+    construction, W=1 degenerates to the decode-layer mask)."""
+    w = q.shape[1]
+    n_pages = tables.shape[1]
+    posn = layout.page_positions(n_pages, sp_axis)
+    tvalid = jnp.repeat(tables > TRASH_BLOCK, layout.bl_loc, axis=1)
+    pos = pos0[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]
+    mask = (
+        (posn[None, None, :] <= pos[:, :, None])
+        & tvalid[:, None, :]
+        & active[:, None, None]
+    )
+    return _pool_attend(pool_l, q, tables, mask, layout, sp_axis)
+
+
+class TestKernelVsDense:
+    """Single-shard interpret-mode agreement: the kernel must reproduce
+    the gather -> masked-softmax path to float tolerance on adversarial
+    table layouts."""
+
+    B, H, HKV, D = 3, 4, 2, 8
+    BL, N_BLOCKS, N_PAGES = 8, 10, 3
+
+    def _case(self, *, w=1, int8=False, seed=0):
+        rng = np.random.RandomState(seed)
+        layout = PagedLayout(self.N_BLOCKS, self.BL, sp=1)
+        pool = _rand_pool(
+            rng, self.N_BLOCKS, self.BL, self.HKV, self.D, int8
+        )
+        q = jnp.asarray(
+            rng.randn(self.B, w, self.H, self.D), jnp.float32
+        )
+        # distinct physical blocks per row, trash in the unreached tail
+        perm = 1 + rng.permutation(self.N_BLOCKS - 1)[
+            : self.B * self.N_PAGES
+        ].reshape(self.B, self.N_PAGES)
+        tables = np.asarray(perm, np.int32)
+        tables[0, 2] = TRASH_BLOCK  # row 0 never grew a third page
+        pos0 = jnp.asarray([5, 11, 2], jnp.int32)  # ragged, mid-block
+        active = jnp.asarray([True, True, True])
+        return pool, q, jnp.asarray(tables), pos0, active, layout
+
+    def _agree(self, pool, q, tables, pos0, active, layout):
+        got = paged_attend(
+            pool, q, tables, pos0, active, layout, None, interpret=True
+        )
+        want = _dense_ref(pool, q, tables, pos0, active, layout)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6
+        )
+
+    def test_decode_step_agrees(self):
+        self._agree(*self._case(w=1))
+
+    def test_wide_verify_step_agrees(self):
+        # W=4: per-query causality inside the window (query i sees
+        # positions <= pos0 + i), same kernel as plain decode
+        self._agree(*self._case(w=4, seed=1))
+
+    def test_int8_dequant_fused(self):
+        # in-kernel dequant: k's scale on the score tile, v's folded
+        # after the normalizer — must match the dense dequant order
+        self._agree(*self._case(w=1, int8=True, seed=2))
+
+    def test_int8_wide(self):
+        self._agree(*self._case(w=4, int8=True, seed=3))
+
+    def test_inactive_row_emits_zero(self):
+        pool, q, tables, pos0, _, layout = self._case()
+        active = jnp.asarray([True, False, True])
+        got = paged_attend(
+            pool, q, tables, pos0, active, layout, None, interpret=True
+        )
+        want = _dense_ref(pool, q, tables, pos0, active, layout)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6
+        )
+        assert np.all(np.asarray(got)[1] == 0.0)
+
+    def test_all_trash_window_emits_zero(self):
+        # a row whose whole table is trash (freshly admitted, nothing
+        # written): the NEG_INF guard must yield exact zeros, not NaN
+        pool, q, tables, pos0, active, layout = self._case()
+        tables = tables.at[2].set(TRASH_BLOCK)
+        got = np.asarray(paged_attend(
+            pool, q, tables, pos0, active, layout, None, interpret=True
+        ))
+        assert np.all(np.isfinite(got))
+        assert np.all(got[2] == 0.0)
+
+    def test_block_tile_divides_pool_block(self):
+        # the tile ladder must never straddle two physical blocks
+        for bl_loc in (4, 8, 16, 64, 256):
+            for gw in (1, 4, 8):
+                bk = block_tile(bl_loc, 64, 4, gw)
+                assert bl_loc % bk == 0 and 1 <= bk <= bl_loc
+
+
+class TestShardedCombine:
+    def test_sp_partials_combine_to_dense(self, devices):
+        """The out-of-kernel sp combine (pmax / rescale / psum) must
+        reproduce the dense sharded attention on a 2-way sp mesh."""
+        rng = np.random.RandomState(4)
+        b, w, h, hkv, d = 2, 1, 4, 2, 8
+        n_blocks, bl, n_pages, sp = 6, 8, 2, 2
+        layout = PagedLayout(n_blocks, bl, sp=sp)
+        mesh = Mesh(np.array(devices[:sp]).reshape(sp), ("sp",))
+        k = jnp.asarray(rng.randn(n_blocks, bl, hkv, d), jnp.float32)
+        v = jnp.asarray(rng.randn(n_blocks, bl, hkv, d), jnp.float32)
+        q = jnp.asarray(rng.randn(b, w, h, d), jnp.float32)
+        tables = jnp.asarray([[1, 2], [3, TRASH_BLOCK]], jnp.int32)
+        pos0 = jnp.asarray([13, 6], jnp.int32)
+        active = jnp.asarray([True, True])
+
+        def body(k_l, v_l, q_r):
+            pool_l = {"k": k_l, "v": v_l}
+            pal = paged_attend(
+                pool_l, q_r, tables, pos0, active, layout, "sp",
+                interpret=True,
+            )
+            den = _dense_ref(
+                pool_l, q_r, tables, pos0, active, layout, "sp"
+            )
+            return pal, den
+
+        fn = jax.jit(jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(None, "sp"), P(None, "sp"), P()),
+            out_specs=(P(), P()),
+            check_vma=False,
+        ))
+        pal, den = fn(k, v, q)
+        np.testing.assert_allclose(
+            np.asarray(pal), np.asarray(den), rtol=2e-5, atol=2e-6
+        )
+
+
+class TestEngineBackendAB:
+    """The serve-level gate: a full continuous-batching trace must
+    retire bit-identical greedy ids on either attention backend."""
+
+    def _ids(self, devices, shape, attn, *, cache_int8=False, spec_k=0,
+             depth=2):
+        mesh = _mesh(devices, shape)
+        mcfg = ModelConfig(**CFG, kv_heads=2, depth=depth)
+        dec = make_paged_lm_decoder(
+            mesh, mcfg, VOCAB, n_blocks=17, block_len=8, max_len=40,
+            cache_int8=cache_int8, attn=attn,
+        )
+        flat = init_lm_params(
+            jax.random.key(0), mcfg, VOCAB, _n_experts(mesh, mcfg)
+        )
+        params = dec.stack_params(flat)
+        rng = np.random.RandomState(11)
+        reqs = [
+            Request(
+                rid=i,
+                tokens=rng.randint(
+                    0, VOCAB, size=rng.randint(3, 21)
+                ).tolist(),
+                n_gen=6,
+            )
+            for i in range(6)
+        ]
+        eng = ServeEngine(dec, params, slots=4, spec_k=spec_k)
+        out = eng.run(reqs)
+        assert not eng.failed and eng.leaked_blocks() == 0
+        return out
+
+    def test_single_shard_ids_identical(self, devices):
+        a = self._ids(devices, (1, 1, 1), "dense", depth=1)
+        b = self._ids(devices, (1, 1, 1), "pallas", depth=1)
+        assert a == b
+
+    def test_sharded_ids_identical(self, devices):
+        a = self._ids(devices, (1, 2, 2), "dense")
+        b = self._ids(devices, (1, 2, 2), "pallas")
+        assert a == b
+
+    def test_int8_pool_ids_identical(self, devices):
+        a = self._ids(devices, (1, 2, 2), "dense", cache_int8=True)
+        b = self._ids(devices, (1, 2, 2), "pallas", cache_int8=True)
+        assert a == b
+
+    def test_spec_decode_ids_identical(self, devices):
+        # the wide verify step runs the same kernel at W = spec_k + 1
+        a = self._ids(devices, (1, 2, 2), "dense", spec_k=2)
+        b = self._ids(devices, (1, 2, 2), "pallas", spec_k=2)
+        assert a == b
+
+    def test_unknown_backend_rejected(self, devices):
+        mesh = _mesh(devices, (1, 1, 1))
+        mcfg = ModelConfig(**CFG, depth=1)
+        with pytest.raises(ValueError, match="attn"):
+            make_paged_lm_decoder(
+                mesh, mcfg, VOCAB, n_blocks=5, block_len=8, max_len=16,
+                attn="flash",
+            )

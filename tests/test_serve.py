@@ -1788,3 +1788,169 @@ class TestPreemption:
         assert not eng.preempted_partial  # nothing banked dangles
         assert eng.leaked_blocks() == 0
         _assert_tier_invariants(eng)
+
+
+def _sampled_trace(n=8, n_gen=5, seed=21, priorities=False):
+    """Mixed greedy/stochastic trace: every third request stays greedy
+    (temperature 0), the rest draw seeded temperature/top-k/top-p."""
+    rng = np.random.RandomState(seed)
+    reqs = []
+    for i in range(n):
+        temp = 0.0 if i % 3 == 0 else float(rng.uniform(0.5, 1.3))
+        reqs.append(Request(
+            rid=i,
+            tokens=rng.randint(
+                0, VOCAB, size=rng.randint(9, 14)
+            ).tolist(),
+            n_gen=n_gen,
+            temperature=temp,
+            top_k=int(rng.choice([0, 5, 16])),
+            top_p=float(rng.choice([1.0, 0.9, 0.95])),
+            seed=int(rng.randint(1 << 30)),
+            priority=(
+                "bulk" if priorities and i < n // 2 else "interactive"
+            ),
+        ))
+    return reqs
+
+
+def _sampled_setup(devices, *, attn="dense", shape=(1, 2, 2),
+                   n_blocks=17):
+    mesh = _mesh(devices, shape)
+    mcfg = ModelConfig(**CFG, kv_heads=2, depth=1)
+    dec = make_paged_lm_decoder(
+        mesh, mcfg, VOCAB, n_blocks=n_blocks, block_len=8, max_len=40,
+        attn=attn, sampling=True,
+    )
+    flat = init_lm_params(
+        jax.random.key(0), mcfg, VOCAB, _n_experts(mesh, mcfg)
+    )
+    return mesh, mcfg, dec, dec.stack_params(flat), flat
+
+
+class TestSampledDecode:
+    """In-kernel seeded sampling: a request's n-th generated token is
+    drawn with key fold_in(fold_in(key(0), seed), gen_offset + n) —
+    independent of mesh, scheduler batching, attention backend, and
+    preemption, so the sampled stream is REPLAYABLE.  These are the
+    fixed-seed-oracle exactness gates."""
+
+    def _run(self, dec, params, reqs, *, slots=3, spec_k=0, **kw):
+        eng = ServeEngine(dec, params, slots=slots, spec_k=spec_k, **kw)
+        out = eng.run([dataclasses.replace(r) for r in reqs])
+        assert not eng.failed and eng.leaked_blocks() == 0
+        return out, eng
+
+    def test_restart_replay_and_oracle(self, devices):
+        # same trace, two fresh engines: bit-identical; and both match
+        # the per-request dense batch-1 oracle
+        from tpu_patterns.serve.engine import _oracle_expected
+
+        mesh, mcfg, dec, params, flat = _sampled_setup(devices)
+        reqs = _sampled_trace()
+        a, _ = self._run(dec, params, reqs)
+        b, _ = self._run(dec, params, reqs)
+        assert a == b
+        want = _oracle_expected(
+            mesh, int(mesh.shape["sp"]), mcfg, VOCAB, flat, reqs,
+            max_prompt=16, max_gen=5,
+        )
+        assert a == want
+
+    def test_backend_invariance(self, devices):
+        # the sampling key never sees the attention backend: dense and
+        # pallas engines retire the SAME stochastic ids
+        _, _, d1, p1, _ = _sampled_setup(devices, attn="dense")
+        _, _, d2, p2, _ = _sampled_setup(devices, attn="pallas")
+        reqs = _sampled_trace()
+        a, _ = self._run(d1, p1, reqs)
+        b, _ = self._run(d2, p2, reqs)
+        assert a == b
+
+    def test_spec_decode_sampled_bit_identical(self, devices):
+        # verify position t draws key gen_offset + t in-device: the
+        # accepted stream equals plain sampled decode exactly
+        _, _, dec, params, _ = _sampled_setup(devices)
+        plain, _ = self._run(dec, params, _sampled_trace())
+        wide, eng = self._run(
+            dec, params, _sampled_trace(), spec_k=2
+        )
+        assert eng.stats.get("spec_accepted", 0) >= 0
+        assert plain == wide
+
+    def test_temperature_zero_rows_match_greedy_decoder(self, devices):
+        # temp 0 through the sampling core IS greedy: identical ids to
+        # the sampling=False decoder on an all-greedy trace
+        mesh, mcfg, dec, params, flat = _sampled_setup(devices)
+        greedy_dec = make_paged_lm_decoder(
+            mesh, mcfg, VOCAB, n_blocks=17, block_len=8, max_len=40,
+        )
+        gparams = greedy_dec.stack_params(flat)
+        reqs = [
+            dataclasses.replace(
+                r, temperature=0.0, top_k=0, top_p=1.0
+            )
+            for r in _sampled_trace()
+        ]
+        a, _ = self._run(dec, params, reqs)
+        b, _ = self._run(greedy_dec, gparams, reqs)
+        assert a == b
+
+    def test_preemption_does_not_advance_sampling_key(self, devices):
+        # a preempted bulk row banks its partial and re-queues with
+        # gen_offset advanced by the BANKED length only — the resumed
+        # tail continues the same key stream, so the stitched ids equal
+        # an unpreempted run exactly
+        mesh, mcfg, dec, params, _ = _sampled_setup(
+            devices, shape=(1, 1, 1), n_blocks=21
+        )
+        reqs = _sampled_trace(n=4, n_gen=8, priorities=True)
+        for r in reqs:
+            if r.priority == "interactive":
+                r.n_gen = 3
+        want, _ = self._run(dec, params, reqs, slots=2)
+        out, eng = self._run(
+            dec, params, reqs, slots=2, kv_host_tier=True,
+            preempt="bulk",
+        )
+        assert eng.stats["preempted"] >= 1
+        assert eng.stats["preempted_resumed"] >= 1
+        assert out == want
+        _assert_tier_invariants(eng)
+
+    def test_sampled_state_survives_snapshot_restore(
+        self, devices, tmp_path
+    ):
+        # SNAPSHOT_FORMAT 3: sampling config + gen_offset serialize;
+        # the restored engine finishes the stochastic trace
+        # bit-identical to an uninterrupted run
+        from tpu_patterns import faults
+        from tpu_patterns.serve.engine import SNAPSHOT_FORMAT
+
+        assert SNAPSHOT_FORMAT == 3
+        mesh, mcfg, dec, params, _ = _sampled_setup(
+            devices, shape=(1, 1, 1), n_blocks=21
+        )
+        reqs = _sampled_trace(n=4, n_gen=8, priorities=True)
+        want, _ = self._run(dec, params, reqs, slots=2)
+        kw = dict(
+            slots=2, kv_host_tier=True, preempt="bulk",
+            snapshot_dir=str(tmp_path / "snap"),
+            fingerprint={"t": "sampled"},
+        )
+        eng = ServeEngine(dec, params, **kw)
+        faults.configure("serve.step:preempt:after=3:count=1")
+        try:
+            eng.run([dataclasses.replace(r) for r in reqs])
+        finally:
+            faults.configure(None)
+        assert eng.preempted_at is not None
+        eng2 = ServeEngine(dec, params, **kw)
+        assert eng2.restore_snapshot() == eng.preempted_at
+        # the sampling state came back through the snapshot: every
+        # restored row carries its config and a consistent gen_offset
+        for s in eng2.active:
+            assert s.gen_offset >= 0 and s.top_p > 0
+        got = eng2.run([])
+        assert got == want
+        _assert_tier_invariants(eng2)

@@ -129,6 +129,10 @@ class LoadGenConfig:
     rope: bool = True
     kv_heads: int = 0
     cache_int8: bool = False
+    # decode-attention backend (ServeConfig.paged_attn): "dense" = the
+    # pool-gather round-trip, "pallas" = the fused paged-attention
+    # kernel — same schedules, same ids, A/B-able under load
+    paged_attn: str = "dense"
     slots: int = 8
     block_len: int = 16
     n_blocks: int = 0  # 0 = auto: full slots x max_len rectangle + trash
@@ -518,10 +522,16 @@ def run_loadgen(mesh, cfg: LoadGenConfig, writer) -> list:
 
         ws = cfg.slots * per_row
         n_blocks = max(math.ceil(ws / ws_mult), per_row + 1) + 1
+    # a scenario with temperature > 0 needs the seeded-sampling cores;
+    # greedy scenarios through a sampling decoder stay bit-identical
+    # (temp=0 rows take the greedy path), so ONE decoder serves a
+    # mixed --scenarios list
+    sampled = any(s.temperature > 0 for s in specs)
     decoder = make_paged_lm_decoder(
         mesh, mcfg, cfg.vocab, n_blocks=n_blocks,
         block_len=cfg.block_len, max_len=max_len,
-        cache_int8=cfg.cache_int8,
+        cache_int8=cfg.cache_int8, attn=cfg.paged_attn,
+        sampling=sampled,
     )
     flat_params = init_lm_params(
         jax.random.key(cfg.seed), mcfg, cfg.vocab, _n_experts(mesh, mcfg)
@@ -550,12 +560,33 @@ def run_loadgen(mesh, cfg: LoadGenConfig, writer) -> list:
         ttft_p = _pcts(st["ttft"])
         tpot_p = _pcts(st["tpot"])
         e2e_p = _pcts(st["e2e"])
+        # stochastic scenarios gate token EXACTNESS against the
+        # fixed-seed oracle: the dense batch-1 decoder replays each
+        # request's (seed, index) draw keys, engine-independent — the
+        # sampled twin of the serve patterns' greedy-ids gate
+        mismatched: list[int] = []
+        sampled_exact = -1.0
+        if spec.temperature > 0:
+            from tpu_patterns.serve.engine import _oracle_expected
+
+            want = _oracle_expected(
+                mesh, sp, mcfg, cfg.vocab, flat_params,
+                [tr.request for tr in schedule],
+                max_prompt=spec.max_prompt, max_gen=spec.max_gen,
+                cache_int8=cfg.cache_int8,
+            )
+            mismatched = sorted(
+                rid for rid, ids in eng.done.items()
+                if list(ids) != want[rid][: len(ids)]
+            )
+            sampled_exact = float(not mismatched)
         ok = (
             not st["unaccounted"]
             and st["failed"] == 0
             and st["dropped"] == 0
             and eng.preempted_at is None
             and st["goodput"] >= cfg.min_goodput
+            and not mismatched
         )
         rec = Record(
             pattern="loadgen",
@@ -583,10 +614,18 @@ def run_loadgen(mesh, cfg: LoadGenConfig, writer) -> list:
                 "tokens": float(st["tokens"]),
                 "slo_ttft_ms": spec.slo_ttft_ms,
                 "slo_tpot_ms": spec.slo_tpot_ms,
+                # -1 = greedy scenario (gate not applicable)
+                "sampled_exact": sampled_exact,
             },
             verdict=Verdict.SUCCESS if ok else Verdict.FAILURE,
         )
         rec.metrics.update(_class_cost_metrics(st))
+        if mismatched:
+            rec.notes.append(
+                f"request(s) {mismatched[:8]} diverged from the "
+                "fixed-seed oracle — the engine's sampled stream is "
+                "not replaying its (seed, index) keys"
+            )
         if st["unaccounted"]:
             rec.notes.append(
                 f"request(s) {st['unaccounted'][:8]} neither completed "

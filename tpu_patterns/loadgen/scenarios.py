@@ -81,6 +81,19 @@ class ScenarioSpec:
     # degradation ladder).  0 (the default) tags nothing and draws
     # nothing, so priority-free schedules replay bit-identically.
     bulk_fraction: float = 0.0
+    # stochastic sampling: with temperature > 0 every request draws a
+    # per-request temperature (uniform in ``temperature +/-
+    # temperature_spread``, floored just above 0) and a per-request
+    # seed, and carries the spec's top_k/top_p — exercising the
+    # engine's fused seeded-sampling cores.  The runner's exactness
+    # gate for such a scenario is the FIXED-SEED ORACLE (the dense
+    # batch-1 decoder replaying each request's (seed, index) keys), not
+    # greedy ids.  0 (the default) draws nothing: greedy schedules
+    # replay bit-identically.
+    temperature: float = 0.0
+    temperature_spread: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
 
     def __post_init__(self):
         if self.arrival not in ARRIVAL_PROCESSES:
@@ -134,6 +147,25 @@ class ScenarioSpec:
                 f"scenario {self.name!r}: bulk_fraction must be in "
                 f"[0, 1], got {self.bulk_fraction}"
             )
+        if self.temperature < 0 or self.temperature_spread < 0:
+            raise ValueError(
+                f"scenario {self.name!r}: temperature and "
+                "temperature_spread must be >= 0"
+            )
+        if self.temperature == 0 and self.temperature_spread > 0:
+            raise ValueError(
+                f"scenario {self.name!r}: temperature_spread needs "
+                "temperature > 0 (the spread widens a sampled preset)"
+            )
+        if self.top_k < 0:
+            raise ValueError(
+                f"scenario {self.name!r}: top_k must be >= 0 (0 = all)"
+            )
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(
+                f"scenario {self.name!r}: top_p must be in (0, 1], got "
+                f"{self.top_p}"
+            )
 
     def deadline_ms(self, n_gen: int) -> float:
         """A request's submit->last-token budget: first token under the
@@ -170,6 +202,19 @@ PRESETS: dict[str, ScenarioSpec] = {
         min_prompt=4, max_prompt=24, mean_prompt=10,
         min_gen=2, max_gen=10, mean_gen=4,
         slo_ttft_ms=1500.0, slo_tpot_ms=400.0, chaos_p99_mult=5.0,
+    ),
+    # chat traffic with STOCHASTIC decoding: every request samples at
+    # its own temperature (0.8 +/- 0.4) under top-k/top-p truncation
+    # with its own seed — the preset that exercises the fused
+    # seeded-sampling decode cores.  Its Record's exactness gate is the
+    # fixed-seed oracle (serve/engine._oracle_expected), not greedy ids.
+    "chat-sampled": ScenarioSpec(
+        name="chat-sampled", arrival="poisson", requests=24,
+        rate_rps=8.0,
+        min_prompt=8, max_prompt=48, mean_prompt=24,
+        min_gen=4, max_gen=16, mean_gen=8,
+        slo_ttft_ms=2000.0, slo_tpot_ms=500.0, chaos_p99_mult=5.0,
+        temperature=0.8, temperature_spread=0.4, top_k=16, top_p=0.95,
     ),
 }
 
@@ -284,6 +329,17 @@ def build_schedule(
         if spec.bulk_fraction > 0:
             if rng.random() < spec.bulk_fraction:
                 priority = "bulk"
+        # sampling draws AFTER priority and only when enabled — greedy
+        # specs keep their exact historical draw sequence too
+        temperature, seed_r = 0.0, 0
+        if spec.temperature > 0:
+            temperature = spec.temperature
+            if spec.temperature_spread > 0:
+                temperature += rng.uniform(
+                    -spec.temperature_spread, spec.temperature_spread
+                )
+            temperature = max(temperature, 0.05)  # spread never => greedy
+            seed_r = rng.randrange(1 << 31)
         out.append(
             TimedRequest(
                 request=Request(
@@ -291,6 +347,9 @@ def build_schedule(
                     scenario=spec.name,
                     deadline_ms=spec.deadline_ms(n_gen),
                     priority=priority,
+                    temperature=temperature, seed=seed_r,
+                    top_k=spec.top_k if temperature > 0 else 0,
+                    top_p=spec.top_p if temperature > 0 else 1.0,
                 ),
                 arrival_s=off * time_scale,
             )

@@ -18,100 +18,28 @@ compute for those tiles is predicated off, the grid itself stays static.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-LANES = 128
-NEG_INF = -1e30
-
-# VMEM working-set budget per kernel instance.  v5e/v5p cores have 16 MB;
-# block sizes auto-shrink to fit (a fixed 1024/2048 default would simply
-# fail to compile on smaller-VMEM parts or larger head dims).  14 MB is
-# calibrated against hardware: the forward's 1024x1024 d=128 config
-# (estimate 13.1 MB) measurably fits and is the documented v5e sweet spot,
-# while 2048x2048 (estimate ~40 MB) measurably OOMs scoped VMEM.
-VMEM_BUDGET = 14 * 1024 * 1024
-
-
-# Hardware-promoted default block shape, written by
-# ``sweep promote --flash-dir`` from a completed measured run whose
-# flagship block-shape lever cell beat the base beyond noise
-# (sweep.py::promote_flash) — the flash twin of comm/tuned.json.
-# Absent file -> the hand-picked (1024, 1024); TPU_PATTERNS_FLASH_TUNED
-# overrides the path (=/dev/null disables).
-FLASH_TUNED_PATH = os.path.join(os.path.dirname(__file__),
-                                "flash_tuned.json")
-DEFAULT_BLOCK_Q = 1024
-DEFAULT_BLOCK_K = 1024
-
-
-# (path, mtime) -> blocks: ModelConfig construction happens dozens of
-# times per process (every dataclasses.replace re-runs __post_init__),
-# so the tuned read is one stat + cache hit, not a JSON parse each time;
-# the mtime key keeps a same-process promotion (tests; the watcher
-# promotes cross-process) visible.
-_TUNED_CACHE: dict[tuple[str, float], tuple[int, int]] = {}
-
-
-def load_tuned_blocks() -> tuple[int, int]:
-    """(block_q, block_k) defaults: the promoted winners when a
-    measured run committed them, the hand-picked squares otherwise."""
-    import json
-
-    path = os.environ.get("TPU_PATTERNS_FLASH_TUNED", FLASH_TUNED_PATH)
-    try:
-        key = (path, os.path.getmtime(path))
-    except OSError:
-        return (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
-    cached = _TUNED_CACHE.get(key)
-    if cached is not None:
-        return cached
-    try:
-        with open(path) as f:
-            tuned = json.load(f)
-        blocks = (int(tuned.get("block_q", DEFAULT_BLOCK_Q)),
-                  int(tuned.get("block_k", DEFAULT_BLOCK_K)))
-    except (OSError, ValueError):
-        blocks = (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
-    _TUNED_CACHE[key] = blocks
-    return blocks
-
-
-def _vmem_estimate(bq: int, bk: int, d: int, in_bytes: int,
-                   score_tiles: int) -> int:
-    """Predicted VMEM working set of one kernel instance at (bq, bk).
-    ``score_tiles`` counts the live f32 [bq, bk] temporaries of the
-    kernel body (2 for the forward's s/p, 4 for the backward's
-    s/p/dp/ds).  The hardware ladder checks this model against Mosaic's
-    actual accept/reject at the budget boundary
-    (:func:`vmem_boundary_probe`)."""
-    score = score_tiles * bq * bk * 4
-    # in/out blocks (q-sized + 2 k-sized inputs, q-sized out) double-
-    # buffered by the pipeline, + f32 accumulator scratch + stats.
-    io = 2 * ((bq + 2 * bk) * d * in_bytes + bq * d * 4)
-    scratch = (bq + bk) * d * 4 + 2 * bq * LANES * 4
-    return score + io + scratch
-
-
-def _auto_block(lq: int, lk: int, d: int, in_bytes: int, score_tiles: int,
-                block_q: int, block_k: int) -> tuple[int, int]:
-    """Largest (block_q, block_k) pair <= the requested sizes whose VMEM
-    working set (:func:`_vmem_estimate`) fits the budget."""
-
-    def est(bq: int, bk: int) -> int:
-        return _vmem_estimate(bq, bk, d, in_bytes, score_tiles)
-
-    bq, bk = min(block_q, lq), min(block_k, lk)
-    while est(bq, bk) > VMEM_BUDGET and max(bq, bk) > 128:
-        if bq >= bk:
-            bq //= 2
-        else:
-            bk //= 2
-    return max(bq, 128) if lq >= 128 else bq, max(bk, 128) if lk >= 128 else bk
+# The block-size auto-tuner (VMEM working-set model + shrink-to-fit
+# ladder + promoted defaults) moved to longctx/tuning.py so the serve
+# paged-attention kernel tunes against the same budget; re-exported here
+# because this module was its historical home (ModelConfig and the
+# sweep promoter import from flash).
+from tpu_patterns.longctx.tuning import (  # noqa: F401
+    DEFAULT_BLOCK_K,
+    DEFAULT_BLOCK_Q,
+    FLASH_TUNED_PATH,
+    LANES,
+    NEG_INF,
+    VMEM_BUDGET,
+    _auto_block,
+    _vmem_estimate,
+    load_tuned_blocks,
+)
 
 
 # Every kernel here runs a (head, block-row, accumulation) grid: the

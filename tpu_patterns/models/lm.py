@@ -169,6 +169,84 @@ def sharded_topk_sample(logits_local, key, temperature, k, tp_axis):
     return jnp.take_along_axis(cands, choice[..., None], axis=-1)[..., 0]
 
 
+# Candidate-list width for per-row seeded sampling: every rank keeps its
+# local top-64, so any top-k/top-p truncation up to 64 survivors is
+# exact and the gathered lists stay tiny (64 * tp f32+i32 per row).
+SAMPLE_CANDIDATES = 64
+
+
+def sample_token_rows(
+    logits_local, seeds, gidx, temp, topk, topp, tp_axis,
+    cap: int = SAMPLE_CANDIDATES,
+):
+    """Per-ROW seeded temperature/top-k/top-p sampling over the sharded
+    vocab — the one sampler behind BOTH the fused serve decode cores
+    (serve/paged.py) and the dense per-request oracle (make_lm_decoder),
+    so "fixed-seed-oracle-identical" is an identity of code, not a
+    numerical accident.
+
+    ``seeds``/``gidx``/``temp``/``topk``/``topp`` are [B] per-row: row
+    b's draw is keyed ``fold_in(fold_in(key(0), seeds[b]), gidx[b])``
+    where ``gidx`` is the request's GLOBAL generated-token index — the
+    replay rule.  The key depends on nothing else (not the mesh, not the
+    scheduler's batching, not which attention backend ran), so the same
+    (seed, index) always draws the same token.  Rows with
+    ``temp[b] <= 0`` return the greedy id (same tie-break as
+    :func:`sharded_argmax`), making greedy requests bit-identical to the
+    unsampled cores.
+
+    Mechanics: each rank's local top-``cap`` candidates are gathered
+    (tiled — the ONE collective this adds, declared in
+    ``SAMPLED_DECODE_DECLARED_COLLECTIVES``), canonicalized to the
+    global top-``cap`` by (value desc, id asc) — a layout-stable order —
+    then top-k masks by candidate rank, top-p masks by exclusive
+    cumulative probability (rank 0 always survives), and a per-row
+    Gumbel-max draw picks the token.  Every rank holds identical
+    candidates and identical keys, so every rank agrees without a
+    further collective."""
+    f32 = logits_local.astype(jnp.float32)
+    vloc = f32.shape[-1]
+    off = _my_offset(vloc, tp_axis)
+    vals, idx = lax.top_k(f32, min(cap, vloc))
+    gids = idx.astype(jnp.int32) + off
+    if tp_axis is not None:
+        vals = lax.all_gather(vals, tp_axis, axis=-1, tiled=True)
+        gids = lax.all_gather(gids, tp_axis, axis=-1, tiled=True)
+    # canonical candidate order: id-ascending, then STABLE value-
+    # descending, truncated to cap => the global top-cap by (value desc,
+    # id asc) on EVERY tp layout (top_k's value order is not layout-
+    # stable under ties; global ids are)
+    ordi = jnp.argsort(gids, axis=-1)
+    vals = jnp.take_along_axis(vals, ordi, axis=-1)
+    gids = jnp.take_along_axis(gids, ordi, axis=-1)
+    ordv = jnp.argsort(-vals, axis=-1, stable=True)
+    vals = jnp.take_along_axis(vals, ordv, axis=-1)[:, :cap]
+    gids = jnp.take_along_axis(gids, ordv, axis=-1)[:, :cap]
+    greedy = gids[:, 0]
+    c = vals.shape[-1]
+    scaled = vals / jnp.maximum(temp, 1e-6)[:, None]
+    # nucleus mask on the temperature-adjusted distribution: exclusive
+    # cumsum < topp keeps the smallest prefix reaching topp mass (and
+    # always rank 0); topk masks by candidate rank; 0/>=1 disable
+    probs = jax.nn.softmax(scaled, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1) - probs
+    rank = jnp.arange(c, dtype=jnp.int32)[None, :]
+    keep = ((topp[:, None] >= 1.0) | (cum < topp[:, None])) & (
+        (topk[:, None] <= 0) | (rank < topk[:, None])
+    )
+    masked = jnp.where(keep, scaled, -1e30)
+    base = jax.random.key(0)
+    keys = jax.vmap(
+        lambda s, g: jax.random.fold_in(jax.random.fold_in(base, s), g)
+    )(seeds.astype(jnp.int32), gidx.astype(jnp.int32))
+    gum = jax.vmap(
+        lambda k: jax.random.gumbel(k, (c,), jnp.float32)
+    )(keys)
+    choice = jnp.argmax(masked + gum, axis=-1)
+    sampled = jnp.take_along_axis(gids, choice[:, None], axis=-1)[:, 0]
+    return jnp.where(temp > 0, sampled, greedy)
+
+
 def lm_param_specs(cfg: ModelConfig, n_experts: int = 0) -> dict[str, P]:
     """Block specs + the tied embedding table, vocab-sharded over tp."""
     specs = {k: s for k, (_, s) in param_specs(cfg, n_experts).items()}
@@ -485,6 +563,14 @@ def make_lm_decoder(
     in (caches, tok) alone).  The whole rollout is one compiled scan;
     tokens never leave the device.
 
+    Both cores also accept ``sample_rows=(seeds, gidx, temp, topk,
+    topp)`` — [batch] arrays — to run the per-ROW fixed-seed sampler
+    (:func:`sample_token_rows`, the serve cores' replay rule): prefill
+    emits each row's generated token ``gidx[b]`` keyed
+    ``(seeds[b], gidx[b])``, generate's step n emits token
+    ``gidx[b] + n + 1``.  This is the dense per-request ORACLE for the
+    engine's stochastic streams.
+
     ``cfg.attn_layout="striped"`` decodes over the striped cache layout
     (prompt tokens arrive pre-striped, x_global[:, r::sp] per shard —
     the training data contract); ``cfg.moe=True`` generates through the
@@ -521,7 +607,7 @@ def make_lm_decoder(
     def _logits_last(wemb, y):  # y [B, 1, E] -> [B, V/tp]
         return jnp.einsum("be,ve->bv", y[:, 0, :], wemb)
 
-    def prefill_shard(params, tokens, lens, seed, *, temperature, top_k):
+    def _prefill_core(params, tokens, lens):
         blocks, wemb = _split(params)
         x = embed_tokens(wemb, tokens, tp_axis).astype(
             jnp.dtype(cfg.dtype)
@@ -541,6 +627,10 @@ def make_lm_decoder(
         )
         y, cache = lax.scan(layer, x, (blocks, zeros))
         y_last = D._gather_last_valid(y, lens, layout, sp_axis)
+        return cache, _logits_last(wemb, y_last)
+
+    def prefill_shard(params, tokens, lens, seed, *, temperature, top_k):
+        cache, logits = _prefill_core(params, tokens, lens)
         # the FIRST continuation token samples too; fold index 2^31-1
         # marks the pre-generation draw, distinct from every scan step's
         # fold n (fold data must be non-negative)
@@ -548,34 +638,47 @@ def make_lm_decoder(
             jax.random.fold_in(jax.random.key(seed), 0x7FFFFFFF),
             lax.axis_index("dp"),
         )
-        logits = _logits_last(wemb, y_last)
         if top_k > 0 and temperature > 0:
             tok = sharded_topk_sample(logits, key, temperature, top_k, tp_axis)
         else:
             tok = sharded_sample(logits, key, temperature, tp_axis)
         return cache, tok
 
+    def prefill_shard_rows(params, tokens, lens, seeds, gidx, temp,
+                           topk, topp):
+        # per-ROW fixed-seed sampling (the serve cores' replay rule):
+        # the prefill emits the request's generated token ``gidx[b]``,
+        # keyed (seeds[b], gidx[b]) — nothing else
+        cache, logits = _prefill_core(params, tokens, lens)
+        return cache, sample_token_rows(
+            logits, seeds, gidx, temp, topk, topp, tp_axis
+        )
+
+    def _decode_one(params, cache, tok, lens, n):
+        blocks, wemb = _split(params)
+        x = embed_tokens(wemb, tok[:, None], tp_axis).astype(
+            jnp.dtype(cfg.dtype)
+        )
+
+        def layer(c2, xs):
+            yy = c2
+            p_l, c_l = xs
+            yy, c_l = D._decode_layer(
+                p_l, yy, c_l, lens, n, layout, lcfg, sp_axis, tp_axis
+            )
+            return yy, c_l
+
+        y2, cache = lax.scan(layer, x, (blocks, cache))
+        return cache, _logits_last(wemb, y2)
+
     def generate_shard(
         params, cache, tok0, lens, n0, seed, *, n_steps, temperature, top_k
     ):
-        blocks, wemb = _split(params)
         base_key = jax.random.key(seed)
 
         def step(carry, _):
             cache, tok, n = carry
-            x = embed_tokens(wemb, tok[:, None], tp_axis).astype(
-                jnp.dtype(cfg.dtype)
-            )
-
-            def layer(c2, xs):
-                yy = c2
-                p_l, c_l = xs
-                yy, c_l = D._decode_layer(
-                    p_l, yy, c_l, lens, n, layout, lcfg, sp_axis, tp_axis
-                )
-                return yy, c_l
-
-            y2, cache = lax.scan(layer, x, (blocks, cache))
+            cache, logits = _decode_one(params, cache, tok, lens, n)
             # per-step key, folded with the dp rank (each batch shard
             # must draw DIFFERENT noise); sp ranks share the key and
             # agree on the draw.  Full-softmax sampling folds the tp
@@ -583,13 +686,33 @@ def make_lm_decoder(
             step_key = jax.random.fold_in(
                 jax.random.fold_in(base_key, n), lax.axis_index("dp")
             )
-            logits = _logits_last(wemb, y2)
             if top_k > 0 and temperature > 0:
                 nxt = sharded_topk_sample(
                     logits, step_key, temperature, top_k, tp_axis
                 )
             else:
                 nxt = sharded_sample(logits, step_key, temperature, tp_axis)
+            return (cache, nxt, n + 1), nxt
+
+        (cache, _, _), toks = lax.scan(
+            step, (cache, tok0, n0), None, length=n_steps
+        )
+        return cache, toks.transpose(1, 0)  # [B, n_steps]
+
+    def generate_shard_rows(
+        params, cache, tok0, lens, n0, seeds, gidx, temp, topk, topp,
+        *, n_steps,
+    ):
+        # per-ROW fixed-seed rollout: the step at carry n emits the
+        # request's generated token gidx + n + 1 (the prefill emitted
+        # gidx), so each draw's key is its stream position — identical
+        # to the serve cores' keys for the same (seed, index)
+        def step(carry, _):
+            cache, tok, n = carry
+            cache, logits = _decode_one(params, cache, tok, lens, n)
+            nxt = sample_token_rows(
+                logits, seeds, gidx + n + 1, temp, topk, topp, tp_axis
+            )
             return (cache, nxt, n + 1), nxt
 
         (cache, _, _), toks = lax.scan(
@@ -614,10 +737,38 @@ def make_lm_decoder(
             )
         )
 
+    @functools.lru_cache(maxsize=None)
+    def _prefill_rows_compiled():
+        return jax.jit(
+            jax.shard_map(
+                prefill_shard_rows,
+                mesh=mesh,
+                in_specs=(
+                    pspecs, P("dp", "sp"), lens_spec,
+                    tok_spec, tok_spec, tok_spec, tok_spec, tok_spec,
+                ),
+                out_specs=(cache_specs, tok_spec),
+                check_vma=False,
+            )
+        )
+
+    def _rows_arrays(sample_rows):
+        seeds, gidx, temp, topk, topp = sample_rows
+        return (
+            jnp.asarray(seeds, jnp.int32), jnp.asarray(gidx, jnp.int32),
+            jnp.asarray(temp, jnp.float32), jnp.asarray(topk, jnp.int32),
+            jnp.asarray(topp, jnp.float32),
+        )
+
     def prefill(params, tokens, lens=None, temperature=0.0, seed=0,
-                top_k=0):
+                top_k=0, sample_rows=None):
         if lens is None:
             lens = jnp.full((batch,), prefill_len, jnp.int32)
+        if sample_rows is not None:
+            return _prefill_rows_compiled()(
+                _stacked(params), tokens, jnp.asarray(lens, jnp.int32),
+                *_rows_arrays(sample_rows),
+            )
         return _prefill_compiled(float(temperature), int(top_k))(
             _stacked(params), tokens, jnp.asarray(lens, jnp.int32),
             jnp.asarray(seed, jnp.uint32),
@@ -652,14 +803,35 @@ def make_lm_decoder(
                 out[k] = v if cfg.depth > 1 else v[None]
         return out
 
+    @functools.lru_cache(maxsize=None)
+    def _gen_rows_compiled(n_steps: int):
+        return jax.jit(
+            jax.shard_map(
+                functools.partial(generate_shard_rows, n_steps=n_steps),
+                mesh=mesh,
+                in_specs=(
+                    pspecs, cache_specs, tok_spec, lens_spec, P(),
+                    tok_spec, tok_spec, tok_spec, tok_spec, tok_spec,
+                ),
+                out_specs=(cache_specs, tok_spec),
+                check_vma=False,
+            ),
+        )
+
     def generate(params, caches, tok, t0, n_steps, temperature=0.0,
-                 seed=0, top_k=0):
+                 seed=0, top_k=0, sample_rows=None):
         if isinstance(t0, tuple):
             lens, n0 = t0
             lens = jnp.asarray(lens, jnp.int32)
         else:
             lens = jnp.full((batch,), prefill_len, jnp.int32)
             n0 = jnp.asarray(t0, jnp.int32) - prefill_len
+        if sample_rows is not None:
+            return _gen_rows_compiled(int(n_steps))(
+                _stacked(params), caches,
+                jnp.asarray(tok, jnp.int32), lens,
+                jnp.asarray(n0, jnp.int32), *_rows_arrays(sample_rows),
+            )
         return _gen_compiled(int(n_steps), float(temperature), int(top_k))(
             _stacked(params), caches,
             jnp.asarray(tok, jnp.int32), lens, jnp.asarray(n0, jnp.int32),

@@ -88,6 +88,8 @@ EXECUTABLES = (
     "decoder.prefill",
     "decoder.step",
     "decoder.verify",
+    "decoder.step_pallas",
+    "decoder.verify_pallas",
     "copy_blocks",
     "serve.step",
     "serve.kv_tier",
@@ -242,7 +244,7 @@ def _spmd_zero_step():
     return step, (shards, opt, x)
 
 
-def _spmd_decoder():
+def _spmd_decoder(attn: str = "dense", sampling: bool = False):
     """Tiny paged decoder + canonical 2-row args, shared by the four
     decoder entries (same shape family as analysis/tracelint.py, but on
     the multi-device mesh so sp/tp collectives are real)."""
@@ -256,7 +258,8 @@ def _spmd_decoder():
     mesh = _spmd_mesh3d()
     mcfg = _spmd_mcfg()
     dec = make_paged_lm_decoder(
-        mesh, mcfg, _SPMD_VOCAB, n_blocks=5, block_len=4, max_len=12
+        mesh, mcfg, _SPMD_VOCAB, n_blocks=5, block_len=4, max_len=12,
+        attn=attn, sampling=sampling,
     )
     flat = init_lm_params(
         jax.random.key(0), mcfg, _SPMD_VOCAB, _n_experts(mesh, mcfg)
@@ -297,6 +300,48 @@ def _spmd_decoder_verify():
     return dec.verify_jit(rows, width), (
         params, pool, jnp.zeros((rows, width), jnp.int32), lens, zeros,
         jnp.full((rows,), width - 1, jnp.int32), tables, active,
+    )
+
+
+def _spmd_decoder_step_pallas():
+    dec, params, pool, rows, tables, lens, zeros, active = _spmd_decoder(
+        attn="pallas"
+    )
+    return dec.step_jit(rows), (
+        params, pool, zeros, lens, zeros, tables, active,
+    )
+
+
+def _spmd_decoder_verify_pallas():
+    import jax.numpy as jnp
+
+    dec, params, pool, rows, tables, lens, zeros, active = _spmd_decoder(
+        attn="pallas"
+    )
+    width = 3
+    return dec.verify_jit(rows, width), (
+        params, pool, jnp.zeros((rows, width), jnp.int32), lens, zeros,
+        jnp.full((rows,), width - 1, jnp.int32), tables, active,
+    )
+
+
+def _spmd_decoder_step_sampled():
+    """The fused-sampling step core: seeds/temps ride in as replicated
+    rows, the only extra collective is the candidate all_gather over
+    tp (SAMPLED_DECODE_DECLARED_COLLECTIVES declares it)."""
+    import jax.numpy as jnp
+
+    dec, params, pool, rows, tables, lens, zeros, active = _spmd_decoder(
+        sampling=True
+    )
+    seeds = jnp.asarray([3, 7], jnp.int32)
+    gidx = jnp.asarray([0, 2], jnp.int32)
+    temp = jnp.asarray([0.8, 0.0], jnp.float32)
+    topk = jnp.asarray([4, 0], jnp.int32)
+    topp = jnp.asarray([0.9, 1.0], jnp.float32)
+    return dec.step_jit(rows), (
+        params, pool, zeros, lens, zeros, tables, active,
+        seeds, gidx, temp, topk, topp,
     )
 
 
@@ -382,7 +427,10 @@ def spmd_entries() -> tuple:
     """The Tier C enumeration: every registered jitted entry point.
     The decode collective budget is declared next to the cores
     (serve/paged.py DECODE_DECLARED_COLLECTIVES)."""
-    from tpu_patterns.serve.paged import DECODE_DECLARED_COLLECTIVES
+    from tpu_patterns.serve.paged import (
+        DECODE_DECLARED_COLLECTIVES,
+        SAMPLED_DECODE_DECLARED_COLLECTIVES,
+    )
 
     builtin = (
         SpmdEntry(
@@ -405,6 +453,25 @@ def spmd_entries() -> tuple:
             "decoder.verify", _SERVE_AXES, _spmd_decoder_verify,
             hot=True, donates=True,
             declared_collectives=DECODE_DECLARED_COLLECTIVES,
+        ),
+        # the pallas paged-attention variants run the SAME collective
+        # budget: the kernel is rank-local, the sp combine stays outside
+        SpmdEntry(
+            "decoder.step_pallas", _SERVE_AXES, _spmd_decoder_step_pallas,
+            hot=True, donates=True,
+            declared_collectives=DECODE_DECLARED_COLLECTIVES,
+        ),
+        SpmdEntry(
+            "decoder.verify_pallas", _SERVE_AXES,
+            _spmd_decoder_verify_pallas,
+            hot=True, donates=True,
+            declared_collectives=DECODE_DECLARED_COLLECTIVES,
+        ),
+        SpmdEntry(
+            "decoder.step_sampled", _SERVE_AXES,
+            _spmd_decoder_step_sampled,
+            hot=True, donates=True,
+            declared_collectives=SAMPLED_DECODE_DECLARED_COLLECTIVES,
         ),
         SpmdEntry(
             "copy_blocks", _SERVE_AXES, _spmd_copy_blocks, donates=True,
@@ -575,7 +642,7 @@ def _capture_train(mesh, cfg: PerfConfig, *, zero: bool) -> dict:
     return metrics
 
 
-def _decoder(mesh, cfg: PerfConfig):
+def _decoder(mesh, cfg: PerfConfig, attn: str = "dense"):
     import jax
 
     from tpu_patterns.models.lm import init_lm_params
@@ -591,7 +658,7 @@ def _decoder(mesh, cfg: PerfConfig):
     decoder = make_paged_lm_decoder(
         mesh, mcfg, cfg.vocab,
         n_blocks=n_blocks, block_len=cfg.block_len, max_len=max_len,
-        cache_int8=cfg.cache_int8,
+        cache_int8=cfg.cache_int8, attn=attn,
     )
     flat = init_lm_params(
         jax.random.key(cfg.seed), mcfg, cfg.vocab, _n_experts(mesh, mcfg)
@@ -732,6 +799,92 @@ def _capture_decoder(mesh, cfg: PerfConfig) -> dict[str, dict]:
     m.update(cost_metrics(cpy, state["pool"], src, dst))
     m["step_ms"] = _timed_reps("copy_blocks", call_copy, cfg)
     out["copy_blocks"] = m
+    return out
+
+
+def _capture_decoder_pallas(mesh, cfg: PerfConfig) -> dict[str, dict]:
+    """decoder.step_pallas / decoder.verify_pallas — the fused
+    paged-attention kernel timed at the SAME shapes and analytic floors
+    as the dense gather legs, so ``perf diff`` reads the A/B directly
+    off two ratcheted rows.  Prefill is backend-independent (the ragged
+    write path never gathers), so only the hot cores get a twin."""
+    from tpu_patterns.models.transformer import cost_metrics
+    from tpu_patterns.perf import analytic
+
+    decoder, params, _flat, mcfg = _decoder(mesh, cfg, attn="pallas")
+    rng = np.random.RandomState(cfg.seed)
+    slots = cfg.slots
+    tables = _tables(decoder, slots)
+    active = np.ones((slots,), bool)
+    out: dict[str, dict] = {}
+    state = {"pool": decoder.init_pool()}  # donated: rethread every call
+
+    # seed real context through the backend-independent prefill so the
+    # timed kernels read live pages, not init zeros
+    lpad = cfg.max_prompt
+    tokens = rng.randint(0, cfg.vocab, size=(slots, lpad)).astype(np.int32)
+    lens_full = np.full((slots,), lpad, np.int32)
+    start0 = np.zeros((slots,), np.int32)
+    pre = decoder.prefill_jit(slots, lpad)
+    state["pool"], _tok0 = pre(
+        params, state["pool"], tokens, lens_full, start0, tables, active
+    )
+
+    tok = rng.randint(0, cfg.vocab, size=(slots,)).astype(np.int32)
+    steps0 = np.zeros((slots,), np.int32)
+    stp = decoder.step_jit(slots)
+
+    def call_step():
+        state["pool"], nxt = stp(
+            params, state["pool"], tok, lens_full, steps0, tables, active
+        )
+        return nxt
+
+    m = {
+        "analytic_flops": analytic.step_flops(
+            mcfg, cfg.vocab, slots, cfg.max_prompt
+        ),
+        "analytic_hbm_bytes": analytic.step_hbm_bytes(
+            mcfg, cfg.vocab, slots, cfg.max_prompt, cfg.cache_int8
+        ),
+    }
+    m.update(cost_metrics(
+        stp, params, state["pool"], tok, lens_full, steps0, tables, active
+    ))
+    m["step_ms"] = _timed_reps("decoder.step_pallas", call_step, cfg)
+    out["decoder.step_pallas"] = m
+
+    width = cfg.spec_width + 1
+    toks_w = rng.randint(0, cfg.vocab, size=(slots, width)).astype(
+        np.int32
+    )
+    n_draft = np.full((slots,), cfg.spec_width, np.int32)
+    ver = decoder.verify_jit(slots, width)
+
+    def call_verify():
+        state["pool"], o = ver(
+            params, state["pool"], toks_w, lens_full, steps0, n_draft,
+            tables, active,
+        )
+        return o
+
+    m = {
+        "analytic_flops": analytic.verify_flops(
+            mcfg, cfg.vocab, slots, width, cfg.max_prompt
+        ),
+        "analytic_hbm_bytes": float(
+            width * analytic.step_hbm_bytes(
+                mcfg, cfg.vocab, slots, cfg.max_prompt, cfg.cache_int8
+            )
+            - (width - 1) * analytic.param_bytes(mcfg, cfg.vocab)
+        ),  # params stream once for the whole wide step
+    }
+    m.update(cost_metrics(
+        ver, params, state["pool"], toks_w, lens_full, steps0, n_draft,
+        tables, active,
+    ))
+    m["step_ms"] = _timed_reps("decoder.verify_pallas", call_verify, cfg)
+    out["decoder.verify_pallas"] = m
     return out
 
 
@@ -919,6 +1072,11 @@ def capture(mesh, cfg: PerfConfig, writer=None) -> dict:
         say("perf capture: decoder prefill/step/verify + copy_blocks")
         dec = _capture_decoder(mesh, cfg)
         for n, m in dec.items():
+            if n in names:
+                executables[n] = m
+    if {n for n in names} & {"decoder.step_pallas", "decoder.verify_pallas"}:
+        say("perf capture: pallas decoder step/verify")
+        for n, m in _capture_decoder_pallas(mesh, cfg).items():
             if n in names:
                 executables[n] = m
     if "serve.step" in names:

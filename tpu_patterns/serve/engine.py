@@ -55,7 +55,10 @@ from tpu_patterns.serve.prefix import PrefixIndex
 # format 2: per-block refcounts, the prefix index, and slot prompts
 # joined the host-side state (PR 7) — older snapshots lack them and are
 # rejected loudly rather than resumed with silently-absent sharing state
-SNAPSHOT_FORMAT = 2
+# format 3: per-request sampling config (temperature/top_k/top_p/seed)
+# and the generated-token key offset joined both queue and active rows —
+# a resumed stochastic stream must keep drawing the same keys
+SNAPSHOT_FORMAT = 3
 
 
 def _bucket(n: int, cap: int) -> int:
@@ -93,6 +96,20 @@ class Request:
     # ladder sheds/preempts bulk first and touches interactive only
     # when the ladder exhausts (docs/robustness.md)
     priority: str = "interactive"
+    # per-request sampling config (honored only by a decoder built with
+    # ``sampling=True``; temperature 0 = greedy, bit-identical to the
+    # unsampled cores).  The draw key for the request's n-th generated
+    # token is (seed, gen_offset + n) and NOTHING else — not the mesh,
+    # not the batch it rode in, not the attention backend — so fixed-
+    # seed streams replay bit-identically.  ``gen_offset`` is the
+    # global index of the NEXT token to generate: 0 for fresh requests,
+    # advanced past the banked output when a preempted session
+    # re-queues so resume never re-draws (or skips) a key.
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+    gen_offset: int = 0
 
 
 @dataclasses.dataclass
@@ -115,6 +132,11 @@ class _Slot:
     deadline_ms: float = 0.0
     jid: str = ""  # fleet journey id (rides the lifecycle spans)
     priority: str = "interactive"  # interactive | bulk (preemptible)
+    temperature: float = 0.0  # per-request sampling config (see Request)
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+    gen_offset: int = 0  # global index of this row's next generated token
     t_admit_ns: int = 0
     t_first_ns: int = 0
     t_last_ns: int = 0
@@ -904,6 +926,12 @@ class ServeEngine:
                 rid=s.rid, tokens=ctx, n_gen=s.n_gen - len(s.out),
                 scenario=s.scenario, deadline_ms=s.deadline_ms,
                 jid=s.jid, priority="bulk",
+                temperature=s.temperature, top_k=s.top_k,
+                top_p=s.top_p, seed=s.seed,
+                # the banked tokens KEEP their draw indices: the forced
+                # session's key sequence continues exactly where the
+                # preempted stream stopped, never re-drawing one
+                gen_offset=s.gen_offset + len(s.out),
             ),
             s.t_submit_ns,
         ))
@@ -1158,6 +1186,9 @@ class ServeEngine:
                 own_blocks=own_blocks,
                 scenario=req.scenario, deadline_ms=req.deadline_ms,
                 jid=req.jid, priority=req.priority,
+                temperature=req.temperature, top_k=req.top_k,
+                top_p=req.top_p, seed=req.seed,
+                gen_offset=req.gen_offset,
                 t_admit_ns=now, slot=slot_tok,
             )
             self.inflight.acquire(req.rid, slot)
@@ -1189,6 +1220,27 @@ class ServeEngine:
         for i, s in enumerate(slots):
             t[i, : len(s.table)] = s.table
         return t
+
+    def _sampling_args(self, slots: list[_Slot], rows: int) -> tuple:
+        """The sampling cores' per-row (seeds, gidx, temp, topk, topp):
+        row i's next draw is keyed (seed, gen_offset + len(out)) — the
+        request's GLOBAL generated-token index, so the key depends on
+        the stream position alone, never on which wave/bucket/backend
+        served it.  Empty when the decoder has no sampling cores."""
+        if not getattr(self.decoder, "sampling", False):
+            return ()
+        seeds = np.zeros((rows,), np.int32)
+        gidx = np.zeros((rows,), np.int32)
+        temp = np.zeros((rows,), np.float32)
+        topk = np.zeros((rows,), np.int32)
+        topp = np.ones((rows,), np.float32)
+        for i, s in enumerate(slots):
+            seeds[i] = s.seed
+            gidx[i] = s.gen_offset + len(s.out)
+            temp[i] = s.temperature
+            topk[i] = s.top_k
+            topp[i] = s.top_p
+        return seeds, gidx, temp, topk, topp
 
     # -- compiled-call assembly ------------------------------------------
 
@@ -1239,7 +1291,7 @@ class ServeEngine:
             self._cow_copy()
             self.pool, tok0 = fn(
                 self.params, self.pool, tokens, lens, start, tables,
-                active,
+                active, *self._sampling_args(slots, rows),
             )
             # graftlint: allow[host-sync-in-hot-path] -- the scheduler's ONE designed sync per iteration: sampled ids must reach the host to retire/admit
             tok0 = np.asarray(tok0)
@@ -1297,7 +1349,8 @@ class ServeEngine:
             rows=len(self.active),
         ):
             self.pool, nxt = fn(
-                self.params, self.pool, tok, lens, steps, tables, active
+                self.params, self.pool, tok, lens, steps, tables, active,
+                *self._sampling_args(self.active, rows),
             )
             # graftlint: allow[host-sync-in-hot-path] -- the scheduler's ONE designed sync per iteration: sampled ids must reach the host to retire/admit
             nxt = np.asarray(nxt)
@@ -1388,6 +1441,7 @@ class ServeEngine:
             self.pool, out = fn(
                 self.params, self.pool, toks, lens, steps, n_draft,
                 tables, active,
+                *self._sampling_args(self.active, rows),
             )
             # graftlint: allow[host-sync-in-hot-path] -- the scheduler's ONE designed sync per iteration: verified ids must reach the host to accept/retire/admit
             out = np.asarray(out)
@@ -1510,7 +1564,9 @@ class ServeEngine:
             "fingerprint": self.fingerprint,
             "queue": [
                 {"rid": r.rid, "tokens": r.tokens, "n_gen": r.n_gen,
-                 "priority": r.priority}
+                 "priority": r.priority, "temperature": r.temperature,
+                 "top_k": r.top_k, "top_p": r.top_p, "seed": r.seed,
+                 "gen_offset": r.gen_offset}
                 for r, _ in self.queue
             ],
             "active": [
@@ -1519,6 +1575,9 @@ class ServeEngine:
                     "n_gen": s.n_gen, "table": s.table,
                     "last_tok": s.last_tok, "out": s.out,
                     "prompt": s.prompt, "priority": s.priority,
+                    "temperature": s.temperature, "top_k": s.top_k,
+                    "top_p": s.top_p, "seed": s.seed,
+                    "gen_offset": s.gen_offset,
                 }
                 for s in self.active
             ],
@@ -1642,7 +1701,11 @@ class ServeEngine:
         self.queue = [
             (Request(rid=q["rid"], tokens=list(q["tokens"]),
                      n_gen=q["n_gen"],
-                     priority=q.get("priority", "interactive")), now)
+                     priority=q.get("priority", "interactive"),
+                     temperature=q.get("temperature", 0.0),
+                     top_k=q.get("top_k", 0), top_p=q.get("top_p", 1.0),
+                     seed=q.get("seed", 0),
+                     gen_offset=q.get("gen_offset", 0)), now)
             for q in state["queue"]
         ]
         self.active = [
@@ -1652,6 +1715,10 @@ class ServeEngine:
                 last_tok=a["last_tok"], out=list(a["out"]),
                 t_submit_ns=now, prompt=list(a["prompt"]),
                 priority=a.get("priority", "interactive"),
+                temperature=a.get("temperature", 0.0),
+                top_k=a.get("top_k", 0), top_p=a.get("top_p", 1.0),
+                seed=a.get("seed", 0),
+                gen_offset=a.get("gen_offset", 0),
                 slot=self.slot_pool.lease(),
             )
             for a in state["active"]
@@ -1933,6 +2000,15 @@ class ServeConfig:
     rope: bool = True
     kv_heads: int = 0
     cache_int8: bool = False
+    # decode-attention backend: "dense" gathers hot KV blocks into a
+    # dense window and runs the batch attention math; "pallas" runs the
+    # fused paged-attention kernel (serve/paged_kernel.py — block
+    # tables consumed in-kernel via scalar prefetch; interpret mode
+    # off-TPU).  Greedy ids are bit-identical either way — the measured
+    # run with "pallas" therefore gates the kernel against the same
+    # dense per-request oracle.  Stays IN the resume fingerprint: a
+    # resumed run must re-drive the executable it snapshotted under.
+    paged_attn: str = "dense"
     slots: int = 8  # active-set ceiling (decode bucket cap)
     block_len: int = 16  # pool block size in token slots
     n_blocks: int = 0  # pool blocks incl. trash; 0 = auto (~3/4 of dense)
@@ -2085,6 +2161,52 @@ def _dense_expected(mesh, sp, mcfg, cfg, flat_params, requests):
         if r.n_gen > 1:
             _, gen_ids = dgen(
                 flat_params, caches, t0_tok, (lens, 0), r.n_gen - 1
+            )
+            ids += np.asarray(gen_ids)[0].tolist()
+        want[r.rid] = ids
+    return want
+
+
+def _oracle_expected(
+    mesh, sp, mcfg, vocab, flat_params, requests, *,
+    max_prompt, max_gen, cache_int8=False,
+):
+    """Per-request ground-truth ids from the dense batch-1 decoder —
+    greedy rows via the argmax rollout (byte-identical to
+    :func:`_dense_expected`), sampled rows via the SAME
+    ``sample_token_rows`` the serve cores fuse in, keyed
+    (request.seed, gen_offset + n).  Engine-independent: no paged pool,
+    no scheduler, no batching — the fixed-seed oracle every stochastic
+    exactness gate compares against."""
+    import jax.numpy as jnp
+
+    from tpu_patterns.models.lm import make_lm_decoder
+
+    lpd = max_prompt + (-max_prompt % sp)
+    gen_cap = max_gen + (-max_gen % sp)
+    dpre, dgen = make_lm_decoder(
+        mesh, mcfg, vocab, 1, lpd, gen_cap, cache_int8=cache_int8
+    )
+    want: dict[int, list[int]] = {}
+    for r in requests:
+        toks = np.zeros((1, lpd), np.int32)
+        toks[0, : len(r.tokens)] = r.tokens
+        lens = jnp.asarray([len(r.tokens)], jnp.int32)
+        rows = None
+        if r.temperature > 0:
+            rows = (
+                jnp.asarray([r.seed], jnp.int32),
+                jnp.asarray([r.gen_offset], jnp.int32),
+                jnp.asarray([r.temperature], jnp.float32),
+                jnp.asarray([r.top_k], jnp.int32),
+                jnp.asarray([r.top_p], jnp.float32),
+            )
+        caches, t0_tok = dpre(flat_params, toks, lens, sample_rows=rows)
+        ids = [int(np.asarray(t0_tok)[0])]
+        if r.n_gen > 1:
+            _, gen_ids = dgen(
+                flat_params, caches, t0_tok, (lens, 0), r.n_gen - 1,
+                sample_rows=rows,
             )
             ids += np.asarray(gen_ids)[0].tolist()
         want[r.rid] = ids
@@ -2340,7 +2462,7 @@ def _kv_tier_pool(mesh, cfg: ServeConfig, mcfg, flat_params):
     n_blocks = 2 + 3 * cfg.slots + 1  # + trash
     decoder = make_paged_lm_decoder(
         mesh, mcfg, cfg.vocab, n_blocks=n_blocks, block_len=bl,
-        max_len=5 * bl, cache_int8=cfg.cache_int8,
+        max_len=5 * bl, cache_int8=cfg.cache_int8, attn=cfg.paged_attn,
     )
     return decoder, decoder.stack_params(flat_params), n_blocks
 
@@ -2622,7 +2744,7 @@ def _prefix_record(mesh, sp, cfg, writer, flat_params, mcfg) -> object:
     decoder = make_paged_lm_decoder(
         mesh, mcfg, cfg.vocab, n_blocks=n_blocks,
         block_len=cfg.block_len, max_len=max_len,
-        cache_int8=cfg.cache_int8,
+        cache_int8=cfg.cache_int8, attn=cfg.paged_attn,
     )
     params = decoder.stack_params(flat_params)
     rng = np.random.RandomState(cfg.seed + 2)
@@ -2920,7 +3042,7 @@ def run_serve(mesh, cfg: ServeConfig, writer) -> list:
     decoder = make_paged_lm_decoder(
         mesh, mcfg, cfg.vocab,
         n_blocks=n_blocks, block_len=cfg.block_len, max_len=max_len,
-        cache_int8=cfg.cache_int8,
+        cache_int8=cfg.cache_int8, attn=cfg.paged_attn,
     )
     flat_params = init_lm_params(
         jax.random.key(cfg.seed), mcfg, cfg.vocab, _n_experts(mesh, mcfg)

@@ -56,7 +56,12 @@ from tpu_patterns.models.decode import (
     _stacked_specs,
     kv_slot_bytes,
 )
-from tpu_patterns.models.lm import embed_tokens, sharded_argmax
+from tpu_patterns.models.lm import (
+    embed_tokens,
+    sample_token_rows,
+    sharded_argmax,
+)
+from tpu_patterns.serve.paged_kernel import paged_attend
 from tpu_patterns.models.transformer import (
     ModelConfig,
     _check_kv_heads_shardable,
@@ -83,6 +88,15 @@ DECODE_DECLARED_COLLECTIVES = frozenset({
     ("pmax", ("tp",)),   # vocab-parallel greedy argmax (max half)
     ("pmin", ("tp",)),   # vocab-parallel greedy argmax (index tiebreak)
 })
+
+# The SAMPLED decode budget: in-kernel seeded sampling gathers each
+# rank's top candidates so every rank draws the identical token
+# (models/lm.py sample_token_rows) — ONE extra tiled all-gather over tp
+# per step, and nothing else.  A separate set so the greedy cores keep
+# the tighter declaration.
+SAMPLED_DECODE_DECLARED_COLLECTIVES = DECODE_DECLARED_COLLECTIVES | {
+    ("all_gather", ("tp",)),
+}
 
 
 class PagedLayout:
@@ -242,12 +256,16 @@ def _paged_prefill_layer(
 
 
 def _paged_decode_layer(
-    p_l, x, pool_l, pos, active, tables, layout, cfg, sp_axis, tp_axis
+    p_l, x, pool_l, pos, active, tables, layout, cfg, sp_axis, tp_axis,
+    attn="dense",
 ):
     """One layer for each active row's NEXT token.  x [B, 1, E]
     sp-replicated; ``pos`` [B] the incoming token's global position
     (``lens + steps`` — per-row step counts, nothing is lockstep);
-    writes go to the row's tail block, reads gather its page window."""
+    writes go to the row's tail block, reads gather its page window.
+    ``attn="pallas"`` swaps the gather → dense-attention round-trip for
+    the fused paged kernel (serve/paged_kernel.py) — same masking by
+    construction, same sp combine outside the kernel."""
     q, k, v = qkv_native(p_l, x)
     if cfg.rope:
         cos, sin = rope_tables(
@@ -267,14 +285,19 @@ def _paged_decode_layer(
         jnp.where(keep, o_loc, 0),
     )
 
-    n_pages = tables.shape[1]
-    posn = layout.page_positions(n_pages, sp_axis)
-    tvalid = jnp.repeat(tables > TRASH_BLOCK, layout.bl_loc, axis=1)
-    mask = (
-        (posn[None, :] <= pos[:, None]) & tvalid & active[:, None]
-    )  # [B, L_loc]
-    attn = _pool_attend(pool_l, q, tables, mask[:, None, :], layout, sp_axis)
-    o_ = jnp.einsum("blhd,hde->ble", attn, p_l["wo"])
+    if attn == "pallas":
+        att = paged_attend(pool_l, q, tables, pos, active, layout, sp_axis)
+    else:
+        n_pages = tables.shape[1]
+        posn = layout.page_positions(n_pages, sp_axis)
+        tvalid = jnp.repeat(tables > TRASH_BLOCK, layout.bl_loc, axis=1)
+        mask = (
+            (posn[None, :] <= pos[:, None]) & tvalid & active[:, None]
+        )  # [B, L_loc]
+        att = _pool_attend(
+            pool_l, q, tables, mask[:, None, :], layout, sp_axis
+        )
+    o_ = jnp.einsum("blhd,hde->ble", att, p_l["wo"])
     if tp_axis is not None:
         o_ = lax.psum(o_, tp_axis)
     y = x + o_
@@ -283,7 +306,7 @@ def _paged_decode_layer(
 
 def _paged_verify_layer(
     p_l, x, pool_l, pos0, n_draft, active, tables, layout, cfg,
-    sp_axis, tp_axis,
+    sp_axis, tp_axis, attn="dense",
 ):
     """One layer of the speculative WIDE step: x [B, W, E] holds each
     row's last committed token followed by up to ``n_draft`` drafted
@@ -331,15 +354,20 @@ def _paged_verify_layer(
         ob,
     )
 
-    posn = layout.page_positions(n_pages, sp_axis)  # [L_loc]
-    tvalid = jnp.repeat(tables > TRASH_BLOCK, layout.bl_loc, axis=1)
-    mask = (
-        (posn[None, None, :] <= pos[:, :, None])
-        & tvalid[:, None, :]
-        & active[:, None, None]
-    )  # [B, W, L_loc]
-    attn = _pool_attend(pool_l, q, tables, mask, layout, sp_axis)
-    o_ = jnp.einsum("blhd,hde->ble", attn, p_l["wo"])
+    if attn == "pallas":
+        att = paged_attend(
+            pool_l, q, tables, pos0, active, layout, sp_axis
+        )
+    else:
+        posn = layout.page_positions(n_pages, sp_axis)  # [L_loc]
+        tvalid = jnp.repeat(tables > TRASH_BLOCK, layout.bl_loc, axis=1)
+        mask = (
+            (posn[None, None, :] <= pos[:, :, None])
+            & tvalid[:, None, :]
+            & active[:, None, None]
+        )  # [B, W, L_loc]
+        att = _pool_attend(pool_l, q, tables, mask, layout, sp_axis)
+    o_ = jnp.einsum("blhd,hde->ble", att, p_l["wo"])
     if tp_axis is not None:
         o_ = lax.psum(o_, tp_axis)
     y = x + o_
@@ -388,8 +416,22 @@ class PagedDecoder:
     layout: PagedLayout
     n_pages: int  # table width: blocks covering the longest sequence
     cache_int8: bool = False
+    # attention backend for the decode/verify hot path: "dense" gathers
+    # the page window and reruns _distributed_attention, "pallas" runs
+    # the fused paged kernel (serve/paged_kernel.py; interpret mode off-
+    # TPU).  Prefill always runs the dense path — it is not the hot op.
+    attn: str = "dense"
+    # in-kernel sampling: the compiled cores take per-row
+    # (seeds, gidx, temp, topk, topp) and return SAMPLED ids through
+    # models/lm.py sample_token_rows (temp<=0 rows stay greedy).  False
+    # keeps every signature and jaxpr identical to the unsampled cores.
+    sampling: bool = False
 
     def __post_init__(self):
+        if self.attn not in ("dense", "pallas"):
+            raise ValueError(
+                f"attn must be 'dense' or 'pallas', got {self.attn!r}"
+            )
         if int(self.mesh.shape.get("dp", 1)) != 1:
             raise ValueError(
                 "serve shards the pool over sp/tp only — fold dp into sp "
@@ -541,7 +583,7 @@ class PagedDecoder:
                 f"({self.n_pages} blocks x {layout.block_len})"
             )
 
-        def body(params, pool, tokens, lens, start, tables, active):
+        def core(params, pool, tokens, lens, start, tables, active):
             blocks, wemb = self._split(params)
             x = embed_tokens(wemb, tokens, tp_axis).astype(
                 jnp.dtype(cfg.dtype)
@@ -560,8 +602,27 @@ class PagedDecoder:
             idx = jnp.clip(lens - 1, 0, prompt_len - 1)
             y_last = jnp.take_along_axis(y, idx[:, None, None], axis=1)
             logits = jnp.einsum("be,ve->bv", y_last[:, 0, :], wemb)
-            tok0 = sharded_argmax(logits, tp_axis)
-            return pool, jnp.where(active, tok0, 0)
+            return pool, logits
+
+        if self.sampling:
+            def body(params, pool, tokens, lens, start, tables, active,
+                     seeds, gidx, temp, topk, topp):
+                pool, logits = core(
+                    params, pool, tokens, lens, start, tables, active
+                )
+                tok0 = sample_token_rows(
+                    logits, seeds, gidx, temp, topk, topp, tp_axis
+                )
+                return pool, jnp.where(active, tok0, 0)
+            extra = (P(),) * 5
+        else:
+            def body(params, pool, tokens, lens, start, tables, active):
+                pool, logits = core(
+                    params, pool, tokens, lens, start, tables, active
+                )
+                tok0 = sharded_argmax(logits, tp_axis)
+                return pool, jnp.where(active, tok0, 0)
+            extra = ()
 
         pool_specs = self.pool_specs()
         return jax.jit(
@@ -570,7 +631,7 @@ class PagedDecoder:
                 mesh=self.mesh,
                 in_specs=(
                     self._param_specs(), pool_specs, P(), P(), P(), P(),
-                    P(),
+                    P(), *extra,
                 ),
                 out_specs=(pool_specs, P()),
                 check_vma=False,
@@ -583,7 +644,7 @@ class PagedDecoder:
         lcfg = dataclasses.replace(cfg, depth=1)
         sp_axis, tp_axis = self._axes()
 
-        def body(params, pool, tok, lens, steps, tables, active):
+        def core(params, pool, tok, lens, steps, tables, active):
             blocks, wemb = self._split(params)
             x = embed_tokens(wemb, tok[:, None], tp_axis).astype(
                 jnp.dtype(cfg.dtype)
@@ -595,14 +656,32 @@ class PagedDecoder:
                 p_l, pl_l = xs
                 y, pl_l = _paged_decode_layer(
                     p_l, y, pl_l, pos, active, tables, layout, lcfg,
-                    sp_axis, tp_axis,
+                    sp_axis, tp_axis, attn=self.attn,
                 )
                 return y, pl_l
 
             y, pool = lax.scan(layer, x, (blocks, pool))
-            logits = jnp.einsum("be,ve->bv", y[:, 0, :], wemb)
-            nxt = sharded_argmax(logits, tp_axis)
-            return pool, jnp.where(active, nxt, 0)
+            return pool, jnp.einsum("be,ve->bv", y[:, 0, :], wemb)
+
+        if self.sampling:
+            def body(params, pool, tok, lens, steps, tables, active,
+                     seeds, gidx, temp, topk, topp):
+                pool, logits = core(
+                    params, pool, tok, lens, steps, tables, active
+                )
+                nxt = sample_token_rows(
+                    logits, seeds, gidx, temp, topk, topp, tp_axis
+                )
+                return pool, jnp.where(active, nxt, 0)
+            extra = (P(),) * 5
+        else:
+            def body(params, pool, tok, lens, steps, tables, active):
+                pool, logits = core(
+                    params, pool, tok, lens, steps, tables, active
+                )
+                nxt = sharded_argmax(logits, tp_axis)
+                return pool, jnp.where(active, nxt, 0)
+            extra = ()
 
         pool_specs = self.pool_specs()
         return jax.jit(
@@ -611,7 +690,7 @@ class PagedDecoder:
                 mesh=self.mesh,
                 in_specs=(
                     self._param_specs(), pool_specs, P(), P(), P(), P(),
-                    P(),
+                    P(), *extra,
                 ),
                 out_specs=(pool_specs, P()),
                 check_vma=False,
@@ -624,7 +703,7 @@ class PagedDecoder:
         lcfg = dataclasses.replace(cfg, depth=1)
         sp_axis, tp_axis = self._axes()
 
-        def body(params, pool, toks, lens, steps, n_draft, tables, active):
+        def core(params, pool, toks, lens, steps, n_draft, tables, active):
             blocks, wemb = self._split(params)
             x = embed_tokens(wemb, toks, tp_axis).astype(
                 jnp.dtype(cfg.dtype)
@@ -636,17 +715,50 @@ class PagedDecoder:
                 p_l, pl_l = xs
                 y, pl_l = _paged_verify_layer(
                     p_l, y, pl_l, pos0, n_draft, active, tables, layout,
-                    lcfg, sp_axis, tp_axis,
+                    lcfg, sp_axis, tp_axis, attn=self.attn,
                 )
                 return y, pl_l
 
             y, pool = lax.scan(layer, x, (blocks, pool))
-            b = y.shape[0]
-            logits = jnp.einsum("bwe,ve->bwv", y, wemb)
-            out = sharded_argmax(
-                logits.reshape(b * width, -1), tp_axis
-            ).reshape(b, width)
-            return pool, jnp.where(active[:, None], out, 0)
+            return pool, jnp.einsum("bwe,ve->bwv", y, wemb)
+
+        if self.sampling:
+            def body(params, pool, toks, lens, steps, n_draft, tables,
+                     active, seeds, gidx, temp, topk, topp):
+                pool, logits = core(
+                    params, pool, toks, lens, steps, n_draft, tables,
+                    active,
+                )
+                b = logits.shape[0]
+                # position t of the wide step emits generated index
+                # gidx + t: EXACTLY the key the plain step would use
+                # after committing t tokens, so acceptance keeps the
+                # sampled stream bit-identical to plain decode
+                i = jnp.arange(width, dtype=jnp.int32)
+                out = sample_token_rows(
+                    logits.reshape(b * width, -1),
+                    jnp.repeat(seeds, width),
+                    (gidx[:, None] + i[None, :]).reshape(-1),
+                    jnp.repeat(temp, width),
+                    jnp.repeat(topk, width),
+                    jnp.repeat(topp, width),
+                    tp_axis,
+                ).reshape(b, width)
+                return pool, jnp.where(active[:, None], out, 0)
+            extra = (P(),) * 5
+        else:
+            def body(params, pool, toks, lens, steps, n_draft, tables,
+                     active):
+                pool, logits = core(
+                    params, pool, toks, lens, steps, n_draft, tables,
+                    active,
+                )
+                b = logits.shape[0]
+                out = sharded_argmax(
+                    logits.reshape(b * width, -1), tp_axis
+                ).reshape(b, width)
+                return pool, jnp.where(active[:, None], out, 0)
+            extra = ()
 
         pool_specs = self.pool_specs()
         return jax.jit(
@@ -655,7 +767,7 @@ class PagedDecoder:
                 mesh=self.mesh,
                 in_specs=(
                     self._param_specs(), pool_specs, P(), P(), P(), P(),
-                    P(), P(),
+                    P(), P(), *extra,
                 ),
                 out_specs=(pool_specs, P()),
                 check_vma=False,
@@ -781,6 +893,14 @@ class PagedDecoder:
             jnp.zeros((rows, self.n_pages), jnp.int32),
             jnp.zeros((rows,), bool),
         )
+        if self.sampling:
+            args += (
+                jnp.zeros((rows,), jnp.int32),
+                jnp.zeros((rows,), jnp.int32),
+                jnp.zeros((rows,), jnp.float32),
+                jnp.zeros((rows,), jnp.int32),
+                jnp.ones((rows,), jnp.float32),
+            )
         try:
             # analysis_compile, not a bare .compile(): a persistent-cache
             # hit deserializes the executable with alias bytes == 0, and
@@ -809,10 +929,14 @@ def make_paged_lm_decoder(
     block_len: int,
     max_len: int,
     cache_int8: bool = False,
+    attn: str = "dense",
+    sampling: bool = False,
 ) -> PagedDecoder:
     """Build the paged token decoder: ``n_blocks`` physical blocks of
     ``block_len`` slots (block 0 reserved as trash), tables sized to
-    cover ``max_len`` positions per sequence."""
+    cover ``max_len`` positions per sequence.  ``attn`` picks the
+    decode/verify attention backend (dense gather vs the fused Pallas
+    kernel); ``sampling`` compiles the per-row seeded-sampling cores."""
     layout = PagedLayout(n_blocks, block_len, int(mesh.shape["sp"]))
     return PagedDecoder(
         mesh=mesh,
@@ -821,4 +945,6 @@ def make_paged_lm_decoder(
         layout=layout,
         n_pages=layout.blocks_for(max_len),
         cache_int8=cache_int8,
+        attn=attn,
+        sampling=sampling,
     )

@@ -1,0 +1,331 @@
+"""Fused paged-attention decode: the serve hot op as a Pallas kernel.
+
+The dense path (``paged._pool_attend``) gathers each row's page window
+out of the pool into a fresh ``[B, Hkv, L_loc, D]`` HBM buffer, then
+reruns ``_distributed_attention`` over it — every decode step pays a
+pool-sized gather round-trip before a single FLOP of attention.  This
+kernel never materializes the window: the block TABLES ride in as
+scalar-prefetch operands and each grid step's BlockSpec index map reads
+them to stream ONE physical pool block (or a sub-tile of one) straight
+into VMEM, where the online-softmax statistics (running max, normalizer,
+unnormalized accumulator) accumulate in scratch across the page walk —
+the PagedAttention formulation on the flash-attention kernel skeleton
+(``longctx/flash.py``), sharing its block-size auto-tuner
+(``longctx/tuning.py``).
+
+Layout (everything LOCAL to one (sp, tp) shard, inside shard_map):
+
+* q [B, W, H, D] is regrouped to [B, Hkv, G*W, D] — G = H/Hkv query
+  heads per kv head, g-major rows (row r is query position ``r % W`` of
+  group ``r // W``) — so one grid step attends every query that reads a
+  given kv head with ONE [G*W, bk] score tile.  W is 1 for plain decode
+  and the draft width for the speculative wide step; both run this same
+  kernel (causality by global positions makes the wide step exact).
+* K/V pool leaves [n_blocks, bl_loc, Hkv, D] are indexed
+  ``tables[b, page]`` by the BlockSpec — the gather IS the pipeline.
+* int8 pools dequantize in-kernel: k's per-slot scale multiplies the
+  score tile, v's folds into the probabilities (AFTER the normalizer
+  accumulates, exactly like the dense path), so no f32 copy of the
+  quantized pool ever exists.
+* masking matches the dense layers: key position <= query position,
+  table entry not TRASH, row active.  Dead tiles (trash page, inactive
+  row, fully-future page) are skipped with ``pl.when`` — compute is
+  predicated off, the grid stays static.
+
+The kernel emits the per-shard partial (o, m, l) triple; the sp combine
+(pmax the max, rescale, psum normalizer + accumulator) happens OUTSIDE
+in :func:`paged_attend` with the same guarded math as
+``_distributed_attention`` — so the kernel path declares the same
+collective set as the dense path and shardlint's decode audit covers
+both.  On non-TPU backends the kernel runs in Pallas interpret mode
+(``runtime.use_interpret``), which is what keeps tier-1 on the CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpu_patterns.longctx.tuning import (
+    LANES,
+    NEG_INF,
+    _auto_block,
+    load_tuned_blocks,
+)
+from tpu_patterns.runtime import use_interpret
+
+TRASH_BLOCK = 0  # block 0 is the write sink (serve/paged.py contract)
+
+# grid = (row, kv head, page tile): rows and heads are independent; the
+# page-tile walk revisits the VMEM scratch accumulators and must run in
+# order.
+_DIM_SEMANTICS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary")
+)
+
+
+def _sds(shape, dtype, vma):
+    """ShapeDtypeStruct carrying the caller's varying-manual-axes when set
+    (required for pallas_call outputs inside shard_map)."""
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def block_tile(bl_loc: int, d: int, in_bytes: int, gw: int) -> int:
+    """Key-side tile size for one pool block's local slice: the shared
+    auto-tuner's pick, snapped DOWN to a divisor of ``bl_loc`` (pool
+    blocks are the physical unit — a tile must never straddle two).
+    Serve-shaped pools (block_len 8-64) fit whole blocks in one tile;
+    the ladder only engages for long-block layouts."""
+    _, bk = _auto_block(gw, bl_loc, d, in_bytes, 2, *load_tuned_blocks())
+    bk = min(bk, bl_loc)
+    while bl_loc % bk:
+        bk //= 2
+    return max(bk, 1)
+
+
+def _paged_kernel(
+    scale: float,
+    block_len: int,
+    bl_loc: int,
+    bk: int,
+    tpp: int,
+    w: int,
+    int8: bool,
+    # scalar prefetch
+    tabs_ref,  # [B, n_pages] physical block per (row, page)
+    aux_ref,   # [B, 3] (pos0, active, sp_rank) per row
+    *refs,
+):
+    if int8:
+        q_ref, k_ref, v_ref, ks_ref, vs_ref = refs[:5]
+        o_ref, m_ref, l_ref, m_scr, l_scr, acc_scr = refs[5:]
+    else:
+        q_ref, k_ref, v_ref = refs[:3]
+        o_ref, m_ref, l_ref, m_scr, l_scr, acc_scr = refs[3:]
+    b, t = pl.program_id(0), pl.program_id(2)
+    nt = pl.num_programs(2)
+    j, u = t // tpp, t % tpp  # page, sub-tile within the page
+
+    @pl.when(t == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    tab = tabs_ref[b, j]
+    pos0, act, rank = aux_ref[b, 0], aux_ref[b, 1], aux_ref[b, 2]
+    gw = m_scr.shape[0]
+    # the tile's first key position vs the row's LAST query position:
+    # a fully-future page has nothing any query may see
+    k_first = j * block_len + rank * bl_loc + u * bk
+    live = (tab != TRASH_BLOCK) & (act > 0) & (k_first <= pos0 + w - 1)
+
+    @pl.when(live)
+    def _tile():
+        q = q_ref[0, 0]  # [GW, D]
+        k = k_ref[0, :, 0, :]  # [bk, D]
+        s = lax.dot_general(
+            q, k.astype(jnp.float32) if int8 else k,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [GW, bk]
+        if int8:
+            s = s * ks_ref[0, :, 0][None, :]
+        # causal by GLOBAL positions: g-major row r is query w = r % W
+        # at position pos0 + w; key lane c sits at the page's global
+        # offset (+ this shard's stripe) + c
+        q_pos = pos0 + lax.broadcasted_iota(jnp.int32, (gw, bk), 0) % w
+        k_pos = k_first + lax.broadcasted_iota(jnp.int32, (gw, bk), 1)
+        s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        m_prev = m_scr[:, 0:1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # rows with nothing unmasked yet keep exp() exactly 0
+        p = jnp.exp(s - m_cur) * (m_cur > NEG_INF / 2)
+        alpha = jnp.exp(m_prev - m_cur)
+        # normalizer accumulates the UNSCALED probabilities; v's dequant
+        # scale folds in after (the dense _distributed_attention order)
+        l_cur = alpha * l_scr[:, 0:1] + jnp.sum(p, axis=-1, keepdims=True)
+        if int8:
+            p = p * vs_ref[0, :, 0][None, :]
+        v = v_ref[0, :, 0, :]  # [bk, D]
+        acc = alpha * acc_scr[:] + lax.dot(
+            p.astype(jnp.float32),
+            v.astype(jnp.float32) if int8 else v,
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_cur, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_cur, l_scr.shape)
+        acc_scr[:] = acc
+
+    @pl.when(t == nt - 1)
+    def _emit():
+        o_ref[0, 0] = acc_scr[:]
+        m_ref[0, 0] = m_scr[:, 0:1]
+        l_ref[0, 0] = l_scr[:, 0:1]
+
+
+def paged_block(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    tables: jax.Array,
+    pos0: jax.Array,
+    active: jax.Array,
+    *,
+    block_len: int,
+    rank,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
+    interpret: bool | None = None,
+):
+    """One shard's partial paged attention: returns the unnormalized
+    (o [B, Hkv, G*W, D] f32, m, l [B, Hkv, G*W]) triple for the sp
+    combine in :func:`paged_attend`.
+
+    q [B, W, H, D]; k/v are ONE layer's local pool leaves
+    [n_blocks, bl_loc, Hkv, D] (int8 with per-slot ``k_scale``/
+    ``v_scale`` [n_blocks, bl_loc, Hkv] when quantized); ``tables``
+    [B, n_pages] physical block ids; ``pos0`` [B] the global position of
+    each row's FIRST fed token; ``rank`` this shard's sp stripe index
+    (traced inside shard_map, 0 unsharded)."""
+    b, w, h, d = q.shape
+    n_blocks, bl_loc, hkv, _ = k.shape
+    g = h // hkv
+    gw = g * w
+    n_pages = tables.shape[1]
+    if interpret is None:
+        interpret = use_interpret()
+    int8 = k.dtype == jnp.int8
+    bk = block_tile(bl_loc, d, jnp.dtype(k.dtype).itemsize, gw)
+    tpp = bl_loc // bk
+
+    # [B, W, H, D] -> [B, Hkv, G*W, D], g-major rows (r = g * W + w) —
+    # the same head grouping as the dense qg reshape, one row block per
+    # kv head
+    qt = q.reshape(b, w, hkv, g, d).transpose(0, 2, 3, 1, 4)
+    qt = qt.reshape(b, hkv, gw, d)
+    aux = jnp.stack(
+        [
+            pos0.astype(jnp.int32),
+            active.astype(jnp.int32),
+            jnp.broadcast_to(jnp.asarray(rank, jnp.int32), pos0.shape),
+        ],
+        axis=1,
+    )
+    vma = getattr(jax.typeof(q), "vma", None)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, gw, d), lambda b, h, t, tabs, aux: (b, h, 0, 0)),
+        # the prefetched table IS the index map: grid step (b, h, t)
+        # streams sub-tile t % tpp of physical block tables[b, t // tpp]
+        pl.BlockSpec(
+            (1, bk, 1, d),
+            lambda b, h, t, tabs, aux: (tabs[b, t // tpp], t % tpp, h, 0),
+        ),
+        pl.BlockSpec(
+            (1, bk, 1, d),
+            lambda b, h, t, tabs, aux: (tabs[b, t // tpp], t % tpp, h, 0),
+        ),
+    ]
+    operands = [qt, k, v]
+    if int8:
+        in_specs += [
+            pl.BlockSpec(
+                (1, bk, 1),
+                lambda b, h, t, tabs, aux: (tabs[b, t // tpp], t % tpp, h),
+            ),
+            pl.BlockSpec(
+                (1, bk, 1),
+                lambda b, h, t, tabs, aux: (tabs[b, t // tpp], t % tpp, h),
+            ),
+        ]
+        operands += [k_scale, v_scale]
+
+    o, m, l = pl.pallas_call(
+        functools.partial(
+            _paged_kernel, d**-0.5, block_len, bl_loc, bk, tpp, w, int8
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, hkv, n_pages * tpp),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec(
+                    (1, 1, gw, d), lambda b, h, t, tabs, aux: (b, h, 0, 0)
+                ),
+                # stats carry a trailing singleton: Mosaic constrains the
+                # last two block dims, and (gw, 1) satisfies it where a
+                # 2-D (1, gw) block would not (the flash.py convention)
+                pl.BlockSpec(
+                    (1, 1, gw, 1), lambda b, h, t, tabs, aux: (b, h, 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, 1, gw, 1), lambda b, h, t, tabs, aux: (b, h, 0, 0)
+                ),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((gw, LANES), jnp.float32),
+                pltpu.VMEM((gw, LANES), jnp.float32),
+                pltpu.VMEM((gw, d), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            _sds((b, hkv, gw, d), jnp.float32, vma),
+            _sds((b, hkv, gw, 1), jnp.float32, vma),
+            _sds((b, hkv, gw, 1), jnp.float32, vma),
+        ],
+        interpret=interpret,
+        compiler_params=_DIM_SEMANTICS,
+    )(jnp.clip(tables, 0, n_blocks - 1).astype(jnp.int32), aux, *operands)
+    return o, m[..., 0], l[..., 0]
+
+
+def paged_attend(
+    pool_l: dict,
+    q: jax.Array,
+    tables: jax.Array,
+    pos0: jax.Array,
+    active: jax.Array,
+    layout,
+    sp_axis: str | None,
+    *,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Drop-in for ``paged._pool_attend`` on the decode/verify hot path:
+    attention of q [B, W, H, D] (query w of row b at global position
+    ``pos0[b] + w``) against the rows' page windows, fused.  Runs the
+    per-shard Pallas kernel, then combines the sp partials with the same
+    guarded online-softmax merge as ``_distributed_attention`` — pmax
+    the running max, rescale, psum normalizer and accumulator — so the
+    collective footprint matches the dense path's declared set."""
+    b, w, h, d = q.shape
+    o, m, l = paged_block(
+        q, pool_l["k"], pool_l["v"], tables, pos0, active,
+        block_len=layout.block_len,
+        rank=layout._rank(sp_axis),
+        k_scale=pool_l.get("ks"),
+        v_scale=pool_l.get("vs"),
+        interpret=interpret,
+    )
+    if sp_axis is not None:
+        m_g = jnp.maximum(lax.pmax(m, sp_axis), NEG_INF / 2)
+        alpha = jnp.exp(m - m_g)
+        l = lax.psum(l * alpha, sp_axis)
+        o = lax.psum(o * alpha[..., None], sp_axis)
+    else:
+        # same guard as the dense path: a row with NO visible slot keeps
+        # m == NEG_INF; clamping makes alpha exactly 0, out exactly 0
+        alpha = jnp.exp(m - jnp.maximum(m, NEG_INF / 2))
+        l = l * alpha
+        o = o * alpha[..., None]
+    out = o / jnp.maximum(l, 1e-30)[..., None]  # [B, Hkv, G*W, D]
+    hkv = out.shape[1]
+    out = out.reshape(b, hkv, h // hkv, w, d).transpose(0, 3, 1, 2, 4)
+    return out.reshape(b, w, h, d).astype(q.dtype)

@@ -381,6 +381,7 @@ def replica_main() -> int:
             mesh, mcfg, cfg["vocab"], n_blocks=cfg["n_blocks"],
             block_len=cfg["block_len"], max_len=cfg["max_len"],
             cache_int8=cfg["cache_int8"],
+            attn=cfg.get("paged_attn", "dense"),
         )
         # SAME seed in every replica -> bit-identical params -> a
         # rerouted request decodes to the same ids anywhere
@@ -1653,6 +1654,7 @@ def run_replicas(mesh, cfg, writer) -> list:
         "head_dim": cfg.head_dim, "mlp_mult": cfg.mlp_mult,
         "depth": cfg.depth, "dtype": cfg.dtype, "rope": cfg.rope,
         "kv_heads": cfg.kv_heads, "cache_int8": cfg.cache_int8,
+        "paged_attn": getattr(cfg, "paged_attn", "dense"),
         "slots": cfg.slots, "block_len": cfg.block_len,
         "n_blocks": n_blocks, "max_len": max_len, "seed": cfg.seed,
         "prefix_share": prefix_share, "spec_k": cfg.spec_k,

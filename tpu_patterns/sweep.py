@@ -517,6 +517,15 @@ def serve_specs(quick: bool = False) -> list[SweepSpec]:
             argv=("serve", *small, "--spec_k", "4"),
             env=env,
         ),
+        # fused paged-attention lever: same trace/dims as the base cell
+        # so serve.pallas_attn vs serve.continuous reads as a direct
+        # A/B; exactness stays gated (greedy ids are bit-identical
+        # across backends by construction)
+        SweepSpec(
+            name="serve.pallas_attn",
+            argv=("serve", *small, "--paged_attn", "pallas"),
+            env=env,
+        ),
         # tiered KV cache under load: the chat preset's working_set_mult
         # sizes the pool UNDER the concurrent working set (prompts
         # pinned at 26-30 tokens so every request needs exactly 5
