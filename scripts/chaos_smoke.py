@@ -59,7 +59,17 @@ heals itself, visibly:
       complete the whole trace with greedy ids bit-identical to dense
       decode (exact==1) and leak zero blocks (the loader drops the
       partial session's orphaned leaf chains rather than fabricate
-      coverage — completeness is the kv-tier smoke's restart gate).
+      coverage — completeness is the kv-tier smoke's restart gate);
+  (h) disagg handoff kill: a split fleet (``--replicas 3 --disagg
+      2:1``) whose prefill replica 0 is SIGKILLed MID-TRANSFER by an
+      injected ``disagg.transfer:kill:replica=0`` — the transfer site
+      fires before the spool write, so the kill leaves no partial
+      wire file; the parent must reroute the dead replica's pending
+      rows through the prefill-only ring (fresh prefill -> fresh
+      handoff), close the accounting identity (every request done or
+      failed, rerouted > 0), keep every completion — adopted ones
+      included — bit-identical to dense decode, and leak zero blocks
+      across BOTH pools.
 
 Zero dependencies beyond the package; exit 0 = pass.
 """
@@ -531,13 +541,72 @@ def main() -> int:
         return fail(f"evict-resume leaked {m.get('leaked_blocks')} "
                     "block(s)")
 
+    # (h) disagg handoff kill: SIGKILL prefill replica 0 mid-transfer
+    # (the ``disagg.transfer`` site fires before the spool write, so
+    # nothing is torn) — the parent reroutes its pending rows through
+    # the prefill-only ring and the A/B Record must still close the
+    # ledger: all requests accounted, rerouted > 0, exact, leak-free.
+    dg_jsonl = os.path.join(work, "disagg-kill.jsonl")
+    rc = _run(
+        "disagg-kill",
+        [*py, "--jsonl", dg_jsonl, "serve", "--dp", "1", "--tp", "2",
+         "--vocab", "64", "--embed", "64", "--head_dim", "8",
+         "--depth", "1", "--requests", "8", "--min_prompt", "4",
+         "--max_prompt", "16", "--gen", "8", "--slots", "4",
+         "--block_len", "8", "--replicas", "3", "--disagg", "2:1",
+         "--min_replica_speedup", "0",
+         "--replica_dir", os.path.join(work, "disagg-kill")],
+        _env("disagg.transfer:kill:replica=0:count=1"),
+    )
+    if rc != 0:
+        return fail("disagg-kill fleet run exited nonzero — a dead "
+                    "prefill replica is a reroute, not a crash")
+    with open(dg_jsonl) as f:
+        dg = [json.loads(ln) for ln in f if ln.strip()][-1]
+    m = dg.get("metrics", {})
+    print(f"  [disagg-kill] verdict={dg.get('verdict')} "
+          f"done={m.get('done_disagg')} failed={m.get('failed')} "
+          f"rerouted={m.get('rerouted')} "
+          f"transfers={m.get('transfers')} adopts={m.get('adopts')} "
+          f"exact={m.get('exact')} leaked={m.get('leaked_blocks')}",
+          flush=True)
+    if dg.get("verdict") == "FAILURE":
+        return fail(f"disagg-kill Record FAILED: {dg.get('notes')}")
+    if not m.get("rerouted", 0) > 0:
+        return fail("disagg-kill: the mid-transfer SIGKILL never "
+                    "forced a reroute off the dead prefill replica")
+    if (
+        m.get("done_disagg", 0) + m.get("failed", 0)
+        != m.get("requests")
+    ) or m.get("covered") != 1.0:
+        # done_disagg is the fleet's done_total: rerouted rows that
+        # finished on the surviving prefill replica count here, so
+        # the identity is done + failed == scheduled with the reroute
+        # trail gated separately above
+        return fail(
+            f"disagg-kill: accounting identity broken — done "
+            f"{m.get('done_disagg')} + failed {m.get('failed')} != "
+            f"{m.get('requests')} scheduled "
+            f"(covered={m.get('covered')})"
+        )
+    if not m.get("transfers", 0) >= 1:
+        return fail("disagg-kill: no handoff crossed the wire — the "
+                    "kill leg never exercised the transfer path")
+    if m.get("exact") != 1.0:
+        return fail("disagg-kill: a completion (adopted or rerouted) "
+                    "diverged from dense decode")
+    if m.get("leaked_blocks") != 0.0:
+        return fail(f"disagg-kill: {m.get('leaked_blocks')} block(s) "
+                    "leaked across the prefill/decode pools")
+
     print("chaos smoke: all gates passed "
           "(cell retry, worker fallback, preempt/resume exactness, "
           "verify-fault quarantine + refcount balance, "
           "chaos-under-load coverage + bounded p99, "
           "replica fail-over: kill + drain legs incl. fleet-metric "
           "identity + stitched cross-replica journeys, "
-          "mid-evict kill -> session-cache resume exactness)",
+          "mid-evict kill -> session-cache resume exactness, "
+          "disagg handoff kill -> prefill-ring reroute exactness)",
           flush=True)
     return 0
 

@@ -80,6 +80,29 @@ class TestParser:
         assert args.min_live_replicas == 1
         assert args.scenario == "diurnal:bulk_fraction=0.4"
 
+    def test_serve_disagg_flag_surface(self):
+        # the disagg split parses with its gate knob...
+        args = build_parser().parse_args([
+            "serve", "--replicas", "4", "--disagg", "2:2",
+            "--min_ttft_improvement", "1.05",
+        ])
+        assert args.disagg == "2:2"
+        assert args.min_ttft_improvement == 1.05
+        # ...and every malformed combo exits loudly at parse time:
+        # no fleet, bad grammar, P or D empty, P+D != N, elastic combo
+        for argv in (
+            ["serve", "--disagg", "1:1"],
+            ["serve", "--replicas", "2", "--disagg", "11"],
+            ["serve", "--replicas", "2", "--disagg", "1:1:1"],
+            ["serve", "--replicas", "2", "--disagg", "2:0"],
+            ["serve", "--replicas", "2", "--disagg", "0:2"],
+            ["serve", "--replicas", "4", "--disagg", "2:3"],
+            ["serve", "--replicas", "3", "--disagg", "2:1",
+             "--scenario", "diurnal", "--elastic_reserve", "1"],
+        ):
+            with pytest.raises(SystemExit):
+                main(argv)
+
     def test_config_fields_become_flags(self):
         args = build_parser().parse_args(["p2p", "--count", "123", "--dtype", "bfloat16"])
         assert args.count == 123 and args.dtype == "bfloat16"

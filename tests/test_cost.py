@@ -242,6 +242,27 @@ class TestExplain:
     def test_no_match_says_so(self):
         assert "no decisions" in explain_table([], key=None)
 
+    def test_handoff_decision_tells_the_disagg_story(self):
+        # `obs explain <rid>` on a disagg fleet: the booked handoff
+        # (src/dst/blocks inputs) and the journey.handoff instant both
+        # land in the request's story
+        led = DecisionLedger()
+        led.book(
+            "handoff", rid=3, jid="j-3",
+            rationale="prefill complete; KV blocks shipped",
+            src="0", dst="2", blocks=2, recompute=False,
+        )
+        obs.event("journey.handoff", jid="j-3", rid="3", src="0",
+                  replica="2")
+        entries = [dict(e) for e in obs.flight_recorder().snapshot()]
+        got = decision_entries(entries, key="3")
+        names = [e["name"] for e in got]
+        assert "decision.handoff" in names
+        assert "journey.handoff" in names
+        text = explain_table(entries, key="3")
+        assert "KV blocks shipped" in text
+        assert "dst=2" in text
+
 
 class TestEngineAttribution:
     """The integration contract on a real preempting run: every identity

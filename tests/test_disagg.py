@@ -1,0 +1,364 @@
+"""Disaggregated prefill/decode serving: the engine role split
+(serve/engine.py handoff/adopt waves), the KV-block wire built on the
+comm/p2p block stream (value-preserving involution, TRASH never
+shipped, adopted bytes bit-identical — int8 scales included), the
+refcount/free-list invariants across the wire, and the
+``disagg.transfer`` / ``disagg.adopt`` fault sites (transient ->
+retried; deterministic -> bounded recompute, never a torn block).
+
+Parent-side plumbing (lease movement, decode round-robin, the handoff
+decision/counters) is tested against fake replicas in test_replica.py;
+the CLI flag surface in test_cli.py; the metric names in test_obs.py.
+"""
+
+import dataclasses
+import tempfile
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from tpu_patterns import faults
+from tpu_patterns.models.lm import init_lm_params
+from tpu_patterns.models.transformer import ModelConfig, _n_experts
+from tpu_patterns.serve import (
+    Request,
+    ServeEngine,
+    TRASH_BLOCK,
+    make_paged_lm_decoder,
+)
+
+CFG = dict(embed=64, heads=8, head_dim=8, causal=True, dtype="float32")
+VOCAB = 64
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.configure(None)
+    yield
+    faults.configure(None)
+
+
+def _mesh(devices, shape):
+    n = int(np.prod(shape))
+    return Mesh(np.array(devices[:n]).reshape(shape), ("dp", "sp", "tp"))
+
+
+def _decoder_and_params(
+    mesh, mcfg, *, n_blocks=13, block_len=8, max_len=40,
+    cache_int8=False, seed=0,
+):
+    dec = make_paged_lm_decoder(
+        mesh, mcfg, VOCAB, n_blocks=n_blocks, block_len=block_len,
+        max_len=max_len, cache_int8=cache_int8,
+    )
+    flat = init_lm_params(
+        jax.random.key(seed), mcfg, VOCAB, _n_experts(mesh, mcfg)
+    )
+    return dec, dec.stack_params(flat)
+
+
+def _trace(n, min_p=3, max_p=20, max_gen=6, seed=1):
+    rng = np.random.RandomState(seed)
+    return [
+        Request(
+            rid=i,
+            tokens=rng.randint(
+                0, VOCAB, size=rng.randint(min_p, max_p + 1)
+            ).tolist(),
+            n_gen=int(rng.randint(1, max_gen + 1)),
+        )
+        for i in range(n)
+    ]
+
+
+def _copy(reqs):
+    return [dataclasses.replace(r) for r in reqs]
+
+
+class TestSiteRegistry:
+    def test_disagg_sites_registered_with_blocks_ctx(self):
+        for site in ("disagg.transfer", "disagg.adopt"):
+            assert site in faults.KNOWN_SITES
+        assert "blocks" in faults.MATCH_KEYS
+        (spec,) = faults.parse_spec("disagg.transfer:error:rid=3")
+        assert spec.match == (("rid", "3"),)
+        (spec,) = faults.parse_spec("disagg.adopt:error:replica=1")
+        assert spec.match == (("replica", "1"),)
+
+
+class TestRoleValidation:
+    def test_bad_role_rejected(self, devices):
+        mesh = _mesh(devices, (1, 1, 1))
+        dec, params = _decoder_and_params(mesh, ModelConfig(**CFG))
+        with pytest.raises(ValueError, match="role"):
+            ServeEngine(dec, params, slots=2, role="router")
+
+    def test_prefill_role_requires_spool_dir(self, devices):
+        mesh = _mesh(devices, (1, 1, 1))
+        dec, params = _decoder_and_params(mesh, ModelConfig(**CFG))
+        with pytest.raises(ValueError, match="spool_dir"):
+            ServeEngine(dec, params, slots=2, role="prefill")
+
+
+class TestBlockStream:
+    @pytest.mark.parametrize("cache_int8", [False, True])
+    def test_stream_round_trip_is_bit_identical(
+        self, devices, cache_int8
+    ):
+        # the wire collective: gathered blocks ride a donated
+        # double-ppermute around the sp ring — a real declared
+        # collective whose net permutation is the identity, so the
+        # payload lands bit-identical (int8 scale planes included)
+        mesh = _mesh(devices, (1, 2, 2))
+        dec, _ = _decoder_and_params(
+            mesh, ModelConfig(**CFG), cache_int8=cache_int8
+        )
+        k = 4
+        rng = np.random.RandomState(7)
+        vals = {}
+        for name, (shape, dt) in dec._pool_leaves().items():
+            s = (shape[0], k, *shape[2:])
+            if np.dtype(dt) == np.int8:
+                vals[name] = rng.randint(
+                    -128, 128, size=s
+                ).astype(np.int8)
+            else:
+                vals[name] = rng.randn(*s).astype(dt)
+        wire = dec.stream_jit(k)(
+            {n: np.asarray(v) for n, v in vals.items()}
+        )
+        for name, v in vals.items():
+            got = np.asarray(wire[name])
+            assert got.dtype == v.dtype
+            assert np.array_equal(got, v), name
+
+
+def _engine_pair(dec, params, spool, slots=3):
+    pre = ServeEngine(
+        dec, params, slots=slots, role="prefill", spool_dir=spool
+    )
+    de = ServeEngine(dec, params, slots=slots, role="decode")
+    return pre, de
+
+
+class TestEnginePairExactness:
+    """The tentpole invariant: prefill -> ship -> adopt -> decode ->
+    retire produces the SAME ids the unified engine produces, with the
+    refcount identity closed and nothing leaked on either side."""
+
+    @pytest.mark.parametrize("cache_int8", [False, True])
+    def test_split_matches_unified_bit_identically(
+        self, devices, cache_int8
+    ):
+        mesh = _mesh(devices, (1, 2, 2))
+        dec, params = _decoder_and_params(
+            mesh, ModelConfig(**CFG), cache_int8=cache_int8
+        )
+        reqs = _trace(6)
+        want = ServeEngine(dec, params, slots=3).run(_copy(reqs))
+        with tempfile.TemporaryDirectory() as spool:
+            pre, de = _engine_pair(dec, params, spool)
+            got = pre.run(_copy(reqs))
+            assert pre.leaked_blocks() == 0
+            # every multi-token request crossed the wire as a REAL
+            # payload; single-token rows retired at prefill
+            assert set(pre.handoffs) == {
+                r.rid for r in reqs if r.n_gen > 1
+            }
+            for m in pre.handoffs.values():
+                assert not m["recompute"]
+                assert m["blocks"] >= 1 and m["nbytes"] > 0
+            de.adopt_queue.extend(
+                pre.handoffs[r] for r in sorted(pre.handoffs)
+            )
+            got.update(de.run([]))
+        assert de.leaked_blocks() == 0
+        assert de.stats["adopts"] == len(pre.handoffs)
+        assert got == want
+
+    def test_shipped_payload_covers_exactly_the_prompt_blocks(
+        self, devices
+    ):
+        # TRASH is never shipped: the wire file holds exactly
+        # blocks_for(len(prompt)) blocks per leaf — the gather pads its
+        # bucket with TRASH reads, and the ship truncates them off
+        mesh = _mesh(devices, (1, 2, 1))
+        dec, params = _decoder_and_params(mesh, ModelConfig(**CFG))
+        reqs = _trace(4, max_gen=4, seed=3)
+        with tempfile.TemporaryDirectory() as spool:
+            pre, _ = _engine_pair(dec, params, spool)
+            pre.run(_copy(reqs))
+            lay = dec.layout
+            by_rid = {r.rid: r for r in reqs}
+            for rid, m in pre.handoffs.items():
+                n_ship = lay.blocks_for(len(by_rid[rid].tokens))
+                assert m["blocks"] == n_ship
+                with np.load(m["path"]) as data:
+                    for name in data.files:
+                        assert data[name].shape[1] == n_ship
+
+    def test_adopted_bytes_bit_identical_and_refcounts_close(
+        self, devices
+    ):
+        # int8 pool: the strictest wire — quantized planes AND float32
+        # scale planes must land bit-identical, and adoption must seat
+        # refcounts/free-list exactly like a local admission
+        mesh = _mesh(devices, (1, 2, 2))
+        dec, params = _decoder_and_params(
+            mesh, ModelConfig(**CFG), cache_int8=True
+        )
+        reqs = _trace(4, max_gen=5, seed=5)
+        with tempfile.TemporaryDirectory() as spool:
+            pre, de = _engine_pair(dec, params, spool)
+            pre.run(_copy(reqs))
+            shipped = {}
+            for rid, m in pre.handoffs.items():
+                with np.load(m["path"]) as data:
+                    shipped[rid] = {
+                        n: data[n].copy() for n in data.files
+                    }
+            de.adopt_queue.extend(
+                pre.handoffs[r] for r in sorted(pre.handoffs)
+            )
+            de._admit_adopts()
+            assert not de.adopt_queue
+            lay = dec.layout
+            adopted = set()
+            for s in de.active:
+                n_ship = lay.blocks_for(s.lens)
+                table = list(s.table[:n_ship])
+                adopted.update(table)
+                # refcount identity: every adopted block referenced
+                # exactly once, absent from the free list, never TRASH
+                for b in table:
+                    assert b != TRASH_BLOCK
+                    assert de.ref[b] == 1
+                # re-gather the adopted blocks: bytes across the wire
+                # must equal the spooled payload bit-for-bit
+                k = n_ship
+                src = np.asarray(table, np.int32)
+                back = dec.gather_jit(k)(de.pool, src)
+                for name, v in shipped[s.rid].items():
+                    assert np.array_equal(np.asarray(back[name]), v), (
+                        s.rid, name
+                    )
+            assert not (adopted & set(de.free))
+            assert TRASH_BLOCK not in set(de.free)
+            # finish the decode leg: nothing leaks, everything retires
+            de.run([])
+            assert de.leaked_blocks() == 0
+
+
+class TestDisaggFaultSites:
+    def _run_pair(self, devices, transfer_spec=None, adopt_spec=None):
+        mesh = _mesh(devices, (1, 2, 1))
+        dec, params = _decoder_and_params(mesh, ModelConfig(**CFG))
+        reqs = _trace(5, max_gen=5, seed=9)
+        want = ServeEngine(dec, params, slots=3).run(_copy(reqs))
+        with tempfile.TemporaryDirectory() as spool:
+            pre, de = _engine_pair(dec, params, spool)
+            try:
+                faults.configure(transfer_spec)
+                got = pre.run(_copy(reqs))
+            finally:
+                faults.configure(None)
+            de.adopt_queue.extend(
+                pre.handoffs[r] for r in sorted(pre.handoffs)
+            )
+            try:
+                faults.configure(adopt_spec)
+                got.update(de.run([]))
+            finally:
+                faults.configure(None)
+        assert pre.leaked_blocks() == 0
+        assert de.leaked_blocks() == 0
+        return pre, de, got, want
+
+    def test_transfer_transient_error_retries_and_ships(self, devices):
+        pre, de, got, want = self._run_pair(
+            devices, transfer_spec="disagg.transfer:error:count=1"
+        )
+        # one transient wire error, retried through: every handoff
+        # still carried a real payload
+        assert pre.stats["handoff_recomputes"] == 0
+        assert all(not m["recompute"] for m in pre.handoffs.values())
+        assert de.stats["adopts"] == len(pre.handoffs)
+        assert got == want
+
+    def test_transfer_deterministic_error_degrades_to_recompute(
+        self, devices
+    ):
+        pre, de, got, want = self._run_pair(
+            devices, transfer_spec="disagg.transfer:error:count=99"
+        )
+        # the wire is down for good: every handoff crosses as a
+        # no-payload manifest, the decode pool re-prefills from the
+        # prompt — bounded recompute, bit-identical ids, never torn
+        assert pre.stats["handoff_recomputes"] == len(pre.handoffs)
+        assert all(
+            m["recompute"] and m["path"] == "" and m["blocks"] == 0
+            for m in pre.handoffs.values()
+        )
+        assert de.stats["adopts"] == 0
+        assert de.stats["adopt_recomputes"] == len(pre.handoffs)
+        assert got == want
+
+    def test_adopt_transient_error_retries_and_adopts(self, devices):
+        pre, de, got, want = self._run_pair(
+            devices, adopt_spec="disagg.adopt:error:count=1"
+        )
+        assert de.stats["adopt_recomputes"] == 0
+        assert de.stats["adopts"] == len(pre.handoffs)
+        assert got == want
+
+    def test_adopt_deterministic_error_reprefills_locally(
+        self, devices
+    ):
+        pre, de, got, want = self._run_pair(
+            devices, adopt_spec="disagg.adopt:error:count=99"
+        )
+        # the target blocks came off the free list holding garbage; a
+        # failed adopt returns them and re-queues the prompt — an
+        # adopted block is never half-written
+        assert de.stats["adopts"] == 0
+        assert de.stats["adopt_recomputes"] == len(pre.handoffs)
+        assert got == want
+
+
+class TestAdoptedSampling:
+    def test_adopted_row_continues_the_sampled_stream(self, devices):
+        # the (seed, gen_offset + position) key stream depends only on
+        # the request's own identity, so a sampled row decoded on the
+        # adopting pool matches the unified engine draw for draw
+        mesh = _mesh(devices, (1, 1, 1))
+        mcfg = ModelConfig(**CFG)
+        dec = make_paged_lm_decoder(
+            mesh, mcfg, VOCAB, n_blocks=13, block_len=8, max_len=40,
+            sampling=True,
+        )
+        flat = init_lm_params(
+            jax.random.key(0), mcfg, VOCAB, _n_experts(mesh, mcfg)
+        )
+        params = dec.stack_params(flat)
+        reqs = [
+            Request(
+                rid=i, tokens=[(i * 3 + j) % VOCAB for j in range(7)],
+                n_gen=5, temperature=0.8, top_k=8, seed=17 + i,
+            )
+            for i in range(3)
+        ]
+        want = ServeEngine(dec, params, slots=2).run(_copy(reqs))
+        with tempfile.TemporaryDirectory() as spool:
+            pre = ServeEngine(
+                dec, params, slots=2, role="prefill", spool_dir=spool,
+            )
+            de = ServeEngine(dec, params, slots=2, role="decode")
+            got = pre.run(_copy(reqs))
+            de.adopt_queue.extend(
+                pre.handoffs[r] for r in sorted(pre.handoffs)
+            )
+            got.update(de.run([]))
+        assert got == want
+        assert de.leaked_blocks() == 0

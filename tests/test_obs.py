@@ -277,6 +277,32 @@ class TestMetrics:
         assert samples[("lat_ns_sum", (("span", "x"),))] == 2500
         assert samples[("lat_ns_count", (("span", "x"),))] == 2
 
+    def test_disagg_series_keep_the_naming_conventions(self):
+        # the disagg wire accounting: three counters in the repo
+        # namespace, _total-suffixed, renderable as Prometheus text —
+        # and the transfers series is the handoff ledger's identity
+        # counter (obs/decisions.py COUNTER_IDENTITIES)
+        from tpu_patterns.obs.decisions import COUNTER_IDENTITIES
+
+        assert COUNTER_IDENTITIES["handoff"] == (
+            "tpu_patterns_disagg_transfers_total"
+        )
+        reg = obs_metrics.Registry()
+        reg.counter("tpu_patterns_disagg_transfers_total").inc()
+        reg.counter("tpu_patterns_disagg_adopted_blocks_total").inc(4)
+        reg.counter("tpu_patterns_disagg_transfer_bytes_total").inc(
+            8192
+        )
+        text = reg.to_prom_text()
+        samples = obs.parse_prom_text(text)
+        assert samples[("tpu_patterns_disagg_transfers_total", ())] == 1
+        assert samples[
+            ("tpu_patterns_disagg_adopted_blocks_total", ())
+        ] == 4
+        assert samples[
+            ("tpu_patterns_disagg_transfer_bytes_total", ())
+        ] == 8192
+
     def test_jsonl_round_trips_through_registry(self):
         reg = obs_metrics.Registry()
         reg.counter("c").inc(3)

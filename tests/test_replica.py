@@ -257,6 +257,8 @@ def _manager(n=2, policy="prefix", obs_base=None):
     mgr.elastic = None
     mgr._spare = []
     mgr.decisions = DecisionLedger()
+    mgr.roles = {}
+    mgr._decode_rr = 0
     for r in range(n):
         h = ReplicaHandle(str(r), _FakeProc(), mgr.inbox)
         h.state = "ready"
@@ -483,6 +485,9 @@ class _FakeEngine:
         self.replica = replica
         self.queue = []
         self.active = []
+        self.first_ns = {}
+        self.handoffs = {}
+        self.adopt_queue = []
 
 
 @pytest.fixture(autouse=True)
@@ -971,3 +976,165 @@ class TestFleetResultShed:
                              (3.0, "out", "2")]
         assert res.scale_outs() == 2
         assert res.scale_ins() == 1
+
+
+# -- disaggregated prefill/decode: the parent handoff plane ----------------
+
+
+def _disagg_manager(n=3):
+    """A fake-process fleet with replica 0 prefill and the rest decode:
+    the ring carries ONLY the prefill pool (decode replicas never take
+    admissions), exactly as ReplicaManager.__init__ builds it."""
+    mgr = _manager(n)
+    mgr.roles = {
+        str(r): ("prefill" if r == 0 else "decode") for r in range(n)
+    }
+    mgr.router = Router(["0"], block_len=8)
+    return mgr
+
+
+def _manifest(rid, blocks=2, nbytes=2048, recompute=False):
+    return {
+        "rid": rid, "jid": f"j{rid}", "prompt": [1] * 9, "n_gen": 4,
+        "scenario": "", "deadline_ms": 0.0, "priority": "bulk",
+        "temperature": 0.0, "top_k": 0, "top_p": 1.0, "seed": 0,
+        "gen_offset": 0, "tok0": 5, "t_submit_ns": 0, "t_first_ns": 0,
+        "path": "" if recompute else f"/spool/kv-{rid}.npz",
+        "blocks": 0 if recompute else blocks,
+        "nbytes": 0 if recompute else nbytes,
+        "recompute": recompute,
+    }
+
+
+class TestDisaggHandoffPlane:
+    def test_roles_validation(self, tmp_path):
+        # the real constructor: every id must carry a role, both pools
+        # must be populated, and elastic+roles is rejected
+        kw = dict(
+            base_env={}, work_dir=str(tmp_path), child_cfg={},
+            device_slices=[[0], [1]], sp=1, tp=1,
+        )
+        with pytest.raises(ValueError, match="at least one"):
+            ReplicaManager(2, roles={"0": "prefill", "1": "prefill"},
+                           **kw)
+        with pytest.raises(ValueError, match="role"):
+            ReplicaManager(2, roles={"0": "prefill"}, **kw)
+        with pytest.raises(ValueError, match="role"):
+            ReplicaManager(2, roles={"0": "prefill", "1": "router"},
+                           **kw)
+
+    def test_handoff_moves_lease_round_robin_and_books(
+        self, no_real_kill
+    ):
+        from tpu_patterns import obs
+
+        mgr = _disagg_manager(3)
+        reqs = _reqs(2)
+        res = _res(mgr, reqs)
+        for r in reqs:
+            mgr._dispatch(r, res)
+        pre = mgr.handles["0"]
+        assert set(pre.leases.held()) == {0, 1}
+        t0 = rt.metric_total("tpu_patterns_disagg_transfers_total")
+        b0 = rt.metric_total("tpu_patterns_disagg_adopted_blocks_total")
+        y0 = rt.metric_total("tpu_patterns_disagg_transfer_bytes_total")
+        for rid in (0, 1):
+            mgr._handle(
+                "0", {"op": "handoff", "rid": rid,
+                      "m": _manifest(rid)}, res,
+            )
+        assert len(pre.leases) == 0
+        # round-robin over the live decode pool: one rid each
+        assert set(mgr.handles["1"].leases.held()) == {0}
+        assert set(mgr.handles["2"].leases.held()) == {1}
+        for d in ("1", "2"):
+            (adopt,) = [
+                m for m in mgr.handles[d].proc.stdin.sent
+                if m.get("op") == "adopt"
+            ]
+            assert adopt["m"]["blocks"] == 2
+        assert res.handoff_rids == {0, 1}
+        assert rt.metric_total(
+            "tpu_patterns_disagg_transfers_total"
+        ) - t0 == 2.0
+        assert rt.metric_total(
+            "tpu_patterns_disagg_adopted_blocks_total"
+        ) - b0 == 4.0
+        assert rt.metric_total(
+            "tpu_patterns_disagg_transfer_bytes_total"
+        ) - y0 == 4096.0
+        booked = [
+            e for e in mgr.decisions.events if e["action"] == "handoff"
+        ]
+        assert len(booked) == 2
+        assert booked[0]["inputs"]["dst"] == "1"
+        ring = [e["name"] for e in obs.flight_recorder().snapshot()]
+        assert "journey.handoff" in ring
+
+    def test_recompute_handoff_counts_transfer_only(self, no_real_kill):
+        mgr = _disagg_manager(2)
+        reqs = _reqs(1)
+        res = _res(mgr, reqs)
+        mgr._dispatch(reqs[0], res)
+        t0 = rt.metric_total("tpu_patterns_disagg_transfers_total")
+        b0 = rt.metric_total("tpu_patterns_disagg_adopted_blocks_total")
+        y0 = rt.metric_total("tpu_patterns_disagg_transfer_bytes_total")
+        mgr._handle(
+            "0", {"op": "handoff", "rid": 0,
+                  "m": _manifest(0, recompute=True)}, res,
+        )
+        # counter identity: the transfers series ticks on EVERY booked
+        # handoff (degradations included); payload series count real
+        # bytes/blocks only
+        assert rt.metric_total(
+            "tpu_patterns_disagg_transfers_total"
+        ) - t0 == 1.0
+        assert rt.metric_total(
+            "tpu_patterns_disagg_adopted_blocks_total"
+        ) - b0 == 0.0
+        assert rt.metric_total(
+            "tpu_patterns_disagg_transfer_bytes_total"
+        ) - y0 == 0.0
+        assert set(mgr.handles["1"].leases.held()) == {0}
+
+    def test_no_live_decode_fails_loudly(self, no_real_kill):
+        mgr = _disagg_manager(2)
+        reqs = _reqs(1)
+        res = _res(mgr, reqs)
+        mgr._dispatch(reqs[0], res)
+        mgr.handles["1"].state = "dead"
+        mgr._handle(
+            "0", {"op": "handoff", "rid": 0, "m": _manifest(0)}, res,
+        )
+        assert "decode" in res.failed[0]
+        assert len(mgr.handles["0"].leases) == 0
+        assert res.covered()
+
+    def test_first_op_stamps_parent_clock_once(self, no_real_kill):
+        mgr = _disagg_manager(2)
+        res = _res(mgr, _reqs(1))
+        mgr._handle("0", {"op": "first", "rid": 0}, res)
+        stamp = res.t_first_ns[0]
+        assert stamp > 0
+        # a recompute degradation may regenerate the first token later:
+        # the front-door stamp must not move
+        mgr._handle("1", {"op": "first", "rid": 0}, res)
+        assert res.t_first_ns[0] == stamp
+
+    def test_decode_death_mid_adopt_reroutes_via_prefill_ring(
+        self, no_real_kill
+    ):
+        mgr = _disagg_manager(2)
+        reqs = _reqs(1)
+        res = _res(mgr, reqs)
+        mgr._dispatch(reqs[0], res)
+        mgr._handle(
+            "0", {"op": "handoff", "rid": 0, "m": _manifest(0)}, res,
+        )
+        assert set(mgr.handles["1"].leases.held()) == {0}
+        # the adopter dies holding the lease: standard fail-over sends
+        # the rid back through the (prefill-only) ring — a fresh
+        # prefill, a fresh handoff, never limbo
+        mgr._replica_down(mgr.handles["1"], "test kill", res)
+        assert 0 in res.rerouted
+        assert set(mgr.handles["0"].leases.held()) == {0}

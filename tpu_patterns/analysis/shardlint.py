@@ -502,6 +502,12 @@ def check_recompile_hazard() -> list[Finding]:
             signatures["onload"],
             _declared_buckets(decoder.layout.n_blocks - 1),
         ),
+        # the disagg handoff wire buckets over shipped block counts,
+        # the same budget as the gather/onload halves it rides between
+        "stream": (
+            signatures["stream"],
+            _declared_buckets(decoder.layout.n_blocks - 1),
+        ),
     }
     for core, (seen, allowed) in budgets.items():
         for sig in sorted(seen - allowed):

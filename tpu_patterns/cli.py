@@ -403,6 +403,34 @@ def _cmd_serve(args, writer: ResultWriter) -> None:
                 f"{cfg.replica_policy!r} (want one of "
                 f"{Router.POLICIES})"
             )
+        if cfg.disagg:
+            try:
+                p, d = (int(x) for x in cfg.disagg.split(":"))
+            except ValueError:
+                raise SystemExit(
+                    f"error: --disagg wants P:D (two integers, e.g. "
+                    f"2:2), got {cfg.disagg!r}"
+                ) from None
+            if p < 1 or d < 1:
+                raise SystemExit(
+                    f"error: --disagg {cfg.disagg}: need at least one "
+                    "prefill and one decode replica"
+                )
+            if p + d != cfg.replicas:
+                raise SystemExit(
+                    f"error: --disagg {cfg.disagg}: P+D = {p + d} "
+                    f"must equal --replicas {cfg.replicas}"
+                )
+            if cfg.elastic_reserve:
+                raise SystemExit(
+                    "error: --disagg and --elastic_reserve are "
+                    "mutually exclusive (role assignment is static)"
+                )
+    elif cfg.disagg:
+        raise SystemExit(
+            "error: --disagg splits a replica fleet into prefill and "
+            "decode pools — it needs --replicas N with P+D == N"
+        )
     if cfg.scenario:
         # parse-time checks up front so spec typos and rejected flag
         # combos read as one line (same surface as loadgen); runtime

@@ -90,6 +90,52 @@ def spmd_probe(mesh):
     return fn, (x,)
 
 
+def stream_permutation(n: int) -> list[tuple[int, int]]:
+    """The KV-block wire's hop permutation over a size-``n`` axis: the
+    bidirectional even/odd pairing (an INVOLUTION — applying it twice is
+    the identity), degrading to the identity permutation on odd or
+    single worlds exactly like :func:`spmd_probe`.  The serve handoff
+    (serve/engine.py) rides this: two hops move every shard's bytes
+    across the ICI and home again, so the spooled wire payload is
+    bit-identical to the gathered blocks while the transfer itself is a
+    real, auditable collective."""
+    if n >= 2 and n % 2 == 0:
+        return pair_permutation(n, bidirectional=True)
+    return [(i, i) for i in range(n)]
+
+
+def make_block_stream(mesh, pool_specs: dict, axis: str = "sp"):
+    """The prefill->decode KV-block transfer core: a jitted, DONATED
+    ``shard_map`` whose body ppermutes every wire leaf (K/V planes plus
+    int8 scales) across ``axis`` and back — the involution round trip —
+    so the emitted bytes cross the inter-chip links like the reference's
+    paired Isend/Irecv while landing bit-identical to the input.
+
+    The payload is donated (the gathered staging copy is dead after the
+    ship), the body is pure data movement (no compute, no reduction),
+    and the only collective is ``ppermute`` over ``axis`` — the declared
+    budget the ``disagg.stream`` SpmdEntry registers for shardlint's
+    ``collective-in-decode-hot-path`` and ``implicit-reshard`` audits.
+    """
+    n = int(mesh.shape[axis]) if axis in mesh.axis_names else 1
+    perm = stream_permutation(n)
+
+    def body(vals):
+        hop = {k: lax.ppermute(v, axis, perm) for k, v in vals.items()}
+        return {k: lax.ppermute(v, axis, perm) for k, v in hop.items()}
+
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(pool_specs,),
+            out_specs=pool_specs,
+            check_vma=False,
+        ),
+        donate_argnums=(0,),
+    )
+
+
 def _shard_checksums(x, *, axis: str):
     return verify.checksum_device(x)[None]
 

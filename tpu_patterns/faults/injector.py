@@ -75,6 +75,11 @@ KNOWN_SITES = frozenset({
     "loadgen.arrive", "router.route", "replica.spawn", "replica.drain",
     "replica.obs_ship", "obs.scrape",
     "fleet.scale_out", "fleet.scale_in",
+    # disaggregated prefill/decode handoff (serve/engine.py): the
+    # prefill-side KV-block ship and the decode-side adoption — both
+    # fire BEFORE any donated pool mutation, so an injected error is
+    # always retryable and can never tear a block
+    "disagg.transfer", "disagg.adopt",
     # cost/decision booking (obs/cost.py, obs/decisions.py): fails
     # OPEN at every call site — a booking error skips the record,
     # never the scheduler action being recorded
@@ -89,6 +94,10 @@ KNOWN_SITES = frozenset({
 MATCH_KEYS = frozenset({
     "pid", "cmd", "cell", "step", "proc", "rows", "rid", "scenario",
     "replica",
+    # the disagg handoff sites carry the shipped block count, so a
+    # chaos spec can target transfers by size (disagg.transfer:error:
+    # blocks=3)
+    "blocks",
     # the live telemetry plane's scrape site is matchable per endpoint
     # (metrics | healthz | statusz | other — obs/live.py)
     "endpoint",

@@ -41,7 +41,7 @@ from tpu_patterns.core.timing import clock_ns
 # ledger-vs-counter identity gap, so book() rejects anything else
 ACTIONS = (
     "defer", "evict", "shed", "preempt",
-    "scale_out", "scale_in", "breaker", "reroute",
+    "scale_out", "scale_in", "breaker", "reroute", "handoff",
 )
 
 # per action: the existing counter the ledger must stay in identity
@@ -56,6 +56,7 @@ COUNTER_IDENTITIES = {
     "scale_in": "tpu_patterns_fleet_scale_events_total",
     "breaker": "tpu_patterns_replica_breaker_trips_total",
     "reroute": "tpu_patterns_router_reroutes_total",
+    "handoff": "tpu_patterns_disagg_transfers_total",
 }
 
 
@@ -146,6 +147,7 @@ class DecisionLedger:
 # a rid (the decision's effect, next to its cause)
 _STORY_EVENTS = (
     "journey.route", "journey.reroute", "journey.admit",
+    "journey.handoff",
     "serve.defer", "serve.shed", "serve.preempted", "serve.quarantine",
     "serve.cow_copy", "replica.reroute",
 )

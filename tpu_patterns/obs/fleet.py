@@ -57,7 +57,13 @@ ROUTER_PID = 1_000_000
 # entries that anchor a journey's flow arrows: the router's decisions
 # and the per-request lifecycle edges (admission is an anchor so a
 # SIGKILLed replica's shipped history still places the request there)
-JOURNEY_EVENTS = ("journey.route", "journey.reroute", "journey.admit")
+JOURNEY_EVENTS = (
+    "journey.route", "journey.reroute", "journey.admit",
+    # the disagg prefill->decode handoff: stamped by the PARENT at
+    # transfer time, so the journey's flow arrow crosses from the
+    # prefill replica's lane to the decode replica's lane
+    "journey.handoff",
+)
 JOURNEY_SPANS = ("req.queued", "req.retired", "req.failed")
 
 _journey_seq = itertools.count(1)

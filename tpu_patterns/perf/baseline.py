@@ -118,6 +118,12 @@ METRIC_CLASS = {
     "kv_onload_bytes": "analytic",
     "kv_evictions": "analytic",
     "kv_onload_hits": "analytic",
+    # disagg KV-block wire (perf/registry.py _capture_disagg_stream):
+    # the shipped-payload byte floor is closed-form from the block
+    # shape (analytic: ratcheted everywhere), the wire wall clock is
+    # machine-bound like every other timed core
+    "transfer_bytes": "analytic",
+    "transfer_ms": "measured",
     "compile_s": "compile",
     "cached_compile_s": "compile",
     "cache_hit": "compile",

@@ -91,6 +91,7 @@ EXECUTABLES = (
     "decoder.step_pallas",
     "decoder.verify_pallas",
     "copy_blocks",
+    "disagg.stream",
     "serve.step",
     "serve.kv_tier",
 )
@@ -354,6 +355,18 @@ def _spmd_copy_blocks():
     )
 
 
+def _spmd_disagg_stream():
+    """The disagg prefill->decode KV-block wire: the payload is a
+    2-block gather in the host-tier wire format, the core is the
+    donated ppermute round trip over sp (serve/paged.py
+    ``stream_jit`` -> comm/p2p.py ``make_block_stream``)."""
+    import jax.numpy as jnp
+
+    dec, params, pool, rows, tables, lens, zeros, active = _spmd_decoder()
+    vals = dec.gather_jit(2)(pool, jnp.asarray([1, 2], jnp.int32))
+    return dec.stream_jit(2), (vals,)
+
+
 # The module-owned probes: each subsystem declares its own SPMD
 # contract next to the collectives it runs (parallel/moe.py,
 # parallel/pipeline.py, longctx/pattern.py, comm/{p2p,ring,
@@ -430,6 +443,7 @@ def spmd_entries() -> tuple:
     from tpu_patterns.serve.paged import (
         DECODE_DECLARED_COLLECTIVES,
         SAMPLED_DECODE_DECLARED_COLLECTIVES,
+        STREAM_DECLARED_COLLECTIVES,
     )
 
     builtin = (
@@ -476,6 +490,15 @@ def spmd_entries() -> tuple:
         SpmdEntry(
             "copy_blocks", _SERVE_AXES, _spmd_copy_blocks, donates=True,
             declared_collectives=frozenset(),  # a copy moves no bytes off-rank
+        ),
+        # the disagg handoff wire is HOT (it sits on the prefill->decode
+        # critical path of every handed-off request) and DONATED (the
+        # gathered staging copy dies with the ship); its only collective
+        # is the declared ppermute pair exchange over sp
+        SpmdEntry(
+            "disagg.stream", _SERVE_AXES, _spmd_disagg_stream,
+            hot=True, donates=True,
+            declared_collectives=STREAM_DECLARED_COLLECTIVES,
         ),
         SpmdEntry("moe.dispatch", ("ep",), _spmd_moe_dispatch),
         SpmdEntry("pipeline.apply", ("pp",), _spmd_pipeline_apply),
@@ -888,6 +911,57 @@ def _capture_decoder_pallas(mesh, cfg: PerfConfig) -> dict[str, dict]:
     return out
 
 
+def _capture_disagg_stream(mesh, cfg: PerfConfig) -> dict:
+    """disagg.stream — the prefill->decode KV-block wire, direct-timed
+    at one request's worth of shipped blocks.  The payload is gathered
+    once (the wire format is the host-tier eviction format), then the
+    donated ppermute round trip is timed rethreading its own output —
+    exactly how the serve handoff drives it.  The analytic byte floor
+    is the shipped payload (``transfer_bytes``, analytic-ratcheted);
+    ``analytic_hbm_bytes`` counts the two hops' read+write traffic."""
+    from tpu_patterns.perf import analytic
+
+    decoder, params, _flat, mcfg = _decoder(mesh, cfg)
+    rng = np.random.RandomState(cfg.seed)
+    slots = cfg.slots
+    tables = _tables(decoder, slots)
+    active = np.ones((slots,), bool)
+    pool = decoder.init_pool()
+
+    # seed real context so the wire carries live KV, not init zeros
+    lpad = cfg.max_prompt
+    tokens = rng.randint(0, cfg.vocab, size=(slots, lpad)).astype(np.int32)
+    lens_full = np.full((slots,), lpad, np.int32)
+    start0 = np.zeros((slots,), np.int32)
+    pool, _tok0 = decoder.prefill_jit(slots, lpad)(
+        params, pool, tokens, lens_full, start0, tables, active
+    )
+
+    # one request's shipped set: its full block-table window
+    n_ship = decoder.n_pages
+    src = tables[0, :n_ship].astype(np.int32)
+    state = {"vals": decoder.gather_jit(n_ship)(pool, src)}
+    stream = decoder.stream_jit(n_ship)
+
+    def call():
+        state["vals"] = stream(state["vals"])
+        return state["vals"]["k"]
+
+    payload = float(
+        n_ship * cfg.block_len
+        * analytic.kv_token_bytes(mcfg, cfg.cache_int8)
+    )
+    ms = _timed_reps("disagg.stream", call, cfg)
+    return {
+        "analytic_flops": 0.0,
+        # two ppermute hops, each reading and writing every payload byte
+        "analytic_hbm_bytes": 4.0 * payload,
+        "transfer_bytes": payload,
+        "transfer_ms": ms,
+        "step_ms": ms,
+    }
+
+
 def _hist_state(name: str) -> tuple[float, int]:
     from tpu_patterns import obs
 
@@ -1079,6 +1153,9 @@ def capture(mesh, cfg: PerfConfig, writer=None) -> dict:
         for n, m in _capture_decoder_pallas(mesh, cfg).items():
             if n in names:
                 executables[n] = m
+    if "disagg.stream" in names:
+        say("perf capture: disagg.stream (KV-block wire)")
+        executables["disagg.stream"] = _capture_disagg_stream(mesh, cfg)
     if "serve.step" in names:
         say("perf capture: serve.step (engine-driven trace)")
         executables["serve.step"] = _capture_serve(mesh, cfg)

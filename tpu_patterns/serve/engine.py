@@ -162,9 +162,20 @@ class ServeEngine:
                  host_tier_blocks: int = 0,
                  slo: SloConfig | None = None,
                  burn_mitigation: str = "off",
-                 preempt: str = "off"):
+                 preempt: str = "off",
+                 role: str = "",
+                 spool_dir: str | None = None):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
+        if role not in ("", "prefill", "decode"):
+            raise ValueError(
+                f"role must be '' | prefill | decode, got {role!r}"
+            )
+        if role == "prefill" and not spool_dir:
+            raise ValueError(
+                "role='prefill' requires spool_dir: the handoff wire "
+                "spools KV payloads there for the decode pool"
+            )
         if spec_k < 0:
             raise ValueError(f"spec_k must be >= 0, got {spec_k}")
         if session_dir and not kv_host_tier:
@@ -205,6 +216,28 @@ class ServeEngine:
         # fleet identity: rides every fault-injection ctx (so a chaos
         # spec can target ONE replica of a fleet) and the obs labels
         self.replica = replica
+        # disaggregated prefill/decode serving (``serve --disagg P:D``):
+        # a ``prefill`` engine admits and prefills, then SHIPS each
+        # finished request's written KV blocks (gather -> the comm/p2p
+        # block stream -> an atomically spooled wire file) and releases
+        # everything it held; a ``decode`` engine ADOPTS those payloads
+        # onto fresh blocks and runs pure decode.  "" keeps the unified
+        # behavior everywhere.
+        self.role = role
+        self.spool_dir = spool_dir
+        # finished handoffs awaiting pickup by the replica report loop:
+        # {rid: wire manifest} — tok0 + sampling state + spool path (or
+        # recompute=True when the transfer failed deterministically)
+        self.handoffs: dict[int, dict] = {}
+        # inbound handoffs (decode role): manifests queued by the parent
+        # ``adopt`` op, admitted FIFO by _admit_adopts each iteration
+        self.adopt_queue: list[dict] = []
+        # first-token ledger: rid -> host stamp when its first token
+        # reached the host (any role).  The replica report loop ships a
+        # ``first`` op off this diff, so the PARENT can clock TTFT at
+        # the front door on its OWN clock — the same measurement for a
+        # unified and a disaggregated fleet
+        self.first_ns: dict[int, int] = {}
         self.watchdog_s = watchdog_s
         self.layout = decoder.layout
         self.n_pages = decoder.n_pages
@@ -335,6 +368,13 @@ class ServeEngine:
             # preempted counts preemption EVENTS, preempted_resumed
             # counts requests that were preempted and later retired
             "preempted": 0, "preempted_resumed": 0,
+            # disagg accounting (0 with role=""): handoffs/transfer_bytes
+            # on the prefill side, adopts/adopted_blocks on the decode
+            # side; *_recomputes count the no-payload degradations
+            # (deterministic wire failure -> re-prefill, never torn)
+            "handoffs": 0, "transfer_bytes": 0,
+            "handoff_recomputes": 0,
+            "adopts": 0, "adopted_blocks": 0, "adopt_recomputes": 0,
         }
         # preemption safety: SIGTERM/SIGINT (or an injected ``preempt``)
         # sets the event; the loop finishes the current decode step,
@@ -1313,6 +1353,7 @@ class ServeEngine:
             s.out.append(s.last_tok)
             s.write_from = 0  # fence spent: the wave is on device
             s.t_first_ns = s.t_last_ns = t_tok
+            self.first_ns.setdefault(s.rid, t_tok)
             self.stats["tokens"] += 1
         if self.index is not None:
             for s in slots:
@@ -1320,6 +1361,289 @@ class ServeEngine:
         obs.counter("tpu_patterns_serve_tokens_total").inc(len(slots))
         self.stats["prefills"] += 1
         self.active.extend(slots)
+
+    # -- disaggregated prefill/decode handoff ----------------------------
+
+    def _spool_path(self, rid: int) -> str:
+        import os
+
+        return os.path.join(self.spool_dir, f"kv-{rid}.npz")
+
+    def _handoff_wave(self) -> None:
+        """Prefill-role tail of an iteration: every still-active row has
+        its first token and its prompt K/V on device — ship each one to
+        the decode pool and release everything this engine held.
+
+        The wire is gather (NOT donated: the pool survives a retry) ->
+        the comm/p2p block stream (donated: the staging copy dies on the
+        wire; the involution round trip makes the payload bit-identical
+        to the gathered blocks while the bytes cross the interconnect as
+        a real, declared ppermute) -> an atomically spooled ``.npz``
+        (tmp + rename, the host-tier commit discipline: a crash leaves
+        the previous complete file or none, never a torn one).  The
+        ``disagg.transfer`` fault site fires BEFORE the spool write and
+        before any pool mutation, so an injected error retries cleanly;
+        deterministic exhaustion degrades to a NO-PAYLOAD handoff
+        (``recompute=True``) — the decode pool re-prefills from the
+        prompt, bit-identical by construction, never torn.
+
+        Block release goes through the normal retire ladder
+        (:meth:`_release_block`), so with the host tier on, this
+        replica's shipped prefixes RETAIN as a device-resident prefix
+        cache for future prompts sharing them."""
+        import os
+
+        from tpu_patterns import obs
+
+        cap = max(self.layout.n_blocks - 1, 1)
+        for s in list(self.active):
+            n_ship = self.layout.blocks_for(s.lens)
+            path = self._spool_path(s.rid) if self.spool_dir else ""
+            nbytes = 0
+            recompute = not path
+
+            def attempt(s=s, n_ship=n_ship, path=path):
+                # fault site: before the gather — nothing spooled,
+                # nothing mutated, so an ``error`` here retries cleanly
+                # and a ``kill`` leaves no partial wire file
+                faults.inject(
+                    "disagg.transfer", rid=s.rid, replica=self.replica,
+                    blocks=n_ship,
+                )
+                k = _bucket(n_ship, cap)
+                src = np.full((k,), TRASH_BLOCK, np.int32)
+                src[:n_ship] = s.table[:n_ship]
+                vals = self.decoder.gather_jit(k)(self.pool, src)
+                wire = self.decoder.stream_jit(k)(vals)
+                # graftlint: allow[host-sync-in-hot-path] -- this sync IS the ship: the device->host wire copy the handoff exists to make
+                host = {
+                    name: np.asarray(leaf)[:, :n_ship]
+                    for name, leaf in wire.items()
+                }
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    np.savez(f, **host)
+                os.replace(tmp, path)
+                return sum(a.nbytes for a in host.values())
+
+            if not recompute:
+                try:
+                    nbytes = faults.call_with_retry(
+                        attempt, policy=self.retry_policy,
+                        site="disagg.transfer",
+                    )
+                except (OSError, faults.Quarantined) as e:
+                    recompute, path, nbytes = True, "", 0
+                    self.stats["handoff_recomputes"] += 1
+                    obs.event(
+                        "disagg.transfer_degraded", rid=str(s.rid),
+                        replica=self.replica, reason=str(e)[:120],
+                    )
+            self.handoffs[s.rid] = {
+                "rid": s.rid, "jid": s.jid,
+                "prompt": list(s.prompt), "n_gen": s.n_gen,
+                "scenario": s.scenario, "deadline_ms": s.deadline_ms,
+                "priority": s.priority,
+                "temperature": s.temperature, "top_k": s.top_k,
+                "top_p": s.top_p, "seed": s.seed,
+                "gen_offset": s.gen_offset,
+                "tok0": s.out[0],
+                "t_submit_ns": s.t_submit_ns,
+                "t_first_ns": s.t_first_ns,
+                "path": path,
+                "blocks": 0 if recompute else n_ship,
+                "nbytes": nbytes,
+                "recompute": recompute,
+            }
+            for b in s.table:
+                self._release_block(b)
+            self.slot_pool.release(s.slot, reusable=True)
+            self.inflight.release(s.rid)
+            self.cost.drop(s.rid)
+            self.active.remove(s)
+            self.stats["handoffs"] += 1
+            self.stats["transfer_bytes"] += nbytes
+            obs.event(
+                "serve.handoff", rid=str(s.rid), replica=self.replica,
+                blocks=str(0 if recompute else n_ship),
+                recompute=str(recompute),
+            )
+
+    def _resubmit_adopt(self, msg: dict) -> None:
+        """The recompute degradation: re-queue the handed-off request
+        for a LOCAL prefill on this (decode) pool.  Greedy ids and the
+        (seed, gen_offset + n) sampling keys depend only on the prompt
+        and the request's own stream position, so the regenerated output
+        is bit-identical to the adopted path — at worst recompute, never
+        corruption."""
+        self.stats["adopt_recomputes"] += 1
+        self.submit(
+            Request(
+                rid=msg["rid"], tokens=list(msg["prompt"]),
+                n_gen=msg["n_gen"], scenario=msg["scenario"],
+                deadline_ms=msg["deadline_ms"], jid=msg["jid"],
+                priority=msg["priority"],
+                temperature=msg["temperature"], top_k=msg["top_k"],
+                top_p=msg["top_p"], seed=msg["seed"],
+                gen_offset=msg["gen_offset"],
+            ),
+            t_submit_ns=msg["t_submit_ns"],
+        )
+
+    def _admit_adopts(self) -> None:
+        """Decode-role head of an iteration: adopt queued handoff
+        payloads onto fresh blocks, FIFO, while slots and blocks last.
+
+        Adoption allocates the request's WHOLE lifetime rectangle (the
+        same reservation admission makes), onloads the shipped prefix
+        blocks in one compiled scatter, and seats a slot that is
+        indistinguishable from one this engine prefilled itself: lens at
+        the prompt boundary, steps 0, the shipped first token as
+        ``last_tok`` — the first decode step writes tok0's K/V exactly
+        where the unified engine would have.  The ``disagg.adopt`` fault
+        site fires BEFORE the donated onload, so an injected error can
+        never tear a block; deterministic exhaustion releases everything
+        and re-prefills locally (:meth:`_resubmit_adopt`)."""
+        import os
+
+        from tpu_patterns import obs
+
+        cap = max(self.layout.n_blocks - 1, 1)
+        while self.adopt_queue:
+            msg = self.adopt_queue[0]
+            if msg.get("recompute"):
+                self.adopt_queue.pop(0)
+                self._resubmit_adopt(msg)
+                continue
+            lens = len(msg["prompt"])
+            need = self.layout.blocks_for(
+                lens + max(msg["n_gen"] - 1, 0)
+            )
+            if need > self.layout.n_blocks - 1:
+                self.adopt_queue.pop(0)
+                self.failed[msg["rid"]] = (
+                    f"adopt needs {need} blocks; pool has "
+                    f"{self.layout.n_blocks - 1}"
+                )
+                continue
+            slot_tok = self.slot_pool.lease()
+            if slot_tok is None:
+                break  # active set full: adopt again next iteration
+            if need > len(self.free):
+                self._evict_for(
+                    need - len(self.free), set(), rid=msg["rid"]
+                )
+            if need > len(self.free):
+                self.slot_pool.release(slot_tok, reusable=True)
+                self.stats["deferrals"] += 1
+                obs.counter("tpu_patterns_serve_deferrals_total").inc()
+                obs.event(
+                    "serve.defer", rid=str(msg["rid"]),
+                    need=need, free=len(self.free),
+                )
+                self.decisions.book(
+                    "defer", rid=msg["rid"], jid=msg["jid"],
+                    rationale="pool pressure: adopted-block need "
+                              "exceeds free list after evict rung",
+                    need=need, free=len(self.free),
+                    adopt_queue=len(self.adopt_queue),
+                    active=len(self.active),
+                )
+                break  # FIFO: later adoptions must not starve this one
+            self.adopt_queue.pop(0)
+            blocks = [self.free.pop() for _ in range(need)]
+            n_ship = msg["blocks"]
+
+            def attempt(msg=msg, blocks=blocks, n_ship=n_ship):
+                # fault site: before the load and the donated scatter —
+                # the target blocks came off the free list and hold
+                # garbage either way, so an ``error`` retries cleanly
+                # and an adopted block is NEVER torn
+                faults.inject(
+                    "disagg.adopt", rid=msg["rid"],
+                    replica=self.replica, blocks=n_ship,
+                )
+                k = _bucket(n_ship, cap)
+                dst = np.full((k,), TRASH_BLOCK, np.int32)
+                dst[:n_ship] = blocks[:n_ship]
+                leaves = self.decoder._pool_leaves()
+                vals = {
+                    name: np.zeros((shape[0], k, *shape[2:]), dt)
+                    for name, (shape, dt) in leaves.items()
+                }
+                with np.load(msg["path"]) as data:
+                    for name in vals:
+                        vals[name][:, :n_ship] = data[name]
+                self.pool = self.decoder.onload_jit(k)(
+                    self.pool, vals, dst
+                )
+
+            try:
+                faults.call_with_retry(
+                    attempt, policy=self.retry_policy,
+                    site="disagg.adopt",
+                )
+            except (OSError, faults.Quarantined) as e:
+                self.free.extend(blocks)
+                self.slot_pool.release(slot_tok, reusable=True)
+                obs.event(
+                    "disagg.adopt_degraded", rid=str(msg["rid"]),
+                    replica=self.replica, reason=str(e)[:120],
+                )
+                self._resubmit_adopt(msg)
+                continue
+            for b in blocks:
+                self.ref[b] = 1
+            own_blocks: tuple[int, ...] = ()
+            if self.index is not None:
+                own_blocks = tuple(
+                    self.index.insert(list(msg["prompt"]), blocks)
+                )
+                self.index.materialize(list(own_blocks))
+            now = clock_ns()
+            s = _Slot(
+                rid=msg["rid"], lens=lens, steps=0,
+                n_gen=msg["n_gen"], table=blocks,
+                last_tok=msg["tok0"], out=[msg["tok0"]],
+                t_submit_ns=msg["t_submit_ns"],
+                prompt=list(msg["prompt"]), write_from=0,
+                own_blocks=own_blocks,
+                scenario=msg["scenario"],
+                deadline_ms=msg["deadline_ms"],
+                jid=msg["jid"], priority=msg["priority"],
+                temperature=msg["temperature"], top_k=msg["top_k"],
+                top_p=msg["top_p"], seed=msg["seed"],
+                gen_offset=msg["gen_offset"],
+                t_admit_ns=now,
+                # lifecycle truth: the client saw its first token when
+                # the PREFILL replica emitted it — TTFT/TPOT must not
+                # restart at adoption
+                t_first_ns=msg["t_first_ns"],
+                t_last_ns=msg["t_first_ns"],
+                slot=slot_tok,
+            )
+            self.inflight.acquire(s.rid, s)
+            self.cost.hold(
+                s.rid, len(blocks),
+                scenario=s.scenario, priority=s.priority,
+            )
+            if s.jid:
+                obs.event(
+                    "journey.admit", jid=s.jid, rid=str(s.rid),
+                    replica=self.replica,
+                )
+            self.active.append(s)
+            self.stats["adopts"] += 1
+            self.stats["adopted_blocks"] += n_ship
+            obs.event(
+                "serve.adopt", rid=str(s.rid), replica=self.replica,
+                blocks=str(n_ship),
+            )
+            if msg["path"]:
+                try:
+                    os.unlink(msg["path"])
+                except OSError:
+                    pass  # the spool dir is per-run scratch either way
 
     def _step(self) -> None:
         from tpu_patterns import obs
@@ -1795,7 +2119,10 @@ class ServeEngine:
                 while True:
                     if source is not None:
                         batch = source(
-                            idle=not (self.queue or self.active)
+                            idle=not (
+                                self.queue or self.active
+                                or self.adopt_queue
+                            )
                         )
                         if batch is None:
                             source = None
@@ -1807,7 +2134,9 @@ class ServeEngine:
                                     )
                                 else:
                                     self.submit(item)
-                    if not (self.queue or self.active):
+                    if not (
+                        self.queue or self.active or self.adopt_queue
+                    ):
                         if self._preempt.is_set():
                             # idle-waiting on future arrivals: the
                             # signal must not wait for the next one
@@ -1823,6 +2152,13 @@ class ServeEngine:
                     # step function here would book the (long, possibly
                     # compiling) prefill window at the stale count
                     self.cost.tick(self.allocated_blocks())
+                    if self.role == "decode" and self.adopt_queue:
+                        # adopt shipped KV ahead of local admission:
+                        # the handoff already paid its prefill on the
+                        # other pool, so an adopted row goes straight
+                        # into the decode wave below
+                        self._admit_adopts()
+                        self.cost.tick(self.allocated_blocks())
                     admitted = self._admit()
                     self.cost.tick(self.allocated_blocks())
                     if admitted:
@@ -1841,6 +2177,14 @@ class ServeEngine:
                         else:
                             self._book_health(True)
                             self._retire()  # n_gen == 1 finish at prefill
+                    if self.role == "prefill" and self.active:
+                        # disagg: everything still active has its first
+                        # token — ship it and free the rectangle.  The
+                        # wave drains ``active`` completely, so a
+                        # prefill-role engine never reaches the decode
+                        # dispatch below
+                        self._handoff_wave()
+                        self.cost.tick(self.allocated_blocks())
                     if self.active:
                         # speculative decoding swaps the one-token step
                         # for the drafted wide step, under its own
@@ -2110,6 +2454,19 @@ class ServeConfig:
     scale_sustain_s: float = 0.5  # signal must hold this long to act
     scale_cooldown_s: float = 2.0  # min gap between scale actions
     min_live_replicas: int = 1  # scale-in floor
+    # disaggregated prefill/decode (serve/replica.py): "P:D" splits the
+    # --replicas fleet into P prefill-only replicas (they admit, fill
+    # paged blocks, then ship each finished request's KV blocks over
+    # the comm/p2p block stream) and D decode-only replicas (they adopt
+    # shipped blocks into their own pool and run pure decode).  The run
+    # becomes the disagg A/B Record: the split fleet vs a unified fleet
+    # of N identical replicas at equal device count.  "" = off.
+    disagg: str = ""
+    # TTFT p99 gate for the disagg A/B: the split fleet's front-door
+    # p99 must be at least this factor better than unified (1.05 =
+    # 5% better).  0 = report, don't gate (CPU hosts under ~4 cores
+    # can't give each pool real parallelism).
+    min_ttft_improvement: float = 0.0
 
 
 def _slo_kwargs(cfg) -> dict:
@@ -2231,7 +2588,10 @@ def _serve_fingerprint(cfg: ServeConfig, n_blocks: int) -> dict:
               # never the token stream (resume is bit-identical)
               "preempt", "elastic_reserve", "scale_out_occupancy",
               "scale_in_occupancy", "scale_sustain_s",
-              "scale_cooldown_s", "min_live_replicas"):
+              "scale_cooldown_s", "min_live_replicas",
+              # a gate threshold, not a trace shape (disagg itself
+              # stays in: roles change which engine serves what)
+              "min_ttft_improvement"):
         fp.pop(k, None)
     fp["n_blocks"] = n_blocks  # resolved, not the 0=auto sentinel
     return fp
@@ -2985,6 +3345,11 @@ def run_serve(mesh, cfg: ServeConfig, writer) -> list:
         from tpu_patterns.serve.replica import run_replicas
 
         return run_replicas(mesh, cfg, writer)
+    if cfg.disagg:
+        raise ValueError(
+            "serve --disagg splits a replica fleet into prefill and "
+            "decode pools — it needs --replicas N with P+D == N"
+        )
     if cfg.scenario:
         # the loadgen bridge: the model/pool knobs map one-to-one, the
         # SCENARIO owns the trace shape — --requests/--min_prompt/

@@ -98,6 +98,15 @@ SAMPLED_DECODE_DECLARED_COLLECTIVES = DECODE_DECLARED_COLLECTIVES | {
     ("all_gather", ("tp",)),
 }
 
+# The disagg KV-block wire's budget (comm/p2p.py make_block_stream via
+# PagedDecoder.stream_jit): pure pair-exchange data movement over sp —
+# ppermute there and back, no reduction, nothing else.  Registered as
+# the ``disagg.stream`` SpmdEntry so the transfer is a DECLARED
+# collective, never compiler drift.
+STREAM_DECLARED_COLLECTIVES = frozenset({
+    ("ppermute", ("sp",)),
+})
+
 
 class PagedLayout:
     """Closed-form slot math for the block pool.
@@ -450,6 +459,7 @@ class PagedDecoder:
         object.__setattr__(self, "_copy_cache", {})
         object.__setattr__(self, "_gather_cache", {})
         object.__setattr__(self, "_onload_cache", {})
+        object.__setattr__(self, "_stream_cache", {})
 
     # -- pool ------------------------------------------------------------
 
@@ -557,6 +567,12 @@ class PagedDecoder:
             fn = self._onload_cache[n] = self._build_onload()
         return fn
 
+    def stream_jit(self, n: int):
+        fn = self._stream_cache.get(n)
+        if fn is None:
+            fn = self._stream_cache[n] = self._build_stream()
+        return fn
+
     def compiled_buckets(self) -> tuple[int, int]:
         return len(self._prefill_cache), len(self._step_cache)
 
@@ -571,6 +587,7 @@ class PagedDecoder:
             "copy": set(self._copy_cache),
             "gather": set(self._gather_cache),
             "onload": set(self._onload_cache),
+            "stream": set(self._stream_cache),
         }
 
     def _build_prefill(self, prompt_len: int):
@@ -850,6 +867,19 @@ class PagedDecoder:
             ),
             donate_argnums=(0,),
         )
+
+    def _build_stream(self):
+        """The disagg prefill->decode wire (comm/p2p.py
+        ``make_block_stream``): the gathered wire payload ppermutes
+        across ``sp`` and back — the bidirectional-pair involution, so
+        the bytes cross the ICI yet land bit-identical — with the
+        payload DONATED (the staging copy is dead once shipped).  The
+        only collective is the declared ``ppermute`` over ``sp``
+        (STREAM_DECLARED_COLLECTIVES), audited via the
+        ``disagg.stream`` SpmdEntry."""
+        from tpu_patterns.comm.p2p import make_block_stream
+
+        return make_block_stream(self.mesh, self.pool_specs(), axis="sp")
 
     # -- params ----------------------------------------------------------
 

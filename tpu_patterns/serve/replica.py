@@ -126,6 +126,8 @@ class _StdinSource:
         self._reported_done: set[int] = set()
         self._reported_failed: set[int] = set()
         self._reported_shed: set[int] = set()
+        self._reported_first: set[int] = set()
+        self._reported_handoff: set[int] = set()
         self._last_hb_ns = 0
         # fleet observability (obs/fleet.py): span/counter deltas ship
         # at iteration boundaries; dump_obs banks ring + metrics into
@@ -152,6 +154,23 @@ class _StdinSource:
     def report(self) -> None:
         """Stream newly-terminal requests + a bounded-rate heartbeat."""
         eng = self._engine
+        # first-token instants ship BEFORE terminal buckets: the parent
+        # clocks TTFT on its own clock at receipt, and a request whose
+        # done lands in the same boundary batch must not look like its
+        # first token arrived after its last
+        for rid in list(eng.first_ns):
+            if rid not in self._reported_first:
+                self._reported_first.add(rid)
+                self._send({"op": "first", "rid": rid})
+        # disagg handoffs (prefill role): the wire manifest — tok0,
+        # sampling state, spool path — goes up so the parent can move
+        # the lease and pick a decode replica to adopt it
+        for rid in list(eng.handoffs):
+            if rid not in self._reported_handoff:
+                self._reported_handoff.add(rid)
+                self._send({
+                    "op": "handoff", "rid": rid, "m": eng.handoffs[rid],
+                })
         for rid in list(eng.done):
             if rid not in self._reported_done:
                 self._reported_done.add(rid)
@@ -241,7 +260,20 @@ class _StdinSource:
                     scenario=str(msg.get("scenario", "")),
                     jid=str(msg.get("jid", "")),
                     priority=str(msg.get("priority", "interactive")),
+                    # per-request sampling rides the wire too: before
+                    # these, a sampled scenario through --replicas
+                    # silently decoded greedy (and a resumed forced
+                    # session restarted its draw keys at 0)
+                    temperature=float(msg.get("temperature", 0.0)),
+                    top_k=int(msg.get("top_k", 0)),
+                    top_p=float(msg.get("top_p", 1.0)),
+                    seed=int(msg.get("seed", 0)),
+                    gen_offset=int(msg.get("gen_offset", 0)),
                 ))
+            elif op == "adopt":
+                # disagg: a handoff manifest routed here by the parent —
+                # queued for _admit_adopts at the next iteration head
+                self._engine.adopt_queue.append(dict(msg["m"]))
             elif op == "fin":
                 self.fin = True
             elif op == "drain":
@@ -284,6 +316,7 @@ class _StdinSource:
             and not batch
             and not eng.queue
             and not eng.active
+            and not eng.adopt_queue
         ):
             return None  # exhausted: the engine loop may exit
         return batch
@@ -321,6 +354,12 @@ def _child_stats(eng) -> dict:
         "sheds": len(eng.shed),
         "preempted": eng.stats["preempted"],
         "preempted_resumed": eng.stats["preempted_resumed"],
+        "handoffs": eng.stats["handoffs"],
+        "handoff_recomputes": eng.stats["handoff_recomputes"],
+        "transfer_bytes": eng.stats["transfer_bytes"],
+        "adopts": eng.stats["adopts"],
+        "adopted_blocks": eng.stats["adopted_blocks"],
+        "adopt_recomputes": eng.stats["adopt_recomputes"],
         "leaked_blocks": eng.leaked_blocks(),
     }
 
@@ -377,11 +416,24 @@ def replica_main() -> int:
             causal=True, dtype=cfg["dtype"], depth=cfg["depth"],
             kv_heads=cfg["kv_heads"], rope=cfg["rope"],
         )
+        role = str(init.get("role", ""))
         decoder = make_paged_lm_decoder(
             mesh, mcfg, cfg["vocab"], n_blocks=cfg["n_blocks"],
             block_len=cfg["block_len"], max_len=cfg["max_len"],
             cache_int8=cfg["cache_int8"],
-            attn=cfg.get("paged_attn", "dense"),
+            # per-pool backend config: a prefill-only pool never runs
+            # the decode/verify hot loop, so the fused decode-attention
+            # kernel choice must not be forwarded to it — it would
+            # compile (and on some backends require) cores the role
+            # never dispatches
+            attn=(
+                "dense" if role == "prefill"
+                else cfg.get("paged_attn", "dense")
+            ),
+            # sampled scenarios need the seeded-sampling cores in the
+            # CHILD decoder too (greedy rows through a sampling decoder
+            # stay bit-identical, so this is safe to turn on fleet-wide)
+            sampling=bool(cfg.get("sampling", False)),
         )
         # SAME seed in every replica -> bit-identical params -> a
         # rerouted request decodes to the same ids anywhere
@@ -438,6 +490,13 @@ def replica_main() -> int:
                     replica=replica,
                 ),
                 replica=replica,
+                # the warm-up engine must serve its trace end-to-end
+                # itself: a prefill role would ship the warm requests
+                # into the handoff spool instead of finishing them
+                role="" if warming else role,
+                spool_dir=(
+                    None if warming else (init.get("spool_dir") or None)
+                ),
             )
 
         # warm-up: serve the parent-supplied warm trace through a
@@ -621,6 +680,13 @@ class FleetResult:
         default_factory=dict
     )
     t_done_ns: dict[int, int] = dataclasses.field(default_factory=dict)
+    # front-door first-token instants, stamped on the PARENT clock when
+    # a child's ``first`` op arrives — the TTFT ledger the disagg A/B
+    # gates (identical measurement for unified and disagg fleets)
+    t_first_ns: dict[int, int] = dataclasses.field(default_factory=dict)
+    # disagg handoff settlement: rids that crossed the prefill->decode
+    # wire (recompute degradations included — they crossed as manifests)
+    handoff_rids: set[int] = dataclasses.field(default_factory=set)
     arrival_ms: dict[int, float] = dataclasses.field(
         default_factory=dict
     )
@@ -681,6 +747,38 @@ class FleetResult:
             for s in self.replica_stats.values()
         ))
 
+    def handoffs(self) -> int:
+        """Prefill->decode handoffs across every engine that reported
+        (recompute degradations included: they crossed as manifests)."""
+        return int(sum(
+            s.get("handoffs", 0) for s in self.replica_stats.values()
+        ))
+
+    def adopts(self) -> int:
+        return int(sum(
+            s.get("adopts", 0) for s in self.replica_stats.values()
+        ))
+
+    def adopted_blocks(self) -> int:
+        return int(sum(
+            s.get("adopted_blocks", 0)
+            for s in self.replica_stats.values()
+        ))
+
+    def transfer_bytes(self) -> int:
+        return int(sum(
+            s.get("transfer_bytes", 0)
+            for s in self.replica_stats.values()
+        ))
+
+    def disagg_recomputes(self) -> int:
+        """Handoffs that degraded to a local re-prefill on either side
+        of the wire — bounded recompute, never a torn block."""
+        return int(sum(
+            s.get("handoff_recomputes", 0) + s.get("adopt_recomputes", 0)
+            for s in self.replica_stats.values()
+        ))
+
     def scale_outs(self) -> int:
         return sum(1 for _, a, _ in self.scale_events if a == "out")
 
@@ -729,9 +827,40 @@ class ReplicaManager:
         warm: list | None = None,
         retry_policy=None,
         elastic: ElasticConfig | None = None,
+        roles: dict[str, str] | None = None,
     ):
         if n < 1:
             raise ValueError(f"replicas must be >= 1, got {n}")
+        # disaggregated fleet: roles maps replica id -> "prefill" |
+        # "decode".  Admission routes over the PREFILL ring only; decode
+        # replicas receive work exclusively through handoff adoption.
+        self.roles = dict(roles or {})
+        if self.roles:
+            if elastic is not None:
+                raise ValueError(
+                    "disagg and elastic are mutually exclusive: the "
+                    "scale controller reasons about one homogeneous "
+                    "pool of slots"
+                )
+            by_role = {"prefill": [], "decode": []}
+            for r in range(n):
+                role = self.roles.get(str(r), "")
+                if role not in by_role:
+                    raise ValueError(
+                        f"replica {r}: role must be prefill | decode, "
+                        f"got {role!r}"
+                    )
+                by_role[role].append(str(r))
+            if not by_role["prefill"] or not by_role["decode"]:
+                raise ValueError(
+                    "disagg needs at least one prefill and one decode "
+                    f"replica, got {len(by_role['prefill'])}:"
+                    f"{len(by_role['decode'])}"
+                )
+        # round-robin cursor over live decode replicas (handoff target
+        # picker) — plain rotation: adopted tables are all-fresh, so
+        # there is no prefix affinity to exploit on the decode side
+        self._decode_rr = 0
         # elastic fleet (serve/elastic.py): the ring is built over ALL
         # n + reserve ids up front with the reserves quarantined —
         # scale-out is ring.restore (only the reserve's own arc remaps)
@@ -760,7 +889,11 @@ class ReplicaManager:
             max_attempts=2, backoff_base_s=0.1
         )
         self.router = Router(
-            [str(r) for r in range(n_total)],
+            [
+                str(r) for r in range(n_total)
+                if not self.roles
+                or self.roles.get(str(r)) == "prefill"
+            ],
             block_len=int(child_cfg["block_len"]),
             policy=policy,
             route_blocks=route_blocks,
@@ -797,6 +930,13 @@ class ReplicaManager:
 
         rid = str(r)
         os.makedirs(self.work_dir, exist_ok=True)
+        spool_dir = None
+        if self.roles:
+            # the handoff wire spool: prefill children write KV payloads
+            # here (tmp + atomic rename), decode children adopt and
+            # unlink — one shared scratch dir per fleet
+            spool_dir = os.path.join(self.work_dir, "spool")
+            os.makedirs(spool_dir, exist_ok=True)
         stderr_path = os.path.join(self.work_dir, f"replica-{rid}.log")
         attempts = {"n": 0}
 
@@ -834,6 +974,8 @@ class ReplicaManager:
             "devices": self.device_slices[r],
             "sp": self.sp, "tp": self.tp,
             "cfg": self.child_cfg,
+            "role": self.roles.get(rid, ""),
+            "spool_dir": spool_dir,
             "snapshot_dir": os.path.join(
                 self.work_dir, f"replica-{rid}-snap"
             ),
@@ -1309,6 +1451,14 @@ class ReplicaManager:
                 res.done[r] = [int(t) for t in msg["ids"]]
                 res.t_done_ns[r] = clock_ns()
             h.breaker.success()
+        elif op == "first":
+            # front-door TTFT is stamped HERE, on the parent's clock —
+            # child perf_counter_ns values are not comparable across
+            # processes, and stamping at receipt measures the same
+            # thing for a unified and a disaggregated fleet
+            res.t_first_ns.setdefault(int(msg["rid"]), clock_ns())
+        elif op == "handoff":
+            self._adopt_handoff(h, msg, res)
         elif op == "shed":
             # the child's burn ladder shed this admission: terminal,
             # lease released, counted in its own bucket — a shed is
@@ -1382,6 +1532,84 @@ class ReplicaManager:
                 return
             self._replica_down(h, op.strip("_"), res)
         # hb / checkpointed: the timestamp update above is the point
+
+    # -- disaggregated prefill/decode handoff ----------------------------
+
+    def _pick_decode(self) -> ReplicaHandle | None:
+        """Round-robin over the LIVE decode pool.  Decode replicas are
+        not on the prefix ring (they never take admissions), so the
+        ring's affinity machinery does not apply — adopted blocks seed
+        each decode replica's own prefix index instead."""
+        live = sorted(
+            (h for h in self._live()
+             if self.roles.get(h.id) == "decode"),
+            key=lambda h: int(h.id),
+        )
+        if not live:
+            return None
+        pick = live[self._decode_rr % len(live)]
+        self._decode_rr += 1
+        return pick
+
+    def _adopt_handoff(
+        self, h: ReplicaHandle, msg: dict, res: FleetResult
+    ) -> None:
+        """A prefill replica finished its half of ``rid``: move the
+        lease to a decode replica and forward the KV-block manifest.
+        The transfer itself already happened child-side (spool file on
+        shared disk, wire format = the host-tier eviction layout); the
+        parent is the control plane — it picks the adopter, keeps the
+        lease table leak-free, and books WHY."""
+        from tpu_patterns import obs
+
+        r = int(msg["rid"])
+        m = dict(msg["m"])
+        h.leases.release(r)
+        h.breaker.success()  # the prefill leg served its half
+        if r in res.done or r in res.failed or r in res.shed:
+            return
+        res.handoff_rids.add(r)
+        d = self._pick_decode()
+        recompute = bool(m.get("recompute"))
+        # counter identity with the decision ledger: ONE transfers
+        # tick per handoff decision, recompute degradations included;
+        # the payload counters count real shipped bytes/blocks only
+        obs.counter("tpu_patterns_disagg_transfers_total").inc()
+        if not recompute:
+            obs.counter(
+                "tpu_patterns_disagg_adopted_blocks_total"
+            ).inc(int(m.get("blocks", 0)))
+            obs.counter(
+                "tpu_patterns_disagg_transfer_bytes_total"
+            ).inc(int(m.get("nbytes", 0)))
+        self.decisions.book(
+            "handoff", rid=r, jid=str(m.get("jid", "")),
+            rationale=(
+                "prefill transfer degraded; decode pool re-prefills "
+                "from the prompt" if recompute else
+                "prefill complete; KV blocks shipped to the decode "
+                "pool over the block stream"
+            ),
+            src=h.id, dst=d.id if d else "",
+            blocks=int(m.get("blocks", 0)),
+            nbytes=int(m.get("nbytes", 0)),
+            recompute=recompute,
+            decode_live=0 if d is None else 1,
+        )
+        if d is None:
+            res.failed[r] = "no live decode replica left to adopt"
+            return
+        obs.event(
+            "journey.handoff", jid=str(m.get("jid", "")),
+            rid=str(r), src=h.id, replica=d.id,
+        )
+        try:
+            d.leases.acquire(r, meta=res.requests_by_rid.get(r))
+            d.send({"op": "adopt", "m": m})
+        except ReplicaError:
+            # adopter died at the send: standard fail-over settles its
+            # leases (this rid included) back through the prefill ring
+            self._replica_down(d, "send failed at adopt", res)
 
     def _check_watchdogs(self, res: FleetResult) -> None:
         now = clock_ns()
@@ -1502,6 +1730,12 @@ def _req_msg(req: Request) -> dict:
         "n_gen": req.n_gen, "deadline_ms": req.deadline_ms,
         "scenario": req.scenario, "jid": req.jid,
         "priority": req.priority,
+        # sampling identity MUST cross the pipe: dropping it silently
+        # turned every sampled child request greedy (seed/gen_offset
+        # are also what keep an adopted row's key stream aligned)
+        "temperature": req.temperature, "top_k": req.top_k,
+        "top_p": req.top_p, "seed": req.seed,
+        "gen_offset": req.gen_offset,
     }
 
 
@@ -1539,6 +1773,24 @@ def _goodput(res: FleetResult, priority: str | None = None) -> float:
         if e2e_ms <= req.deadline_ms:
             good += len(ids)
     return good / total
+
+
+def _ttft_p99(res: FleetResult) -> float:
+    """Front-door p99 time-to-first-token over completed requests, in
+    ms: the parent-clock first-token stamp minus the request's
+    scheduled arrival offset.  Child perf-counter values never cross
+    the pipe — both A/B legs stamp at the parent's receipt of the
+    child ``first`` op, so the comparison measures like with like
+    (queueing, routing, and handoff latency all included)."""
+    waits = [
+        (res.t_first_ns[rid] - res.t0_ns) / 1e6
+        - res.arrival_ms.get(rid, 0.0)
+        for rid in res.done
+        if rid in res.t_first_ns
+    ]
+    if not waits:
+        return -1.0
+    return float(np.percentile(np.asarray(waits), 99.0))
 
 
 def run_replicas(mesh, cfg, writer) -> list:
@@ -1587,6 +1839,35 @@ def run_replicas(mesh, cfg, writer) -> list:
             "Record is the diurnal-ramp A/B, and priority classes ride "
             "the scenario schedule"
         )
+    roles: dict[str, str] | None = None
+    n_pre = n_dec = 0
+    if cfg.disagg:
+        if reserve:
+            raise ValueError(
+                "serve --disagg and --elastic_reserve are mutually "
+                "exclusive: role assignment is static for this Record"
+            )
+        try:
+            n_pre, n_dec = (int(x) for x in cfg.disagg.split(":"))
+        except ValueError:
+            raise ValueError(
+                f"--disagg wants P:D (two integers), got "
+                f"{cfg.disagg!r}"
+            ) from None
+        if n_pre < 1 or n_dec < 1:
+            raise ValueError(
+                f"--disagg {cfg.disagg}: need at least one prefill "
+                "and one decode replica"
+            )
+        if n_pre + n_dec != n:
+            raise ValueError(
+                f"--disagg {cfg.disagg}: P+D = {n_pre + n_dec} must "
+                f"equal --replicas {n}"
+            )
+        roles = {
+            str(i): ("prefill" if i < n_pre else "decode")
+            for i in range(n)
+        }
     flat = [d for d in np.asarray(mesh.devices).flat]
     tp = int(mesh.shape["tp"])
     # the elastic fleet pre-partitions n + reserve DISJOINT slices up
@@ -1669,6 +1950,10 @@ def run_replicas(mesh, cfg, writer) -> list:
         "kv_host_tier": cfg.kv_host_tier,
         "host_tier_blocks": cfg.host_tier_blocks,
         "preempt": cfg.preempt,
+        # children must build the sampling decoder iff any request in
+        # the trace samples (the runner.py idiom) — a greedy decoder
+        # silently argmaxes a temperature>0 request otherwise
+        "sampling": any(r.temperature > 0 for _, r in timed),
     }
     # warm every executable bucket the trace will touch BEFORE timing:
     # a slice of the real trace, generation capped so warm-up is cheap
@@ -1685,6 +1970,7 @@ def run_replicas(mesh, cfg, writer) -> list:
     def fleet(
         n_replicas: int, policy: str, tag: str, primary: bool = False,
         elastic: ElasticConfig | None = None,
+        roles: dict[str, str] | None = None,
     ) -> FleetResult:
         # the PRIMARY leg's per-replica obs dirs live under the run's
         # obs dir (`<obs_dir>/replica-<id>/`), where `obs fleet` /
@@ -1707,6 +1993,7 @@ def run_replicas(mesh, cfg, writer) -> list:
             ),
             warm=warm,
             elastic=elastic,
+            roles=roles,
         )
         writer.progress(
             f"fleet[{tag}]: spawning {n_replicas} replica(s) x "
@@ -1748,6 +2035,117 @@ def run_replicas(mesh, cfg, writer) -> list:
             r.rid for r in reqs if res.done[r.rid] != want[r.rid]
         ]
         return (0.0 if bad else 1.0), bad
+
+    if roles is not None:
+        # -- disagg Record (P:D split vs unified, equal devices) -----
+        # Same device count, same schedule, same per-replica slice:
+        # a fleet split P prefill + D decode — prefill replicas admit,
+        # fill paged blocks, and ship each finished request's KV over
+        # the block stream for a decode replica to adopt — against a
+        # unified fleet of N identical replicas.  The gates: both legs
+        # covered/exact/leak-free, at least one REAL handoff crossed
+        # the wire, and (with --min_ttft_improvement set) front-door
+        # TTFT p99 at least that factor better than unified.
+        res_d = fleet(
+            n, cfg.replica_policy, "disagg", primary=True,
+            roles=roles,
+        )
+        res_u = fleet(n, cfg.replica_policy, "unified")
+        # one dense decode of the schedule serves both legs
+        want_all = _dense_expected(
+            mesh, sp_parent, mcfg, oracle_cfg, flat_params,
+            [r for _, r in timed],
+        )
+        exact_d, bad_d = exactness(res_d, want_all)
+        exact_u, bad_u = exactness(res_u, want_all)
+        p99_d, p99_u = _ttft_p99(res_d), _ttft_p99(res_u)
+        improvement = p99_u / p99_d if p99_d > 0 else 0.0
+        counts_d, counts_u = res_d.counts(), res_u.counts()
+        transfers = res_d.handoffs()
+        ok = (
+            res_d.covered() and res_u.covered()
+            and exact_d == 1.0 and exact_u == 1.0
+            and res_d.leaked_blocks() == 0
+            and res_u.leaked_blocks() == 0
+            and transfers >= 1
+        )
+        if cfg.min_ttft_improvement > 0:
+            ok = ok and improvement >= cfg.min_ttft_improvement
+        rec = Record(
+            pattern="serve",
+            mode=(
+                f"disagg_{spec.name if spec else 'trace'}_"
+                f"p{n_pre}d{n_dec}_sp{child_sp}"
+            ),
+            commands=(
+                f"{cfg.scenario or _serve_commands(cfg)} | "
+                f"{n_pre} prefill + {n_dec} decode x "
+                f"sp{child_sp}tp{tp} vs {n} unified"
+            ),
+            metrics={
+                "requests": float(len(timed)),
+                "ttft_p99_ms_disagg": round(p99_d, 3),
+                "ttft_p99_ms_unified": round(p99_u, 3),
+                "ttft_improvement": round(improvement, 4),
+                "transfers": float(transfers),
+                "adopts": float(res_d.adopts()),
+                "adopted_blocks": float(res_d.adopted_blocks()),
+                "transfer_bytes": float(res_d.transfer_bytes()),
+                "recomputes": float(res_d.disagg_recomputes()),
+                "done_disagg": float(counts_d["done_total"]),
+                "done_unified": float(counts_u["done_total"]),
+                "failed": float(
+                    counts_d["failed_total"] + counts_u["failed_total"]
+                ),
+                "rerouted": float(counts_d["rerouted"]),
+                "exact": float(exact_d == 1.0 and exact_u == 1.0),
+                "covered": float(
+                    res_d.covered() and res_u.covered()
+                ),
+                "leaked_blocks": float(
+                    res_d.leaked_blocks() + res_u.leaked_blocks()
+                ),
+            },
+            verdict=Verdict.SUCCESS if ok else Verdict.FAILURE,
+        )
+        if transfers < 1:
+            rec.notes.append(
+                "no request crossed the prefill->decode wire — the "
+                "split fleet never exercised the handoff path and the "
+                "A/B is vacuous"
+            )
+        if 0 < improvement < cfg.min_ttft_improvement:
+            rec.notes.append(
+                f"TTFT p99 improvement {improvement:.3f}x < gate "
+                f"{cfg.min_ttft_improvement:g}x ({p99_d:.1f}ms disagg "
+                f"vs {p99_u:.1f}ms unified) — dedicating {n_pre} "
+                "replica(s) to prefill did not pay on this schedule"
+            )
+        for tag, bad in (("disagg", bad_d), ("unified", bad_u)):
+            if bad:
+                rec.notes.append(
+                    f"exactness FAILED on the {tag} leg for "
+                    f"request(s) {bad[:8]}: ids diverged from dense "
+                    "decode (adopted completions gate here too)"
+                )
+        for tag, r in (("disagg", res_d), ("unified", res_u)):
+            if not r.covered():
+                missing = sorted(
+                    set(r.requests_by_rid) - set(r.done)
+                    - set(r.failed) - set(r.shed)
+                )
+                rec.notes.append(
+                    f"coverage identity broken on the {tag} leg: "
+                    f"request(s) {missing[:8]} unaccounted"
+                )
+        if res_d.disagg_recomputes():
+            rec.notes.append(
+                f"{res_d.disagg_recomputes()} handoff(s) degraded to "
+                "a re-prefill (transfer or adopt fault) — bounded "
+                "recompute, completions still exact"
+            )
+        writer.record(rec)
+        return [rec]
 
     if spec is not None and reserve:
         # -- elastic Record (diurnal-ramp A/B: elastic vs static) ----
