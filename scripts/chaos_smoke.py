@@ -69,7 +69,17 @@ heals itself, visibly:
       handoff), close the accounting identity (every request done or
       failed, rerouted > 0), keep every completion — adopted ones
       included — bit-identical to dense decode, and leak zero blocks
-      across BOTH pools.
+      across BOTH pools;
+  (i) warm fail-over through the fleet prefix store: a 2-replica
+      fleet on the 75%-shared chat schedule with ``--prefix_store``
+      attached has its busy arc-owner SIGKILLed mid-trace (shared
+      fault-state dir: the single firing is spent fleet-wide) — the
+      dead replica's eagerly-published blocks must be fetched by the
+      survivor (publishes >= 1, hits >= 1), the rerouted requests'
+      fresh prefill blocks must drop STRICTLY below the same kill
+      without the store, and both legs stay exact + leak-free (the
+      full A/B with byte-level gates is scripts/prefix_store_smoke.py
+      — this case pins the chaos surface end-to-end).
 
 Zero dependencies beyond the package; exit 0 = pass.
 """
@@ -599,6 +609,75 @@ def main() -> int:
         return fail(f"disagg-kill: {m.get('leaked_blocks')} block(s) "
                     "leaked across the prefill/decode pools")
 
+    # (i) warm fail-over through the fleet prefix store: the same
+    # SIGKILL-the-busy-owner leg as scripts/prefix_store_smoke.py,
+    # run once per side — the store side's rerouted requests must
+    # prefill strictly fewer fresh blocks (they fetch the dead
+    # replica's published prefixes instead), with both sides exact
+    # and leak-free.  The shared fault-state dir is load-bearing:
+    # both children inherit the kill spec, and only a GLOBAL ordinal
+    # keeps the survivor alive after the reroute.
+    ps_args = [
+        "serve", "--dp", "1", "--tp", "2",
+        "--vocab", "64", "--embed", "64", "--head_dim", "8",
+        "--depth", "1", "--requests", "8", "--min_prompt", "4",
+        "--max_prompt", "16", "--gen", "6", "--slots", "4",
+        "--block_len", "8", "--replicas", "2",
+        "--min_replica_speedup", "0",
+        "--prefix_share", "true", "--kv_host_tier", "true",
+    ]
+    ps_fresh = {}
+    for tag, extra in (
+        ("store-kill-base", []),
+        ("store-kill-warm",
+         ["--prefix_store", os.path.join(work, "prefix-store")]),
+    ):
+        ps_jsonl = os.path.join(work, f"{tag}.jsonl")
+        rc = _run(
+            tag,
+            [*py, "--jsonl", ps_jsonl, *ps_args,
+             "--replica_dir", os.path.join(work, f"{tag}-work"),
+             *extra],
+            _env("serve.step:kill:after=4:count=1",
+                 os.path.join(work, f"{tag}-state")),
+        )
+        if rc != 0:
+            return fail(f"{tag}: fleet run exited nonzero — a replica "
+                        "kill is a WARNING, not a crash")
+        with open(ps_jsonl) as f:
+            ps = [json.loads(ln) for ln in f if ln.strip()][-1]
+        m = ps.get("metrics", {})
+        print(f"  [{tag}] verdict={ps.get('verdict')} "
+              f"done={m.get('done')} rerouted={m.get('rerouted')} "
+              f"exact={m.get('exact')} leaked={m.get('leaked_blocks')} "
+              f"rerouted_fresh_blocks={m.get('rerouted_fresh_blocks')} "
+              f"publishes={m.get('store_publishes')} "
+              f"hits={m.get('store_hits')}", flush=True)
+        if ps.get("verdict") == "FAILURE":
+            return fail(f"{tag}: fleet Record FAILED: {ps.get('notes')}")
+        if (
+            m.get("done", 0) + m.get("failed", 0) + m.get("rerouted", 0)
+            != m.get("scheduled")
+        ) or m.get("covered") != 1.0 or not m.get("rerouted", 0) > 0:
+            return fail(f"{tag}: fail-over ledger broken or no reroute")
+        if m.get("exact") != 1.0 or m.get("leaked_blocks") != 0.0:
+            return fail(
+                f"{tag}: exact={m.get('exact')} "
+                f"leaked={m.get('leaked_blocks')} — a migrated block "
+                "round-tripped wrong bytes or leaked through fail-over"
+            )
+        ps_fresh[tag] = m.get("rerouted_fresh_blocks", -1.0)
+    if not (
+        ps_fresh["store-kill-warm"] >= 0
+        and ps_fresh["store-kill-warm"] < ps_fresh["store-kill-base"]
+    ):
+        return fail(
+            f"store-kill: rerouted fresh prefill "
+            f"{ps_fresh['store-kill-warm']} not strictly below the "
+            f"{ps_fresh['store-kill-base']} private-tier baseline — "
+            "the fleet store did not make fail-over land warm"
+        )
+
     print("chaos smoke: all gates passed "
           "(cell retry, worker fallback, preempt/resume exactness, "
           "verify-fault quarantine + refcount balance, "
@@ -606,7 +685,8 @@ def main() -> int:
           "replica fail-over: kill + drain legs incl. fleet-metric "
           "identity + stitched cross-replica journeys, "
           "mid-evict kill -> session-cache resume exactness, "
-          "disagg handoff kill -> prefill-ring reroute exactness)",
+          "disagg handoff kill -> prefill-ring reroute exactness, "
+          "prefix-store warm fail-over: strict fresh-prefill drop)",
           flush=True)
     return 0
 

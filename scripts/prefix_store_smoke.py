@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""CI gate for the fleet prefix store (docs/serving.md "Fleet prefix
+store", docs/robustness.md chaos case (i)).
+
+One warm-failover A/B through the real CLI on the simulated 8-device
+CPU mesh: a 2-replica fleet on the 75%-shared chat schedule
+(``--prefix_share``) has its busy arc-owner SIGKILLed mid-trace
+(``serve.step:kill`` with a SHARED fault-state dir, so the single
+firing is spent fleet-wide and the survivor keeps stepping), run twice:
+
+  base  — private host tiers only: the survivor re-prefills every
+          rerouted request's shared prefix from scratch;
+  store — ``--prefix_store`` attached: the dead replica's retained and
+          evicted blocks reached the shared atomic-commit directory
+          BEFORE the kill (publishes are eager, bounded per
+          iteration), so the survivor's admission misses fetch the
+          migrated blocks instead.
+
+Gates:
+
+  * both legs exit 0 and close the fail-over ledger — done + failed +
+    rerouted == scheduled, covered, rerouted > 0, greedy ids
+    bit-identical to dense decode (``exact == 1``: fetched blocks'
+    int8/f32 planes round-tripped bit-exact through the store), zero
+    blocks leaked fleet-wide;
+  * the store leg published (>= 1) and the survivor fetched (>= 1
+    hit) — the migration actually crossed processes;
+  * the headline: the store leg's rerouted requests prefilled STRICTLY
+    fewer fresh full prompt blocks than the base leg's
+    (``rerouted_fresh_blocks``) — fail-over landed warm.
+
+Zero dependencies beyond the package; exit 0 = pass.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the validated recipe: 8 shared-prefix requests, kill the busy
+# arc-owner at its 5th scheduler iteration — deterministic on the
+# seeded trace (the idle replica's engine never steps in act one, so
+# the global ordinal lands on the owner serving the shared prefix)
+KILL = "serve.step:kill:after=4:count=1"
+SERVE_ARGS = [
+    "serve", "--dp", "1", "--tp", "2",
+    "--vocab", "64", "--embed", "64", "--head_dim", "8", "--depth", "1",
+    "--requests", "8", "--min_prompt", "4", "--max_prompt", "16",
+    "--gen", "6", "--slots", "4", "--block_len", "8",
+    "--replicas", "2", "--min_replica_speedup", "0",
+    "--prefix_share", "true", "--kv_host_tier", "true",
+]
+
+
+def _env(faults: str = "", state: str = "") -> dict:
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.pop("TPU_PATTERNS_FAULTS", None)
+    env.pop("TPU_PATTERNS_FAULTS_STATE", None)
+    if faults:
+        env["TPU_PATTERNS_FAULTS"] = faults
+    if state:
+        env["TPU_PATTERNS_FAULTS_STATE"] = state
+    return env
+
+
+def _run(tag: str, cmd: list[str], env: dict) -> int:
+    print(f"+ [{tag}]", " ".join(cmd), flush=True)
+    t0 = time.monotonic()
+    proc = subprocess.run(cmd, env=env, cwd=ROOT)
+    print(f"  [{tag}] rc={proc.returncode} "
+          f"wall={time.monotonic() - t0:.1f}s", flush=True)
+    return proc.returncode
+
+
+def fail(msg: str) -> int:
+    print(f"prefix-store smoke: {msg}", file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    work = tempfile.mkdtemp(prefix="prefix_store_smoke_")
+    py = [sys.executable, "-m", "tpu_patterns"]
+
+    def leg(tag: str, extra: list[str]):
+        jsonl = os.path.join(work, f"{tag}.jsonl")
+        rc = _run(
+            tag,
+            [*py, "--jsonl", jsonl, *SERVE_ARGS,
+             "--replica_dir", os.path.join(work, f"{tag}-work"),
+             *extra],
+            # the shared fault-state dir is load-bearing: both replica
+            # children inherit the kill spec, and only a GLOBAL
+            # ordinal spends the single firing fleet-wide — without
+            # it the survivor kills itself after the reroute
+            _env(KILL, os.path.join(work, f"{tag}-state")),
+        )
+        if rc != 0:
+            return None
+        with open(jsonl) as f:
+            return [json.loads(ln) for ln in f if ln.strip()][-1]
+
+    store_dir = os.path.join(work, "store")
+    legs = {}
+    for tag, extra in (
+        ("base", []),
+        ("store", ["--prefix_store", store_dir]),
+    ):
+        rec = leg(tag, extra)
+        if rec is None:
+            return fail(f"{tag} leg exited nonzero — a replica kill "
+                        "is a WARNING, not a crash")
+        m = rec.get("metrics", {})
+        print(f"  [{tag}] verdict={rec.get('verdict')} "
+              f"done={m.get('done')} failed={m.get('failed')} "
+              f"rerouted={m.get('rerouted')} exact={m.get('exact')} "
+              f"leaked={m.get('leaked_blocks')} "
+              f"rerouted_fresh_blocks={m.get('rerouted_fresh_blocks')} "
+              f"publishes={m.get('store_publishes')} "
+              f"hits={m.get('store_hits')} "
+              f"fetch_bytes={m.get('store_fetch_bytes')}", flush=True)
+        if rec.get("verdict") == "FAILURE":
+            return fail(f"{tag}: fleet Record FAILED: "
+                        f"{rec.get('notes')}")
+        if (
+            m.get("done", 0) + m.get("failed", 0)
+            + m.get("rerouted", 0) != m.get("scheduled")
+        ) or m.get("covered") != 1.0:
+            return fail(
+                f"{tag}: accounting identity broken — done "
+                f"{m.get('done')} + failed {m.get('failed')} + "
+                f"rerouted {m.get('rerouted')} != "
+                f"{m.get('scheduled')} scheduled"
+            )
+        if not m.get("rerouted", 0) > 0:
+            return fail(f"{tag}: the kill never forced a reroute")
+        if m.get("exact") != 1.0:
+            return fail(
+                f"{tag}: rerouted requests diverged from dense "
+                "decode — a migrated block round-tripped wrong bytes"
+            )
+        if m.get("leaked_blocks") != 0.0:
+            return fail(f"{tag}: {m.get('leaked_blocks')} block(s) "
+                        "leaked fleet-wide through fail-over")
+        legs[tag] = m
+
+    # the migration crossed processes, visibly
+    if not legs["store"].get("store_publishes", 0) >= 1:
+        return fail("store leg published nothing — the dead replica's "
+                    "blocks never reached the shared directory")
+    if not legs["store"].get("store_hits", 0) >= 1:
+        return fail("store leg fetched nothing — the survivor "
+                    "re-prefilled instead of consulting the store")
+
+    # the headline: fail-over lands warm
+    base_fresh = legs["base"].get("rerouted_fresh_blocks", -1.0)
+    store_fresh = legs["store"].get("rerouted_fresh_blocks", -1.0)
+    if not (store_fresh >= 0 and base_fresh >= 0):
+        return fail("rerouted_fresh_blocks missing from a leg's Record")
+    if not store_fresh < base_fresh:
+        return fail(
+            f"store leg's rerouted requests prefilled {store_fresh} "
+            f"fresh block(s) vs {base_fresh} baseline — the fleet "
+            "store did not make fail-over land warm"
+        )
+
+    print("prefix-store smoke: all gates passed (both legs exact + "
+          "leak-free, store published and fetched across processes, "
+          f"rerouted fresh prefill {store_fresh} < {base_fresh} "
+          "baseline)", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

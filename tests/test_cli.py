@@ -103,6 +103,30 @@ class TestParser:
             with pytest.raises(SystemExit):
                 main(argv)
 
+    def test_serve_prefix_store_flag_surface(self):
+        # the fleet prefix store parses with its fleet context...
+        args = build_parser().parse_args([
+            "serve", "--replicas", "2", "--prefix_store", "/tmp/ps",
+            "--kv_host_tier", "true", "--prefix_share", "true",
+        ])
+        assert args.prefix_store == "/tmp/ps"
+        assert args.kv_host_tier is True
+        # ...and every unservable combo exits loudly at PARSE time
+        # (the silent-accept path where fleet children dropped the
+        # flag is gone): no host tier, no fleet, disagg split, and
+        # the routing A/B (store warmth would leak between its legs)
+        for argv in (
+            ["serve", "--replicas", "2", "--prefix_store", "/tmp/ps"],
+            ["serve", "--prefix_store", "/tmp/ps",
+             "--kv_host_tier", "true"],
+            ["serve", "--replicas", "4", "--disagg", "2:2",
+             "--prefix_store", "/tmp/ps", "--kv_host_tier", "true"],
+            ["serve", "--replicas", "2", "--prefix_store", "/tmp/ps",
+             "--kv_host_tier", "true", "--scenario", "prefix_aware"],
+        ):
+            with pytest.raises(SystemExit):
+                main(argv)
+
     def test_config_fields_become_flags(self):
         args = build_parser().parse_args(["p2p", "--count", "123", "--dtype", "bfloat16"])
         assert args.count == 123 and args.dtype == "bfloat16"

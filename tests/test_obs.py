@@ -394,6 +394,43 @@ class TestMetrics:
             ("tpu_patterns_serve_kv_evict_bytes_sum", ())
         ] == 49152.0
 
+    def test_store_series_export_cleanly(self):
+        # the PR 20 fleet-prefix-store series (serve/engine.py store
+        # section + replica.py prewarm): publish/fetch traffic
+        # histograms export bucket/sum/count, counters carry _total
+        reg = obs_metrics.Registry()
+        reg.counter("tpu_patterns_store_publishes_total").inc(3)
+        reg.counter("tpu_patterns_store_hits_total").inc(2)
+        reg.counter("tpu_patterns_store_prewarms_total").inc(4)
+        reg.counter("tpu_patterns_store_fallbacks_total").inc()
+        reg.counter("tpu_patterns_fleet_prewarms_total").inc()
+        pub = reg.histogram("tpu_patterns_store_publish_bytes")
+        pub.observe(4096.0)
+        pub.observe(4096.0)
+        reg.histogram("tpu_patterns_store_fetch_bytes").observe(4096.0)
+        text = reg.to_prom_text()
+        assert (
+            "# TYPE tpu_patterns_store_publishes_total counter" in text
+        )
+        assert (
+            "# TYPE tpu_patterns_store_publish_bytes histogram" in text
+        )
+        samples = obs.parse_prom_text(text)
+        assert samples[("tpu_patterns_store_publishes_total", ())] == 3
+        assert samples[("tpu_patterns_store_hits_total", ())] == 2
+        assert samples[("tpu_patterns_store_prewarms_total", ())] == 4
+        assert samples[("tpu_patterns_store_fallbacks_total", ())] == 1
+        assert samples[("tpu_patterns_fleet_prewarms_total", ())] == 1
+        assert samples[
+            ("tpu_patterns_store_publish_bytes_count", ())
+        ] == 2
+        assert samples[
+            ("tpu_patterns_store_publish_bytes_sum", ())
+        ] == 8192.0
+        assert samples[
+            ("tpu_patterns_store_fetch_bytes_count", ())
+        ] == 1
+
     def test_router_and_replica_series_export_with_replica_label(self):
         # the PR-12 fleet series (serve/router.py, serve/replica.py):
         # routed / prefix-hit / reroute counters and the breaker-open

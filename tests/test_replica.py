@@ -62,6 +62,19 @@ class TestSiteRegistry:
         (spec,) = faults.parse_spec("replica.obs_ship:error:replica=1")
         assert spec.match == (("replica", "1"),)
 
+    def test_store_sites_are_registered_with_match_keys(self):
+        # PR 20 fleet prefix-store sites: every store round-trip is
+        # injectable, scoped down to a single block's fingerprint
+        for site in ("store.publish", "store.fetch", "store.prewarm"):
+            assert site in faults.KNOWN_SITES
+        assert "fingerprint" in faults.MATCH_KEYS
+        (spec,) = faults.parse_spec("store.fetch:error:fingerprint=ab12")
+        assert spec.match == (("fingerprint", "ab12"),)
+        (spec,) = faults.parse_spec("store.publish:error:rid=3")
+        assert spec.match == (("rid", "3"),)
+        (spec,) = faults.parse_spec("store.prewarm:error:replica=1")
+        assert spec.match == (("replica", "1"),)
+
 
 class TestPrefixFingerprint:
     def test_same_block_prefix_same_fingerprint(self):
@@ -1138,3 +1151,146 @@ class TestDisaggHandoffPlane:
         mgr._replica_down(mgr.handles["1"], "test kill", res)
         assert 0 in res.rerouted
         assert set(mgr.handles["0"].leases.held()) == {0}
+
+
+class TestScaleOutPrewarm:
+    """PR 20: a just-joined elastic spawn is shipped its ring arc's
+    hottest fleet-store prefixes — the parent picks PATHS (arc filter,
+    hottest-first, ancestor closure, shallow-first order), the child
+    fetches the bytes itself."""
+
+    LEAVES = {"k": ((1, 8, 1, 2), __import__("numpy").dtype("float32"))}
+
+    def _seed_store(self, root, paths):
+        import numpy as np
+
+        from tpu_patterns.serve.store import PrefixStore
+
+        st = PrefixStore(str(root), self.LEAVES, block_len=8)
+        for i, p in enumerate(paths):
+            st.publish(
+                {"k": np.full((1, 8, 1, 2), float(i), np.float32)},
+                p,
+            )
+        return st
+
+    def _ready_spawn(self, monkeypatch, tmp_path, store_paths):
+        monkeypatch.setattr(
+            "tpu_patterns.exec.proc.popen_in_group",
+            lambda *a, **k: _FakeProc(),
+        )
+        mgr = _elastic_manager()
+        mgr.work_dir = str(tmp_path)
+        sd = tmp_path / "store"
+        self._seed_store(sd, store_paths)
+        mgr.child_cfg["prefix_store"] = str(sd)
+        res = _res(mgr, [])
+        mgr._scale_out(1.0, res)
+        mgr._handle("1", {"ready": True, "pid": 1}, res)
+        return mgr, mgr.handles["1"]
+
+    def test_ready_ships_only_the_arc_shallow_first(
+        self, monkeypatch, tmp_path, no_real_kill
+    ):
+        import numpy as np
+
+        from tpu_patterns.serve.router import prefix_fingerprint
+
+        rng = np.random.RandomState(7)
+        paths = [
+            tuple(int(t) for t in rng.randint(0, 64, size=8))
+            for _ in range(12)
+        ]
+        # two deep children whose parents the store also holds — the
+        # closure must ship parent before child
+        paths += [
+            paths[0] + tuple(int(t) for t in rng.randint(0, 64, size=8)),
+            paths[1] + tuple(int(t) for t in rng.randint(0, 64, size=8)),
+        ]
+        mgr, h = self._ready_spawn(monkeypatch, tmp_path, paths)
+        sent = [m for m in h.proc.stdin.sent if m.get("op") == "prewarm"]
+        assert len(sent) == 1
+        got = [tuple(p) for p in sent[0]["paths"]]
+        # only paths whose fingerprint lands on the newcomer's arc
+        want = {
+            p for p in paths
+            if mgr.router.ring.lookup(
+                prefix_fingerprint(list(p), 8, mgr.router.route_blocks)
+            ) == "1"
+        }
+        # ... closed over in-store ancestors
+        want |= {
+            p[:k] for p in want for k in range(8, len(p), 8)
+            if p[:k] in set(paths)
+        }
+        assert set(got) == want
+        assert got == sorted(got, key=lambda p: (len(p), p))
+        # deep entries never precede their in-store parents
+        seen = set()
+        for p in got:
+            if len(p) > 8 and p[:-8] in want:
+                assert p[:-8] in seen
+            seen.add(p)
+
+    def test_empty_or_missing_store_is_a_cold_start(
+        self, monkeypatch, tmp_path, no_real_kill
+    ):
+        monkeypatch.setattr(
+            "tpu_patterns.exec.proc.popen_in_group",
+            lambda *a, **k: _FakeProc(),
+        )
+        mgr = _elastic_manager()
+        mgr.work_dir = str(tmp_path)
+        mgr.child_cfg["prefix_store"] = str(tmp_path / "nowhere")
+        res = _res(mgr, [])
+        mgr._scale_out(1.0, res)
+        mgr._handle("1", {"ready": True, "pid": 1}, res)
+        h = mgr.handles["1"]
+        assert h.state == "ready"
+        assert not [
+            m for m in h.proc.stdin.sent if m.get("op") == "prewarm"
+        ]
+
+    def test_no_store_configured_sends_nothing(
+        self, monkeypatch, tmp_path, no_real_kill
+    ):
+        monkeypatch.setattr(
+            "tpu_patterns.exec.proc.popen_in_group",
+            lambda *a, **k: _FakeProc(),
+        )
+        mgr = _elastic_manager()
+        mgr.work_dir = str(tmp_path)
+        res = _res(mgr, [])
+        mgr._scale_out(1.0, res)
+        mgr._handle("1", {"ready": True, "pid": 1}, res)
+        assert not [
+            m for m in mgr.handles["1"].proc.stdin.sent
+            if m.get("op") == "prewarm"
+        ]
+
+    def test_stdin_prewarm_op_reaches_the_engine(self):
+        # the child half of the wire: a prewarm op calls
+        # ServeEngine.prewarm_paths at the iteration boundary
+        class _Eng(_FakeEngine):
+            def __init__(self):
+                super().__init__()
+                self.prewarmed = []
+
+            def prewarm_paths(self, paths):
+                self.prewarmed.append(paths)
+                return len(paths)
+
+        eng = _Eng()
+        sent = []
+        src = _StdinSource(
+            iter([json.dumps(
+                {"op": "prewarm", "paths": [[1, 2], [3, 4]]}
+            )]),
+            eng, sent.append,
+        )
+        src._last_hb_ns = 0
+        for _ in range(50):
+            src()
+            if eng.prewarmed:
+                break
+        assert eng.prewarmed == [[[1, 2], [3, 4]]]

@@ -1,0 +1,633 @@
+"""Fleet-scoped shared prefix store (serve/store.py + engine wiring):
+commit-protocol atomicity (tmp + os.replace, last-commit-wins, never a
+torn read), round-trip bit-identity (f32 and int8 scale planes), loud
+rejection of foreign/corrupt entries, the admission-miss fetch path
+(indistinguishable from a local host-tier hit), scale-out pre-warm,
+fault-site degradation (retry transients, recompute on deterministic
+failure — never a half-adopted block), and the randomized concurrent
+publish/fetch/evict/death property with per-engine AND fleet-wide
+refcount/leak invariants."""
+
+import dataclasses
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from test_serve import (
+    CFG,
+    _assert_tier_invariants,
+    _conv_reqs,
+    _decoder_and_params,
+    _mesh,
+)
+from tpu_patterns import faults
+from tpu_patterns.models.transformer import ModelConfig
+from tpu_patterns.serve import ServeEngine
+from tpu_patterns.serve.store import (
+    META_MEMBER,
+    PrefixStore,
+    block_fingerprint,
+    scan,
+)
+
+LEAVES_F32 = {
+    "k": ((1, 8, 2, 4), np.dtype(np.float32)),
+    "v": ((1, 8, 2, 4), np.dtype(np.float32)),
+}
+# the int8 pool shape: quantized planes plus their f32 scales — the
+# bit-identity contract covers BOTH (a store that round-trips the int8
+# payload but perturbs a scale plane corrupts every adopted block)
+LEAVES_I8 = {
+    "k": ((1, 8, 2, 4), np.dtype(np.int8)),
+    "k_scale": ((1, 8, 2, 1), np.dtype(np.float32)),
+    "v": ((1, 8, 2, 4), np.dtype(np.int8)),
+    "v_scale": ((1, 8, 2, 1), np.dtype(np.float32)),
+}
+
+
+def _block(leaves, seed):
+    rng = np.random.RandomState(seed)
+    out = {}
+    for name, (shape, dt) in leaves.items():
+        if dt == np.int8:
+            out[name] = rng.randint(-128, 128, size=shape).astype(dt)
+        else:
+            out[name] = rng.randn(*shape).astype(dt)
+    return out
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.configure(None)
+    yield
+    faults.configure(None)
+
+
+class TestPrefixStoreUnit:
+    @pytest.mark.parametrize(
+        "leaves", [LEAVES_F32, LEAVES_I8], ids=["f32", "int8"]
+    )
+    def test_round_trip_bit_identical(self, tmp_path, leaves):
+        st = PrefixStore(
+            str(tmp_path / "s"), leaves, block_len=8,
+            fingerprint={"cfg": 1},
+        )
+        path = tuple(range(16))
+        data = _block(leaves, 3)
+        nbytes = st.publish(
+            {n: a.copy() for n, a in data.items()}, path
+        )
+        assert nbytes == st.block_nbytes() == sum(
+            a.nbytes for a in data.values()
+        )
+        got = st.fetch(path)
+        assert set(got) == set(leaves)
+        for name, a in data.items():
+            assert got[name].dtype == a.dtype, name
+            assert np.array_equal(got[name], a), name
+
+    def test_fetch_miss_is_none_and_missing_dir_scans_empty(
+        self, tmp_path
+    ):
+        st = PrefixStore(str(tmp_path / "s"), LEAVES_F32, block_len=8)
+        assert st.fetch((1,) * 8) is None
+        assert len(st) == 0
+        assert scan(str(tmp_path / "nowhere")) == []
+
+    def test_last_commit_wins_and_no_tmp_litter(self, tmp_path):
+        # two handles on the SAME directory (two publishers): both
+        # commit the same path, the later os.replace wins whole
+        root = str(tmp_path / "s")
+        a = PrefixStore(root, LEAVES_F32, block_len=8)
+        b = PrefixStore(root, LEAVES_F32, block_len=8)
+        path = tuple(range(8))
+        first, second = _block(LEAVES_F32, 1), _block(LEAVES_F32, 2)
+        a.publish({n: x.copy() for n, x in first.items()}, path)
+        b.publish({n: x.copy() for n, x in second.items()}, path)
+        got = a.fetch(path)
+        for name in second:
+            assert np.array_equal(got[name], second[name])
+        assert len(a) == 1
+        assert not [f for f in os.listdir(root) if f.endswith(".tmp")]
+
+    def test_publish_validation_is_loud(self, tmp_path):
+        st = PrefixStore(str(tmp_path / "s"), LEAVES_F32, block_len=8)
+        data = _block(LEAVES_F32, 0)
+        with pytest.raises(ValueError, match="whole number"):
+            st.publish(data, tuple(range(5)))
+        with pytest.raises(ValueError, match="whole number"):
+            st.publish(data, ())
+        with pytest.raises(ValueError, match="leaves"):
+            st.publish({"k": data["k"]}, tuple(range(8)))
+        with pytest.raises(ValueError, match="shape"):
+            st.publish(
+                {"k": np.zeros((2, 8, 2, 4), np.float32),
+                 "v": np.zeros((2, 8, 2, 4), np.float32)},
+                tuple(range(8)),
+            )
+        with pytest.raises(ValueError, match="shadows"):
+            PrefixStore(
+                str(tmp_path / "t"),
+                {**LEAVES_F32, META_MEMBER: ((1,), np.dtype(np.int8))},
+                block_len=8,
+            )
+
+    def test_fetch_rejects_foreign_fingerprint(self, tmp_path):
+        root = str(tmp_path / "s")
+        st = PrefixStore(
+            root, LEAVES_F32, block_len=8, fingerprint={"cfg": 1}
+        )
+        path = tuple(range(8))
+        st.publish(_block(LEAVES_F32, 0), path)
+        other = PrefixStore(
+            root, LEAVES_F32, block_len=8, fingerprint={"cfg": 2}
+        )
+        with pytest.raises(ValueError, match="different pool/model"):
+            other.fetch(path)
+
+    def test_fetch_rejects_mismatched_block_len_and_leaf_table(
+        self, tmp_path
+    ):
+        root = str(tmp_path / "s")
+        st = PrefixStore(root, LEAVES_F32, block_len=8)
+        path = tuple(range(8))
+        st.publish(_block(LEAVES_F32, 0), path)
+        with pytest.raises(ValueError, match="block_len"):
+            PrefixStore(root, LEAVES_F32, block_len=4).fetch(path)
+        with pytest.raises(ValueError, match="leaf table"):
+            PrefixStore(root, LEAVES_I8, block_len=8).fetch(path)
+
+    def test_fetch_refuses_corrupt_payload(self, tmp_path):
+        # tamper with a committed entry's payload without updating the
+        # digest: fetch must refuse the block, never adopt wrong bytes
+        import json
+
+        st = PrefixStore(str(tmp_path / "s"), LEAVES_F32, block_len=8)
+        path = tuple(range(8))
+        st.publish(_block(LEAVES_F32, 0), path)
+        entry = st.entry_path(path)
+        with np.load(entry) as z:
+            meta = bytes(z[META_MEMBER])
+            payload = {
+                n: np.array(z[n]) for n in z.files if n != META_MEMBER
+            }
+        payload["k"] = payload["k"] + 1.0
+        with open(entry, "wb") as f:
+            np.savez(
+                f,
+                **{META_MEMBER: np.frombuffer(meta, np.uint8)},
+                **payload,
+            )
+        with pytest.raises(ValueError, match="digest"):
+            st.fetch(path)
+        # and the entry under the WRONG fingerprint key is refused too
+        ok = _block(LEAVES_F32, 1)
+        st.publish(ok, path)
+        os.replace(
+            st.entry_path(path),
+            os.path.join(
+                st.root, block_fingerprint(tuple(range(8, 16))) + ".npz"
+            ),
+        )
+        with pytest.raises(ValueError, match="does not match"):
+            st.fetch(tuple(range(8, 16)))
+
+    def test_scan_shallow_first_skips_foreign_and_inflight(
+        self, tmp_path
+    ):
+        root = str(tmp_path / "s")
+        st = PrefixStore(
+            root, LEAVES_F32, block_len=8, fingerprint={"cfg": 1}
+        )
+        deep = tuple(range(16))
+        st.publish(_block(LEAVES_F32, 1), deep)
+        st.publish(_block(LEAVES_F32, 0), deep[:8])
+        # garbage and an in-flight tmp sibling are not entries
+        with open(os.path.join(root, "junk.npz"), "wb") as f:
+            f.write(b"not an npz")
+        with open(os.path.join(root, "x.npz.1.0.tmp"), "wb") as f:
+            f.write(b"partial")
+        # a foreign-fingerprint entry is skipped quietly (scan is the
+        # advisory plane; fetch stays the loud path)
+        PrefixStore(
+            root, LEAVES_F32, block_len=8, fingerprint={"cfg": 2}
+        ).publish(_block(LEAVES_F32, 2), tuple(range(100, 108)))
+        got = [p for p, _ in st.scan()]
+        assert got == [deep[:8], deep]
+
+    def test_concurrent_publishers_never_tear_a_reader(self, tmp_path):
+        """Threaded hammer on ONE path: publishers race os.replace
+        while readers fetch continuously — every fetch must return a
+        COMPLETE committed payload (the digest check turns any torn
+        read into a loud error) that equals one of the published
+        variants bit-for-bit."""
+        root = str(tmp_path / "s")
+        path = tuple(range(8))
+        variants = [_block(LEAVES_F32, s) for s in range(4)]
+        errors: list = []
+        stop = threading.Event()
+
+        def publisher(seed):
+            st = PrefixStore(root, LEAVES_F32, block_len=8)
+            rng = np.random.RandomState(seed)
+            try:
+                for _ in range(25):
+                    v = variants[rng.randint(len(variants))]
+                    st.publish({n: a.copy() for n, a in v.items()}, path)
+            except Exception as e:  # noqa: BLE001 - failing the test
+                errors.append(e)
+
+        def reader():
+            st = PrefixStore(root, LEAVES_F32, block_len=8)
+            try:
+                while not stop.is_set():
+                    got = st.fetch(path)
+                    if got is None:
+                        continue
+                    assert any(
+                        all(
+                            np.array_equal(got[n], v[n]) for n in v
+                        )
+                        for v in variants
+                    ), "fetched payload matches no published variant"
+            except Exception as e:  # noqa: BLE001 - failing the test
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=publisher, args=(s,))
+            for s in range(3)
+        ] + [threading.Thread(target=reader) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads[:3]:
+            t.join()
+        stop.set()
+        for t in threads[3:]:
+            t.join()
+        assert not errors, errors
+        assert not [
+            f for f in os.listdir(root) if f.endswith(".tmp")
+        ], "a publisher left tmp litter"
+
+
+def _store_engine(devices, store_dir, *, n_blocks=15, slots=4,
+                  cache_int8=False, prefix_store=True, seed=0,
+                  fingerprint=None):
+    mesh = _mesh(devices, (1, 1, 1))
+    mcfg = ModelConfig(**CFG, depth=1)
+    dec, params, flat = _decoder_and_params(
+        mesh, mcfg, n_blocks=n_blocks, block_len=8, max_len=40,
+        cache_int8=cache_int8, seed=seed,
+    )
+    eng = ServeEngine(
+        dec, params, slots=slots, kv_host_tier=True,
+        prefix_store=(str(store_dir) if prefix_store else None),
+        fingerprint=fingerprint or {"t": 1, "int8": cache_int8},
+    )
+    return eng, dec, params
+
+
+class TestStoreEngineIntegration:
+    def test_requires_kv_host_tier_and_rejects_roles(self, devices):
+        mesh = _mesh(devices, (1, 1, 1))
+        mcfg = ModelConfig(**CFG, depth=1)
+        dec, params, _ = _decoder_and_params(mesh, mcfg)
+        with pytest.raises(ValueError, match="requires kv_host_tier"):
+            ServeEngine(dec, params, slots=2, prefix_store="/tmp/x")
+        with pytest.raises(ValueError, match="disaggregated"):
+            ServeEngine(
+                dec, params, slots=2, kv_host_tier=True,
+                prefix_store="/tmp/x", role="decode",
+            )
+
+    def test_store_off_is_free(self, devices):
+        eng, *_ = _store_engine(devices, None, prefix_store=False)
+        eng.run([dataclasses.replace(r) for r in _conv_reqs(4)])
+        assert eng.store is None
+        assert eng.stats["store_publishes"] == 0
+        assert eng.stats["store_hits"] == 0
+        _assert_tier_invariants(eng)
+
+    @pytest.mark.parametrize("int8", [False, True])
+    def test_second_engine_fetches_what_first_published(
+        self, devices, tmp_path, int8
+    ):
+        """The tentpole miss path: engine B's admission miss consults
+        the store engine A populated and serves with ZERO fresh full
+        prompt blocks — outputs bit-identical, both pools leak-free,
+        and every store round-trip bit-identical to A's host copy."""
+        sd = tmp_path / "store"
+        reqs = _conv_reqs(6)
+        e1, *_ = _store_engine(devices, sd, cache_int8=int8)
+        out1 = e1.run([dataclasses.replace(r) for r in reqs])
+        assert e1.stats["store_publishes"] > 0
+        assert len(e1.store) == e1.stats["store_publishes"]
+        assert e1.stats["store_publish_bytes"] == (
+            e1.stats["store_publishes"] * e1.store.block_nbytes()
+        )
+        _assert_tier_invariants(e1)
+        # bit-identity against the publisher's own host copies
+        for h, path in e1.tier.paths.items():
+            got = e1.store.fetch(path)
+            if got is None:
+                continue
+            for name, a in e1.tier.get(h).items():
+                assert got[name].dtype == a.dtype
+                assert np.array_equal(got[name], a), (path, name)
+        e2, *_ = _store_engine(devices, sd, cache_int8=int8, seed=0)
+        out2 = e2.run([dataclasses.replace(r) for r in reqs])
+        assert out2 == out1
+        assert e2.stats["store_hits"] > 0
+        assert e2.stats["prompt_fresh_full_blocks"] == 0
+        assert e2.stats["store_fetch_bytes"] == (
+            e2.stats["store_hits"] * e2.store.block_nbytes()
+        )
+        _assert_tier_invariants(e2)
+        # fleet-wide: both engines' ledgers balance
+        assert e1.leaked_blocks() + e2.leaked_blocks() == 0
+
+    def test_fetch_degrades_to_fresh_prefill_on_foreign_store(
+        self, devices, tmp_path
+    ):
+        """A store directory committed under a DIFFERENT model
+        fingerprint: every fetch hits a real entry, loud-rejects in
+        validation, and the engine degrades to fresh prefill
+        (store_fallbacks trail) — the trace still serves exactly."""
+        sd = tmp_path / "store"
+        reqs = _conv_reqs(4)
+        e1, *_ = _store_engine(devices, sd, seed=0)
+        want = e1.run([dataclasses.replace(r) for r in reqs])
+        assert e1.stats["store_publishes"] > 0
+        eng, *_ = _store_engine(
+            devices, sd, seed=0, fingerprint={"t": 999}
+        )
+        out = eng.run([dataclasses.replace(r) for r in reqs])
+        assert out == want
+        assert eng.stats["store_hits"] == 0
+        assert eng.stats["store_fallbacks"] > 0
+        assert eng.leaked_blocks() == 0
+        _assert_tier_invariants(eng)
+
+    def test_prewarm_adopts_into_host_tier(self, devices, tmp_path):
+        sd = tmp_path / "store"
+        reqs = _conv_reqs(6)
+        e1, *_ = _store_engine(devices, sd)
+        out1 = e1.run([dataclasses.replace(r) for r in reqs])
+        entries = e1.store.scan()
+        assert entries
+        e2, *_ = _store_engine(devices, sd, seed=0)
+        n = e2.prewarm_paths([list(p) for p, _ in entries])
+        assert n == len(entries) == e2.stats["store_prewarmed"]
+        assert len(e2.tier) == n
+        # non-block-aligned and unknown paths are skipped, not fatal
+        assert e2.prewarm_paths([[1, 2, 3], list(range(64, 72))]) == 0
+        out2 = e2.run([dataclasses.replace(r) for r in reqs])
+        assert out2 == out1
+        # the pre-warmed set answered the whole history: zero store
+        # round-trips at admission, zero fresh full prompt blocks
+        assert e2.stats["prompt_fresh_full_blocks"] == 0
+        assert e2.stats["onload_hits"] > 0
+        _assert_tier_invariants(e2)
+
+    def test_property_concurrent_publish_fetch_evict_death(
+        self, devices, tmp_path
+    ):
+        """Satellite property test: two engines share one store under
+        a seeded random op schedule — admissions (each engine sees a
+        random half of a shared-prefix family), scheduler iterations,
+        forced evictions, row quarantines, and DEATH (an engine is
+        dropped mid-trace and replaced by a fresh one on the same
+        store, like a SIGKILLed replica's slot respawning).  Every
+        step holds each engine's refcount/host/free invariants
+        (``sum(refcounts) == live table references`` via
+        ``_assert_tier_invariants``) and the fleet identity
+        ``sum(leaked_blocks) == 0``; every fetch that lands adopted a
+        complete committed block (digest-checked upstream)."""
+        sd = tmp_path / "store"
+        rng = np.random.RandomState(13)
+        all_reqs = _conv_reqs(8, n_gen=2)
+        engines = {}
+        for name in ("a", "b"):
+            eng, *_ = _store_engine(devices, sd, n_blocks=17)
+            engines[name] = eng
+        pending = {
+            "a": [r for i, r in enumerate(all_reqs) if i % 2 == 0][::-1],
+            "b": [r for i, r in enumerate(all_reqs) if i % 2 == 1][::-1],
+        }
+        deaths = 0
+        for step in range(80):
+            name = ("a", "b")[rng.randint(2)]
+            eng = engines[name]
+            op = rng.randint(5)
+            if op == 0 and pending[name]:
+                eng.submit(dataclasses.replace(pending[name].pop()))
+            eng._retire()
+            admitted = eng._admit()
+            if admitted:
+                eng._prefill(admitted)
+                eng._retire()
+            if op == 1 and eng.active:
+                eng._quarantine(
+                    [eng.active.pop(rng.randint(len(eng.active)))],
+                    "property-test",
+                )
+            if op == 2:
+                eng._evict_for(rng.randint(1, 3), set())
+            if op == 3 and deaths < 2 and step > 20:
+                # death: the engine vanishes mid-trace (its un-served
+                # half re-queues, like a parent rerouting leases) and
+                # a cold replacement joins on the same store
+                deaths += 1
+                dead = engines[name]
+                requeue = [
+                    dataclasses.replace(s.req)
+                    for s in dead.active
+                ] + [dataclasses.replace(r) for r in dead.queue]
+                fresh, *_ = _store_engine(devices, sd, n_blocks=17)
+                engines[name] = fresh
+                pending[name].extend(requeue)
+                eng = fresh
+            if eng.active:
+                eng._step()
+            eng._store_publish_wave()
+            for e in engines.values():
+                _assert_tier_invariants(e)
+            assert sum(
+                e.leaked_blocks() for e in engines.values()
+            ) == 0
+        # drain both engines clean
+        for name, eng in engines.items():
+            while pending[name] or eng.queue or eng.active:
+                if pending[name]:
+                    eng.submit(dataclasses.replace(pending[name].pop()))
+                eng._retire()
+                admitted = eng._admit()
+                if admitted:
+                    eng._prefill(admitted)
+                    eng._retire()
+                if eng.active:
+                    eng._step()
+                _assert_tier_invariants(eng)
+            eng._store_flush()
+            _assert_tier_invariants(eng)
+        assert sum(e.leaked_blocks() for e in engines.values()) == 0
+        # the survivors collectively used the store: blocks crossed
+        total_store_traffic = sum(
+            e.stats["store_publishes"] + e.stats["store_hits"]
+            for e in engines.values()
+        )
+        assert total_store_traffic > 0
+        assert len(engines["a"].store) > 0
+
+
+class TestStoreFaults:
+    def test_sites_registered_with_match_keys(self):
+        for site in ("store.publish", "store.fetch", "store.prewarm"):
+            assert site in faults.KNOWN_SITES
+        for key in ("rid", "replica", "fingerprint"):
+            assert key in faults.MATCH_KEYS
+
+    def test_publish_transient_error_retries_through(
+        self, devices, tmp_path
+    ):
+        faults.configure("store.publish:error:count=1")
+        eng, *_ = _store_engine(devices, tmp_path / "s")
+        eng.run([dataclasses.replace(r) for r in _conv_reqs(6)])
+        assert eng.stats["store_fallbacks"] == 0
+        assert eng.stats["store_publishes"] > 0
+        assert len(eng.store) == eng.stats["store_publishes"]
+        _assert_tier_invariants(eng)
+
+    def test_publish_deterministic_error_skips_never_tears(
+        self, devices, tmp_path
+    ):
+        sd = tmp_path / "s"
+        reqs = _conv_reqs(6)
+        clean, *_ = _store_engine(
+            devices, tmp_path / "clean", seed=0
+        )
+        want = clean.run([dataclasses.replace(r) for r in reqs])
+        faults.configure("store.publish:error:count=1000000")
+        eng, *_ = _store_engine(devices, sd, seed=0)
+        out = eng.run([dataclasses.replace(r) for r in reqs])
+        faults.configure(None)
+        # every publish quarantined: local serving untouched, the
+        # store holds NOTHING (no entry, no tmp litter) — degraded,
+        # never torn
+        assert out == want
+        assert eng.stats["store_publishes"] == 0
+        assert eng.stats["store_fallbacks"] > 0
+        assert len(eng.store) == 0
+        assert not [
+            f for f in os.listdir(sd) if f.endswith(".tmp")
+        ]
+        _assert_tier_invariants(eng)
+
+    def test_fetch_transient_error_retries_through(
+        self, devices, tmp_path
+    ):
+        sd = tmp_path / "s"
+        reqs = _conv_reqs(6)
+        e1, *_ = _store_engine(devices, sd)
+        out1 = e1.run([dataclasses.replace(r) for r in reqs])
+        faults.configure("store.fetch:error:count=1")
+        e2, *_ = _store_engine(devices, sd, seed=0)
+        out2 = e2.run([dataclasses.replace(r) for r in reqs])
+        assert out2 == out1
+        assert e2.stats["store_fallbacks"] == 0
+        assert e2.stats["store_hits"] > 0
+        _assert_tier_invariants(e2)
+
+    def test_fetch_deterministic_error_prefills_fresh(
+        self, devices, tmp_path
+    ):
+        """The satellite contract: deterministic store failure means
+        recompute, never a torn or half-adopted block — ids identical
+        to the publisher's run, zero store hits, zero leaks."""
+        sd = tmp_path / "s"
+        reqs = _conv_reqs(6)
+        e1, *_ = _store_engine(devices, sd)
+        out1 = e1.run([dataclasses.replace(r) for r in reqs])
+        faults.configure("store.fetch:error:count=1000000")
+        e2, *_ = _store_engine(devices, sd, seed=0)
+        out2 = e2.run([dataclasses.replace(r) for r in reqs])
+        assert out2 == out1
+        assert e2.stats["store_hits"] == 0
+        assert e2.stats["store_fallbacks"] > 0
+        assert e2.stats["prompt_fresh_full_blocks"] > 0
+        assert e2.leaked_blocks() == 0
+        _assert_tier_invariants(e2)
+
+    def test_fetch_scoped_to_one_fingerprint_spares_the_rest(
+        self, devices, tmp_path
+    ):
+        """The fingerprint match key: fail exactly ONE prefix's
+        migration — the victim recomputes fresh, every other path
+        still fetches warm, outputs stay exact."""
+        sd = tmp_path / "s"
+        reqs = _conv_reqs(6)
+        e1, *_ = _store_engine(devices, sd)
+        out1 = e1.run([dataclasses.replace(r) for r in reqs])
+        victim = e1.store.scan()[0][0]
+        faults.configure(
+            "store.fetch:error:count=1000000:"
+            f"fingerprint={block_fingerprint(victim)}"
+        )
+        e2, *_ = _store_engine(devices, sd, seed=0)
+        out2 = e2.run([dataclasses.replace(r) for r in reqs])
+        assert out2 == out1
+        assert e2.stats["store_fallbacks"] > 0
+        assert e2.stats["store_hits"] > 0  # the others still landed
+        _assert_tier_invariants(e2)
+
+    def test_corrupt_entry_degrades_loudly_not_fatally(
+        self, devices, tmp_path
+    ):
+        sd = tmp_path / "s"
+        reqs = _conv_reqs(6)
+        e1, *_ = _store_engine(devices, sd)
+        out1 = e1.run([dataclasses.replace(r) for r in reqs])
+        # truncate one committed entry in place: a real torn write
+        # cannot happen through os.replace, so simulate disk rot
+        victim = e1.store.entry_path(e1.store.scan()[0][0])
+        with open(victim, "r+b") as f:
+            f.truncate(32)
+        e2, *_ = _store_engine(devices, sd, seed=0)
+        out2 = e2.run([dataclasses.replace(r) for r in reqs])
+        assert out2 == out1
+        assert e2.stats["store_fallbacks"] > 0
+        assert e2.leaked_blocks() == 0
+        _assert_tier_invariants(e2)
+
+    def test_prewarm_deterministic_error_leaves_no_partial_adopt(
+        self, devices, tmp_path
+    ):
+        sd = tmp_path / "s"
+        e1, *_ = _store_engine(devices, sd)
+        e1.run([dataclasses.replace(r) for r in _conv_reqs(6)])
+        entries = e1.store.scan()
+        faults.configure("store.prewarm:error:count=1000000")
+        e2, *_ = _store_engine(devices, sd, seed=0)
+        assert e2.prewarm_paths([list(p) for p, _ in entries]) == 0
+        assert e2.stats["store_prewarmed"] == 0
+        assert e2.stats["store_fallbacks"] > 0
+        assert len(e2.tier) == 0
+        assert not e2.index.host_handles()
+        _assert_tier_invariants(e2)
+
+    def test_prewarm_transient_error_retries_through(
+        self, devices, tmp_path
+    ):
+        sd = tmp_path / "s"
+        e1, *_ = _store_engine(devices, sd)
+        e1.run([dataclasses.replace(r) for r in _conv_reqs(6)])
+        entries = e1.store.scan()
+        faults.configure("store.prewarm:error:count=1")
+        e2, *_ = _store_engine(devices, sd, seed=0)
+        assert e2.prewarm_paths(
+            [list(p) for p, _ in entries]
+        ) == len(entries)
+        assert e2.stats["store_fallbacks"] == 0
+        _assert_tier_invariants(e2)

@@ -431,6 +431,35 @@ def _cmd_serve(args, writer: ResultWriter) -> None:
             "error: --disagg splits a replica fleet into prefill and "
             "decode pools — it needs --replicas N with P+D == N"
         )
+    if cfg.prefix_store:
+        # same parse-time surface as --preempt: the fleet prefix store
+        # rides the host tier and the replica fleet, and the rejected
+        # combos read as one line instead of a runtime traceback
+        if not cfg.kv_host_tier:
+            raise SystemExit(
+                "error: --prefix_store requires --kv_host_tier — "
+                "fetched blocks adopt through the host tier's onload "
+                "path"
+            )
+        if cfg.disagg:
+            raise SystemExit(
+                "error: --prefix_store and --disagg are mutually "
+                "exclusive: the handoff wire owns cross-engine KV "
+                "movement in a disaggregated fleet"
+            )
+        if not cfg.replicas:
+            raise SystemExit(
+                "error: --prefix_store runs through --replicas N (the "
+                "fleet store migrates KV across replicas); "
+                "single-engine restart persistence is --session_dir"
+            )
+        if cfg.scenario:
+            raise SystemExit(
+                "error: --prefix_store and --scenario are mutually "
+                "exclusive: the routing-comparison A/B would leak "
+                "warmth between its legs through the shared store — "
+                "run the store on the plain --prefix_share trace"
+            )
     if cfg.scenario:
         # parse-time checks up front so spec typos and rejected flag
         # combos read as one line (same surface as loadgen); runtime

@@ -84,6 +84,13 @@ KNOWN_SITES = frozenset({
     # OPEN at every call site — a booking error skips the record,
     # never the scheduler action being recorded
     "obs.cost_book",
+    # the fleet prefix store (serve/store.py via serve/engine.py):
+    # publish fires before the device→host gather + tmp/os.replace
+    # commit, fetch before an admission-miss store read, prewarm
+    # before a scale-out pre-fetch — all three degrade to fresh
+    # prefill on deterministic failure (recompute, never a torn or
+    # half-adopted block)
+    "store.publish", "store.fetch", "store.prewarm",
 })
 
 # ctx keys the call sites actually pass — the only keys a match
@@ -101,6 +108,10 @@ MATCH_KEYS = frozenset({
     # the live telemetry plane's scrape site is matchable per endpoint
     # (metrics | healthz | statusz | other — obs/live.py)
     "endpoint",
+    # the store.* sites carry the block's radix path fingerprint
+    # (serve/store.py block_fingerprint), so a chaos spec can fail
+    # exactly one prefix's migration (store.fetch:error:fingerprint=…)
+    "fingerprint",
 })
 
 

@@ -42,6 +42,7 @@ from tpu_patterns.core.timing import clock_ns
 ACTIONS = (
     "defer", "evict", "shed", "preempt",
     "scale_out", "scale_in", "breaker", "reroute", "handoff",
+    "prewarm",
 )
 
 # per action: the existing counter the ledger must stay in identity
@@ -57,6 +58,7 @@ COUNTER_IDENTITIES = {
     "breaker": "tpu_patterns_replica_breaker_trips_total",
     "reroute": "tpu_patterns_router_reroutes_total",
     "handoff": "tpu_patterns_disagg_transfers_total",
+    "prewarm": "tpu_patterns_fleet_prewarms_total",
 }
 
 

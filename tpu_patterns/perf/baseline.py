@@ -118,6 +118,14 @@ METRIC_CLASS = {
     "kv_onload_bytes": "analytic",
     "kv_evictions": "analytic",
     "kv_onload_hits": "analytic",
+    # fleet prefix-store round-trip (perf/registry.py
+    # _capture_prefix_store): publish/fetch traffic at the fixed
+    # deterministic trace — analytic-banded so a thundering-herd
+    # regression (every replica republishing or refetching the same
+    # blocks) fails perf diff like a FLOP-count drift would
+    "store_publish_bytes": "analytic",
+    "store_fetch_bytes": "analytic",
+    "store_hits": "analytic",
     # disagg KV-block wire (perf/registry.py _capture_disagg_stream):
     # the shipped-payload byte floor is closed-form from the block
     # shape (analytic: ratcheted everywhere), the wire wall clock is

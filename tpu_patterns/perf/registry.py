@@ -94,6 +94,7 @@ EXECUTABLES = (
     "disagg.stream",
     "serve.step",
     "serve.kv_tier",
+    "serve.prefix_store",
 )
 
 
@@ -1084,6 +1085,88 @@ def _capture_kv_tier(mesh, cfg: PerfConfig) -> dict:
     }
 
 
+def _capture_prefix_store(mesh, cfg: PerfConfig) -> dict:
+    """The fleet prefix-store round-trip: a publisher engine serves the
+    deterministic session trace with the store attached (every retained
+    or evicted full block commits), then a cold consumer engine serves
+    the SAME trace against the warm store — its admission misses fetch
+    instead of prefilling.  ``store_publish_bytes`` /
+    ``store_fetch_bytes`` / ``store_hits`` are exact host-side
+    accounting at the fixed trace, ratcheted in the ``analytic`` class:
+    a thundering-herd regression (republish or refetch traffic
+    exploding at the same trace) fails ``perf diff`` both directions —
+    plus the measured decode wall clock of the warm consumer leg."""
+    import shutil
+    import tempfile
+
+    from tpu_patterns.serve.engine import (
+        ServeConfig,
+        ServeEngine,
+        _kv_tier_pool,
+        _session_trace,
+    )
+
+    scfg = ServeConfig(
+        vocab=cfg.vocab, embed=cfg.embed, heads=cfg.heads,
+        head_dim=cfg.head_dim, mlp_mult=cfg.mlp_mult, depth=cfg.depth,
+        dtype=cfg.dtype, rope=cfg.rope, kv_heads=cfg.kv_heads,
+        cache_int8=cfg.cache_int8, slots=cfg.slots,
+        block_len=cfg.block_len, requests=cfg.requests, gen=cfg.gen,
+        seed=cfg.seed,
+    )
+    trace, _gen = _session_trace(scfg)
+    mcfg = _mcfg(cfg)
+
+    import jax
+
+    from tpu_patterns.models.lm import init_lm_params
+    from tpu_patterns.models.transformer import _n_experts
+
+    flat = init_lm_params(
+        jax.random.key(cfg.seed), mcfg, cfg.vocab, _n_experts(mesh, mcfg)
+    )
+    decoder, params, _n_blocks = _kv_tier_pool(mesh, scfg, mcfg, flat)
+
+    store_dir = tempfile.mkdtemp(prefix="tpu_patterns_perf_store_")
+    try:
+        pub = ServeEngine(
+            decoder, params, slots=scfg.slots, kv_host_tier=True,
+            prefix_store=store_dir,
+        )
+        pub.run([dataclasses.replace(r) for r in trace])
+
+        def run_once():
+            eng = ServeEngine(
+                decoder, params, slots=scfg.slots, kv_host_tier=True,
+                prefix_store=store_dir,
+            )
+            eng.run([dataclasses.replace(r) for r in trace])
+            return eng
+
+        run_once()  # warm every bucket (gather/fetch/onload included)
+        reps = []
+        eng = None
+        for _ in range(cfg.k):
+            s0, c0 = _hist_state("tpu_patterns_serve_decode_wall_ms")
+            eng = run_once()
+            s1, c1 = _hist_state("tpu_patterns_serve_decode_wall_ms")
+            if c1 > c0:
+                reps.append((s1 - s0) / (c1 - c0))
+        st = eng.stats
+        return {
+            # exact store traffic at the fixed trace — deterministic,
+            # so it rides the analytic ratchet band
+            "store_publish_bytes": float(
+                pub.stats["store_publish_bytes"]
+            ),
+            "store_fetch_bytes": float(st["store_fetch_bytes"]),
+            "store_hits": float(st["store_hits"]),
+            "step_ms": _median_ms(reps) if reps else -1.0,
+        }
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+
+
 # -- the snapshot ----------------------------------------------------------
 
 
@@ -1162,6 +1245,11 @@ def capture(mesh, cfg: PerfConfig, writer=None) -> dict:
     if "serve.kv_tier" in names:
         say("perf capture: serve.kv_tier (tiered-KV offload trace)")
         executables["serve.kv_tier"] = _capture_kv_tier(mesh, cfg)
+    if "serve.prefix_store" in names:
+        say("perf capture: serve.prefix_store (fleet-store round-trip)")
+        executables["serve.prefix_store"] = _capture_prefix_store(
+            mesh, cfg
+        )
 
     n_chips = int(np.asarray(mesh.devices).size)
     for name, metrics in executables.items():

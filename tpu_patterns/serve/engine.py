@@ -51,6 +51,7 @@ from tpu_patterns.obs.slo import SloConfig, SloMonitor
 from tpu_patterns.serve.kvtier import HostTier
 from tpu_patterns.serve.paged import TRASH_BLOCK, make_paged_lm_decoder
 from tpu_patterns.serve.prefix import PrefixIndex
+from tpu_patterns.serve.store import PrefixStore, block_fingerprint
 
 # format 2: per-block refcounts, the prefix index, and slot prompts
 # joined the host-side state (PR 7) — older snapshots lack them and are
@@ -164,7 +165,8 @@ class ServeEngine:
                  burn_mitigation: str = "off",
                  preempt: str = "off",
                  role: str = "",
-                 spool_dir: str | None = None):
+                 spool_dir: str | None = None,
+                 prefix_store: str | None = None):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if role not in ("", "prefill", "decode"):
@@ -180,6 +182,16 @@ class ServeEngine:
             raise ValueError(f"spec_k must be >= 0, got {spec_k}")
         if session_dir and not kv_host_tier:
             raise ValueError("session_dir requires kv_host_tier")
+        if prefix_store and not kv_host_tier:
+            raise ValueError(
+                "prefix_store requires kv_host_tier: fetched blocks "
+                "adopt through the host tier's onload path"
+            )
+        if prefix_store and role:
+            raise ValueError(
+                "prefix_store is incompatible with disaggregated "
+                "roles: the handoff wire owns cross-engine KV movement"
+            )
         if burn_mitigation not in ("off", "shed", "spec_off"):
             raise ValueError(
                 f"burn_mitigation must be off | shed | spec_off, got "
@@ -267,6 +279,24 @@ class ServeEngine:
         # last-reference, leaf-first), and page back on a prefix hit —
         # the degradation ladder alias -> evict -> defer
         self.tier: HostTier | None = None
+        # the fleet prefix store (serve/store.py): a shared atomic-
+        # commit directory every replica publishes materialized full
+        # prefix blocks into (eagerly, so a SIGKILLed replica's warm
+        # prefixes are already fleet-visible) and consults on an
+        # admission miss before prefilling fresh
+        self.store: PrefixStore | None = None
+        # blocks awaiting publication, with the path captured at
+        # materialize time (block ids are reused; the pair lets the
+        # publish wave drop stale entries instead of shipping a
+        # repurposed block under an old path)
+        self._store_pending: list[tuple[int, tuple[int, ...]]] = []
+        # paths this engine already published (or adopted FROM the
+        # store) — republishing is safe but wasted wire
+        self._store_published: set[tuple[int, ...]] = set()
+        # per-request fresh full prompt blocks (the per-rid split of
+        # prompt_fresh_full_blocks): what the fleet's fail-over gate
+        # reads to prove rerouted requests landed warm
+        self.fresh_by_rid: dict[int, int] = {}
         # device-resident retained blocks: refcount 0 but kept out of
         # the free list so a future prefix hit can alias them; value is
         # a monotonic last-reference stamp (LRU order, clock-free so
@@ -286,6 +316,12 @@ class ServeEngine:
                 capacity_blocks=host_tier_blocks,
                 fingerprint=dict(fingerprint or {}),
             )
+            if prefix_store:
+                self.store = PrefixStore(
+                    prefix_store, leaf_meta,
+                    block_len=self.layout.block_len,
+                    fingerprint=dict(fingerprint or {}),
+                )
         # self-drafting speculative decoding: propose up to spec_k
         # tokens per row per step, verify all of them in ONE wide call
         self.spec_k = spec_k
@@ -362,6 +398,10 @@ class ServeEngine:
             "tier_fallbacks": 0, "pressure_admits": 0,
             "session_loaded": 0, "prompt_fresh_full_blocks": 0,
             "retained_peak": 0,
+            # fleet prefix store accounting (all 0 with the store off)
+            "store_publishes": 0, "store_publish_bytes": 0,
+            "store_hits": 0, "store_fetch_bytes": 0,
+            "store_prewarmed": 0, "store_fallbacks": 0,
             # burn-rate mitigation accounting (0 with the ladder off)
             "sheds": 0,
             # priority preemption accounting (0 with preempt="off"):
@@ -572,6 +612,13 @@ class ServeEngine:
             self.index.evict_block(b, h)
             self.retained.pop(b, None)
             self.free.append(b)
+        if self.store is not None:
+            # the host bytes are already in hand — publish the wave to
+            # the fleet store alongside the tier copy (best-effort:
+            # a publish failure never affects the eviction above)
+            self._store_publish_entries(
+                [(path, data) for _, data, path in entries], rid=rid
+            )
         n_bytes = self.tier.block_nbytes() * len(entries)
         self.stats["evictions"] += len(entries)
         self.stats["evict_bytes"] += n_bytes
@@ -725,6 +772,278 @@ class ServeEngine:
             host_blocks=str(len(self.tier)),
         )
         return blocks
+
+    # -- the fleet prefix store (serve/store.py) -------------------------
+
+    def _store_fallback(self, op: str, err: Exception) -> None:
+        """A store operation failed deterministically: degrade to
+        fresh prefill / skip publication for this wave — engine state
+        is unchanged (never a torn or half-adopted block) — and leave
+        a visible WARNING trail."""
+        import os
+        import sys
+
+        from tpu_patterns import obs
+        from tpu_patterns.core.results import Record, ResultWriter, Verdict
+
+        self.stats["store_fallbacks"] += 1
+        obs.counter("tpu_patterns_store_fallbacks_total").inc()
+        obs.event("serve.store_fallback", op=op, error=str(err))
+        try:
+            ResultWriter(
+                jsonl_path=os.path.join(obs.run_dir(), "serve.jsonl"),
+                stream=sys.stderr,
+            ).record(Record(
+                pattern="serve",
+                mode="store_fallback",
+                commands=op,
+                metrics={"pid": float(os.getpid())},
+                verdict=Verdict.WARNING,
+                notes=[
+                    f"prefix store {op} failed after retries ({err}); "
+                    "degrading to fresh prefill for this wave — "
+                    "engine state unchanged, never torn"
+                ],
+            ))
+        # graftlint: allow[bare-except-in-runtime] -- the fallback trail is best-effort; a logging failure must not turn a healed recompute into a crash
+        except Exception:
+            pass
+
+    def _store_enqueue(self, blocks) -> None:
+        """Queue newly materialized blocks for publication (the index
+        only holds whole blocks, so every node path is block-aligned).
+        The path is captured NOW: block ids are recycled, and the
+        publish wave re-checks the pair before gathering."""
+        if self.store is None:
+            return
+        for b in blocks:
+            path = self.index.node_path(b)
+            if path and path not in self._store_published:
+                self._store_pending.append((b, path))
+
+    def _store_publish_entries(self, entries, rid: int = -1) -> int:
+        """Commit host-side block payloads to the store under the
+        ``store.publish`` fault site: tmp + ``os.replace`` per block
+        (last-commit-wins, readers never torn).  ``entries`` is
+        ``[(path, {leaf: host array})]``; returns blocks published.
+        Deterministic failure skips publication — local serving is
+        untouched (the store is never load-bearing)."""
+        from tpu_patterns import obs
+
+        todo = [
+            (path, data)
+            for path, data in entries
+            if tuple(path) not in self._store_published
+        ]
+        if self.store is None or not todo:
+            return 0
+
+        def attempt():
+            # fault site: before any file I/O — a retried publish
+            # rewrites the same content under the same keys
+            # (idempotent by the commit protocol)
+            faults.inject(
+                "store.publish", rid=rid, rows=len(todo),
+                replica=self.replica,
+                fingerprint=block_fingerprint(todo[0][0]),
+            )
+            return sum(
+                self.store.publish(data, path) for path, data in todo
+            )
+
+        try:
+            n_bytes = faults.call_with_retry(
+                attempt, policy=self.retry_policy, site="store.publish"
+            )
+        except (OSError, faults.Quarantined) as e:
+            self._store_fallback("publish", e)
+            return 0
+        for path, _ in todo:
+            self._store_published.add(tuple(path))
+        self.stats["store_publishes"] += len(todo)
+        self.stats["store_publish_bytes"] += n_bytes
+        obs.counter("tpu_patterns_store_publishes_total").inc(len(todo))
+        obs.histogram("tpu_patterns_store_publish_bytes").observe(
+            float(n_bytes)
+        )
+        obs.event(
+            "serve.store_publish", blocks=str(len(todo)),
+            replica=self.replica,
+        )
+        return len(todo)
+
+    def _store_publish_wave(self, limit: int = 8, rid: int = -1) -> int:
+        """Publish up to ``limit`` pending materialized blocks in one
+        compiled gather (the pool is NOT donated — publication never
+        mutates device state).  Eager, at iteration boundaries: a
+        SIGKILLed replica cannot be asked for its warm set post-
+        mortem, so the set must already be fleet-visible."""
+        if self.store is None or not self._store_pending:
+            return 0
+        batch: list[tuple[int, tuple[int, ...]]] = []
+        while self._store_pending and len(batch) < limit:
+            b, path = self._store_pending.pop(0)
+            # stale pair: published meanwhile, evicted/freed, or the
+            # block id was recycled under a different path
+            if path in self._store_published:
+                continue
+            if not self.index.is_materialized(b):
+                continue
+            if self.index.node_path(b) != path:
+                continue
+            batch.append((b, path))
+        if not batch:
+            return 0
+        n = _bucket(len(batch), max(self.layout.n_blocks - 1, 1))
+        src = np.full((n,), TRASH_BLOCK, np.int32)
+        for i, (b, _) in enumerate(batch):
+            src[i] = b
+        out = self.decoder.gather_jit(n)(self.pool, src)
+        # graftlint: allow[host-sync-in-hot-path] -- this sync IS the publication: the device->host block copy the fleet store exists to share, bounded per iteration
+        host = {name: np.asarray(leaf) for name, leaf in out.items()}
+        return self._store_publish_entries(
+            [
+                (path, {name: host[name][:, i] for name in host})
+                for i, (_, path) in enumerate(batch)
+            ],
+            rid=rid,
+        )
+
+    def _store_flush(self) -> int:
+        """Drain/run-end flush: everything still unpublished —
+        pending device-resident blocks AND the host tier's resident
+        set — reaches the store before the engine exits, so fail-over
+        reroutes and restarts land warm."""
+        if self.store is None:
+            return 0
+        n = 0
+        while self._store_pending:
+            done = self._store_publish_wave()
+            if not done and self._store_pending:
+                # deterministic publish failure (or all-stale tail):
+                # drop the rest — the flush must not wedge shutdown
+                self._store_pending = []
+                break
+            n += done
+        n += self._store_publish_entries([
+            (self.tier.paths[h], self.tier.get(h))
+            for h in sorted(self.tier.store)
+        ])
+        return n
+
+    def _store_fetch(self, req, need: int, covered: int) -> list[int]:
+        """Admission-miss consult: extend the plan's coverage with
+        store blocks, contiguously from ``covered`` full blocks deep.
+        Each hit lands in the HOST tier + index (``add_host_path``) and
+        returns as a restore handle — the caller onloads it exactly
+        like a local host-tier hit (indistinguishable by design).  Any
+        miss/failure stops the run: coverage stays a contiguous
+        prefix, the rest prefills fresh."""
+        from tpu_patterns import obs
+
+        out: list[int] = []
+        if self.store is None:
+            return out
+        bl = self.layout.block_len
+        for j in range(covered, min(need, len(req.tokens) // bl)):
+            path = tuple(req.tokens[: (j + 1) * bl])
+
+            def attempt(path=path):
+                # fault site: before the store read — nothing adopted
+                # yet, so an ``error`` retries cleanly
+                faults.inject(
+                    "store.fetch", rid=req.rid, replica=self.replica,
+                    fingerprint=block_fingerprint(path),
+                )
+                return self.store.fetch(path)
+
+            try:
+                data = faults.call_with_retry(
+                    attempt, policy=self.retry_policy, site="store.fetch"
+                )
+            except (OSError, faults.Quarantined) as e:
+                self._store_fallback("fetch", e)
+                break
+            except ValueError as e:
+                # foreign-config or corrupt entry: refused upstream —
+                # the loud trail, then fresh prefill
+                self._store_fallback("fetch-validate", e)
+                break
+            if data is None:
+                break  # a miss at depth j means no deeper entry helps
+            h = self.tier.put(data, path)
+            if not self.index.add_host_path(path, h):
+                # duplicate (raced with a local admission) — the local
+                # copy wins, the fetched bytes are dropped whole
+                self.tier.discard(h)
+                break
+            out.append(h)
+            self._store_published.add(path)  # already fleet-visible
+            self.stats["store_hits"] += 1
+            self.stats["store_fetch_bytes"] += self.store.block_nbytes()
+            obs.counter("tpu_patterns_store_hits_total").inc()
+            obs.histogram("tpu_patterns_store_fetch_bytes").observe(
+                float(self.store.block_nbytes())
+            )
+        if out:
+            obs.event(
+                "serve.store_fetch", rid=str(req.rid),
+                blocks=str(len(out)), replica=self.replica,
+            )
+        return out
+
+    def prewarm_paths(self, paths) -> int:
+        """Scale-out pre-warm: fetch the ring arc's hottest prefixes
+        from the store into the HOST tier (shallow-first; onload is
+        lazy — the first admission hit pages them onto device).  Any
+        failure stops the walk: a cold replica is correct, just
+        slower."""
+        from tpu_patterns import obs
+
+        if self.store is None:
+            return 0
+        n = 0
+        for path in sorted(
+            (tuple(int(t) for t in p) for p in paths),
+            key=lambda p: (len(p), p),
+        ):
+            if len(path) % self.layout.block_len or not path:
+                continue
+
+            def attempt(path=path):
+                faults.inject(
+                    "store.prewarm", replica=self.replica,
+                    fingerprint=block_fingerprint(path),
+                )
+                return self.store.fetch(path)
+
+            try:
+                data = faults.call_with_retry(
+                    attempt, policy=self.retry_policy,
+                    site="store.prewarm",
+                )
+            except (OSError, faults.Quarantined) as e:
+                self._store_fallback("prewarm", e)
+                break
+            except ValueError as e:
+                self._store_fallback("prewarm-validate", e)
+                break
+            if data is None:
+                continue
+            h = self.tier.put(data, path)
+            if not self.index.add_host_path(path, h):
+                self.tier.discard(h)
+                continue
+            self._store_published.add(path)
+            n += 1
+        if n:
+            self.stats["store_prewarmed"] += n
+            obs.counter("tpu_patterns_store_prewarms_total").inc(n)
+            obs.event(
+                "serve.store_prewarm", blocks=str(n),
+                replica=self.replica,
+            )
+        return n
 
     def save_session(self) -> None:
         """Persist the session cache: evict every retained block to the
@@ -1111,6 +1430,16 @@ class ServeEngine:
                 if plan and self.tier is not None
                 else []
             )
+            if self.store is not None:
+                # the fleet store consult: an admission miss extends
+                # its coverage with blocks a SIBLING replica published
+                # — fetched entries land in the host tier + index and
+                # ride the same onload below, indistinguishable from
+                # a local alias/restore hit (miss or failure = fresh
+                # prefill, never a half-adopted block)
+                restores += self._store_fetch(
+                    req, need, len(aliased) + len(restores)
+                )
             # the ladder's middle rung: restore targets and fresh
             # blocks both draw on the free list — when it runs dry,
             # evict cold retained blocks to host BEFORE giving up.
@@ -1209,8 +1538,15 @@ class ServeEngine:
                 obs.counter(
                     "tpu_patterns_serve_prefix_hit_blocks_total"
                 ).inc(covered)
-            self.stats["prompt_fresh_full_blocks"] += max(
+            fresh_full = max(
                 0, len(req.tokens) // self.layout.block_len - covered
+            )
+            self.stats["prompt_fresh_full_blocks"] += fresh_full
+            # per-rid split: the fleet's fail-over gate proves
+            # REROUTED requests' fresh prefill dropped, which needs
+            # this keyed by rid, not the engine-wide total
+            self.fresh_by_rid[req.rid] = (
+                self.fresh_by_rid.get(req.rid, 0) + fresh_full
             )
             own_blocks: tuple[int, ...] = ()
             if self.index is not None:
@@ -1358,6 +1694,10 @@ class ServeEngine:
         if self.index is not None:
             for s in slots:
                 self.index.materialize(list(s.own_blocks))
+                # publish-on-materialize: once prefilled, a full
+                # block's contents are immutable (CoW discipline) —
+                # queue it for the fleet store's next publish wave
+                self._store_enqueue(s.own_blocks)
         obs.counter("tpu_patterns_serve_tokens_total").inc(len(slots))
         self.stats["prefills"] += 1
         self.active.extend(slots)
@@ -2272,6 +2612,12 @@ class ServeEngine:
                     # ticks the allocated count was constant, so
                     # busy + free == pool x elapsed closes exactly
                     self.cost.tick(self.allocated_blocks())
+                    # fleet prefix store: publish this iteration's
+                    # newly materialized full blocks (bounded wave,
+                    # pool not donated).  Eager by design — a replica
+                    # SIGKILLed next iteration has already made its
+                    # warm prefixes fleet-visible
+                    self._store_publish_wave()
                     if self.breaker_tripped:
                         # the engine declared itself unhealthy: stop at
                         # this iteration boundary with queue + verdicts
@@ -2301,6 +2647,12 @@ class ServeEngine:
                     if self._preempt.is_set():
                         self._take_preemption()
                         break
+            if self.store is not None:
+                # drain/run-end flush: pending and host-resident
+                # blocks reach the fleet store before this engine
+                # exits — a drained replica's retained set ships so
+                # fail-over reroutes land warm
+                self._store_flush()
             if self.tier is not None and self.tier.session_dir:
                 # bank the session cache at the run boundary: every
                 # retained prefix evicts to host and commits, so a
@@ -2393,6 +2745,15 @@ class ServeConfig:
     session_dir: str = ""
     host_tier_blocks: int = 0  # host-tier capacity in blocks (0 = unbounded)
     min_tier_speedup: float = 1.0  # tier-vs-defer tokens/s gate
+    # the fleet prefix store (serve/store.py): a shared atomic-commit
+    # directory every replica publishes materialized full prefix
+    # blocks into and consults on an admission miss before prefilling
+    # — fail-over reroutes land warm on the survivors and scale-out
+    # replicas pre-warm their ring arc.  Requires --kv_host_tier and
+    # --replicas (KV migration ACROSS replicas; single-engine restart
+    # persistence is --session_dir); incompatible with --disagg (the
+    # handoff wire owns cross-engine KV movement there).  "" = off.
+    prefix_store: str = ""
     # trace-driven load generation: a loadgen scenario spec
     # ("chat", "rag:requests=16", ... — loadgen/scenarios.py grammar).
     # Set, the run becomes the SLO measured pattern: the scenario's
@@ -2578,6 +2939,10 @@ def _serve_fingerprint(cfg: ServeConfig, n_blocks: int) -> dict:
               "min_speedup", "min_block_savings", "min_accepted",
               "min_replica_speedup", "replica_watchdog_s", "replica_dir",
               "session_dir", "host_tier_blocks", "min_tier_speedup",
+              # the fleet store is a pure optimization plane: a fetch
+              # replaces recompute with bit-identical bytes, so the
+              # token stream never depends on it
+              "prefix_store",
               # the telemetry plane and burn ladder never shape the
               # token stream (shed requests are terminal bookkeeping,
               # spec_off is bit-identical) — a resumed run may change
@@ -3342,9 +3707,31 @@ def run_serve(mesh, cfg: ServeConfig, writer) -> list:
                 "serve --preempt requires --kv_host_tier (a preempted "
                 "row parks in the host tier)"
             )
+        if cfg.prefix_store and not cfg.kv_host_tier:
+            raise ValueError(
+                "serve --prefix_store requires --kv_host_tier "
+                "(fetched blocks adopt through the host tier)"
+            )
+        if cfg.prefix_store and cfg.disagg:
+            raise ValueError(
+                "serve --prefix_store is incompatible with --disagg: "
+                "the handoff wire owns cross-engine KV movement there"
+            )
+        if cfg.prefix_store and cfg.scenario:
+            raise ValueError(
+                "serve --prefix_store is incompatible with "
+                "--scenario: the routing-comparison A/B would leak "
+                "warmth between its legs through the shared store"
+            )
         from tpu_patterns.serve.replica import run_replicas
 
         return run_replicas(mesh, cfg, writer)
+    if cfg.prefix_store:
+        raise ValueError(
+            "serve --prefix_store runs through --replicas (the fleet "
+            "store migrates KV across replicas); single-engine "
+            "restart persistence is --session_dir"
+        )
     if cfg.disagg:
         raise ValueError(
             "serve --disagg splits a replica fleet into prefill and "

@@ -274,6 +274,15 @@ class _StdinSource:
                 # disagg: a handoff manifest routed here by the parent —
                 # queued for _admit_adopts at the next iteration head
                 self._engine.adopt_queue.append(dict(msg["m"]))
+            elif op == "prewarm":
+                # scale-out pre-warm: the parent shipped this replica's
+                # ring-arc store prefixes — fetch them into the host
+                # tier here, between engine iterations (the source runs
+                # at the loop head, so adoption is engine-thread-safe;
+                # failure = a cold start, never a torn block)
+                self._engine.prewarm_paths(
+                    list(msg.get("paths", []))
+                )
             elif op == "fin":
                 self.fin = True
             elif op == "drain":
@@ -360,6 +369,19 @@ def _child_stats(eng) -> dict:
         "adopts": eng.stats["adopts"],
         "adopted_blocks": eng.stats["adopted_blocks"],
         "adopt_recomputes": eng.stats["adopt_recomputes"],
+        "store_publishes": eng.stats["store_publishes"],
+        "store_publish_bytes": eng.stats["store_publish_bytes"],
+        "store_hits": eng.stats["store_hits"],
+        "store_fetch_bytes": eng.stats["store_fetch_bytes"],
+        "store_prewarmed": eng.stats["store_prewarmed"],
+        "store_fallbacks": eng.stats["store_fallbacks"],
+        # per-rid fresh full prompt blocks (JSON keys are strings):
+        # the warm-failover gate sums these over the REROUTED rids —
+        # the engine-wide total cannot tell a rerouted request's
+        # recompute from everyone else's
+        "fresh_full_blocks_by_rid": {
+            str(rid): n for rid, n in eng.fresh_by_rid.items()
+        },
         "leaked_blocks": eng.leaked_blocks(),
     }
 
@@ -496,6 +518,14 @@ def replica_main() -> int:
                 role="" if warming else role,
                 spool_dir=(
                     None if warming else (init.get("spool_dir") or None)
+                ),
+                # the fleet prefix store: per-replica handles on ONE
+                # shared directory.  The warm-up engine must neither
+                # publish its throwaway traffic nor fetch real blocks
+                # into an engine about to be discarded
+                prefix_store=(
+                    None if warming
+                    else (cfg.get("prefix_store") or None)
                 ),
             )
 
@@ -778,6 +808,58 @@ class FleetResult:
             s.get("handoff_recomputes", 0) + s.get("adopt_recomputes", 0)
             for s in self.replica_stats.values()
         ))
+
+    def store_publishes(self) -> int:
+        return int(sum(
+            s.get("store_publishes", 0)
+            for s in self.replica_stats.values()
+        ))
+
+    def store_publish_bytes(self) -> int:
+        return int(sum(
+            s.get("store_publish_bytes", 0)
+            for s in self.replica_stats.values()
+        ))
+
+    def store_hits(self) -> int:
+        """Admission misses answered from the fleet prefix store,
+        across every engine that reported."""
+        return int(sum(
+            s.get("store_hits", 0) for s in self.replica_stats.values()
+        ))
+
+    def store_fetch_bytes(self) -> int:
+        return int(sum(
+            s.get("store_fetch_bytes", 0)
+            for s in self.replica_stats.values()
+        ))
+
+    def store_prewarmed(self) -> int:
+        return int(sum(
+            s.get("store_prewarmed", 0)
+            for s in self.replica_stats.values()
+        ))
+
+    def store_fallbacks(self) -> int:
+        return int(sum(
+            s.get("store_fallbacks", 0)
+            for s in self.replica_stats.values()
+        ))
+
+    def rerouted_fresh_blocks(self) -> int:
+        """Fresh full prompt blocks the REROUTED requests re-prefilled
+        after fail-over, summed over every engine that reported their
+        second act — the warm-failover headline: with the fleet store
+        on, this drops strictly below the private-tier baseline."""
+        total = 0
+        for s in self.replica_stats.values():
+            by_rid = s.get("fresh_full_blocks_by_rid", {})
+            total += sum(
+                int(n)
+                for rid, n in by_rid.items()
+                if int(rid) in self.rerouted
+            )
+        return total
 
     def scale_outs(self) -> int:
         return sum(1 for _, a, _ in self.scale_events if a == "out")
@@ -1377,6 +1459,72 @@ class ReplicaManager:
         res.obs_stalls = self.obs_stalls
         return res
 
+    # how many store blocks a pre-warm ships to one newcomer: enough
+    # to cover its arc's hot prefixes, small enough that adoption
+    # can't crowd out the first routed requests
+    PREWARM_CAP = 64
+
+    def _send_prewarm(self, h: ReplicaHandle, res: FleetResult) -> None:
+        """Ship a just-joined replica its ring arc's hottest fleet-store
+        prefixes.  The parent only picks PATHS — it scans the store
+        directory (advisory plane), keeps the paths whose router
+        fingerprint lands on ``h``'s arc, ranks hottest-first by
+        commit stamp, closes over ancestors (the child's radix adopt
+        needs parents before children), and sends one ``prewarm`` op;
+        the child fetches/validates the bytes itself through
+        ``ServeEngine.prewarm_paths`` behind the ``store.prewarm``
+        fault site.  Best-effort: an empty or unreadable store is a
+        cold start, exactly what scale-out did before the store."""
+        from tpu_patterns import obs
+        from tpu_patterns.serve.router import prefix_fingerprint
+        from tpu_patterns.serve.store import scan
+
+        entries = scan(self.child_cfg["prefix_store"])
+        bl = self.router.block_len
+        stamp = dict(entries)
+        mine = [
+            (path, st)
+            for path, st in entries
+            if self.router.ring.lookup(
+                prefix_fingerprint(
+                    list(path), bl, self.router.route_blocks
+                )
+            ) == h.id
+        ]
+        picked: set[tuple[int, ...]] = set()
+        for path, _ in sorted(mine, key=lambda e: -e[1]):
+            if len(picked) >= self.PREWARM_CAP:
+                break
+            # ancestor closure: a child block is only adoptable once
+            # every ancestor block is — pull in whichever ancestors
+            # the store holds so the chain lands whole
+            for k in range(bl, len(path) + 1, bl):
+                anc = path[:k]
+                if anc in stamp:
+                    picked.add(anc)
+        if not picked:
+            return
+        paths = sorted(picked, key=lambda p: (len(p), p))
+        try:
+            h.send({
+                "op": "prewarm",
+                "paths": [list(p) for p in paths],
+            })
+        except ReplicaError:
+            self._replica_down(h, "send failed", res)
+            return
+        obs.event(
+            "fleet.prewarm", replica=h.id, blocks=len(paths),
+        )
+        obs.counter("tpu_patterns_fleet_prewarms_total").inc()
+        self.decisions.book(
+            "prewarm",
+            rationale="scale-out replica joined the ring; shipping "
+                      "its arc's hottest fleet-store prefixes so its "
+                      "first routed requests land warm",
+            target=h.id, blocks=len(paths),
+        )
+
     def _dispatch(self, req: Request, res: FleetResult) -> None:
         from tpu_patterns import obs
 
@@ -1430,6 +1578,11 @@ class ReplicaManager:
                 h.state = "ready"
                 self.router.restore(h.id)
                 obs.event("fleet.scale_ready", replica=h.id)
+                if self.child_cfg.get("prefix_store"):
+                    # pre-warm the newcomer: ship its ring arc's
+                    # hottest fleet-store prefixes so its first
+                    # routed requests land warm instead of cold
+                    self._send_prewarm(h, res)
             return
         if msg.get("ready") is False:
             # a late spawn failed init: it never joined the ring and
@@ -1820,6 +1973,7 @@ def run_replicas(mesh, cfg, writer) -> list:
     from tpu_patterns.serve.engine import (
         _dense_expected,
         _serve_commands,
+        _shared_trace,
         random_trace,
     )
     from tpu_patterns.topo import placement, topology
@@ -1924,7 +2078,19 @@ def run_replicas(mesh, cfg, writer) -> list:
             )
     else:
         spec = None
-        timed = [(0.0, r) for r in random_trace(cfg)]
+        if prefix_share:
+            # the fleet's plain trace under --prefix_share is the
+            # shared-prefix chat schedule (75% shared by default) —
+            # the same deterministic trace the single-engine sharing
+            # pattern serves, and the schedule the prefix-store chaos
+            # leg kills a replica under: reroutes land on a sibling
+            # whose fresh-prefill count the store must strictly cut
+            trace, _ = _shared_trace(
+                cfg, np.random.RandomState(cfg.seed + 2)
+            )
+        else:
+            trace = random_trace(cfg)
+        timed = [(0.0, r) for r in trace]
         max_len = cfg.max_prompt + cfg.gen
         oracle_cfg = cfg
 
@@ -1950,6 +2116,10 @@ def run_replicas(mesh, cfg, writer) -> list:
         "kv_host_tier": cfg.kv_host_tier,
         "host_tier_blocks": cfg.host_tier_blocks,
         "preempt": cfg.preempt,
+        # the fleet prefix store rides the child cfg explicitly — the
+        # old bridge silently DROPPED unknown keys, so children would
+        # have ignored --prefix_store without this line
+        "prefix_store": cfg.prefix_store,
         # children must build the sampling decoder iff any request in
         # the trace samples (the runner.py idiom) — a greedy decoder
         # silently argmaxes a temperature>0 request otherwise
@@ -2386,6 +2556,18 @@ def run_replicas(mesh, cfg, writer) -> list:
             "drains": float(res_n.drains),
             "spawn_retries": float(res_n.spawn_retries),
             "prefix_hit_blocks": float(res_n.prefix_hit_blocks()),
+            # fleet prefix store accounting (all 0 with the store
+            # off): the chaos A/B reads rerouted_fresh_blocks — the
+            # warm-failover headline — straight off this Record
+            "rerouted_fresh_blocks": float(
+                res_n.rerouted_fresh_blocks()
+            ),
+            "store_publishes": float(res_n.store_publishes()),
+            "store_publish_bytes": float(res_n.store_publish_bytes()),
+            "store_hits": float(res_n.store_hits()),
+            "store_fetch_bytes": float(res_n.store_fetch_bytes()),
+            "store_prewarmed": float(res_n.store_prewarmed()),
+            "store_fallbacks": float(res_n.store_fallbacks()),
             "tokens": float(res_n.tokens()),
             "fleet_shipped_done": float(res_n.shipped_done),
             "fleet_shipped_failed": float(res_n.shipped_failed),
